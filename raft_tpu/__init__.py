@@ -1,0 +1,12 @@
+"""raft_tpu — TPU-native frequency-domain floating wind turbine framework.
+
+A ground-up JAX/XLA re-design of the capabilities of NREL's RAFT (reference
+mounted at /root/reference): strip-theory + potential-flow hydrodynamics of
+member-based floating platforms, quasi-static mooring, linearized aero-servo
+rotor dynamics, second-order wave loads, multi-turbine arrays, and design
+optimization interfaces — with frequencies, load cases, headings, and design
+variants as batched array axes sharded over TPU meshes.
+"""
+from raft_tpu import _config  # noqa: F401  (sets x64 before anything traces)
+
+__version__ = "0.1.0"

@@ -1,0 +1,26 @@
+"""Global numerical configuration for raft_tpu.
+
+The frequency-domain solves (batched complex 6x6 linear systems, hydrostatic
+stiffness assembly, eigen solves) need float64 to match the CPU reference to
+1e-6 (reference regression tolerances: rtol=1e-5/atol=1e-3 on PSDs,
+atol~1e-10 on statics — see /root/reference tests/test_model.py,
+tests/test_fowt.py).  We therefore enable JAX x64 mode at import unless the
+user opts out with RAFT_TPU_X64=0 (e.g. for a pure-throughput bf16/f32 TPU
+sweep where accuracy is traded for speed).
+"""
+import os
+
+import jax
+
+if os.environ.get("RAFT_TPU_X64", "1") != "0":
+    jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402  (after x64 flag)
+
+#: default real/complex dtypes used when building model arrays
+def real_dtype():
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def complex_dtype():
+    return jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64

@@ -1,0 +1,1 @@
+from raft_tpu.ops import geometry, spectra, transforms, waves  # noqa: F401

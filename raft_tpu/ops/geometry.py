@@ -1,0 +1,92 @@
+"""Frustum volume/centroid/inertia primitives for member geometry.
+
+Reference: raft/helpers.py:36-63 (FrustumVCV) and raft/raft_member.py:321-402
+(FrustumMOI, RectangularFrustumMOI).  These run at model-build time *and*
+inside jitted design sweeps (geometry is a differentiable design variable),
+so they are written as pure jnp with circular/rectangular variants split
+into separate functions instead of the reference's isinstance branching.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def frustum_vcv_circ(dA, dB, H):
+    """Volume and center-of-volume height of a circular frustum with end
+    diameters dA (bottom), dB (top) and height H.  Batched elementwise.
+    Returns (V, hc) with hc measured from the dA end."""
+    dA, dB, H = jnp.asarray(dA, float), jnp.asarray(dB, float), jnp.asarray(H, float)
+    A1 = (jnp.pi / 4) * dA**2
+    A2 = (jnp.pi / 4) * dB**2
+    Am = (jnp.pi / 4) * dA * dB
+    denom = A1 + Am + A2
+    V = denom * H / 3.0
+    hc = jnp.where(denom > 0, ((A1 + 2 * Am + 3 * A2) / jnp.where(denom > 0, denom, 1.0)) * H / 4.0, 0.0)
+    return V, hc
+
+
+def frustum_vcv_rect(slA, slB, H):
+    """Rectangular (pyramidal) frustum volume/centroid; slA, slB are (...,2)
+    side-length pairs at the two ends."""
+    slA, slB, H = jnp.asarray(slA, float), jnp.asarray(slB, float), jnp.asarray(H, float)
+    A1 = slA[..., 0] * slA[..., 1]
+    A2 = slB[..., 0] * slB[..., 1]
+    Am = jnp.sqrt(A1 * A2)
+    denom = A1 + Am + A2
+    V = denom * H / 3.0
+    hc = jnp.where(denom > 0, ((A1 + 2 * Am + 3 * A2) / jnp.where(denom > 0, denom, 1.0)) * H / 4.0, 0.0)
+    return V, hc
+
+
+def frustum_moi_circ(dA, dB, H, p):
+    """Axial (Izz) and transverse (Ixx=Iyy) moments of inertia of a solid
+    circular frustum about the center of its *bottom* end, density p.
+    Closed-form integrals of r(z) = rA + (rB-rA) z/H (matches reference
+    raft/raft_member.py:321-339)."""
+    dA, dB, H = jnp.asarray(dA, float), jnp.asarray(dB, float), jnp.asarray(H, float)
+    rA, rB = 0.5 * dA, 0.5 * dB
+    m = jnp.where(H > 0, (rB - rA) / jnp.where(H > 0, H, 1.0), 0.0)
+    # uniform-cylinder limit (m==0) vs tapered closed forms; m guarded so the
+    # dead branch stays finite (and differentiable) under jnp.where
+    m_safe = jnp.where(m == 0, 1.0, m)
+    Izz_t = (jnp.pi * p / (10.0 * m_safe)) * (rB**5 - rA**5)
+    Ixx_t = jnp.pi * p * (
+        H**3 / 30.0 * (rA**2 + 3.0 * rA * rB + 6.0 * rB**2)
+        + 1.0 / 20.0 / m_safe * (rB**5 - rA**5)
+    )
+    Izz_cyl = 0.5 * jnp.pi * p * H * rA**4
+    Ixx_cyl = jnp.pi * p * H * (rA**4 / 4.0 + (H**2 * rA**2) / 3.0)
+    Izz = jnp.where(m == 0, Izz_cyl, Izz_t)
+    Ixx = jnp.where(m == 0, Ixx_cyl, Ixx_t)
+    return Ixx, Izz
+
+
+def frustum_moi_rect(slA, slB, H, p):
+    """Moments of inertia of a solid rectangular frustum about the center of
+    its bottom end; slA/slB are (...,2) side pairs (matches reference
+    raft/raft_member.py:341-402 semantics via direct z-integration of the
+    linearly-interpolated cross-section)."""
+    slA, slB, H = jnp.asarray(slA, float), jnp.asarray(slB, float), jnp.asarray(H, float)
+    # cross-section sides a(z), b(z) are linear in z, so the integrands are
+    # polynomials of degree <= 5; 8-point Gauss-Legendre (exact to degree 15)
+    # integrates them exactly
+    xg, wg = _GL8
+    z = H[..., None] * xg
+    t = jnp.where(H[..., None] > 0, z / jnp.where(H[..., None] > 0, H[..., None], 1.0), 0.0)
+    a = slA[..., 0:1] * (1 - t) + slB[..., 0:1] * t
+    b = slA[..., 1:2] * (1 - t) + slB[..., 1:2] * t
+    w = H[..., None] * wg
+    Izz = jnp.sum(w * p * (a * b) * (a**2 + b**2) / 12.0, axis=-1)
+    Ixx = jnp.sum(w * p * ((a * b**3) / 12.0 + a * b * z**2), axis=-1)
+    Iyy = jnp.sum(w * p * ((b * a**3) / 12.0 + a * b * z**2), axis=-1)
+    return Ixx, Iyy, Izz
+
+
+def _gl8():
+    import numpy as np
+
+    x, w = np.polynomial.legendre.leggauss(8)
+    return (0.5 * (x + 1.0)), (0.5 * w)
+
+
+_GL8 = _gl8()

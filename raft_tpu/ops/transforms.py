@@ -1,0 +1,159 @@
+"""Rigid-body frame transforms as batched jnp ops.
+
+Covers the reference's transform kernel set (reference: raft/helpers.py:314-579
+— SmallRotate, VecVecTrans, getH, rotationMatrix, translateForce3to6DOF,
+transformForce, translateMatrix3to6DOF, translateMatrix6to6DOF, rotateMatrix3,
+rotateMatrix6, RotFrm2Vect).  All functions here are pure, shape-polymorphic
+over leading batch axes where noted, and jit/vmap-safe.  Matrix layouts use
+the Sadeghi & Incecik 6-DOF block convention  [[m, J], [J^T, I]].
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def small_rotate(r, th):
+    """First-order (small-angle) displacement of point ``r`` under rotation ``th``.
+
+    r: (..., 3) real;  th: (..., 3) possibly complex rotation amplitudes.
+    Returns cross(th, r) elementwise (reference: raft/helpers.py:314-326).
+    """
+    r = jnp.asarray(r)
+    th = jnp.asarray(th)
+    return jnp.stack(
+        [
+            -th[..., 2] * r[..., 1] + th[..., 1] * r[..., 2],
+            th[..., 2] * r[..., 0] - th[..., 0] * r[..., 2],
+            -th[..., 1] * r[..., 0] + th[..., 0] * r[..., 1],
+        ],
+        axis=-1,
+    )
+
+
+def vec_vec_trans(v):
+    """Outer product v v^T for (...,3) vectors -> (...,3,3)."""
+    v = jnp.asarray(v)
+    return v[..., :, None] * v[..., None, :]
+
+
+def skew(r):
+    """Alternator ("H") matrix: H(r) @ x == cross(x, r) in the reference's
+    sign convention (reference: raft/helpers.py:346-355).  r: (...,3)."""
+    r = jnp.asarray(r)
+    z = jnp.zeros_like(r[..., 0])
+    return jnp.stack(
+        [
+            jnp.stack([z, r[..., 2], -r[..., 1]], axis=-1),
+            jnp.stack([-r[..., 2], z, r[..., 0]], axis=-1),
+            jnp.stack([r[..., 1], -r[..., 0], z], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def rotation_matrix(x3, x2, x1):
+    """Intrinsic z-y-x (Tait-Bryan) rotation matrix; args are the roll(x3),
+    pitch(x2), yaw(x1) angles in radians, matching the reference's argument
+    order (reference: raft/helpers.py:357-384).  Scalar or batched."""
+    x3, x2, x1 = jnp.asarray(x3), jnp.asarray(x2), jnp.asarray(x1)
+    s1, c1 = jnp.sin(x1), jnp.cos(x1)
+    s2, c2 = jnp.sin(x2), jnp.cos(x2)
+    s3, c3 = jnp.sin(x3), jnp.cos(x3)
+    row0 = jnp.stack([c1 * c2, c1 * s2 * s3 - c3 * s1, s1 * s3 + c1 * c3 * s2], axis=-1)
+    row1 = jnp.stack([c2 * s1, c1 * c3 + s1 * s2 * s3, c3 * s1 * s2 - c1 * s3], axis=-1)
+    row2 = jnp.stack([-s2, c2 * s3, c2 * c3], axis=-1)
+    return jnp.stack([row0, row1, row2], axis=-2)
+
+
+def translate_force_3to6(F, r):
+    """Force (...,3) acting at point r (...,3) -> 6-DOF wrench (...,6) about
+    the origin (reference: raft/helpers.py:386-401)."""
+    F = jnp.asarray(F)
+    r = jnp.asarray(r)
+    m = jnp.cross(jnp.broadcast_to(r, F.shape).astype(F.dtype), F)
+    return jnp.concatenate([F, m], axis=-1)
+
+
+def transform_force(f, offset=None, rotmat=None):
+    """Rotate a 3- or 6-wrench by ``rotmat`` then shift its point of action by
+    ``offset`` (reference: raft/helpers.py:404-451)."""
+    f = jnp.asarray(f)
+    if f.shape[-1] == 3:
+        f = jnp.concatenate([f, jnp.zeros_like(f)], axis=-1)
+    F, M = f[..., :3], f[..., 3:]
+    if rotmat is not None:
+        F = jnp.einsum("...ij,...j->...i", rotmat, F)
+        M = jnp.einsum("...ij,...j->...i", rotmat, M)
+    if offset is not None:
+        offset = jnp.asarray(offset)
+        M = M + jnp.cross(jnp.broadcast_to(offset, F.shape).astype(F.dtype), F)
+    return jnp.concatenate([F, M], axis=-1)
+
+
+def translate_matrix_3to6(M, r):
+    """3x3 mass matrix about its CG -> 6x6 about a point offset by r
+    (parallel-axis; reference: raft/helpers.py:455-478).  M: (...,3,3),
+    r: (...,3) -> (...,6,6)."""
+    M = jnp.asarray(M)
+    H = skew(r).astype(M.dtype)
+    MH = M @ H
+    top = jnp.concatenate([M, MH], axis=-1)
+    bot = jnp.concatenate([jnp.swapaxes(MH, -1, -2), H @ M @ jnp.swapaxes(H, -1, -2)], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def translate_matrix_6to6(M, r):
+    """6x6 mass/inertia matrix translated to a new reference point; r points
+    from the new reference to the current one (reference:
+    raft/helpers.py:481-503)."""
+    M = jnp.asarray(M)
+    H = skew(r).astype(M.dtype)
+    Ht = jnp.swapaxes(H, -1, -2)
+    m = M[..., :3, :3]
+    J = M[..., :3, 3:]
+    I = M[..., 3:, 3:]
+    Jp = m @ H + J
+    Ip = H @ m @ Ht + jnp.swapaxes(J, -1, -2) @ H + Ht @ J + I
+    top = jnp.concatenate([m, Jp], axis=-1)
+    bot = jnp.concatenate([jnp.swapaxes(Jp, -1, -2), Ip], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def rotate_matrix_3(M, R):
+    """Congruence rotation R M R^T (reference: raft/helpers.py:545-558)."""
+    return R @ M @ jnp.swapaxes(R, -1, -2)
+
+
+def rotate_matrix_6(M, R):
+    """Blockwise rotation of a 6x6 tensor (reference: raft/helpers.py:507-542).
+    Note the reference symmetrizes the off-diagonal block (lower = upper^T)
+    rather than rotating it independently; we reproduce that.
+    M: (...,6,6), R: (...,3,3)."""
+    Rt = jnp.swapaxes(R, -1, -2)
+    m = R @ M[..., :3, :3] @ Rt
+    J = R @ M[..., :3, 3:] @ Rt
+    I = R @ M[..., 3:, 3:] @ Rt
+    top = jnp.concatenate([m, J], axis=-1)
+    bot = jnp.concatenate([jnp.swapaxes(J, -1, -2), I], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def rot_frm_2_vect(A, B):
+    """Rodrigues rotation matrix taking direction A to direction B; identity
+    when they are (anti)parallel (reference: raft/helpers.py:561-579)."""
+    A = jnp.asarray(A, dtype=float)
+    B = jnp.asarray(B, dtype=float)
+    A = A / jnp.linalg.norm(A, axis=-1, keepdims=True)
+    B = B / jnp.linalg.norm(B, axis=-1, keepdims=True)
+    v = jnp.cross(A, B)
+    v2 = jnp.sum(v * v, axis=-1)
+    ssc = -skew(v)  # reference's ssc is skew-symmetric cross-product matrix of v
+    dotAB = jnp.sum(A * B, axis=-1)
+    # guard the v2==0 division; result replaced by identity below
+    safe_v2 = jnp.where(v2 == 0.0, 1.0, v2)
+    R = (
+        jnp.eye(3)
+        + ssc
+        + (ssc @ ssc) * ((1.0 - dotAB) / safe_v2)[..., None, None]
+    )
+    return jnp.where((v2 == 0.0)[..., None, None], jnp.eye(3), R)

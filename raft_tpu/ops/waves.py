@@ -1,0 +1,264 @@
+"""Linear (Airy) wave kinematics and second-order wave terms as batched jnp ops.
+
+TPU-first reimplementation of the reference wave kernel set (reference:
+raft/helpers.py:66-310 — getKinematics, getWaveKin, getWaveKin_grad_u1,
+getWaveKin_grad_dudt, getWaveKin_grad_pres1st, getWaveKin_axdivAcc,
+getWaveKin_pot2ndOrd, waveNumber).  The reference evaluates these in
+per-frequency / per-node Python loops; here every function is fully
+vectorized over frequency and broadcastable over node/heading batch axes so
+the whole excitation field is computed as one fused XLA program.
+
+Conventions follow the reference: wave heading ``beta`` is in *radians* for
+the first-order kinematics and in *degrees* for the gradient/second-order
+kernels (the reference mixes conventions; see each docstring).  z is positive
+up with the free surface at z=0; nodes above the surface produce zeros.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_G_DEFAULT = 9.81
+
+# same deep-water switch threshold as the reference (raft/helpers.py:133)
+_KH_DEEP = 89.4
+
+
+def wave_number(w, h, g=_G_DEFAULT, tol=1e-3):
+    """Solve the linear dispersion relation w^2 = g k tanh(k h) for k.
+
+    Replicates the reference's fixed-point iteration *including its early
+    stopping* (reference: raft/helpers.py:295-310): each frequency iterates
+    k <- w^2/(g tanh(k h)) from the deep-water seed until the relative change
+    drops below ``tol``, independently per element (converged elements are
+    frozen so results match the serial reference bit-for-bit up to fp
+    reassociation).
+
+    w: (...,) rad/s (w=0 returns k=0);  h: scalar depth [m].
+    """
+    w = jnp.asarray(w, dtype=float)
+    w2g = w * w / g
+    k1 = w2g  # deep-water seed
+    k2 = w2g / jnp.tanh(jnp.maximum(k1, 1e-300) * h)
+    done = jnp.abs(k2 - k1) / jnp.maximum(k1, 1e-300) <= tol
+
+    def cond(state):
+        _, _, done = state
+        return ~jnp.all(done)
+
+    def body(state):
+        k1, k2, done = state
+        k1n = jnp.where(done, k1, k2)
+        k2n = jnp.where(done, k2, w2g / jnp.tanh(jnp.maximum(k1n, 1e-300) * h))
+        done_n = done | (jnp.abs(k2n - k1n) / jnp.maximum(k1n, 1e-300) <= tol)
+        return k1n, k2n, done_n
+
+    _, k2, _ = lax.while_loop(cond, body, (k1, k2, done))
+    return jnp.where(w == 0.0, 0.0, k2)
+
+
+def _depth_ratios(k, z, h):
+    """The three hyperbolic depth-attenuation ratios, numerically safe.
+
+    Returns (sinh(k(z+h))/sinh(kh), cosh(k(z+h))/sinh(kh),
+    cosh(k(z+h))/cosh(kh)) with the reference's deep-water switchover at
+    k h > 89.4 (reference: raft/helpers.py:126-140).  Shapes broadcast.
+    """
+    kh = k * h
+    kh_safe = jnp.minimum(kh, _KH_DEEP)  # keep cosh/sinh finite in dead branch
+    kzh = jnp.minimum(k * (z + h), _KH_DEEP)
+    shallow_s = jnp.sinh(kzh) / jnp.sinh(kh_safe)
+    shallow_c = jnp.cosh(kzh) / jnp.sinh(kh_safe)
+    shallow_cc = jnp.cosh(kzh) / jnp.cosh(kh_safe)
+    deep = jnp.exp(k * z)
+    deep_cc = deep + jnp.exp(-k * (z + 2.0 * h))
+    use_deep = kh > _KH_DEEP
+    s_ratio = jnp.where(use_deep, deep, shallow_s)
+    c_ratio = jnp.where(use_deep, deep, shallow_c)
+    cc_ratio = jnp.where(use_deep, deep_cc, shallow_cc)
+    # k == 0 limit as in the reference (raft/helpers.py:128-132)
+    s_ratio = jnp.where(k == 0.0, 1.0, s_ratio)
+    c_ratio = jnp.where(k == 0.0, 99999.0, c_ratio)
+    cc_ratio = jnp.where(k == 0.0, 99999.0, cc_ratio)
+    return s_ratio, c_ratio, cc_ratio
+
+
+def wave_kinematics(zeta0, beta, w, k, h, r, rho=1025.0, g=_G_DEFAULT):
+    """First-order wave kinematics at point(s) r from an elevation spectrum.
+
+    Vectorized equivalent of the reference's per-frequency loop (reference:
+    raft/helpers.py:105-154).
+
+    Parameters
+    ----------
+    zeta0 : (nw,) complex wave elevation amplitudes at the origin
+    beta : wave heading [rad] (scalar)
+    w, k : (nw,) frequencies [rad/s] and wave numbers [1/m]
+    h : water depth [m]
+    r : (..., 3) node position(s); any leading batch shape
+    Returns (u, ud, pDyn): velocities (...,3,nw), accelerations (...,3,nw),
+    dynamic pressure (...,nw); zero above the waterline.
+    """
+    zeta0 = jnp.asarray(zeta0)
+    r = jnp.asarray(r, dtype=float)
+    batch = r.shape[:-1]
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    cosb, sinb = jnp.cos(beta), jnp.sin(beta)
+    # phase shift to node location: (..., nw)
+    phase = jnp.exp(-1j * k * (cosb * x + sinb * y)[..., None])
+    zeta = zeta0 * phase
+    s_r, c_r, cc_r = _depth_ratios(k, z[..., None], h)
+    wet = (z <= 0.0)[..., None]
+    u = jnp.stack(
+        [
+            w * zeta * c_r * cosb,
+            w * zeta * c_r * sinb,
+            1j * w * zeta * s_r,
+        ],
+        axis=len(batch),
+    )
+    u = jnp.where(wet[..., None, :], u, 0.0)
+    ud = 1j * w * u
+    pDyn = jnp.where(wet, rho * g * zeta * cc_r, 0.0)
+    return u, ud, pDyn
+
+
+def kinematics_from_motion(r, Xi, w):
+    """Node displacement/velocity/acceleration amplitudes from 6-DOF platform
+    motion Xi (6, nw) at offset r (...,3) from the PRP (reference:
+    raft/helpers.py:66-101).  Returns (dr, v, a), each (...,3,nw)."""
+    Xi = jnp.asarray(Xi)
+    r = jnp.asarray(r, dtype=float)
+    trans = Xi[..., :3, :]  # (...,3,nw)
+    rot = Xi[..., 3:, :]
+    # small-angle cross term: cross(th, r) per frequency
+    rx = r[..., :, None]
+    disp_rot = jnp.stack(
+        [
+            -rot[..., 2, :] * rx[..., 1, :] + rot[..., 1, :] * rx[..., 2, :],
+            rot[..., 2, :] * rx[..., 0, :] - rot[..., 0, :] * rx[..., 2, :],
+            -rot[..., 1, :] * rx[..., 0, :] + rot[..., 0, :] * rx[..., 1, :],
+        ],
+        axis=-2,
+    )
+    dr = trans + disp_rot
+    v = 1j * w * dr
+    a = 1j * w * v
+    return dr, v, a
+
+
+def _grad_ratios_deg(k, z, h, denom_sinh=True):
+    """Depth ratios for the gradient kernels, which use a k*h >= 10 deep-water
+    switch (reference: raft/helpers.py:168-175, 213-220)."""
+    kh = k * h
+    kh_safe = jnp.minimum(kh, _KH_DEEP)
+    kzh = jnp.minimum(k * (z + h), _KH_DEEP)
+    den = jnp.sinh(kh_safe) if denom_sinh else jnp.cosh(kh_safe)
+    shallow_xy = jnp.cosh(kzh) / den
+    shallow_z = jnp.sinh(kzh) / den
+    deep = jnp.exp(k * z)
+    use_deep = kh >= 10.0
+    return jnp.where(use_deep, deep, shallow_xy), jnp.where(use_deep, deep, shallow_z)
+
+
+def wave_vel_gradient(w, k, beta_deg, h, r):
+    """Spatial gradient matrix of first-order wave velocity, (...,3,3).
+
+    Reference: raft/helpers.py:157-195.  NOTE the reference uses
+    cos(deg2rad(beta)) for the directional factors but cos(beta) (radians)
+    inside the phase exponent; we reproduce that exactly for parity — in the
+    main QTF path headings are integer degrees so both agree only at beta=0.
+    """
+    r = jnp.asarray(r, dtype=float)
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    b = jnp.deg2rad(beta_deg)
+    cosB, sinB = jnp.cos(b), jnp.sin(b)
+    cosb_r, sinb_r = jnp.cos(beta_deg), jnp.sin(beta_deg)  # reference phase uses radians-interp
+    khz_xy, khz_z = _grad_ratios_deg(k, z, h, denom_sinh=True)
+    phase = jnp.exp(-1j * (k * (cosb_r * x + sinb_r * y)))
+    aux_x = w * cosB * phase
+    aux_y = w * sinB * phase
+    aux_z = 1j * w * phase
+    zero = jnp.zeros_like(phase)
+    g00 = -1j * aux_x * khz_xy * k * cosB
+    g01 = -1j * aux_x * khz_xy * k * sinB
+    g02 = aux_x * k * khz_z
+    g11 = -1j * aux_y * khz_xy * k * sinB
+    g12 = aux_y * k * khz_z
+    g22 = aux_z * k * khz_xy
+    grad = jnp.stack(
+        [
+            jnp.stack([g00, g01, g02], axis=-1),
+            jnp.stack([g01, g11, g12], axis=-1),
+            # reference fills grad[2,0]=du/dz and grad[2,1]=du/dy (sic)
+            jnp.stack([g02, g01, g22], axis=-1),
+        ],
+        axis=-2,
+    )
+    active = ((z <= 0.0) & (k > 0.0))[..., None, None]
+    return jnp.where(active, grad, jnp.zeros_like(zero)[..., None, None])
+
+
+def wave_acc_gradient(w, k, beta_deg, h, r):
+    """Gradient of first-order wave acceleration (reference:
+    raft/helpers.py:198-199)."""
+    return 1j * w * wave_vel_gradient(w, k, beta_deg, h, r)
+
+
+def wave_pres1st_gradient(k, beta_deg, h, r, rho=1025.0, g=_G_DEFAULT):
+    """Gradient of first-order dynamic pressure, (...,3) (reference:
+    raft/helpers.py:202-225).  Same mixed-units phase convention caveat as
+    wave_vel_gradient."""
+    r = jnp.asarray(r, dtype=float)
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    b = jnp.deg2rad(beta_deg)
+    cosB, sinB = jnp.cos(b), jnp.sin(b)
+    khz_xy, khz_z = _grad_ratios_deg(k, z, h, denom_sinh=False)
+    phase = jnp.exp(-1j * (k * (cosB * x + sinB * y)))
+    gx = rho * g * khz_xy * phase * (-1j * k * cosB)
+    gy = rho * g * khz_xy * phase * (-1j * k * sinB)
+    gz = rho * g * khz_z * phase * k
+    grad = jnp.stack([gx, gy, gz], axis=-1)
+    active = ((z <= 0.0) & (k > 0.0))[..., None]
+    return jnp.where(active, grad, 0.0)
+
+
+def wave_pot_2nd_order(w1, w2, k1, k2, beta1_deg, beta2_deg, h, r,
+                       g=_G_DEFAULT, rho=1025.0):
+    """Acceleration and pressure from the difference-frequency second-order
+    potential for a bichromatic pair (reference: raft/helpers.py:254-291).
+
+    All of w1,w2,k1,k2 broadcast; r is (...,3).  Returns (acc (...,3), p).
+    Zero when w1==w2 (no mean-drift contribution from the 2nd-order
+    potential), above water, or at k<=0.
+    """
+    r = jnp.asarray(r, dtype=float)
+    z = r[..., 2]
+    b1 = jnp.deg2rad(beta1_deg)
+    b2 = jnp.deg2rad(beta2_deg)
+    dkx = k1 * jnp.cos(b1) - k2 * jnp.cos(b2)
+    dky = k1 * jnp.sin(b1) - k2 * jnp.sin(b2)
+    nk = jnp.sqrt(dkx * dkx + dky * dky)
+    dw = w1 - w2
+    # gamma factors; guard divisions (dead values masked at the end)
+    th1, th2, thn = jnp.tanh(k1 * h), jnp.tanh(k2 * h), jnp.tanh(nk * h)
+    den12 = dw * dw / g - nk * thn
+    den12 = jnp.where(den12 == 0.0, 1.0, den12)
+    g12 = (-1j * g / (2 * w1)) * ((k1**2) * (1 - th1**2) - 2 * k1 * k2 * (1 + th1 * th2)) / den12
+    g21 = (-1j * g / (2 * w2)) * ((k2**2) * (1 - th2**2) - 2 * k2 * k1 * (1 + th2 * th1)) / den12
+    aux = 0.5 * (g21 + jnp.conj(g12))
+    nkh = jnp.minimum(nk * h, _KH_DEEP)
+    nkzh = jnp.minimum(nk * (z + h), _KH_DEEP)
+    khz_xy = jnp.cosh(nkzh) / jnp.cosh(nkh)
+    khz_z = jnp.sinh(nkzh) / jnp.cosh(nkh)
+    phase = jnp.exp(-1j * (dkx * r[..., 0] + dky * r[..., 1]))
+    ax = aux * khz_xy * phase * dw * dkx
+    ay = aux * khz_xy * phase * dw * dky
+    az = aux * khz_z * phase * 1j * dw * nk
+    p = aux * khz_xy * phase * (-1j) * rho * dw
+    acc = jnp.stack([ax, ay, az], axis=-1)
+    active = (z <= 0.0) & (k1 > 0.0) & (k2 > 0.0) & (w1 != w2)
+    acc = jnp.where(active[..., None], acc, 0.0)
+    p = jnp.where(active, p, 0.0)
+    return acc, p

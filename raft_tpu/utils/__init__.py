@@ -1,0 +1,1 @@
+from raft_tpu.utils.dicttools import get_from_dict  # noqa: F401

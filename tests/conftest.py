@@ -1,0 +1,41 @@
+"""Test configuration: force CPU backend with 8 virtual devices so sharding
+tests exercise a multi-chip mesh without TPU hardware, and enable x64 for
+reference-matching accuracy.
+
+Note: this environment's sitecustomize registers an 'axon' TPU-tunnel PJRT
+plugin at interpreter startup and forces JAX_PLATFORMS=axon; connecting to it
+from test processes can block on the single-claim tunnel.  We override the
+platform back to cpu *after* import (config update beats the env var) and set
+the virtual device count before the CPU client is instantiated.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_DIR = "/root/reference"
+
+
+@pytest.fixture(scope="session")
+def reference_test_data():
+    """Path to the reference's regression test data (ground-truth pickles and
+    design yamls), or skip when unavailable."""
+    path = os.path.join(REFERENCE_DIR, "tests", "test_data")
+    if not os.path.isdir(path):
+        pytest.skip("reference test data not available")
+    return path
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(2026)

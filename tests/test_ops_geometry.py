@@ -1,0 +1,83 @@
+"""Frustum volume/centroid/inertia kernels vs the reference closed forms
+(reference: tests/test_helpers.py:14-23 values; raft/raft_member.py:321-402
+formulas re-derived here in plain numpy)."""
+import numpy as np
+from numpy.testing import assert_allclose
+
+from raft_tpu.ops import geometry as geo
+
+
+def test_frustum_vcv_circ():
+    V, hc = geo.frustum_vcv_circ(2.0, 1.0, 2.0)
+    assert_allclose([float(V), float(hc)], [3.665191429188092, 0.7857142857142856],
+                    rtol=1e-5)
+    # zero-size frustum
+    V0, hc0 = geo.frustum_vcv_circ(0.0, 0.0, 1.0)
+    assert float(V0) == 0.0 and float(hc0) == 0.0
+
+
+def test_frustum_vcv_rect():
+    V, hc = geo.frustum_vcv_rect(np.array([2.0, 1.0]), np.array([1.0, 0.5]), 2.0)
+    assert_allclose([float(V), float(hc)], [2.3333333333333335, 0.7857142857142857],
+                    rtol=1e-5)
+
+
+def test_frustum_moi_circ_cylinder():
+    d, H, p = 5.0, 12.0, 850.0
+    r = d / 2
+    Ixx, Izz = geo.frustum_moi_circ(d, d, H, p)
+    I_rad = (1 / 12) * (p * H * np.pi * r**2) * (3 * r**2 + 4 * H**2)
+    I_ax = 0.5 * p * np.pi * H * r**4
+    assert_allclose(float(Ixx), I_rad, rtol=1e-10)
+    assert_allclose(float(Izz), I_ax, rtol=1e-10)
+
+
+def test_frustum_moi_circ_tapered():
+    dA, dB, H, p = 4.0, 6.0, 10.0, 850.0
+    r1, r2 = dA / 2, dB / 2
+    Ixx, Izz = geo.frustum_moi_circ(dA, dB, H, p)
+    I_rad = (1 / 20) * p * np.pi * H * (r2**5 - r1**5) / (r2 - r1) \
+        + (1 / 30) * p * np.pi * H**3 * (r1**2 + 3 * r1 * r2 + 6 * r2**2)
+    I_ax = (1 / 10) * p * np.pi * H * (r2**5 - r1**5) / (r2 - r1)
+    assert_allclose(float(Ixx), I_rad, rtol=1e-10)
+    assert_allclose(float(Izz), I_ax, rtol=1e-10)
+
+
+def test_frustum_moi_rect_cuboid():
+    L, W, H, p = 3.0, 2.0, 7.0, 1000.0
+    M = p * L * W * H
+    Ixx, Iyy, Izz = geo.frustum_moi_rect(np.array([L, W]), np.array([L, W]), H, p)
+    assert_allclose(float(Ixx), (1 / 12) * M * (W**2 + 4 * H**2), rtol=1e-10)
+    assert_allclose(float(Iyy), (1 / 12) * M * (L**2 + 4 * H**2), rtol=1e-10)
+    assert_allclose(float(Izz), (1 / 12) * M * (L**2 + W**2), rtol=1e-10)
+
+
+def test_frustum_moi_rect_tapered():
+    La, Wa, Lb, Wb, H, p = 4.0, 3.0, 2.0, 1.5, 6.0, 500.0
+    Ixx, Iyy, Izz = geo.frustum_moi_rect(np.array([La, Wa]), np.array([Lb, Wb]), H, p)
+    # truncated-pyramid closed forms (both side pairs taper)
+    x2 = (1 / 12) * p * ((Lb - La)**3 * H * (Wb / 5 + Wa / 20)
+                         + (Lb - La)**2 * La * H * (3 * Wb / 4 + Wa / 4)
+                         + (Lb - La) * La**2 * H * (Wb + Wa / 2)
+                         + La**3 * H * (Wb / 2 + Wa / 2))
+    y2 = (1 / 12) * p * ((Wb - Wa)**3 * H * (Lb / 5 + La / 20)
+                         + (Wb - Wa)**2 * Wa * H * (3 * Lb / 4 + La / 4)
+                         + (Wb - Wa) * Wa**2 * H * (Lb + La / 2)
+                         + Wa**3 * H * (Lb / 2 + La / 2))
+    z2 = p * (Wb * Lb / 5 + Wa * Lb / 20 + La * Wb / 20 + Wa * La / 30) * H**3
+    assert_allclose(float(Ixx), y2 + z2, rtol=1e-10)
+    assert_allclose(float(Iyy), x2 + z2, rtol=1e-10)
+    assert_allclose(float(Izz), x2 + y2, rtol=1e-10)
+
+
+def test_frustum_moi_rect_prism():
+    # only widths taper (truncated triangular prism)
+    La, Wa, Lb, Wb, H, p = 3.0, 2.0, 3.0, 1.0, 5.0, 800.0
+    Ixx, Iyy, Izz = geo.frustum_moi_rect(np.array([La, Wa]), np.array([Lb, Wb]), H, p)
+    L = La
+    x2 = (1 / 24) * p * L**3 * H * (Wb + Wa)
+    y2 = (1 / 48) * p * L * H * (Wb**3 + Wa * Wb**2 + Wa**2 * Wb + Wa**3)
+    z2 = (1 / 12) * p * L * H**3 * (3 * Wb + Wa)
+    assert_allclose(float(Ixx), y2 + z2, rtol=1e-10)
+    assert_allclose(float(Iyy), x2 + z2, rtol=1e-10)
+    assert_allclose(float(Izz), x2 + y2, rtol=1e-10)

@@ -1,0 +1,127 @@
+"""L0 transform kernels vs the reference's analytic unit-test values
+(reference: tests/test_helpers.py)."""
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from raft_tpu.ops import transforms as tf
+
+
+def test_small_rotate():
+    r = np.array([1.0, 2.0, 3.0])
+    th = np.array([5 + 3j, 3 + 5j, 4 + 3j]) * (np.pi / 180.0)
+    rt = tf.small_rotate(r, th)
+    desired = np.array([0.01745329 + 0.15707963j, -0.19198622 - 0.10471976j,
+                        0.12217305 + 0.01745329j])
+    assert_allclose(np.asarray(rt), desired, rtol=1e-5)
+
+
+def test_vec_vec_trans():
+    v = np.array([0.7 + 1.2j, 1.5 + 0.4j, 3.0 + 2.3j])
+    desired = np.array([[-0.95 + 1.68j, 0.57 + 2.08j, -0.66 + 5.21j],
+                        [0.57 + 2.08j, 2.09 + 1.2j, 3.58 + 4.65j],
+                        [-0.66 + 5.21j, 3.58 + 4.65j, 3.71 + 13.8j]])
+    assert_allclose(np.asarray(tf.vec_vec_trans(v)), desired, rtol=1e-5)
+
+
+def test_translate_force_3to6():
+    Fin = np.array([0.5 + 3j, 2.0 + 1.5j, 3.0 + 0.7j])
+    r = np.array([1.0, 2.0, 3.0])
+    desired = np.array([0.5 + 3.0j, 2.0 + 1.5j, 3.0 + 0.7j,
+                        0.0 - 3.1j, -1.5 + 8.3j, 1.0 - 4.5j])
+    assert_allclose(np.asarray(tf.translate_force_3to6(Fin, r)), desired, rtol=1e-5)
+
+
+def test_transform_force():
+    offset = np.array([10.0, 20.0, 30.0])
+    f_in = np.array([0.5 + 3j, 2.0 + 1.5j, 3.0 + 0.7j])
+    F_in = np.array([1.2 + 0.3j, 0.4 + 1.5j, 2.3 + 0.7j,
+                     0.5 + 0.9j, 1.1 + 0.2j, 0.7 + 1.4j])
+    R = tf.rotation_matrix(0.1, 0.2, 0.3)
+
+    desired3 = np.array([0.57300698 + 2.54908178j, 1.94679387 + 2.27765615j,
+                         3.02186311 + 0.23337633j, 2.03344603 - 63.66215798j,
+                         -13.02842176 + 74.13869023j, 8.00779917 - 28.20507416j])
+    assert_allclose(np.asarray(tf.transform_force(f_in, offset=offset, rotmat=R)),
+                    desired3, rtol=1e-5)
+
+    desired6 = np.array([1.51572022 + 2.10897023e-02j, 0.64512428 + 1.49565656j,
+                         2.04362591 + 7.69783522e-01j, 21.83717669 - 2.83806906e+01j,
+                         26.20635997 - 6.66493243j, -23.17224939 + 1.57407763e+01j])
+    assert_allclose(np.asarray(tf.transform_force(F_in, offset=offset, rotmat=R)),
+                    desired6, rtol=1e-5)
+
+
+def test_translate_matrix_3to6():
+    Min = np.array([[0.73, 2.41, 3.88], [1.25, 9.12, 5.79], [5.37, 7.94, 8.63]])
+    r = np.array([10.0, 20.0, 30.0])
+    desired = np.array(
+        [[7.300e-01, 2.410e+00, 3.880e+00, 5.300e+00, -1.690e+01, 9.500e+00],
+         [1.250e+00, 9.120e+00, 5.790e+00, -1.578e+02, -2.040e+01, 6.620e+01],
+         [5.370e+00, 7.940e+00, 8.630e+00, -6.560e+01, 7.480e+01, -2.800e+01],
+         [5.300e+00, -1.578e+02, -6.560e+01, 3.422e+03, 2.108e+03, -2.546e+03],
+         [-1.690e+01, -2.040e+01, 7.480e+01, 8.150e+02, -1.255e+03, 5.650e+02],
+         [9.500e+00, 6.620e+01, -2.800e+01, -1.684e+03, 1.340e+02, 4.720e+02]])
+    assert_allclose(np.asarray(tf.translate_matrix_3to6(Min, r)), desired, rtol=1e-5)
+
+
+def test_translate_matrix_6to6():
+    Min = np.array([[0.57, 0.64, 0.88, 0.12, 0.34, 0.56],
+                    [2.03, -13.02, 8.00, 0.78, 0.90, 0.12],
+                    [1.11, -0.15, 0.10, 0.34, 0.56, 0.78],
+                    [0.12, 0.78, 0.34, 0.90, 0.12, 0.34],
+                    [0.34, 0.90, 0.56, 0.12, 0.34, 0.56],
+                    [0.56, 0.12, 0.78, 0.34, 0.56, 0.78]])
+    r = np.array([10.0, 20.0, 30.0])
+    desired = np.array(
+        [[5.70000e-01, 6.40000e-01, 8.80000e-01, -1.48000e+00, 8.64000e+00, -4.44000e+00],
+         [2.03000e+00, -1.30200e+01, 8.00000e+00, 5.51380e+02, -1.82000e+01, -1.70680e+02],
+         [1.11000e+00, -1.50000e-01, 1.00000e-01, 6.84000e+00, 3.28600e+01, -2.29200e+01],
+         [-1.48000e+00, 5.51380e+02, 6.84000e+00, -1.64203e+04, 1.20352e+03, 4.66774e+03],
+         [8.64000e+00, -1.82000e+01, 3.28600e+01, -1.28480e+02, -6.44600e+01, 9.87600e+01],
+         [-4.44000e+00, -1.70680e+02, -2.29200e+01, 5.55574e+03, -3.45240e+02, -1.62722e+03]])
+    assert_allclose(np.asarray(tf.translate_matrix_6to6(Min, r)), desired, rtol=1e-5)
+
+
+def test_rotate_matrix_6():
+    R = tf.rotation_matrix(0.1, 0.2, 0.3)
+    Min = np.array([[0.57, 0.64, 0.88, 0.12, 0.34, 0.56],
+                    [2.03, -13.02, 8.00, 0.78, 0.90, 0.12],
+                    [1.11, -0.15, 0.10, 0.34, 0.56, 0.78],
+                    [0.12, 0.78, 0.34, 0.90, 0.12, 0.34],
+                    [0.34, 0.90, 0.56, 0.12, 0.34, 0.56],
+                    [0.56, 0.12, 0.78, 0.34, 0.56, 0.78]])
+    desired = np.array(
+        [[-1.23327412, 4.08056795, -0.95870608, 0.06516703, 0.15206293, 0.66964386],
+         [7.03270577, -11.42123791, 6.09625616, 0.51524892, 1.11098643, 0.18118973],
+         [1.67312218, -1.16775529, 0.30451203, 0.34805446, 0.62871201, 0.62384654],
+         [0.06516703, 0.51524892, 0.34805446, 0.86182628, 0.37858592, 0.16449501],
+         [0.15206293, 1.11098643, 0.62871201, 0.37858592, 0.40719201, 0.55131878],
+         [0.66964386, 0.18118973, 0.62384654, 0.16449501, 0.55131878, 0.75098172]])
+    assert_allclose(np.asarray(tf.rotate_matrix_6(Min, R)), desired, rtol=1e-5)
+
+
+def test_rot_frm_2_vect():
+    R0 = tf.rotation_matrix(0.1, 0.2, 0.3)
+    A = np.array([5.0, 0.0, 0.0])
+    B = np.asarray(R0) @ A
+    R = tf.rot_frm_2_vect(A, B)
+    assert_allclose(B, np.asarray(R) @ A, rtol=1e-5)
+    # parallel vectors -> identity
+    assert_allclose(np.asarray(tf.rot_frm_2_vect(A, A)), np.eye(3), atol=1e-12)
+
+
+def test_batched_transforms_match_loop(rng):
+    """vmap semantics: batched kernels equal the per-item results."""
+    Ms = rng.normal(size=(7, 3, 3))
+    rs = rng.normal(size=(7, 3))
+    batched = np.asarray(tf.translate_matrix_3to6(Ms, rs))
+    for i in range(7):
+        assert_allclose(batched[i], np.asarray(tf.translate_matrix_3to6(Ms[i], rs[i])),
+                        rtol=1e-12)
+    M6 = rng.normal(size=(5, 6, 6))
+    r6 = rng.normal(size=(5, 3))
+    b6 = np.asarray(tf.translate_matrix_6to6(M6, r6))
+    for i in range(5):
+        assert_allclose(b6[i], np.asarray(tf.translate_matrix_6to6(M6[i], r6[i])),
+                        rtol=1e-12)

@@ -1,0 +1,143 @@
+"""Wave kinematics / spectra kernels vs reference analytic values
+(reference: tests/test_helpers.py:26-69) plus batching/jit invariants."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from numpy.testing import assert_allclose
+
+from raft_tpu.ops import spectra, waves
+
+
+def test_wave_number():
+    w = np.array([0.1, 0.25, 0.5, 0.75])
+    desired = np.array([0.00233623, 0.0071452, 0.02548611, 0.05733945])
+    k = np.asarray(waves.wave_number(w, 200.0))
+    assert_allclose(k, desired, rtol=1e-5)
+    # deep water limit: k -> w^2/g
+    kd = np.asarray(waves.wave_number(np.array([2.0]), 5000.0))
+    assert_allclose(kd, [2.0**2 / 9.81], rtol=1e-3)
+    assert np.asarray(waves.wave_number(np.array([0.0]), 100.0))[0] == 0.0
+
+
+def test_wave_kinematics():
+    w = np.array([0.1, 0.25, 0.5, 0.75])
+    zeta0 = np.array([0.2, 0.2, 0.2, 0.2], dtype=complex)
+    beta, h = 30.0, 200.0   # beta interpreted in radians, matching reference test
+    r = np.array([30.0, 45.0, -20.0])
+    k = np.asarray(waves.wave_number(w, h))
+
+    desired_u = np.array(
+        [[0.00690971 + 0.00064489j, 0.00732697 + 0.00214361j,
+          0.00488759 + 0.00787284j, -0.00480898 + 0.00555819j],
+         [-0.04425901 - 0.00413072j, -0.04693167 - 0.01373052j,
+          -0.03130665 - 0.05042812j, 0.03080313 - 0.03560204j],
+         [-0.00166131 + 0.01780023j, -0.01192503 + 0.04076042j,
+          -0.05102840 + 0.03167931j, -0.03603330 - 0.03117625j]])
+    desired_ud = np.array(
+        [[-0.0000644885 + 0.0006909710j, -0.0005359019 + 0.0018317440j,
+          -0.0039364177 + 0.0024438000j, -0.0041686415 - 0.0036067400j],
+         [0.0004130725 - 0.0044259010j, 0.0034326291 - 0.0117329200j,
+          0.0252140594 - 0.0156533200j, 0.0267015296 + 0.0231023400j],
+         [-0.0017800228 - 0.0001661310j, -0.0101901044 - 0.0029812600j,
+          -0.0158396548 - 0.0255142000j, 0.0233821912 - 0.0270249700j]])
+    desired_pDyn = np.array([1963.730340920 + 183.276331860j,
+                             1703.156386190 + 498.282218140j,
+                             637.171137130 + 1026.342526750j,
+                             -417.980049950 + 483.098446900j])
+
+    u, ud, pDyn = waves.wave_kinematics(zeta0, beta, w, k, h, r)
+    assert_allclose(np.asarray(u), desired_u, rtol=1e-5)
+    assert_allclose(np.asarray(ud), desired_ud, rtol=1e-5)
+    assert_allclose(np.asarray(pDyn), desired_pDyn, rtol=1e-5)
+
+
+def test_wave_kinematics_above_water_and_batched():
+    w = np.array([0.3, 0.6])
+    k = np.asarray(waves.wave_number(w, 100.0))
+    zeta0 = np.array([1.0 + 0.5j, 0.3 - 0.2j])
+    # node above the surface -> all zeros
+    u, ud, pD = waves.wave_kinematics(zeta0, 0.2, w, k, 100.0, np.array([1.0, 2.0, 3.0]))
+    assert np.all(np.asarray(u) == 0) and np.all(np.asarray(pD) == 0)
+    # batched nodes give same result as per-node calls
+    rs = np.array([[0.0, 0.0, -5.0], [10.0, -3.0, -50.0], [2.0, 2.0, 1.0]])
+    ub, udb, pb = waves.wave_kinematics(zeta0, 0.2, w, k, 100.0, rs)
+    for i in range(3):
+        ui, udi, pi = waves.wave_kinematics(zeta0, 0.2, w, k, 100.0, rs[i])
+        assert_allclose(np.asarray(ub)[i], np.asarray(ui), rtol=1e-12)
+        assert_allclose(np.asarray(pb)[i], np.asarray(pi), rtol=1e-12)
+
+
+def test_kinematics_from_motion():
+    r = np.array([2.0, 2.0, 2.0])
+    w = np.array([0.5, 0.75])
+    Xi = np.array([[1, 2 + 1j], [0.1 + 0.2j, 0.3 + 0.4j], [0.5 + 0.6j, 0.7 + 0.8j],
+                   [0.9 + 1.0j, 1.1 + 1.2j], [1.3 + 1.4j, 1.5 + 1.6j],
+                   [1.7 + 1.8j, 1.9 + 2.0j]])
+    desired = np.array([
+        [[0.2 - 8.00000000e-01j, 1.2 + 2.00000000e-01j],
+         [1.7 + 1.80000000e+00j, 1.9 + 2.00000000e+00j],
+         [-0.3 - 2.00000000e-01j, -0.1 - 2.22044605e-16j]],
+        [[4.00000000e-01 + 0.1j, -1.50000000e-01 + 0.9j],
+         [-9.00000000e-01 + 0.85j, -1.50000000e+00 + 1.425j],
+         [1.00000000e-01 - 0.15j, 1.66533454e-16 - 0.075j]],
+        [[-0.05 + 2.0000000e-01j, -0.675 - 1.1250000e-01j],
+         [-0.425 - 4.5000000e-01j, -1.06875 - 1.1250000e+00j],
+         [0.075 + 5.0000000e-02j, 0.05625 + 1.2490009e-16j]]])
+    dr, v, a = waves.kinematics_from_motion(r, Xi, w)
+    assert_allclose(np.asarray(dr), desired[0], rtol=1e-5, atol=1e-12)
+    assert_allclose(np.asarray(v), desired[1], rtol=1e-5, atol=1e-12)
+    assert_allclose(np.asarray(a), desired[2], rtol=1e-5, atol=1e-12)
+
+
+def test_jonswap_matches_reference_formula():
+    ws = np.linspace(0.03, 2.5, 100)
+    for Hs, Tp in [(6.0, 10.0), (2.0, 14.0), (9.0, 8.0)]:
+        S = np.asarray(spectra.jonswap(ws, Hs, Tp))
+        # re-derive with plain numpy (reference formula, helpers.py:606-663)
+        TpOvrSqrtHs = Tp / np.sqrt(Hs)
+        if TpOvrSqrtHs <= 3.6:
+            Gamma = 5.0
+        elif TpOvrSqrtHs >= 5.0:
+            Gamma = 1.0
+        else:
+            Gamma = np.exp(5.75 - 1.15 * TpOvrSqrtHs)
+        f = 0.5 / np.pi * ws
+        fpOvrf4 = (Tp * f) ** -4.0
+        C = 1.0 - 0.287 * np.log(Gamma)
+        Sigma = 0.07 * (f <= 1.0 / Tp) + 0.09 * (f > 1.0 / Tp)
+        Alpha = np.exp(-0.5 * ((f * Tp - 1.0) / Sigma) ** 2)
+        S_ref = 0.5 / np.pi * C * 0.3125 * Hs * Hs * fpOvrf4 / f \
+            * np.exp(-1.25 * fpOvrf4) * Gamma**Alpha
+        assert_allclose(S, S_ref, rtol=1e-12)
+    # spectrum integrates to ~ (Hs/4)^2 variance (sanity, coarse tolerance)
+    ws_f = np.linspace(0.02, 4.0, 4000)
+    S = np.asarray(spectra.jonswap(ws_f, 6.0, 10.0))
+    m0 = np.trapezoid(S, ws_f)
+    assert abs(np.sqrt(m0) - 6.0 / 4.0) / (6.0 / 4.0) < 0.05
+
+
+def test_psd_rms_rao():
+    rng = np.random.default_rng(0)
+    xi = rng.normal(size=(3, 20)) + 1j * rng.normal(size=(3, 20))
+    dw = 0.01
+    assert_allclose(float(spectra.get_rms(xi)), np.sqrt(0.5 * np.sum(np.abs(xi) ** 2)),
+                    rtol=1e-12)
+    assert_allclose(np.asarray(spectra.get_psd(xi, dw, source_axis=0)),
+                    np.sum(0.5 * np.abs(xi) ** 2 / dw, axis=0), rtol=1e-12)
+    zeta = rng.normal(size=20) + 1j * rng.normal(size=20)
+    zeta[5] = 0.0
+    rao = np.asarray(spectra.get_rao(xi, zeta))
+    assert np.all(rao[:, 5] == 0)
+    assert_allclose(rao[:, 6], xi[:, 6] / zeta[6], rtol=1e-12)
+
+
+def test_wave_kinematics_jits_and_vmaps():
+    w = jnp.linspace(0.05, 2.0, 40)
+    k = waves.wave_number(w, 150.0)
+    zeta0 = jnp.ones(40, dtype=complex)
+    rs = jnp.array([[0.0, 0.0, -z] for z in np.linspace(1, 80, 16)])
+    f = jax.jit(lambda r: waves.wave_kinematics(zeta0, 0.0, w, k, 150.0, r))
+    u, ud, pD = f(rs)
+    assert u.shape == (16, 3, 40)
+    assert pD.shape == (16, 40)
+    assert bool(jnp.all(jnp.isfinite(u.real)))

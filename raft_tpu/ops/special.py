@@ -1,0 +1,97 @@
+"""Special functions needed by the aero/hydro kernels, implemented in jnp.
+
+The reference uses scipy.special (modstruve/iv in the Kaimal rotor-averaging,
+reference: raft/raft_rotor.py:1216-1218; hankel1 in the MacCamy-Fuchs and
+Kim&Yue kernels, raft/raft_member.py:1070-1073, 1102-1109).  scipy.special
+is not jax-traceable, so the needed combinations are implemented here.
+
+Struve-minus-Bessel differences D_nu(x) = L_nu(x) - I_nu(x) stay O(1) while
+L and I grow like e^x/sqrt(x); computing them naively (as the reference
+does) loses all precision for x over ~35.  Here D_0 and D_1 come from the
+power-series difference (cumulative-product terms) for small x and the DLMF
+11.6.2 asymptotic expansion for large x, and the nu=-2 combination used in
+the rotor-averaged Kaimal spectrum comes from the exact recurrence
+(DLMF 11.4.23 with I-recurrence):  D_{-2} = D_0 - (2/x) D_1 - 2/(pi x).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+_SERIES_K = 90
+_ASYM_K = 10
+_SWITCH = 18.0
+
+
+def _asym_coeffs(nu: float):
+    """DLMF 11.6.2: L_nu(z) - I_nu(z) ~ (1/pi) sum_k (-1)^{k+1}
+    Gamma(k+1/2)/Gamma(nu+1/2-k) (z/2)^{nu-2k-1}."""
+    def gamma_any(x):
+        if x > 0:
+            return math.gamma(x)
+        return math.pi / (math.sin(math.pi * x) * math.gamma(1.0 - x))
+
+    k = np.arange(_ASYM_K)
+    c = np.array([(-1.0) ** (kk + 1) * math.gamma(kk + 0.5) / gamma_any(nu + 0.5 - kk)
+                  for kk in k]) / math.pi
+    p = nu - 2.0 * k - 1.0
+    return c, p
+
+
+_A0_C, _A0_P = _asym_coeffs(0.0)
+_A1_C, _A1_P = _asym_coeffs(1.0)
+
+
+def _series_diff(x, nu: int):
+    """L_nu(x) - I_nu(x) by direct summation with cumulative-product terms
+    (full f64 precision; used for x < _SWITCH where cancellation is mild)."""
+    h = 0.5 * jnp.asarray(x, float)[..., None]
+    h2 = h * h
+    k = jnp.arange(_SERIES_K, dtype=float)
+    # I_nu: t0 = h^nu / Gamma(nu+1); t_{k+1}/t_k = h^2/((k+1)(k+nu+1))
+    tI0 = h[..., 0] ** nu / math.gamma(nu + 1.0)
+    ratios_I = h2 / ((k[:-1] + 1.0) * (k[:-1] + nu + 1.0))
+    tI = tI0[..., None] * jnp.concatenate(
+        [jnp.ones_like(h), jnp.cumprod(ratios_I, axis=-1)], axis=-1)
+    # L_nu: t0 = h^{nu+1} / (Gamma(3/2) Gamma(nu+3/2));
+    # t_{k+1}/t_k = h^2/((k+3/2)(k+nu+3/2))
+    tL0 = h[..., 0] ** (nu + 1) / (math.gamma(1.5) * math.gamma(nu + 1.5))
+    ratios_L = h2 / ((k[:-1] + 1.5) * (k[:-1] + nu + 1.5))
+    tL = tL0[..., None] * jnp.concatenate(
+        [jnp.ones_like(h), jnp.cumprod(ratios_L, axis=-1)], axis=-1)
+    return jnp.sum(tL - tI, axis=-1)
+
+
+def _eval_asym(x, coeffs, powers):
+    h = 0.5 * jnp.asarray(x, float)[..., None]
+    h_safe = jnp.where(h > 0, h, 1.0)
+    terms = jnp.asarray(coeffs) * jnp.exp(jnp.asarray(powers) * jnp.log(h_safe))
+    return jnp.sum(terms, axis=-1)
+
+
+def struve_bessel_diff_0(x):
+    """D_0(x) = L_0(x) - I_0(x), elementwise, x >= 0."""
+    x = jnp.asarray(x, float)
+    out = jnp.where(x < _SWITCH, _series_diff(jnp.minimum(x, _SWITCH), 0),
+                    _eval_asym(jnp.maximum(x, _SWITCH), _A0_C, _A0_P))
+    return jnp.where(x == 0.0, -1.0, out)
+
+
+def struve_bessel_diff_1(x):
+    """D_1(x) = L_1(x) - I_1(x), elementwise, x >= 0.  -> -2/pi at inf."""
+    x = jnp.asarray(x, float)
+    out = jnp.where(x < _SWITCH, _series_diff(jnp.minimum(x, _SWITCH), 1),
+                    _eval_asym(jnp.maximum(x, _SWITCH), _A1_C, _A1_P))
+    return jnp.where(x == 0.0, 0.0, out)
+
+
+def struve_bessel_diff_m2(x):
+    """L_{-2}(x) - I_2(x) (= L_{-2} - I_{-2}), elementwise, x > 0, via the
+    recurrence D_{-2} = D_0 - (2/x) D_1 - 2/(pi x)."""
+    x = jnp.asarray(x, float)
+    x_safe = jnp.where(x > 0, x, 1.0)
+    out = (struve_bessel_diff_0(x) - (2.0 / x_safe) * struve_bessel_diff_1(x)
+           - 2.0 / (jnp.pi * x_safe))
+    return jnp.where(x == 0.0, 0.0, out)

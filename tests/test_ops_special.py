@@ -1,0 +1,45 @@
+"""Struve-minus-Bessel difference kernels vs scipy (in scipy's accurate
+range) and vs asymptotic limits."""
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from raft_tpu.ops.special import (
+    struve_bessel_diff_0,
+    struve_bessel_diff_1,
+    struve_bessel_diff_m2,
+)
+
+scipy_special = pytest.importorskip("scipy.special")
+
+
+def test_vs_scipy_small_x():
+    # scipy's naive difference is accurate for small/moderate x only
+    x = np.linspace(1e-3, 12.0, 80)
+    assert_allclose(np.asarray(struve_bessel_diff_0(x)),
+                    scipy_special.modstruve(0, x) - scipy_special.iv(0, x),
+                    rtol=1e-7)
+    assert_allclose(np.asarray(struve_bessel_diff_1(x)),
+                    scipy_special.modstruve(1, x) - scipy_special.iv(1, x),
+                    rtol=1e-7)
+    assert_allclose(np.asarray(struve_bessel_diff_m2(x)),
+                    scipy_special.modstruve(-2, x) - scipy_special.iv(2, x),
+                    rtol=1e-5)
+
+
+def test_large_x_limits():
+    # D_1 -> -2/pi; D_0 -> 0-; both finite where scipy's difference has
+    # catastrophically cancelled (the reference zeroes resulting NaNs,
+    # raft_rotor.py:1221 — we stay accurate instead)
+    x = np.array([50.0, 100.0, 500.0, 5000.0])
+    d1 = np.asarray(struve_bessel_diff_1(x))
+    assert_allclose(d1, -2 / np.pi, rtol=1e-3)
+    d0 = np.asarray(struve_bessel_diff_0(x))
+    assert np.all(d0 < 0) and np.all(np.abs(d0) < 0.02)
+    dm2 = np.asarray(struve_bessel_diff_m2(x))
+    assert np.all(np.isfinite(dm2))
+
+
+def test_zero_edge():
+    assert float(struve_bessel_diff_1(0.0)) == 0.0
+    assert float(struve_bessel_diff_0(0.0)) == -1.0

@@ -1,0 +1,771 @@
+"""Rotor aero-servo layer: differentiable BEM + control linearization.
+
+TPU-first replacement for the reference Rotor class and its CCBlade
+(Fortran) dependency (reference: raft/raft_rotor.py).  Structure:
+
+- `build_rotor(turbine, w, ir)` parses the turbine dict ONCE (numpy):
+  blade geometry resampled to `nr` elements (raft_rotor.py:309-320),
+  airfoil polars interpolated spanwise by relative thickness with PCHIP
+  (raft_rotor.py:250-296), then each element's cl/cd(alpha) fitted with the
+  same smoothing-spline family CCBlade's CCAirfoil uses and converted to
+  piecewise-cubic coefficient tables evaluable in jnp.
+- `bem_evaluate(...)` is a pure-jnp blade-element-momentum solve of Ning
+  (2014)'s single-residual formulation (the algorithm inside CCBlade's
+  Fortran `inductionfactors`): bracketed bisection (non-differentiated) +
+  Newton polish (differentiable), vmapped over blade elements and azimuth
+  sectors.  Hub loads integrate over the curved blade path.  Derivatives
+  dT/d(U, Omega, pitch) come from `jax.jacfwd` instead of CCBlade's
+  hand-coded adjoints (raft_rotor.py:726, 753-764).
+- `calc_aero(...)` reproduces the aero-servo linearization
+  (raft_rotor.py:788-1005): aeroServoMod 1 (thrust-damping only) and 2
+  (closed-loop H_QT transfer function with gain-scheduled pitch PI, torque
+  PI, and floating feedback), rotated to global frame.
+- `kaimal_spectra(...)` is the IEC 61400-1 Kaimal model with rotor
+  averaging via Struve/Bessel kernels (raft_rotor.py:1125-1223), using the
+  numerically-stable difference functions from raft_tpu.ops.special.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.special import struve_bessel_diff_1, struve_bessel_diff_m2
+from raft_tpu.ops.transforms import rotation_matrix, rotate_matrix_3, rotate_matrix_6
+from raft_tpu.utils.dicttools import get_from_dict
+
+# the reference's (approximate) conversion constants — kept bit-identical
+# for parity (raft_rotor.py:31-32)
+_RAD2DEG = 57.2958
+_RPM2RADPS = 0.1047
+_RPM2RS = np.pi / 30.0   # exact, used inside the BEM like CCBlade does
+
+_N_BISECT = 60
+_N_NEWTON = 3
+_EPS_PHI = 1e-6
+
+
+@dataclass
+class RotorModel:
+    """Static description of one rotor (numpy arrays + flags)."""
+
+    # RNA / drivetrain
+    r_rel: np.ndarray
+    overhang: float
+    xCG_RNA: float
+    mRNA: float
+    IxRNA: float
+    IrRNA: float
+    speed_gain: float
+    nBlades: int
+    yaw_mode: int
+    azimuths: np.ndarray
+    shaft_tilt: float      # [rad]
+    shaft_toe: float       # [rad]
+    aeroServoMod: int
+    I_drivetrain: float
+    # blade/BEM geometry
+    Rhub: float
+    Rtip: float
+    R_rot: float
+    precone: float         # [deg]
+    blade_r: np.ndarray
+    chord: np.ndarray
+    theta_deg: np.ndarray
+    precurve: np.ndarray
+    presweep: np.ndarray
+    precurveTip: float
+    presweepTip: float
+    nSector: int
+    rho: float
+    mu: float
+    shearExp: float
+    hubHt: float
+    # operating schedule (incl. parked extension)
+    Uhub_ops: np.ndarray
+    Omega_rpm_ops: np.ndarray
+    pitch_deg_ops: np.ndarray
+    # control gains
+    kp_0: np.ndarray
+    ki_0: np.ndarray
+    k_float: float
+    kp_tau: float
+    ki_tau: float
+    Ng: float
+    # per-element polar piecewise-cubics: breakpoints (nr, nbp) and
+    # coefficients (nr, nbp-1, 4) highest-power-first
+    cl_bp: np.ndarray = field(default=None, repr=False)
+    cl_c: np.ndarray = field(default=None, repr=False)
+    cd_bp: np.ndarray = field(default=None, repr=False)
+    cd_c: np.ndarray = field(default=None, repr=False)
+    cpmin_bp: np.ndarray = field(default=None, repr=False)
+    cpmin_c: np.ndarray = field(default=None, repr=False)
+    # spanwise airfoil info (underwater blade members, cavitation)
+    Ca_interp: np.ndarray = field(default=None, repr=False)
+    r_thick_interp: np.ndarray = field(default=None, repr=False)
+    aoa_grid: np.ndarray = field(default=None, repr=False)
+
+
+# --------------------------------------------------------------------------
+# build
+# --------------------------------------------------------------------------
+
+def _ppoly_from_smoothing_spline(x, y, s):
+    """Fit the same bivariate smoothing spline CCAirfoil uses (duplicated
+    Reynolds column, kx=3/ky=1) and convert the alpha dependence to
+    piecewise-cubic (breakpoints, coeffs highest-power-first)."""
+    from scipy.interpolate import RectBivariateSpline
+
+    Re = np.array([1e1, 1e15])
+    yy = np.c_[y, y]
+    kx = min(len(x) - 1, 3)
+    spl = RectBivariateSpline(x, Re, yy, kx=kx, ky=1, s=s)
+    tx = spl.get_knots()[0]
+    bp = np.unique(tx)
+    Re0 = 1e7
+    nseg = len(bp) - 1
+    c = np.zeros((nseg, 4))
+    x0 = bp[:-1]
+    h = np.diff(bp)
+    c[:, 3] = spl.ev(x0, Re0)
+    c[:, 2] = spl.ev(x0, Re0, dx=1)
+    c[:, 1] = spl.ev(x0, Re0, dx=2) / 2.0
+    # cubic term from the change in second derivative across the segment
+    # (FITPACK can't evaluate dx=3 for kx=3)
+    c[:, 0] = (spl.ev(bp[1:], Re0, dx=2) - spl.ev(x0, Re0, dx=2)) / (6.0 * h)
+    return bp, c
+
+
+def build_rotor(turbine: dict, w, ir: int = 0) -> RotorModel:
+    """Parse a turbine dict into a RotorModel (reference:
+    raft_rotor.py:37-373)."""
+    from scipy.interpolate import PchipInterpolator
+
+    nrot = turbine.get("nrotors", 1)
+    turbine = dict(turbine)
+    turbine.setdefault("nrotors", nrot)
+
+    if "rRNA" in turbine:
+        r_rel = np.asarray(get_from_dict(turbine, "rRNA", shape=[nrot, 3]))[ir].astype(float)
+    else:
+        r_rel = np.array([0.0, 0.0, 100.0])
+    overhang = float(np.atleast_1d(get_from_dict(turbine, "overhang", shape=nrot))[ir])
+    xCG_RNA = float(np.atleast_1d(get_from_dict(turbine, "xCG_RNA", shape=nrot))[ir])
+    mRNA = float(np.atleast_1d(get_from_dict(turbine, "mRNA", shape=nrot))[ir])
+    IxRNA = float(np.atleast_1d(get_from_dict(turbine, "IxRNA", shape=nrot))[ir])
+    IrRNA = float(np.atleast_1d(get_from_dict(turbine, "IrRNA", shape=nrot))[ir])
+    speed_gain = float(np.atleast_1d(get_from_dict(turbine, "speed_gain", shape=nrot, default=1.0))[ir])
+    nBlades = int(np.atleast_1d(get_from_dict(turbine, "nBlades", shape=nrot, dtype=int))[ir])
+    yaw_mode = int(np.atleast_1d(get_from_dict(turbine, "yaw_mode", shape=nrot, dtype=int, default=0))[ir])
+    azimuths = np.atleast_1d(np.asarray(
+        get_from_dict(turbine, "headings", shape=-1,
+                      default=list(np.arange(nBlades) * 360.0 / nBlades)), float))
+    Rhub = float(np.atleast_1d(get_from_dict(turbine, "Rhub", shape=nrot))[ir])
+    precone = float(np.atleast_1d(get_from_dict(turbine, "precone", shape=nrot))[ir])
+    shaft_tilt = float(np.atleast_1d(get_from_dict(turbine, "shaft_tilt", shape=nrot))[ir]) * np.pi / 180
+    shaft_toe = float(np.atleast_1d(get_from_dict(turbine, "shaft_toe", shape=nrot, default=0))[ir]) * np.pi / 180
+    aeroServoMod = int(np.atleast_1d(get_from_dict(turbine, "aeroServoMod", shape=nrot, default=1))[ir])
+    I_drivetrain = float(np.atleast_1d(get_from_dict(turbine, "I_drivetrain", shape=nrot))[ir])
+
+    # initial axis/hub height (reference :99-112)
+    q_rel = rotation_matrix_np(0.0, shaft_tilt, shaft_toe) @ np.array([1.0, 0.0, 0.0])
+    if "hHub" in turbine:
+        hHub = float(np.atleast_1d(get_from_dict(turbine, "hHub", shape=nrot))[ir])
+        r_rel[2] = hHub - q_rel[2] * overhang
+    hubHt = r_rel[2] + q_rel[2] * overhang
+
+    blade = turbine["blade"]
+    if isinstance(blade, dict):
+        blade = [blade] * nrot
+    wt_ops = turbine["wt_ops"]
+    if isinstance(wt_ops, dict):
+        wt_ops = [wt_ops] * nrot
+    bl = blade[ir]
+    Rtip = float(bl["Rtip"])
+
+    Uhub = np.asarray(get_from_dict(wt_ops[ir], "v", shape=-1), float)
+    Omega_rpm = np.asarray(get_from_dict(wt_ops[ir], "omega_op", shape=-1), float)
+    pitch_deg = np.asarray(get_from_dict(wt_ops[ir], "pitch_op", shape=-1), float)
+    # parked extension (reference :157-159)
+    Uhub = np.r_[Uhub, Uhub.max() * 1.4, 100.0]
+    Omega_rpm = np.r_[Omega_rpm, 0.0, 0.0]
+    pitch_deg = np.r_[pitch_deg, 90.0, 90.0]
+
+    # fluid properties by initial hub position (reference :323-330)
+    underwater = (r_rel[2] + q_rel[2] * overhang) < 0
+    if underwater:
+        rho = float(turbine["rho_water"]); mu = float(turbine["mu_water"])
+        shearExp = float(turbine["shearExp_water"])
+    else:
+        rho = float(turbine["rho_air"]); mu = float(turbine["mu_air"])
+        shearExp = float(turbine["shearExp_air"])
+
+    # ----- airfoil polar database (reference :179-296) -----
+    station_airfoil = [b for [a, b] in bl["airfoils"]]
+    station_position = np.array([a for [a, b] in bl["airfoils"]], float)
+    n_aoa = 200
+    aoa = np.unique(np.hstack([np.linspace(-180, -30, int(n_aoa / 4 + 1)),
+                               np.linspace(-30, 30, int(n_aoa / 2)),
+                               np.linspace(30, 180, int(n_aoa / 4 + 1))]))
+    afs = turbine["airfoils"]
+    names = [a["name"] for a in afs]
+    thick = np.array([a["relative_thickness"] for a in afs], float)
+    Ca_af = np.array([a.get("added_mass_coeff", [0.5, 1.0]) for a in afs], float)
+    cpmin_flag = len(np.array(afs[0]["data"])[0]) > 4
+    tables = {}
+    for a in afs:
+        tab = np.array(a["data"], float)
+        cl = np.interp(aoa, tab[:, 0], tab[:, 1])
+        cd = np.interp(aoa, tab[:, 0], tab[:, 2])
+        cpm = np.interp(aoa, tab[:, 0], tab[:, 4]) if cpmin_flag else np.zeros_like(aoa)
+        # enforce +-pi continuity as the reference does (:228-239)
+        cl[0] = cl[-1]; cd[0] = cd[-1]; cpm[0] = cpm[-1]
+        tables[a["name"]] = (cl, cd, cpm)
+
+    nSector = int(get_from_dict(bl, "nSector", default=4))
+    nr = int(get_from_dict(bl, "nr", default=20))
+    grid = np.linspace(0.0, 1.0, nr, endpoint=False) + 0.5 / nr
+
+    st_thick = np.array([thick[names.index(s)] for s in station_airfoil])
+    st_Ca = np.array([Ca_af[names.index(s)] for s in station_airfoil])
+    st_cl = np.array([tables[s][0] for s in station_airfoil])
+    st_cd = np.array([tables[s][1] for s in station_airfoil])
+    st_cpm = np.array([tables[s][2] for s in station_airfoil])
+
+    if not np.all(st_thick == np.flip(np.sort(st_thick))):
+        raise NotImplementedError("non-monotonic spanwise airfoil thickness")
+    r_thick_interp = PchipInterpolator(station_position, st_thick)(grid)
+    Ca_interp = PchipInterpolator(station_position, st_Ca)(grid)
+    r_thick_unique, idx = np.unique(st_thick, return_index=True)
+    cl_interp = np.flip(PchipInterpolator(r_thick_unique, st_cl[idx])(np.flip(r_thick_interp)), axis=0)
+    cd_interp = np.flip(PchipInterpolator(r_thick_unique, st_cd[idx])(np.flip(r_thick_interp)), axis=0)
+    cpm_interp = np.flip(PchipInterpolator(r_thick_unique, st_cpm[idx])(np.flip(r_thick_interp)), axis=0)
+
+    # per-element smoothing-spline piecewise cubics (CCAirfoil equivalent:
+    # RectBivariateSpline with s=0.1 on cl, s=0.001 on cd)
+    aoa_rad = np.radians(aoa)
+    cl_bps, cl_cs, cd_bps, cd_cs, cp_bps, cp_cs = [], [], [], [], [], []
+    for i in range(nr):
+        bp, c = _ppoly_from_smoothing_spline(aoa_rad, cl_interp[i], s=0.1)
+        cl_bps.append(bp); cl_cs.append(c)
+        bp, c = _ppoly_from_smoothing_spline(aoa_rad, cd_interp[i], s=0.001)
+        cd_bps.append(bp); cd_cs.append(c)
+        bp, c = _ppoly_from_smoothing_spline(aoa_rad, cpm_interp[i], s=0.1)
+        cp_bps.append(bp); cp_cs.append(c)
+    cl_bp, cl_c = _pad_ppoly(cl_bps, cl_cs)
+    cd_bp, cd_c = _pad_ppoly(cd_bps, cd_cs)
+    cp_bp, cp_c = _pad_ppoly(cp_bps, cp_cs)
+
+    # blade element geometry (reference :309-320)
+    gt = np.array(bl["geometry"], float)
+    dr = (Rtip - Rhub) / nr
+    blade_r = np.linspace(Rhub, Rtip, nr, endpoint=False) + dr / 2
+    chord = np.interp(blade_r, gt[:, 0], gt[:, 1])
+    theta = np.interp(blade_r, gt[:, 0], gt[:, 2])
+    precurve = np.interp(blade_r, gt[:, 0], gt[:, 3])
+    presweep = np.interp(blade_r, gt[:, 0], gt[:, 4])
+
+    # control gains (reference :770-784)
+    pc = turbine["pitch_control"]
+    pc_angles = np.array(pc["GS_Angles"]) * _RAD2DEG
+    kp_0 = np.interp(pitch_deg, pc_angles, pc["GS_Kp"], left=0, right=0)
+    ki_0 = np.interp(pitch_deg, pc_angles, pc["GS_Ki"], left=0, right=0)
+    k_float = -pc["Fl_Kp"]
+    kp_tau = -turbine["torque_control"]["VS_KP"]
+    ki_tau = -turbine["torque_control"]["VS_KI"]
+    Ng = turbine["gear_ratio"]
+
+    cone_r = np.radians(precone)
+    R_rot = Rtip * np.cos(cone_r) + float(bl["precurveTip"]) * np.sin(cone_r)
+
+    return RotorModel(
+        r_rel=r_rel, overhang=overhang, xCG_RNA=xCG_RNA, mRNA=mRNA,
+        IxRNA=IxRNA, IrRNA=IrRNA, speed_gain=speed_gain, nBlades=nBlades,
+        yaw_mode=yaw_mode, azimuths=azimuths, shaft_tilt=shaft_tilt,
+        shaft_toe=shaft_toe, aeroServoMod=aeroServoMod,
+        I_drivetrain=I_drivetrain,
+        Rhub=Rhub, Rtip=Rtip, R_rot=R_rot, precone=precone,
+        blade_r=blade_r, chord=chord, theta_deg=theta,
+        precurve=precurve, presweep=presweep,
+        precurveTip=float(bl["precurveTip"]), presweepTip=float(bl["presweepTip"]),
+        nSector=nSector, rho=rho, mu=mu, shearExp=shearExp, hubHt=hubHt,
+        Uhub_ops=Uhub, Omega_rpm_ops=Omega_rpm, pitch_deg_ops=pitch_deg,
+        kp_0=kp_0, ki_0=ki_0, k_float=k_float, kp_tau=kp_tau, ki_tau=ki_tau,
+        Ng=float(Ng),
+        cl_bp=cl_bp, cl_c=cl_c, cd_bp=cd_bp, cd_c=cd_c,
+        cpmin_bp=cp_bp, cpmin_c=cp_c,
+        Ca_interp=Ca_interp, r_thick_interp=r_thick_interp, aoa_grid=aoa_rad,
+    )
+
+
+def _pad_ppoly(bps, cs):
+    """Pad ragged per-element piecewise-cubic tables to a common segment
+    count (repeating the last breakpoint; padded segments are never
+    selected by searchsorted)."""
+    nmax = max(len(b) for b in bps)
+    bp = np.stack([np.pad(b, (0, nmax - len(b)), mode="edge") for b in bps])
+    cc = np.stack([np.pad(c, ((0, nmax - 1 - len(c)), (0, 0)), mode="edge") for c in cs])
+    return bp, cc
+
+
+def rotation_matrix_np(x3, x2, x1):
+    import numpy as _np
+    s1, c1 = _np.sin(x1), _np.cos(x1)
+    s2, c2 = _np.sin(x2), _np.cos(x2)
+    s3, c3 = _np.sin(x3), _np.cos(x3)
+    return _np.array([
+        [c1 * c2, c1 * s2 * s3 - c3 * s1, s1 * s3 + c1 * c3 * s2],
+        [c2 * s1, c1 * c3 + s1 * s2 * s3, c3 * s1 * s2 - c1 * s3],
+        [-s2, c2 * s3, c2 * c3]])
+
+
+# --------------------------------------------------------------------------
+# polar evaluation (piecewise cubic, batched over elements)
+# --------------------------------------------------------------------------
+
+def _ppoly_eval(bp, c, x):
+    """bp: (nr, nbp), c: (nr, nbp-1, 4), x: (nr,) -> (nr,)"""
+    x = jnp.clip(x, bp[:, 0], bp[:, -1])
+    idx = jnp.clip(jax.vmap(jnp.searchsorted)(bp, x) - 1, 0, bp.shape[1] - 2)
+    t = x - jnp.take_along_axis(bp, idx[:, None], axis=1)[:, 0]
+    ci = jnp.take_along_axis(c, idx[:, None, None], axis=1)[:, 0, :]
+    return ((ci[:, 0] * t + ci[:, 1]) * t + ci[:, 2]) * t + ci[:, 3]
+
+
+# --------------------------------------------------------------------------
+# BEM core (Ning 2014 single-residual formulation)
+# --------------------------------------------------------------------------
+
+def _define_curvature(r, precurve, presweep, precone_rad):
+    """Azimuthal-frame coordinates and local cone angle of the blade axis
+    (CCBlade's definecurvature)."""
+    x_az = -r * jnp.sin(precone_rad) + precurve * jnp.cos(precone_rad)
+    z_az = r * jnp.cos(precone_rad) + precurve * jnp.sin(precone_rad)
+    y_az = presweep
+    dx = x_az[1:] - x_az[:-1]
+    dz = z_az[1:] - z_az[:-1]
+    seg = jnp.arctan2(-dx, dz)
+    cone = jnp.concatenate([seg[:1], 0.5 * (seg[1:] + seg[:-1]), seg[-1:]])
+    ds = jnp.sqrt((x_az[1:] - x_az[:-1]) ** 2 + (y_az[1:] - y_az[:-1]) ** 2
+                  + (z_az[1:] - z_az[:-1]) ** 2)
+    s = jnp.concatenate([jnp.zeros(1), jnp.cumsum(ds)])
+    return x_az, y_az, z_az, cone, s
+
+
+def _wind_components(rot: RotorModel, Uinf, Omega_rs, azimuth_rad, tilt, yaw):
+    """Axial/tangential velocity at each element (CCBlade windcomponents)."""
+    r = jnp.asarray(rot.blade_r)
+    precone = jnp.radians(rot.precone)
+    x_az, y_az, z_az, cone, _ = _define_curvature(
+        r, jnp.asarray(rot.precurve), jnp.asarray(rot.presweep), precone)
+    sy, cy = jnp.sin(yaw), jnp.cos(yaw)
+    st, ct = jnp.sin(tilt), jnp.cos(tilt)
+    sa, ca = jnp.sin(azimuth_rad), jnp.cos(azimuth_rad)
+    sc, cc = jnp.sin(cone), jnp.cos(cone)
+
+    height = (y_az * sa + z_az * ca) * ct - x_az * st
+    V = Uinf * (1.0 + height / rot.hubHt) ** rot.shearExp
+    Vwind_x = V * ((cy * st * ca + sy * sa) * sc + cy * ct * cc)
+    Vwind_y = V * (cy * st * sa - sy * ca)
+    Vrot_x = -Omega_rs * y_az * sc
+    Vrot_y = Omega_rs * z_az
+    return Vwind_x + Vrot_x, Vwind_y + Vrot_y
+
+
+def _induction_residual(rot, phi, alpha_off, Vx, Vy):
+    """Ning (2014) residual + induction factors at inflow angle phi.
+
+    All element arrays (nr,).  Returns (R, a, ap, cn, ct)."""
+    sphi, cphi = jnp.sin(phi), jnp.cos(phi)
+    alpha = phi - alpha_off
+    cl = _ppoly_eval(jnp.asarray(rot.cl_bp), jnp.asarray(rot.cl_c), alpha)
+    cd = _ppoly_eval(jnp.asarray(rot.cd_bp), jnp.asarray(rot.cd_c), alpha)
+    cn = cl * cphi + cd * sphi
+    ct = cl * sphi - cd * cphi
+
+    r = jnp.asarray(rot.blade_r)
+    B = rot.nBlades
+    sigma_p = B / (2.0 * jnp.pi) * jnp.asarray(rot.chord) / r
+    asphi = jnp.maximum(jnp.abs(sphi), 1e-9)
+    ftip = B / 2.0 * (rot.Rtip - r) / (r * asphi)
+    Ftip = 2.0 / jnp.pi * jnp.arccos(jnp.clip(jnp.exp(-ftip), -1.0, 1.0))
+    fhub = B / 2.0 * (r - rot.Rhub) / (rot.Rhub * asphi)
+    Fhub = 2.0 / jnp.pi * jnp.arccos(jnp.clip(jnp.exp(-fhub), -1.0, 1.0))
+    F = jnp.maximum(Ftip * Fhub, 1e-9)
+
+    def _signed_floor(x, floor):
+        s = jnp.where(x < 0, -1.0, 1.0)
+        return s * jnp.maximum(jnp.abs(x), floor)
+
+    sphi_safe = _signed_floor(sphi, 1e-12)
+    cphi_safe = _signed_floor(cphi, 1e-12)
+    k = sigma_p * cn / (4.0 * F * sphi_safe * sphi_safe)
+    kp = sigma_p * ct / (4.0 * F * sphi_safe * cphi_safe)
+
+    # axial induction: momentum region / Buhl empirical region (phi>0)
+    g1 = 2.0 * F * k - (10.0 / 9.0 - F)
+    g2 = jnp.maximum(2.0 * F * k - (4.0 / 3.0 - F) * F, 1e-12)
+    g3 = 2.0 * F * k - (25.0 / 9.0 - 2.0 * F)
+    g3_safe = jnp.where(jnp.abs(g3) < 1e-6, 1.0, g3)
+    a_buhl = jnp.where(jnp.abs(g3) < 1e-6,
+                       1.0 - 1.0 / (2.0 * jnp.sqrt(g2)),
+                       (g1 - jnp.sqrt(g2)) / g3_safe)
+    # momentum solution: guard k == -1 (pole) with a signed floor
+    a_mom = k / _signed_floor(1.0 + k, 1e-12)
+    a_pos = jnp.where(k <= 2.0 / 3.0, a_mom, a_buhl)
+    # propeller-brake region (phi<0)
+    a_neg = jnp.where(k > 1.0, k / _signed_floor(k - 1.0, 1e-12), 0.0)
+    a = jnp.where(phi > 0, a_pos, a_neg)
+
+    ap = kp / _signed_floor(1.0 - kp, 1e-12)
+
+    Vx_safe = _signed_floor(Vx, 1e-9)
+    Vy_safe = _signed_floor(Vy, 1e-9)
+    lam = Vy_safe / Vx_safe
+    one_m_a = _signed_floor(1.0 - a, 1e-12)
+    R_pos = sphi / one_m_a - cphi / lam * (1.0 - kp)
+    R_neg = sphi * (1.0 - k) - cphi / lam * (1.0 - kp)
+    R = jnp.where(phi > 0, R_pos, R_neg)
+    return R, a, ap, cn, ct
+
+
+def _solve_phi(rot, alpha_off, Vx, Vy):
+    """Bracketed bisection (CCBlade's interval strategy) + Newton polish."""
+    def res(phi):
+        return _induction_residual(rot, phi, alpha_off, Vx, Vy)[0]
+
+    eps = _EPS_PHI
+    lo1, hi1 = jnp.full_like(Vx, eps), jnp.full_like(Vx, jnp.pi / 2)
+    lo2, hi2 = jnp.full_like(Vx, -jnp.pi / 4), jnp.full_like(Vx, -eps)
+    lo3, hi3 = jnp.full_like(Vx, jnp.pi / 2), jnp.full_like(Vx, jnp.pi - eps)
+    r1lo, r1hi = res(lo1), res(hi1)
+    r2lo, r2hi = res(lo2), res(hi2)
+    use1 = r1lo * r1hi <= 0.0
+    use2 = (~use1) & (r2lo * r2hi <= 0.0)
+    lo = jnp.where(use1, lo1, jnp.where(use2, lo2, lo3))
+    hi = jnp.where(use1, hi1, jnp.where(use2, hi2, hi3))
+
+    def body(_, state):
+        lo, hi, rlo = state
+        mid = 0.5 * (lo + hi)
+        rmid = res(mid)
+        go_lo = rlo * rmid <= 0.0
+        lo_n = jnp.where(go_lo, lo, mid)
+        hi_n = jnp.where(go_lo, mid, hi)
+        rlo_n = jnp.where(go_lo, rlo, rmid)
+        return lo_n, hi_n, rlo_n
+
+    lo_f, hi_f, _ = jax.lax.fori_loop(
+        0, _N_BISECT, body,
+        (jax.lax.stop_gradient(lo), jax.lax.stop_gradient(hi),
+         jax.lax.stop_gradient(res(lo))))
+    phi = 0.5 * (lo_f + hi_f)
+
+    # Newton polish (differentiable; restores implicit-function gradients)
+    for _ in range(_N_NEWTON):
+        r, dr = jax.jvp(res, (phi,), (jnp.ones_like(phi),))
+        dr_safe = jnp.where(jnp.abs(dr) < 1e-14, 1e-14, dr)
+        step = jnp.clip(r / dr_safe, -0.05, 0.05)
+        phi = phi - step
+    return phi
+
+
+def _distributed_loads(rot: RotorModel, Uinf, Omega_rpm, pitch_deg, azimuth_deg,
+                       tilt, yaw):
+    """Np, Tp (N/m) along the blade at one azimuth, plus W and alpha."""
+    Omega_rs = Omega_rpm * _RPM2RS
+    az = jnp.radians(azimuth_deg)
+    Vx, Vy = _wind_components(rot, Uinf, Omega_rs, az, tilt, yaw)
+    alpha_off = jnp.radians(jnp.asarray(rot.theta_deg) + pitch_deg)
+    phi = _solve_phi(rot, alpha_off, Vx, Vy)
+    _, a, ap, cn, ct = _induction_residual(rot, phi, alpha_off, Vx, Vy)
+    W2 = (Vx * (1.0 - a)) ** 2 + (Vy * (1.0 + ap)) ** 2
+    chord = jnp.asarray(rot.chord)
+    Np = cn * 0.5 * rot.rho * W2 * chord
+    Tp = ct * 0.5 * rot.rho * W2 * chord
+    return Np, Tp, jnp.sqrt(W2), phi - alpha_off
+
+
+def _hub_loads_one_azimuth(rot: RotorModel, Np, Tp, azimuth_deg):
+    """Integrate one blade's distributed loads (with hub/tip zero padding)
+    along the curved path and express force/moment in the hub frame."""
+    r = jnp.asarray(rot.blade_r)
+    rfull = jnp.concatenate([jnp.array([rot.Rhub]), r, jnp.array([rot.Rtip])])
+    curve = jnp.concatenate([jnp.zeros(1), jnp.asarray(rot.precurve),
+                             jnp.array([rot.precurveTip])])
+    sweep = jnp.concatenate([jnp.zeros(1), jnp.asarray(rot.presweep),
+                             jnp.array([rot.presweepTip])])
+    Npf = jnp.concatenate([jnp.zeros(1), Np, jnp.zeros(1)])
+    Tpf = jnp.concatenate([jnp.zeros(1), Tp, jnp.zeros(1)])
+    x_az, y_az, z_az, cone, s = _define_curvature(rfull, curve, sweep,
+                                                  jnp.radians(rot.precone))
+    # force per unit path length in the azimuthal frame
+    f = jnp.stack([Npf * jnp.cos(cone), -Tpf, Npf * jnp.sin(cone)], axis=-1)
+    p = jnp.stack([x_az, y_az, z_az], axis=-1)
+    m = jnp.cross(p, f)
+    F_az = jnp.trapezoid(f, s, axis=0)
+    M_az = jnp.trapezoid(m, s, axis=0)
+    # azimuthal -> hub frame: rotation about x by the azimuth angle
+    psi = jnp.radians(azimuth_deg)
+    cpsi, spsi = jnp.cos(psi), jnp.sin(psi)
+    Rx = jnp.array([[1.0, 0.0, 0.0],
+                    [0.0, cpsi, spsi],
+                    [0.0, -spsi, cpsi]])
+    return Rx @ F_az, Rx @ M_az
+
+
+def bem_evaluate(rot: RotorModel, Uinf, Omega_rpm, pitch_deg,
+                 tilt=0.0, yaw=0.0):
+    """Azimuth-averaged hub loads: dict(T, Y, Z, Q, My, Mz, P).
+
+    Equivalent of ccblade.evaluate (reference use: raft_rotor.py:726)
+    with nSector azimuthal sectors.  Fully differentiable w.r.t.
+    (Uinf, Omega_rpm, pitch_deg).
+    """
+    azimuths = jnp.linspace(0.0, 360.0, rot.nSector, endpoint=False)
+
+    def one(azimuth):
+        Np, Tp, _, _ = _distributed_loads(rot, Uinf, Omega_rpm, pitch_deg,
+                                          azimuth, tilt, yaw)
+        return _hub_loads_one_azimuth(rot, Np, Tp, azimuth)
+
+    F, M = jax.vmap(one)(azimuths)
+    F = rot.nBlades * jnp.mean(F, axis=0)
+    M = rot.nBlades * jnp.mean(M, axis=0)
+    Omega_rs = Omega_rpm * _RPM2RS
+    return dict(T=F[0], Y=F[1], Z=F[2], Q=M[0], My=M[1], Mz=M[2],
+                P=M[0] * Omega_rs)
+
+
+def bem_thrust_torque_derivs(rot: RotorModel, Uinf, Omega_rpm, pitch_deg,
+                             tilt=0.0, yaw=0.0):
+    """(T, Q) and their Jacobian w.r.t. (Uinf, Omega_rpm, pitch_deg) by
+    forward-mode autodiff (replaces CCBlade's hand-coded derivatives,
+    reference: raft_rotor.py:753-764)."""
+    def tq(x):
+        out = bem_evaluate(rot, x[0], x[1], x[2], tilt, yaw)
+        return jnp.stack([out["T"], out["Q"]])
+
+    x = jnp.stack([jnp.asarray(Uinf, float), jnp.asarray(Omega_rpm, float),
+                   jnp.asarray(pitch_deg, float)])
+    TQ = tq(x)
+    J = jax.jacfwd(tq)(x)
+    return TQ, J
+
+
+# --------------------------------------------------------------------------
+# IEC Kaimal rotor-averaged spectrum
+# --------------------------------------------------------------------------
+
+_IEC_VREF = {"I": 50.0, "II": 42.5, "III": 37.5, "IV": 30.0}
+_IEC_IREF = {"A+": 0.18, "A": 0.16, "B": 0.14, "C": 0.12}
+
+
+def turbulence_sigma(turbulence, speed, turbine_class="I",
+                     turbulence_class="B"):
+    """sigma_1 from the IEC 61400-1 models (host-side; reference:
+    raft/pyIECWind.py NTM/ETM/EWM + raft_rotor.py:1147-1193).
+
+    ``turbulence`` is a float TI (NTM with I_ref=TI) or a string like
+    'IB_NTM' (class+category+model)."""
+    if isinstance(turbulence, str):
+        cls = ""
+        for ch in turbulence:
+            if ch in ("I", "V"):
+                cls += ch
+            else:
+                break
+        if not cls:
+            I_ref = float(turbulence)
+            model = "NTM"
+            V_ave = _IEC_VREF[turbine_class] * 0.2
+        else:
+            categ = turbulence[len(cls)]
+            model = turbulence.split("_")[1]
+            I_ref = _IEC_IREF[categ]
+            V_ave = _IEC_VREF[cls] * 0.2
+    else:
+        I_ref = float(turbulence)
+        model = "NTM"
+        V_ave = _IEC_VREF[turbine_class] * 0.2
+
+    if model == "NTM":
+        return I_ref * (0.75 * speed + 5.6)
+    if model == "ETM":
+        c = 2.0
+        return c * I_ref * (0.072 * (V_ave / c + 3) * (speed / c - 4) + 10)
+    if model == "EWM":
+        return 0.11 * speed
+    raise ValueError(f"unknown turbulence model {model}")
+
+
+def kaimal_spectra(w, speed, HH, R, sigma_1):
+    """IEC Kaimal spectra U,V,W plus rotor-averaged Rot spectrum
+    [(m/s)^2/(rad/s)] (reference: raft_rotor.py:1195-1223), computed with
+    numerically-stable Struve-Bessel differences (the reference's naive
+    scipy difference collapses for 2*R*kappa over ~38)."""
+    w = jnp.asarray(w, float)
+    f = w / (2.0 * jnp.pi)
+    L_1 = jnp.where(HH <= 60.0, 0.7 * HH, 42.0)
+    sigma_u, L_u = sigma_1, 8.1 * L_1
+    sigma_v, L_v = 0.8 * sigma_1, 2.7 * L_1
+    sigma_w, L_w = 0.5 * sigma_1, 0.66 * L_1
+    U = (4 * L_u / speed) * sigma_u**2 / (1 + 6 * f * L_u / speed) ** (5.0 / 3.0)
+    V = (4 * L_v / speed) * sigma_v**2 / (1 + 6 * f * L_v / speed) ** (5.0 / 3.0)
+    W = (4 * L_w / speed) * sigma_w**2 / (1 + 6 * f * L_w / speed) ** (5.0 / 3.0)
+    kappa = 12.0 * jnp.sqrt((f / speed) ** 2 + (0.12 / L_u) ** 2)
+    x = 2.0 * R * kappa
+    d1 = struve_bessel_diff_1(x)
+    dm2 = struve_bessel_diff_m2(x)
+    Rk = R * kappa
+    Rot = (2.0 * U / Rk**3) * (d1 - 2.0 / jnp.pi + Rk * (-2.0 * dm2 + 1.0))
+    Rot = jnp.where(jnp.isfinite(Rot), Rot, 0.0)
+    return U, V, W, Rot
+
+
+# --------------------------------------------------------------------------
+# pose / yaw
+# --------------------------------------------------------------------------
+
+def rotor_pose(rot: RotorModel, r6=None, inflow_heading=0.0,
+               turbine_heading=0.0, yaw_command=0.0):
+    """Rotor orientation under a platform pose and yaw mode (reference:
+    raft_rotor.py:376-460).  Returns dict(R_ptfm, R_q, q, r_hub, yaw).
+    Angles in radians."""
+    if r6 is None:
+        r6 = jnp.zeros(6)
+    r6 = jnp.asarray(r6, float)
+    R_ptfm = rotation_matrix(r6[3], r6[4], r6[5])
+    platform_heading = r6[5]
+    if rot.yaw_mode == 0:
+        yaw = inflow_heading - platform_heading + yaw_command
+    elif rot.yaw_mode == 1:
+        yaw = turbine_heading - platform_heading
+    elif rot.yaw_mode == 2:
+        yaw = yaw_command
+    elif rot.yaw_mode == 3:
+        yaw = yaw_command - platform_heading
+    else:
+        raise ValueError("yaw_mode must be 0..3")
+    R_q_rel = rotation_matrix(0.0, rot.shaft_tilt, rot.shaft_toe + yaw)
+    # NOTE: the reference composes R_q = R_q_rel @ R_ptfm (raft_rotor.py:454);
+    # replicated verbatim for parity.
+    R_q = R_q_rel @ R_ptfm
+    q_rel = R_q_rel @ jnp.array([1.0, 0.0, 0.0])
+    q = R_ptfm @ q_rel
+    r_RRP_rel = R_ptfm @ jnp.asarray(rot.r_rel)
+    r_hub_rel = r_RRP_rel + q * rot.overhang
+    r_hub = r6[:3] + r_hub_rel
+    return dict(R_ptfm=R_ptfm, R_q=R_q, q=q, q_rel=q_rel, r_hub=r_hub, yaw=yaw)
+
+
+# --------------------------------------------------------------------------
+# aero-servo linearization
+# --------------------------------------------------------------------------
+
+def calc_aero(rot: RotorModel, w, case: dict, r6=None, current=False):
+    """Mean loads + frequency-domain aero matrices (reference:
+    raft_rotor.py:788-1005).
+
+    Returns dict(f0 (6,), f (6,nw) complex, a (6,6,nw), b (6,6,nw),
+    C (nw,) control transfer fn, pose info, operating point).
+    """
+    w = jnp.asarray(w, float)
+    nw = w.shape[0]
+    if current:
+        speed = float(get_from_dict(case, "current_speed", shape=0, default=1.0))
+        heading = float(get_from_dict(case, "current_heading", shape=0, default=0.0))
+        turb = case.get("current_turbulence", 0.0)
+    else:
+        speed = float(get_from_dict(case, "wind_speed", shape=0, default=10.0))
+        heading = float(get_from_dict(case, "wind_heading", shape=0, default=0.0))
+        turb = case.get("turbulence", 0.0)
+
+    inflow_heading = np.radians(heading)
+    turbine_heading = np.radians(float(get_from_dict(case, "turbine_heading", shape=0, default=0.0)))
+    yaw_command = np.radians(float(get_from_dict(case, "yaw_misalign", shape=0, default=0.0)))
+
+    pose = rotor_pose(rot, r6, inflow_heading=inflow_heading,
+                      turbine_heading=turbine_heading, yaw_command=yaw_command)
+    q = pose["q"]
+    yaw_misalign = jnp.arctan2(q[1], q[0]) - inflow_heading
+    turbine_tilt = jnp.arctan2(q[2], jnp.hypot(q[0], q[1]))
+
+    # operating point (reference :714-718)
+    Uhub = speed * rot.speed_gain
+    Omega_rpm = jnp.interp(Uhub, jnp.asarray(rot.Uhub_ops), jnp.asarray(rot.Omega_rpm_ops))
+    pitch_deg = jnp.interp(Uhub, jnp.asarray(rot.Uhub_ops), jnp.asarray(rot.pitch_deg_ops))
+
+    loads = bem_evaluate(rot, Uhub, Omega_rpm, pitch_deg,
+                         tilt=turbine_tilt, yaw=yaw_misalign)
+    TQ, J = bem_thrust_torque_derivs(rot, Uhub, Omega_rpm, pitch_deg,
+                                     tilt=turbine_tilt, yaw=yaw_misalign)
+    dT_dU = J[0, 0]
+    dT_dOm = J[0, 1] / _RPM2RADPS
+    dT_dPi = J[0, 2] * _RAD2DEG
+    dQ_dU = J[1, 0]
+    dQ_dOm = J[1, 1] / _RPM2RADPS
+    dQ_dPi = J[1, 2] * _RAD2DEG
+
+    R_q = pose["R_q"]
+    f0 = jnp.concatenate([
+        R_q @ jnp.stack([loads["T"], loads["Y"], loads["Z"]]),
+        R_q @ jnp.stack([loads["My"], loads["Q"], loads["Mz"]]),
+    ])
+
+    # rotor-averaged turbulence spectrum -> wave-like amplitudes
+    HH = jnp.abs(pose["r_hub"][2])
+    sigma_1 = turbulence_sigma(turb, speed)
+    _, _, _, S_rot = kaimal_spectra(w, speed, HH, rot.R_rot, sigma_1)
+    V_w = jnp.sqrt(S_rot).astype(complex)
+
+    a = jnp.zeros((6, 6, nw))
+    b = jnp.zeros((6, 6, nw))
+    fvec = jnp.zeros((6, nw), dtype=complex)
+    C = jnp.zeros(nw, dtype=complex)
+
+    if rot.aeroServoMod == 1:
+        b_inflow = jnp.zeros((6, 6, nw)).at[0, 0, :].set(dT_dU)
+        a = rotate_matrix_6(jnp.moveaxis(a, -1, 0), R_q)
+        a = jnp.moveaxis(a, 0, -1)
+        b = rotate_matrix_6(jnp.moveaxis(b_inflow, -1, 0), R_q)
+        b = jnp.moveaxis(b, 0, -1)
+        f_inflow = dT_dU * V_w
+        fvec = fvec.at[:3, :].set(R_q.astype(complex)
+                                  @ jnp.stack([f_inflow,
+                                               jnp.zeros_like(f_inflow),
+                                               jnp.zeros_like(f_inflow)]))
+    elif rot.aeroServoMod == 2:
+        kp_beta = -jnp.interp(jnp.asarray(speed, float), jnp.asarray(rot.Uhub_ops), jnp.asarray(rot.kp_0))
+        ki_beta = -jnp.interp(jnp.asarray(speed, float), jnp.asarray(rot.Uhub_ops), jnp.asarray(rot.ki_0))
+        kp_tau = rot.kp_tau * (kp_beta == 0)
+        ki_tau = rot.ki_tau * (ki_beta == 0)
+        zhub = pose["r_hub"][2]
+
+        D = (rot.I_drivetrain * w**2
+             + (dQ_dOm + kp_beta * dQ_dPi - rot.Ng * kp_tau) * 1j * w
+             + ki_beta * dQ_dPi - rot.Ng * ki_tau)
+        C = 1j * w * (dQ_dU - rot.k_float * dQ_dPi / zhub) / D
+        H_QT = ((dT_dOm + kp_beta * dT_dPi) * 1j * w + ki_beta * dT_dPi) / D
+        f2 = (dT_dU - H_QT * dQ_dU) * V_w
+        b2 = jnp.real(dT_dU - rot.k_float * dT_dPi
+                      - H_QT * (dQ_dU - rot.k_float * dQ_dPi))
+        a2 = jnp.real((dT_dU - rot.k_float * dT_dPi
+                       - H_QT * (dQ_dU - rot.k_float * dQ_dPi)) / (1j * w))
+
+        diag_a = jnp.zeros((nw, 3, 3)).at[:, 0, 0].set(a2)
+        diag_b = jnp.zeros((nw, 3, 3)).at[:, 0, 0].set(b2)
+        a = a.at[:3, :3, :].set(jnp.moveaxis(rotate_matrix_3(diag_a, R_q), 0, -1))
+        b = b.at[:3, :3, :].set(jnp.moveaxis(rotate_matrix_3(diag_b, R_q), 0, -1))
+        fvec = fvec.at[:3, :].set(R_q.astype(complex)
+                                  @ jnp.stack([f2, jnp.zeros_like(f2),
+                                               jnp.zeros_like(f2)]))
+    # aeroServoMod == 0: all zeros
+
+    return dict(f0=f0, f=fvec, a=a, b=b, C=C, pose=pose, V_w=V_w,
+                loads=loads, op=dict(U=Uhub, Omega_rpm=Omega_rpm,
+                                     pitch_deg=pitch_deg),
+                derivs=dict(dT_dU=dT_dU, dT_dOm=dT_dOm, dT_dPi=dT_dPi,
+                            dQ_dU=dQ_dU, dQ_dOm=dQ_dOm, dQ_dPi=dQ_dPi))

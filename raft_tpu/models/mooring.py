@@ -241,11 +241,11 @@ def coupled_stiffness(sys_: MooringSystem, r6):
 
 
 def tensions(sys_: MooringSystem, r6):
-    """Line end tensions [TA..., TB...] per line, shape (2*nl,), ordered
-    (TA_i, TB_i) pairs flattened line-major like the reference's
-    getTensions (MoorPy returns TA and TB per line)."""
+    """Line end tensions, shape (2*nl,): all anchor-end tensions first,
+    then all fairlead-end tensions ([TA_1..TA_n, TB_1..TB_n]), matching
+    MoorPy's getTensions ordering used by the reference."""
     _, _, sol = line_forces(sys_, r6)
-    return jnp.stack([sol["TA"], sol["TB"]], axis=1).reshape(-1)
+    return jnp.concatenate([sol["TA"], sol["TB"]])
 
 
 def tension_jacobian(sys_: MooringSystem, r6):

@@ -1,0 +1,98 @@
+"""End-to-end Model parity on OC3spar vs the reference regression data.
+
+Case 0 (wave-only, parked-equivalent loading) validates the entire
+strip-theory hydro + mooring + drag-linearization + RAO pipeline: PSDs
+match the reference pickle to ~1e-5 relative.  Case 1 (operating turbine)
+inherits the documented ~2% BEM aero deviation (see tests/test_rotor.py),
+so only loose sanity tolerances apply there pending CCBlade cross-load
+parity.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+import yaml
+from numpy.testing import assert_allclose
+
+from raft_tpu.model import Model
+
+YAML = "/root/reference/tests/test_data/OC3spar.yaml"
+PKL = "/root/reference/tests/test_data/OC3spar_true_analyzeCases.pkl"
+
+
+@pytest.fixture(scope="module")
+def model_and_truth():
+    if not (os.path.isfile(YAML) and os.path.isfile(PKL)):
+        pytest.skip("reference test data not available")
+    design = yaml.safe_load(open(YAML))
+    m = Model(design)
+    m.analyzeCases()
+    truth = pickle.load(open(PKL, "rb"))
+    return m, truth
+
+
+def test_wave_only_case_psd_parity(model_and_truth):
+    m, truth = model_and_truth
+    ours, ref = m.results["case_metrics"][0][0], truth[0][0]
+    for ch in ["surge", "sway", "heave", "roll", "pitch", "yaw"]:
+        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=1e-4, atol=1e-10,
+                        err_msg=f"{ch}_std")
+        assert_allclose(ours[f"{ch}_PSD"], ref[f"{ch}_PSD"], rtol=1e-4, atol=1e-3,
+                        err_msg=f"{ch}_PSD")
+    assert_allclose(ours["heave_avg"], ref["heave_avg"], rtol=1e-4)
+    # mooring tension statistics (std depends on the tension Jacobian,
+    # where our exact-autodiff values differ from MoorPy's analytic
+    # derivatives by a few percent)
+    assert_allclose(ours["Tmoor_avg"], ref["Tmoor_avg"], rtol=2e-3)
+    assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=6e-2)
+
+
+def test_operating_case_sanity(model_and_truth):
+    """Loose check: operating-turbine case within ~10% (limited by the
+    reimplemented BEM; see test_rotor.py docstring)."""
+    m, truth = model_and_truth
+    ours, ref = m.results["case_metrics"][1][0], truth[1][0]
+    for ch, tol in [("surge", 0.05), ("heave", 0.05), ("pitch", 0.10)]:
+        assert_allclose(ours[f"{ch}_avg"], ref[f"{ch}_avg"], rtol=tol,
+                        err_msg=f"{ch}_avg")
+        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=0.10,
+                        err_msg=f"{ch}_std")
+
+
+def test_statics_wave_and_current():
+    if not os.path.isfile(YAML):
+        pytest.skip("reference test data not available")
+    design = yaml.safe_load(open(YAML))
+    m = Model(design)
+    base = {"wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+            "turbine_status": "operating", "yaw_misalign": 0,
+            "wave_spectrum": "JONSWAP", "wave_period": 10, "wave_height": 4,
+            "wave_heading": -30, "current_speed": 0, "current_heading": 0}
+    X = m.solveStatics(dict(base))
+    ref_wave = np.array([-1.64267049e-05, -2.83795893e-15, -6.65861624e-01,
+                         3.88717546e-19, -5.94238978e-11, -4.02571352e-17])
+    assert_allclose(X, ref_wave, rtol=2e-2, atol=5e-5)
+    cur = dict(base, wave_period=0, wave_height=0, wave_heading=0,
+               current_speed=0.6, current_heading=15)
+    X = m.solveStatics(cur)
+    ref_cur = np.array([3.86072176e+00, 9.22694246e-01, -6.74898762e-01,
+                        -2.64759824e-04, 9.82529767e-04, -1.03532699e-05])
+    assert_allclose(X, ref_cur, rtol=1e-3, atol=5e-5)
+
+
+def test_eigen_frequencies():
+    if not os.path.isfile(YAML):
+        pytest.skip("reference test data not available")
+    design = yaml.safe_load(open(YAML))
+    m = Model(design)
+    m.analyzeUnloaded()
+    fns, modes = m.solveEigen()
+    # OC3 spar published natural periods: surge/sway ~125s, heave ~31s,
+    # pitch/roll ~30s, yaw ~8s (approximate ranges)
+    assert 0.007 < fns[0] < 0.010   # surge
+    assert 0.007 < fns[1] < 0.010   # sway
+    assert 0.030 < fns[2] < 0.035   # heave
+    assert 0.030 < fns[3] < 0.036   # roll
+    assert 0.030 < fns[4] < 0.036   # pitch
+    assert 0.10 < fns[5] < 0.25     # yaw

@@ -1,0 +1,106 @@
+"""Benchmark: batched frequency-domain RAO solves on the flagship model.
+
+Metric: RAO frequency-bin solves per second per chip (BASELINE.json unit),
+measured on a batch of VolturnUS-S load cases run through the full
+drag-linearization fixed point + batched complex 6x6 solve.
+
+vs_baseline compares against a serial reference-equivalent implementation
+measured on this host: the same math with vectorized-numpy node operations
+but Python loops over cases and frequency bins (the reference's structure,
+raft/raft_model.py:942-947 — and generous to it, since the reference also
+loops members/nodes in Python).
+
+Prints ONE json line.
+"""
+import json
+import os
+import time
+
+# TPU has no float64 — run the benchmark in f32/c64 (must be set before any
+# raft_tpu import; accuracy-critical CPU runs keep the default x64)
+os.environ.setdefault("RAFT_TPU_X64", "0")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _load_fowt
+    from raft_tpu.parallel.sweep import make_case_solver
+
+    fowt = _load_fowt()
+    nw = len(fowt.w)
+    NC = 256
+    NITER = 10
+
+    rng = np.random.default_rng(1)
+    Hs = 4.0 + 2.0 * rng.random(NC)
+    Tp = 8.0 + 6.0 * rng.random(NC)
+    beta = np.zeros(NC)
+
+    solver = make_case_solver(fowt, nIter=NITER, tol=-1.0)  # tol<0: full iterations
+    batched = jax.jit(jax.vmap(solver))
+
+    out = batched(Hs, Tp, beta)  # compile + warmup
+    jax.block_until_ready(out["std"])
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = batched(Hs, Tp, beta)
+        jax.block_until_ready(out["std"])
+    dt = (time.perf_counter() - t0) / reps
+    # each case solves nw bins per fixed-point iteration
+    bins_per_sec = NC * nw * NITER / dt
+
+    baseline_bps = _serial_numpy_baseline(fowt, nw, NITER)
+
+    dev = jax.devices()[0]
+    result = {
+        "metric": "RAO freq-bin solves/sec/chip (VolturnUS-S case sweep, "
+                  f"f32, device={dev.platform})",
+        "value": round(bins_per_sec, 1),
+        "unit": "bins/s/chip",
+        "vs_baseline": round(bins_per_sec / baseline_bps, 2),
+    }
+    print(json.dumps(result))
+
+
+def _serial_numpy_baseline(fowt, nw, niter):
+    """Reference-structure serial solve: Python loops over cases/freqs."""
+    from raft_tpu.models.fowt import fowt_pose, fowt_statics, fowt_hydro_constants
+    import jax
+
+    r6 = np.zeros(6)
+    pose = fowt_pose(fowt, r6)
+    stat = fowt_statics(fowt, pose)
+    hc = fowt_hydro_constants(fowt, pose)
+    M = np.asarray(stat["M_struc"]) + np.asarray(hc["A_hydro_morison"])
+    C = np.asarray(stat["C_struc"]) + np.asarray(stat["C_hydro"])
+    C = C + np.eye(6) * np.abs(np.diag(C)).max() * 0.1  # keep it invertible
+    w = fowt.w
+    r = np.asarray(pose["r"])
+    N = r.shape[0]
+    ncase_meas = 2
+    F = (np.ones((6, nw)) + 1j * np.ones((6, nw)))
+    t0 = time.perf_counter()
+    for _ in range(ncase_meas):
+        Xi = np.zeros((6, nw), complex)
+        for _ in range(niter):
+            # node-level linearization stand-in (vectorized numpy)
+            vrel = np.random.default_rng(0).random((N, 3, nw))
+            vrms = np.sqrt(0.5 * np.sum(np.abs(vrel) ** 2, axis=2))
+            Bn = vrms[:, :, None] * np.eye(3)[None, :, :]
+            B6 = np.sum(Bn, axis=0)
+            B = np.zeros((6, 6))
+            B[:3, :3] = B6
+            for iw in range(nw):
+                Z = -w[iw] ** 2 * M + 1j * w[iw] * B + C
+                Xi[:, iw] = np.linalg.solve(Z, F[:, iw])
+    dt = time.perf_counter() - t0
+    return ncase_meas * nw * niter / dt
+
+
+if __name__ == "__main__":
+    main()

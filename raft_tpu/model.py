@@ -40,6 +40,7 @@ from raft_tpu.models.fowt import (
 )
 from raft_tpu.models.rotor import calc_aero
 from raft_tpu.ops.spectra import get_psd, get_rms
+from raft_tpu.ops.linalg import solve_complex
 from raft_tpu.ops.transforms import transform_force, translate_matrix_6to6
 from raft_tpu.models.member import member_inertia
 from raft_tpu.utils.dicttools import get_from_dict
@@ -255,8 +256,9 @@ class Model:
                   + 1j * w[None, None, :] * B_tot
                   + C_lin[:, :, None]).astype(complex)
             # batched complex 6x6 solve over all frequencies at once
-            Xin = jnp.linalg.solve(jnp.moveaxis(Zn, -1, 0),
-                                   jnp.moveaxis(F_lin + F_drag, -1, 0)[..., None])[..., 0]
+            # (real block embedding keeps this TPU-compatible)
+            Xin = solve_complex(jnp.moveaxis(Zn, -1, 0),
+                                jnp.moveaxis(F_lin + F_drag, -1, 0))
             Xin = jnp.moveaxis(Xin, 0, -1)   # (6, nw)
             tolCheck = jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
             conv = jnp.all(tolCheck < tol)
@@ -280,7 +282,7 @@ class Model:
         for ih in range(nWaves):
             F_drag_h = fowt_drag_excitation(fowt, pose_eq, Bmat, exc["u"][ih])
             F_wave = exc["F_hydro_iner"][ih] + F_drag_h
-            Xi_h = jnp.linalg.solve(Zb, jnp.moveaxis(F_wave, -1, 0)[..., None])[..., 0]
+            Xi_h = solve_complex(Zb, jnp.moveaxis(F_wave, -1, 0))
             Xi_all[ih] = np.asarray(jnp.moveaxis(Xi_h, 0, -1))
 
         state["Xi"] = Xi_all

@@ -1,0 +1,36 @@
+"""Linear-algebra helpers for TPU compatibility.
+
+TPU backends implement real LU/triangular solves but NOT complex ones
+(jnp.linalg.solve on complex inputs raises UNIMPLEMENTED on TPU).  The
+frequency-domain impedance solves Z X = F are complex, so they run through
+a real 2n x 2n block embedding
+
+    [Re Z  -Im Z] [Re X]   [Re F]
+    [Im Z   Re Z] [Im X] = [Im F]
+
+which is mathematically identical and uses only real kernels, keeping one
+code path across CPU/GPU/TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def solve_complex(A, b):
+    """Solve A x = b for complex A (..., n, n) and b (..., n) or (..., n, k)
+    via the real block embedding (TPU-safe)."""
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    n = A.shape[-1]
+    vec = b.ndim == A.ndim - 1
+    if vec:
+        b = b[..., None]
+    Ar, Ai = jnp.real(A), jnp.imag(A)
+    M = jnp.concatenate([
+        jnp.concatenate([Ar, -Ai], axis=-1),
+        jnp.concatenate([Ai, Ar], axis=-1),
+    ], axis=-2)
+    rhs = jnp.concatenate([jnp.real(b), jnp.imag(b)], axis=-2)
+    x = jnp.linalg.solve(M, rhs)
+    out = x[..., :n, :] + 1j * x[..., n:, :]
+    return out[..., 0] if vec else out

@@ -1,0 +1,105 @@
+"""Batched/sharded case and design sweeps.
+
+The reference runs load cases and design variants in serial Python loops
+(reference: raft/raft_model.py:267 case loop; raft/parametersweep.py:56-100
+design loop).  Here a case is a pure function of its parameters, so cases
+vmap into one batched program and shard across a `jax.sharding.Mesh` —
+the ICI/DCN-parallel axis of this framework (the reference has no
+distributed backend; SURVEY.md §2.9).
+
+`make_case_solver(fowt)` closes over the static model description and
+returns a jit/vmap-able function (Hs, Tp, heading_rad) -> response stats:
+the full drag-linearization fixed point (lax.while_loop) around one
+batched complex 6x6 solve over all frequencies.
+
+`sweep_cases(...)` vmaps it over a case batch and shards the batch axis
+over the devices of a 1-D mesh.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.models import mooring as mr
+from raft_tpu.models.fowt import (
+    FOWTModel, fowt_pose, fowt_statics, fowt_hydro_constants,
+    fowt_hydro_excitation, fowt_hydro_linearization, fowt_drag_excitation,
+)
+from raft_tpu.ops.linalg import solve_complex
+from raft_tpu.ops.spectra import jonswap, get_rms
+
+
+def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
+                     XiStart: float = 0.1, r6=None):
+    """Pure per-case response solver (no aero; wave loading) suitable for
+    jit/vmap.  Returns fn(Hs, Tp, beta_rad) -> dict(Xi (6,nw) complex,
+    std (6,))."""
+    if r6 is None:
+        r6 = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+    w = jnp.asarray(fowt.w)
+    nw = len(fowt.w)
+    dw = float(fowt.w[1] - fowt.w[0])
+
+    def solve(Hs, Tp, beta):
+        pose = fowt_pose(fowt, r6)
+        stat = fowt_statics(fowt, pose)
+        hc = fowt_hydro_constants(fowt, pose)
+        C_moor = (mr.coupled_stiffness(fowt.mooring, r6)
+                  if fowt.mooring is not None else jnp.zeros((6, 6)))
+
+        S = jonswap(w, Hs, Tp)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        seastate = dict(beta=jnp.asarray(beta)[None], zeta=zeta[None])
+        exc = fowt_hydro_excitation(fowt, pose, seastate, hc)
+
+        M_lin = (stat["M_struc"] + hc["A_hydro_morison"])[:, :, None]
+        C_lin = stat["C_struc"] + C_moor + stat["C_hydro"]
+        F_lin = exc["F_hydro_iner"][0]
+        u0 = exc["u"][0]
+
+        def body(carry):
+            XiLast, Xi, ii, done = carry
+            B_drag6, Bmat = fowt_hydro_linearization(fowt, pose, XiLast, u0)
+            F_drag = fowt_drag_excitation(fowt, pose, Bmat, u0)
+            Z = (-w[None, None, :] ** 2 * M_lin
+                 + 1j * w[None, None, :] * B_drag6[:, :, None]
+                 + C_lin[:, :, None]).astype(complex)
+            Xin = solve_complex(jnp.moveaxis(Z, -1, 0),
+                                jnp.moveaxis(F_lin + F_drag, -1, 0))
+            Xin = jnp.moveaxis(Xin, 0, -1)
+            conv = jnp.all(jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol) < tol)
+            XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xin)
+            return (XiNext, Xin, ii + 1, done | conv)
+
+        def cond(carry):
+            _, _, ii, done = carry
+            return (ii < nIter) & (~done)
+
+        Xi0 = jnp.zeros((6, nw), dtype=complex) + XiStart
+        _, Xi, _, _ = jax.lax.while_loop(cond, body, (Xi0, Xi0, 0, False))
+        std = jax.vmap(lambda row: get_rms(row))(Xi)
+        return dict(Xi=Xi, std=std)
+
+    return solve
+
+
+def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
+                axis_name: str = "cases", **kw):
+    """Solve a batch of cases, sharding the case axis over ``mesh``.
+
+    Hs/Tp/beta: (ncases,) arrays.  Returns dict with batched outputs.
+    With no mesh, runs as a plain vmap on the default device.
+    """
+    solver = make_case_solver(fowt, **kw)
+    batched = jax.jit(jax.vmap(solver))
+    Hs = jnp.asarray(Hs, float)
+    Tp = jnp.asarray(Tp, float)
+    beta = jnp.asarray(beta, float)
+    if mesh is not None:
+        sh = NamedSharding(mesh, P(axis_name))
+        Hs = jax.device_put(Hs, sh)
+        Tp = jax.device_put(Tp, sh)
+        beta = jax.device_put(beta, sh)
+    return batched(Hs, Tp, beta)

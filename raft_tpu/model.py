@@ -361,7 +361,7 @@ class Model:
             results["Tmoor_max"] = T0 + 3 * TRMS
             results["Tmoor_min"] = T0 - 3 * TRMS
             results["Tmoor_PSD"] = np.stack(
-                [np.asarray(get_psd(T_amps[:, iT, :], self.w[0], source_axis=0))
+                [np.asarray(get_psd(T_amps[:, iT, :], dw, source_axis=0))
                  for iT in range(nT)])
 
         # nacelle acceleration + tower base bending (reference :1900-1971)

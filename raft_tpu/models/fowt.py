@@ -447,15 +447,19 @@ def build_seastate(fowt: FOWTModel, case: dict):
     """Host-side sea-state setup from a case dict (reference:
     raft_fowt.py:977-1014).  Returns dict(beta (nH,), S (nH,nw),
     zeta (nH,nw) complex)."""
-    wh = case["wave_heading"]
+    wh = case.get("wave_heading", 0.0)
     nWaves = 1 if np.isscalar(wh) else len(wh)
     heading = np.atleast_1d(np.asarray(
         get_from_dict(case, "wave_heading", shape=nWaves, dtype=float, default=0), float))
     spectrum = get_from_dict(case, "wave_spectrum", shape=nWaves, dtype=str,
                              default="JONSWAP")
     spectrum = [spectrum] * nWaves if isinstance(spectrum, str) else list(np.atleast_1d(spectrum))
-    period = np.atleast_1d(np.asarray(get_from_dict(case, "wave_period", shape=nWaves, dtype=float), float))
-    height = np.atleast_1d(np.asarray(get_from_dict(case, "wave_height", shape=nWaves, dtype=float), float))
+    # wind-only case rows carry no wave keys: default to a still sea state
+    period = np.atleast_1d(np.asarray(get_from_dict(case, "wave_period", shape=nWaves, dtype=float, default=0), float))
+    height = np.atleast_1d(np.asarray(get_from_dict(case, "wave_height", shape=nWaves, dtype=float, default=0), float))
+    for ih in range(nWaves):
+        if spectrum[ih] == "JONSWAP" and (height[ih] <= 0.0 or period[ih] <= 0.0):
+            spectrum[ih] = "still"
     gamma = np.atleast_1d(np.asarray(get_from_dict(case, "wave_gamma", shape=nWaves, dtype=float, default=0), float))
 
     w = fowt.w

@@ -58,6 +58,15 @@ def test_operating_case_sanity(model_and_truth):
                         err_msg=f"{ch}_avg")
         assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=0.10,
                         err_msg=f"{ch}_std")
+    # yaw + aero-servo control channels: loose guards so regressions in the
+    # aero-servo path are caught (ADVICE r1); tolerances limited by the
+    # reimplemented BEM (~3%).
+    assert_allclose(ours["yaw_std"], ref["yaw_std"], rtol=0.15, atol=1e-3,
+                    err_msg="yaw_std")
+    for ch in ("omega_std", "torque_std", "bPitch_std"):
+        assert_allclose(ours[ch], ref[ch], rtol=0.25, err_msg=ch)
+    assert_allclose(ours["omega_avg"], ref["omega_avg"], rtol=0.02)
+    assert_allclose(ours["bPitch_avg"], ref["bPitch_avg"], rtol=0.10)
 
 
 def test_statics_wave_and_current():

@@ -1,0 +1,72 @@
+"""Mesh-sharded case sweep: sharded outputs must match single-device.
+
+conftest.py forces an 8-virtual-device CPU platform, so these tests
+exercise the real `jax.sharding.Mesh` + NamedSharding path of
+`sweep_cases` — the framework's ICI/DCN-parallel axis (SURVEY.md §2.9) —
+without TPU hardware.
+"""
+import os
+
+import numpy as np
+import pytest
+import yaml
+from numpy.testing import assert_allclose
+
+import jax
+from jax.sharding import Mesh
+
+from raft_tpu.models.fowt import build_fowt
+from raft_tpu.parallel.sweep import sweep_cases
+
+YAML = "/root/reference/designs/OC3spar.yaml"
+
+
+@pytest.fixture(scope="module")
+def fowt():
+    if not os.path.isfile(YAML):
+        pytest.skip("reference designs not available")
+    design = yaml.safe_load(open(YAML))
+    # coarse frequency grid keeps the compile cheap while still exercising
+    # the full batched pipeline
+    w = np.arange(0.02, 0.40, 0.02) * 2 * np.pi
+    depth = float(design["site"]["water_depth"])
+    return build_fowt(design, w, depth=depth)
+
+
+def test_virtual_device_count():
+    assert len(jax.devices("cpu")) >= 8
+
+
+def test_sharded_sweep_matches_single_device(fowt):
+    rng = np.random.default_rng(7)
+    ncases = 16
+    Hs = 4.0 + 2.0 * rng.random(ncases)
+    Tp = 8.0 + 6.0 * rng.random(ncases)
+    beta = np.deg2rad(rng.integers(0, 360, ncases).astype(float))
+
+    plain = sweep_cases(fowt, Hs, Tp, beta, mesh=None, nIter=4)
+
+    devices = np.array(jax.devices("cpu")[:8])
+    mesh = Mesh(devices, axis_names=("cases",))
+    sharded = sweep_cases(fowt, Hs, Tp, beta, mesh=mesh, nIter=4)
+
+    std_p = np.asarray(plain["std"])
+    std_s = np.asarray(sharded["std"])
+    assert std_s.shape == (ncases, 6)
+    assert np.all(np.isfinite(std_s))
+    assert_allclose(std_s, std_p, rtol=1e-10, atol=1e-12)
+    assert_allclose(np.asarray(sharded["Xi"]), np.asarray(plain["Xi"]),
+                    rtol=1e-9, atol=1e-12)
+
+
+def test_sharded_output_is_distributed(fowt):
+    """The case axis must actually be sharded over the mesh devices."""
+    ncases = 8
+    Hs = np.full(ncases, 6.0)
+    Tp = np.full(ncases, 10.0)
+    beta = np.zeros(ncases)
+    devices = np.array(jax.devices("cpu")[:8])
+    mesh = Mesh(devices, axis_names=("cases",))
+    out = sweep_cases(fowt, Hs, Tp, beta, mesh=mesh, nIter=2)
+    sh = out["std"].sharding
+    assert len(sh.device_set) == 8

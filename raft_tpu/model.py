@@ -37,6 +37,7 @@ from raft_tpu.models.fowt import (
     FOWTModel, build_fowt, build_seastate, fowt_pose, fowt_statics,
     fowt_hydro_constants, fowt_hydro_excitation, fowt_hydro_linearization,
     fowt_drag_excitation, fowt_current_loads, fowt_turbine_constants,
+    fowt_bem_excitation,
 )
 from raft_tpu.models.rotor import calc_aero
 from raft_tpu.ops.spectra import get_psd, get_rms
@@ -238,12 +239,20 @@ class Model:
             B_turb = jnp.zeros((6, 6, nw))
             B_gyro = jnp.zeros((6, 6))
 
+        # potential-flow coefficients (reference: raft_model.py:911-914 —
+        # A_BEM/B_BEM always enter the linear system once loaded; F_BEM per
+        # the potMod guard inside fowt_bem_excitation)
+        from raft_tpu.io.wamit import bem_coeffs
+        A_BEM, B_BEM = bem_coeffs(fowt.bem, nw)
+        F_BEM = fowt_bem_excitation(fowt, seastate)   # (nH,6,nw)
+        state["F_BEM"] = F_BEM
+
         M_lin = M_turb + jnp.asarray(stat["M_struc"])[:, :, None] \
-            + jnp.asarray(hc0["A_hydro_morison"])[:, :, None]
-        B_lin = B_turb + B_gyro[:, :, None]
+            + jnp.asarray(hc0["A_hydro_morison"])[:, :, None] + A_BEM
+        B_lin = B_turb + B_gyro[:, :, None] + B_BEM
         C_lin = (jnp.asarray(stat["C_struc"]) + jnp.asarray(state["C_moor"])
                  + jnp.asarray(stat["C_hydro"]))
-        F_lin = exc["F_hydro_iner"][0]   # (6, nw); BEM excitation TBD
+        F_lin = F_BEM[0] + exc["F_hydro_iner"][0]   # (6, nw)
 
         u0 = exc["u"][0]
 
@@ -281,7 +290,7 @@ class Model:
         Xi_all = np.zeros((nWaves + 1, 6, nw), dtype=complex)
         for ih in range(nWaves):
             F_drag_h = fowt_drag_excitation(fowt, pose_eq, Bmat, exc["u"][ih])
-            F_wave = exc["F_hydro_iner"][ih] + F_drag_h
+            F_wave = F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag_h
             Xi_h = solve_complex(Zb, jnp.moveaxis(F_wave, -1, 0))
             Xi_all[ih] = np.asarray(jnp.moveaxis(Xi_h, 0, -1))
 

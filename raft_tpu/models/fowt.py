@@ -108,6 +108,13 @@ class FOWTModel:
     potModMaster: int
     potSecOrder: int = 0
     potFirstOrder: int = 0
+    bem: Optional[object] = None   # io.wamit.BEMData when potential-flow files loaded
+
+    @property
+    def potMod_any(self) -> bool:
+        """True when any member is modeled with potential flow (the
+        reference's self.potMod flag, raft_fowt.py:209-210)."""
+        return any(m.potMod for m in self.members)
 
     @property
     def nw(self):
@@ -195,6 +202,28 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
 
     nodes = _build_nodeset(members)
 
+    # potential-flow coefficient files (reference: raft_fowt.py:222-227 for
+    # potFirstOrder==1; :654-655 reuses the same path for potModMaster==3)
+    potFirstOrder = int(get_from_dict(platform, "potFirstOrder", dtype=int, default=0))
+    bem = None
+    if potFirstOrder == 1 or potModMaster == 3:
+        if "hydroPath" not in platform:
+            raise ValueError("potFirstOrder==1/potModMaster==3 require "
+                             "'hydroPath' in the platform input")
+        from raft_tpu.io.wamit import load_bem
+        bem = load_bem(platform["hydroPath"], w, rho=rho_water, g=g)
+    if bem is None and any(m.potMod for m in members):
+        # potMod members get no strip-theory hydro; without BEM coefficients
+        # they would silently have NO hydrodynamics at all.  The reference
+        # would run its pyHAMS BEM solver here (raft_fowt.py:568-650) —
+        # until a native radiation/diffraction core lands, require
+        # precomputed WAMIT files.
+        raise NotImplementedError(
+            "members with potMod=True require precomputed WAMIT coefficients "
+            "(set potFirstOrder: 1 with hydroPath, or potModMaster: 3); "
+            "an in-process BEM solver equivalent to the reference's pyHAMS "
+            "path is not implemented")
+
     return FOWTModel(
         members=members, member_types=member_types, member_names=member_names,
         rotors=rotors, mooring=moor, nodes=nodes,
@@ -204,7 +233,8 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
         heading_adjust=float(heading_adjust),
         nplatmems=nplatmems, ntowers=ntowers, potModMaster=potModMaster,
         potSecOrder=int(get_from_dict(platform, "potSecOrder", dtype=int, default=0)),
-        potFirstOrder=int(get_from_dict(platform, "potFirstOrder", dtype=int, default=0)),
+        potFirstOrder=potFirstOrder,
+        bem=bem,
     )
 
 
@@ -458,8 +488,12 @@ def build_seastate(fowt: FOWTModel, case: dict):
     period = np.atleast_1d(np.asarray(get_from_dict(case, "wave_period", shape=nWaves, dtype=float, default=0), float))
     height = np.atleast_1d(np.asarray(get_from_dict(case, "wave_height", shape=nWaves, dtype=float, default=0), float))
     for ih in range(nWaves):
-        if spectrum[ih] == "JONSWAP" and (height[ih] <= 0.0 or period[ih] <= 0.0):
+        if spectrum[ih] == "JONSWAP" and height[ih] <= 0.0:
             spectrum[ih] = "still"
+        elif spectrum[ih] == "JONSWAP" and period[ih] <= 0.0:
+            raise ValueError(
+                f"case specifies wave_height={height[ih]} but no positive "
+                "wave_period — set both (or neither, for a still sea state)")
     gamma = np.atleast_1d(np.asarray(get_from_dict(case, "wave_gamma", shape=nWaves, dtype=float, default=0), float))
 
     w = fowt.w
@@ -481,6 +515,30 @@ def build_seastate(fowt: FOWTModel, case: dict):
             raise ValueError(f"unknown wave spectrum '{sp}'")
         zeta[ih, :] = np.sqrt(2.0 * S[ih, :] * dw)
     return dict(beta=np.deg2rad(heading), S=S, zeta=zeta, nWaves=nWaves)
+
+
+def fowt_bem_excitation(fowt: FOWTModel, seastate):
+    """Potential-flow wave excitation per heading, (nH,6,nw) complex
+    (reference: raft_fowt.py:1034-1093).  Zero when no BEM data applies —
+    the reference computes F_BEM only when a member is potential-flow
+    modeled or potModMaster is 2/3 (raft_fowt.py:1040)."""
+    import jax
+
+    beta = jnp.atleast_1d(jnp.asarray(seastate["beta"]))
+    nH = beta.shape[0]
+    nw = fowt.nw
+    if fowt.bem is None or not (fowt.potMod_any or fowt.potModMaster in (2, 3)):
+        return jnp.zeros((nH, 6, nw), dtype=complex)
+    from raft_tpu.io.wamit import bem_excitation
+    zeta = jnp.asarray(seastate["zeta"]).reshape(nH, nw)
+    k = jnp.asarray(fowt.k)
+
+    def one(beta_h, zeta_h):
+        return bem_excitation(fowt.bem, beta_h, zeta_h, k,
+                              x_ref=fowt.x_ref, y_ref=fowt.y_ref,
+                              heading_adjust=fowt.heading_adjust)
+
+    return jax.vmap(one)(beta, zeta)
 
 
 def fowt_hydro_excitation(fowt: FOWTModel, pose, seastate, hydro_consts):

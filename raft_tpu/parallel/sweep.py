@@ -26,6 +26,7 @@ from raft_tpu.models import mooring as mr
 from raft_tpu.models.fowt import (
     FOWTModel, fowt_pose, fowt_statics, fowt_hydro_constants,
     fowt_hydro_excitation, fowt_hydro_linearization, fowt_drag_excitation,
+    fowt_bem_excitation,
 )
 from raft_tpu.ops.linalg import solve_complex
 from raft_tpu.ops.spectra import jonswap, get_rms
@@ -53,10 +54,13 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
         seastate = dict(beta=jnp.asarray(beta)[None], zeta=zeta[None])
         exc = fowt_hydro_excitation(fowt, pose, seastate, hc)
+        F_BEM = fowt_bem_excitation(fowt, seastate)[0]
 
-        M_lin = (stat["M_struc"] + hc["A_hydro_morison"])[:, :, None]
+        from raft_tpu.io.wamit import bem_coeffs
+        A_BEM, B_BEM = bem_coeffs(fowt.bem, nw)
+        M_lin = (stat["M_struc"] + hc["A_hydro_morison"])[:, :, None] + A_BEM
         C_lin = stat["C_struc"] + C_moor + stat["C_hydro"]
-        F_lin = exc["F_hydro_iner"][0]
+        F_lin = F_BEM + exc["F_hydro_iner"][0]
         u0 = exc["u"][0]
 
         def body(carry):
@@ -64,7 +68,7 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
             B_drag6, Bmat = fowt_hydro_linearization(fowt, pose, XiLast, u0)
             F_drag = fowt_drag_excitation(fowt, pose, Bmat, u0)
             Z = (-w[None, None, :] ** 2 * M_lin
-                 + 1j * w[None, None, :] * B_drag6[:, :, None]
+                 + 1j * w[None, None, :] * (B_drag6[:, :, None] + B_BEM)
                  + C_lin[:, :, None]).astype(complex)
             Xin = solve_complex(jnp.moveaxis(Z, -1, 0),
                                 jnp.moveaxis(F_lin + F_drag, -1, 0))

@@ -141,31 +141,29 @@ def _interp_freq(w_model, w_data, Y, Y_at_zero):
     return out
 
 
-def load_bem(hydro_path: str, w_model, rho: float = 1025.0, g: float = 9.81,
-             search_dirs=("/root/reference",)) -> BEMData:
+def load_bem(hydro_path: str, w_model, rho: float = 1025.0,
+             g: float = 9.81) -> BEMData:
     """Read `hydro_path`.1/.3 and interpolate onto the model grid
     (reference: raft_fowt.py:663-768).
 
-    A relative path that doesn't resolve from the cwd is retried against
-    ``search_dirs`` (reference designs use paths relative to their repo
-    root).  A missing `.3` file yields zero excitation with a single
-    0-degree heading (the strip-theory excitation path still applies) —
-    the reference would raise instead.
+    A missing `.3` file yields zero excitation with a single 0-degree
+    heading (the strip-theory excitation path still applies) — the
+    reference would raise instead.
     """
     path = hydro_path
     if not os.path.isfile(path + ".1"):
-        for d in search_dirs:
-            cand = os.path.join(d, hydro_path.lstrip("./"))
-            if os.path.isfile(cand + ".1"):
-                path = cand
-                break
-        else:
-            raise FileNotFoundError(f"WAMIT file {hydro_path}.1 not found")
+        raise FileNotFoundError(f"WAMIT file {hydro_path}.1 not found")
 
     w_model = np.asarray(w_model, float)
     d1 = read_wamit1(path + ".1")
     A0 = d1["A0"] if d1["A0"] is not None else d1["A"][:, :, 0]
     A_BEM = rho * _interp_freq(w_model, d1["w"], d1["A"], A0)
+    # above the data range, use the file's infinite-frequency limit when
+    # provided (PER=0 rows) instead of flat-clamping the last sample
+    if d1["Ainf"] is not None:
+        above = w_model > d1["w"][-1]
+        if np.any(above):
+            A_BEM[:, :, above] = rho * d1["Ainf"][:, :, None]
     # pyhams' read_wamit1 returns damping already scaled by w; our reader
     # keeps the file's raw Bbar, so apply the WAMIT w*Bbar dimensionalization
     B_dim = d1["B"] * d1["w"][None, None, :]

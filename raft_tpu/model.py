@@ -40,6 +40,7 @@ from raft_tpu.models.fowt import (
     fowt_bem_excitation,
 )
 from raft_tpu.models.rotor import calc_aero
+from raft_tpu.models import qtf as qt
 from raft_tpu.ops.spectra import get_psd, get_rms
 from raft_tpu.ops.linalg import solve_complex
 from raft_tpu.ops.transforms import transform_force, translate_matrix_6to6
@@ -252,47 +253,129 @@ class Model:
         B_lin = B_turb + B_gyro[:, :, None] + B_BEM
         C_lin = (jnp.asarray(stat["C_struc"]) + jnp.asarray(state["C_moor"])
                  + jnp.asarray(stat["C_hydro"]))
-        F_lin = F_BEM[0] + exc["F_hydro_iner"][0]   # (6, nw)
 
         u0 = exc["u"][0]
 
-        def iteration(carry):
-            XiLast, Xi, Z, Bmat, ii, done = carry
-            B_drag, Bmat = fowt_hydro_linearization(fowt, pose_eq, XiLast, u0)
-            F_drag = fowt_drag_excitation(fowt, pose_eq, Bmat, u0)
-            B_tot = B_lin + B_drag[:, :, None]
-            Zn = (-w[None, None, :] ** 2 * M_lin
-                  + 1j * w[None, None, :] * B_tot
-                  + C_lin[:, :, None]).astype(complex)
-            # batched complex 6x6 solve over all frequencies at once
-            # (real block embedding keeps this TPU-compatible)
-            Xin = solve_complex(jnp.moveaxis(Zn, -1, 0),
-                                jnp.moveaxis(F_lin + F_drag, -1, 0))
-            Xin = jnp.moveaxis(Xin, 0, -1)   # (6, nw)
-            tolCheck = jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
-            conv = jnp.all(tolCheck < tol)
-            XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xin)
-            return (XiNext, Xin, Zn, Bmat, ii + 1, done | conv)
+        # ----- second-order forces (reference: raft_model.py:901-904) -----
+        Fhydro_2nd = np.zeros((nWaves, 6, nw))
+        Fhydro_2nd_mean = np.zeros((nWaves, 6))
+        if fowt.potSecOrder == 2:
+            qd = fowt.qtf_data
+            Fhydro_2nd_mean[0], f2 = (np.asarray(a) for a in qt.hydro_force_2nd(
+                qd.qtf, qd.heads_rad, qd.w, seastate["beta"][0],
+                seastate["S"][0], self.w))
+            Fhydro_2nd[0] = f2
 
-        def cond(carry):
-            _, _, _, _, ii, done = carry
-            return (ii < nIter) & (~done)
+        F_lin = F_BEM[0] + exc["F_hydro_iner"][0] + Fhydro_2nd[0]   # (6, nw)
 
-        Xi0c = jnp.zeros((6, nw), dtype=complex) + self.XiStart
-        Z0 = jnp.zeros((6, 6, nw), dtype=complex)
-        Bmat0 = jnp.zeros((fowt.nodes.n, 3, 3))
-        carry = jax.lax.while_loop(cond, iteration,
-                                   (Xi0c, Xi0c, Z0, Bmat0, 0, False))
+        def run_fixed_point(F_lin, Xi_init=None):
+            """Drag-linearization fixed point: lax.while_loop around one
+            batched complex solve over all frequencies.  ``Xi_init`` warm-
+            starts the iteration (used by the potSecOrder==1 re-solve,
+            matching the reference's counter-only reset at
+            raft_model.py:966-989)."""
+
+            def iteration(carry):
+                XiLast, Xi, Z, Bmat, ii, done = carry
+                B_drag, Bmat = fowt_hydro_linearization(fowt, pose_eq, XiLast, u0)
+                F_drag = fowt_drag_excitation(fowt, pose_eq, Bmat, u0)
+                B_tot = B_lin + B_drag[:, :, None]
+                Zn = (-w[None, None, :] ** 2 * M_lin
+                      + 1j * w[None, None, :] * B_tot
+                      + C_lin[:, :, None]).astype(complex)
+                # batched complex 6x6 solve over all frequencies at once
+                # (real block embedding keeps this TPU-compatible)
+                Xin = solve_complex(jnp.moveaxis(Zn, -1, 0),
+                                    jnp.moveaxis(F_lin + F_drag, -1, 0))
+                Xin = jnp.moveaxis(Xin, 0, -1)   # (6, nw)
+                tolCheck = jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
+                conv = jnp.all(tolCheck < tol)
+                XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xin)
+                return (XiNext, Xin, Zn, Bmat, ii + 1, done | conv)
+
+            def cond(carry):
+                _, _, _, _, ii, done = carry
+                return (ii < nIter) & (~done)
+
+            if Xi_init is None:
+                Xi0c = jnp.zeros((6, nw), dtype=complex) + self.XiStart
+            else:
+                Xi0c = jnp.asarray(Xi_init)
+            Z0 = jnp.zeros((6, 6, nw), dtype=complex)
+            Bmat0 = jnp.zeros((fowt.nodes.n, 3, 3))
+            return jax.lax.while_loop(cond, iteration,
+                                      (Xi0c, Xi0c, Z0, Bmat0, 0, False))
+
+        carry = run_fixed_point(jnp.asarray(F_lin))
+
+        if fowt.potSecOrder == 1:
+            # internal QTF from the drag-converged first-order RAOs, then
+            # re-converge with the 2nd-order forces included (reference:
+            # raft_model.py:966-989)
+            Xi1 = np.asarray(carry[1])
+            zeta0 = np.asarray(seastate["zeta"][0])
+            mask = np.abs(zeta0) > 1e-6
+            RAO = np.where(mask, Xi1 / np.where(mask, zeta0, 1.0), 0.0)
+            qtf_local = qt.calc_qtf_slender_body(
+                fowt, pose_eq, seastate["beta"][0], Xi0=RAO,
+                M_struc=stat["M_struc"])
+            qtf4 = np.asarray(qtf_local)[:, :, None, :]
+            heads = np.array([seastate["beta"][0]])
+            Fhydro_2nd_mean[0], f2 = (np.asarray(a) for a in qt.hydro_force_2nd(
+                qtf4, heads, fowt.w1_2nd, seastate["beta"][0],
+                seastate["S"][0], self.w))
+            Fhydro_2nd[0] = f2
+            F_lin = F_lin + Fhydro_2nd[0]
+            carry = run_fixed_point(jnp.asarray(F_lin), Xi_init=Xi1)
+            state["qtf"] = qtf4
+
         XiLast, Xi1, Z, Bmat, niter, converged = carry
+
+        # remaining headings' 2nd-order forces from the read QTF file
+        # (reference: raft_model.py:1058-1060)
+        if fowt.potSecOrder == 2:
+            qd = fowt.qtf_data
+            for ih in range(1, nWaves):
+                Fhydro_2nd_mean[ih], f2h = (np.asarray(a) for a in
+                    qt.hydro_force_2nd(qd.qtf, qd.heads_rad, qd.w,
+                                       seastate["beta"][ih], seastate["S"][ih],
+                                       self.w))
+                Fhydro_2nd[ih] = f2h
 
         # per-heading responses through the final impedance
         Zb = jnp.moveaxis(Z, -1, 0)   # (nw,6,6)
         Xi_all = np.zeros((nWaves + 1, 6, nw), dtype=complex)
         for ih in range(nWaves):
             F_drag_h = fowt_drag_excitation(fowt, pose_eq, Bmat, exc["u"][ih])
-            F_wave = F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag_h
+            F_wave_lin = F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag_h
+            F_wave = F_wave_lin + jnp.asarray(Fhydro_2nd[ih])
             Xi_h = solve_complex(Zb, jnp.moveaxis(F_wave, -1, 0))
             Xi_all[ih] = np.asarray(jnp.moveaxis(Xi_h, 0, -1))
+            if fowt.potSecOrder == 1 and ih > 0:
+                # secondary headings need their own QTF from that heading's
+                # first-order RAOs, then a re-solve with the 2nd-order
+                # forces included (reference: raft_model.py:1066-1083)
+                zeta_h = np.asarray(seastate["zeta"][ih])
+                mask = np.abs(zeta_h) > 1e-6
+                RAO_h = np.where(mask, Xi_all[ih] / np.where(mask, zeta_h, 1.0),
+                                 0.0)
+                qtf_h = np.asarray(qt.calc_qtf_slender_body(
+                    fowt, pose_eq, seastate["beta"][ih], Xi0=RAO_h,
+                    M_struc=stat["M_struc"]))[:, :, None, :]
+                Fhydro_2nd_mean[ih], f2h = (np.asarray(a) for a in
+                    qt.hydro_force_2nd(qtf_h, np.array([seastate["beta"][ih]]),
+                                       fowt.w1_2nd, seastate["beta"][ih],
+                                       seastate["S"][ih], self.w))
+                Fhydro_2nd[ih] = f2h
+                Xi_h = solve_complex(Zb, jnp.moveaxis(
+                    F_wave_lin + jnp.asarray(Fhydro_2nd[ih]), -1, 0))
+                Xi_all[ih] = np.asarray(jnp.moveaxis(Xi_h, 0, -1))
+
+        state["Fhydro_2nd"] = Fhydro_2nd
+        state["Fhydro_2nd_mean"] = Fhydro_2nd_mean
+        if fowt.potSecOrder > 0:
+            # mean drift feeds the statics re-solve (reference :548-554)
+            state["F_meandrift"] = Fhydro_2nd_mean.sum(axis=0)
 
         state["Xi"] = Xi_all
         state["Z"] = np.asarray(Z)
@@ -323,6 +406,14 @@ class Model:
             self.results["case_metrics"][iCase] = {}
             self.solveStatics(case, display=display)
             self.solveDynamics(case, display=display)
+            # re-solve the operating point with mean wave drift included,
+            # then clear it so it can't leak into the next case (reference:
+            # raft_model.py:296-303)
+            if any(f.potSecOrder > 0 for f in self.fowtList):
+                self.results["mean_offsets"].pop()   # superseded by re-solve
+                self.solveStatics(case, display=display)
+                for state in self._state:
+                    state.pop("F_meandrift", None)
             for i, fowt in enumerate(self.fowtList):
                 self.results["case_metrics"][iCase][i] = {}
                 self.saveTurbineOutputs(

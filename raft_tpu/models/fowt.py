@@ -26,6 +26,7 @@ positions/orientations; the `fowt_*` kernels mirror the reference methods:
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -109,6 +110,9 @@ class FOWTModel:
     potSecOrder: int = 0
     potFirstOrder: int = 0
     bem: Optional[object] = None   # io.wamit.BEMData when potential-flow files loaded
+    w1_2nd: Optional[np.ndarray] = None   # 2nd-order QTF frequency grid (potSecOrder==1)
+    k1_2nd: Optional[np.ndarray] = None
+    qtf_data: Optional[object] = None     # models.qtf.QTFData (potSecOrder==2)
 
     @property
     def potMod_any(self) -> bool:
@@ -212,6 +216,28 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
                              "'hydroPath' in the platform input")
         from raft_tpu.io.wamit import load_bem
         bem = load_bem(platform["hydroPath"], w, rho=rho_water, g=g)
+    # second-order hydro setup (reference: raft_fowt.py:231-252)
+    potSecOrder = int(get_from_dict(platform, "potSecOrder", dtype=int, default=0))
+    w1_2nd = k1_2nd = qtf_data = None
+    if potSecOrder == 1:
+        if "min_freq2nd" not in platform or "max_freq2nd" not in platform:
+            raise ValueError("potSecOrder==1 requires min_freq2nd and "
+                             "max_freq2nd in the platform input")
+        f_min2 = float(platform["min_freq2nd"])
+        f_max2 = float(platform["max_freq2nd"])
+        f_df2 = float(platform.get("df_freq2nd", f_min2))
+        w1_2nd = np.arange(f_min2, f_max2 + 0.5 * f_min2, f_df2) * 2 * np.pi
+        k1_2nd = np.asarray(wave_number(w1_2nd, depth))
+    elif potSecOrder == 2:
+        if "hydroPath" not in platform:
+            raise ValueError("potSecOrder==2 requires hydroPath in the "
+                             "platform input")
+        from raft_tpu.models.qtf import read_qtf_12d
+        qpath = platform["hydroPath"] + ".12d"
+        if not os.path.isfile(qpath):
+            raise FileNotFoundError(f"QTF file {qpath} not found")
+        qtf_data = read_qtf_12d(qpath, rho=rho_water, g=g)
+
     if bem is None and any(m.potMod for m in members):
         # potMod members get no strip-theory hydro; without BEM coefficients
         # they would silently have NO hydrodynamics at all.  The reference
@@ -232,9 +258,9 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
         x_ref=float(x_ref), y_ref=float(y_ref),
         heading_adjust=float(heading_adjust),
         nplatmems=nplatmems, ntowers=ntowers, potModMaster=potModMaster,
-        potSecOrder=int(get_from_dict(platform, "potSecOrder", dtype=int, default=0)),
+        potSecOrder=potSecOrder,
         potFirstOrder=potFirstOrder,
-        bem=bem,
+        bem=bem, w1_2nd=w1_2nd, k1_2nd=k1_2nd, qtf_data=qtf_data,
     )
 
 

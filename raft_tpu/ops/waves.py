@@ -162,21 +162,21 @@ def _grad_ratios_deg(k, z, h, denom_sinh=True):
     return jnp.where(use_deep, deep, shallow_xy), jnp.where(use_deep, deep, shallow_z)
 
 
-def wave_vel_gradient(w, k, beta_deg, h, r):
+def wave_vel_gradient(w, k, beta, h, r):
     """Spatial gradient matrix of first-order wave velocity, (...,3,3).
 
-    Reference: raft/helpers.py:157-195.  NOTE the reference uses
-    cos(deg2rad(beta)) for the directional factors but cos(beta) (radians)
-    inside the phase exponent; we reproduce that exactly for parity — in the
-    main QTF path headings are integer degrees so both agree only at beta=0.
+    Reference: raft/helpers.py:157-195, with ``beta`` in RADIANS used
+    consistently for both the directional factors and the phase.  (The
+    reference's QTF engine passes radians into a kernel that applies
+    deg2rad to them for the direction factors only — a mixed-units
+    inconsistency that vanishes at beta=0, the only heading its examples
+    exercise.  We use one convention throughout instead.)
     """
     r = jnp.asarray(r, dtype=float)
     x, y, z = r[..., 0], r[..., 1], r[..., 2]
-    b = jnp.deg2rad(beta_deg)
-    cosB, sinB = jnp.cos(b), jnp.sin(b)
-    cosb_r, sinb_r = jnp.cos(beta_deg), jnp.sin(beta_deg)  # reference phase uses radians-interp
+    cosB, sinB = jnp.cos(beta), jnp.sin(beta)
     khz_xy, khz_z = _grad_ratios_deg(k, z, h, denom_sinh=True)
-    phase = jnp.exp(-1j * (k * (cosb_r * x + sinb_r * y)))
+    phase = jnp.exp(-1j * (k * (cosB * x + sinB * y)))
     aux_x = w * cosB * phase
     aux_y = w * sinB * phase
     aux_z = 1j * w * phase
@@ -187,12 +187,15 @@ def wave_vel_gradient(w, k, beta_deg, h, r):
     g11 = -1j * aux_y * khz_xy * k * sinB
     g12 = aux_y * k * khz_z
     g22 = aux_z * k * khz_xy
+    # the velocity-gradient tensor of an irrotational field is symmetric:
+    # dw/dx = du/dz (g02) and dw/dy = dv/dz (g12).  (The reference instead
+    # fills grad[2][1] with du/dy — a copy-paste quirk, raft/helpers.py:192
+    # — which is zero at beta=0, the only heading its examples use.)
     grad = jnp.stack(
         [
             jnp.stack([g00, g01, g02], axis=-1),
             jnp.stack([g01, g11, g12], axis=-1),
-            # reference fills grad[2,0]=du/dz and grad[2,1]=du/dy (sic)
-            jnp.stack([g02, g01, g22], axis=-1),
+            jnp.stack([g02, g12, g22], axis=-1),
         ],
         axis=-2,
     )
@@ -200,20 +203,19 @@ def wave_vel_gradient(w, k, beta_deg, h, r):
     return jnp.where(active, grad, jnp.zeros_like(zero)[..., None, None])
 
 
-def wave_acc_gradient(w, k, beta_deg, h, r):
+def wave_acc_gradient(w, k, beta, h, r):
     """Gradient of first-order wave acceleration (reference:
-    raft/helpers.py:198-199)."""
-    return 1j * w * wave_vel_gradient(w, k, beta_deg, h, r)
+    raft/helpers.py:198-199).  ``beta`` in radians."""
+    return 1j * w * wave_vel_gradient(w, k, beta, h, r)
 
 
-def wave_pres1st_gradient(k, beta_deg, h, r, rho=1025.0, g=_G_DEFAULT):
+def wave_pres1st_gradient(k, beta, h, r, rho=1025.0, g=_G_DEFAULT):
     """Gradient of first-order dynamic pressure, (...,3) (reference:
-    raft/helpers.py:202-225).  Same mixed-units phase convention caveat as
-    wave_vel_gradient."""
+    raft/helpers.py:202-225).  ``beta`` in radians (see wave_vel_gradient
+    on the reference's mixed-units convention)."""
     r = jnp.asarray(r, dtype=float)
     x, y, z = r[..., 0], r[..., 1], r[..., 2]
-    b = jnp.deg2rad(beta_deg)
-    cosB, sinB = jnp.cos(b), jnp.sin(b)
+    cosB, sinB = jnp.cos(beta), jnp.sin(beta)
     khz_xy, khz_z = _grad_ratios_deg(k, z, h, denom_sinh=False)
     phase = jnp.exp(-1j * (k * (cosB * x + sinB * y)))
     gx = rho * g * khz_xy * phase * (-1j * k * cosB)
@@ -224,10 +226,11 @@ def wave_pres1st_gradient(k, beta_deg, h, r, rho=1025.0, g=_G_DEFAULT):
     return jnp.where(active, grad, 0.0)
 
 
-def wave_pot_2nd_order(w1, w2, k1, k2, beta1_deg, beta2_deg, h, r,
+def wave_pot_2nd_order(w1, w2, k1, k2, beta1, beta2, h, r,
                        g=_G_DEFAULT, rho=1025.0):
     """Acceleration and pressure from the difference-frequency second-order
     potential for a bichromatic pair (reference: raft/helpers.py:254-291).
+    ``beta1``/``beta2`` in radians.
 
     All of w1,w2,k1,k2 broadcast; r is (...,3).  Returns (acc (...,3), p).
     Zero when w1==w2 (no mean-drift contribution from the 2nd-order
@@ -235,10 +238,8 @@ def wave_pot_2nd_order(w1, w2, k1, k2, beta1_deg, beta2_deg, h, r,
     """
     r = jnp.asarray(r, dtype=float)
     z = r[..., 2]
-    b1 = jnp.deg2rad(beta1_deg)
-    b2 = jnp.deg2rad(beta2_deg)
-    dkx = k1 * jnp.cos(b1) - k2 * jnp.cos(b2)
-    dky = k1 * jnp.sin(b1) - k2 * jnp.sin(b2)
+    dkx = k1 * jnp.cos(beta1) - k2 * jnp.cos(beta2)
+    dky = k1 * jnp.sin(beta1) - k2 * jnp.sin(beta2)
     nk = jnp.sqrt(dkx * dkx + dky * dky)
     dw = w1 - w2
     # gamma factors; guard divisions (dead values masked at the end)

@@ -37,6 +37,12 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
     """Pure per-case response solver (no aero; wave loading) suitable for
     jit/vmap.  Returns fn(Hs, Tp, beta_rad) -> dict(Xi (6,nw) complex,
     std (6,))."""
+    if fowt.potSecOrder > 0:
+        import warnings
+        warnings.warn(
+            "sweep case solver does not include second-order (potSecOrder) "
+            "wave forces yet — sweep responses will exclude slow-drift "
+            "excitation that Model.solveDynamics includes", stacklevel=2)
     if r6 is None:
         r6 = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
     w = jnp.asarray(fowt.w)

@@ -52,6 +52,25 @@ def test_load_bem_dimensionalization():
     assert np.all(bem.X_BEM == 0)
 
 
+def test_load_bem_uses_Ainf_above_range(tmp_path):
+    """Frequencies above the .1 file's range take the infinite-frequency
+    added mass (PER=0 rows) rather than the last finite sample."""
+    p = tmp_path / "syn"
+    lines = []
+    # zero-frequency (PER<0) and infinite-frequency (PER=0) limits
+    lines.append("-1.0 1 1 5.0\n")
+    lines.append("0.0 1 1 2.0\n")
+    # two finite periods: w = 2pi/T
+    for T, a, b in ((10.0, 4.0, 0.1), (5.0, 3.0, 0.2)):
+        lines.append(f"{T} 1 1 {a} {b}\n")
+    (tmp_path / "syn.1").write_text("".join(lines))
+    w_model = np.array([0.2, 1.0, 5.0])   # below, inside, above range
+    bem = load_bem(str(p), w_model, rho=1.0, g=9.81)
+    assert_allclose(bem.A_BEM[0, 0, 0], 5.0 + (4.0 - 5.0) * (0.2 / (2 * np.pi / 10)),
+                    rtol=1e-12)   # interp between zero-freq pad and first sample
+    assert_allclose(bem.A_BEM[0, 0, 2], 2.0, rtol=1e-12)   # Ainf clamp
+
+
 def test_read_wamit3_synthetic(tmp_path):
     p = tmp_path / "syn.3"
     # two periods, two headings, mod/phase columns ignored by the reader

@@ -41,8 +41,8 @@ from raft_tpu.models.fowt import (
 )
 from raft_tpu.models.rotor import calc_aero
 from raft_tpu.models import qtf as qt
-from raft_tpu.ops.spectra import get_psd, get_rms
-from raft_tpu.ops.linalg import solve_complex
+from raft_tpu.ops.spectra import get_psd, get_rao, get_rms
+from raft_tpu.ops.linalg import inv_complex, solve_complex
 from raft_tpu.ops.transforms import transform_force, translate_matrix_6to6
 from raft_tpu.models.member import member_inertia
 from raft_tpu.utils.dicttools import get_from_dict
@@ -69,15 +69,64 @@ class Model:
         self.nw = len(self.w)
         self.depth = float(get_from_dict(design["site"], "water_depth", dtype=float))
 
+        self.arr_ms = None
+        self._arr_xf = None
+        self._K_array = None
         if "array" in design:
-            raise NotImplementedError("array mode lands with the farm milestone")
-        self.fowtList = [build_fowt(design, self.w, depth=self.depth)]
-        self.nFOWT = 1
-        self.nDOF = 6
+            # ----- array/farm mode (reference: raft_model.py:67-141) -----
+            if "turbine" in design and "turbines" not in design:
+                design["turbines"] = [design["turbine"]]
+            if "platform" in design and "platforms" not in design:
+                design["platforms"] = [design["platform"]]
+            if "mooring" in design and "moorings" not in design:
+                design["moorings"] = [design["mooring"]]
+            fowtInfo = [dict(zip(design["array"]["keys"], row))
+                        for row in design["array"]["data"]]
+            self.nFOWT = len(fowtInfo)
+            if "array_mooring" in design:
+                from raft_tpu.models import mooring_array as ma
+                if not design["array_mooring"].get("file"):
+                    raise ValueError(
+                        "'array_mooring' requires a MoorDyn-style input "
+                        "file as 'file'")
+                self.arr_ms = ma.parse_moordyn(
+                    design["array_mooring"]["file"], nbodies=self.nFOWT,
+                    depth=self.depth)
+            self.fowtList = []
+            for info in fowtInfo:
+                design_i = {"site": design["site"]}
+                if info["turbineID"] != 0:
+                    design_i["turbine"] = design["turbines"][info["turbineID"] - 1]
+                design_i["platform"] = design["platforms"][info["platformID"] - 1]
+                if info["mooringID"] != 0:
+                    design_i["mooring"] = design["moorings"][info["mooringID"] - 1]
+                self.fowtList.append(build_fowt(
+                    design_i, self.w, depth=self.depth,
+                    x_ref=float(info["x_location"]),
+                    y_ref=float(info["y_location"]),
+                    heading_adjust=float(info["heading_adjust"])))
+        else:
+            self.fowtList = [build_fowt(design, self.w, depth=self.depth)]
+            self.nFOWT = 1
+        self.nDOF = 6 * self.nFOWT
         self.design = design
         self.results = {}
         # per-fowt case state (filled by solveStatics/solveDynamics)
         self._state = [dict() for _ in self.fowtList]
+
+    @staticmethod
+    def _case_for_fowt(case, i):
+        """Per-FOWT view of a case row: farm cases may give per-turbine
+        lists for the wind parameters (reference: raft_model.py:515-519,
+        536-547)."""
+        if not case:
+            return case
+        case_i = dict(case)
+        for key in ("wind_speed", "wind_heading", "turbulence"):
+            v = case.get(key)
+            if isinstance(v, (list, tuple, np.ndarray)):
+                case_i[key] = v[i] if i < len(v) else v[-1]
+        return case_i
 
     # ------------------------------------------------------------------
     # statics
@@ -114,56 +163,78 @@ class Model:
         state["F_env_constant"] = F_env
 
     def solveStatics(self, case, display=0):
-        """Mean-offset equilibrium (reference: raft_model.py:479-849)."""
-        fowt = self.fowtList[0]
-        state = self._state[0]
-        self._case_constants(fowt, case, state)
+        """Mean-offset equilibrium over all 6N system DOFs (reference:
+        raft_model.py:479-849).  In array mode the shared mooring's free
+        points are re-equilibrated every Newton iteration and its coupled
+        stiffness couples the FOWT blocks."""
+        N = self.nFOWT
+        for i, fowt in enumerate(self.fowtList):
+            self._case_constants(fowt, self._case_for_fowt(case, i),
+                                 self._state[i])
 
-        K_hs = state["K_hydrostatic"]
-        F0 = state["F_undisplaced"] + state["F_env_constant"]
-        ref = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
-        moor = fowt.mooring
+        K_hs = [st["K_hydrostatic"] for st in self._state]
+        F0 = [st["F_undisplaced"] + st["F_env_constant"] for st in self._state]
+        refs = np.concatenate([
+            [f.x_ref, f.y_ref, 0, 0, 0, 0] for f in self.fowtList])
+        arr = self.arr_ms
+        if arr is not None:
+            from raft_tpu.models import mooring_array as ma
 
-        def net_force(X):
-            Xi0 = X - ref
-            F = jnp.asarray(F0) - jnp.asarray(K_hs) @ Xi0
-            if moor is not None:
-                F = F + mr.body_wrench(moor, X)
-            return F
-
-        net_force_j = jax.jit(net_force)
-
-        X = ref.copy()
-        db = np.array([30, 30, 5, 0.1, 0.1, 0.1])
+        X = refs.copy()
+        xf = self._arr_xf
+        db = np.tile(np.array([30, 30, 5, 0.1, 0.1, 0.1]), N)
+        tol = np.tile(np.array([0.05, 0.05, 0.05, 5e-3, 5e-3, 5e-3]) * 1e-3, N)
         for it in range(50):
-            F = np.asarray(net_force_j(X))
-            K = K_hs.copy()
-            if moor is not None:
-                K = K + np.asarray(mr.coupled_stiffness(moor, X))
+            F = np.zeros(6 * N)
+            K = np.zeros((6 * N, 6 * N))
+            for i, fowt in enumerate(self.fowtList):
+                s = slice(6 * i, 6 * i + 6)
+                Xi0 = X[s] - refs[s]
+                F[s] = F0[i] - K_hs[i] @ Xi0
+                K[s, s] = K_hs[i]
+                if fowt.mooring is not None:
+                    F[s] += np.asarray(mr.body_wrench(fowt.mooring, X[s]))
+                    K[s, s] += np.asarray(
+                        mr.coupled_stiffness(fowt.mooring, X[s]))
+            if arr is not None:
+                Xb = X.reshape(N, 6)
+                xf = ma.solve_free_points(arr, Xb, xf0=xf)
+                F += np.asarray(ma.body_wrenches(arr, Xb, xf)).reshape(-1)
+                K += np.asarray(ma.coupled_stiffness(arr, Xb, xf))
             # guard zero-stiffness diagonals like the reference (:713-715)
             kmean = np.mean(np.diag(K))
-            for i in range(6):
+            for i in range(6 * N):
                 if K[i, i] == 0:
                     K[i, i] = kmean
             dX = np.linalg.solve(K, F)
             dX = np.clip(dX, -db, db)
             X = X + dX
-            if np.all(np.abs(dX) < np.array([0.05, 0.05, 0.05, 5e-3, 5e-3, 5e-3]) * 1e-3):
+            if np.all(np.abs(dX) < tol):
                 break
 
-        state["r6"] = X
-        state["Xi0"] = X - ref
+        self._arr_xf = xf
         # mooring properties at equilibrium
-        if moor is not None:
-            state["C_moor"] = np.asarray(mr.coupled_stiffness(moor, X))
-            state["F_moor0"] = np.asarray(mr.body_wrench(moor, X))
+        if arr is not None:
+            Xb = X.reshape(N, 6)
+            self._K_array = np.asarray(ma.coupled_stiffness(arr, Xb, xf))
         else:
-            state["C_moor"] = np.zeros((6, 6))
-            state["F_moor0"] = np.zeros(6)
+            self._K_array = None
+        for i, fowt in enumerate(self.fowtList):
+            s = slice(6 * i, 6 * i + 6)
+            state = self._state[i]
+            state["r6"] = X[s]
+            state["Xi0"] = X[s] - refs[s]
+            if fowt.mooring is not None:
+                state["C_moor"] = np.asarray(
+                    mr.coupled_stiffness(fowt.mooring, X[s]))
+                state["F_moor0"] = np.asarray(mr.body_wrench(fowt.mooring, X[s]))
+            else:
+                state["C_moor"] = np.zeros((6, 6))
+                state["F_moor0"] = np.zeros(6)
         if case and "iCase" in case:
             self.results.setdefault("mean_offsets", []).append(X.copy())
         if display > 0:
-            print(f"Found mean offsets: {state['Xi0']}")
+            print(f"Found mean offsets: {X - refs}")
         return X
 
     # ------------------------------------------------------------------
@@ -171,16 +242,23 @@ class Model:
     # ------------------------------------------------------------------
 
     def solveEigen(self, display=0):
-        fowt = self.fowtList[0]
-        state = self._state[0]
-        stat = state["statics"]
-        hc = state.get("hydro0") or fowt_hydro_constants(fowt, state["pose0"])
-        M_tot = np.asarray(stat["M_struc"]) + np.asarray(hc["A_hydro_morison"])
-        C_tot = (np.asarray(stat["C_struc"]) + np.asarray(stat["C_hydro"])
-                 + state["C_moor"])
-        C_tot[5, 5] += fowt.yawstiff
+        nDOF = self.nDOF
+        M_tot = np.zeros((nDOF, nDOF))
+        C_tot = np.zeros((nDOF, nDOF))
+        for i, fowt in enumerate(self.fowtList):
+            s = slice(6 * i, 6 * i + 6)
+            state = self._state[i]
+            stat = state["statics"]
+            hc = state.get("hydro0") or fowt_hydro_constants(fowt, state["pose0"])
+            M_tot[s, s] = (np.asarray(stat["M_struc"])
+                           + np.asarray(hc["A_hydro_morison"]))
+            C_tot[s, s] = (np.asarray(stat["C_struc"])
+                           + np.asarray(stat["C_hydro"]) + state["C_moor"])
+            C_tot[6 * i + 5, 6 * i + 5] += fowt.yawstiff
+        if self._K_array is not None:
+            C_tot += self._K_array
 
-        for i in range(6):
+        for i in range(nDOF):
             if M_tot[i, i] < 1.0 or C_tot[i, i] < 1.0:
                 raise RuntimeError(
                     f"small/negative diagonal in system matrices at DOF {i}")
@@ -191,9 +269,9 @@ class Model:
 
         # DOF-claiming sort (reference: raft_model.py:441-456)
         ind_list = []
-        for i in range(5, -1, -1):
+        for i in range(nDOF - 1, -1, -1):
             vec = np.abs(eigenvectors[i, :]).copy()
-            for _ in range(6):
+            for _ in range(nDOF):
                 ind = int(np.argmax(vec))
                 if ind in ind_list:
                     vec[ind] = 0.0
@@ -211,10 +289,99 @@ class Model:
     # ------------------------------------------------------------------
 
     def solveDynamics(self, case, tol=0.01, display=0):
-        """Iterative drag linearization + batched RAO solve (reference:
-        raft_model.py:852-1146)."""
-        fowt = self.fowtList[0]
-        state = self._state[0]
+        """Iterative drag linearization per FOWT + block system RAO solve
+        (reference: raft_model.py:852-1146).  Each FOWT's drag fixed point
+        converges on its own 6x6 impedance (matching the reference, which
+        excludes the array-level mooring stiffness from the linearization
+        loop); the block-diagonal system impedance plus the shared-mooring
+        stiffness then yields the coupled response per heading."""
+        N = self.nFOWT
+        nw = self.nw
+        for i in range(N):
+            self._fowt_linearize(i, self._case_for_fowt(case, i), tol=tol,
+                                 display=display)
+
+        # ----- system assembly (reference: raft_model.py:1021-1031) -----
+        Z_sys = np.zeros((nw, 6 * N, 6 * N), dtype=complex)
+        for i in range(N):
+            s = slice(6 * i, 6 * i + 6)
+            Z_sys[:, s, s] = np.moveaxis(self._state[i]["Z"], -1, 0)
+        if self._K_array is not None:
+            Z_sys = Z_sys + self._K_array[None, :, :]
+        # factor once, reuse across headings and 2nd-order re-solves
+        # (the reference's Zinv, raft_model.py:1038-1040)
+        Zinv = jnp.asarray(inv_complex(jnp.asarray(Z_sys)))
+
+        nWaves = self._state[0]["seastate"]["nWaves"]
+        Xi_sys = np.zeros((nWaves + 1, 6 * N, nw), dtype=complex)
+
+        def system_solve(F_wave):
+            Xi_h = jnp.einsum("wij,wj->wi", Zinv,
+                              jnp.moveaxis(jnp.asarray(F_wave), -1, 0))
+            return np.asarray(jnp.moveaxis(Xi_h, 0, -1))
+
+        for ih in range(nWaves):
+            F_wave = np.zeros((6 * N, nw), dtype=complex)
+            for i, fowt in enumerate(self.fowtList):
+                s = slice(6 * i, 6 * i + 6)
+                st = self._state[i]
+                exc = st["excitation"]
+                F_drag_h = np.asarray(fowt_drag_excitation(
+                    fowt, st["pose_eq"], st["Bmat"], exc["u"][ih]))
+                st["F_drag"][ih] = F_drag_h
+                if fowt.potSecOrder == 2 and ih > 0:
+                    qd = fowt.qtf_data
+                    st["Fhydro_2nd_mean"][ih], f2h = (np.asarray(a) for a in
+                        qt.hydro_force_2nd(qd.qtf, qd.heads_rad, qd.w,
+                                           st["seastate"]["beta"][ih],
+                                           st["seastate"]["S"][ih], self.w))
+                    st["Fhydro_2nd"][ih] = f2h
+                F_wave[s] = (np.asarray(st["F_BEM"][ih])
+                             + np.asarray(exc["F_hydro_iner"][ih])
+                             + F_drag_h + st["Fhydro_2nd"][ih])
+            Xi_sys[ih] = system_solve(F_wave)
+
+            # internal-QTF secondary headings: QTF from that heading's
+            # first-order RAOs, then a system re-solve with the 2nd-order
+            # forces included (reference: raft_model.py:1066-1083)
+            if ih > 0 and any(f.potSecOrder == 1 for f in self.fowtList):
+                for i, fowt in enumerate(self.fowtList):
+                    if fowt.potSecOrder != 1:
+                        continue
+                    s = slice(6 * i, 6 * i + 6)
+                    st = self._state[i]
+                    RAO_h = np.asarray(get_rao(
+                        Xi_sys[ih, s, :], st["seastate"]["zeta"][ih]))
+                    qtf_h = np.asarray(qt.calc_qtf_slender_body(
+                        fowt, st["pose_eq"], st["seastate"]["beta"][ih],
+                        Xi0=RAO_h, M_struc=st["statics"]["M_struc"]))[:, :, None, :]
+                    st["Fhydro_2nd_mean"][ih], f2h = (np.asarray(a) for a in
+                        qt.hydro_force_2nd(qtf_h,
+                                           np.array([st["seastate"]["beta"][ih]]),
+                                           fowt.w1_2nd, st["seastate"]["beta"][ih],
+                                           st["seastate"]["S"][ih], self.w))
+                    st["Fhydro_2nd"][ih] = f2h
+                    F_wave[s] = (np.asarray(st["F_BEM"][ih])
+                                 + np.asarray(st["excitation"]["F_hydro_iner"][ih])
+                                 + st["F_drag"][ih] + st["Fhydro_2nd"][ih])
+                Xi_sys[ih] = system_solve(F_wave)
+
+        for i, fowt in enumerate(self.fowtList):
+            s = slice(6 * i, 6 * i + 6)
+            st = self._state[i]
+            st["Xi"] = Xi_sys[:, s, :]
+            if fowt.potSecOrder > 0:
+                # mean drift feeds the statics re-solve (reference :548-554)
+                st["F_meandrift"] = st["Fhydro_2nd_mean"].sum(axis=0)
+        self.Xi = Xi_sys
+        self.results["response"] = {}
+        return Xi_sys
+
+    def _fowt_linearize(self, ifowt, case, tol=0.01, display=0):
+        """Per-FOWT drag-linearization fixed point producing the converged
+        6x6 impedance (reference: raft_model.py:877-1013)."""
+        fowt = self.fowtList[ifowt]
+        state = self._state[ifowt]
         nIter = self.nIter + 1
         w = jnp.asarray(self.w)
         nw = self.nw
@@ -313,9 +480,7 @@ class Model:
             # re-converge with the 2nd-order forces included (reference:
             # raft_model.py:966-989)
             Xi1 = np.asarray(carry[1])
-            zeta0 = np.asarray(seastate["zeta"][0])
-            mask = np.abs(zeta0) > 1e-6
-            RAO = np.where(mask, Xi1 / np.where(mask, zeta0, 1.0), 0.0)
+            RAO = np.asarray(get_rao(Xi1, seastate["zeta"][0]))
             qtf_local = qt.calc_qtf_slender_body(
                 fowt, pose_eq, seastate["beta"][0], Xi0=RAO,
                 M_struc=stat["M_struc"])
@@ -331,67 +496,160 @@ class Model:
 
         XiLast, Xi1, Z, Bmat, niter, converged = carry
 
-        # remaining headings' 2nd-order forces from the read QTF file
-        # (reference: raft_model.py:1058-1060)
-        if fowt.potSecOrder == 2:
-            qd = fowt.qtf_data
-            for ih in range(1, nWaves):
-                Fhydro_2nd_mean[ih], f2h = (np.asarray(a) for a in
-                    qt.hydro_force_2nd(qd.qtf, qd.heads_rad, qd.w,
-                                       seastate["beta"][ih], seastate["S"][ih],
-                                       self.w))
-                Fhydro_2nd[ih] = f2h
-
-        # per-heading responses through the final impedance
-        Zb = jnp.moveaxis(Z, -1, 0)   # (nw,6,6)
-        Xi_all = np.zeros((nWaves + 1, 6, nw), dtype=complex)
-        for ih in range(nWaves):
-            F_drag_h = fowt_drag_excitation(fowt, pose_eq, Bmat, exc["u"][ih])
-            F_wave_lin = F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag_h
-            F_wave = F_wave_lin + jnp.asarray(Fhydro_2nd[ih])
-            Xi_h = solve_complex(Zb, jnp.moveaxis(F_wave, -1, 0))
-            Xi_all[ih] = np.asarray(jnp.moveaxis(Xi_h, 0, -1))
-            if fowt.potSecOrder == 1 and ih > 0:
-                # secondary headings need their own QTF from that heading's
-                # first-order RAOs, then a re-solve with the 2nd-order
-                # forces included (reference: raft_model.py:1066-1083)
-                zeta_h = np.asarray(seastate["zeta"][ih])
-                mask = np.abs(zeta_h) > 1e-6
-                RAO_h = np.where(mask, Xi_all[ih] / np.where(mask, zeta_h, 1.0),
-                                 0.0)
-                qtf_h = np.asarray(qt.calc_qtf_slender_body(
-                    fowt, pose_eq, seastate["beta"][ih], Xi0=RAO_h,
-                    M_struc=stat["M_struc"]))[:, :, None, :]
-                Fhydro_2nd_mean[ih], f2h = (np.asarray(a) for a in
-                    qt.hydro_force_2nd(qtf_h, np.array([seastate["beta"][ih]]),
-                                       fowt.w1_2nd, seastate["beta"][ih],
-                                       seastate["S"][ih], self.w))
-                Fhydro_2nd[ih] = f2h
-                Xi_h = solve_complex(Zb, jnp.moveaxis(
-                    F_wave_lin + jnp.asarray(Fhydro_2nd[ih]), -1, 0))
-                Xi_all[ih] = np.asarray(jnp.moveaxis(Xi_h, 0, -1))
-
         state["Fhydro_2nd"] = Fhydro_2nd
         state["Fhydro_2nd_mean"] = Fhydro_2nd_mean
-        if fowt.potSecOrder > 0:
-            # mean drift feeds the statics re-solve (reference :548-554)
-            state["F_meandrift"] = Fhydro_2nd_mean.sum(axis=0)
-
-        state["Xi"] = Xi_all
+        state["F_drag"] = np.zeros((nWaves, 6, nw), dtype=complex)
         state["Z"] = np.asarray(Z)
         state["Bmat"] = Bmat
-        self.Xi = Xi_all
-        self.results["response"] = {}
-        return Xi_all
 
     # ------------------------------------------------------------------
     # case loop
     # ------------------------------------------------------------------
 
     def analyzeUnloaded(self, ballast=0, heave_tol=1.0):
+        """Unloaded equilibrium, optionally preceded by ballast trim
+        (reference: raft_model.py:184-241; ballast==1 walks fill levels,
+        ballast==2 shifts fill densities uniformly)."""
+        if self.nFOWT > 1:
+            raise Exception(
+                "analyzeUnloaded only works for a single FOWT (reference: "
+                "raft_model.py:191-192)")
+        fowt = self.fowtList[0]
+        if ballast == 1:
+            self.adjustBallast(fowt, heave_tol=heave_tol)
+        elif ballast == 2:
+            self.adjustBallastDensity(fowt)
         self.results.setdefault("properties", {})
         self.solveStatics(None)
         self.results["properties"]["offset_unloaded"] = self._state[0]["Xi0"]
+
+    # ------------------------------------------------------------------
+    # ballast trim
+    # ------------------------------------------------------------------
+
+    def _heave_imbalance(self, fowt):
+        """(sumFz, heave, stat): net vertical force at the undisplaced pose
+        and the linearized heave offset (reference: raft_model.py:1448-1453)."""
+        ref = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+        pose0 = fowt_pose(fowt, ref)
+        stat = fowt_statics(fowt, pose0)
+        Fz_moor = 0.0
+        if fowt.mooring is not None:
+            Fz_moor = float(np.asarray(mr.body_wrench(fowt.mooring, ref))[2])
+        m = float(np.asarray(stat["M_struc"])[0, 0])
+        V = float(np.asarray(stat["V"]))
+        AWP = float(np.asarray(stat["AWP"]))
+        sumFz = -m * fowt.g + V * fowt.rho_water * fowt.g + Fz_moor
+        heave = sumFz / (fowt.rho_water * fowt.g * AWP)
+        return sumFz, heave, stat
+
+    @staticmethod
+    def _section_fill_volume(geom, j, l_fill):
+        """Ballast volume of member section j filled to ``l_fill``, using
+        the reference's convention of interpolating the inner frustum over
+        the FULL member length (raft_model.py:1484-1492)."""
+        l = geom.l
+        if geom.circular:
+            dAi = float(geom.d[j] - 2 * geom.t[j])
+            dBi = float(geom.d[j + 1] - 2 * geom.t[j + 1])
+            dBf = (dBi - dAi) * (l_fill / l) + dAi
+            return np.pi / 12.0 * l_fill * (dAi**2 + dAi * dBf + dBf**2)
+        slAi = np.asarray(geom.d[j]) - 2 * geom.t[j]
+        slBi = np.asarray(geom.d[j + 1]) - 2 * geom.t[j + 1]
+        slBf = (slBi - slAi) * (l_fill / l) + slAi
+        A1 = slAi[0] * slAi[1]
+        A2 = slBf[0] * slBf[1]
+        return l_fill / 3.0 * (A1 + A2 + np.sqrt(max(A1 * A2, 0.0)))
+
+    def _member_groups(self, fowt):
+        """Platform members grouped by repeated-heading pattern (one yaml
+        member entry per group, recorded at build time), mirroring the
+        reference's one-member-per-heading-group adjustment
+        (raft_model.py:1464-1467 keyed off member.headings)."""
+        if fowt.platmem_groups is not None:
+            return fowt.platmem_groups
+        return [[i] for i in range(fowt.nplatmems)]
+
+    def adjustBallast(self, fowt, heave_tol=1.0, display=0):
+        """Walk ballast fill levels member-by-member until the linearized
+        unloaded heave is within ``heave_tol`` (reference:
+        raft_model.py:1434-1566).  The reference's 1 cm stepping loop is
+        replaced by an exact bisection to the same rounded (2-decimal)
+        fill level."""
+        sumFz, heave, _ = self._heave_imbalance(fowt)
+        dmass = sumFz / fowt.g
+        if display:
+            print(f" initial heave imbalance {heave:.3f} m")
+        for group in self._member_groups(fowt):
+            geom0 = fowt.members[group[0]]
+            rho_fills = np.atleast_1d(np.asarray(geom0.rho_fill, float))
+            for j, rho_b in enumerate(rho_fills):
+                if rho_b <= 0:
+                    continue
+                dvol = dmass / rho_b
+                mdvol = dvol / len(group)
+                l = geom0.l
+                l_fill0 = float(np.atleast_1d(geom0.l_fill)[j])
+                V0 = self._section_fill_volume(geom0, j, l_fill0)
+                Vtarget = V0 + mdvol
+                Vmax = self._section_fill_volume(geom0, j, l)
+                if Vtarget >= Vmax:
+                    l_new = l
+                elif Vtarget <= 0.0:
+                    l_new = 0.0
+                else:
+                    lo, hi = 0.0, l
+                    for _ in range(60):
+                        mid = 0.5 * (lo + hi)
+                        if self._section_fill_volume(geom0, j, mid) < Vtarget:
+                            lo = mid
+                        else:
+                            hi = mid
+                    l_new = 0.5 * (lo + hi)
+                l_new = round(l_new, 2)
+                for imem in group:
+                    fowt.members[imem].l_fill = np.asarray(
+                        np.atleast_1d(fowt.members[imem].l_fill), float)
+                    fowt.members[imem].l_fill[j] = l_new
+                sumFz, heave, _ = self._heave_imbalance(fowt)
+                if display:
+                    print(f" member {geom0.name} section {j}: l_fill -> "
+                          f"{l_new:.2f} m, heave {heave:.3f} m")
+                if abs(heave) < heave_tol:
+                    return heave
+                dmass = sumFz / fowt.g
+        return heave
+
+    def adjustBallastDensity(self, fowt, display=0):
+        """Uniform ballast-density shift to zero the unloaded heave —
+        closed form (reference: raft_model.py:1569-1624)."""
+        from raft_tpu.models.member import member_inertia
+        ref = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+        pose0 = fowt_pose(fowt, ref)
+        # zero fill levels wherever the fill density is zero (:1576-1583)
+        for geom in fowt.members:
+            lf = np.asarray(np.atleast_1d(geom.l_fill), float)
+            rf = np.atleast_1d(np.asarray(geom.rho_fill, float))
+            geom.l_fill = np.where(rf == 0.0, 0.0, lf)
+        sumFz, heave, _ = self._heave_imbalance(fowt)
+        ballast_volume = 0.0
+        for imem, geom in enumerate(fowt.members):
+            mi = member_inertia(geom, pose0["members"][imem],
+                               rPRP=ref[:3])
+            ballast_volume += float(np.sum(np.asarray(mi["vfill"])))
+        if ballast_volume <= 0:
+            raise Exception(
+                "adjustBallastDensity needs a platform with ballast volume")
+        delta_rho_fill = sumFz / fowt.g / ballast_volume
+        for geom in fowt.members:
+            lf = np.atleast_1d(np.asarray(geom.l_fill, float))
+            rf = np.asarray(np.atleast_1d(np.asarray(geom.rho_fill, float)))
+            geom.rho_fill = np.where(lf > 0.0, rf + delta_rho_fill, rf)
+        if display:
+            _, heave_new, _ = self._heave_imbalance(fowt)
+            print(f" ballast density shifted {delta_rho_fill:+.3f} kg/m3; "
+                  f"heave {heave:.3f} -> {heave_new:.3f} m")
+        return delta_rho_fill
 
     def analyzeCases(self, display=0, RAO_plot=False):
         nCases = len(self.design["cases"]["data"])
@@ -418,6 +676,32 @@ class Model:
                 self.results["case_metrics"][iCase][i] = {}
                 self.saveTurbineOutputs(
                     self.results["case_metrics"][iCase][i], i, case)
+
+            # array-level mooring tension statistics through the coupled
+            # tension Jacobian (reference: raft_model.py:345-388)
+            if self.arr_ms is not None:
+                from raft_tpu.models import mooring_array as ma
+                Xb = np.stack([self._state[i]["r6"]
+                               for i in range(self.nFOWT)])
+                xf = self._arr_xf
+                J = np.asarray(ma.tension_jacobian(self.arr_ms, Xb, xf))
+                T0 = np.asarray(ma.tensions(self.arr_ms, Xb, xf))
+                T_amps = np.einsum("tj,hjw->htw", J, self.Xi)
+                dw = self.w[1] - self.w[0]
+                nT = len(T0)
+                TRMS = np.array([float(get_rms(T_amps[:, iT, :]))
+                                 for iT in range(nT)])
+                am = {
+                    "Tmoor_avg": T0,
+                    "Tmoor_std": TRMS,
+                    "Tmoor_max": T0 + 3 * TRMS,
+                    "Tmoor_min": T0 - 3 * TRMS,
+                    "Tmoor_PSD": np.stack(
+                        [np.asarray(get_psd(T_amps[:, iT, :], dw,
+                                            source_axis=0))
+                         for iT in range(nT)]),
+                }
+                self.results["case_metrics"][iCase]["array_mooring"] = am
         return self.results
 
     # ------------------------------------------------------------------
@@ -522,7 +806,8 @@ class Model:
                 results["Mbase_max"][ir] = results["Mbase_avg"][ir] + 3 * results["Mbase_std"][ir]
                 results["Mbase_min"][ir] = results["Mbase_avg"][ir] - 3 * results["Mbase_std"][ir]
 
-        results["wave_PSD"] = np.asarray(get_psd(state["seastate"]["zeta"], dw))
+        results["wave_PSD"] = np.asarray(
+            get_psd(state["seastate"]["zeta"], dw, source_axis=0))
 
         # rotor control channels (reference :1976-2045)
         for key in ("omega", "torque", "power", "bPitch"):
@@ -572,6 +857,10 @@ class Model:
 
     def calcOutputs(self):
         """Fill results['properties'] (reference: raft_model.py:1150-1189)."""
+        if self.nFOWT > 1:
+            # the reference only fills properties for single-FOWT models
+            # (raft_model.py:1153)
+            return self.results
         fowt = self.fowtList[0]
         state = self._state[0]
         stat = state["statics"]

@@ -107,6 +107,10 @@ class FOWTModel:
     nplatmems: int
     ntowers: int
     potModMaster: int
+    #: platform members grouped by repeated-heading pattern (one yaml
+    #: member entry -> one group), for ballast trim (reference keys the
+    #: adjustment off member.headings, raft_model.py:1464-1467)
+    platmem_groups: Optional[List[List[int]]] = None
     potSecOrder: int = 0
     potFirstOrder: int = 0
     bem: Optional[object] = None   # io.wamit.BEMData when potential-flow files loaded
@@ -146,6 +150,7 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
     member_types: List[int] = []
     member_names: List[str] = []
     nplatmems = 0
+    platmem_groups: List[List[int]] = []
     for mi in platform["members"]:
         mi = dict(mi)
         if potModMaster in (1,):
@@ -154,6 +159,8 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
             mi["potMod"] = True
         mi.setdefault("dlsMax", dlsMax)
         headings = get_from_dict(mi, "heading", shape=-1, default=0.0)
+        platmem_groups.append(list(range(
+            nplatmems, nplatmems + len(np.atleast_1d(headings)))))
         for h in (np.atleast_1d(headings)):
             members.append(build_member_geometry(mi, heading=float(h) + heading_adjust))
             member_types.append(int(mi.get("type", 2)))
@@ -257,7 +264,8 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
         shearExp_water=shearExp_water, yawstiff=yawstiff,
         x_ref=float(x_ref), y_ref=float(y_ref),
         heading_adjust=float(heading_adjust),
-        nplatmems=nplatmems, ntowers=ntowers, potModMaster=potModMaster,
+        nplatmems=nplatmems, ntowers=ntowers,
+        platmem_groups=platmem_groups, potModMaster=potModMaster,
         potSecOrder=potSecOrder,
         potFirstOrder=potFirstOrder,
         bem=bem, w1_2nd=w1_2nd, k1_2nd=k1_2nd, qtf_data=qtf_data,

@@ -122,9 +122,16 @@ def parse_mooring(moor: dict, rho: float = _RHO, g: float = _G,
 # catenary kernel
 # --------------------------------------------------------------------------
 
-def _profile_spans(H, V, L, EA, w):
+def _profile_spans(H, V, L, EA, w, contact_allowed=True):
     """(XF, ZF) reached by a line with fairlead force (H, V); both seabed
-    branches evaluated and selected by mask (elementwise)."""
+    branches evaluated and selected by mask (elementwise).
+
+    ``contact_allowed`` gates the seabed-contact branch: it is only valid
+    when the lower (anchor) end actually rests on the seabed.  For lines
+    suspended between elevated points (shared farm lines, line segments
+    between free junction points) pass False — the suspended-catenary
+    formulas remain valid for a negative anchor-end vertical force
+    (line sagging below the lower attachment)."""
     H = jnp.maximum(H, 1e-8)
     Va = V - w * L  # vertical force at anchor end (suspended case)
     s1 = jnp.sqrt(1.0 + (V / H) ** 2)
@@ -136,11 +143,11 @@ def _profile_spans(H, V, L, EA, w):
     LB = L - V / w
     XF_c = LB + (H / w) * jnp.arcsinh(V / H) + H * L / EA
     ZF_c = (H / w) * (s1 - 1.0) + V**2 / (2.0 * EA * w)
-    contact = V < w * L
+    contact = (V < w * L) & contact_allowed
     return jnp.where(contact, XF_c, XF_s), jnp.where(contact, ZF_c, ZF_s)
 
 
-def catenary_solve(XF, ZF, L, EA, w):
+def catenary_solve(XF, ZF, L, EA, w, contact_allowed=True):
     """Solve one line's fairlead force (H, V) from its spans.  Elementwise
     over any batch shape; fixed ``_NEWTON_ITERS`` damped-Newton iterations
     (shape-stable under jit/vmap, differentiable by unrolled iteration —
@@ -163,8 +170,11 @@ def catenary_solve(XF, ZF, L, EA, w):
     H0 = jnp.maximum(jnp.abs(0.5 * w * XF / lam), 1e3)
     V0 = 0.5 * w * (ZF / jnp.tanh(lam) + L)
 
+    contact_allowed = jnp.asarray(contact_allowed)
+
     def resid(x):
-        Xc, Zc = _profile_spans(x[..., 0], x[..., 1], L, EA, w)
+        Xc, Zc = _profile_spans(x[..., 0], x[..., 1], L, EA, w,
+                                contact_allowed)
         return jnp.stack([Xc - XF, Zc - ZF], axis=-1)
 
     def newton_step(x, _):
@@ -189,7 +199,7 @@ def catenary_solve(XF, ZF, L, EA, w):
     x, _ = jax.lax.scan(newton_step, x0, None, length=_NEWTON_ITERS)
     H, V = jnp.maximum(x[..., 0], 1e-8), x[..., 1]
 
-    contact = V < w * L
+    contact = (V < w * L) & contact_allowed
     Va = jnp.where(contact, 0.0, V - w * L)
     Ha = jnp.where(contact, H, H)  # frictionless seabed: H unchanged
     TB = jnp.sqrt(H**2 + V**2)

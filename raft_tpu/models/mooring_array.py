@@ -1,0 +1,372 @@
+"""Array-level (multi-body) quasi-static mooring with shared lines.
+
+TPU-first replacement for the array-level MoorPy ``System`` the reference
+builds for farms (reference: raft/raft_model.py:83-100 — ``mp.System`` +
+``addBody`` per FOWT + ``load(MoorDyn file)``; used at raft_model.py:
+600-606 for equilibrium forces, :1029-1031 for the coupled stiffness added
+to the block impedance, and :345-388 for tension statistics).
+
+Capability set (the subset the reference exercises):
+
+- points: FIXED anchors (global coords), FREE junction points (clump
+  weights / multi-segment line junctions, positions solved to static
+  equilibrium), and BODY-attached fairleads on any number of bodies
+  (body-frame coords).
+- lines: the same differentiable elastic catenary as ``models.mooring``,
+  generalized to arbitrary end elevations.  The seabed-contact branch is
+  only enabled for lines whose lower end is a fixed anchor on the seabed
+  (static per-line mask) — suspended shared lines between elevated points
+  use the pure-catenary branch, which is valid for a negative lower-end
+  vertical force (line sagging below the attachment).
+
+Everything is jnp and differentiable end-to-end:
+
+- free-point equilibrium is a fixed-iteration damped Newton (jacfwd
+  Jacobian) — shape-stable under jit;
+- the coupled body stiffness eliminates the free-point DOFs by the
+  implicit-function theorem (Schur complement), i.e. the exact equivalent
+  of MoorPy's ``getCoupledStiffnessA`` finite differencing:
+      K = -( dFb/dXb - dFb/dxf (dg/dxf)^-1 dg/dXb )     with g(xf; Xb)=0
+- tension Jacobians for the farm tension statistics get the same implicit
+  correction (equivalent of ``getCoupledStiffness(..., tensions=True)``).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.models.mooring import catenary_solve
+from raft_tpu.ops.transforms import rotation_matrix, translate_force_3to6
+
+_G = 9.81
+_RHO = 1025.0
+
+ATTACH_FIXED = -1
+ATTACH_FREE = -2
+
+
+@dataclass
+class ArrayMooring:
+    """Static description of a multi-body mooring system (numpy)."""
+
+    depth: float
+    nbodies: int
+    # points
+    attach: np.ndarray      # (npt,) ATTACH_FIXED | ATTACH_FREE | body index
+    r0: np.ndarray          # (npt,3) body-frame (body pts) or global coords
+    pmass: np.ndarray       # (npt,) point mass [kg]
+    pvol: np.ndarray        # (npt,) point displaced volume [m^3]
+    free_idx: np.ndarray    # (npt,) row into the free-point vector, -1 else
+    # lines
+    iA: np.ndarray          # (nl,) endpoint A point index
+    iB: np.ndarray          # (nl,) endpoint B point index
+    L: np.ndarray           # (nl,) unstretched length
+    EA: np.ndarray          # (nl,) axial stiffness
+    w: np.ndarray           # (nl,) submerged weight per length [N/m]
+    contact_ok: np.ndarray  # (nl,) bool: lower end is a seabed anchor
+    g: float = _G
+    rho: float = _RHO
+
+    @property
+    def n_free(self) -> int:
+        return int((self.attach == ATTACH_FREE).sum())
+
+    @property
+    def n_lines(self) -> int:
+        return len(self.L)
+
+
+# --------------------------------------------------------------------------
+# MoorDyn-format parsing (reference loads the same file through MoorPy's
+# System.load; schema per tests/test_data/shared_mooring_volturnus.dat)
+# --------------------------------------------------------------------------
+
+_BODY_RE = re.compile(r"^(?:turbine|body|vessel|coupled)(\d*)$", re.I)
+
+
+def parse_moordyn(path: str, nbodies: int, depth: float | None = None,
+                  rho: float = _RHO, g: float = _G) -> ArrayMooring:
+    """Parse the sections of a MoorDyn v2 input file that define a
+    quasi-static system: LINE TYPES, POINTS, LINES, and the WtrDpth option.
+
+    Body attachments named ``Turbine<i>``/``Body<i>`` map to body ``i-1``;
+    their coordinates are body-frame (MoorPy attaches them as relative
+    coordinates to the pre-created FOWT bodies, reference
+    raft_model.py:93-97)."""
+    sections: dict[str, list[str]] = {}
+    current = None
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line:
+                continue
+            if line.startswith("---"):
+                name = line.strip("- ").upper()
+                current = name
+                sections[current] = []
+            elif current is not None:
+                sections[current].append(line)
+
+    def section(key, n_header=2):
+        for name, rows in sections.items():
+            if key in name:
+                return rows[n_header:]  # drop column-name/units header rows
+        return []
+
+    # line types
+    types = {}
+    for row in section("LINE TYPES"):
+        c = row.split()
+        d, m, EA = float(c[1]), float(c[2]), float(c[3])
+        w_wet = (m - rho * np.pi / 4.0 * d**2) * g
+        types[c[0]] = dict(d=d, m=m, EA=EA, w=w_wet)
+
+    # options (water depth)
+    for row in section("OPTIONS", n_header=0):
+        c = row.split()
+        if len(c) >= 2 and c[1].lower() in ("wtrdpth", "depth", "wtrdepth"):
+            depth = float(c[0])
+    if depth is None:
+        raise ValueError("water depth not found in MoorDyn file or args")
+
+    # points
+    ids, attach, r0, pmass, pvol = [], [], [], [], []
+    for row in section("POINTS"):
+        c = row.split()
+        ids.append(int(c[0]))
+        a = c[1].lower()
+        if a in ("fixed", "fix", "anchor"):
+            attach.append(ATTACH_FIXED)
+        elif a in ("free", "connect"):
+            attach.append(ATTACH_FREE)
+        else:
+            mm = _BODY_RE.match(a)
+            if not mm:
+                raise ValueError(f"unknown point attachment {c[1]!r}")
+            attach.append(int(mm.group(1) or 1) - 1)
+        r0.append([float(c[2]), float(c[3]), float(c[4])])
+        pmass.append(float(c[5]))
+        pvol.append(float(c[6]))
+    ids = np.array(ids)
+    attach = np.array(attach)
+    r0 = np.array(r0)
+    if attach.size and attach.max() >= nbodies:
+        raise ValueError(
+            f"MoorDyn file references body {attach.max()+1} but the array "
+            f"has only {nbodies} FOWTs")
+
+    id2row = {pid: i for i, pid in enumerate(ids)}
+    free_idx = np.full(len(ids), -1)
+    free_idx[attach == ATTACH_FREE] = np.arange((attach == ATTACH_FREE).sum())
+
+    # lines
+    iA, iB, L, EA, w = [], [], [], [], []
+    for row in section("LINES"):
+        c = row.split()
+        lt = types[c[1]]
+        iA.append(id2row[int(c[2])])
+        iB.append(id2row[int(c[3])])
+        L.append(float(c[4]))
+        EA.append(lt["EA"])
+        w.append(lt["w"])
+    iA, iB = np.array(iA), np.array(iB)
+
+    # seabed contact only for lines whose lower end is a fixed anchor on
+    # the seabed (static: anchors don't move, other points sit well above)
+    def on_seabed(ipt):
+        return (attach[ipt] == ATTACH_FIXED) & (r0[ipt, 2] <= -depth + 1.0)
+
+    contact_ok = on_seabed(iA) | on_seabed(iB)
+
+    return ArrayMooring(
+        depth=float(depth), nbodies=nbodies,
+        attach=attach, r0=r0, pmass=np.array(pmass), pvol=np.array(pvol),
+        free_idx=free_idx,
+        iA=iA, iB=iB, L=np.array(L), EA=np.array(EA), w=np.array(w),
+        contact_ok=contact_ok, g=g, rho=rho,
+    )
+
+
+# --------------------------------------------------------------------------
+# kinematics & forces
+# --------------------------------------------------------------------------
+
+def point_positions(ms: ArrayMooring, Xb, xf):
+    """Global point positions. Xb: (nb,6) body poses; xf: (nf,3) free
+    point positions."""
+    Xb = jnp.asarray(Xb, float)
+    xf = jnp.asarray(xf, float)
+    r0 = jnp.asarray(ms.r0)
+
+    R = jax.vmap(lambda x: rotation_matrix(x[3], x[4], x[5]))(Xb)  # (nb,3,3)
+    bidx = jnp.clip(jnp.asarray(ms.attach), 0, ms.nbodies - 1)
+    body_pos = Xb[bidx, :3] + jnp.einsum("pij,pj->pi", R[bidx], r0)
+    fidx = jnp.clip(jnp.asarray(ms.free_idx), 0, max(ms.n_free - 1, 0))
+    free_pos = xf[fidx] if ms.n_free else jnp.zeros_like(r0)
+
+    attach = jnp.asarray(ms.attach)
+    pts = jnp.where((attach >= 0)[:, None], body_pos,
+                    jnp.where((attach == ATTACH_FREE)[:, None], free_pos, r0))
+    return pts
+
+
+def line_end_forces(ms: ArrayMooring, pts):
+    """Per-line forces exerted BY the line ON its two endpoints, plus end
+    tensions.  Returns (FA, FB, TA, TB) with F* (nl,3) and T* oriented so
+    TA belongs to endpoint A of the file's line definition (matching
+    MoorPy's per-line TA/TB)."""
+    rA = pts[jnp.asarray(ms.iA)]
+    rB = pts[jnp.asarray(ms.iB)]
+    flip = rA[:, 2] > rB[:, 2]          # A above B -> A is the upper end
+    rLow = jnp.where(flip[:, None], rB, rA)
+    rUp = jnp.where(flip[:, None], rA, rB)
+
+    dxy = rUp[:, :2] - rLow[:, :2]
+    XF = jnp.linalg.norm(dxy, axis=1)
+    ZF = rUp[:, 2] - rLow[:, 2]
+    sol = catenary_solve(XF, ZF, jnp.asarray(ms.L), jnp.asarray(ms.EA),
+                         jnp.asarray(ms.w),
+                         contact_allowed=jnp.asarray(ms.contact_ok))
+
+    dir_h = dxy / jnp.where(XF > 1e-8, XF, 1.0)[:, None]
+    # upper end: line pulls down-and-toward-lower; lower end: toward upper
+    F_up = jnp.concatenate([-sol["H"][:, None] * dir_h, -sol["V"][:, None]],
+                           axis=1)
+    F_low = jnp.concatenate([sol["Ha"][:, None] * dir_h, sol["Va"][:, None]],
+                            axis=1)
+    FA = jnp.where(flip[:, None], F_up, F_low)
+    FB = jnp.where(flip[:, None], F_low, F_up)
+    TA = jnp.where(flip, sol["TB"], sol["TA"])
+    TB = jnp.where(flip, sol["TA"], sol["TB"])
+    return FA, FB, TA, TB
+
+
+def _point_forces(ms: ArrayMooring, pts):
+    """Net line force on every point, (npt,3)."""
+    FA, FB, _, _ = line_end_forces(ms, pts)
+    F = jnp.zeros_like(pts)
+    F = F.at[jnp.asarray(ms.iA)].add(FA)
+    F = F.at[jnp.asarray(ms.iB)].add(FB)
+    return F
+
+
+def free_net_force(ms: ArrayMooring, Xb, xf):
+    """Equilibrium residual of the free points: line forces + weight +
+    buoyancy, (nf,3)."""
+    pts = point_positions(ms, Xb, xf)
+    F = _point_forces(ms, pts)
+    Wz = (-jnp.asarray(ms.pmass) * ms.g
+          + jnp.asarray(ms.pvol) * ms.rho * ms.g)
+    F = F.at[:, 2].add(Wz)
+    return F[np.where(ms.attach == ATTACH_FREE)[0]]
+
+
+def solve_free_points(ms: ArrayMooring, Xb, xf0=None, iters: int = 40,
+                      step_max: float = 30.0):
+    """Damped-Newton equilibrium of the free points (fixed iterations,
+    jit/vmap-safe).  The MoorPy analog is System.solveEquilibrium over the
+    free-point DOFs (called from the reference's eval_func_equil,
+    raft_model.py:600-606)."""
+    if ms.n_free == 0:
+        return jnp.zeros((0, 3))
+    if xf0 is None:
+        xf0 = ms.r0[ms.attach == ATTACH_FREE]
+    x0 = jnp.asarray(xf0, float).reshape(-1)
+
+    def resid(x):
+        return free_net_force(ms, Xb, x.reshape(-1, 3)).reshape(-1)
+
+    def step(x, _):
+        r = resid(x)
+        J = jax.jacfwd(resid)(x)
+        J = J + 1e-6 * jnp.eye(J.shape[0])
+        dx = jnp.linalg.solve(J, -r)
+        dx = jnp.clip(dx, -step_max, step_max)
+        return x + dx, None
+
+    x, _ = jax.lax.scan(step, x0, None, length=iters)
+    return x.reshape(-1, 3)
+
+
+def body_wrenches(ms: ArrayMooring, Xb, xf):
+    """6-DOF mooring wrench on each body about its pose reference point,
+    (nb,6) (equivalent of per-body Body.getForces(lines_only=True))."""
+    Xb = jnp.asarray(Xb, float)
+    pts = point_positions(ms, Xb, xf)
+    F = _point_forces(ms, pts)
+    attach = jnp.asarray(ms.attach)
+
+    def wrench(b):
+        mask = (attach == b).astype(float)[:, None]
+        offs = pts - Xb[b, :3]
+        return jnp.sum(translate_force_3to6(F * mask, offs), axis=0)
+
+    return jnp.stack([wrench(b) for b in range(ms.nbodies)])
+
+
+# --------------------------------------------------------------------------
+# equilibrium-coupled quantities (implicit-function / Schur complement)
+# --------------------------------------------------------------------------
+
+def _implicit_dxf_dXb(ms: ArrayMooring, Xb_flat, xf_eq):
+    """d(xf)/d(Xb) at equilibrium: -(dg/dxf)^-1 (dg/dXb)."""
+    nf3 = ms.n_free * 3
+
+    def g(xb, xf):
+        return free_net_force(ms, xb.reshape(-1, 6), xf.reshape(-1, 3)
+                              ).reshape(-1)
+
+    xf_flat = jnp.asarray(xf_eq, float).reshape(-1)
+    dg_dxf = jax.jacfwd(lambda xf: g(Xb_flat, xf))(xf_flat)
+    dg_dxb = jax.jacfwd(lambda xb: g(xb, xf_flat))(Xb_flat)
+    return -jnp.linalg.solve(dg_dxf + 1e-9 * jnp.eye(nf3), dg_dxb)
+
+
+def coupled_stiffness(ms: ArrayMooring, Xb, xf_eq):
+    """(6nb,6nb) coupled mooring stiffness about the body poses with the
+    free points eliminated — equivalent of MoorPy's
+    getCoupledStiffnessA(lines_only=True) (reference raft_model.py:
+    1029-1031), but by exact autodiff instead of finite differences."""
+    Xb_flat = jnp.asarray(Xb, float).reshape(-1)
+
+    def fb(xb, xf):
+        return body_wrenches(ms, xb.reshape(-1, 6), xf.reshape(-1, 3)
+                             ).reshape(-1)
+
+    xf_flat = jnp.asarray(xf_eq, float).reshape(-1)
+    dfb_dxb = jax.jacfwd(lambda xb: fb(xb, xf_flat))(Xb_flat)
+    if ms.n_free == 0:
+        return -dfb_dxb
+    dfb_dxf = jax.jacfwd(lambda xf: fb(Xb_flat, xf))(xf_flat)
+    dxf_dxb = _implicit_dxf_dXb(ms, Xb_flat, xf_eq)
+    return -(dfb_dxb + dfb_dxf @ dxf_dxb)
+
+
+def tensions(ms: ArrayMooring, Xb, xf):
+    """Line end tensions, (2*nl,): [TA_1..TA_n, TB_1..TB_n] (MoorPy
+    getTensions ordering used by the farm statistics at
+    raft_model.py:360-363)."""
+    pts = point_positions(ms, jnp.asarray(Xb, float), xf)
+    _, _, TA, TB = line_end_forces(ms, pts)
+    return jnp.concatenate([TA, TB])
+
+
+def tension_jacobian(ms: ArrayMooring, Xb, xf_eq):
+    """d(tensions)/d(body poses) with the implicit free-point correction,
+    (2nl, 6nb) — the J_moor of getCoupledStiffness(..., tensions=True)."""
+    Xb_flat = jnp.asarray(Xb, float).reshape(-1)
+    xf_flat = jnp.asarray(xf_eq, float).reshape(-1)
+
+    def T(xb, xf):
+        return tensions(ms, xb.reshape(-1, 6), xf.reshape(-1, 3))
+
+    dT_dxb = jax.jacfwd(lambda xb: T(xb, xf_flat))(Xb_flat)
+    if ms.n_free == 0:
+        return dT_dxb
+    dT_dxf = jax.jacfwd(lambda xf: T(Xb_flat, xf))(xf_flat)
+    dxf_dxb = _implicit_dxf_dXb(ms, Xb_flat, xf_eq)
+    return dT_dxb + dT_dxf @ dxf_dxb

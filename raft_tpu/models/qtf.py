@@ -224,7 +224,10 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None):
             else:
                 d1, d2w = m.ds[i_wl, 0], m.ds[i_wl, 1]
             a_wl_area = d1 * d2w
-        last = int(sel[-1])
+        # global node index whose Ca the reference's loop leaks into the
+        # waterline term: the last node that passed the submerged guard
+        # (raft_fowt.py:1527-1529 'continue' on r[il,2]>=0, used at :1613)
+        last = int(sel[below[-1]])
         # frequency fields at the intersection point (unit wave amplitude;
         # rho=g=1 so the "pressure" output is the wave elevation)
         _, udw, eta = wave_kinematics(ones, beta, w2, k2, h,

@@ -34,3 +34,14 @@ def solve_complex(A, b):
     x = jnp.linalg.solve(M, rhs)
     out = x[..., :n, :] + 1j * x[..., n:, :]
     return out[..., 0] if vec else out
+
+
+def inv_complex(A):
+    """Inverse of complex A (..., n, n) via the real block embedding
+    (TPU-safe).  Used to factor the system impedance once and reuse it
+    across excitation sources (the reference's Zinv, raft_model.py:
+    1038-1040)."""
+    A = jnp.asarray(A)
+    n = A.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=A.dtype), A.shape)
+    return solve_complex(A, eye)

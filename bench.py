@@ -1,14 +1,25 @@
-"""Benchmark: batched frequency-domain RAO solves on the flagship model.
+"""Benchmark: the north-star design-variant sweep on the flagship model.
 
-Metric: RAO frequency-bin solves per second per chip (BASELINE.json unit),
-measured on a batch of VolturnUS-S load cases run through the full
-drag-linearization fixed point + batched complex 6x6 solve.
+Workload (BASELINE.md target: 10,000 VolturnUS-S variants x 200 freq bins
+< 60 s on 8 chips): per variant, the FULL pipeline — traced geometry
+rebuild, ballast density trim, Newton statics equilibrium with line
+search, drag-linearization fixed point, batched complex 6x6 RAO solve,
+response statistics — vmapped over the variant batch on one chip.
 
-vs_baseline compares against a serial reference-equivalent implementation
-measured on this host: the same math with vectorized-numpy node operations
-but Python loops over cases and frequency bins (the reference's structure,
-raft/raft_model.py:942-947 — and generous to it, since the reference also
-loops members/nodes in Python).
+Metric: design-variants/hour/chip at 200 frequency bins.  The 8-chip
+north-star target (10k x 200 bins < 60 s) equals 75,000 variants/hour/chip.
+
+vs_baseline: the same per-variant pipeline executed the way the reference
+executes it (raft/parametersweep.py:93 — serial Python per variant;
+raft/raft_model.py:918-947 — Python fixed-point loop with a per-frequency
+6x6 solve; raft/raft_fowt.py:1152-1266 — node-level drag linearization),
+implemented in REAL numpy node-level math (actual wave kinematics,
+actual relative-velocity RMS linearization, actual drag excitation — not a
+synthetic stand-in), measured on this host's CPU and extrapolated.
+The reference itself cannot run here (moorpy/ccblade are not installed),
+so this reference-structure serial implementation is the measured stand-in;
+it is GENEROUS to the baseline (statics are computed with the vectorized
+kernels rather than the reference's per-member Python loops).
 
 Prints ONE json line.
 """
@@ -22,84 +33,209 @@ os.environ.setdefault("RAFT_TPU_X64", "0")
 
 import numpy as np
 
+NW = 200          # north-star frequency bins: 0.002..0.4 Hz @ 0.002
+NV = 1024         # variants measured on-chip (>= several per-core batches)
+NITER = 10        # drag-linearization iterations (VolturnUS-S setting)
+
+
+def _design():
+    import yaml
+    path = "/root/reference/tests/test_data/VolturnUS-S.yaml"
+    if not os.path.isfile(path):
+        path = os.path.join(os.path.dirname(__file__), "designs",
+                            "VolturnUS-S.yaml")
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def _base_fowt(design):
+    from raft_tpu.models.fowt import build_fowt
+    w = np.arange(1, NW + 1) * 0.002 * 2 * np.pi
+    return build_fowt(design, w, depth=float(design["site"]["water_depth"]))
+
+
+def _thetas(design, base, nv):
+    """nv geometry variants sampled over the parametersweep factor range."""
+    from raft_tpu.parallel.variants import volturn_grid
+    thetas, _ = volturn_grid(design, factors=(0.85, 1.0, 1.15))
+    n0 = len(thetas["rA0"])
+    rng = np.random.default_rng(7)
+    idx = rng.integers(0, n0, nv)
+    return {k: np.asarray(v)[idx] for k, v in thetas.items()}
+
 
 def main():
     import jax
-    import jax.numpy as jnp
 
-    from __graft_entry__ import _load_fowt
-    from raft_tpu.parallel.sweep import make_case_solver
+    from raft_tpu.parallel.variants import make_variant_solver
 
-    fowt = _load_fowt()
-    nw = len(fowt.w)
-    NC = 256
-    NITER = 10
+    design = _design()
+    base = _base_fowt(design)
+    thetas = _thetas(design, base, NV)
 
-    rng = np.random.default_rng(1)
-    Hs = 4.0 + 2.0 * rng.random(NC)
-    Tp = 8.0 + 6.0 * rng.random(NC)
-    beta = np.zeros(NC)
-
-    solver = make_case_solver(fowt, nIter=NITER, tol=-1.0)  # tol<0: full iterations
+    solver = make_variant_solver(base, Hs=6.0, Tp=12.0, ballast=True,
+                                 nIter=NITER, tol=-1.0,  # full iterations
+                                 newton_iters=10)
     batched = jax.jit(jax.vmap(solver))
 
-    out = batched(Hs, Tp, beta)  # compile + warmup
+    out = batched(thetas)   # compile + warmup
     jax.block_until_ready(out["std"])
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = batched(Hs, Tp, beta)
+        out = batched(thetas)
         jax.block_until_ready(out["std"])
     dt = (time.perf_counter() - t0) / reps
-    # each case solves nw bins per fixed-point iteration
-    bins_per_sec = NC * nw * NITER / dt
+    variants_per_hour = NV / dt * 3600.0
 
-    baseline_bps = _serial_numpy_baseline(fowt, nw, NITER)
+    baseline_vph = _serial_numpy_baseline(base)
 
     dev = jax.devices()[0]
     result = {
-        "metric": "RAO freq-bin solves/sec/chip (VolturnUS-S case sweep, "
-                  f"f32, device={dev.platform})",
-        "value": round(bins_per_sec, 1),
-        "unit": "bins/s/chip",
-        "vs_baseline": round(bins_per_sec / baseline_bps, 2),
+        "metric": f"design-variants/hour/chip ({NW}-bin VolturnUS-S variant "
+                  f"pipeline: geometry+ballast+statics+dynamics, f32, "
+                  f"device={dev.platform}; north-star 8-chip target=75000/h/chip)",
+        "value": round(variants_per_hour, 1),
+        "unit": "variants/h/chip",
+        "vs_baseline": round(variants_per_hour / baseline_vph, 2),
     }
     print(json.dumps(result))
 
 
-def _serial_numpy_baseline(fowt, nw, niter):
-    """Reference-structure serial solve: Python loops over cases/freqs."""
-    from raft_tpu.models.fowt import fowt_pose, fowt_statics, fowt_hydro_constants
-    import jax
+def _serial_numpy_baseline(fowt):
+    """Reference-structure serial pipeline in real numpy node-level math.
 
-    r6 = np.zeros(6)
-    pose = fowt_pose(fowt, r6)
+    Mirrors raft_model.py:918-947: per variant, nIter drag-linearization
+    passes, each doing the actual node-level relative-velocity RMS
+    linearization (raft_fowt.py:1152-1266), the actual linearized drag
+    excitation (:1270-1293), and a Python loop of nw complex 6x6 solves.
+    Wave kinematics and strip excitation are the real formulas evaluated
+    in numpy.  Statics/added-mass use the vectorized kernels once
+    (generous: the reference loops members/nodes in Python there too).
+    """
+    from raft_tpu.models.fowt import (fowt_pose, fowt_statics,
+                                      fowt_hydro_constants)
+    from raft_tpu.ops.spectra import jonswap
+
+    nw = len(fowt.w)
+    w = np.asarray(fowt.w)
+    k = np.asarray(fowt.k)
+    dw = w[1] - w[0]
+    rho = fowt.rho_water
+    h = fowt.depth
+
+    pose = fowt_pose(fowt, np.zeros(6))
     stat = fowt_statics(fowt, pose)
     hc = fowt_hydro_constants(fowt, pose)
     M = np.asarray(stat["M_struc"]) + np.asarray(hc["A_hydro_morison"])
     C = np.asarray(stat["C_struc"]) + np.asarray(stat["C_hydro"])
-    C = C + np.eye(6) * np.abs(np.diag(C)).max() * 0.1  # keep it invertible
-    w = fowt.w
+    from raft_tpu.models import mooring as mr
+    if fowt.mooring is not None:
+        C = C + np.asarray(mr.coupled_stiffness(fowt.mooring, np.zeros(6)))
+
     r = np.asarray(pose["r"])
+    q = np.asarray(pose["q"])
+    p1 = np.asarray(pose["p1"])
+    p2 = np.asarray(pose["p2"])
+    qMat = np.asarray(pose["qMat"])
+    p1Mat = np.asarray(pose["p1Mat"])
+    p2Mat = np.asarray(pose["p2Mat"])
+    nd = fowt.nodes
     N = r.shape[0]
-    ncase_meas = 2
-    F = (np.ones((6, nw)) + 1j * np.ones((6, nw)))
+    offsets = r  # PRP at origin
+
+    # real wave kinematics at the nodes (helpers.py:105-154 math)
+    S = np.asarray(jonswap(w, 6.0, 12.0))
+    zeta = np.sqrt(2.0 * S * dw).astype(complex)
+    z = r[:, 2]
+    kz = np.outer(z, k)
+    kh = k * h
+    # overflow-stable cosh/sinh ratios (same algebra as ops/waves.py):
+    # cosh(kz+kh)/cosh(kh) = (e^{kz} + e^{-kz-2kh}) / (1 + e^{-2kh})
+    e1 = np.exp(np.minimum(kz, 0.0))
+    e2 = np.exp(-kz - 2.0 * kh[None, :])
+    den = 1.0 + np.exp(-2.0 * kh)[None, :]
+    c_r = (e1 + e2) / den
+    s_r = (e1 - e2) / den
+    wet = (z <= 0.0)[:, None]
+    phase = np.exp(-1j * np.outer(r[:, 0], k))
+    zn = zeta[None, :] * phase
+    u = np.stack([w * zn * c_r, np.zeros_like(zn), 1j * w * zn * s_r], axis=1)
+    u *= wet[:, None, :]
+    ud = 1j * w[None, None, :] * u
+    pDyn = np.where(wet, rho * 9.81 * zn * c_r, 0.0)
+
+    # strip inertial excitation (raft_fowt.py:1098-1124 math)
+    Imat = np.asarray(hc["Imat"])
+    a_i = np.asarray(hc["a_i"])
+    F_nodes = (np.einsum("nij,njw->niw", Imat, ud)
+               + pDyn[:, None, :] * (a_i[:, None] * q)[:, :, None])
+    F_iner = np.zeros((6, nw), complex)
+    F_iner[:3] = F_nodes.sum(axis=0)
+    F_iner[3:] = np.cross(offsets[:, :, None], F_nodes,
+                          axisa=1, axisb=1, axisc=1).sum(axis=0)
+
+    sub = (r[:, 2] < 0.0).astype(float)
+    c_lin = np.sqrt(8.0 / np.pi) * 0.5 * rho
+    a_i_q = np.asarray(nd.a_i_q) * np.asarray(nd.Cd_q)
+    a_i_p1 = np.asarray(nd.a_i_p1) * np.asarray(nd.Cd_p1)
+    a_i_p2 = np.asarray(nd.a_i_p2) * np.asarray(nd.Cd_p2)
+    a_i_end = np.asarray(nd.a_i_end_drag) * np.asarray(nd.Cd_End)
+
+    nmeas = 2
     t0 = time.perf_counter()
-    for _ in range(ncase_meas):
+    for _ in range(nmeas):
         Xi = np.zeros((6, nw), complex)
-        for _ in range(niter):
-            # node-level linearization stand-in (vectorized numpy)
-            vrel = np.random.default_rng(0).random((N, 3, nw))
-            vrms = np.sqrt(0.5 * np.sum(np.abs(vrel) ** 2, axis=2))
-            Bn = vrms[:, :, None] * np.eye(3)[None, :, :]
-            B6 = np.sum(Bn, axis=0)
+        # NITER+1 passes, matching both the reference (nIter+1 loop,
+        # raft_model.py:862/918) and the measured TPU pipeline
+        for _ in range(NITER + 1):
+            # node velocities from platform motion (helpers.py:66-101)
+            vn = 1j * w[None, None, :] * (
+                Xi[None, :3, :]
+                + np.cross(np.broadcast_to(Xi[3:, :].T[:, None, :], (nw, N, 3)),
+                           r[None, :, :], axisa=2, axisb=2).transpose(1, 2, 0))
+            vrel = u - vn
+            # real stochastic linearization (raft_fowt.py:1205-1250)
+            vq = np.einsum("ncw,nc->nw", vrel, q)
+            vrel_q = vq[:, None, :] * q[:, :, None]
+            vrel_p = vrel - vrel_q
+            vRMS_q = np.sqrt(0.5 * np.sum(np.abs(vrel_q)**2, axis=(1, 2)))
+            vRMS_p = np.sqrt(0.5 * np.sum(np.abs(vrel_p)**2, axis=(1, 2)))
+            Bmat = (c_lin * (vRMS_q * (a_i_q + a_i_end))[:, None, None] * qMat
+                    + c_lin * (vRMS_p * a_i_p1)[:, None, None] * p1Mat
+                    + c_lin * (vRMS_p * a_i_p2)[:, None, None] * p2Mat)
+            Bmat *= sub[:, None, None]
             B = np.zeros((6, 6))
-            B[:3, :3] = B6
+            B[:3, :3] = Bmat.sum(axis=0)
+            mom = np.einsum("nab,nbc->nac",
+                            _skew(offsets), Bmat)
+            B[3:, :3] = mom.sum(axis=0)
+            B[:3, 3:] = -np.einsum("nab,nbc->nac", Bmat,
+                                   _skew(offsets)).sum(axis=0)
+            B[3:, 3:] = -np.einsum("nab,nbc,ncd->nad", _skew(offsets), Bmat,
+                                   _skew(offsets)).sum(axis=0)
+            # real drag excitation (raft_fowt.py:1270-1293)
+            Fd_nodes = np.einsum("nij,njw->niw", Bmat, u)
+            F_drag = np.zeros((6, nw), complex)
+            F_drag[:3] = Fd_nodes.sum(axis=0)
+            F_drag[3:] = np.cross(offsets[:, :, None], Fd_nodes,
+                                  axisa=1, axisb=1, axisc=1).sum(axis=0)
+            F = F_iner + F_drag
+            # the reference's per-frequency solve loop (raft_model.py:942-947)
             for iw in range(nw):
-                Z = -w[iw] ** 2 * M + 1j * w[iw] * B + C
+                Z = -w[iw]**2 * M + 1j * w[iw] * B + C
                 Xi[:, iw] = np.linalg.solve(Z, F[:, iw])
-    dt = time.perf_counter() - t0
-    return ncase_meas * nw * niter / dt
+    dt = (time.perf_counter() - t0) / nmeas
+    return 3600.0 / dt
+
+
+def _skew(v):
+    O = np.zeros(len(v))
+    return np.stack([
+        np.stack([O, -v[:, 2], v[:, 1]], axis=1),
+        np.stack([v[:, 2], O, -v[:, 0]], axis=1),
+        np.stack([-v[:, 1], v[:, 0], O], axis=1),
+    ], axis=1)
 
 
 if __name__ == "__main__":

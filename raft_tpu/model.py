@@ -109,6 +109,10 @@ class Model:
             self.fowtList = [build_fowt(design, self.w, depth=self.depth)]
             self.nFOWT = 1
         self.nDOF = 6 * self.nFOWT
+        # 0: no current on mooring lines; 1: uniform case current included
+        # in the line-drag wrench (reference: raft_model.py:162-163)
+        self.mooring_currentMod = int(get_from_dict(
+            design.get("mooring") or {}, "currentMod", dtype=int, default=0))
         self.design = design
         self.results = {}
         # per-fowt case state (filled by solveStatics/solveDynamics)
@@ -125,7 +129,13 @@ class Model:
         for key in ("wind_speed", "wind_heading", "turbulence"):
             v = case.get(key)
             if isinstance(v, (list, tuple, np.ndarray)):
-                case_i[key] = v[i] if i < len(v) else v[-1]
+                if i >= len(v):
+                    raise ValueError(
+                        f"case list for '{key}' has {len(v)} entries but "
+                        f"FOWT {i+1} exists — per-turbine lists must match "
+                        "the number of turbines (reference: "
+                        "raft_model.py:517-519)")
+                case_i[key] = v[i]
         return case_i
 
     # ------------------------------------------------------------------
@@ -154,6 +164,15 @@ class Model:
             D_hydro = fowt_current_loads(fowt, pose0, cur_speed, cur_head)
             state["D_hydro"] = np.asarray(D_hydro)
             F_env = np.asarray(jnp.sum(tc["f_aero0"], axis=1)) + np.asarray(D_hydro)
+            # current drag on the mooring lines (reference passes the case
+            # current to MoorPy, raft_model.py:559-578)
+            if (self.mooring_currentMod > 0 and cur_speed > 0
+                    and fowt.mooring is not None):
+                U = cur_speed * np.array([np.cos(np.deg2rad(cur_head)),
+                                          np.sin(np.deg2rad(cur_head)), 0.0])
+                X0 = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+                F_env = F_env + np.asarray(
+                    mr.current_wrench(fowt.mooring, X0, U))
             if "F_meandrift" in state:
                 F_env = F_env + state["F_meandrift"]
         else:
@@ -161,6 +180,47 @@ class Model:
             state["hydro0"] = fowt_hydro_constants(fowt, pose0)
             state["D_hydro"] = np.zeros(6)
         state["F_env_constant"] = F_env
+
+    def _statics_eval_fn(self):
+        """Jitted (net force, tangent stiffness, free points) evaluation,
+        built ONCE per Model and reused across Newton iterations, cases,
+        and the potSecOrder statics re-solves — the per-case constants
+        (F0, K_hs) are traced arguments, not baked-in constants."""
+        if getattr(self, "_eval_FK_j", None) is not None:
+            return self._eval_FK_j
+        N = self.nFOWT
+        refs = np.concatenate([
+            [f.x_ref, f.y_ref, 0, 0, 0, 0] for f in self.fowtList])
+        moors = [f.mooring for f in self.fowtList]
+        arr = self.arr_ms
+        if arr is not None:
+            from raft_tpu.models import mooring_array as ma
+
+        def eval_FK(X, xf, F0s, K_hss):
+            Fs, Kblocks = [], []
+            for i in range(N):
+                s = slice(6 * i, 6 * i + 6)
+                Xi0 = X[s] - refs[s]
+                F = F0s[i] - K_hss[i] @ Xi0
+                K = K_hss[i]
+                if moors[i] is not None:
+                    F = F + mr.body_wrench(moors[i], X[s])
+                    K = K + mr.coupled_stiffness(moors[i], X[s])
+                Fs.append(F)
+                Kblocks.append(K)
+            Fv = jnp.concatenate(Fs)
+            Km = jnp.zeros((6 * N, 6 * N))
+            for i in range(N):
+                Km = Km.at[6 * i:6 * i + 6, 6 * i:6 * i + 6].set(Kblocks[i])
+            if arr is not None:
+                Xb = X.reshape(N, 6)
+                xf = ma.solve_free_points(arr, Xb, xf0=xf)
+                Fv = Fv + ma.body_wrenches(arr, Xb, xf).reshape(-1)
+                Km = Km + ma.coupled_stiffness(arr, Xb, xf)
+            return Fv, Km, xf
+
+        self._eval_FK_j = jax.jit(eval_FK)
+        return self._eval_FK_j
 
     def solveStatics(self, case, display=0):
         """Mean-offset equilibrium over all 6N system DOFs (reference:
@@ -182,25 +242,19 @@ class Model:
 
         X = refs.copy()
         xf = self._arr_xf
+        if arr is not None and xf is None:
+            xf = arr.r0[arr.attach == -2]
+
+        eval_FK_j = self._statics_eval_fn()
+
+        F0s = jnp.asarray(np.stack(F0))
+        K_hss = jnp.asarray(np.stack(K_hs))
         db = np.tile(np.array([30, 30, 5, 0.1, 0.1, 0.1]), N)
         tol = np.tile(np.array([0.05, 0.05, 0.05, 5e-3, 5e-3, 5e-3]) * 1e-3, N)
+        xf_arg = jnp.zeros((0, 3)) if xf is None else jnp.asarray(xf)
         for it in range(50):
-            F = np.zeros(6 * N)
-            K = np.zeros((6 * N, 6 * N))
-            for i, fowt in enumerate(self.fowtList):
-                s = slice(6 * i, 6 * i + 6)
-                Xi0 = X[s] - refs[s]
-                F[s] = F0[i] - K_hs[i] @ Xi0
-                K[s, s] = K_hs[i]
-                if fowt.mooring is not None:
-                    F[s] += np.asarray(mr.body_wrench(fowt.mooring, X[s]))
-                    K[s, s] += np.asarray(
-                        mr.coupled_stiffness(fowt.mooring, X[s]))
-            if arr is not None:
-                Xb = X.reshape(N, 6)
-                xf = ma.solve_free_points(arr, Xb, xf0=xf)
-                F += np.asarray(ma.body_wrenches(arr, Xb, xf)).reshape(-1)
-                K += np.asarray(ma.coupled_stiffness(arr, Xb, xf))
+            Fj, Kj, xf_arg = eval_FK_j(jnp.asarray(X), xf_arg, F0s, K_hss)
+            F, K = np.asarray(Fj), np.asarray(Kj).copy()
             # guard zero-stiffness diagonals like the reference (:713-715)
             kmean = np.mean(np.diag(K))
             for i in range(6 * N):
@@ -212,12 +266,15 @@ class Model:
             if np.all(np.abs(dX) < tol):
                 break
 
-        self._arr_xf = xf
-        # mooring properties at equilibrium
+        # mooring properties at the FINAL pose (one more free-point solve
+        # so xf corresponds to X, not the previous Newton iterate)
         if arr is not None:
             Xb = X.reshape(N, 6)
+            xf = ma.solve_free_points(arr, Xb, xf0=xf_arg)
+            self._arr_xf = np.asarray(xf)
             self._K_array = np.asarray(ma.coupled_stiffness(arr, Xb, xf))
         else:
+            self._arr_xf = None
             self._K_array = None
         for i, fowt in enumerate(self.fowtList):
             s = slice(6 * i, 6 * i + 6)

@@ -78,6 +78,8 @@ class NodeSet:
     Ca_End: np.ndarray
     circ: np.ndarray             # bool per node
     potMod: np.ndarray           # bool per node (True -> no strip hydro)
+    MCF: np.ndarray = None       # bool per node: MacCamy-Fuchs member
+    R: np.ndarray = None         # node radius ds/2 (circular; 0 for rect)
 
     @property
     def n(self):
@@ -272,47 +274,57 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
     )
 
 
+def member_node_cols(m: MemberGeometry):
+    """Per-node derived areas/volumes for one member, from its strip arrays
+    (reference: raft_fowt.py:1200-1202, raft_member.py:925-949).
+
+    Written with jnp so it works both at build time (numpy leaves) and
+    inside a traced design-variant pipeline where ds/drs/dls are functions
+    of the variant parameters (parallel/variants.py)."""
+    ds, drs, dls = m.ds, m.drs, m.dls
+    if m.circular:
+        a_i_q = np.pi * ds * dls
+        a_i_p1 = ds * dls
+        a_i_p2 = ds * dls
+        a_end_drag = jnp.abs(np.pi * ds * drs)
+        v_side = 0.25 * np.pi * ds**2 * dls
+        v_end = np.pi / 12.0 * jnp.abs((ds + drs) ** 3 - (ds - drs) ** 3)
+        a_i = np.pi * ds * drs
+    else:
+        # NOTE: a_i_q uses ds[:,0] twice, replicating the reference
+        # (raft_fowt.py:1200: 2*(ds[il,0]+ds[il,0])*dls)
+        a_i_q = 2 * (ds[:, 0] + ds[:, 0]) * dls
+        a_i_p1 = ds[:, 0] * dls
+        a_i_p2 = ds[:, 1] * dls
+        a_end = ((ds[:, 0] + drs[:, 0]) * (ds[:, 1] + drs[:, 1])
+                 - (ds[:, 0] - drs[:, 0]) * (ds[:, 1] - drs[:, 1]))
+        a_end_drag = jnp.abs(a_end)
+        v_side = ds[:, 0] * ds[:, 1] * dls
+        dmean_p = jnp.mean(ds + drs, axis=1)
+        dmean_m = jnp.mean(ds - drs, axis=1)
+        v_end = np.pi / 12.0 * (dmean_p**3 - dmean_m**3)
+        a_i = a_end
+    R = 0.5 * ds if m.circular else 0.0 * ds[:, 0]
+    return dict(frac=m.ls / m.l, dls=dls, a_i_q=a_i_q, a_i_p1=a_i_p1,
+                a_i_p2=a_i_p2, a_i_end_drag=a_end_drag, v_side=v_side,
+                v_end=v_end, a_i=a_i, R=R)
+
+
 def _build_nodeset(members: List[MemberGeometry]) -> NodeSet:
     cols = {k: [] for k in ("member_index", "frac", "dls", "a_i_q", "a_i_p1",
                             "a_i_p2", "a_i_end_drag", "v_side", "v_end", "a_i",
                             "Cd_q", "Cd_p1", "Cd_p2", "Cd_End",
-                            "Ca_p1", "Ca_p2", "Ca_End", "circ", "potMod")}
+                            "Ca_p1", "Ca_p2", "Ca_End", "circ", "potMod",
+                            "MCF", "R")}
     for im, m in enumerate(members):
         ns = m.ns
         circ = m.circular
-        ds, drs, dls = m.ds, m.drs, m.dls
-        if circ:
-            a_i_q = np.pi * ds * dls
-            a_i_p1 = ds * dls
-            a_i_p2 = ds * dls
-            a_end_drag = np.abs(np.pi * ds * drs)
-            v_side = 0.25 * np.pi * ds**2 * dls
-            v_end = np.pi / 12.0 * np.abs((ds + drs) ** 3 - (ds - drs) ** 3)
-            a_i = np.pi * ds * drs
-        else:
-            # NOTE: a_i_q uses ds[:,0] twice, replicating the reference
-            # (raft_fowt.py:1200: 2*(ds[il,0]+ds[il,0])*dls)
-            a_i_q = 2 * (ds[:, 0] + ds[:, 0]) * dls
-            a_i_p1 = ds[:, 0] * dls
-            a_i_p2 = ds[:, 1] * dls
-            a_end = ((ds[:, 0] + drs[:, 0]) * (ds[:, 1] + drs[:, 1])
-                     - (ds[:, 0] - drs[:, 0]) * (ds[:, 1] - drs[:, 1]))
-            a_end_drag = np.abs(a_end)
-            v_side = ds[:, 0] * ds[:, 1] * dls
-            dmean_p = np.mean(ds + drs, axis=1)
-            dmean_m = np.mean(ds - drs, axis=1)
-            v_end = np.pi / 12.0 * (dmean_p**3 - dmean_m**3)
-            a_i = a_end
+        derived = member_node_cols(m)
         cols["member_index"].append(np.full(ns, im))
-        cols["frac"].append(m.ls / m.l)
-        cols["dls"].append(dls)
-        cols["a_i_q"].append(a_i_q)
-        cols["a_i_p1"].append(a_i_p1)
-        cols["a_i_p2"].append(a_i_p2)
-        cols["a_i_end_drag"].append(a_end_drag)
-        cols["v_side"].append(v_side)
-        cols["v_end"].append(v_end)
-        cols["a_i"].append(a_i)
+        cols["MCF"].append(np.full(ns, bool(m.MCF), dtype=bool))
+        for key in ("frac", "dls", "a_i_q", "a_i_p1", "a_i_p2",
+                    "a_i_end_drag", "v_side", "v_end", "a_i", "R"):
+            cols[key].append(np.asarray(derived[key]))
         cols["Cd_q"].append(m.Cd_q_n)
         cols["Cd_p1"].append(m.Cd_p1_n)
         cols["Cd_p2"].append(m.Cd_p2_n)
@@ -497,6 +509,35 @@ def fowt_hydro_constants(fowt: FOWTModel, pose):
     Imat = Imat * mask[:, None, None]
     a_i = jnp.asarray(nd.a_i) * mask
 
+    # MacCamy-Fuchs: frequency-dependent complex inertial coefficient for
+    # flagged circular members (reference: raft_member.py:1053-1088 — Cm =
+    # 4i/(pi (kR)^2 H1'(kR)) with a cosine ramp blending to the Morison Cm
+    # for long waves; applied to the transverse terms only)
+    if nd.MCF is not None and bool(np.any(np.asarray(nd.MCF))):
+        from raft_tpu.ops.special import hankel1p_all
+        k = jnp.asarray(fowt.k)                       # (nw,)
+        R = jnp.asarray(nd.R)                         # (N,)
+        R_safe = jnp.where(R > 0, R, 1.0)
+        kR = k[None, :] * R_safe[:, None]             # (N, nw)
+        Hp1 = hankel1p_all(kR, 1)[1]
+        Cm = 4j / (jnp.pi * kR**2 * Hp1)
+        Tr = jnp.pi / 5.0 / R_safe                    # (N,)
+        ramp = jnp.where(k[None, :] < Tr[:, None],
+                         0.5 * (1.0 - jnp.cos(jnp.pi * k[None, :] / Tr[:, None])),
+                         1.0)
+        ramp = jnp.where(k[None, :] <= 0.0, 0.0, ramp)
+        mcf = jnp.asarray(nd.MCF)[:, None]
+        Cm_p1 = jnp.where(mcf, Cm * ramp + (1.0 + Ca_p1[:, None]) * (1 - ramp),
+                          (1.0 + Ca_p1[:, None]).astype(complex))
+        Cm_p2 = jnp.where(mcf, Cm * ramp + (1.0 + Ca_p2[:, None]) * (1 - ramp),
+                          (1.0 + Ca_p2[:, None]).astype(complex))
+        Imat = ((rho * v_side)[:, None, None, None]
+                * (Cm_p1[:, None, None, :] * p1Mat[:, :, :, None]
+                   + Cm_p2[:, None, None, :] * p2Mat[:, :, :, None])
+                + ((rho * v_end * Ca_End)[:, None, None]
+                   * qMat)[:, :, :, None].astype(complex))
+        Imat = Imat * mask[:, None, None, None]
+
     offsets = r - pose["r6"][:3]
     A_hydro = jnp.sum(translate_matrix_3to6(Amat, offsets), axis=0)
     return dict(A_hydro_morison=A_hydro, Amat=Amat, Imat=Imat, a_i=a_i,
@@ -599,10 +640,15 @@ def fowt_hydro_excitation(fowt: FOWTModel, pose, seastate, hydro_consts):
     u, ud, pDyn = jax.vmap(per_heading)(zeta, beta)
 
     # inertial excitation: F = Imat @ ud + pDyn * a_i * q   per node
+    # (Imat is (N,3,3,nw) complex when MacCamy-Fuchs members are present)
     Imat = hydro_consts["Imat"].astype(complex)
     a_i = hydro_consts["a_i"]
     q = pose["q"]
-    F_nodes = (jnp.einsum("nij,hnjw->hniw", Imat, ud)
+    if Imat.ndim == 4:
+        F_I = jnp.einsum("nijw,hnjw->hniw", Imat, ud)
+    else:
+        F_I = jnp.einsum("nij,hnjw->hniw", Imat, ud)
+    F_nodes = (F_I
                + pDyn[:, :, None, :] * (a_i[:, None] * q)[None, :, :, None])
     offsets = r - pose["r6"][:3]
     F_hydro_iner = jnp.sum(_wrench_about_origin(F_nodes, offsets, node_axis=1),

@@ -64,10 +64,17 @@ class MooringSystem:
 
 
 def parse_mooring(moor: dict, rho: float = _RHO, g: float = _G,
-                  trans=(0.0, 0.0), rot: float = 0.0) -> MooringSystem:
-    """Build a MooringSystem from the design['mooring'] YAML dict
+                  trans=(0.0, 0.0), rot: float = 0.0):
+    """Build a mooring system from the design['mooring'] YAML dict
     (schema per reference designs/*.yaml: water_depth, points with
-    type fixed|vessel, lines endA/endB, line_types).
+    type fixed|vessel|free, lines endA/endB, line_types).
+
+    Simple anchor->fairlead topologies build the vectorized
+    `MooringSystem`.  Topologies with FREE intermediate points or
+    multi-segment composite lines build a single-body
+    `mooring_array.ArrayMooring` (same differentiable catenary, plus a
+    free-point equilibrium solve) — the MoorPy-general path the reference
+    gets from System.parseYAML (raft_fowt.py:166-189).
 
     ``trans``/``rot`` apply the reference's array-placement transform
     (reference: raft_fowt.py:185): rotate the whole system about z by
@@ -81,40 +88,110 @@ def parse_mooring(moor: dict, rho: float = _RHO, g: float = _G,
     c, s = np.cos(np.deg2rad(rot)), np.sin(np.deg2rad(rot))
     Rz = np.array([[c, -s, 0.0], [s, c, 0.0], [0.0, 0.0, 1.0]])
 
-    rAnchor, rFair0 = [], []
-    L, EA, w, d_vol, m_lin, Cd_t, Cd_a = [], [], [], [], [], [], []
-    for ln in moor["lines"]:
-        pA, pB = points[ln["endA"]], points[ln["endB"]]
-        # orient so that A is the fixed (anchor) end, B the vessel end
-        if pA["type"].lower().startswith("vessel"):
-            pA, pB = pB, pA
-        if not pB["type"].lower().startswith("vessel"):
-            raise NotImplementedError(
-                "free intermediate mooring points not supported yet "
-                f"(line {ln.get('name')})")
-        anchor = Rz @ np.array(pA["location"], float)
-        anchor[0] += trans[0]
-        anchor[1] += trans[1]
-        fair = Rz @ np.array(pB["location"], float)
-        rAnchor.append(anchor)
-        rFair0.append(fair)
+    def ptype(p):
+        t = p["type"].lower()
+        if t.startswith("vessel") or t.startswith("body") \
+                or t.startswith("coupled"):
+            return "vessel"
+        if t.startswith("free") or t.startswith("connect"):
+            return "free"
+        return "fixed"
+
+    simple = all(
+        {ptype(points[ln["endA"]]), ptype(points[ln["endB"]])}
+        == {"fixed", "vessel"}
+        for ln in moor["lines"])
+
+    def line_props(ln):
         lt = types[ln["type"]]
         d = float(lt["diameter"])
         m = float(lt["mass_density"])
-        L.append(float(ln["length"]))
-        EA.append(float(lt["stiffness"]))
-        w.append((m - rho * np.pi / 4 * d**2) * g)
-        d_vol.append(d)
-        m_lin.append(m)
-        Cd_t.append(float(lt.get("transverse_drag", 0.0)))
-        Cd_a.append(float(lt.get("tangential_drag", 0.0)))
+        return dict(L=float(ln["length"]), EA=float(lt["stiffness"]),
+                    w=(m - rho * np.pi / 4 * d**2) * g, d=d, m=m,
+                    Cd_t=float(lt.get("transverse_drag", 0.0)),
+                    Cd_a=float(lt.get("tangential_drag", 0.0)))
 
-    return MooringSystem(
-        depth=depth,
-        rAnchor=np.array(rAnchor), rFair0=np.array(rFair0),
-        L=np.array(L), EA=np.array(EA), w=np.array(w),
-        d_vol=np.array(d_vol), m_lin=np.array(m_lin),
-        Cd_t=np.array(Cd_t), Cd_a=np.array(Cd_a),
+    if simple:
+        rAnchor, rFair0 = [], []
+        L, EA, w, d_vol, m_lin, Cd_t, Cd_a = [], [], [], [], [], [], []
+        for ln in moor["lines"]:
+            pA, pB = points[ln["endA"]], points[ln["endB"]]
+            if ptype(pA) == "vessel":
+                pA, pB = pB, pA
+            anchor = Rz @ np.array(pA["location"], float)
+            anchor[0] += trans[0]
+            anchor[1] += trans[1]
+            fair = Rz @ np.array(pB["location"], float)
+            rAnchor.append(anchor)
+            rFair0.append(fair)
+            lp = line_props(ln)
+            L.append(lp["L"])
+            EA.append(lp["EA"])
+            w.append(lp["w"])
+            d_vol.append(lp["d"])
+            m_lin.append(lp["m"])
+            Cd_t.append(lp["Cd_t"])
+            Cd_a.append(lp["Cd_a"])
+
+        return MooringSystem(
+            depth=depth,
+            rAnchor=np.array(rAnchor), rFair0=np.array(rFair0),
+            L=np.array(L), EA=np.array(EA), w=np.array(w),
+            d_vol=np.array(d_vol), m_lin=np.array(m_lin),
+            Cd_t=np.array(Cd_t), Cd_a=np.array(Cd_a),
+        )
+
+    # ----- general topology: single-body ArrayMooring -----
+    from raft_tpu.models import mooring_array as ma
+
+    names = list(points.keys())
+    attach, r0, pmass, pvol = [], [], [], []
+    for name in names:
+        p = points[name]
+        t = ptype(p)
+        loc = np.array(p["location"], float)
+        if t == "vessel":
+            attach.append(0)
+            r0.append(Rz @ loc)          # body frame (placement on body)
+        else:
+            attach.append(ma.ATTACH_FIXED if t == "fixed" else ma.ATTACH_FREE)
+            loc = Rz @ loc
+            loc[0] += trans[0]
+            loc[1] += trans[1]
+            r0.append(loc)
+        pmass.append(float(p.get("mass", 0.0)))
+        pvol.append(float(p.get("volume", 0.0)))
+    attach = np.array(attach)
+    r0 = np.array(r0)
+    free_idx = np.full(len(names), -1)
+    free_idx[attach == ma.ATTACH_FREE] = np.arange(
+        (attach == ma.ATTACH_FREE).sum())
+    name2row = {n: i for i, n in enumerate(names)}
+
+    iA, iB, L, EA, w = [], [], [], [], []
+    d_vol, Cd_t, Cd_a = [], [], []
+    for ln in moor["lines"]:
+        lp = line_props(ln)
+        iA.append(name2row[ln["endA"]])
+        iB.append(name2row[ln["endB"]])
+        L.append(lp["L"])
+        EA.append(lp["EA"])
+        w.append(lp["w"])
+        d_vol.append(lp["d"])
+        Cd_t.append(lp["Cd_t"])
+        Cd_a.append(lp["Cd_a"])
+    iA, iB = np.array(iA), np.array(iB)
+
+    def on_seabed(ipt):
+        return (attach[ipt] == ma.ATTACH_FIXED) & (r0[ipt, 2] <= -depth + 1.0)
+
+    return ma.ArrayMooring(
+        depth=depth, nbodies=1,
+        attach=attach, r0=r0, pmass=np.array(pmass), pvol=np.array(pvol),
+        free_idx=free_idx,
+        iA=iA, iB=iB, L=np.array(L), EA=np.array(EA), w=np.array(w),
+        contact_ok=on_seabed(iA) | on_seabed(iB), g=g, rho=rho,
+        d_vol=np.array(d_vol), Cd_t=np.array(Cd_t), Cd_a=np.array(Cd_a),
     )
 
 
@@ -235,30 +312,75 @@ def line_forces(sys_: MooringSystem, r6):
     return F, rF, sol
 
 
-def body_wrench(sys_: MooringSystem, r6):
+def _is_general(sys_) -> bool:
+    """True for the general (free-point / multi-segment) single-body
+    system built by parse_mooring on non-simple topologies."""
+    return hasattr(sys_, "attach")
+
+
+def body_wrench(sys_, r6):
     """Net 6-DOF mooring wrench on the body about its reference point
     (equivalent of Body.getForces(lines_only=True))."""
+    if _is_general(sys_):
+        from raft_tpu.models import mooring_array as ma
+        Xb = jnp.asarray(r6, float)[None, :]
+        xf = ma.solve_free_points(sys_, Xb)
+        return ma.body_wrenches(sys_, Xb, xf)[0]
     F, rF, _ = line_forces(sys_, r6)
     r6 = jnp.asarray(r6, float)
     return jnp.sum(translate_force_3to6(F, rF - r6[:3]), axis=0)
 
 
-def coupled_stiffness(sys_: MooringSystem, r6):
+def coupled_stiffness(sys_, r6):
     """6x6 mooring stiffness -dF/dx about the body pose (equivalent of
     getCoupledStiffnessA(lines_only=True)), by exact forward-mode autodiff
-    through the catenary Newton solve."""
+    through the catenary Newton solve (free points eliminated by the
+    implicit-function theorem on the general path)."""
+    if _is_general(sys_):
+        from raft_tpu.models import mooring_array as ma
+        Xb = jnp.asarray(r6, float)[None, :]
+        xf = ma.solve_free_points(sys_, Xb)
+        return ma.coupled_stiffness(sys_, Xb, xf)
     return -jax.jacfwd(lambda x: body_wrench(sys_, x))(jnp.asarray(r6, float))
 
 
-def tensions(sys_: MooringSystem, r6):
+def tensions(sys_, r6):
     """Line end tensions, shape (2*nl,): all anchor-end tensions first,
     then all fairlead-end tensions ([TA_1..TA_n, TB_1..TB_n]), matching
     MoorPy's getTensions ordering used by the reference."""
+    if _is_general(sys_):
+        from raft_tpu.models import mooring_array as ma
+        Xb = jnp.asarray(r6, float)[None, :]
+        xf = ma.solve_free_points(sys_, Xb)
+        return ma.tensions(sys_, Xb, xf)
     _, _, sol = line_forces(sys_, r6)
     return jnp.concatenate([sol["TA"], sol["TB"]])
 
 
-def tension_jacobian(sys_: MooringSystem, r6):
+def current_wrench(sys_, r6, U, rho: float = _RHO):
+    """Uniform-current drag on the mooring lines, lumped to the body —
+    chord-direction approximation of MoorPy's currentMod=1 (the reference
+    passes case currents to MoorPy, raft_model.py:559-578).  Half of each
+    line's drag loads the fairlead, the anchor half sheds to ground."""
+    if _is_general(sys_):
+        from raft_tpu.models import mooring_array as ma
+        Xb = jnp.asarray(r6, float)[None, :]
+        xf = ma.solve_free_points(sys_, Xb)
+        return ma.current_wrenches(sys_, Xb, xf, U)[0]
+    from raft_tpu.models.mooring_array import chord_drag
+    r6 = jnp.asarray(r6, float)
+    rF = fairlead_positions(sys_, r6)
+    F_line = chord_drag(sys_.rAnchor, rF, U, sys_.L, sys_.d_vol,
+                        sys_.Cd_t, sys_.Cd_a, rho)
+    return jnp.sum(translate_force_3to6(0.5 * F_line, rF - r6[:3]), axis=0)
+
+
+def tension_jacobian(sys_, r6):
     """d(tensions)/d(pose): (2*nl, 6), the J_moor of the reference's
     getCoupledStiffness(..., tensions=True)."""
+    if _is_general(sys_):
+        from raft_tpu.models import mooring_array as ma
+        Xb = jnp.asarray(r6, float)[None, :]
+        xf = ma.solve_free_points(sys_, Xb)
+        return ma.tension_jacobian(sys_, Xb, xf)
     return jax.jacfwd(lambda x: tensions(sys_, x))(jnp.asarray(r6, float))

@@ -70,6 +70,9 @@ class ArrayMooring:
     contact_ok: np.ndarray  # (nl,) bool: lower end is a seabed anchor
     g: float = _G
     rho: float = _RHO
+    d_vol: np.ndarray = None   # (nl,) volume-equivalent line diameter
+    Cd_t: np.ndarray = None    # (nl,) transverse drag coefficient
+    Cd_a: np.ndarray = None    # (nl,) tangential (axial) drag coefficient
 
     @property
     def n_free(self) -> int:
@@ -123,7 +126,9 @@ def parse_moordyn(path: str, nbodies: int, depth: float | None = None,
         c = row.split()
         d, m, EA = float(c[1]), float(c[2]), float(c[3])
         w_wet = (m - rho * np.pi / 4.0 * d**2) * g
-        types[c[0]] = dict(d=d, m=m, EA=EA, w=w_wet)
+        types[c[0]] = dict(d=d, m=m, EA=EA, w=w_wet,
+                           Cd=float(c[6]) if len(c) > 6 else 0.0,
+                           CdAx=float(c[8]) if len(c) > 8 else 0.0)
 
     # options (water depth)
     for row in section("OPTIONS", n_header=0):
@@ -165,6 +170,7 @@ def parse_moordyn(path: str, nbodies: int, depth: float | None = None,
 
     # lines
     iA, iB, L, EA, w = [], [], [], [], []
+    d_vol, Cd_t, Cd_a = [], [], []
     for row in section("LINES"):
         c = row.split()
         lt = types[c[1]]
@@ -173,6 +179,9 @@ def parse_moordyn(path: str, nbodies: int, depth: float | None = None,
         L.append(float(c[4]))
         EA.append(lt["EA"])
         w.append(lt["w"])
+        d_vol.append(lt["d"])
+        Cd_t.append(lt["Cd"])
+        Cd_a.append(lt["CdAx"])
     iA, iB = np.array(iA), np.array(iB)
 
     # seabed contact only for lines whose lower end is a fixed anchor on
@@ -188,6 +197,7 @@ def parse_moordyn(path: str, nbodies: int, depth: float | None = None,
         free_idx=free_idx,
         iA=iA, iB=iB, L=np.array(L), EA=np.array(EA), w=np.array(w),
         contact_ok=contact_ok, g=g, rho=rho,
+        d_vol=np.array(d_vol), Cd_t=np.array(Cd_t), Cd_a=np.array(Cd_a),
     )
 
 
@@ -254,14 +264,19 @@ def _point_forces(ms: ArrayMooring, pts):
     return F
 
 
+_KBOT_POINT = 1e5   # [N/m] seabed normal-contact stiffness for free points
+
+
 def free_net_force(ms: ArrayMooring, Xb, xf):
     """Equilibrium residual of the free points: line forces + weight +
-    buoyancy, (nf,3)."""
+    buoyancy + seabed normal contact (linear penalty below z = -depth,
+    the MoorDyn kbot analog), (nf,3)."""
     pts = point_positions(ms, Xb, xf)
     F = _point_forces(ms, pts)
     Wz = (-jnp.asarray(ms.pmass) * ms.g
           + jnp.asarray(ms.pvol) * ms.rho * ms.g)
     F = F.at[:, 2].add(Wz)
+    F = F.at[:, 2].add(_KBOT_POINT * jnp.maximum(-ms.depth - pts[:, 2], 0.0))
     return F[np.where(ms.attach == ATTACH_FREE)[0]]
 
 
@@ -290,6 +305,54 @@ def solve_free_points(ms: ArrayMooring, Xb, xf0=None, iters: int = 40,
 
     x, _ = jax.lax.scan(step, x0, None, length=iters)
     return x.reshape(-1, 3)
+
+
+def chord_drag(rA, rB, U, L, d, Cd_t, Cd_a, rho):
+    """Per-line uniform-current drag on the straight chord rA->rB, (nl,3):
+    transverse 0.5 rho Cd_t d |Un| Un plus tangential
+    0.5 rho Cd_a (pi d) |Ut| Ut per unit length over the unstretched
+    length.  Shared by the single-body and array mooring paths."""
+    U = jnp.asarray(U, float)
+    chord = jnp.asarray(rB) - jnp.asarray(rA)
+    t = chord / jnp.linalg.norm(chord, axis=1, keepdims=True)
+    Ut = jnp.sum(U[None, :] * t, axis=1, keepdims=True) * t
+    Un = U[None, :] - Ut
+    return (0.5 * rho * jnp.asarray(L) * jnp.asarray(d))[:, None] * (
+        jnp.asarray(Cd_t)[:, None]
+        * jnp.linalg.norm(Un, axis=1, keepdims=True) * Un
+        + np.pi * jnp.asarray(Cd_a)[:, None]
+        * jnp.linalg.norm(Ut, axis=1, keepdims=True) * Ut)
+
+
+def current_wrenches(ms: ArrayMooring, Xb, xf, U):
+    """Uniform-current drag on the mooring lines, lumped to the attached
+    bodies, (nb,6).
+
+    Quasi-static approximation of MoorPy's currentMod=1 (the reference
+    passes case currents to MoorPy at raft_model.py:559-578): drag is
+    evaluated on each line's straight CHORD direction — transverse
+    0.5 rho Cd_t d |Un| Un and tangential 0.5 rho Cd_a (pi d) |Ut| Ut per
+    unit length over the unstretched length — and half of each line's
+    total is lumped to each endpoint.  Free/fixed endpoints shed their
+    share to the seabed/junction, body endpoints load the body."""
+    if ms.Cd_t is None:
+        return jnp.zeros((ms.nbodies, 6))
+    Xb = jnp.asarray(Xb, float)
+    pts = point_positions(ms, Xb, xf)
+    rA = pts[jnp.asarray(ms.iA)]
+    rB = pts[jnp.asarray(ms.iB)]
+    F_line = chord_drag(rA, rB, U, ms.L, ms.d_vol, ms.Cd_t, ms.Cd_a, ms.rho)
+    Fp = jnp.zeros_like(pts)
+    Fp = Fp.at[jnp.asarray(ms.iA)].add(0.5 * F_line)
+    Fp = Fp.at[jnp.asarray(ms.iB)].add(0.5 * F_line)
+    attach = jnp.asarray(ms.attach)
+
+    def wrench(b):
+        mask = (attach == b).astype(float)[:, None]
+        offs = pts - Xb[b, :3]
+        return jnp.sum(translate_force_3to6(Fp * mask, offs), axis=0)
+
+    return jnp.stack([wrench(b) for b in range(ms.nbodies)])
 
 
 def body_wrenches(ms: ArrayMooring, Xb, xf):

@@ -28,12 +28,10 @@ Physics deviations from the reference:
   ops/waves.py and inert at beta=0, the only heading the reference's QTF
   examples exercise;
 - the Kim & Yue second-order diffraction correction for MCF members
-  (reference: raft_fowt.py:1636 -> raft_member.py:1090-1205) is NOT yet
-  implemented; calc_qtf_slender_body warns when a member requests MCF.
+  (reference: raft_fowt.py:1636 -> raft_member.py:1090-1205) is applied
+  on the dense pair grid via `kim_yue_correction`.
 """
 from __future__ import annotations
-
-import warnings
 from dataclasses import dataclass
 
 import numpy as np
@@ -118,6 +116,168 @@ def write_qtf_12d(path: str, qtf, w, heads_rad, rho: float = 1025.0,
 
 
 # --------------------------------------------------------------------------
+# Kim & Yue analytical 2nd-order diffraction correction
+# (reference: raft_member.py:1090-1205, applied at raft_fowt.py:1636)
+# --------------------------------------------------------------------------
+
+def kim_yue_correction(fowt, pose, beta, Nm: int = 10):
+    """Sum of the Kim & Yue (1989/1990) bottom-mounted-cylinder
+    difference-frequency corrections over the MCF-flagged surface-piercing
+    members, on the dense (i1,i2) QTF pair grid.  Returns (nw2,nw2,6)
+    complex (zero when no member is flagged).
+
+    Faithful to the reference, including its quirks: the real part only is
+    kept (diffraction share, avoiding double counting with the Rainey
+    terms, :1148/:1196), the segment phase uses the waterline intersection
+    point rwl (:1199 — not the segment midpoint), end nodes reuse ds as the
+    radius (:1173-1179), and the whole force is conjugated where k1 < k2
+    (:1202-1203)."""
+    from raft_tpu.ops.special import hankel1p_all
+
+    w2 = np.asarray(fowt.w1_2nd)
+    k2g = np.asarray(fowt.k1_2nd)
+    nw2 = len(w2)
+    h = fowt.depth
+    rho, g = fowt.rho_water, fowt.g
+
+    members = [(im, m) for im, m in enumerate(fowt.members)
+               if getattr(m, "MCF", False)
+               and float(m.rA0[2]) * float(m.rB0[2]) < 0]
+    if not members:
+        return jnp.zeros((nw2, nw2, 6), dtype=complex)
+
+    k1 = jnp.asarray(k2g)[:, None]     # (nw2,1) broadcast over pairs
+    k2 = jnp.asarray(k2g)[None, :]
+    w1 = jnp.asarray(w2)[:, None]
+    wv2 = jnp.asarray(w2)[None, :]
+    cosB, sinB = np.cos(beta), np.sin(beta)
+    rPRP = pose["r6"][:3]
+
+    def _recip(z):
+        """1/z with overflow-safe zero for huge |z| (high-order Hankel
+        magnitudes saturate the dtype; the physical limit of 1/(H'H') is
+        exactly 0 there)."""
+        r = 1.0 / z
+        ok = jnp.isfinite(jnp.real(r)) & jnp.isfinite(jnp.imag(r))
+        return jnp.where(ok, r, 0.0)
+
+    def omega_sum(Hp, weights):
+        """sum_n weights_n * Omega_n where Omega_n = 1/(Hp_{n+1} conj(Hp_n))
+        - 1/(Hp_n conj(Hp_{n+1})) on the (nw2, nw2) pair grid; Hp is the
+        (Nm+2, nw2) derivative table on the k grid, weights a per-n list of
+        grids or a scalar (reference: raft_member.py:1102-1109)."""
+        tot = 0.0
+        for n in range(Nm + 1):
+            a1 = Hp[n + 1][:, None] * jnp.conj(Hp[n][None, :])
+            a2 = Hp[n][:, None] * jnp.conj(Hp[n + 1][None, :])
+            wn = weights[n] if isinstance(weights, (list, tuple)) else weights
+            tot = tot + wn * (_recip(a1) - _recip(a2))
+        return tot
+
+    def sinh_over_coshcosh(a, b, c):
+        """sinh(a) / (cosh(b) cosh(c)), overflow-stable for |a| <= b + c
+        (same exp-ratio algebra as ops/waves.py's depth ratios)."""
+        num = jnp.exp(a - b - c) - jnp.exp(-a - b - c)
+        den = (1.0 + jnp.exp(-2.0 * b)) * (1.0 + jnp.exp(-2.0 * c))
+        return 2.0 * num / den
+
+    def inv_coshcosh(b, c):
+        return 4.0 * jnp.exp(-(b + c)) / (
+            (1.0 + jnp.exp(-2.0 * b)) * (1.0 + jnp.exp(-2.0 * c)))
+
+    # Hankel derivative tables cached by radius (uniform columns share one)
+    _hp_cache: dict = {}
+
+    def hp_table(R):
+        key = round(float(R), 12)
+        if key not in _hp_cache:
+            _hp_cache[key] = hankel1p_all(jnp.asarray(k2g) * R, Nm + 1)
+        return _hp_cache[key]
+
+    F = jnp.zeros((nw2, nw2, 6), dtype=complex)
+    for im, m in members:
+        mpose = pose["members"][im]
+        rA = np.asarray(mpose["rA"])
+        rB = np.asarray(mpose["rB"])
+        rm = np.asarray(mpose["r"])
+        p1 = np.asarray(mpose["p1"])
+        p2 = np.asarray(mpose["p2"])
+        ds = np.asarray(m.ds)
+        dls = np.asarray(m.dls)
+
+        # wave-aligned transverse force direction (:1128-1131)
+        bvec = np.array([cosB, sinB, 0.0])
+        pf = np.dot(bvec, p1) * p1 + np.dot(bvec, p2) * p2
+        pf = pf / np.linalg.norm(pf)
+        pf = jnp.asarray(pf)
+
+        # waterline intersection and radius (:1136-1139)
+        rwl = rA + (rB - rA) * (0.0 - rA[2]) / (rB[2] - rA[2])
+        order = np.argsort(rm[:, 2])
+        Rwl = float(np.interp(0.0, rm[order, 2], 0.5 * ds[order]))
+        phase = jnp.exp(-1j * ((k1 - k2) * (cosB * rwl[0] + sinB * rwl[1])))
+
+        # ---- waterline relative-elevation term (:1134-1149) ----
+        k1R, k2R = k1 * Rwl, k2 * Rwl
+        Fwl = -rho * g * Rwl * 2j / jnp.pi / (k1R * k2R) * omega_sum(
+            hp_table(Rwl), 1.0)
+        Fwl = jnp.real(Fwl) * phase                           # (nw2,nw2)
+        off_wl = jnp.asarray(rwl) - rPRP
+        F = F + Fwl[:, :, None] * jnp.concatenate(
+            [pf, jnp.cross(off_wl, pf)])[None, None, :]
+
+        # ---- Bernoulli quadratic-velocity depth integral (:1155-1200) ----
+        for il in range(len(rm) - 1):
+            z1 = float(rm[il, 2])
+            if z1 > 0:
+                continue
+            z2 = min(float(rm[il + 1, 2]), 0.0)
+            R1 = ds[il] / 2.0 if dls[il] != 0 else ds[il]
+            R2 = ds[il + 1] / 2.0 if dls[il + 1] != 0 else ds[il]
+            R = 0.5 * (R1 + R2)
+            k1R, k2R = k1 * R, k2 * R
+
+            diag = (w1 == wv2)
+            kp = k1 + k2
+            km_safe = jnp.where(diag, 1.0, k1 - k2)
+            k1h, k2h = k1R * (h / R), k2R * (h / R)
+            # Im/Ip pre-divided by cosh(k1h)cosh(k2h) with the
+            # overflow-stable exp-ratio algebra (the raw sinh/cosh of the
+            # reference overflow for (k1+k2)h beyond the dtype range)
+            icc = inv_coshcosh(k1h, k2h)
+            sp2 = sinh_over_coshcosh(kp * (z2 + h), k1h, k2h) / (k1h + k2h)
+            sp1 = sinh_over_coshcosh(kp * (z1 + h), k1h, k2h) / (k1h + k2h)
+            sm2 = jnp.where(
+                diag, (z2 + h) / h * icc,
+                sinh_over_coshcosh(km_safe * (z2 + h), k1h, k2h)
+                / jnp.where(diag, 1.0, k1h - k2h))
+            sm1 = jnp.where(
+                diag, (z1 + h) / h * icc,
+                sinh_over_coshcosh(km_safe * (z1 + h), k1h, k2h)
+                / jnp.where(diag, 1.0, k1h - k2h))
+            Im_cc = 0.5 * (sp2 - sm2 - sp1 + sm1)
+            Ip_cc = 0.5 * (sp2 + sm2 - sp1 - sm1)
+
+            t1 = jnp.sqrt(k1h * jnp.tanh(k1h))
+            t2 = jnp.sqrt(k2h * jnp.tanh(k2h))
+            pref = k1h * k2h / t1 / t2
+            weights = [pref * (Im_cc + Ip_cc * n * (n + 1) / k1R / k2R)
+                       for n in range(Nm + 1)]
+            dF = (rho * g * R * 2j / jnp.pi / (k1R * k2R)
+                  * omega_sum(hp_table(R), weights))
+            rmid = 0.5 * (rm[il] + rm[il + 1])
+            dF = jnp.real(dF) * phase
+            off = jnp.asarray(rmid) - rPRP
+            F = F + dF[:, :, None] * jnp.concatenate(
+                [pf, jnp.cross(off, pf)])[None, None, :]
+
+    # conjugate where k1 < k2 (:1202-1203)
+    conj_mask = (k1 < k2)
+    F = jnp.where(conj_mask[:, :, None], jnp.conj(F), F)
+    return F
+
+
+# --------------------------------------------------------------------------
 # slender-body QTF  (reference: raft_fowt.py:1385-1648)
 # --------------------------------------------------------------------------
 
@@ -133,13 +293,6 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None):
     Xi0 : (6, nw) motion RAOs on the MODEL grid, or None for a fixed body
     M_struc : (6,6) structural mass matrix for the Pinkster-IV term
     """
-    if any(getattr(m, "MCF", False) for m in fowt.members):
-        warnings.warn(
-            "QTF computed WITHOUT the Kim & Yue MCF correction "
-            "(reference: raft_fowt.py:1636) — not yet implemented; "
-            "second-order loads on MCF members will deviate from the "
-            "reference", stacklevel=2)
-
     w2 = jnp.asarray(fowt.w1_2nd)
     k2 = jnp.asarray(fowt.k1_2nd)
     nw2 = len(fowt.w1_2nd)
@@ -370,6 +523,10 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None):
         return F_rotN + F_side + F_eta
 
     Q = jax.vmap(jax.vmap(pair, in_axes=(None, 0)), in_axes=(0, None))(idx, idx)
+
+    # Kim & Yue analytical 2nd-order diffraction correction for MCF
+    # members (reference: raft_fowt.py:1636 -> raft_member.py:1090-1205)
+    Q = Q + kim_yue_correction(fowt, pose, beta)
 
     # keep only the upper triangle (w2 >= w1), then Hermitian-complete
     # (reference :1638-1640)

@@ -95,3 +95,154 @@ def struve_bessel_diff_m2(x):
     out = (struve_bessel_diff_0(x) - (2.0 / x_safe) * struve_bessel_diff_1(x)
            - 2.0 / (jnp.pi * x_safe))
     return jnp.where(x == 0.0, 0.0, out)
+
+
+# --------------------------------------------------------------------------
+# Bessel Y / Hankel functions (MacCamy-Fuchs + Kim&Yue kernels; the
+# reference calls scipy.special.hankel1, raft_member.py:1070-1073, 1102-1109)
+# --------------------------------------------------------------------------
+# J0/J1/Y0/Y1 use the Abramowitz & Stegun 9.4 rational/amplitude-phase
+# approximations (|eps| < ~1.6e-8 — ample for the MCF/K&Y physics); higher
+# orders: J_n from jax.scipy.special.bessel_jn (stable downward recurrence,
+# machine precision), Y_n by upward recurrence (stable for Y).
+
+def _poly(t, coeffs):
+    out = jnp.zeros_like(t) + coeffs[0]
+    for c in coeffs[1:]:
+        out = out * t + c
+    return out
+
+
+def bessel_j0(x):
+    x = jnp.abs(jnp.asarray(x, float))
+    t = (x / 3.0) ** 2
+    small = _poly(t, [0.0002100, -0.0039444, 0.0444479, -0.3163866,
+                      1.2656208, -2.2499997, 1.0])
+    z = 3.0 / jnp.where(x > 3.0, x, 3.0)
+    f0 = _poly(z, [0.00014476, -0.00072805, 0.00137237, -0.00009512,
+                   -0.00552740, -0.00000077, 0.79788456])
+    th0 = x + _poly(z, [0.00013558, -0.00029333, -0.00054125, 0.00262573,
+                        -0.00003954, -0.04166397, -0.78539816])
+    big = f0 * jnp.cos(th0) / jnp.sqrt(jnp.where(x > 0, x, 1.0))
+    return jnp.where(x <= 3.0, small, big)
+
+
+def bessel_j1(x):
+    x = jnp.asarray(x, float)
+    ax = jnp.abs(x)
+    t = (ax / 3.0) ** 2
+    small = ax * _poly(t, [0.00001109, -0.00031761, 0.00443319, -0.03954289,
+                           0.21093573, -0.56249985, 0.5])
+    z = 3.0 / jnp.where(ax > 3.0, ax, 3.0)
+    f1 = _poly(z, [-0.00020033, 0.00113653, -0.00249511, 0.00017105,
+                   0.01659667, 0.00000156, 0.79788456])
+    th1 = ax + _poly(z, [-0.00029166, 0.00079824, 0.00074348, -0.00637879,
+                         0.00005650, 0.12499612, -2.35619449])
+    big = f1 * jnp.cos(th1) / jnp.sqrt(jnp.where(ax > 0, ax, 1.0))
+    return jnp.sign(x) * jnp.where(ax <= 3.0, small, big)
+
+
+def bessel_y0(x):
+    """Y_0(x), x > 0 (A&S 9.4.2/9.4.3)."""
+    x = jnp.asarray(x, float)
+    x_safe = jnp.where(x > 0, x, 1.0)
+    t = (x / 3.0) ** 2
+    small = (2.0 / jnp.pi) * jnp.log(0.5 * x_safe) * bessel_j0(x) + _poly(
+        t, [-0.00024846, 0.00427916, -0.04261214, 0.25300117,
+            -0.74350384, 0.60559366, 0.36746691])
+    z = 3.0 / jnp.where(x > 3.0, x, 3.0)
+    f0 = _poly(z, [0.00014476, -0.00072805, 0.00137237, -0.00009512,
+                   -0.00552740, -0.00000077, 0.79788456])
+    th0 = x + _poly(z, [0.00013558, -0.00029333, -0.00054125, 0.00262573,
+                        -0.00003954, -0.04166397, -0.78539816])
+    big = f0 * jnp.sin(th0) / jnp.sqrt(x_safe)
+    return jnp.where(x <= 3.0, small, big)
+
+
+def bessel_y1(x):
+    """Y_1(x), x > 0 (A&S 9.4.5/9.4.6)."""
+    x = jnp.asarray(x, float)
+    x_safe = jnp.where(x > 0, x, 1.0)
+    t = (x / 3.0) ** 2
+    small = ((2.0 / jnp.pi) * x * jnp.log(0.5 * x_safe) * bessel_j1(x)
+             + _poly(t, [0.0027873, -0.0400976, 0.3123951, -1.3164827,
+                         2.1682709, 0.2212091, -0.6366198])) / x_safe
+    z = 3.0 / jnp.where(x > 3.0, x, 3.0)
+    f1 = _poly(z, [-0.00020033, 0.00113653, -0.00249511, 0.00017105,
+                   0.01659667, 0.00000156, 0.79788456])
+    th1 = x + _poly(z, [-0.00029166, 0.00079824, 0.00074348, -0.00637879,
+                        0.00005650, 0.12499612, -2.35619449])
+    big = f1 * jnp.sin(th1) / jnp.sqrt(x_safe)
+    return jnp.where(x <= 3.0, small, big)
+
+
+def _bessel_jn_miller(x, nmax: int):
+    """J_n(x) for n = 0..nmax by Miller's normalized downward recurrence —
+    overflow-safe in f32 (jax.scipy.special.bessel_jn NaNs without x64,
+    which is exactly the TPU throughput mode bench.py runs in).  Accuracy
+    is set by the A&S j0/j1 normalization (~1e-7)."""
+    x = jnp.asarray(x, float)
+    x_safe = jnp.where(x > 0, x, 1.0)
+    start = nmax + 26          # > x + ~15 for the x <= ~15 range used here
+    big = 1e18
+    b_np1 = jnp.zeros_like(x_safe)
+    b_n = jnp.full_like(x_safe, 1e-25)
+    rows = {}
+    for n in range(start, -1, -1):
+        if n <= nmax:
+            rows[n] = b_n
+        b_nm1 = (2.0 * n / x_safe) * b_n - b_np1
+        b_np1, b_n = b_n, b_nm1
+        # renormalize before f32 overflow; rescales all collected rows too
+        scale = jnp.where(jnp.abs(b_n) > big, 1.0 / big, 1.0)
+        b_n = b_n * scale
+        b_np1 = b_np1 * scale
+        rows = {k: v * scale for k, v in rows.items()}
+    b0 = rows[0]
+    b1 = rows[1] if nmax >= 1 else b0
+    j0, j1 = bessel_j0(x), bessel_j1(x)
+    # normalize against whichever of J0/J1 is away from a zero
+    use0 = jnp.abs(j0) > 0.05
+    denom = jnp.where(use0, b0, jnp.where(jnp.abs(b1) > 0, b1, 1.0))
+    ratio = jnp.where(use0, j0, j1) / jnp.where(denom == 0, 1.0, denom)
+    return jnp.stack([rows[n] * ratio for n in range(nmax + 1)])
+
+
+def hankel1_all(x, nmax: int):
+    """H^(1)_n(x) = J_n(x) + i Y_n(x) for n = 0..nmax, x > 0 real.
+
+    Returns (nmax+1, ...) complex.  J_n via jax.scipy.special.bessel_jn
+    under x64 (machine precision) or the f32-safe Miller recurrence
+    otherwise; Y_n by the (stable upward) recurrence
+    Y_{n+1} = (2n/x) Y_n - Y_{n-1}.
+    """
+    import jax
+
+    x = jnp.asarray(x, float)
+    flat = x.reshape(-1)
+    if jax.config.jax_enable_x64:
+        from jax.scipy.special import bessel_jn
+        J = bessel_jn(flat, v=nmax)                 # (nmax+1, nx)
+    else:
+        J = _bessel_jn_miller(flat, nmax)
+    x_safe = jnp.where(flat > 0, flat, 1.0)
+    # clamp the (rapidly growing) Y magnitudes below the dtype overflow so
+    # downstream differences/products stay NaN-free; consumers treat huge
+    # |H| via guarded reciprocals (1/|H| -> 0), which is the correct limit
+    cap = 1e300 if jax.config.jax_enable_x64 else 1e18
+    Ys = [bessel_y0(flat), bessel_y1(flat)]
+    for n in range(1, nmax):
+        Ys.append(jnp.clip((2.0 * n / x_safe) * Ys[n] - Ys[n - 1],
+                           -cap, cap))
+    Y = jnp.stack(Ys[:nmax + 1])
+    H = (J + 1j * Y).reshape((nmax + 1,) + x.shape)
+    return H
+
+
+def hankel1p_all(x, nmax: int):
+    """Derivatives H^(1)'_n(x) for n = 0..nmax: 0.5 (H_{n-1} - H_{n+1}),
+    with H_{-1} = -H_1 (so H'_0 = -H_1)."""
+    H = hankel1_all(x, nmax + 1)              # orders 0 .. nmax+1
+    lower = jnp.concatenate([-H[1][None], H[:nmax]])   # H_{n-1}, n=0..nmax
+    upper = H[1:nmax + 2]                              # H_{n+1}, n=0..nmax
+    return 0.5 * (lower - upper)

@@ -1,0 +1,420 @@
+"""Design-variant sweep axis: geometry as batched, traced leaves.
+
+The reference's design-study workload mutates the design dict and reruns
+the whole serial pipeline per variant (reference: raft/parametersweep.py:
+56-100 — 3^5 = 243 VolturnUS-S geometry variants through runRAFT each,
+incl. ballast trim; the north star scales this to 10k variants).  Here a
+variant is a pytree of arrays θ (member end positions, diameter scales,
+ballast, mooring geometry) and the whole per-variant pipeline —
+
+    geometry rebuild -> statics -> ballast density trim -> Newton
+    equilibrium (autodiff Jacobian + backtracking line search) ->
+    drag-linearization fixed point -> batched RAO solve -> stats
+
+— is one pure jnp function of θ, vmapped over the variant batch and
+sharded across the devices of a `jax.sharding.Mesh` (the ICI/DCN axis,
+SURVEY.md §2.9).
+
+Geometry under tracing: strip node COUNTS and station layout fractions are
+static (set by the base design's discretization), while lengths, node
+positions, diameters, areas and volumes are traced functions of θ.  The
+member/statics/hydro kernels already consume geometry through jnp ops, so
+a `dataclasses.replace` of the static `MemberGeometry`/`MooringSystem`/
+`NodeSet` containers with traced leaves reuses every kernel unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.models import mooring as mr
+from raft_tpu.models.fowt import (
+    FOWTModel, NodeSet, build_fowt, fowt_pose, fowt_statics,
+    fowt_hydro_constants, fowt_hydro_excitation, fowt_hydro_linearization,
+    fowt_drag_excitation, member_node_cols,
+)
+from raft_tpu.models.member import member_inertia
+from raft_tpu.ops.linalg import solve_complex
+from raft_tpu.ops.spectra import jonswap, get_rms
+
+
+# --------------------------------------------------------------------------
+# traced geometry rebuild
+# --------------------------------------------------------------------------
+
+def variant_member(m, rA0=None, rB0=None, d_scale=None,
+                   l_fill=None, rho_fill=None):
+    """Traced copy of one MemberGeometry with moved ends / scaled section.
+
+    rA0/rB0: (3,) new end positions (PRP frame); d_scale: scalar or (2,)
+    diameter (side-length) scale.  Station fractions and node counts stay
+    static; lengths/diameters and the dependent strip arrays are traced.
+    """
+    rA0 = jnp.asarray(m.rA0 if rA0 is None else rA0, float)
+    rB0 = jnp.asarray(m.rB0 if rB0 is None else rB0, float)
+    l = jnp.linalg.norm(rB0 - rA0)
+    s_l = l / m.l
+    if d_scale is None:
+        d_scale = 1.0
+    d_scale = jnp.asarray(d_scale, float)
+    if m.circular:
+        sd_node = d_scale if d_scale.ndim == 0 else d_scale[0]
+        sd_cap = sd_node
+    else:
+        sd_node = d_scale[None, :] if d_scale.ndim == 1 else d_scale
+        sd_cap = jnp.mean(d_scale)
+    # caps: diameters scale, plate thickness (dA - dAi)/2 is preserved
+    cap_t = 0.5 * (jnp.asarray(m.cap_dA) - jnp.asarray(m.cap_dAi))
+    cap_dA = jnp.asarray(m.cap_dA) * sd_cap
+    cap_dB = jnp.asarray(m.cap_dB) * sd_cap
+    return dataclasses.replace(
+        m,
+        rA0=rA0, rB0=rB0, l=l,
+        stations=jnp.asarray(m.stations) * s_l,
+        d=jnp.asarray(m.d) * sd_node,
+        ls=jnp.asarray(m.ls) * s_l,
+        dls=jnp.asarray(m.dls) * s_l,
+        ds=jnp.asarray(m.ds) * sd_node,
+        drs=jnp.asarray(m.drs) * sd_node,
+        l_fill=jnp.asarray(m.l_fill if l_fill is None else l_fill, float) * s_l,
+        rho_fill=jnp.asarray(m.rho_fill if rho_fill is None else rho_fill,
+                             float),
+        cap_L=jnp.asarray(m.cap_L) * sd_cap,
+        cap_h=jnp.asarray(m.cap_h) * s_l,
+        cap_dA=cap_dA, cap_dB=cap_dB,
+        cap_dAi=cap_dA - 2.0 * cap_t, cap_dBi=cap_dB - 2.0 * cap_t,
+    )
+
+
+def variant_fowt(base: FOWTModel, theta: dict) -> FOWTModel:
+    """Traced FOWTModel for one variant.
+
+    theta keys (all optional, indexed over base.members / mooring lines):
+      rA0, rB0     (nmem, 3)  member end positions
+      d_scale      (nmem, 2)  diameter / side-length scales
+      l_fill, rho_fill        per-member lists (ragged -> list of arrays)
+      moor_rFair0  (nl, 3), moor_rAnchor (nl, 3), moor_L (nl,)
+    """
+    nmem = len(base.members)
+
+    def get(key, i=None):
+        v = theta.get(key)
+        if v is None:
+            return None
+        return v[i] if i is not None else v
+
+    members = [
+        variant_member(
+            m,
+            rA0=get("rA0", i), rB0=get("rB0", i),
+            d_scale=None if theta.get("d_scale") is None
+            else theta["d_scale"][i, :2],
+            l_fill=None if theta.get("l_fill") is None else theta["l_fill"][i],
+            rho_fill=None if theta.get("rho_fill") is None
+            else theta["rho_fill"][i],
+        )
+        for i, m in enumerate(base.members[:nmem])
+    ]
+
+    # rebuild the stacked node arrays from the traced members; the static
+    # columns (indices, coefficients, masks) carry over from the base
+    derived = [member_node_cols(m) for m in members]
+    nd = base.nodes
+    nodes = dataclasses.replace(
+        nd, **{key: jnp.concatenate([d[key] for d in derived])
+               for key in ("frac", "dls", "a_i_q", "a_i_p1", "a_i_p2",
+                           "a_i_end_drag", "v_side", "v_end", "a_i", "R")})
+
+    moor = base.mooring
+    if moor is not None and any(k in theta for k in
+                                ("moor_rFair0", "moor_rAnchor", "moor_L")):
+        moor = dataclasses.replace(
+            moor,
+            rFair0=jnp.asarray(theta.get("moor_rFair0", moor.rFair0), float),
+            rAnchor=jnp.asarray(theta.get("moor_rAnchor", moor.rAnchor), float),
+            L=jnp.asarray(theta.get("moor_L", moor.L), float),
+        )
+
+    return dataclasses.replace(base, members=members, nodes=nodes,
+                               mooring=moor)
+
+
+# --------------------------------------------------------------------------
+# in-jit statics: exact-Jacobian Newton with backtracking line search
+# --------------------------------------------------------------------------
+
+_DB = jnp.array([30.0, 30.0, 5.0, 0.1, 0.1, 0.1])
+_ALPHAS = jnp.array([1.0, 0.5, 0.25, 0.125, 0.0625])
+
+
+def statics_newton(net_force, X0, iters: int = 20):
+    """Damped Newton equilibrium with exact forward-mode Jacobian and a
+    backtracking line search on |F|^2 — the principled in-jit replacement
+    for the reference's clip-step loop with diagonal-boost fallbacks
+    (reference: raft_model.py:677-767; SURVEY §7 'Hard parts' statics
+    robustness).  Shape-stable: fixed iterations, masked line search."""
+    X0 = jnp.asarray(X0, float)
+
+    def step(X, _):
+        F = net_force(X)
+        J = -jax.jacfwd(net_force)(X)
+        J = J + 1e-6 * jnp.eye(6)
+        dX = jnp.clip(jnp.linalg.solve(J, F), -_DB, _DB)
+        cands = X[None, :] + _ALPHAS[:, None] * dX[None, :]
+        merit = jax.vmap(lambda x: jnp.sum(net_force(x) ** 2))(cands)
+        merit = jnp.where(jnp.isfinite(merit), merit, jnp.inf)
+        best = jnp.argmin(merit)
+        # accept the best candidate only if it improves on X itself
+        X_new = jnp.where(merit[best] < jnp.sum(F**2), cands[best], X)
+        return X_new, None
+
+    X, _ = jax.lax.scan(step, X0, None, length=iters)
+    return X
+
+
+# --------------------------------------------------------------------------
+# per-variant pipeline
+# --------------------------------------------------------------------------
+
+def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
+                        F_env=None, A_turb=None, B_turb=None,
+                        ballast: bool = True, nIter: int = 10,
+                        tol: float = 0.01, XiStart: float = 0.1,
+                        newton_iters: int = 20):
+    """Build the pure per-variant function θ -> outputs.
+
+    F_env: constant environmental force (aero mean thrust + current drag),
+    computed once from the base design per load case (rotor geometry does
+    not vary across these sweeps; reference evaluates calcTurbineConstants
+    at the zero-offset pose, raft_model.py:527-556).  A_turb/B_turb:
+    (6,6,nw) aero added mass/damping for the dynamics stage.
+
+    Outputs (per variant): mass, displacement, GMT, offset, pitch_deg (the
+    parametersweep.py:9-21 metrics) plus Xi (6,nw) and std (6,).
+    """
+    w = jnp.asarray(base.w)
+    nw = len(base.w)
+    dw = float(base.w[1] - base.w[0])
+    F_env = jnp.zeros(6) if F_env is None else jnp.asarray(F_env)
+    A_t = jnp.zeros((6, 6, nw)) if A_turb is None else jnp.asarray(A_turb)
+    B_t = jnp.zeros((6, 6, nw)) if B_turb is None else jnp.asarray(B_turb)
+    g = base.g
+    rho = base.rho_water
+
+    def solve(theta):
+        fowt = variant_fowt(base, theta)
+        ref = jnp.zeros(6)
+        pose0 = fowt_pose(fowt, ref)
+        stat = fowt_statics(fowt, pose0)
+
+        # ----- ballast density trim, closed form in-jit (reference:
+        #       raft_model.py:1569-1624 run per sweep point via
+        #       runRAFT(design, ballast=True), parametersweep.py:93) -----
+        if ballast:
+            # free-flooding sections (rho_fill == 0) are excluded: their
+            # fill level is zeroed before the trim, exactly like
+            # Model.adjustBallastDensity (reference raft_model.py:1576-1583)
+            l_fill = [jnp.where(jnp.atleast_1d(m.rho_fill) == 0.0, 0.0,
+                                jnp.atleast_1d(m.l_fill))
+                      for m in fowt.members]
+            stat = fowt_statics(fowt, pose0, l_fill=l_fill)
+            Fz_moor = (mr.body_wrench(fowt.mooring, ref)[2]
+                       if fowt.mooring is not None else 0.0)
+            sumFz = (-stat["M_struc"][0, 0] * g + stat["V"] * rho * g
+                     + Fz_moor)
+            vb = 0.0
+            for i, m in enumerate(fowt.members):
+                inert = member_inertia(m, pose0["members"][i], rPRP=ref[:3],
+                                       l_fill=l_fill[i])
+                vb = vb + jnp.sum(inert["vfill"])
+            delta = jnp.where(vb > 0.0, sumFz / g / jnp.where(vb > 0, vb, 1.0),
+                              0.0)
+            rho_fill = [jnp.where(lf > 0.0, jnp.atleast_1d(m.rho_fill) + delta,
+                                  jnp.atleast_1d(m.rho_fill))
+                        for m, lf in zip(fowt.members, l_fill)]
+            stat = fowt_statics(fowt, pose0, l_fill=l_fill,
+                                rho_fill=rho_fill)
+        else:
+            rho_fill = None
+
+        K_hs = stat["C_struc"] + stat["C_hydro"]
+        F0 = stat["W_struc"] + stat["W_hydro"] + F_env
+
+        def net_force(X):
+            F = F0 - K_hs @ X
+            if fowt.mooring is not None:
+                F = F + mr.body_wrench(fowt.mooring, X)
+            return F
+
+        Xeq = statics_newton(net_force, ref, iters=newton_iters)
+
+        # ----- dynamics: drag fixed point + batched RAO solve -----
+        hc = fowt_hydro_constants(fowt, pose0)
+        C_moor = (mr.coupled_stiffness(fowt.mooring, Xeq)
+                  if fowt.mooring is not None else jnp.zeros((6, 6)))
+        pose_eq = fowt_pose(fowt, Xeq)
+
+        S = jonswap(w, Hs, Tp)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        seastate = dict(beta=jnp.asarray(beta)[None], zeta=zeta[None])
+        exc = fowt_hydro_excitation(fowt, pose_eq, seastate, hc)
+        u0 = exc["u"][0]
+
+        M_lin = (stat["M_struc"] + hc["A_hydro_morison"])[:, :, None] + A_t
+        C_lin = stat["C_struc"] + stat["C_hydro"] + C_moor
+        F_lin = exc["F_hydro_iner"][0]
+
+        def body(carry):
+            XiLast, Xi, ii, done = carry
+            B_drag6, Bmat = fowt_hydro_linearization(fowt, pose_eq, XiLast, u0)
+            F_drag = fowt_drag_excitation(fowt, pose_eq, Bmat, u0)
+            Z = (-w[None, None, :] ** 2 * M_lin
+                 + 1j * w[None, None, :] * (B_t + B_drag6[:, :, None])
+                 + C_lin[:, :, None]).astype(complex)
+            Xin = solve_complex(jnp.moveaxis(Z, -1, 0),
+                                jnp.moveaxis(F_lin + F_drag, -1, 0))
+            Xin = jnp.moveaxis(Xin, 0, -1)
+            conv = jnp.all(jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol) < tol)
+            XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xin)
+            return (XiNext, Xin, ii + 1, done | conv)
+
+        def cond(carry):
+            _, _, ii, done = carry
+            return (ii < nIter + 1) & (~done)
+
+        Xi0 = jnp.zeros((6, nw), dtype=complex) + XiStart
+        _, Xi, _, _ = jax.lax.while_loop(cond, body, (Xi0, Xi0, 0, False))
+
+        std = jax.vmap(get_rms)(Xi)
+        return dict(
+            mass=stat["M_struc"][0, 0],
+            displacement=stat["V"] * rho,
+            GMT=stat["rM"][2] - stat["rCG"][2],
+            offset=jnp.hypot(Xeq[0], Xeq[1]),
+            pitch_deg=jnp.rad2deg(Xeq[4]),
+            Xeq=Xeq, Xi=Xi, std=std,
+        )
+
+    return solve
+
+
+def sweep_variants(base: FOWTModel, thetas: dict, mesh: Optional[Mesh] = None,
+                   axis_name: str = "designs", **kw):
+    """vmap the per-variant pipeline over a θ batch, sharding the variant
+    axis over ``mesh`` (the reference's serial parametersweep loop
+    collapsed onto the device mesh)."""
+    solver = make_variant_solver(base, **kw)
+    batched = jax.jit(jax.vmap(solver))
+    thetas = {k: jnp.asarray(v) if not isinstance(v, list) else
+              [jnp.asarray(x) for x in v] for k, v in thetas.items()}
+    nv = len(jax.tree.leaves(thetas)[0])
+    if mesh is not None:
+        ndev = int(np.prod(list(mesh.shape.values())))
+        # pad the variant axis to a device multiple (repeat the last row)
+        npad = (-nv) % ndev
+        if npad:
+            thetas = jax.tree.map(
+                lambda x: jnp.concatenate(
+                    [x, jnp.repeat(x[-1:], npad, axis=0)]), thetas)
+        sh = NamedSharding(mesh, P(axis_name))
+        thetas = jax.tree.map(lambda x: jax.device_put(x, sh), thetas)
+    out = batched(thetas)
+    return jax.tree.map(lambda x: x[:nv], out)
+
+
+# --------------------------------------------------------------------------
+# the reference 3^5 VolturnUS-S grid as a θ batch
+# --------------------------------------------------------------------------
+
+def volturn_grid(design: dict, factors=(0.75, 1.0, 1.25)):
+    """Reproduce the reference parametersweep grid (parametersweep.py:
+    33-100): center-column diameter, outer-column diameter, draft,
+    outer-column radius, pontoon height — with the dependent pontoon-end
+    and mooring-fairlead updates — as a θ batch over the base FOWTModel's
+    member list (12 members after heading expansion: 1 center column,
+    3 outer columns, 3 lower + 3 upper pontoons expanded from 2 entries...
+    built from the actual platform member table, so index bookkeeping
+    follows the design dict).
+
+    Returns (thetas, meta) where meta carries the grid shape and axes.
+    """
+    plat = design["platform"]["members"]
+    ccD0 = float(np.atleast_1d(plat[0]["d"])[0])
+    ocD0 = float(np.atleast_1d(plat[1]["d"])[0])
+    T0 = float(plat[0]["rA"][2])
+    ocR0 = float(plat[1]["rA"][0])
+    pH0 = float(np.atleast_1d(plat[2]["d"])[1]) if np.ndim(plat[2]["d"]) \
+        else float(plat[2]["d"])
+
+    f = np.asarray(factors, float)
+    ccDs, ocDs, Ts, ocRs, pHs = (ccD0 * f, ocD0 * f, T0 * f, ocR0 * f, pH0 * f)
+    grid = np.stack(np.meshgrid(ccDs, ocDs, Ts, ocRs, pHs, indexing="ij"),
+                    axis=-1).reshape(-1, 5)
+    nv = len(grid)
+
+    # the per-variant design mutations, replicated on the flattened member
+    # list (reference parametersweep.py:57-90); heading-expanded members of
+    # one entry share the same local-frame mutation
+    base = build_fowt(design, np.asarray([1.0]), depth=600.0)
+    nmem = len(base.members)
+    rA = np.tile(np.stack([np.asarray(m.rA0) for m in base.members]),
+                 (nv, 1, 1))
+    rB = np.tile(np.stack([np.asarray(m.rB0) for m in base.members]),
+                 (nv, 1, 1))
+    d_scale = np.ones((nv, nmem, 2))
+    groups = base.platmem_groups
+
+    moor = base.mooring
+    rFair = np.tile(np.asarray(moor.rFair0), (nv, 1, 1)) if moor else None
+
+    for iv, (a, b, c, d, e) in enumerate(grid):
+        sa, sb, se = a / ccD0, b / ocD0, e / pH0
+        # member entry 0: center column - diameter a, draft c
+        for i in groups[0]:
+            d_scale[iv, i, :] = sa
+            rA[iv, i, 2] = c
+        # member entry 1: outer columns - diameter b, radius d, draft c
+        for i in groups[1]:
+            ang = np.arctan2(rB[iv, i, 1], rB[iv, i, 0])
+            rA[iv, i, 0], rA[iv, i, 1] = d * np.cos(ang), d * np.sin(ang)
+            rB[iv, i, 0], rB[iv, i, 1] = d * np.cos(ang), d * np.sin(ang)
+            d_scale[iv, i, :] = sb
+            rA[iv, i, 2] = c
+        # member entry 2: lower pontoons - height e, span from center
+        # column face to outer column face, sitting on the keel at draft c
+        for i in groups[2]:
+            ang = np.arctan2(rB[iv, i, 1], rB[iv, i, 0])
+            d_scale[iv, i, 1] = se   # height is the second side length
+            # inner end follows the center-column face (parametersweep:58-59)
+            rA[iv, i, :2] = np.array([np.cos(ang), np.sin(ang)]) \
+                * np.hypot(*np.asarray(base.members[i].rA0)[:2]) * sa
+            rB[iv, i, :2] = np.array([np.cos(ang), np.sin(ang)]) * (d - b / 2)
+            rA[iv, i, 2] = c + e / 2
+            rB[iv, i, 2] = c + e / 2
+        if len(groups) > 3:
+            # member entry 3: upper pontoons / struts - follow the columns
+            for i in groups[3]:
+                ang = np.arctan2(rB[iv, i, 1], rB[iv, i, 0])
+                rA[iv, i, :2] = np.array([np.cos(ang), np.sin(ang)]) \
+                    * np.hypot(*np.asarray(base.members[i].rA0)[:2]) * sa
+                rB[iv, i, :2] = np.array([np.cos(ang), np.sin(ang)]) \
+                    * (d - b / 2)
+        # mooring fairleads follow the outer-column outer face
+        # (parametersweep.py:66-71, 82-87)
+        if rFair is not None:
+            for il in range(rFair.shape[1]):
+                ang = np.arctan2(rFair[iv, il, 1], rFair[iv, il, 0])
+                rFair[iv, il, 0] = (d + b / 2) * np.cos(ang)
+                rFair[iv, il, 1] = (d + b / 2) * np.sin(ang)
+
+    thetas = dict(rA0=rA, rB0=rB, d_scale=d_scale)
+    if rFair is not None:
+        thetas["moor_rFair0"] = rFair
+    meta = dict(shape=(len(f),) * 5, axes=dict(ccD=ccDs, ocD=ocDs, T=Ts,
+                                               ocR=ocRs, pH=pHs), grid=grid)
+    return thetas, meta

@@ -1,0 +1,245 @@
+"""MacCamy-Fuchs + Kim & Yue (reference: raft_member.py:1053-1205,
+applied at raft_fowt.py:865-870 / :1636), validated against scipy-based
+oracles that transcribe the reference formulas directly."""
+import os
+
+import numpy as np
+import pytest
+import scipy.special as sp
+import yaml
+from numpy.testing import assert_allclose
+
+from raft_tpu.models.fowt import build_fowt, fowt_pose, fowt_hydro_constants
+from raft_tpu.ops.special import hankel1_all, hankel1p_all
+
+EXAMPLES = "/root/reference/examples"
+
+
+def test_hankel_vs_scipy():
+    x = np.array([0.02, 0.3, 1.0, 2.9, 3.1, 5.0, 9.0, 15.0])
+    H = np.asarray(hankel1_all(x, 12))
+    ref = np.stack([sp.hankel1(n, x) for n in range(13)])
+    assert np.abs((H - ref) / ref).max() < 1e-6
+    Hp = np.asarray(hankel1p_all(x, 11))
+    refp = np.stack([0.5 * (sp.hankel1(n - 1, x) - sp.hankel1(n + 1, x))
+                     for n in range(12)])
+    assert np.abs((Hp - refp) / refp).max() < 1e-6
+
+
+@pytest.fixture(scope="module")
+def oc4semi():
+    path = os.path.join(EXAMPLES, "OC4semi-RAFT_QTF.yaml")
+    if not os.path.isfile(path):
+        pytest.skip("OC4semi example not available")
+    with open(path) as f:
+        design = yaml.safe_load(f)
+    w = np.arange(0.01, 0.25, 0.01) * 2 * np.pi
+    return build_fowt(design, w, depth=float(design["site"]["water_depth"]))
+
+
+def test_mcf_imat_frequency_dependent(oc4semi):
+    fowt = oc4semi
+    assert any(m.MCF for m in fowt.members), "OC4semi flags MCF members"
+    pose = fowt_pose(fowt, np.zeros(6))
+    hc = fowt_hydro_constants(fowt, pose)
+    Imat = np.asarray(hc["Imat"])
+    assert Imat.ndim == 4 and Imat.shape[-1] == fowt.nw
+    assert np.iscomplexobj(Imat)
+    # frequency dependence only on MCF nodes
+    mcf = np.asarray(fowt.nodes.MCF)
+    act = np.asarray(hc["active"])
+    var = np.abs(Imat - Imat[..., :1]).max(axis=(1, 2, 3))
+    assert var[mcf & act].max() > 0.0
+    assert var[~mcf].max() < 1e-9
+
+
+def test_mcf_cm_vs_scipy_oracle(oc4semi):
+    """Cm on an MCF node equals the reference getCmSides formula
+    (raft_member.py:1066-1086) evaluated with scipy."""
+    fowt = oc4semi
+    pose = fowt_pose(fowt, np.zeros(6))
+    hc = fowt_hydro_constants(fowt, pose)
+    Imat = np.asarray(hc["Imat"])
+    nd = fowt.nodes
+    r = np.asarray(pose["r"])
+    # pick a fully submerged MCF node with side volume
+    idx = np.where(np.asarray(nd.MCF) & (r[:, 2] < -1.0)
+                   & (np.asarray(nd.v_side) > 0) & np.asarray(nd.circ))[0]
+    assert len(idx) > 0
+    il = int(idx[0])
+    R = float(np.asarray(nd.R)[il])
+    rho = fowt.rho_water
+
+    dls = np.asarray(nd.dls)
+    z = r[:, 2]
+    scale = np.where(z + 0.5 * dls > 0.0,
+                     (0.5 * dls - z) / np.where(dls == 0, 1, dls), 1.0)
+    v_side = float(np.asarray(nd.v_side)[il] * scale[il])
+
+    for iw in [2, fowt.nw // 2, fowt.nw - 1]:
+        k = float(fowt.k[iw])
+        Hp1 = 0.5 * (sp.hankel1(0, k * R) - sp.hankel1(2, k * R))
+        Cm = 4j / (np.pi * (k * R) ** 2 * Hp1)
+        Tr = np.pi / 5 / R
+        ramp = 0.5 * (1 - np.cos(np.pi * k / Tr)) if k < Tr else 1.0
+        Ca = float(np.asarray(nd.Ca_p1)[il])
+        Cm_b = Cm * ramp + (1.0 + Ca) * (1 - ramp)
+        # p1-projection of Imat at this node recovers rho*v_side*Cm
+        p1 = np.asarray(pose["p1"])[il]
+        got = p1 @ Imat[il, :, :, iw] @ p1
+        assert_allclose(got, rho * v_side * Cm_b, rtol=1e-6)
+
+
+def test_kim_yue_vs_scipy_oracle(oc4semi):
+    """kim_yue_correction matches a direct numpy/scipy transcription of
+    the reference correction_KAY (raft_member.py:1090-1205) summed over
+    the flagged members."""
+    import jax.numpy as jnp
+    from raft_tpu.models import qtf as qt
+
+    fowt = oc4semi
+    # small dedicated pair grid
+    import dataclasses
+    w2 = np.arange(0.25, 1.01, 0.25)
+    from raft_tpu.ops.waves import wave_number
+    k2 = np.asarray(wave_number(w2, fowt.depth))
+    fowt = dataclasses.replace(fowt, w1_2nd=w2, k1_2nd=k2)
+    pose = fowt_pose(fowt, np.zeros(6))
+    beta = 0.0
+    got = np.asarray(qt.kim_yue_correction(fowt, pose, beta))
+
+    want = np.zeros((len(w2), len(w2), 6), complex)
+    h, rho, g = fowt.depth, fowt.rho_water, fowt.g
+    Nm = 10
+
+    def omega(k1R, k2R, n):
+        H_N_ii = 0.5 * (sp.hankel1(n - 1, k1R) - sp.hankel1(n + 1, k1R))
+        H_N_jj = 0.5 * np.conj(sp.hankel1(n - 1, k2R) - sp.hankel1(n + 1, k2R))
+        H_Nm1_ii = 0.5 * (sp.hankel1(n, k1R) - sp.hankel1(n + 2, k1R))
+        H_Nm1_jj = 0.5 * np.conj(sp.hankel1(n, k2R) - sp.hankel1(n + 2, k2R))
+        return 1 / (H_Nm1_ii * H_N_jj) - 1 / (H_N_ii * H_Nm1_jj)
+
+    def t3to6(f, p):
+        return np.concatenate([f, np.cross(p, f)])
+
+    for im, m in enumerate(fowt.members):
+        if not (m.MCF and float(m.rA0[2]) * float(m.rB0[2]) < 0):
+            continue
+        mp = pose["members"][im]
+        rA, rB = np.asarray(mp["rA"]), np.asarray(mp["rB"])
+        rm = np.asarray(mp["r"])
+        p1v, p2v = np.asarray(mp["p1"]), np.asarray(mp["p2"])
+        ds, dls = np.asarray(m.ds), np.asarray(m.dls)
+        bvec = np.array([1.0, 0.0, 0.0])
+        pf = bvec @ p1v * p1v + bvec @ p2v * p2v
+        pf /= np.linalg.norm(pf)
+        rwl = rA + (rB - rA) * (0 - rA[2]) / (rB[2] - rA[2])
+        order = np.argsort(rm[:, 2])
+        R = np.interp(0, rm[order, 2], 0.5 * ds[order])
+        for i1, w1 in enumerate(w2):
+            for i2, wv2 in enumerate(w2):
+                kk1, kk2 = k2[i1], k2[i2]
+                k1_k2 = np.array([kk1 - kk2, 0, 0])
+                F = np.zeros(6, complex)
+                k1R, k2R = kk1 * R, kk2 * R
+                Fwl = sum(-rho * g * R * 2j / np.pi / (k1R * k2R)
+                          * omega(k1R, k2R, nn) for nn in range(Nm + 1))
+                Fwl = np.real(Fwl) * np.exp(-1j * (k1_k2 @ rwl))
+                F += t3to6(Fwl * pf, rwl)
+                for il in range(len(rm) - 1):
+                    z1 = rm[il, 2]
+                    if z1 > 0:
+                        continue
+                    z2 = min(rm[il + 1, 2], 0.0)
+                    R1 = ds[il] / 2 if dls[il] != 0 else ds[il]
+                    R2s = ds[il + 1] / 2 if dls[il + 1] != 0 else ds[il]
+                    Rm = 0.5 * (R1 + R2s)
+                    kR1, kR2 = kk1 * Rm, kk2 * Rm
+                    k1h, k2h = kk1 * h, kk2 * h
+                    if w1 == wv2:
+                        Im = 0.5 * (np.sinh((kk1 + kk2) * (z2 + h)) / (k1h + k2h)
+                                    - (z2 + h) / h
+                                    - np.sinh((kk1 + kk2) * (z1 + h)) / (k1h + k2h)
+                                    + (z1 + h) / h)
+                        Ip = 0.5 * (np.sinh((kk1 + kk2) * (z2 + h)) / (k1h + k2h)
+                                    + (z2 + h) / h
+                                    - np.sinh((kk1 + kk2) * (z1 + h)) / (k1h + k2h)
+                                    - (z1 + h) / h)
+                    else:
+                        Im = 0.5 * (np.sinh((kk1 + kk2) * (z2 + h)) / (k1h + k2h)
+                                    - np.sinh((kk1 - kk2) * (z2 + h)) / (k1h - k2h)
+                                    - np.sinh((kk1 + kk2) * (z1 + h)) / (k1h + k2h)
+                                    + np.sinh((kk1 - kk2) * (z1 + h)) / (k1h - k2h))
+                        Ip = 0.5 * (np.sinh((kk1 + kk2) * (z2 + h)) / (k1h + k2h)
+                                    + np.sinh((kk1 - kk2) * (z2 + h)) / (k1h - k2h)
+                                    - np.sinh((kk1 + kk2) * (z1 + h)) / (k1h + k2h)
+                                    - np.sinh((kk1 - kk2) * (z1 + h)) / (k1h - k2h))
+                    dF = sum(rho * g * Rm * 2j / np.pi / (kR1 * kR2)
+                             * omega(kR1, kR2, nn)
+                             * (k1h * k2h
+                                / np.sqrt(k1h * np.tanh(k1h))
+                                / np.sqrt(k2h * np.tanh(k2h))
+                                * (Im + Ip * nn * (nn + 1) / kR1 / kR2)
+                                / np.cosh(k1h) / np.cosh(k2h))
+                             for nn in range(Nm + 1))
+                    rmid = 0.5 * (rm[il] + rm[il + 1])
+                    dF = np.real(dF) * np.exp(-1j * (k1_k2 @ rwl))
+                    F += t3to6(dF * pf, rmid)
+                if kk1 < kk2:
+                    F = np.conj(F)
+                want[i1, i2] += F
+
+    scale = np.abs(want).max()
+    assert scale > 0
+    assert np.abs(got - want).max() / scale < 1e-5
+
+
+def test_hankel_and_kim_yue_f32_safe():
+    """The TPU throughput mode (RAFT_TPU_X64=0) must produce finite MCF
+    and Kim&Yue values: jax.scipy.special.bessel_jn NaNs in f32, so the
+    Miller-recurrence path and the clamped-Y/guarded-reciprocal algebra
+    cover it (found by review; conftest forces x64, hence a subprocess)."""
+    import subprocess
+    import sys
+
+    code = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import raft_tpu
+assert not jax.config.jax_enable_x64
+from raft_tpu.ops.special import hankel1_all, hankel1p_all
+x = np.array([0.003, 0.05, 0.8, 3.2, 9.0], np.float32)
+H = np.asarray(hankel1_all(x, 12))
+Hp = np.asarray(hankel1p_all(x, 11))
+assert np.isfinite(H).all() and np.isfinite(Hp).all()
+import scipy.special as sp
+ref = np.stack([sp.hankel1(n, x.astype(float)) for n in range(4)])
+rel = np.abs(H[:4] - ref) / np.abs(ref)
+assert rel.max() < 1e-4, rel.max()
+
+# Kim & Yue at deep water (h=600) stays finite in f32
+import yaml, dataclasses
+from raft_tpu.models.fowt import build_fowt, fowt_pose
+from raft_tpu.models import qtf as qt
+from raft_tpu.ops.waves import wave_number
+with open('/root/reference/examples/OC4semi-RAFT_QTF.yaml') as f:
+    design = yaml.safe_load(f)
+design['site']['water_depth'] = 600.0
+w = np.arange(0.01, 0.25, 0.01) * 2 * np.pi
+fowt = build_fowt(design, w, depth=600.0)
+w2 = np.arange(0.25, 1.3, 0.25)
+fowt = dataclasses.replace(fowt, w1_2nd=w2,
+                           k1_2nd=np.asarray(wave_number(w2, 600.0)))
+Q = np.asarray(qt.kim_yue_correction(fowt, fowt_pose(fowt, np.zeros(6)), 0.0))
+assert np.isfinite(Q).all()
+assert np.abs(Q).max() > 0
+print('F32 OK')
+"""
+    env = dict(os.environ, RAFT_TPU_X64="0", JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600,
+                          cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "F32 OK" in proc.stdout

@@ -1,0 +1,141 @@
+"""General per-FOWT mooring topologies (multi-segment lines, free
+junction points, line currents) — reference gets these from MoorPy
+(raft_fowt.py:166-189; currents raft_model.py:559-578)."""
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from raft_tpu.models import mooring as mr
+
+DEPTH = 200.0
+
+LINE_TYPE = dict(name="chain", diameter=0.1334, mass_density=125.6,
+                 stiffness=7.5e8, transverse_drag=1.1, tangential_drag=0.2)
+
+
+def _simple_design(length=870.0):
+    pts, lines = [], []
+    for i, ang in enumerate(np.deg2rad([0, 120, 240])):
+        pts.append(dict(name=f"a{i}", type="fixed",
+                        location=[850 * np.cos(ang), 850 * np.sin(ang),
+                                  -DEPTH]))
+        pts.append(dict(name=f"f{i}", type="vessel",
+                        location=[58 * np.cos(ang), 58 * np.sin(ang), -14.0]))
+        lines.append(dict(name=f"l{i}", endA=f"a{i}", endB=f"f{i}",
+                          type="chain", length=length))
+    return dict(water_depth=DEPTH, points=pts, lines=lines,
+                line_types=[LINE_TYPE])
+
+
+def _general_design():
+    """Same topology but with an explicit FREE junction point splitting
+    each line into two segments (anchor->junction->fairlead)."""
+    pts, lines = [], []
+    for i, ang in enumerate(np.deg2rad([0, 120, 240])):
+        c, s = np.cos(ang), np.sin(ang)
+        pts.append(dict(name=f"a{i}", type="fixed",
+                        location=[850 * c, 850 * s, -DEPTH]))
+        pts.append(dict(name=f"j{i}", type="free", mass=2000.0,
+                        location=[400 * c, 400 * s, -150.0]))
+        pts.append(dict(name=f"f{i}", type="vessel",
+                        location=[58 * c, 58 * s, -14.0]))
+        lines.append(dict(name=f"lA{i}", endA=f"a{i}", endB=f"j{i}",
+                          type="chain", length=458.0))
+        lines.append(dict(name=f"lB{i}", endA=f"j{i}", endB=f"f{i}",
+                          type="chain", length=372.0))
+    return dict(water_depth=DEPTH, points=pts, lines=lines,
+                line_types=[LINE_TYPE])
+
+
+def test_simple_topology_builds_vectorized_system():
+    sys_ = mr.parse_mooring(_simple_design())
+    assert isinstance(sys_, mr.MooringSystem)
+    assert sys_.n_lines == 3
+
+
+def test_general_topology_no_longer_raises():
+    sys_ = mr.parse_mooring(_general_design())
+    assert not isinstance(sys_, mr.MooringSystem)
+    assert sys_.nbodies == 1
+    assert sys_.n_free == 3
+    assert sys_.n_lines == 6
+
+
+def test_general_system_equilibrium_and_stiffness():
+    sys_ = mr.parse_mooring(_general_design())
+    r6 = np.zeros(6)
+    W = np.asarray(mr.body_wrench(sys_, r6))
+    assert np.all(np.isfinite(W))
+    # symmetric layout: no net horizontal force or moment, downward pull
+    assert abs(W[0]) < 1e-3 * abs(W[2])
+    assert abs(W[1]) < 1e-3 * abs(W[2])
+    assert W[2] < 0
+    K = np.asarray(mr.coupled_stiffness(sys_, r6))
+    assert K.shape == (6, 6)
+    assert np.all(np.diag(K)[:3] > 0)
+    assert np.abs(K - K.T).max() < 2e-2 * np.abs(K).max()
+    T = np.asarray(mr.tensions(sys_, r6))
+    assert T.shape == (12,)
+    assert np.all(T > 0)
+    J = np.asarray(mr.tension_jacobian(sys_, r6))
+    assert J.shape == (12, 6)
+    # surging +x (toward line 0's anchor) slackens its fairlead segment
+    # and tightens the opposing lines
+    r6b = np.array([5.0, 0, 0, 0, 0, 0])
+    T2 = np.asarray(mr.tensions(sys_, r6b))
+    assert T2[6 + 1] < T[6 + 1]       # fairlead end of segment lB0
+    assert T2[6 + 3] > T[6 + 3]       # fairlead end of segment lB1 (120 deg)
+
+
+def test_general_matches_simple_when_junction_inline():
+    """A massless free junction splitting a line into two segments of the
+    same total length relaxes onto the single-catenary shape, so the
+    general path must reproduce the vectorized single-line system."""
+    gen = _general_design()
+    for p in gen["points"]:
+        p.pop("mass", None)
+    sys_g = mr.parse_mooring(gen)
+    sys_s = mr.parse_mooring(_simple_design(length=458.0 + 372.0))
+    r6 = np.zeros(6)
+    Wg = np.asarray(mr.body_wrench(sys_g, r6))
+    Ws = np.asarray(mr.body_wrench(sys_s, r6))
+    assert_allclose(Wg[2], Ws[2], rtol=1e-3)
+    Kg = np.asarray(mr.coupled_stiffness(sys_g, r6))
+    Ks = np.asarray(mr.coupled_stiffness(sys_s, r6))
+    assert_allclose(Kg[0, 0], Ks[0, 0], rtol=1e-2)
+
+
+def test_current_wrench_direction_and_scaling():
+    sys_ = mr.parse_mooring(_simple_design())
+    r6 = np.zeros(6)
+    U1 = np.array([1.0, 0.0, 0.0])
+    F1 = np.asarray(mr.current_wrench(sys_, r6, U1))
+    F2 = np.asarray(mr.current_wrench(sys_, r6, 2 * U1))
+    assert F1[0] > 0          # downstream push
+    assert_allclose(F2[0] / F1[0], 4.0, rtol=1e-6)   # quadratic drag
+    # general path agrees in form
+    sys_g = mr.parse_mooring(_general_design())
+    Fg = np.asarray(mr.current_wrench(sys_g, r6, U1))
+    assert Fg[0] > 0
+
+
+def test_model_mooring_current_acts(reference_test_data):
+    """currentMod=1 shifts the mean surge offset downstream for a current
+    case (OC3spar)."""
+    import os
+    import yaml
+    from raft_tpu.model import Model
+
+    with open(os.path.join(reference_test_data, "OC3spar.yaml")) as f:
+        design = yaml.safe_load(f)
+    case = {"wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+            "turbine_status": "operating", "yaw_misalign": 0,
+            "wave_spectrum": "JONSWAP", "wave_period": 10, "wave_height": 0,
+            "wave_heading": 0, "current_speed": 1.0, "current_heading": 0}
+    m0 = Model(design)
+    X0 = m0.solveStatics(case)
+    design2 = dict(design)
+    design2["mooring"] = dict(design["mooring"], currentMod=1)
+    m1 = Model(design2)
+    X1 = m1.solveStatics(case)
+    assert X1[0] > X0[0]

@@ -39,13 +39,8 @@ NITER = 10        # drag-linearization iterations (VolturnUS-S setting)
 
 
 def _design():
-    import yaml
-    path = "/root/reference/tests/test_data/VolturnUS-S.yaml"
-    if not os.path.isfile(path):
-        path = os.path.join(os.path.dirname(__file__), "designs",
-                            "VolturnUS-S.yaml")
-    with open(path) as f:
-        return yaml.safe_load(f)
+    from raft_tpu.io.designs import load_design
+    return load_design("VolturnUS-S")
 
 
 def _base_fowt(design):

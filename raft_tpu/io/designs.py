@@ -1,0 +1,32 @@
+"""Shared design-YAML resolution for driver entry points, bench, tests.
+
+One canonical lookup for named reference designs so bench.py,
+__graft_entry__ and tests all load the SAME yaml (they previously kept
+three hand-rolled fallback copies that could silently diverge)."""
+import os
+
+#: search roots, in priority order: the reference checkout, then a local
+#: designs/ directory next to the repo root (for standalone deployments)
+_SEARCH_DIRS = (
+    "/root/reference/designs",
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "designs"),
+)
+
+
+def design_path(name: str) -> str:
+    """Absolute path of the named design yaml (e.g. 'VolturnUS-S')."""
+    fname = name if name.endswith((".yaml", ".yml")) else name + ".yaml"
+    for root in _SEARCH_DIRS:
+        path = os.path.join(root, fname)
+        if os.path.isfile(path):
+            return path
+    raise FileNotFoundError(
+        f"design '{fname}' not found in {list(_SEARCH_DIRS)}")
+
+
+def load_design(name: str) -> dict:
+    """Load the named design yaml into a dict."""
+    import yaml
+    with open(design_path(name)) as f:
+        return yaml.safe_load(f)

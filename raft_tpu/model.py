@@ -204,8 +204,11 @@ class Model:
                 F = F0s[i] - K_hss[i] @ Xi0
                 K = K_hss[i]
                 if moors[i] is not None:
-                    F = F + mr.body_wrench(moors[i], X[s])
-                    K = K + mr.coupled_stiffness(moors[i], X[s])
+                    # general topologies: solve free points once per
+                    # evaluation, share across wrench + stiffness
+                    xf_i = mr.free_points(moors[i], X[s])
+                    F = F + mr.body_wrench(moors[i], X[s], xf=xf_i)
+                    K = K + mr.coupled_stiffness(moors[i], X[s], xf=xf_i)
                 Fs.append(F)
                 Kblocks.append(K)
             Fv = jnp.concatenate(Fs)
@@ -579,6 +582,10 @@ class Model:
         self.results.setdefault("properties", {})
         self.solveStatics(None)
         self.results["properties"]["offset_unloaded"] = self._state[0]["Xi0"]
+        # unloaded mooring reaction/stiffness snapshots for calcOutputs
+        # (the reference's self.C_moor0/F_moor0, raft_model.py:230-233)
+        self.C_moor0 = self._state[0]["C_moor"].copy()
+        self.F_moor0 = self._state[0]["F_moor0"].copy()
 
     # ------------------------------------------------------------------
     # ballast trim
@@ -929,15 +936,44 @@ class Model:
         props["shell mass"] = float(stat["m_shell"])
         props["total mass"] = float(stat["m"])
         props["total CG"] = np.asarray(stat["rCG"])
+        # ballast masses grouped by unique fill density (reference:
+        # raft_fowt.py:505-516)
+        mball = np.concatenate([np.atleast_1d(np.asarray(m, float))
+                                for m in stat["mballast"]]) \
+            if stat["mballast"] else np.zeros(0)
+        pball = np.concatenate([np.atleast_1d(np.asarray(p, float))
+                                for p in stat["pballast"]]) \
+            if stat["pballast"] else np.zeros(0)
+        pb = []
+        for p in pball:
+            if p != 0 and p not in pb:
+                pb.append(p)
+        props["ballast densities"] = np.asarray(pb)
+        props["ballast mass"] = np.asarray(
+            [mball[pball == p].sum() for p in pb])
+        props["roll inertia at subCG"] = float(stat["Ixx_sub"])
+        props["pitch inertia at subCG"] = float(stat["Iyy_sub"])
+        props["yaw inertia at subCG"] = float(stat["Izz_sub"])
         props["buoyancy (pgV)"] = fowt.rho_water * fowt.g * float(stat["V"])
         props["center of buoyancy"] = np.asarray(stat["rCB"])
-        props["C stiffness matrix"] = np.asarray(stat["C_hydro"])
+        props["C hydrostatic"] = np.asarray(stat["C_hydro"])
+        C_moor0 = getattr(self, "C_moor0", state["C_moor"])
+        props["C system"] = np.asarray(
+            stat["C_struc"] + stat["C_hydro"]) + C_moor0
+        props["F_lines0"] = getattr(self, "F_moor0", state["F_moor0"])
+        props["C_lines0"] = C_moor0
         hc = state.get("hydro0")
-        if hc is not None:
-            props["A matrix"] = np.asarray(hc["A_hydro_morison"])
+        A_morison = np.asarray(hc["A_hydro_morison"]) if hc is not None \
+            else np.zeros((6, 6))
+        props["A matrix"] = A_morison
+        # added mass at the highest BEM frequency, matching the reference's
+        # fowt.A_BEM[:,:,-1] convention (raft_model.py:1185)
+        from raft_tpu.io.wamit import bem_coeffs
+        A_BEM, _ = bem_coeffs(fowt.bem, self.nw)
         props["M support structure"] = np.asarray(stat["M_struc_sub"])
+        props["A support structure"] = A_morison + np.asarray(A_BEM[:, :, -1])
         props["C support structure"] = np.asarray(
-            stat["C_struc_sub"] + stat["C_hydro"])
+            stat["C_struc_sub"] + stat["C_hydro"]) + C_moor0
         return self.results
 
 

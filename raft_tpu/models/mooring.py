@@ -318,20 +318,33 @@ def _is_general(sys_) -> bool:
     return hasattr(sys_, "attach")
 
 
-def body_wrench(sys_, r6):
+def free_points(sys_, r6, xf0=None):
+    """Equilibrium free-point positions for a general system (None for the
+    simple topology).  Callers evaluating several mooring quantities at one
+    pose should solve this ONCE and pass it via the ``xf=`` arguments below
+    instead of paying a cold Newton solve per quantity."""
+    if not _is_general(sys_):
+        return None
+    from raft_tpu.models import mooring_array as ma
+    return ma.solve_free_points(sys_, jnp.asarray(r6, float)[None, :],
+                                xf0=xf0)
+
+
+def body_wrench(sys_, r6, xf=None):
     """Net 6-DOF mooring wrench on the body about its reference point
     (equivalent of Body.getForces(lines_only=True))."""
     if _is_general(sys_):
         from raft_tpu.models import mooring_array as ma
         Xb = jnp.asarray(r6, float)[None, :]
-        xf = ma.solve_free_points(sys_, Xb)
+        if xf is None:
+            xf = ma.solve_free_points(sys_, Xb)
         return ma.body_wrenches(sys_, Xb, xf)[0]
     F, rF, _ = line_forces(sys_, r6)
     r6 = jnp.asarray(r6, float)
     return jnp.sum(translate_force_3to6(F, rF - r6[:3]), axis=0)
 
 
-def coupled_stiffness(sys_, r6):
+def coupled_stiffness(sys_, r6, xf=None):
     """6x6 mooring stiffness -dF/dx about the body pose (equivalent of
     getCoupledStiffnessA(lines_only=True)), by exact forward-mode autodiff
     through the catenary Newton solve (free points eliminated by the
@@ -339,25 +352,27 @@ def coupled_stiffness(sys_, r6):
     if _is_general(sys_):
         from raft_tpu.models import mooring_array as ma
         Xb = jnp.asarray(r6, float)[None, :]
-        xf = ma.solve_free_points(sys_, Xb)
+        if xf is None:
+            xf = ma.solve_free_points(sys_, Xb)
         return ma.coupled_stiffness(sys_, Xb, xf)
     return -jax.jacfwd(lambda x: body_wrench(sys_, x))(jnp.asarray(r6, float))
 
 
-def tensions(sys_, r6):
+def tensions(sys_, r6, xf=None):
     """Line end tensions, shape (2*nl,): all anchor-end tensions first,
     then all fairlead-end tensions ([TA_1..TA_n, TB_1..TB_n]), matching
     MoorPy's getTensions ordering used by the reference."""
     if _is_general(sys_):
         from raft_tpu.models import mooring_array as ma
         Xb = jnp.asarray(r6, float)[None, :]
-        xf = ma.solve_free_points(sys_, Xb)
+        if xf is None:
+            xf = ma.solve_free_points(sys_, Xb)
         return ma.tensions(sys_, Xb, xf)
     _, _, sol = line_forces(sys_, r6)
     return jnp.concatenate([sol["TA"], sol["TB"]])
 
 
-def current_wrench(sys_, r6, U, rho: float = _RHO):
+def current_wrench(sys_, r6, U, rho: float = _RHO, xf=None):
     """Uniform-current drag on the mooring lines, lumped to the body —
     chord-direction approximation of MoorPy's currentMod=1 (the reference
     passes case currents to MoorPy, raft_model.py:559-578).  Half of each
@@ -365,7 +380,8 @@ def current_wrench(sys_, r6, U, rho: float = _RHO):
     if _is_general(sys_):
         from raft_tpu.models import mooring_array as ma
         Xb = jnp.asarray(r6, float)[None, :]
-        xf = ma.solve_free_points(sys_, Xb)
+        if xf is None:
+            xf = ma.solve_free_points(sys_, Xb)
         return ma.current_wrenches(sys_, Xb, xf, U)[0]
     from raft_tpu.models.mooring_array import chord_drag
     r6 = jnp.asarray(r6, float)
@@ -375,12 +391,13 @@ def current_wrench(sys_, r6, U, rho: float = _RHO):
     return jnp.sum(translate_force_3to6(0.5 * F_line, rF - r6[:3]), axis=0)
 
 
-def tension_jacobian(sys_, r6):
+def tension_jacobian(sys_, r6, xf=None):
     """d(tensions)/d(pose): (2*nl, 6), the J_moor of the reference's
     getCoupledStiffness(..., tensions=True)."""
     if _is_general(sys_):
         from raft_tpu.models import mooring_array as ma
         Xb = jnp.asarray(r6, float)[None, :]
-        xf = ma.solve_free_points(sys_, Xb)
+        if xf is None:
+            xf = ma.solve_free_points(sys_, Xb)
         return ma.tension_jacobian(sys_, Xb, xf)
     return jax.jacfwd(lambda x: tensions(sys_, x))(jnp.asarray(r6, float))

@@ -1,0 +1,1002 @@
+"""OpenMDAO wrapper: the WEIS integration gate.
+
+TPU-native equivalent of the reference ``RAFT_OMDAO`` / ``RAFT_Group``
+(reference: raft/omdao_raft.py:14-831).  The component declares the same
+input/output surface as the reference — WEIS drives either implementation
+interchangeably — and ``compute`` rebuilds the RAFT design dictionary from
+the OpenMDAO inputs (reference: omdao_raft.py:389-686) then runs this
+package's :class:`raft_tpu.model.Model`.
+
+OpenMDAO itself is an *optional* dependency (it is an optimization harness,
+not part of the physics).  When ``openmdao`` is importable the classes are
+real ``om.ExplicitComponent`` / ``om.Group`` subclasses; otherwise a small
+API-compatible shim (declare/add_input/add_output/list_outputs/compute)
+stands in so the adapter — and everything downstream of its design-dict
+rebuild — stays fully usable and testable.
+"""
+from __future__ import annotations
+
+import copy
+from itertools import compress
+
+import numpy as np
+
+ndim = 3
+ndof = 6
+
+try:  # pragma: no cover - environment dependent
+    import openmdao.api as om
+    _HAVE_OM = True
+    _ComponentBase = om.ExplicitComponent
+    _GroupBase = om.Group
+except ImportError:
+    _HAVE_OM = False
+
+    class _OptionsDict(dict):
+        """Minimal stand-in for openmdao's OptionsDictionary."""
+
+        def declare(self, name, default=None, **kwargs):
+            self.setdefault(name, default)
+
+    class _Vector(dict):
+        """Key->value store that mimics openmdao vector __getitem__."""
+
+    class _ComponentBase:
+        """API-compatible shim for ``om.ExplicitComponent``.
+
+        Supports the subset the adapter uses: ``options.declare``,
+        ``add_input``/``add_discrete_input``/``add_output``,
+        ``list_inputs``/``list_outputs`` and a ``run`` driver that mirrors
+        ``prob.run_model()`` for a single component.
+        """
+
+        def __init__(self, **options):
+            self.options = _OptionsDict()
+            self.initialize()
+            for k, v in options.items():
+                self.options[k] = v
+            self._inputs = _Vector()
+            self._discrete_inputs = _Vector()
+            self._outputs = _Vector()
+            self._discrete_outputs = _Vector()
+            self._is_setup = False
+
+        # --- declaration API ---
+        def initialize(self):
+            pass
+
+        def setup(self):
+            pass
+
+        def add_input(self, name, val=0.0, units=None, desc=''):
+            self._inputs[name] = np.array(val, dtype=float) \
+                if not np.isscalar(val) else float(val)
+
+        def add_discrete_input(self, name, val=None, desc=''):
+            self._discrete_inputs[name] = val
+
+        def add_output(self, name, val=0.0, units=None, desc=''):
+            self._outputs[name] = np.array(val, dtype=float) \
+                if not np.isscalar(val) else float(val)
+
+        def add_discrete_output(self, name, val=None, desc=''):
+            self._discrete_outputs[name] = val
+
+        # --- introspection API (reference uses these in compute) ---
+        def list_inputs(self, out_stream=None, all_procs=False):
+            return [(k, {'val': v}) for k, v in self._inputs.items()]
+
+        def list_outputs(self, out_stream=None, all_procs=False):
+            return [(k, {'val': v}) for k, v in self._outputs.items()]
+
+        # --- driver ---
+        def prime(self, inputs=None, discrete_inputs=None):
+            """setup() once and overlay the provided input values (no
+            compute) — lets callers inspect the merged input vector or call
+            ``build_design`` without paying for a model run."""
+            if not self._is_setup:
+                self.setup()
+                self._is_setup = True
+            if inputs:
+                for k, v in inputs.items():
+                    if k not in self._inputs:
+                        raise KeyError(f"unknown input '{k}'")
+                    self._inputs[k] = np.asarray(v, dtype=float) \
+                        if not np.isscalar(v) else float(v)
+            if discrete_inputs:
+                for k, v in discrete_inputs.items():
+                    self._discrete_inputs[k] = v
+            return self._inputs
+
+        def run(self, inputs=None, discrete_inputs=None):
+            """prime() then compute() — mirrors prob.run_model()."""
+            self.prime(inputs, discrete_inputs)
+            self.compute(self._inputs, self._outputs,
+                         self._discrete_inputs, self._discrete_outputs)
+            return self._outputs
+
+    class _GroupBase:
+        """Shim for ``om.Group`` holding promoted subsystems."""
+
+        def __init__(self, **options):
+            self.options = _OptionsDict()
+            self.initialize()
+            for k, v in options.items():
+                self.options[k] = v
+            self._subsystems = {}
+
+        def initialize(self):
+            pass
+
+        def setup(self):
+            pass
+
+        def add_subsystem(self, name, comp, promotes=None):
+            self._subsystems[name] = comp
+            return comp
+
+
+class RAFT_OMDAO(_ComponentBase):
+    """RAFT OpenMDAO wrapper (reference: omdao_raft.py:14-810).
+
+    Declares the reference's full input/output surface keyed off the same
+    five option dictionaries (modeling/turbine/members/mooring/analysis).
+    """
+
+    def initialize(self):
+        self.options.declare('modeling_options')
+        self.options.declare('turbine_options')
+        self.options.declare('mooring_options')
+        self.options.declare('member_options')
+        self.options.declare('analysis_options')
+
+    # ------------------------------------------------------------------
+    # setup: declare inputs/outputs (reference: omdao_raft.py:26-335)
+    # ------------------------------------------------------------------
+    def setup(self):
+        modeling_opt = self.options['modeling_options']
+        nfreq = modeling_opt['nfreq']
+        n_cases = modeling_opt['n_cases']
+
+        turbine_opt = self.options['turbine_options']
+        turbine_npts = turbine_opt['npts']
+        n_gain = turbine_opt['PC_GS_n']
+        n_span = turbine_opt['n_span']
+        n_aoa = turbine_opt['n_aoa']
+        n_Re = turbine_opt['n_Re']
+        n_tab = turbine_opt['n_tab']
+        n_pc = turbine_opt['n_pc']
+        n_af = turbine_opt['n_af']
+        n_af_span = len(turbine_opt['af_used_names'])
+
+        members_opt = self.options['member_options']
+        nmembers = members_opt['nmembers']
+        n_ballast_type = members_opt['n_ballast_type']
+
+        mooring_opt = self.options['mooring_options']
+        nlines = mooring_opt['nlines']
+        nline_types = mooring_opt['nline_types']
+        nconnections = mooring_opt['nconnections']
+
+        # ---- turbine / RNA inputs (reference :66-76) ----
+        for name in ('turbine_mRNA', 'turbine_IxRNA', 'turbine_IrRNA',
+                     'turbine_xCG_RNA', 'turbine_hHub', 'turbine_overhang',
+                     'turbine_Fthrust', 'turbine_yaw_stiffness'):
+            self.add_input(name, val=0.0)
+
+        # ---- tower (one member; reference :77-104) ----
+        self.add_input('turbine_tower_rA', val=np.zeros(ndim))
+        self.add_input('turbine_tower_rB', val=np.zeros(ndim))
+        self.add_input('turbine_tower_gamma', val=0.0)
+        self.add_input('turbine_tower_stations', val=np.zeros(turbine_npts))
+        self._add_member_shape_inputs(
+            'turbine_tower_', turbine_opt['shape'], turbine_npts,
+            turbine_opt['scalar_diameters'], turbine_opt['scalar_thicknesses'],
+            turbine_opt['scalar_coefficients'])
+        self.add_input('turbine_tower_rho_shell', val=0.0)
+
+        # ---- control (reference :106-113) ----
+        self.add_input('rotor_PC_GS_angles', val=np.zeros(n_gain))
+        self.add_input('rotor_PC_GS_Kp', val=np.zeros(n_gain))
+        self.add_input('rotor_PC_GS_Ki', val=np.zeros(n_gain))
+        self.add_input('Fl_Kp', val=0.0)
+        self.add_input('rotor_inertia', val=0.0)
+        self.add_input('rotor_TC_VS_Kp', val=0.0)
+        self.add_input('rotor_TC_VS_Ki', val=0.0)
+
+        # ---- blade & rotor (reference :114-144) ----
+        self.add_discrete_input('nBlades', val=3)
+        for name in ('tilt', 'precone', 'wind_reference_height', 'hub_radius'):
+            self.add_input(name, val=0.0)
+        self.add_input('gear_ratio', val=1.0)
+        for name in ('blade_r', 'blade_chord', 'blade_theta',
+                     'blade_precurve', 'blade_presweep'):
+            self.add_input(name, val=np.zeros(n_span))
+        for name in ('blade_Rtip', 'blade_precurveTip', 'blade_presweepTip'):
+            self.add_input(name, val=0.0)
+        self.add_discrete_input('airfoils_name', val=n_af * [""])
+        self.add_input('airfoils_position', val=np.zeros(n_af_span))
+        self.add_input('airfoils_r_thick', val=np.zeros(n_af))
+        self.add_input('airfoils_aoa', val=np.zeros(n_aoa))
+        for name in ('airfoils_cl', 'airfoils_cd', 'airfoils_cm'):
+            self.add_input(name, val=np.zeros((n_af, n_aoa, n_Re, n_tab)))
+        self.add_input('rotor_powercurve_v', val=np.zeros(n_pc))
+        self.add_input('rotor_powercurve_omega_rpm', val=np.zeros(n_pc))
+        self.add_input('rotor_powercurve_pitch', val=np.zeros(n_pc))
+        self.add_input('rho_air', val=1.225)
+        self.add_input('rho_water', val=1025.0)
+        self.add_input('mu_air', val=1.81e-5)
+        self.add_input('shear_exp', val=0.2)
+        self.add_input('rated_rotor_speed', val=0.0)
+
+        # ---- platform members (reference :146-225) ----
+        for i in range(1, nmembers + 1):
+            m_name = f'platform_member{i}_'
+            mnpts = members_opt['npts'][i - 1]
+            mnpts_lfill = members_opt['npts_lfill'][i - 1]
+            mncaps = members_opt['ncaps'][i - 1]
+            mnreps = members_opt['nreps'][i - 1]
+            self.add_input(m_name + 'heading', val=np.zeros(mnreps))
+            self.add_input(m_name + 'rA', val=np.zeros(ndim))
+            self.add_input(m_name + 'rB', val=np.zeros(ndim))
+            self.add_input(m_name + 's_ghostA', val=0.0)
+            self.add_input(m_name + 's_ghostB', val=1.0)
+            self.add_input(m_name + 'gamma', val=0.0)
+            self.add_input(m_name + 'stations', val=np.zeros(mnpts))
+            self._add_member_shape_inputs(
+                m_name, members_opt['shape'][i - 1], mnpts,
+                members_opt['scalar_diameters'][i - 1],
+                members_opt['scalar_thicknesses'][i - 1],
+                members_opt['scalar_coefficients'][i - 1])
+            self.add_input(m_name + 'rho_shell', val=0.0)
+            self.add_input(m_name + 'l_fill', val=np.zeros(mnpts_lfill))
+            self.add_input(m_name + 'rho_fill', val=np.zeros(mnpts_lfill))
+            self.add_input(m_name + 'cap_stations', val=np.zeros(mncaps))
+            self.add_input(m_name + 'cap_t', val=np.zeros(mncaps))
+            self.add_input(m_name + 'cap_d_in', val=np.zeros(mncaps))
+            self.add_input(m_name + 'ring_spacing', val=0.0)
+            self.add_input(m_name + 'ring_t', val=0.0)
+            self.add_input(m_name + 'ring_h', val=0.0)
+
+        # ---- mooring (reference :227-248) ----
+        self.add_input('mooring_water_depth', val=0.0)
+        for i in range(1, nconnections + 1):
+            self.add_input(f'mooring_point{i}_location', val=np.zeros(ndim))
+        for i in range(1, nlines + 1):
+            self.add_input(f'mooring_line{i}_length', val=0.0)
+        for i in range(1, nline_types + 1):
+            lt_name = f'mooring_line_type{i}_'
+            for prop in ('diameter', 'mass_density', 'stiffness',
+                         'breaking_load', 'cost', 'transverse_added_mass',
+                         'tangential_added_mass', 'transverse_drag',
+                         'tangential_drag'):
+                self.add_input(lt_name + prop, val=0.0)
+
+        # ---- outputs: properties (reference :250-272) ----
+        self.add_output('properties_tower mass', val=0.0)
+        self.add_output('properties_tower CG', val=np.zeros(ndim))
+        self.add_output('properties_substructure mass', val=0.0)
+        self.add_output('properties_substructure CG', val=np.zeros(ndim))
+        self.add_output('properties_shell mass', val=0.0)
+        self.add_output('properties_ballast mass', val=np.zeros(n_ballast_type))
+        self.add_output('properties_ballast densities', val=np.zeros(n_ballast_type))
+        self.add_output('properties_total mass', val=0.0)
+        self.add_output('properties_total CG', val=np.zeros(ndim))
+        self.add_output('properties_roll inertia at subCG', val=np.zeros(ndim))
+        self.add_output('properties_pitch inertia at subCG', val=np.zeros(ndim))
+        self.add_output('properties_yaw inertia at subCG', val=np.zeros(ndim))
+        self.add_output('properties_buoyancy (pgV)', val=0.0)
+        self.add_output('properties_center of buoyancy', val=np.zeros(ndim))
+        self.add_output('properties_C hydrostatic', val=np.zeros((ndof, ndof)))
+        self.add_output('properties_C system', val=np.zeros((ndof, ndof)))
+        self.add_output('properties_F_lines0', val=np.zeros(ndof))
+        self.add_output('properties_C_lines0', val=np.zeros((ndof, ndof)))
+        self.add_output('properties_M support structure', val=np.zeros((ndof, ndof)))
+        self.add_output('properties_A support structure', val=np.zeros((ndof, ndof)))
+        self.add_output('properties_C support structure', val=np.zeros((ndof, ndof)))
+
+        # ---- outputs: response RAOs (reference :273-283) ----
+        self.add_output('response_frequencies', val=np.zeros(nfreq))
+        self.add_output('response_wave elevation', val=np.zeros(nfreq))
+        for ch in ('surge', 'sway', 'heave', 'pitch', 'roll', 'yaw'):
+            self.add_output(f'response_{ch} RAO', val=np.zeros(nfreq))
+        self.add_output('response_nacelle acceleration', val=np.zeros(nfreq))
+
+        # ---- outputs: per-case statistics (reference :284-314) ----
+        names = ['surge', 'sway', 'heave', 'roll', 'pitch', 'yaw',
+                 'AxRNA', 'Mbase', 'omega', 'torque', 'power', 'bPitch',
+                 'Tmoor']
+        stats = ['avg', 'std', 'max', 'PSD', 'DEL']
+        for n in names:
+            for s in stats:
+                if s == 'DEL' and n not in ['Tmoor', 'Mbase']:
+                    continue
+                if n == 'Tmoor':
+                    myval = np.zeros((n_cases, 2 * nlines)) if s != 'PSD' \
+                        else np.zeros((n_cases, 2 * nlines, nfreq))
+                else:
+                    myval = np.zeros(n_cases) if s != 'PSD' \
+                        else np.zeros((n_cases, nfreq))
+                self.add_output(f'stats_{n}_{s}', val=myval)
+        self.add_output('stats_wind_PSD', val=np.zeros((n_cases, nfreq)))
+        self.add_output('stats_wave_PSD', val=np.zeros((n_cases, nfreq)))
+
+        # ---- outputs: natural periods + aggregates (reference :316-335) ----
+        self.add_output('rigid_body_periods', val=np.zeros(6))
+        for ch in ('surge', 'sway', 'heave', 'roll', 'pitch', 'yaw'):
+            self.add_output(f'{ch}_period', val=0.0)
+        for name in ('Max_Offset', 'heave_avg', 'Max_PtfmPitch',
+                     'Std_PtfmPitch', 'max_nac_accel', 'rotor_overspeed',
+                     'max_tower_base'):
+            self.add_output(name, val=0.0)
+        self.add_output('platform_total_center_of_mass', val=np.zeros(3))
+        self.add_output('platform_displacement', val=0.0)
+        self.add_output('platform_mass', val=0.0)
+        self.add_output('platform_I_total', val=np.zeros(6))
+
+    def _add_member_shape_inputs(self, m_name, shape, npts, scalar_d,
+                                 scalar_t, scalar_coeff):
+        """d/t/Cd/Ca/CdEnd/CaEnd declarations shared by tower and platform
+        members (reference: omdao_raft.py:81-104, 167-214)."""
+        if scalar_d:
+            self.add_input(m_name + 'd',
+                           val=0.0 if shape != 'rect' else [0.0, 0.0])
+        elif shape == 'rect':
+            self.add_input(m_name + 'd', val=np.zeros((npts, 2)))
+        else:
+            self.add_input(m_name + 'd', val=np.zeros(npts))
+        self.add_input(m_name + 't', val=0.0 if scalar_t else np.zeros(npts))
+        if shape == 'circ':
+            cval = 0.0 if scalar_coeff else np.zeros(npts)
+        else:
+            cval = [0.0, 0.0] if scalar_coeff else np.zeros((npts, 2))
+        self.add_input(m_name + 'Cd', val=cval)
+        self.add_input(m_name + 'Ca', val=copy.deepcopy(cval))
+        self.add_input(m_name + 'CdEnd', val=0.0 if scalar_coeff else np.zeros(npts))
+        self.add_input(m_name + 'CaEnd', val=0.0 if scalar_coeff else np.zeros(npts))
+
+    # ------------------------------------------------------------------
+    # design-dict rebuild (reference: omdao_raft.py:389-686)
+    # ------------------------------------------------------------------
+    def build_design(self, inputs, discrete_inputs):
+        modeling_opt = self.options['modeling_options']
+        analysis_options = self.options['analysis_options']
+        turbine_opt = self.options['turbine_options']
+        members_opt = self.options['member_options']
+        mooring_opt = self.options['mooring_options']
+
+        design = {}
+        design['type'] = ['input dictionary for RAFT']
+        design['name'] = [analysis_options['general']['fname_output']]
+        design['comments'] = ['none']
+
+        design['settings'] = {
+            'XiStart': float(modeling_opt['xi_start']),
+            'min_freq': float(modeling_opt['min_freq']),
+            'max_freq': float(modeling_opt['max_freq']),
+            'nIter': int(modeling_opt['nIter']),
+        }
+        design['site'] = {
+            'water_depth': float(np.asarray(inputs['mooring_water_depth']).flat[0]),
+            'rho_air': float(np.asarray(inputs['rho_air']).flat[0]),
+            'rho_water': float(np.asarray(inputs['rho_water']).flat[0]),
+            'mu_air': float(np.asarray(inputs['mu_air']).flat[0]),
+            'shearExp': float(np.asarray(inputs['shear_exp']).flat[0]),
+        }
+
+        # ---- turbine (reference :412-500) ----
+        turbine = {}
+        for key, iname in (('mRNA', 'turbine_mRNA'), ('IxRNA', 'turbine_IxRNA'),
+                           ('IrRNA', 'turbine_IrRNA'),
+                           ('xCG_RNA', 'turbine_xCG_RNA'),
+                           ('hHub', 'turbine_hHub'),
+                           ('overhang', 'turbine_overhang'),
+                           ('Fthrust', 'turbine_Fthrust'),
+                           ('yaw_stiffness', 'turbine_yaw_stiffness'),
+                           ('gear_ratio', 'gear_ratio')):
+            turbine[key] = float(np.asarray(inputs[iname]).flat[0])
+
+        tower = {'name': 'tower', 'type': 1}
+        rA = np.array(inputs['turbine_tower_rA'], float)
+        rB = np.array(inputs['turbine_tower_rB'], float)
+        if rA[2] > rB[2]:      # MHK towers come flipped (reference :430-433)
+            rA, rB = rB, rA
+        tower['rA'] = rA
+        tower['rB'] = rB
+        tower['shape'] = turbine_opt['shape']
+        tower['gamma'] = float(np.asarray(inputs['turbine_tower_gamma']).flat[0])
+        tower['stations'] = np.array(inputs['turbine_tower_stations'], float)
+        for key, scalar in (('d', turbine_opt['scalar_diameters']),
+                            ('t', turbine_opt['scalar_thicknesses'])):
+            v = inputs['turbine_tower_' + key]
+            tower[key] = float(np.asarray(v).flat[0]) if scalar else np.array(v, float)
+        for key in ('Cd', 'Ca', 'CdEnd', 'CaEnd'):
+            v = inputs['turbine_tower_' + key]
+            tower[key] = float(np.asarray(v).flat[0]) \
+                if turbine_opt['scalar_coefficients'] else np.array(v, float)
+        tower['rho_shell'] = float(np.asarray(inputs['turbine_tower_rho_shell']).flat[0])
+        turbine['tower'] = tower
+
+        turbine['nBlades'] = int(discrete_inputs['nBlades'])
+        turbine['shaft_tilt'] = float(np.asarray(inputs['tilt']).flat[0])
+        turbine['precone'] = float(np.asarray(inputs['precone']).flat[0])
+        turbine['Zhub'] = float(np.asarray(inputs['wind_reference_height']).flat[0])
+        turbine['Rhub'] = float(np.asarray(inputs['hub_radius']).flat[0])
+        turbine['I_drivetrain'] = float(np.asarray(inputs['rotor_inertia']).flat[0])
+
+        turbine['blade'] = {
+            'geometry': np.c_[inputs['blade_r'], inputs['blade_chord'],
+                              inputs['blade_theta'], inputs['blade_precurve'],
+                              inputs['blade_presweep']],
+            'Rtip': float(np.asarray(inputs['blade_Rtip']).flat[0]),
+            'precurveTip': float(np.asarray(inputs['blade_precurveTip']).flat[0]),
+            'presweepTip': float(np.asarray(inputs['blade_presweepTip']).flat[0]),
+            'airfoils': list(zip([float(ap) for ap in inputs['airfoils_position']],
+                                 turbine_opt['af_used_names'])),
+        }
+        n_af = turbine_opt['n_af']
+        turbine['airfoils'] = []
+        for i in range(n_af):
+            turbine['airfoils'].append({
+                'name': discrete_inputs['airfoils_name'][i],
+                'relative_thickness': float(np.asarray(inputs['airfoils_r_thick'])[i]),
+                'data': np.c_[np.rad2deg(np.asarray(inputs['airfoils_aoa'])),
+                              np.asarray(inputs['airfoils_cl'])[i, :, 0, 0],
+                              np.asarray(inputs['airfoils_cd'])[i, :, 0, 0],
+                              np.asarray(inputs['airfoils_cm'])[i, :, 0, 0]],
+            })
+
+        turbine['pitch_control'] = {
+            'GS_Angles': np.array(inputs['rotor_PC_GS_angles'], float),
+            'GS_Kp': np.array(inputs['rotor_PC_GS_Kp'], float),
+            'GS_Ki': np.array(inputs['rotor_PC_GS_Ki'], float),
+            'Fl_Kp': float(np.asarray(inputs['Fl_Kp']).flat[0]),
+        }
+        turbine['torque_control'] = {
+            'VS_KP': float(np.asarray(inputs['rotor_TC_VS_Kp']).flat[0]),
+            'VS_KI': float(np.asarray(inputs['rotor_TC_VS_Ki']).flat[0]),
+        }
+        turbine['wt_ops'] = {
+            'v': np.array(inputs['rotor_powercurve_v'], float),
+            'omega_op': np.array(inputs['rotor_powercurve_omega_rpm'], float),
+            'pitch_op': np.array(inputs['rotor_powercurve_pitch'], float),
+        }
+        design['turbine'] = turbine
+
+        # ---- platform members incl. ghost segments (reference :502-640) ----
+        design['platform'] = {
+            'potModMaster': int(modeling_opt['potential_model_override']),
+            'dlsMax': float(modeling_opt['dls_max']),
+            # the reference stores this under design['turbine'] only
+            # (omdao_raft.py:419) while the model reads it from
+            # design['platform'] (raft_fowt.py:194-197) — i.e. WEIS's yaw
+            # stiffness is silently dropped there; wire it through here
+            'yaw_stiffness': float(np.asarray(
+                inputs['turbine_yaw_stiffness']).flat[0]),
+        }
+        min_freq_BEM = float(modeling_opt['min_freq_BEM'])
+        if min_freq_BEM >= modeling_opt['min_freq']:
+            min_freq_BEM = modeling_opt['min_freq'] - 1e-7
+        design['platform']['min_freq_BEM'] = min_freq_BEM
+        nmembers = members_opt['nmembers']
+        design['platform']['members'] = []
+        for i in range(nmembers):
+            m_name = f'platform_member{i+1}_'
+            m_shape = members_opt['shape'][i]
+            scalar_d = members_opt['scalar_diameters'][i]
+            scalar_t = members_opt['scalar_thicknesses'][i]
+            scalar_coeff = members_opt['scalar_coefficients'][i]
+            mem = {'name': m_name, 'type': i + 2, 'shape': m_shape,
+                   'gamma': float(np.asarray(inputs[m_name + 'gamma']).flat[0]),
+                   'potMod': members_opt[m_name + 'potMod']}
+
+            # ghost-segment trim: clip stations to [s_ghostA, s_ghostB] and
+            # move the physical ends (reference :517-527)
+            rA_0 = np.array(inputs[m_name + 'rA'], float)
+            rB_0 = np.array(inputs[m_name + 'rB'], float)
+            s_ghostA = float(np.asarray(inputs[m_name + 's_ghostA']).flat[0])
+            s_ghostB = float(np.asarray(inputs[m_name + 's_ghostB']).flat[0])
+            s_0 = np.array(inputs[m_name + 'stations'], float)
+            idx = np.logical_and(s_0 >= s_ghostA, s_0 <= s_ghostB)
+            s_grid = np.unique(np.r_[s_ghostA, s_0[idx], s_ghostB])
+            mnpts = len(idx)
+            mem['rA'] = rA_0 + s_ghostA * (rB_0 - rA_0)
+            mem['rB'] = rA_0 + s_ghostB * (rB_0 - rA_0)
+            mem['stations'] = s_grid
+
+            if m_shape in ('circ', 'square'):
+                if scalar_d:
+                    mem['d'] = [float(np.asarray(inputs[m_name + 'd']).flat[0])] * mnpts
+                else:
+                    mem['d'] = np.interp(s_grid, s_0, np.asarray(inputs[m_name + 'd']))
+            else:
+                d_in = np.asarray(inputs[m_name + 'd'], float)
+                d = np.zeros([len(s_grid), 2])
+                if scalar_d:
+                    d[:, 0], d[:, 1] = d_in.flat[0], d_in.flat[1]
+                else:
+                    d[:, 0] = np.interp(s_grid, s_0, d_in[:, 0])
+                    d[:, 1] = np.interp(s_grid, s_0, d_in[:, 1])
+                mem['d'] = d
+            if scalar_t:
+                mem['t'] = float(np.asarray(inputs[m_name + 't']).flat[0])
+            else:
+                mem['t'] = np.interp(s_grid, s_0, np.asarray(inputs[m_name + 't']))
+
+            for key in ('Cd', 'Ca'):
+                v = np.asarray(inputs[m_name + key], float)
+                if m_shape == 'circ':
+                    mem[key] = float(v.flat[0]) if scalar_coeff \
+                        else np.interp(s_grid, s_0, v)
+                else:
+                    c = np.zeros([len(s_grid), 2])
+                    if scalar_coeff:
+                        c[:, 0], c[:, 1] = v.flat[0], v.flat[1]
+                    else:
+                        c[:, 0] = np.interp(s_grid, s_0, v[:, 0])
+                        c[:, 1] = np.interp(s_grid, s_0, v[:, 1])
+                    mem[key] = c
+            for key in ('CdEnd', 'CaEnd'):
+                v = np.asarray(inputs[m_name + key], float)
+                mem[key] = float(v.flat[0]) if scalar_coeff \
+                    else np.interp(s_grid, s_0, v)
+            mem['rho_shell'] = float(np.asarray(inputs[m_name + 'rho_shell']).flat[0])
+            if members_opt['nreps'][i] > 0:
+                mem['heading'] = np.array(inputs[m_name + 'heading'], float)
+            if members_opt['npts_lfill'][i] > 0:
+                mem['l_fill'] = np.array(inputs[m_name + 'l_fill'], float)
+                mem['rho_fill'] = np.array(inputs[m_name + 'rho_fill'], float)
+
+            # end caps / bulkheads / ring stiffeners (reference :596-638)
+            mncaps = members_opt['ncaps'][i]
+            ring_spacing = float(np.asarray(inputs[m_name + 'ring_spacing']).flat[0])
+            if mncaps > 0 or ring_spacing > 0:
+                s_height = s_grid[-1] - s_grid[0]
+                n_stiff = 0 if ring_spacing == 0.0 else \
+                    int(np.floor(s_height / ring_spacing))
+                s_ring = (np.arange(1, n_stiff + 0.1) - 0.5) * (ring_spacing / s_height)
+                d_ring = None
+                if len(s_ring):
+                    if m_shape != 'rect':
+                        d_ring = np.interp(s_ring, s_grid, mem['d'])
+                    else:
+                        d_ring = np.zeros([len(s_ring), 2])
+                        d_ring[:, 0] = np.interp(s_ring, s_grid, mem['d'][:, 0])
+                        d_ring[:, 1] = np.interp(s_ring, s_grid, mem['d'][:, 1])
+                s_cap_0 = np.asarray(inputs[m_name + 'cap_stations'], float)
+                t_cap_0 = np.asarray(inputs[m_name + 'cap_t'], float)
+                if len(s_cap_0):
+                    idx_cap = np.logical_and(s_cap_0 >= s_ghostA, s_cap_0 <= s_ghostB)
+                    s_cap, isort = np.unique(
+                        np.r_[s_ghostA, s_cap_0[idx_cap], s_ghostB],
+                        return_index=True)
+                    t_cap = np.r_[t_cap_0[0], t_cap_0[idx_cap], t_cap_0[-1]][isort]
+                    di_cap = np.zeros(s_cap.shape)
+                    if s_ghostA > 0.0:
+                        s_cap, t_cap, di_cap = s_cap[1:], t_cap[1:], di_cap[1:]
+                    if s_ghostB < 1.0:
+                        s_cap, t_cap, di_cap = s_cap[:-1], t_cap[:-1], di_cap[:-1]
+                else:
+                    s_cap = np.zeros(0)
+                    t_cap = np.zeros(0)
+                    di_cap = np.zeros(0)
+                if len(s_ring):
+                    s_cap = np.r_[s_ring, s_cap]
+                    t_cap = np.r_[float(np.asarray(inputs[m_name + 'ring_t']).flat[0])
+                                  * np.ones(n_stiff), t_cap]
+                    di_cap = np.r_[d_ring - 2 * float(
+                        np.asarray(inputs[m_name + 'ring_h']).flat[0]), di_cap]
+                if len(s_cap) > 0:
+                    isort = np.argsort(s_cap)
+                    mem['cap_stations'] = s_cap[isort]
+                    mem['cap_t'] = t_cap[isort]
+                    mem['cap_d_in'] = di_cap[isort]
+            design['platform']['members'].append(mem)
+
+        # ---- mooring (reference :641-675) ----
+        nconnections = mooring_opt['nconnections']
+        nlines = mooring_opt['nlines']
+        nline_types = mooring_opt['nline_types']
+        mooring = {'water_depth': float(np.asarray(
+            inputs['mooring_water_depth']).flat[0])}
+        mooring['points'] = []
+        for i in range(nconnections):
+            pt_name = f'mooring_point{i+1}_'
+            pt = {'name': mooring_opt[pt_name + 'name'],
+                  'type': mooring_opt[pt_name + 'type'],
+                  'location': np.array(inputs[pt_name + 'location'], float)}
+            if pt['type'].lower() == 'fixed':
+                pt['anchor_type'] = 'drag_embedment'
+            mooring['points'].append(pt)
+        mooring['lines'] = []
+        for i in range(nlines):
+            ml_name = f'mooring_line{i+1}_'
+            mooring['lines'].append({
+                'name': f'line{i+1}',
+                'endA': mooring_opt[ml_name + 'endA'],
+                'endB': mooring_opt[ml_name + 'endB'],
+                'type': mooring_opt[ml_name + 'type'],
+                'length': float(np.asarray(inputs[ml_name + 'length']).flat[0]),
+            })
+        mooring['line_types'] = []
+        for i in range(nline_types):
+            lt_name = f'mooring_line_type{i+1}_'
+            lt = {'name': mooring_opt[lt_name + 'name']}
+            for prop in ('diameter', 'mass_density', 'stiffness',
+                         'breaking_load', 'cost', 'transverse_added_mass',
+                         'tangential_added_mass', 'transverse_drag',
+                         'tangential_drag'):
+                lt[prop] = float(np.asarray(inputs[lt_name + prop]).flat[0])
+            mooring['line_types'].append(lt)
+        mooring['anchor_types'] = [{
+            'name': 'drag_embedment', 'mass': 1e3, 'cost': 1e4,
+            'max_vertical_load': 0.0, 'max_lateral_load': 1e5}]
+        design['mooring'] = mooring
+
+        # ---- DLC cases: keep spectral-wind rows only (reference :676-686) ----
+        turb_ind = modeling_opt['raft_dlcs_keys'].index('turbulence')
+        case_mask = [any(tt in str(cd[turb_ind]) for tt in ('NTM', 'ETM', 'EWM'))
+                     for cd in modeling_opt['raft_dlcs']]
+        design['cases'] = {
+            'keys': modeling_opt['raft_dlcs_keys'],
+            'data': list(compress(modeling_opt['raft_dlcs'], case_mask)),
+        }
+        return design, case_mask
+
+    # ------------------------------------------------------------------
+    # compute (reference: omdao_raft.py:698-810)
+    # ------------------------------------------------------------------
+    def compute(self, inputs, outputs, discrete_inputs=None,
+                discrete_outputs=None):
+        from raft_tpu.model import Model
+
+        modeling_opt = self.options['modeling_options']
+        design, case_mask = self.build_design(inputs, discrete_inputs)
+
+        model = Model(design)
+        model.analyzeUnloaded(
+            ballast=modeling_opt.get('trim_ballast', 0)
+            if hasattr(modeling_opt, 'get') else modeling_opt['trim_ballast'],
+            heave_tol=modeling_opt['heave_tol'])
+        model.analyzeCases()
+        results = model.calcOutputs()
+
+        # properties pattern-match (reference :750-755)
+        for name, _meta in self.list_outputs(out_stream=None, all_procs=True):
+            if name.startswith('properties_'):
+                key = name.split('properties_')[1]
+                if key in results['properties']:
+                    val = np.asarray(results['properties'][key], float)
+                    outputs[name] = val.reshape(np.shape(outputs[name])) \
+                        if np.size(val) == np.size(outputs[name]) else val
+
+        # per-case statistics (reference :766-776)
+        names = ['surge', 'sway', 'heave', 'roll', 'pitch', 'yaw',
+                 'AxRNA', 'Mbase', 'Tmoor']
+        stats = ['avg', 'std', 'max', 'PSD']
+        case_mask_arr = np.array(case_mask)
+        case_metrics = [cm[0] for cm in results['case_metrics'].values()
+                        if 0 in cm]
+        for n in names:
+            for s in stats:
+                iout = f'{n}_{s}'
+                stat = np.squeeze(np.array([cm[iout] for cm in case_metrics]))
+                full = np.asarray(outputs['stats_' + iout])
+                if n == 'Tmoor':
+                    stat = np.reshape(stat, (len(case_metrics), -1))
+                    ncol = min(stat.shape[-1], full.shape[-1]) \
+                        if full.ndim > 1 else stat.shape[-1]
+                    if s == 'PSD':
+                        stat3 = stat.reshape(len(case_metrics), -1,
+                                             model.nw)[:, :ncol, :]
+                        full[case_mask_arr, :ncol, :] = stat3
+                    else:
+                        full[case_mask_arr, :ncol] = stat[:, :ncol]
+                else:
+                    full[case_mask_arr] = stat
+                outputs['stats_' + iout] = full
+
+        # natural periods (reference :786-795)
+        fns, _modes = model.solveEigen()
+        periods = 1.0 / np.asarray(fns)[:6]
+        outputs['rigid_body_periods'] = periods
+        for idof, ch in enumerate(('surge', 'sway', 'heave', 'roll',
+                                   'pitch', 'yaw')):
+            outputs[f'{ch}_period'] = periods[idof]
+
+        # aggregates (reference :797-805)
+        def _stat(name):
+            return np.asarray(outputs['stats_' + name])[case_mask_arr]
+
+        outputs['Max_Offset'] = float(np.sqrt(
+            _stat('surge_max') ** 2 + _stat('sway_max') ** 2).max())
+        outputs['heave_avg'] = float(_stat('heave_avg').mean())
+        outputs['Max_PtfmPitch'] = float(_stat('pitch_max').max())
+        outputs['Std_PtfmPitch'] = float(_stat('pitch_std').mean())
+        outputs['max_nac_accel'] = float(np.max([
+            np.max(results['case_metrics'][ic][0]['AxRNA_std'])
+            for ic in results['case_metrics'] if 0 in results['case_metrics'][ic]]))
+        rated = float(np.asarray(inputs['rated_rotor_speed']).flat[0])
+        omega_max = np.max([
+            np.max(results['case_metrics'][ic][0]['omega_max'])
+            for ic in results['case_metrics'] if 0 in results['case_metrics'][ic]])
+        outputs['rotor_overspeed'] = (omega_max - rated) / rated if rated else 0.0
+        outputs['max_tower_base'] = float(np.max([
+            np.max(results['case_metrics'][ic][0]['Mbase_max'])
+            for ic in results['case_metrics'] if 0 in results['case_metrics'][ic]]))
+
+        # combined outputs for OpenFAST (reference :807-814)
+        stat0 = model._state[0]['statics']
+        outputs['platform_displacement'] = float(np.asarray(stat0['V']))
+        outputs['platform_total_center_of_mass'] = np.asarray(
+            results['properties']['substructure CG'], float)
+        outputs['platform_mass'] = float(
+            results['properties']['substructure mass'])
+        I_total = np.asarray(outputs['platform_I_total'])
+        I_total[:3] = np.r_[
+            np.atleast_1d(results['properties']['roll inertia at subCG'])[0],
+            np.atleast_1d(results['properties']['pitch inertia at subCG'])[0],
+            np.atleast_1d(results['properties']['yaw inertia at subCG'])[0]]
+        outputs['platform_I_total'] = I_total
+
+
+class RAFT_Group(_GroupBase):
+    """Group wrapper promoting the RAFT component (reference:
+    omdao_raft.py:816-831)."""
+
+    def initialize(self):
+        self.options.declare('modeling_options')
+        self.options.declare('turbine_options')
+        self.options.declare('mooring_options')
+        self.options.declare('member_options')
+        self.options.declare('analysis_options')
+
+    def setup(self):
+        self.add_subsystem('raft', RAFT_OMDAO(
+            modeling_options=self.options['modeling_options'],
+            analysis_options=self.options['analysis_options'],
+            turbine_options=self.options['turbine_options'],
+            mooring_options=self.options['mooring_options'],
+            member_options=self.options['member_options']), promotes=['*'])
+
+
+# ----------------------------------------------------------------------
+# design-dict -> omdao options/inputs (inverse mapping; test + CLI aid)
+# ----------------------------------------------------------------------
+
+def omdao_from_design(design: dict, n_aoa=200):
+    """Build (options, inputs, discrete_inputs) for :class:`RAFT_OMDAO`
+    from a RAFT design dictionary — the inverse of ``build_design``.
+
+    Lets a yaml-defined design be driven through the exact WEIS/OpenMDAO
+    interface without WEIS present (and gives tests a closed loop:
+    design -> OM inputs -> ``build_design`` -> design).  Airfoil polars are
+    resampled onto one shared ``n_aoa``-point angle-of-attack grid, since
+    the OM interface stores all polars on a common grid; stations are
+    normalized to [0, 1] the way WEIS supplies them (the yaml path allows
+    arbitrary monotonic station scales, reference: raft_member.py:71-82).
+    """
+
+    def _norm_stations(st):
+        st = np.asarray(st, float)
+        return (st - st[0]) / (st[-1] - st[0])
+
+    def _norm_stations_of(vals, st):
+        st = np.asarray(st, float)
+        return (np.asarray(vals, float) - st[0]) / (st[-1] - st[0])
+
+    design = copy.deepcopy(design)
+    turbine = design['turbine']
+    tower = turbine['tower']
+    if isinstance(tower, list):
+        tower = tower[0]
+    blade = turbine['blade']
+    geom = np.asarray(blade['geometry'], float)
+    airfoils = turbine['airfoils']
+    af_pos = [float(a[0]) for a in blade['airfoils']]
+    af_used = [str(a[1]) for a in blade['airfoils']]
+    aoa_grid = np.linspace(-np.pi, np.pi, n_aoa)
+    n_af = len(airfoils)
+    cl = np.zeros((n_af, n_aoa, 1, 1))
+    cd = np.zeros((n_af, n_aoa, 1, 1))
+    cm = np.zeros((n_af, n_aoa, 1, 1))
+    for i, af in enumerate(airfoils):
+        data = np.asarray(af['data'], float)
+        aoa_rad = np.deg2rad(data[:, 0])
+        cl[i, :, 0, 0] = np.interp(aoa_grid, aoa_rad, data[:, 1])
+        cd[i, :, 0, 0] = np.interp(aoa_grid, aoa_rad, data[:, 2])
+        cm[i, :, 0, 0] = np.interp(aoa_grid, aoa_rad,
+                                   data[:, 3] if data.shape[1] > 3
+                                   else np.zeros(len(data)))
+
+    settings = design.get('settings', {})
+    cases = design['cases']
+    site = design['site']
+    platform = design['platform']
+    members = platform['members']
+    mooring = design['mooring']
+
+    tower_d = tower['d']
+    tower_scalar_d = np.isscalar(tower_d)
+    tower_scalar_t = np.isscalar(tower['t'])
+    tower_scalar_c = np.isscalar(tower['Cd'])
+    turbine_options = {
+        'npts': 1 if tower_scalar_d else len(np.atleast_1d(tower['stations'])),
+        'PC_GS_n': len(turbine['pitch_control']['GS_Angles']),
+        'n_span': geom.shape[0],
+        'n_aoa': n_aoa, 'n_Re': 1, 'n_tab': 1,
+        'n_pc': len(turbine['wt_ops']['v']),
+        'n_af': n_af,
+        'af_used_names': af_used,
+        'shape': tower['shape'],
+        'scalar_diameters': tower_scalar_d,
+        'scalar_thicknesses': tower_scalar_t,
+        'scalar_coefficients': tower_scalar_c,
+    }
+
+    member_options = {
+        'nmembers': len(members),
+        'npts': [], 'npts_lfill': [], 'npts_rho_fill': [], 'ncaps': [],
+        'nreps': [], 'shape': [], 'scalar_thicknesses': [],
+        'scalar_diameters': [], 'scalar_coefficients': [],
+        'n_ballast_type': 2,
+    }
+    for i, mem in enumerate(members):
+        member_options['npts'].append(len(np.atleast_1d(mem['stations'])))
+        lf = np.atleast_1d(np.asarray(mem.get('l_fill', []), float))
+        member_options['npts_lfill'].append(len(lf) if np.any(lf) or len(lf) > 1 else 0)
+        member_options['npts_rho_fill'].append(member_options['npts_lfill'][-1])
+        member_options['ncaps'].append(len(np.atleast_1d(
+            np.asarray(mem.get('cap_stations', []), float))))
+        member_options['nreps'].append(len(np.atleast_1d(
+            np.asarray(mem.get('heading', []), float)))
+            if 'heading' in mem else 0)
+        member_options['shape'].append(mem['shape'])
+        member_options['scalar_thicknesses'].append(np.isscalar(mem['t']))
+        member_options['scalar_diameters'].append(np.isscalar(mem['d']))
+        member_options['scalar_coefficients'].append(np.isscalar(mem['Cd']))
+        member_options[f'platform_member{i+1}_potMod'] = bool(
+            mem.get('potMod', False))
+
+    mooring_options = {
+        'nlines': len(mooring['lines']),
+        'nline_types': len(mooring['line_types']),
+        'nconnections': len(mooring['points']),
+    }
+    for i, pt in enumerate(mooring['points']):
+        mooring_options[f'mooring_point{i+1}_name'] = pt['name']
+        mooring_options[f'mooring_point{i+1}_type'] = pt['type']
+    for i, ln in enumerate(mooring['lines']):
+        mooring_options[f'mooring_line{i+1}_endA'] = ln['endA']
+        mooring_options[f'mooring_line{i+1}_endB'] = ln['endB']
+        mooring_options[f'mooring_line{i+1}_type'] = ln['type']
+    for i, lt in enumerate(mooring['line_types']):
+        mooring_options[f'mooring_line_type{i+1}_name'] = lt['name']
+
+    min_freq = float(settings.get('min_freq', 0.01))
+    max_freq = float(settings.get('max_freq', 1.0))
+    nfreq = len(np.arange(min_freq, max_freq + 0.5 * min_freq, min_freq))
+    modeling_options = {
+        'nfreq': nfreq,
+        'n_cases': len(cases['data']),
+        'xi_start': float(settings.get('XiStart', 0.1)),
+        'min_freq': min_freq,
+        'max_freq': max_freq,
+        'nIter': int(settings.get('nIter', 15)),
+        'potential_model_override': int(platform.get('potModMaster', 0)),
+        'dls_max': float(platform.get('dlsMax', 5.0)),
+        'min_freq_BEM': float(platform.get('min_freq_BEM', min_freq - 1e-7)),
+        'raft_dlcs_keys': list(cases['keys']),
+        'raft_dlcs': [list(row) for row in cases['data']],
+        'trim_ballast': 0,
+        'heave_tol': 1.0,
+        'save_designs': False, 'plot_designs': False,
+    }
+    analysis_options = {'general': {'fname_output': 'raft_tpu',
+                                    'folder_output': '.'}}
+
+    options = dict(modeling_options=modeling_options,
+                   turbine_options=turbine_options,
+                   member_options=member_options,
+                   mooring_options=mooring_options,
+                   analysis_options=analysis_options)
+
+    inputs = {
+        'turbine_mRNA': turbine['mRNA'], 'turbine_IxRNA': turbine['IxRNA'],
+        'turbine_IrRNA': turbine['IrRNA'],
+        'turbine_xCG_RNA': turbine['xCG_RNA'],
+        'turbine_hHub': turbine['hHub'],
+        'turbine_overhang': turbine['overhang'],
+        'turbine_Fthrust': float(turbine.get('Fthrust', 0.0)),
+        'turbine_yaw_stiffness': float(platform.get('yaw_stiffness', 0.0)),
+        'gear_ratio': float(turbine.get('gear_ratio', 1.0)),
+        'turbine_tower_rA': np.asarray(tower['rA'], float),
+        'turbine_tower_rB': np.asarray(tower['rB'], float),
+        'turbine_tower_gamma': float(tower.get('gamma', 0.0)),
+        'turbine_tower_stations': _norm_stations(tower['stations']),
+        'turbine_tower_d': tower['d'],
+        'turbine_tower_t': tower['t'],
+        'turbine_tower_Cd': tower['Cd'], 'turbine_tower_Ca': tower['Ca'],
+        'turbine_tower_CdEnd': tower['CdEnd'],
+        'turbine_tower_CaEnd': tower['CaEnd'],
+        'turbine_tower_rho_shell': float(tower['rho_shell']),
+        'rotor_PC_GS_angles': np.asarray(
+            turbine['pitch_control']['GS_Angles'], float),
+        'rotor_PC_GS_Kp': np.asarray(turbine['pitch_control']['GS_Kp'], float),
+        'rotor_PC_GS_Ki': np.asarray(turbine['pitch_control']['GS_Ki'], float),
+        'Fl_Kp': float(turbine['pitch_control'].get('Fl_Kp', 0.0)),
+        'rotor_inertia': float(turbine.get('I_drivetrain', 0.0)),
+        'rotor_TC_VS_Kp': float(turbine['torque_control']['VS_KP']),
+        'rotor_TC_VS_Ki': float(turbine['torque_control']['VS_KI']),
+        'tilt': float(turbine.get('shaft_tilt', 0.0)),
+        'precone': float(turbine.get('precone', 0.0)),
+        'wind_reference_height': float(turbine['Zhub']),
+        'hub_radius': float(turbine['Rhub']),
+        'blade_r': geom[:, 0], 'blade_chord': geom[:, 1],
+        'blade_theta': geom[:, 2], 'blade_precurve': geom[:, 3],
+        'blade_presweep': geom[:, 4],
+        'blade_Rtip': float(blade['Rtip']),
+        'blade_precurveTip': float(blade.get('precurveTip', 0.0)),
+        'blade_presweepTip': float(blade.get('presweepTip', 0.0)),
+        'airfoils_position': np.asarray(af_pos, float),
+        'airfoils_r_thick': np.asarray(
+            [af.get('relative_thickness', 0.2) for af in airfoils], float),
+        'airfoils_aoa': aoa_grid,
+        'airfoils_cl': cl, 'airfoils_cd': cd, 'airfoils_cm': cm,
+        'rotor_powercurve_v': np.asarray(turbine['wt_ops']['v'], float),
+        'rotor_powercurve_omega_rpm': np.asarray(
+            turbine['wt_ops']['omega_op'], float),
+        'rotor_powercurve_pitch': np.asarray(
+            turbine['wt_ops']['pitch_op'], float),
+        'rho_air': float(site.get('rho_air', 1.225)),
+        'rho_water': float(site.get('rho_water', 1025.0)),
+        'mu_air': float(site.get('mu_air', 1.81e-5)),
+        'shear_exp': float(site.get('shearExp', 0.2)),
+        'rated_rotor_speed': float(np.max(turbine['wt_ops']['omega_op'])),
+        'mooring_water_depth': float(site['water_depth']),
+    }
+    for i, mem in enumerate(members):
+        m = f'platform_member{i+1}_'
+        inputs[m + 'heading'] = np.atleast_1d(np.asarray(
+            mem.get('heading', np.zeros(0)), float))
+        inputs[m + 'rA'] = np.asarray(mem['rA'], float)
+        inputs[m + 'rB'] = np.asarray(mem['rB'], float)
+        inputs[m + 's_ghostA'] = 0.0
+        inputs[m + 's_ghostB'] = 1.0
+        inputs[m + 'gamma'] = float(mem.get('gamma', 0.0))
+        inputs[m + 'stations'] = _norm_stations(mem['stations'])
+        for key in ('d', 't', 'Cd', 'Ca', 'CdEnd', 'CaEnd'):
+            inputs[m + key] = mem[key]
+        inputs[m + 'rho_shell'] = float(mem['rho_shell'])
+        st = np.asarray(mem['stations'], float)
+        st_span = st[-1] - st[0]
+        if member_options['npts_lfill'][i] > 0:
+            # WEIS passes fill levels in the normalized station scale
+            inputs[m + 'l_fill'] = np.atleast_1d(
+                np.asarray(mem['l_fill'], float)) / st_span
+            inputs[m + 'rho_fill'] = np.atleast_1d(
+                np.asarray(mem['rho_fill'], float))
+        if member_options['ncaps'][i] > 0:
+            inputs[m + 'cap_stations'] = _norm_stations_of(
+                np.atleast_1d(np.asarray(mem['cap_stations'], float)), st)
+            inputs[m + 'cap_t'] = np.atleast_1d(
+                np.asarray(mem['cap_t'], float))
+            inputs[m + 'cap_d_in'] = np.atleast_1d(np.asarray(
+                mem.get('cap_d_in', np.zeros_like(inputs[m + 'cap_t'])), float))
+    for i, pt in enumerate(mooring['points']):
+        inputs[f'mooring_point{i+1}_location'] = np.asarray(
+            pt['location'], float)
+    for i, ln in enumerate(mooring['lines']):
+        inputs[f'mooring_line{i+1}_length'] = float(ln['length'])
+    for i, lt in enumerate(mooring['line_types']):
+        for prop in ('diameter', 'mass_density', 'stiffness', 'breaking_load',
+                     'cost', 'transverse_added_mass', 'tangential_added_mass',
+                     'transverse_drag', 'tangential_drag'):
+            inputs[f'mooring_line_type{i+1}_{prop}'] = float(
+                lt.get(prop, 0.0))
+
+    discrete_inputs = {
+        'nBlades': int(turbine.get('nBlades', 3)),
+        'airfoils_name': [af['name'] for af in airfoils],
+    }
+    return options, inputs, discrete_inputs

@@ -68,10 +68,20 @@ def variant_member(m, rA0=None, rB0=None, d_scale=None,
     else:
         sd_node = d_scale[None, :] if d_scale.ndim == 1 else d_scale
         sd_cap = jnp.mean(d_scale)
-    # caps: diameters scale, plate thickness (dA - dAi)/2 is preserved
-    cap_t = 0.5 * (jnp.asarray(m.cap_dA) - jnp.asarray(m.cap_dAi))
-    cap_dA = jnp.asarray(m.cap_dA) * sd_cap
+    # caps: diameters scale; ring caps keep their radial plate width
+    # (dA - dAi)/2, while solid caps (dAi == 0) must stay solid — scaling
+    # the width rule there would open a spurious hole of (1-s)*dA
+    cap_dA0 = jnp.asarray(m.cap_dA)
+    cap_dAi0 = jnp.asarray(m.cap_dAi)
+    cap_dBi0 = jnp.asarray(m.cap_dBi)
+    cap_dA = cap_dA0 * sd_cap
     cap_dB = jnp.asarray(m.cap_dB) * sd_cap
+    cap_tA = 0.5 * (cap_dA0 - cap_dAi0)
+    cap_tB = 0.5 * (jnp.asarray(m.cap_dB) - cap_dBi0)
+    cap_dAi = jnp.where(cap_dAi0 > 0.0,
+                        jnp.maximum(cap_dA - 2.0 * cap_tA, 0.0), 0.0)
+    cap_dBi = jnp.where(cap_dBi0 > 0.0,
+                        jnp.maximum(cap_dB - 2.0 * cap_tB, 0.0), 0.0)
     return dataclasses.replace(
         m,
         rA0=rA0, rB0=rB0, l=l,
@@ -87,7 +97,7 @@ def variant_member(m, rA0=None, rB0=None, d_scale=None,
         cap_L=jnp.asarray(m.cap_L) * sd_cap,
         cap_h=jnp.asarray(m.cap_h) * s_l,
         cap_dA=cap_dA, cap_dB=cap_dB,
-        cap_dAi=cap_dA - 2.0 * cap_t, cap_dBi=cap_dB - 2.0 * cap_t,
+        cap_dAi=cap_dAi, cap_dBi=cap_dBi,
     )
 
 

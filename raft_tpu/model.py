@@ -157,6 +157,20 @@ class Model:
         if case:
             tc = fowt_turbine_constants(fowt, case, X0)
             state["turbine"] = tc
+            # cavitation check for operating submerged rotors (reference:
+            # raft_fowt.py:826-827 -> raft_rotor.py:639-696)
+            status = str(case.get("turbine_status", "operating"))
+            cav = []
+            for rot in fowt.rotors:
+                if rot.hubHt < 0 and status == "operating" and \
+                        float(get_from_dict(case, "current_speed", shape=0,
+                                            default=0.0)) > 0:
+                    from raft_tpu.models.rotor import calc_cavitation
+                    cav.append(calc_cavitation(rot, case))
+            if cav:
+                state["cavitation"] = cav
+            else:
+                state.pop("cavitation", None)
             hc = fowt_hydro_constants(fowt, pose0)
             state["hydro0"] = hc
             cur_speed = float(get_from_dict(case, "current_speed", shape=0, default=0.0))
@@ -872,6 +886,11 @@ class Model:
 
         results["wave_PSD"] = np.asarray(
             get_psd(state["seastate"]["zeta"], dw, source_axis=0))
+
+        # cavitation check results for submerged rotors (reference:
+        # raft_fowt.py:2047-2049)
+        if "cavitation" in state:
+            results["cavitation"] = state["cavitation"]
 
         # rotor control channels (reference :1976-2045)
         for key in ("omega", "torque", "power", "bPitch"):

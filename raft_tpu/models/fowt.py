@@ -203,6 +203,20 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
         for ir in range(nrotors):
             rotors.append(build_rotor(turbine, w, ir))
 
+        # fully-submerged rotors get per-element blade members for added
+        # mass / buoyancy / inertial excitation (reference:
+        # raft_rotor.py:369-373 creates bladeMemberList when
+        # r3[2] + R_rot < 0; raft_fowt.py:384-444, 873-880 consume it).
+        # Appended last so platform/tower member indexing is unchanged.
+        from raft_tpu.models.rotor import blade_member_dicts
+        for rot in rotors:
+            if rot.hubHt + rot.R_rot < 0:
+                for bm in blade_member_dicts(rot):
+                    bm.setdefault("dlsMax", dlsMax)
+                    members.append(build_member_geometry(bm))
+                    member_types.append(3)
+                    member_names.append("blade")
+
     moor = None
     if design.get("mooring"):
         moor = mr.parse_mooring(design["mooring"], rho=rho_water, g=g,
@@ -399,7 +413,10 @@ def fowt_statics(fowt: FOWTModel, pose, l_fill=None, rho_fill=None):
     for i, (m, mtype, mname) in enumerate(zip(fowt.members, fowt.member_types,
                                               fowt.member_names)):
         mpose = pose["members"][i]
-        if mname != "nacelle":
+        # nacelles and underwater-rotor blade members contribute buoyancy
+        # only — their inertia lives in mRNA/IxRNA/IrRNA (reference:
+        # raft_fowt.py:447-464 nacelles, :402-405 blade members)
+        if mname not in ("nacelle", "blade"):
             lf = None if l_fill is None else l_fill[i]
             rf = None if rho_fill is None else rho_fill[i]
             inert = member_inertia(m, mpose, rPRP=rPRP, l_fill=lf, rho_fill=rf)

@@ -106,6 +106,9 @@ class RotorModel:
     Ca_interp: np.ndarray = field(default=None, repr=False)
     r_thick_interp: np.ndarray = field(default=None, repr=False)
     aoa_grid: np.ndarray = field(default=None, repr=False)
+    # rotor axis unit vector in the platform frame at build (tilt+toe
+    # applied, zero nacelle yaw) — the reference's q_rel (raft_rotor.py:100)
+    q_rel0: np.ndarray = field(default=None, repr=False)
 
 
 # --------------------------------------------------------------------------
@@ -213,13 +216,23 @@ def build_rotor(turbine: dict, w, ir: int = 0) -> RotorModel:
     names = [a["name"] for a in afs]
     thick = np.array([a["relative_thickness"] for a in afs], float)
     Ca_af = np.array([a.get("added_mass_coeff", [0.5, 1.0]) for a in afs], float)
-    cpmin_flag = len(np.array(afs[0]["data"])[0]) > 4
     tables = {}
     for a in afs:
-        tab = np.array(a["data"], float)
+        # airfoils may differ in column count (5th cpmin column optional,
+        # e.g. FOCTT_example.yaml) but each table must be internally
+        # consistent — silently truncating would zero cpmin and disable
+        # the cavitation check for that airfoil
+        rows = [np.asarray(row, float) for row in a["data"]]
+        ncols = {len(row) for row in rows}
+        if len(ncols) != 1:
+            raise ValueError(
+                f"airfoil '{a.get('name')}' polar rows have inconsistent "
+                f"column counts {sorted(ncols)}")
+        ncol = ncols.pop()
+        tab = np.stack(rows)
         cl = np.interp(aoa, tab[:, 0], tab[:, 1])
         cd = np.interp(aoa, tab[:, 0], tab[:, 2])
-        cpm = np.interp(aoa, tab[:, 0], tab[:, 4]) if cpmin_flag else np.zeros_like(aoa)
+        cpm = np.interp(aoa, tab[:, 0], tab[:, 4]) if ncol > 4 else np.zeros_like(aoa)
         # enforce +-pi continuity as the reference does (:228-239)
         cl[0] = cl[-1]; cd[0] = cd[-1]; cpm[0] = cpm[-1]
         tables[a["name"]] = (cl, cd, cpm)
@@ -297,6 +310,7 @@ def build_rotor(turbine: dict, w, ir: int = 0) -> RotorModel:
         cl_bp=cl_bp, cl_c=cl_c, cd_bp=cd_bp, cd_c=cd_c,
         cpmin_bp=cp_bp, cpmin_c=cp_c,
         Ca_interp=Ca_interp, r_thick_interp=r_thick_interp, aoa_grid=aoa_rad,
+        q_rel0=q_rel,
     )
 
 
@@ -769,3 +783,103 @@ def calc_aero(rot: RotorModel, w, case: dict, r6=None, current=False):
                                      pitch_deg=pitch_deg),
                 derivs=dict(dT_dU=dT_dU, dT_dOm=dT_dOm, dT_dPi=dT_dPi,
                             dQ_dU=dQ_dU, dQ_dOm=dQ_dOm, dQ_dPi=dQ_dPi))
+
+
+# --------------------------------------------------------------------------
+# underwater rotors (MHK): blade members + cavitation
+# --------------------------------------------------------------------------
+
+def _rodrigues_np(az_deg, axis):
+    """Rotation matrix about ``axis`` by the blade azimuth angle
+    (reference: raft_rotor.py:565-583 getBladeMemberPositions)."""
+    c = np.cos(np.deg2rad(az_deg))
+    s = np.sin(np.deg2rad(az_deg))
+    a = np.asarray(axis, float)
+    return np.array([
+        [c + a[0]**2*(1-c), a[0]*a[1]*(1-c) - a[2]*s, a[0]*a[2]*(1-c) + a[1]*s],
+        [a[1]*a[0]*(1-c) + a[2]*s, c + a[1]**2*(1-c), a[1]*a[2]*(1-c) - a[0]*s],
+        [a[2]*a[0]*(1-c) - a[1]*s, a[2]*a[1]*(1-c) + a[0]*s, c + a[2]**2*(1-c)]])
+
+
+def blade_member_dicts(rot: RotorModel):
+    """Rectangular member dicts for each blade element of a submerged rotor,
+    one set per blade at its build azimuth, positioned in the PLATFORM frame
+    (reference: raft_rotor.py:522-562 bladeGeometry2Member creates them
+    hub-relative and rotates per azimuth at use time, raft_fowt.py:384-444;
+    here the azimuth rotation is baked in at build so the members flow
+    through the same stacked-node strip kernels as everything else).
+
+    Each element becomes a rect member of chord x equivalent-area thickness
+    with the blade twist as gamma and the airfoil's added-mass coefficient
+    pair; Cd = 0 (drag handled by the rotor aero model).  The last element
+    is skipped, replicating the reference's ``range(len(blade_r)-1)``.
+    """
+    q = np.asarray(rot.q_rel0, float)
+    # 90-degree z-rotation of the rotor axis: the azimuth-zero blade
+    # direction (reference: raft_rotor.py:530 airfoil_zero_heading)
+    dir0 = np.array([[0.0, -1.0, 0.0], [1.0, 0.0, 0.0], [0.0, 0.0, 1.0]]) @ q
+    r_hub_rel = np.asarray(rot.r_rel, float) + q * rot.overhang
+    dr = float(rot.blade_r[1] - rot.blade_r[0])
+    mems = []
+    for az in np.atleast_1d(rot.azimuths):
+        R = _rodrigues_np(float(az), q)
+        for i in range(len(rot.blade_r) - 1):
+            chord = float(rot.chord[i])
+            rect_thick = (np.pi / 4.0) * chord * float(rot.r_thick_interp[i])
+            rA = r_hub_rel + R @ (dir0 * (rot.blade_r[i] - dr / 2.0))
+            rB = r_hub_rel + R @ (dir0 * (rot.blade_r[i] + dr / 2.0))
+            mems.append(dict(
+                name="blade", type=3, rA=rA, rB=rB, shape="rect",
+                stations=[0, 1],
+                d=[[chord, rect_thick], [chord, rect_thick]],
+                gamma=float(rot.theta_deg[i]), potMod=False,
+                Cd=0.0, Ca=list(np.atleast_1d(rot.Ca_interp[i])),
+                CdEnd=0.0, CaEnd=0.0, t=0.01, rho_shell=1850.0))
+    return mems
+
+
+def calc_cavitation(rot: RotorModel, case: dict, clearance_margin=1.0,
+                    Patm=101325.0, Pvap=2500.0, error_on_cavitation=False,
+                    display=0):
+    """Cavitation check for a submerged rotor (reference:
+    raft_rotor.py:639-696 calcCavitation).
+
+    For each blade (azimuth) and element: run the BEM at the case current
+    speed to get the relative velocity W and angle of attack, look up the
+    airfoil's minimum pressure coefficient, and compare the critical
+    cavitation number sigma_crit = (Patm + rho*g*|z| - Pvap)/(0.5*rho*W^2)
+    against sigma_l = -cpmin.  Returns cav_check (nBlades, nr-ish):
+    negative entries cavitate.
+    """
+    if rot.hubHt >= 0:
+        raise ValueError("Hub depth must be below the water surface to "
+                         "calculate cavitation")
+    Uhub = float(get_from_dict(case, "current_speed", shape=0, default=0.0)) \
+        * rot.speed_gain
+    Omega_rpm = float(np.interp(Uhub, rot.Uhub_ops, rot.Omega_rpm_ops))
+    pitch_deg = float(np.interp(Uhub, rot.Uhub_ops, rot.pitch_deg_ops))
+
+    q = np.asarray(rot.q_rel0, float)
+    dir0 = np.array([[0.0, -1.0, 0.0], [1.0, 0.0, 0.0], [0.0, 0.0, 1.0]]) @ q
+    azimuths = np.atleast_1d(rot.azimuths)
+    cav = np.zeros((len(azimuths), len(rot.blade_r)))
+    for a, az in enumerate(azimuths):
+        _, _, W, alpha = _distributed_loads(
+            rot, Uhub, Omega_rpm, pitch_deg, float(az),
+            rot.shaft_tilt, 0.0)
+        cpmin = _ppoly_eval(jnp.asarray(rot.cpmin_bp),
+                            jnp.asarray(rot.cpmin_c), alpha)
+        # node depths at the zero-offset pose
+        R = _rodrigues_np(float(az), q)
+        z = rot.hubHt + (np.asarray(rot.blade_r)[:, None]
+                         * (R @ dir0)[None, :])[:, 2] * clearance_margin
+        W = np.asarray(W)
+        sigma_crit = (Patm + rot.rho * 9.81 * np.abs(z) - Pvap) \
+            / np.maximum(0.5 * rot.rho * W**2, 1e-9)
+        cav[a, :] = sigma_crit + np.asarray(cpmin)
+    if np.any(cav < 0.0):
+        if error_on_cavitation:
+            raise ValueError("Cavitation occurred at a blade node")
+        print("WARNING: Cavitation check found a blade node with cavitation "
+              "occurring")
+    return cav

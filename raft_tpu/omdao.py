@@ -27,113 +27,125 @@ ndof = 6
 try:  # pragma: no cover - environment dependent
     import openmdao.api as om
     _HAVE_OM = True
-    _ComponentBase = om.ExplicitComponent
-    _GroupBase = om.Group
 except ImportError:
     _HAVE_OM = False
 
-    class _OptionsDict(dict):
-        """Minimal stand-in for openmdao's OptionsDictionary."""
 
-        def declare(self, name, default=None, **kwargs):
-            self.setdefault(name, default)
+class _OptionsDict(dict):
+    """Minimal stand-in for openmdao's OptionsDictionary."""
 
-    class _Vector(dict):
-        """Key->value store that mimics openmdao vector __getitem__."""
+    def declare(self, name, default=None, **kwargs):
+        self.setdefault(name, default)
 
-    class _ComponentBase:
-        """API-compatible shim for ``om.ExplicitComponent``.
 
-        Supports the subset the adapter uses: ``options.declare``,
-        ``add_input``/``add_discrete_input``/``add_output``,
-        ``list_inputs``/``list_outputs`` and a ``run`` driver that mirrors
-        ``prob.run_model()`` for a single component.
-        """
+class _Vector(dict):
+    """Key->value store that mimics openmdao vector __getitem__."""
 
-        def __init__(self, **options):
-            self.options = _OptionsDict()
-            self.initialize()
-            for k, v in options.items():
-                self.options[k] = v
-            self._inputs = _Vector()
-            self._discrete_inputs = _Vector()
-            self._outputs = _Vector()
-            self._discrete_outputs = _Vector()
-            self._is_setup = False
 
-        # --- declaration API ---
-        def initialize(self):
-            pass
+class _ShimComponent:
+    """API-compatible stand-in for ``om.ExplicitComponent``.
 
-        def setup(self):
-            pass
+    Supports the subset the adapter uses: ``options.declare``,
+    ``add_input``/``add_discrete_input``/``add_output``,
+    ``list_inputs``/``list_outputs`` plus a ``prime``/``run`` driver that
+    mirrors ``prob.run_model()`` for a single component.  Always defined —
+    ``RAFT_OMDAO_Standalone`` uses it as its driver even when the real
+    openmdao is installed.
+    """
 
-        def add_input(self, name, val=0.0, units=None, desc=''):
-            self._inputs[name] = np.array(val, dtype=float) \
-                if not np.isscalar(val) else float(val)
+    def __init__(self, **options):
+        self.options = _OptionsDict()
+        self.initialize()
+        for k, v in options.items():
+            self.options[k] = v
+        self._inputs = _Vector()
+        self._discrete_inputs = _Vector()
+        self._outputs = _Vector()
+        self._discrete_outputs = _Vector()
+        self._is_setup = False
 
-        def add_discrete_input(self, name, val=None, desc=''):
-            self._discrete_inputs[name] = val
+    # --- declaration API ---
+    def initialize(self):
+        pass
 
-        def add_output(self, name, val=0.0, units=None, desc=''):
-            self._outputs[name] = np.array(val, dtype=float) \
-                if not np.isscalar(val) else float(val)
+    def setup(self):
+        pass
 
-        def add_discrete_output(self, name, val=None, desc=''):
-            self._discrete_outputs[name] = val
+    def add_input(self, name, val=0.0, units=None, desc=''):
+        self._inputs[name] = np.array(val, dtype=float) \
+            if not np.isscalar(val) else float(val)
 
-        # --- introspection API (reference uses these in compute) ---
-        def list_inputs(self, out_stream=None, all_procs=False):
-            return [(k, {'val': v}) for k, v in self._inputs.items()]
+    def add_discrete_input(self, name, val=None, desc=''):
+        self._discrete_inputs[name] = val
 
-        def list_outputs(self, out_stream=None, all_procs=False):
-            return [(k, {'val': v}) for k, v in self._outputs.items()]
+    def add_output(self, name, val=0.0, units=None, desc=''):
+        self._outputs[name] = np.array(val, dtype=float) \
+            if not np.isscalar(val) else float(val)
 
-        # --- driver ---
-        def prime(self, inputs=None, discrete_inputs=None):
-            """setup() once and overlay the provided input values (no
-            compute) — lets callers inspect the merged input vector or call
-            ``build_design`` without paying for a model run."""
-            if not self._is_setup:
-                self.setup()
-                self._is_setup = True
-            if inputs:
-                for k, v in inputs.items():
-                    if k not in self._inputs:
-                        raise KeyError(f"unknown input '{k}'")
-                    self._inputs[k] = np.asarray(v, dtype=float) \
-                        if not np.isscalar(v) else float(v)
-            if discrete_inputs:
-                for k, v in discrete_inputs.items():
-                    self._discrete_inputs[k] = v
-            return self._inputs
+    def add_discrete_output(self, name, val=None, desc=''):
+        self._discrete_outputs[name] = val
 
-        def run(self, inputs=None, discrete_inputs=None):
-            """prime() then compute() — mirrors prob.run_model()."""
-            self.prime(inputs, discrete_inputs)
-            self.compute(self._inputs, self._outputs,
-                         self._discrete_inputs, self._discrete_outputs)
-            return self._outputs
+    # --- introspection API (reference uses these in compute) ---
+    def list_inputs(self, out_stream=None, all_procs=False):
+        return [(k, {'val': v}) for k, v in self._inputs.items()]
 
-    class _GroupBase:
-        """Shim for ``om.Group`` holding promoted subsystems."""
+    def list_outputs(self, out_stream=None, all_procs=False):
+        return [(k, {'val': v}) for k, v in self._outputs.items()]
 
-        def __init__(self, **options):
-            self.options = _OptionsDict()
-            self.initialize()
-            for k, v in options.items():
-                self.options[k] = v
-            self._subsystems = {}
+    # --- driver ---
+    def prime(self, inputs=None, discrete_inputs=None):
+        """setup() once and overlay the provided input values (no
+        compute) — lets callers inspect the merged input vector or call
+        ``build_design`` without paying for a model run."""
+        if not self._is_setup:
+            self.setup()
+            self._is_setup = True
+        if inputs:
+            for k, v in inputs.items():
+                if k not in self._inputs:
+                    raise KeyError(f"unknown input '{k}'")
+                self._inputs[k] = np.asarray(v, dtype=float) \
+                    if not np.isscalar(v) else float(v)
+        if discrete_inputs:
+            for k, v in discrete_inputs.items():
+                self._discrete_inputs[k] = v
+        return self._inputs
 
-        def initialize(self):
-            pass
+    def run(self, inputs=None, discrete_inputs=None):
+        """prime() then compute() — mirrors prob.run_model()."""
+        self.prime(inputs, discrete_inputs)
+        self.compute(self._inputs, self._outputs,
+                     self._discrete_inputs, self._discrete_outputs)
+        return self._outputs
 
-        def setup(self):
-            pass
 
-        def add_subsystem(self, name, comp, promotes=None):
-            self._subsystems[name] = comp
-            return comp
+class _ShimGroup:
+    """Stand-in for ``om.Group`` holding promoted subsystems."""
+
+    def __init__(self, **options):
+        self.options = _OptionsDict()
+        self.initialize()
+        for k, v in options.items():
+            self.options[k] = v
+        self._subsystems = {}
+
+    def initialize(self):
+        pass
+
+    def setup(self):
+        pass
+
+    def add_subsystem(self, name, comp, promotes=None):
+        self._subsystems[name] = comp
+        return comp
+
+
+if _HAVE_OM:  # pragma: no cover - environment dependent
+    _ComponentBase = om.ExplicitComponent
+    _GroupBase = om.Group
+else:
+    _ComponentBase = _ShimComponent
+    _GroupBase = _ShimGroup
 
 
 class RAFT_OMDAO(_ComponentBase):
@@ -499,7 +511,10 @@ class RAFT_OMDAO(_ComponentBase):
             s_0 = np.array(inputs[m_name + 'stations'], float)
             idx = np.logical_and(s_0 >= s_ghostA, s_0 <= s_ghostB)
             s_grid = np.unique(np.r_[s_ghostA, s_0[idx], s_ghostB])
-            mnpts = len(idx)
+            # NOTE: the reference uses len(idx) (= the untrimmed station
+            # count, omdao_raft.py:525) — its Member tolerates a longer 'd'
+            # list, this package's parser does not, so use the real grid
+            mnpts = len(s_grid)
             mem['rA'] = rA_0 + s_ghostA * (rB_0 - rA_0)
             mem['rB'] = rA_0 + s_ghostB * (rB_0 - rA_0)
             mem['stations'] = s_grid
@@ -655,8 +670,7 @@ class RAFT_OMDAO(_ComponentBase):
 
         model = Model(design)
         model.analyzeUnloaded(
-            ballast=modeling_opt.get('trim_ballast', 0)
-            if hasattr(modeling_opt, 'get') else modeling_opt['trim_ballast'],
+            ballast=modeling_opt.get('trim_ballast', 0),
             heave_tol=modeling_opt['heave_tol'])
         model.analyzeCases()
         results = model.calcOutputs()
@@ -758,6 +772,19 @@ class RAFT_Group(_GroupBase):
             turbine_options=self.options['turbine_options'],
             mooring_options=self.options['mooring_options'],
             member_options=self.options['member_options']), promotes=['*'])
+
+
+class RAFT_OMDAO_Standalone(_ShimComponent):
+    """RAFT_OMDAO with the shim driver regardless of whether openmdao is
+    installed — the standalone entry for running the WEIS interface without
+    an ``om.Problem`` (tests, CLI).  Same declarations/compute as
+    RAFT_OMDAO; only the component base differs."""
+
+    initialize = RAFT_OMDAO.initialize
+    setup = RAFT_OMDAO.setup
+    _add_member_shape_inputs = RAFT_OMDAO._add_member_shape_inputs
+    build_design = RAFT_OMDAO.build_design
+    compute = RAFT_OMDAO.compute
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,119 @@
+"""MHK (underwater-rotor) support: blade members, buoyancy, cavitation.
+
+Exercises the reference's marine-hydrokinetic capability surface
+(reference: raft_rotor.py:369-373, 522-696; raft_fowt.py:384-444,
+873-880) on the two MHK designs shipped with the reference
+(RM1_Floating, FOCTT_example).
+"""
+import numpy as np
+import pytest
+import yaml
+
+from raft_tpu.io.designs import load_design
+from raft_tpu.model import Model
+from raft_tpu.models.fowt import build_fowt, fowt_pose, fowt_statics
+from raft_tpu.models.rotor import blade_member_dicts, calc_cavitation
+
+
+@pytest.fixture(scope="module")
+def rm1_model():
+    design = load_design("RM1_Floating")
+    design["cases"]["data"] = design["cases"]["data"][:1]
+    return Model(design)
+
+
+def test_blade_members_created(rm1_model):
+    """Submerged rotors get (nBlades x (nr-1)) rectangular blade members
+    (reference: raft_rotor.py:528 creates len(blade_r)-1 members/blade)."""
+    fowt = rm1_model.fowtList[0]
+    rot = fowt.rotors[0]
+    assert rot.hubHt < 0 and rot.hubHt + rot.R_rot < 0
+    nblade = sum(1 for n in fowt.member_names if n == "blade")
+    assert nblade == len(rot.azimuths) * (len(rot.blade_r) - 1)
+    # blade members are rectangular chord x equivalent-area sections with
+    # the airfoil's added-mass pair and zero drag
+    bm = blade_member_dicts(rot)[0]
+    assert bm["shape"] == "rect"
+    chord, rect_t = bm["d"][0]
+    i0 = 0
+    assert chord == pytest.approx(float(rot.chord[i0]))
+    assert chord * rect_t == pytest.approx(
+        np.pi / 4 * chord**2 * float(rot.r_thick_interp[i0]))
+    assert bm["Cd"] == 0.0 and list(bm["Ca"]) == list(rot.Ca_interp[i0])
+
+
+def test_blade_buoyancy_counted(rm1_model):
+    """Blade members add displaced volume but no structural inertia
+    (reference: raft_fowt.py:402-444)."""
+    fowt = rm1_model.fowtList[0]
+    pose = fowt_pose(fowt, np.zeros(6))
+    stat = fowt_statics(fowt, pose)
+
+    # strip the blade members and rebuild: volume must drop, mass must not
+    design = load_design("RM1_Floating")
+    import raft_tpu.models.fowt as fmod
+    w = fowt.w
+    full_V = float(stat["V"])
+    full_m = float(stat["m"])
+
+    fowt2 = build_fowt(design, w, depth=fowt.depth)
+    keep = [i for i, n in enumerate(fowt2.member_names) if n != "blade"]
+    fowt2.members = [fowt2.members[i] for i in keep]
+    fowt2.member_types = [fowt2.member_types[i] for i in keep]
+    fowt2.member_names = [fowt2.member_names[i] for i in keep]
+    fowt2.nodes = fmod._build_nodeset(fowt2.members)
+    stat2 = fowt_statics(fowt2, fowt_pose(fowt2, np.zeros(6)))
+    assert float(stat2["V"]) < full_V
+    assert float(stat2["m"]) == pytest.approx(full_m, rel=1e-9)
+
+
+def test_rm1_end_to_end(rm1_model):
+    """RM1_Floating runs the full case pipeline with finite outputs and a
+    cavitation check attached (reference capability: designs/RM1_Floating)."""
+    m = rm1_model
+    m.analyzeUnloaded()
+    res = m.analyzeCases()
+    fns, _ = m.solveEigen()
+    assert np.all(np.isfinite(np.real(fns))) and np.all(np.real(fns) > 0)
+    cm = res["case_metrics"][0][0]
+    for ch in ("surge", "heave", "pitch"):
+        assert np.isfinite(cm[f"{ch}_std"])
+    assert "cavitation" in cm
+    cav = np.asarray(cm["cavitation"][0])
+    rot = m.fowtList[0].rotors[0]
+    assert cav.shape == (len(rot.azimuths), len(rot.blade_r))
+    # RM1 at its operating current does not cavitate
+    assert np.all(cav > 0.0)
+
+
+def test_cavitation_onset():
+    """Shallow fast rotors cavitate: sigma_crit + cpmin goes negative and
+    the error flag raises (reference: raft_rotor.py:686-694)."""
+    design = load_design("RM1_Floating")
+    m = Model(design)
+    rot = m.fowtList[0].rotors[0]
+    case = {"current_speed": float(design["cases"]["data"][0][9])}
+    cav_op = calc_cavitation(rot, case)
+    assert np.all(cav_op > 0.0)
+    # shrink the static-pressure margin (high vapor pressure): the same
+    # operating point must now cavitate and the error flag must raise
+    cav_low = calc_cavitation(rot, case, Pvap=3.0e5)
+    assert np.any(cav_low < 0.0)
+    assert cav_low.min() < cav_op.min()
+    with pytest.raises(ValueError, match="[Cc]avitation"):
+        calc_cavitation(rot, case, Pvap=3.0e5, error_on_cavitation=True)
+
+
+def test_foctt_end_to_end():
+    """FOCTT (model-scale MHK, aeroServoMod=2 on current) runs end-to-end
+    (reference capability: designs/FOCTT_example)."""
+    design = load_design("FOCTT_example")
+    design["cases"]["data"] = design["cases"]["data"][:1]
+    m = Model(design)
+    m.analyzeUnloaded()
+    res = m.analyzeCases()
+    cm = res["case_metrics"][0][0]
+    assert np.isfinite(cm["surge_std"]) and cm["surge_std"] > 0
+    assert np.isfinite(cm["pitch_std"])
+    # control channels exist for the servo rotor on current
+    assert cm["omega_avg"][0] > 0

@@ -12,7 +12,8 @@ import numpy as np
 import pytest
 import yaml
 
-from raft_tpu.omdao import RAFT_OMDAO, RAFT_Group, omdao_from_design
+from raft_tpu.omdao import (RAFT_OMDAO, RAFT_OMDAO_Standalone, RAFT_Group,
+                            omdao_from_design)
 
 REF_DESIGNS = "/root/reference/designs"
 
@@ -33,7 +34,7 @@ def _oc3_design():
 def oc3_om():
     design = _oc3_design()
     options, inputs, discrete_inputs = omdao_from_design(design)
-    comp = RAFT_OMDAO(**options)
+    comp = RAFT_OMDAO_Standalone(**options)
     outputs = comp.run(inputs, discrete_inputs)
     return design, comp, inputs, discrete_inputs, outputs
 

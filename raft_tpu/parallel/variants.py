@@ -370,7 +370,8 @@ def volturn_grid(design: dict, factors=(0.75, 1.0, 1.25)):
     # the per-variant design mutations, replicated on the flattened member
     # list (reference parametersweep.py:57-90); heading-expanded members of
     # one entry share the same local-frame mutation
-    base = build_fowt(design, np.asarray([1.0]), depth=600.0)
+    base = build_fowt(design, np.asarray([1.0]), depth=600.0,
+                      geometry_only=True)
     nmem = len(base.members)
     rA = np.tile(np.stack([np.asarray(m.rA0) for m in base.members]),
                  (nv, 1, 1))

@@ -46,6 +46,7 @@ from raft_tpu.ops.linalg import inv_complex, solve_complex
 from raft_tpu.ops.transforms import transform_force, translate_matrix_6to6
 from raft_tpu.models.member import member_inertia
 from raft_tpu.utils.dicttools import get_from_dict
+from raft_tpu.utils.profiling import timed
 
 RAD2DEG = 180.0 / np.pi
 
@@ -555,9 +556,10 @@ class Model:
             # raft_model.py:966-989)
             Xi1 = np.asarray(carry[1])
             RAO = np.asarray(get_rao(Xi1, seastate["zeta"][0]))
-            qtf_local = qt.calc_qtf_slender_body(
-                fowt, pose_eq, seastate["beta"][0], Xi0=RAO,
-                M_struc=stat["M_struc"])
+            with timed("calcQTF_slenderBody"):
+                qtf_local = qt.calc_qtf_slender_body(
+                    fowt, pose_eq, seastate["beta"][0], Xi0=RAO,
+                    M_struc=stat["M_struc"])
             qtf4 = np.asarray(qtf_local)[:, :, None, :]
             heads = np.array([seastate["beta"][0]])
             Fhydro_2nd_mean[0], f2 = (np.asarray(a) for a in qt.hydro_force_2nd(
@@ -740,20 +742,26 @@ class Model:
                             self.design["cases"]["data"][iCase]))
             case["iCase"] = iCase
             self.results["case_metrics"][iCase] = {}
-            self.solveStatics(case, display=display)
-            self.solveDynamics(case, display=display)
+            with timed("solveStatics"):
+                self.solveStatics(case, display=display)
+            with timed("solveDynamics"):
+                self.solveDynamics(case, display=display)
             # re-solve the operating point with mean wave drift included,
             # then clear it so it can't leak into the next case (reference:
             # raft_model.py:296-303)
             if any(f.potSecOrder > 0 for f in self.fowtList):
                 self.results["mean_offsets"].pop()   # superseded by re-solve
-                self.solveStatics(case, display=display)
+                with timed("solveStatics"):
+                    self.solveStatics(case, display=display)
                 for state in self._state:
                     state.pop("F_meandrift", None)
             for i, fowt in enumerate(self.fowtList):
                 self.results["case_metrics"][iCase][i] = {}
-                self.saveTurbineOutputs(
-                    self.results["case_metrics"][iCase][i], i, case)
+                with timed("saveTurbineOutputs"):
+                    self.saveTurbineOutputs(
+                        self.results["case_metrics"][iCase][i], i, case)
+                if display > 0:
+                    self._print_stats_table(iCase, i)
 
             # array-level mooring tension statistics through the coupled
             # tension Jacobian (reference: raft_model.py:345-388)
@@ -995,6 +1003,85 @@ class Model:
             stat["C_struc_sub"] + stat["C_hydro"]) + C_moor0
         return self.results
 
+    # ------------------------------------------------------------------
+    # observability: stats table, PSD export, plots
+    # ------------------------------------------------------------------
+
+    def _print_stats_table(self, iCase, ifowt):
+        """Console response-statistics table (reference:
+        raft_model.py:315-341)."""
+        m = self.results["case_metrics"][iCase][ifowt]
+        fowt = self.fowtList[ifowt]
+        print(f"---------------- FOWT {ifowt+1} Case {iCase+1} "
+              "Statistics ----------------")
+        print("Response channel     Average     RMS         Maximum     "
+              "Minimum")
+        for ch, unit in (("surge", "m"), ("sway", "m"), ("heave", "m"),
+                         ("roll", "deg"), ("pitch", "deg"), ("yaw", "deg")):
+            print(f"{(ch + ' (' + unit + ')').ljust(19)}"
+                  f"{m[ch + '_avg']:10.2e}  {m[ch + '_std']:10.2e}  "
+                  f"{m[ch + '_max']:10.2e}  {m[ch + '_min']:10.2e}")
+        for ir in range(fowt.nrotors):
+            print(f"nacelle acc (m/s2) {m['AxRNA_avg'][ir]:10.2e}  "
+                  f"{m['AxRNA_std'][ir]:10.2e}  {m['AxRNA_max'][ir]:10.2e}  "
+                  f"{m['AxRNA_min'][ir]:10.2e}")
+            print(f"tower bending (Nm) {m['Mbase_avg'][ir]:10.2e}  "
+                  f"{m['Mbase_std'][ir]:10.2e}  {m['Mbase_max'][ir]:10.2e}  "
+                  f"{m['Mbase_min'][ir]:10.2e}")
+            if m["omega_avg"][ir] != 0.0:
+                print(f"rotor speed (RPM)  {m['omega_avg'][ir]:10.2e}  "
+                      f"{m['omega_std'][ir]:10.2e}  "
+                      f"{m['omega_max'][ir]:10.2e}  "
+                      f"{m['omega_min'][ir]:10.2e}")
+                print(f"blade pitch (deg)  {m['bPitch_avg'][ir]:10.2e}  "
+                      f"{m['bPitch_std'][ir]:10.2e}")
+                print(f"rotor power        {m['power_avg'][ir]:10.2e}")
+        print("-----------------------------------------------------------")
+
+    def saveResponses(self, out_path):
+        """Per-case per-FOWT PSD text export (reference:
+        raft_model.py:1231-1261)."""
+        from raft_tpu.plot import save_responses
+        return save_responses(self, out_path)
+
+    def plotResponses(self, cases=None, ifowt=0):
+        from raft_tpu.plot import plot_responses
+        return plot_responses(self, cases=cases, ifowt=ifowt)
+
+    def plot(self, ax=None, color=None, station_plot=None):
+        """3D wireframe of the system (reference: raft_model.py:1333-1431)."""
+        from raft_tpu.plot import plot_model
+        return plot_model(self, ax=ax, color=color, plot2d=False,
+                          station_plot=station_plot)
+
+    def plot2d(self, ax=None, color=None, Xuvec=(1, 0, 0), Yuvec=(0, 0, 1)):
+        from raft_tpu.plot import plot_model
+        return plot_model(self, ax=ax, color=color, plot2d=True,
+                          Xuvec=Xuvec, Yuvec=Yuvec)
+
+    # ------------------------------------------------------------------
+    # wake coupling (FLORIS-equivalent, reference: raft_model.py:1674-2022)
+    # ------------------------------------------------------------------
+
+    def powerThrustCurve(self, speeds=None, ifowt=0):
+        """Cp/Ct/power/pitch tables vs wind speed from the BEM rotor
+        (reference: raft_model.py:1674-1750)."""
+        from raft_tpu.models.wake import power_thrust_curve
+        return power_thrust_curve(self, speeds=speeds, ifowt=ifowt)
+
+    def findWakeEquilibrium(self, case, k_w=0.05, **kw):
+        """Farm wake fixed point with the built-in Gaussian-deficit model
+        (reference: raft_model.py:1852-1994 florisFindEquilibrium).  The
+        returned case carries per-turbine wind speeds for analyzeCases."""
+        from raft_tpu.models.wake import find_wake_equilibrium
+        return find_wake_equilibrium(self, case, k_w=k_w, **kw)
+
+    def calcAEP(self, wind_rose, **kw):
+        """Wind-rose AEP with wake losses (reference:
+        raft_model.py:1996-2022 florisCalcAEP)."""
+        from raft_tpu.models.wake import calc_aep
+        return calc_aep(self, wind_rose, **kw)
+
 
 def run_raft(design_or_path, plots=0, ballast=False, station_plot=[]):
     """Convenience entry point (reference: raft_model.py:2024-2061)."""
@@ -1007,6 +1094,9 @@ def run_raft(design_or_path, plots=0, ballast=False, station_plot=[]):
         design = design_or_path
     model = Model(design)
     model.analyzeUnloaded(ballast=1 if ballast else 0)
-    model.analyzeCases()
+    model.analyzeCases(display=1 if plots else 0)
     model.calcOutputs()
+    if plots:
+        model.plot(station_plot=station_plot)
+        model.plotResponses()
     return model

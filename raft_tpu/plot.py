@@ -1,0 +1,185 @@
+"""Visualization + response export (host-side matplotlib, lazily imported).
+
+Equivalents of the reference's plotting surface (reference:
+raft_model.py:1194-1306 plotResponses/saveResponses, :1333-1431
+Model.plot/plot2d over Member.plot wireframes raft_member.py:1217-1317 and
+mooring line profiles).  All functions return the matplotlib objects so
+callers can restyle/save; nothing here touches the jit path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _mpl():
+    import matplotlib
+    import matplotlib.pyplot as plt
+    return plt
+
+
+def _member_wireframe(ax, geom, pose, color="k", nth=12, plot2d=False,
+                      Xuvec=(1, 0, 0), Yuvec=(0, 0, 1), station_plot=None):
+    """Side lines + station rings of one member (reference:
+    raft_member.py:1217-1317).  ``station_plot``: optional station indices
+    whose rings are drawn (default: all)."""
+    rA = np.asarray(pose["rA"])
+    q = np.asarray(pose["q"])
+    p1 = np.asarray(pose["p1"])
+    p2 = np.asarray(pose["p2"])
+    st = np.asarray(geom.stations, float)
+    th = np.linspace(0, 2 * np.pi, nth + 1)
+    rings = []
+    draw = set(range(len(st))) if not station_plot else set(station_plot)
+    for i, s in enumerate(st):
+        center = rA + q * s
+        if geom.circular:
+            r = 0.5 * float(np.atleast_1d(np.asarray(geom.d, float).reshape(len(st), -1)[i])[0])
+            ring = (center[None, :] + r * np.cos(th)[:, None] * p1[None, :]
+                    + r * np.sin(th)[:, None] * p2[None, :])
+        else:
+            sl = np.asarray(geom.d, float).reshape(len(st), -1)[i]
+            c1, c2 = 0.5 * sl[0], 0.5 * sl[-1]
+            corners = np.array([[c1, c2], [-c1, c2], [-c1, -c2], [c1, -c2],
+                                [c1, c2]])
+            ring = (center[None, :] + corners[:, 0:1] * p1[None, :]
+                    + corners[:, 1:2] * p2[None, :])
+        rings.append(ring)
+        if i in draw:
+            _plot_line(ax, ring, color, plot2d, Xuvec, Yuvec)
+    rings = np.array(rings)            # (nst, nth+1, 3)
+    for j in range(rings.shape[1]):
+        _plot_line(ax, rings[:, j, :], color, plot2d, Xuvec, Yuvec)
+
+
+def _plot_line(ax, pts, color, plot2d, Xuvec, Yuvec):
+    pts = np.asarray(pts)
+    if plot2d:
+        X = pts @ np.asarray(Xuvec, float)
+        Y = pts @ np.asarray(Yuvec, float)
+        ax.plot(X, Y, color=color, lw=0.6)
+    else:
+        ax.plot(pts[:, 0], pts[:, 1], pts[:, 2], color=color, lw=0.6)
+
+
+def _mooring_lines(ax, fowt, r6, color="b", plot2d=False,
+                   Xuvec=(1, 0, 0), Yuvec=(0, 0, 1), npts=30):
+    from raft_tpu.models import mooring as mr
+    moor = fowt.mooring
+    if moor is None or not hasattr(moor, "rFair0"):
+        return
+    rF = np.asarray(mr.fairlead_positions(moor, np.asarray(r6, float)))
+    rA = np.asarray(moor.rAnchor)
+    for i in range(len(rA)):
+        # simple sagged-line visualization: straight horizontal projection
+        # with a catenary-like vertical profile between anchor and fairlead
+        f = np.linspace(0.0, 1.0, npts)
+        xy = rA[i, :2][None, :] * (1 - f[:, None]) + rF[i, :2][None, :] * f[:, None]
+        sag = (np.cosh(2 * (f - 0.5)) - np.cosh(1.0))
+        z = rA[i, 2] * (1 - f) + rF[i, 2] * f + sag * 0.05 * abs(
+            rF[i, 2] - rA[i, 2])
+        pts = np.c_[xy, z]
+        _plot_line(ax, pts, color, plot2d, Xuvec, Yuvec)
+
+
+def plot_model(model, ax=None, color=None, plot2d=False,
+               Xuvec=(1, 0, 0), Yuvec=(0, 0, 1), station_plot=None):
+    """Wireframe of every FOWT (members + mooring) at its current mean
+    pose (reference: raft_model.py:1333-1431 plot/plot2d).
+
+    Returns (fig, ax)."""
+    plt = _mpl()
+    from raft_tpu.models.fowt import fowt_pose
+
+    if ax is None:
+        fig = plt.figure(figsize=(8, 8))
+        ax = fig.add_subplot(111) if plot2d else \
+            fig.add_subplot(111, projection="3d")
+    else:
+        fig = ax.get_figure()
+
+    for i, fowt in enumerate(model.fowtList):
+        state = model._state[i] if model._state[i] else {}
+        r6 = state.get("r6", np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0]))
+        pose = fowt_pose(fowt, np.asarray(r6, float))
+        c = color or "k"
+        for im, geom in enumerate(fowt.members):
+            mname = fowt.member_names[im]
+            mpose = {k: np.asarray(v) for k, v in pose["members"][im].items()}
+            _member_wireframe(ax, geom, mpose,
+                              color=("0.5" if mname == "blade" else c),
+                              plot2d=plot2d, Xuvec=Xuvec, Yuvec=Yuvec,
+                              station_plot=station_plot)
+        _mooring_lines(ax, fowt, r6, plot2d=plot2d, Xuvec=Xuvec, Yuvec=Yuvec)
+
+    if not plot2d:
+        ax.set_zlabel("z [m]")
+    ax.set_xlabel("x [m]")
+    ax.set_ylabel("y [m]" if not plot2d else "z [m]")
+    return fig, ax
+
+
+_PSD_CHANNELS = [("wave", "wave elevation", "m"),
+                 ("surge", "surge", "m"),
+                 ("heave", "heave", "m"),
+                 ("pitch", "pitch", "deg"),
+                 ("AxRNA", "nacelle acceleration", "m/s^2"),
+                 ("Mbase", "tower base moment", "N m")]
+
+
+def plot_responses(model, cases=None, ifowt=0):
+    """Stacked response PSD plots for the chosen cases (reference:
+    raft_model.py:1194-1230 plotResponses).  Returns (fig, axes)."""
+    plt = _mpl()
+    metrics = model.results.get("case_metrics")
+    if not metrics:
+        raise RuntimeError("run analyzeCases before plotting responses")
+    if cases is None:
+        cases = sorted(k for k in metrics if isinstance(k, int))
+
+    fig, axes = plt.subplots(len(_PSD_CHANNELS), 1, sharex=True,
+                             figsize=(7, 2 * len(_PSD_CHANNELS)))
+    for ic in cases:
+        cm = metrics[ic][ifowt]
+        for ax, (key, label, unit) in zip(axes, _PSD_CHANNELS):
+            psd = np.squeeze(np.asarray(cm[f"{key}_PSD"]))
+            if psd.ndim > 1:
+                psd = psd[:, 0]
+            ax.plot(model.w, psd, label=f"case {ic + 1}")
+            ax.set_ylabel(f"{label}\n[{unit}$^2$/(rad/s)]")
+    axes[0].legend(fontsize=8)
+    axes[-1].set_xlabel("frequency [rad/s]")
+    fig.tight_layout()
+    return fig, axes
+
+
+def save_responses(model, out_path):
+    """Write per-case per-FOWT response PSD text files (reference:
+    raft_model.py:1231-1261 saveResponses; same file naming and layout).
+    Returns the list of files written."""
+    choose = ["wave_PSD", "surge_PSD", "heave_PSD", "pitch_PSD",
+              "AxRNA_PSD", "Mbase_PSD"]
+    units = ["m^2/Hz", "m^2/Hz", "m^2/Hz", "deg^2/Hz", "(m/s^2)^2/Hz",
+             "(Nm)^2/Hz"]
+    written = []
+    metrics_all = model.results.get("case_metrics")
+    if not metrics_all:
+        raise RuntimeError("run analyzeCases before saving responses")
+    ncases = len([k for k in metrics_all if isinstance(k, int)])
+    for i in range(model.nFOWT):
+        for iCase in range(ncases):
+            metrics = metrics_all[iCase][i]
+            path = f"{out_path}_Case{iCase+1}_WT{i}.txt"
+            with open(path, "w") as f:
+                f.write("Frequency [rad/s] \t")
+                for metric, unit in zip(choose, units):
+                    f.write(f"{metric} [{unit}] \t")
+                f.write("\n")
+                for iFreq in range(len(model.w)):
+                    f.write(f"{model.w[iFreq]:.5f} \t")
+                    for metric in choose:
+                        val = np.squeeze(np.asarray(metrics[metric]))
+                        v = val[iFreq] if val.ndim == 1 else val[iFreq, 0]
+                        f.write(f"{float(v):.5f} \t")
+                    f.write("\n")
+            written.append(path)
+    return written

@@ -1,0 +1,184 @@
+"""Parametric-study builders and misc analysis utilities.
+
+Equivalents of the reference's L0 helpers that sit outside the physics
+kernels (reference: raft/helpers.py:966-1272): the parametric case-list
+builder, the WAMIT `.2` mean-drift reader, tower-base stress PSDs, and
+the design-dict mooring write-back.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.ops.spectra import get_psd
+from raft_tpu.utils.dicttools import get_from_dict
+
+#: changeType -> (case key to increment, extra keys swept in lockstep)
+#: (the reference hardcodes case-row column indices for the same studies,
+#: helpers.py:983-1063; keying on names is robust to column order)
+_SWEEP_KEYS = {
+    "misalignment": ("wave_heading2", ()),
+    "windMisalignment": ("wind_heading", ()),
+    "floaterRotation": ("wind_heading", ("wave_heading", "wave_heading2")),
+    "windSpeed": ("wind_speed", ()),
+    "waveHeight1": ("wave_height", ()),
+    "waveHeight2": ("wave_height2", ()),
+    "wavePeriod1": ("wave_period", ()),
+    "wavePeriod2": ("wave_period2", ()),
+}
+
+#: changeType -> (parametricAnalysis yaml keys for increment and count)
+_SWEEP_CONFIG = {
+    "misalignment": ("misalignmentAngle", "numMisalign"),
+    "windMisalignment": ("windMisalignmentAngle", "numWindMisalign"),
+    "floaterRotation": ("rotationAngle", "numRotations"),
+    "windSpeed": ("windSpeedIncrement", "numWSIncrements"),
+    "waveHeight1": ("waveHeightIncrement1", "numWHIncrements1"),
+    "waveHeight2": ("waveHeightIncrement2", "numWHIncrements2"),
+    "wavePeriod1": ("wavePeriodIncrement1", "numWPIncrements1"),
+    "wavePeriod2": ("wavePeriodIncrement2", "numWPIncrements2"),
+}
+
+
+def parametric_analysis_builder(design, change_type, start_value=None,
+                                parametric_analysis=True):
+    """Expand design['cases'] into a 1-D parameter sweep (reference:
+    helpers.py:983-1063 parametricAnalysisBuilder).
+
+    The sweep configuration comes from design['parametricAnalysis'] (an
+    increment and a count per study type); the first case row is the base,
+    optionally re-anchored at ``start_value``, and one row is appended per
+    increment.  Returns the mutated design.
+    """
+    if not parametric_analysis or change_type not in _SWEEP_CONFIG:
+        return design
+    pa = design.get("parametricAnalysis", {})
+    inc_key, num_key = _SWEEP_CONFIG[change_type]
+    inc = get_from_dict(pa, inc_key, default=0)
+    num = int(get_from_dict(pa, num_key, dtype=int, default=0))
+    if not inc or num <= 0:
+        return design
+
+    keys = list(design["cases"]["keys"])
+    main_key, extra_keys = _SWEEP_KEYS[change_type]
+    if main_key not in keys:
+        raise ValueError(f"case key '{main_key}' (needed for "
+                         f"{change_type} sweep) not in cases.keys")
+    i_main = keys.index(main_key)
+    i_extra = [keys.index(k) for k in extra_keys if k in keys]
+
+    base = list(design["cases"]["data"][0])
+    if start_value is not None:
+        base[i_main] = start_value
+        design["cases"]["data"][0] = base
+    for n in range(1, num + 1):
+        row = list(base)
+        row[i_main] = base[i_main] + inc * n
+        for ix in i_extra:
+            row[ix] = base[ix] + inc * n
+        design["cases"]["data"].append(row)
+    return design
+
+
+def retrieve_axis_par_analysis(iCase, case, change_type, xaxis,
+                               pa_dict=None):
+    """X-axis value + labels for parametric-study plots (reference:
+    helpers.py:1066-1111 retrieveAxisParAnalysis)."""
+    labels = {
+        "misalignment": ("wave_heading2", "Misalignment second wave system [deg]"),
+        "misalignment1": ("wave_heading", "Misalignment first wave system [deg]"),
+        "windMisalignment": ("wind_heading", "Wind heading [deg]"),
+        "windSpeed": ("wind_speed", "Average Wind Speed [m/s]"),
+        "waveHeight1": ("wave_height", "Wave Height system 1 [m]"),
+        "waveHeight2": ("wave_height2", "Wave Height system 2 [m]"),
+        "wavePeriod1": ("wave_period", "Wave Period system 1 [s]"),
+        "wavePeriod2": ("wave_period2", "Wave Period system 2 [s]"),
+    }
+    if change_type == "floaterRotation":
+        rot = get_from_dict(pa_dict or {}, "rotationAngle", default=0.0)
+        xaxis.append(iCase * rot)
+        return xaxis, "Floater rotation [deg]", \
+            f"Floater Rotation = {xaxis[-1]:.2f} deg"
+    if change_type in labels:
+        key, xlabel = labels[change_type]
+        xaxis.append(case[key])
+        return xaxis, xlabel, f"{key} = {xaxis[-1]:.2f}"
+    xaxis.append(iCase)
+    return xaxis, "Case number", f"Base Case {iCase + 1}"
+
+
+def read_wamit_p2(path, rho=1.0, L=1.0, g=1.0):
+    """Read a WAMIT `.2` mean-drift file into per-DOF complex matrices
+    (periods x headings), dimensionalized by rho*g*L^k (reference:
+    helpers.py:1236-1272 readWAMIT_p2)."""
+    data = np.loadtxt(path)
+    head = np.unique(data[:, 1])
+    period = np.unique(data[:, 0])
+    dof_names = ["surge", "sway", "heave", "roll", "pitch", "yaw"]
+    k_ulen = [2, 2, 2, 3, 3, 3]
+    out = {}
+    for i, name in enumerate(dof_names):
+        rows = data[data[:, 2] == i + 1, :]
+        rows = rows[np.lexsort((rows[:, 1], rows[:, 0]))]
+        re = rows[:, 5].reshape(-1, len(head))
+        im = rows[:, 6].reshape(-1, len(head))
+        out[name] = (re + 1j * im) * rho * g * L ** k_ulen[i]
+    out["period"] = period
+    out["heading"] = head
+    return out
+
+
+def get_sigma_x_psd(TBFA, TBSS, frequencies,
+                    angles=np.linspace(0, 2 * np.pi, 50),
+                    d=10.0, thickness=0.083):
+    """Tower-base axial-stress PSD [MPa^2/(rad/s)] around the tower
+    circumference from fore-aft / side-side bending amplitude spectra
+    (reference: helpers.py:966-981 getSigmaXPSD).
+
+    Returns (psd (nw, nangles), angle mesh, frequency mesh).
+    """
+    TBFA = np.asarray(TBFA)
+    TBSS = np.asarray(TBSS)
+    frequencies = np.asarray(frequencies, float)
+    angle_fa, fa = np.meshgrid(angles, TBFA)
+    angle_ss, ss = np.meshgrid(angles, TBSS)
+    Izz = np.pi / 8.0 * thickness * d**3      # thin-walled bending inertia
+    sigma_x = (fa * np.cos(angle_fa) - ss * np.sin(angle_ss)) * d / 2 / Izz
+    psd = np.asarray(get_psd(sigma_x / 1e6, frequencies[1] - frequencies[0]))
+    a_mesh, f_mesh = np.meshgrid(angles, frequencies)
+    return psd, a_mesh, f_mesh
+
+
+def adjust_mooring(ms, design):
+    """Write a MooringSystem's line properties back into the design dict
+    (reference: helpers.py:1212-1234 adjustMooring — same simple-topology
+    assumption: anchors listed before fairleads, one line type list)."""
+    moor = design["mooring"]
+    moor["water_depth"] = float(ms.depth)
+    nl = len(np.atleast_1d(ms.L))
+    for i in range(min(len(moor.get("line_types", [])), 1)):
+        moor["line_types"][i]["diameter"] = float(np.atleast_1d(ms.d_vol)[0])
+        moor["line_types"][i]["mass_density"] = float(
+            np.atleast_1d(ms.m_lin)[0])
+        moor["line_types"][i]["stiffness"] = float(np.atleast_1d(ms.EA)[0])
+    for i in range(nl):
+        moor["lines"][i]["length"] = float(np.atleast_1d(ms.L)[i])
+    # anchor / fairlead locations (points list: anchors first, reference
+    # convention in adjustMooring)
+    for i in range(nl):
+        moor["points"][i]["location"] = list(np.asarray(ms.rAnchor)[i])
+        moor["points"][nl + i]["location"] = list(np.asarray(ms.rFair0)[i])
+    return design
+
+
+def clean_raft_dict(design):
+    """Recursively convert numpy containers to plain Python for YAML
+    export (reference: helpers.py:1273 cleanRAFTdict)."""
+    if isinstance(design, dict):
+        return {k: clean_raft_dict(v) for k, v in design.items()}
+    if isinstance(design, (list, tuple)):
+        return [clean_raft_dict(v) for v in design]
+    if isinstance(design, np.ndarray):
+        return design.tolist()
+    if isinstance(design, (np.floating, np.integer)):
+        return design.item()
+    return design

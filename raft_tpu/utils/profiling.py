@@ -1,0 +1,93 @@
+"""Structured timing, logging and jax-profiler hooks.
+
+The reference has one ad-hoc `time.perf_counter` pair around its QTF
+kernel and bare prints everywhere (reference: raft_model.py:980-984;
+SURVEY §5.1 asks for real tracing as a feature, not a port).  This module
+provides:
+
+- `timed(name)`: context manager accumulating wall time per section into
+  a process-wide registry (`timing_report()` to dump it); used around the
+  Model phases (statics / dynamics / QTF / outputs).
+- `trace(dir)`: context manager around `jax.profiler.start_trace` /
+  `stop_trace` for XLA-level traces viewable in TensorBoard/Perfetto.
+- `get_logger(name)`: namespaced loggers under "raft_tpu" with a single
+  stderr handler; `set_verbosity(n)` maps the reference's integer
+  `display` levels onto logging levels.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+
+_TIMINGS = defaultdict(lambda: [0.0, 0])     # name -> [total_s, calls]
+
+_ROOT = "raft_tpu"
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    logger = logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)
+    root = logging.getLogger(_ROOT)
+    if not root.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s: %(message)s", "%H:%M:%S"))
+        root.addHandler(h)
+        root.setLevel(logging.WARNING)
+    return logger
+
+
+def set_verbosity(display: int):
+    """Map the reference's integer display levels to logging levels
+    (0 = warnings only, 1 = info, 2+ = debug)."""
+    level = (logging.WARNING if display <= 0
+             else logging.INFO if display == 1 else logging.DEBUG)
+    logging.getLogger(_ROOT).setLevel(level)
+    get_logger()   # ensure the handler exists
+
+
+@contextlib.contextmanager
+def timed(name: str, logger: logging.Logger = None):
+    """Accumulate wall time for a named section; optionally log it at
+    DEBUG (the reference's QTF timing print, raft_model.py:980-984,
+    becomes `timed('qtf')`)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        entry = _TIMINGS[name]
+        entry[0] += dt
+        entry[1] += 1
+        (logger or get_logger("timing")).debug("%s: %.4f s", name, dt)
+
+
+def timing_report(reset: bool = False) -> dict:
+    """{section: (total_seconds, calls)} accumulated so far."""
+    out = {k: tuple(v) for k, v in _TIMINGS.items()}
+    if reset:
+        _TIMINGS.clear()
+    return out
+
+
+def print_timing_report():
+    rep = timing_report()
+    if not rep:
+        print("no timed sections recorded")
+        return
+    width = max(len(k) for k in rep)
+    print(f"{'section'.ljust(width)}  total [s]   calls   per-call [s]")
+    for k, (tot, n) in sorted(rep.items(), key=lambda kv: -kv[1][0]):
+        print(f"{k.ljust(width)}  {tot:9.4f}   {n:5d}   {tot / max(n, 1):10.5f}")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """XLA-level profiler trace (TensorBoard/Perfetto viewable)."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,153 @@
+"""Observability + misc analysis utilities: parametric case builder,
+WAMIT .2 reader, stress PSDs, response export, plots, timing registry.
+
+Reference analogs: helpers.py:966-1272, raft_model.py:315-341 (stats
+table), :1194-1306 (plotResponses/saveResponses), :1333-1431 (plots).
+"""
+import os
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+import yaml
+
+from raft_tpu.model import Model
+from raft_tpu.utils.analysis import (adjust_mooring, clean_raft_dict,
+                                     get_sigma_x_psd,
+                                     parametric_analysis_builder,
+                                     read_wamit_p2,
+                                     retrieve_axis_par_analysis)
+from raft_tpu.utils.profiling import (print_timing_report, timed,
+                                      timing_report)
+
+
+def test_parametric_analysis_builder():
+    design = dict(
+        parametricAnalysis=dict(windSpeedIncrement=2.0, numWSIncrements=3),
+        cases=dict(keys=["wind_speed", "wave_height"], data=[[8.0, 2.0]]))
+    out = parametric_analysis_builder(design, "windSpeed", start_value=6.0)
+    data = out["cases"]["data"]
+    assert [row[0] for row in data] == [6.0, 8.0, 10.0, 12.0]
+    assert all(row[1] == 2.0 for row in data)
+
+    # floaterRotation sweeps heading keys in lockstep
+    design = dict(
+        parametricAnalysis=dict(rotationAngle=30.0, numRotations=2),
+        cases=dict(keys=["wind_speed", "wind_heading", "wave_heading"],
+                   data=[[10.0, 0.0, 0.0]]))
+    out = parametric_analysis_builder(design, "floaterRotation")
+    data = out["cases"]["data"]
+    assert [row[1] for row in data] == [0.0, 30.0, 60.0]
+    assert [row[2] for row in data] == [0.0, 30.0, 60.0]
+
+    # unknown type / disabled: no-op
+    before = [list(r) for r in data]
+    parametric_analysis_builder(out, "nope")
+    assert out["cases"]["data"] == before
+
+    xaxis, xlabel, _title = retrieve_axis_par_analysis(
+        0, dict(zip(out["cases"]["keys"], data[1])), "windSpeed", [])
+    assert xaxis == [10.0] and "Wind Speed" in xlabel
+
+
+def test_read_wamit_p2(tmp_path):
+    # synthetic .2 file: 2 periods x 2 headings x 6 dof
+    path = tmp_path / "drift.2"
+    rows = []
+    for T in (10.0, 5.0):
+        for head in (0.0, 90.0):
+            for i in range(1, 7):
+                re, im = i * T, -i * head / 90.0
+                rows.append(f"{T} {head} {i} {np.hypot(re, im)} 0.0 {re} {im}")
+    path.write_text("\n".join(rows) + "\n")
+    out = read_wamit_p2(str(path), rho=1025.0, L=1.0, g=9.81)
+    assert out["surge"].shape == (2, 2)
+    # dimensionalization: rho*g*L^2 for translations, L^3 rotations
+    assert out["surge"][0, 0] == pytest.approx(1025 * 9.81 * 5.0)  # T sorted asc
+    assert out["yaw"][1, 1] == pytest.approx(1025 * 9.81 * (6 * 10 - 6j),
+                                             rel=1e-12)
+
+
+def test_get_sigma_x_psd():
+    w = np.arange(0.1, 2.0, 0.1)
+    TBFA = (1e6 + 0j) * np.ones_like(w)
+    TBSS = np.zeros_like(w)
+    psd, a_mesh, f_mesh = get_sigma_x_psd(TBFA, TBSS, w, d=10.0,
+                                          thickness=0.083)
+    assert psd.shape == (len(w), 50)
+    # peak stress at theta=0 (pure fore-aft bending), zero at 90 deg
+    Izz = np.pi / 8 * 0.083 * 1000.0
+    sigma0 = 1e6 * 5.0 / Izz / 1e6
+    expect = 0.5 * sigma0**2 / 0.1
+    assert psd[0, 0] == pytest.approx(expect, rel=1e-6)
+    i90 = np.argmin(np.abs(a_mesh[0] - np.pi / 2))
+    assert psd[0, i90] < 5e-3 * psd[0, 0]   # grid point nearest 90 deg
+
+
+def test_adjust_mooring_roundtrip():
+    from raft_tpu.models import mooring as mr
+    design = yaml.safe_load(open("/root/reference/designs/OC3spar.yaml"))
+    ms = mr.parse_mooring(design["mooring"])
+    ms2 = __import__("dataclasses").replace(ms, L=np.asarray(ms.L) + 25.0)
+    out = adjust_mooring(ms2, design)
+    for i, ln in enumerate(out["mooring"]["lines"]):
+        assert ln["length"] == pytest.approx(float(np.asarray(ms.L)[i]) + 25.0)
+    clean = clean_raft_dict(out)
+    yaml.safe_dump(clean)      # numpy fully stripped -> dumps fine
+
+
+def test_timing_registry():
+    timing_report(reset=True)
+    with timed("unit_test_section"):
+        pass
+    with timed("unit_test_section"):
+        pass
+    rep = timing_report()
+    assert rep["unit_test_section"][1] == 2
+    print_timing_report()      # smoke
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    design = yaml.safe_load(open("/root/reference/designs/OC3spar.yaml"))
+    design["cases"]["data"] = [design["cases"]["data"][1]]   # parked case
+    design["settings"]["max_freq"] = 0.2
+    m = Model(design)
+    m.analyzeUnloaded()
+    m.analyzeCases(display=1)
+    return m
+
+
+def test_stats_table_printed(small_model, capsys):
+    small_model._print_stats_table(0, 0)
+    out = capsys.readouterr().out
+    assert "Statistics" in out and "surge (m)" in out and "pitch (deg)" in out
+
+
+def test_save_responses(small_model, tmp_path):
+    files = small_model.saveResponses(str(tmp_path / "resp"))
+    assert len(files) == 1
+    lines = open(files[0]).read().splitlines()
+    assert "surge_PSD" in lines[0] and "Mbase_PSD" in lines[0]
+    assert len(lines) == 1 + small_model.nw
+    first = [float(x) for x in lines[1].split()]
+    assert first[0] == pytest.approx(small_model.w[0], abs=1e-4)
+
+
+def test_plots(small_model, tmp_path):
+    fig, ax = small_model.plot()
+    fig.savefig(tmp_path / "sys3d.png")
+    fig2, _ = small_model.plot2d()
+    fig2.savefig(tmp_path / "sys2d.png")
+    fig3, axes = small_model.plotResponses()
+    fig3.savefig(tmp_path / "psd.png")
+    assert (tmp_path / "sys3d.png").stat().st_size > 1000
+    assert (tmp_path / "psd.png").stat().st_size > 1000
+    import matplotlib.pyplot as plt
+    plt.close("all")
+
+    # timing registry was fed by analyzeCases
+    rep = timing_report()
+    assert "solveDynamics" in rep and rep["solveDynamics"][1] >= 1

@@ -1,0 +1,88 @@
+"""Wake coupling (FLORIS-equivalent): Gaussian deficit, farm equilibrium,
+power/thrust curves, AEP (reference: raft_model.py:1674-2022)."""
+import dataclasses
+import types
+
+import numpy as np
+import pytest
+import yaml
+
+from raft_tpu.models.wake import (calc_aep, find_wake_equilibrium,
+                                  gaussian_deficit, power_thrust_curve,
+                                  wake_velocities)
+
+
+def test_gaussian_deficit_shape():
+    # no deficit upstream; decays downstream and crosswind; grows with Ct
+    assert gaussian_deficit(-2.0, 0.0, 0.8, 240.0) == 0.0
+    d4 = gaussian_deficit(4.0, 0.0, 0.8, 240.0)
+    d8 = gaussian_deficit(8.0, 0.0, 0.8, 240.0)
+    assert 0 < d8 < d4 < 1
+    assert gaussian_deficit(4.0, 2.0, 0.8, 240.0) < d4
+    assert gaussian_deficit(4.0, 0.0, 0.4, 240.0) < d4
+
+
+def test_wake_velocities_alignment():
+    xy = np.array([[0.0, 0.0], [1000.0, 0.0]])
+    D, Ct = 200.0, np.array([0.8, 0.8])
+    U = wake_velocities(xy, D, Ct, 10.0, wind_dir_deg=0.0)
+    assert U[0] == pytest.approx(10.0, abs=1e-6)   # upstream untouched
+    assert U[1] < 9.0                               # waked
+    # crosswind: both free stream
+    U90 = wake_velocities(xy, D, Ct, 10.0, wind_dir_deg=90.0)
+    assert np.allclose(U90, 10.0, atol=1e-3)
+    # reversed wind: roles swap
+    U180 = wake_velocities(xy, D, Ct, 10.0, wind_dir_deg=180.0)
+    assert U180[1] == pytest.approx(10.0, abs=1e-6)
+    assert U180[0] < 9.0
+
+
+@pytest.fixture(scope="module")
+def pseudo_farm():
+    """Two copies of the OC3 FOWT spaced 8D downwind — avoids the heavy
+    farm-yaml build; wake functions only need positions + rotors."""
+    from raft_tpu.models.fowt import build_fowt
+
+    design = yaml.safe_load(open("/root/reference/designs/OC3spar.yaml"))
+    w = np.arange(0.01, 0.2, 0.01) * 2 * np.pi
+    f0 = build_fowt(design, w, depth=200.0)
+    D = 2 * f0.rotors[0].R_rot
+    f1 = dataclasses.replace(f0, x_ref=8.0 * D)
+    return types.SimpleNamespace(nFOWT=2, fowtList=[f0, f1])
+
+
+def test_power_thrust_curve(pseudo_farm):
+    curve = power_thrust_curve(pseudo_farm, speeds=np.arange(4.0, 25.0, 2.0))
+    assert np.all(curve["Cp"] > 0) and np.all(curve["Cp"] < 0.6)
+    assert np.all(curve["Ct"] > 0)
+    # NREL 5MW-class turbine: rated power within a factor ~1.3 of 5 MW
+    assert 3.5e6 < curve["power"].max() < 7.0e6
+    # below rated, Ct high; far above rated (pitched), Ct drops
+    assert curve["Ct"][0] > curve["Ct"][-1]
+
+
+def test_find_wake_equilibrium(pseudo_farm):
+    eq = find_wake_equilibrium(pseudo_farm,
+                               dict(wind_speed=8.0, wind_heading=0.0))
+    assert eq["U"][0] == pytest.approx(8.0, abs=1e-4)
+    assert eq["U"][1] < 7.5                       # waked below free stream
+    assert eq["power"][1] < eq["power"][0]
+    assert eq["iterations"] < 50
+    assert eq["case"]["wind_speed"][1] == pytest.approx(eq["U"][1])
+    # crosswind: no wake interaction
+    eq90 = find_wake_equilibrium(pseudo_farm,
+                                 dict(wind_speed=8.0, wind_heading=90.0))
+    assert np.allclose(eq90["U"], 8.0, atol=1e-2)
+
+
+def test_calc_aep(pseudo_farm):
+    rose = [(8.0, 0.0, 0.5), (8.0, 90.0, 0.5)]
+    out = calc_aep(pseudo_farm, rose)
+    assert out["AEP"] > 0
+    # the aligned state loses power to wakes; the crosswind one does not
+    p_aligned = out["states"][0]["farm_power"]
+    p_cross = out["states"][1]["farm_power"]
+    assert p_aligned < p_cross
+    # AEP equals the probability-weighted sum of state powers x hours
+    expect = 8760.0 * (0.5 * p_aligned + 0.5 * p_cross)
+    assert out["AEP"] == pytest.approx(expect, rel=1e-9)

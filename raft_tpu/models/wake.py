@@ -18,9 +18,10 @@ from __future__ import annotations
 import numpy as np
 
 
-def gaussian_deficit(x_d, y_d, Ct, D, k_w=0.05):
+def gaussian_deficit(x_d, y_d, Ct, k_w=0.05):
     """Normalized velocity deficit at (x_d, y_d) rotor diameters
     downstream/crosswind of a turbine with thrust coefficient Ct.
+    (Fully nondimensional in the diameter — positions enter in D units.)
 
     Bastankhah & Porte-Agel (2014): sigma/D = k_w x/D + 0.25 sqrt(beta),
     beta = (1 + sqrt(1-Ct)) / (2 sqrt(1-Ct));
@@ -58,7 +59,7 @@ def wake_velocities(xy, D, Ct, U_inf, wind_dir_deg=0.0, k_w=0.05):
                 continue
             dx = (xy_w[i, 0] - xy_w[j, 0]) / D[j]
             dy = (xy_w[i, 1] - xy_w[j, 1]) / D[j]
-            ssq += gaussian_deficit(dx, dy, Ct[j], D[j], k_w) ** 2
+            ssq += gaussian_deficit(dx, dy, Ct[j], k_w) ** 2
         U[i] = U_inf * (1.0 - np.sqrt(ssq))
     return U
 
@@ -98,35 +99,73 @@ def power_thrust_curve(model, speeds=None, ifowt=0):
                 pitch_deg=pitch, omega_rpm=omega, rotor_area=A)
 
 
+def _curve_interp(U, curve, key, outside=0.0):
+    """Interpolate a power/thrust-curve channel at speeds U, returning
+    `outside` beyond the curve's speed range (below cut-in / above
+    cut-out the turbine is parked: zero power and thrust, not the
+    clamped endpoint value np.interp would give)."""
+    U = np.asarray(U, float)
+    xs = curve["wind_speed"]
+    vals = np.interp(U, xs, curve[key])
+    return np.where((U < xs[0]) | (U > xs[-1]), outside, vals)
+
+
+def _farm_curves(model, curve=None):
+    """One power/thrust curve per FOWT, computed once per distinct rotor
+    object (heterogeneous farms of design variants get their own curves).
+    `curve` may be a single curve dict (applied to all) or a list."""
+    if isinstance(curve, dict):
+        return [curve] * model.nFOWT
+    if curve is not None:
+        return list(curve)
+    cache = {}
+    out = []
+    for i, f in enumerate(model.fowtList):
+        key = id(f.rotors[0])
+        if key not in cache:
+            cache[key] = power_thrust_curve(model, ifowt=i)
+        out.append(cache[key])
+    return out
+
+
 def find_wake_equilibrium(model, case, k_w=0.05, max_iter=100, tol=1e-4,
                           relax=0.5, curve=None):
     """Farm wake fixed point (reference: raft_model.py:1852-1994
     florisFindEquilibrium): wake model -> per-turbine wind speeds ->
     thrust coefficients -> wake model, with under-relaxation.
 
+    `case['wind_speed']` may be a scalar free-stream speed or a
+    per-turbine list (as produced by this function itself / accepted by
+    Model._case_for_fowt) — a list is reduced to its maximum, the
+    free-stream value unaffected by wakes.
+
     Returns dict(U (n,), Ct (n,), power (n,), case with per-turbine
     wind_speed list ready for Model.analyzeCases).
     """
     n = model.nFOWT
-    U_inf = float(case.get("wind_speed", 10.0))
-    wind_dir = float(case.get("wind_heading", 0.0))
+    ws = case.get("wind_speed", 10.0)
+    U_inf = float(np.max(ws)) if np.ndim(ws) > 0 else float(ws)
+    wh = case.get("wind_heading", 0.0)
+    wind_dir = float(np.mean(wh)) if np.ndim(wh) > 0 else float(wh)
     xy = np.array([[f.x_ref, f.y_ref] for f in model.fowtList])
     rots = [f.rotors[0] for f in model.fowtList]
     D = np.array([2.0 * r.R_rot for r in rots])
 
-    if curve is None:
-        curve = power_thrust_curve(model, ifowt=0)
+    curves = _farm_curves(model, curve)
 
     U = np.full(n, U_inf)
-    Ct = np.interp(U, curve["wind_speed"], curve["Ct"])
+    Ct = np.array([float(_curve_interp(U[i], curves[i], "Ct"))
+                   for i in range(n)])
     for it in range(max_iter):
         U_new = wake_velocities(xy, D, Ct, U_inf, wind_dir, k_w)
         if np.max(np.abs(U_new - U)) < tol:
             U = U_new
             break
         U = relax * U + (1.0 - relax) * U_new
-        Ct = np.interp(U, curve["wind_speed"], curve["Ct"])
-    power = np.interp(U, curve["wind_speed"], curve["power"])
+        Ct = np.array([float(_curve_interp(U[i], curves[i], "Ct"))
+                       for i in range(n)])
+    power = np.array([float(_curve_interp(U[i], curves[i], "power"))
+                      for i in range(n)])
     case_out = dict(case)
     case_out["wind_speed"] = list(U)
     return dict(U=U, Ct=Ct, power=power, case=case_out, iterations=it + 1)
@@ -139,14 +178,14 @@ def calc_aep(model, wind_rose, k_w=0.05, availability=1.0):
     wind_rose: iterable of (speed [m/s], direction [deg], probability);
     probabilities should sum to ~1.
     """
-    curve = power_thrust_curve(model, ifowt=0)
+    curves = _farm_curves(model)
     hours = 8760.0
     aep = 0.0
     per_state = []
     for speed, wd, prob in wind_rose:
         eq = find_wake_equilibrium(
             model, dict(wind_speed=speed, wind_heading=wd),
-            k_w=k_w, curve=curve)
+            k_w=k_w, curve=curves)
         farm_p = float(np.sum(eq["power"]))
         per_state.append(dict(speed=speed, dir=wd, prob=prob,
                               farm_power=farm_p, U=eq["U"]))

@@ -29,7 +29,9 @@ def _member_wireframe(ax, geom, pose, color="k", nth=12, plot2d=False,
     st = np.asarray(geom.stations, float)
     th = np.linspace(0, 2 * np.pi, nth + 1)
     rings = []
-    draw = set(range(len(st))) if not station_plot else set(station_plot)
+    draw = (set(range(len(st))) if station_plot is None
+            or len(np.atleast_1d(station_plot)) == 0
+            else set(np.atleast_1d(station_plot).tolist()))
     for i, s in enumerate(st):
         center = rA + q * s
         if geom.circular:
@@ -174,12 +176,12 @@ def save_responses(model, out_path):
                 for metric, unit in zip(choose, units):
                     f.write(f"{metric} [{unit}] \t")
                 f.write("\n")
+                cols = [np.squeeze(np.asarray(metrics[m])) for m in choose]
+                cols = [c if c.ndim == 1 else c[:, 0] for c in cols]
                 for iFreq in range(len(model.w)):
                     f.write(f"{model.w[iFreq]:.5f} \t")
-                    for metric in choose:
-                        val = np.squeeze(np.asarray(metrics[metric]))
-                        v = val[iFreq] if val.ndim == 1 else val[iFreq, 0]
-                        f.write(f"{float(v):.5f} \t")
+                    for col in cols:
+                        f.write(f"{float(col[iFreq]):.5f} \t")
                     f.write("\n")
             written.append(path)
     return written

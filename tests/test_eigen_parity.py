@@ -1,0 +1,98 @@
+"""Value-level solveEigen parity vs the reference's published eigen
+frequencies (reference: tests/test_model.py:118-135 `desired_fn`, asserted
+there at rtol=1e-5 after `solveStatics(case)` + `solveEigen()`,
+tests/test_model.py:192-204).
+
+Ground truth: the `desired_fn` / `cases4solveEigen` literal tables in the
+reference's own test module, extracted via AST (the reference package is
+not importable here — moorpy absent); same pure-data-extraction approach
+as tests/test_member_parity.py.
+
+Tolerances: *unloaded* natural frequencies depend only on statics +
+hydrostatics + mooring stiffness at the unloaded equilibrium and match the
+reference to ~1e-6 relative (OC3spar 1.5e-7, VolturnUS-S 1.0e-6 measured)
+— asserted at rtol=5e-6.  *Loaded* frequencies additionally depend on the
+mean operating point (aero thrust -> offset -> mooring stiffness), so they
+inherit the documented ~3% BEM reimplementation deviation
+(tests/test_rotor.py) at second order: measured max 0.5%, asserted at
+rtol=1e-2.
+"""
+import ast
+import os
+
+import numpy as np
+import pytest
+import yaml
+from numpy.testing import assert_allclose
+
+from raft_tpu.model import Model
+
+REF_TEST = "/root/reference/tests/test_model.py"
+DATA = "/root/reference/tests/test_data"
+
+
+@pytest.fixture(scope="module")
+def truth():
+    if not os.path.isfile(REF_TEST):
+        pytest.skip("reference test data not available")
+    tree = ast.parse(open(REF_TEST).read())
+    ns = {"np": np, "os": os, "__file__": REF_TEST}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            try:
+                exec(compile(ast.Module([node], []), REF_TEST, "exec"), ns)
+            except Exception:
+                pass  # assignments needing the raft package; literals only
+    assert "desired_fn" in ns and "cases4solveEigen" in ns
+    return ns
+
+
+def _model(name):
+    design = yaml.safe_load(open(os.path.join(DATA, f"{name}.yaml")))
+    if "array_mooring" in design and design["array_mooring"].get("file"):
+        design["array_mooring"]["file"] = os.path.join(
+            DATA, os.path.basename(design["array_mooring"]["file"]))
+    return Model(design)
+
+
+# reference file list order: VolturnUS-S=0, OC3spar=1, farm=2
+@pytest.fixture(scope="module")
+def oc3(truth):
+    return _model("OC3spar")
+
+
+@pytest.fixture(scope="module")
+def volturn(truth):
+    return _model("VolturnUS-S")
+
+
+def _check(model, truth, index, key, rtol):
+    model.solveStatics(dict(truth["cases4solveEigen"][key]))
+    fns, modes = model.solveEigen()
+    assert_allclose(fns, truth["desired_fn"][key][index], rtol=rtol,
+                    err_msg=f"eigen fn, case {key}")
+    assert modes.shape == (len(fns), len(fns))
+
+
+def test_oc3_unloaded(oc3, truth):
+    _check(oc3, truth, 1, "unloaded", 5e-6)
+
+
+def test_oc3_loaded(oc3, truth):
+    _check(oc3, truth, 1, "loaded", 1e-2)
+
+
+def test_volturn_unloaded(volturn, truth):
+    _check(volturn, truth, 0, "unloaded", 5e-6)
+
+
+def test_volturn_loaded(volturn, truth):
+    _check(volturn, truth, 0, "loaded", 1e-2)
+
+
+def test_farm_unloaded(truth):
+    """12-DOF array eigen: shared-mooring stiffness enters the C blocks.
+    Looser than single-FOWT because the shared-line equilibrium (free
+    points) reproduces MoorPy only to ~1e-4."""
+    m = _model("VolturnUS-S_farm")
+    _check(m, truth, 2, "unloaded", 5e-3)

@@ -158,6 +158,11 @@ def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
         h.update(np.ascontiguousarray(mesh.verts).tobytes())
         h.update(np.ascontiguousarray(mesh.panels).tobytes())
         h.update(np.asarray(fowt.w, float).tobytes())
+        # the BEM grid is part of the key: a custom w_bem (preprocess_BEM)
+        # must not reload coefficients solved on a different grid
+        h.update(np.asarray(w_bem if w_bem is not None else [], float)
+                 .tobytes())
+        h.update(np.array([max_freqs], float).tobytes())
         h.update(headings.tobytes())
         h.update(np.array([rho, g, fowt.depth, mesh.nbody]).tobytes())
         key = h.hexdigest()

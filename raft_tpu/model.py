@@ -114,6 +114,12 @@ class Model:
         # in the line-drag wrench (reference: raft_model.py:162-163)
         self.mooring_currentMod = int(get_from_dict(
             design.get("mooring") or {}, "currentMod", dtype=int, default=0))
+        # QTF output folder: internal-QTF runs drop .12d/.4 snapshots here
+        # and reload them as a checkpoint cache (reference:
+        # raft_fowt.py:255-257, 1420-1433, 1642-1648)
+        plat = design.get("platform") or (design.get("platforms") or [{}])[0]
+        self.outFolderQTF = plat.get("outFolderQTF")
+        self._iCase = None
         self.design = design
         self.results = {}
         # per-fowt case state (filled by solveStatics/solveDynamics)
@@ -556,11 +562,55 @@ class Model:
             # raft_model.py:966-989)
             Xi1 = np.asarray(carry[1])
             RAO = np.asarray(get_rao(Xi1, seastate["zeta"][0]))
-            with timed("calcQTF_slenderBody"):
-                qtf_local = qt.calc_qtf_slender_body(
-                    fowt, pose_eq, seastate["beta"][0], Xi0=RAO,
-                    M_struc=stat["M_struc"])
-            qtf4 = np.asarray(qtf_local)[:, :, None, :]
+            # outFolderQTF: drop .4 RAO + .12d QTF snapshots and reload the
+            # QTF as a checkpoint when inputs are unchanged (reference
+            # writes the same files, raft_fowt.py:1420-1433/1642-1648; the
+            # content-hash reload is the resume half the reference lacks)
+            qtf4 = None
+            cache_path = key = None
+            if self.outFolderQTF is not None:
+                import hashlib
+                import os as _os
+                _os.makedirs(self.outFolderQTF, exist_ok=True)
+                beta0 = float(seastate["beta"][0])
+                tag = f"Head{int(round(np.rad2deg(beta0)))}"
+                if self._iCase is not None:
+                    tag += f"_Case{self._iCase + 1}"
+                tag += f"_WT{ifowt}"
+                qt.write_rao_4(
+                    _os.path.join(self.outFolderQTF,
+                                  f"raos-slender_body_{tag}.4"),
+                    self.w, beta0, RAO)
+                h = hashlib.sha256()
+                for a in (state["r6"], [beta0], RAO,
+                          stat["M_struc"], fowt.w1_2nd):
+                    h.update(np.ascontiguousarray(
+                        np.asarray(a, dtype=complex)).tobytes())
+                key = h.hexdigest()
+                cache_path = _os.path.join(
+                    self.outFolderQTF,
+                    f"qtf-slender_body-total_{tag}.12d")
+                key_path = cache_path + ".key"
+                if (_os.path.isfile(cache_path)
+                        and _os.path.isfile(key_path)
+                        and open(key_path).read().strip() == key):
+                    qd = qt.read_qtf_12d(cache_path, rho=fowt.rho_water,
+                                         g=fowt.g)
+                    if (len(qd.w) == len(fowt.w1_2nd)
+                            and np.allclose(qd.w, fowt.w1_2nd, rtol=1e-6)):
+                        qtf4 = qd.qtf
+            if qtf4 is None:
+                with timed("calcQTF_slenderBody"):
+                    qtf_local = qt.calc_qtf_slender_body(
+                        fowt, pose_eq, seastate["beta"][0], Xi0=RAO,
+                        M_struc=stat["M_struc"])
+                qtf4 = np.asarray(qtf_local)[:, :, None, :]
+                if cache_path is not None:
+                    qt.write_qtf_12d(cache_path, qtf4, fowt.w1_2nd,
+                                     [float(seastate["beta"][0])],
+                                     rho=fowt.rho_water, g=fowt.g)
+                    with open(cache_path + ".key", "w") as f:
+                        f.write(key)
             heads = np.array([seastate["beta"][0]])
             Fhydro_2nd_mean[0], f2 = (np.asarray(a) for a in qt.hydro_force_2nd(
                 qtf4, heads, fowt.w1_2nd, seastate["beta"][0],
@@ -741,6 +791,7 @@ class Model:
             case = dict(zip(self.design["cases"]["keys"],
                             self.design["cases"]["data"][iCase]))
             case["iCase"] = iCase
+            self._iCase = iCase
             self.results["case_metrics"][iCase] = {}
             with timed("solveStatics"):
                 self.solveStatics(case, display=display)
@@ -788,6 +839,9 @@ class Model:
                          for iT in range(nT)]),
                 }
                 self.results["case_metrics"][iCase]["array_mooring"] = am
+        # a later direct solveDynamics call must not write its QTF snapshot
+        # under the last case's tag
+        self._iCase = None
         return self.results
 
     # ------------------------------------------------------------------
@@ -945,6 +999,29 @@ class Model:
                 results["bPitch_PSD"][:, ir] = RAD2DEG**2 * np.asarray(
                     get_psd(bPitch_w, dw, source_axis=0))
                 results["wind_PSD"] = np.asarray(get_psd(V_w, dw))
+
+    def preprocess_BEM(self, dw=0.05, wMax=3.0, mesh_dir=None,
+                       headings=None, dz=None, da=None):
+        """Re-run the native BEM core at a custom frequency resolution and
+        write WAMIT-format .1/.3 coefficient files plus the panel mesh
+        (reference: raft_model.py:1310-1330 preprocess_HAMS, which re-runs
+        pyHAMS to export coefficients for OpenFAST).  One output directory
+        per FOWT (``mesh_dir`` gets a ``_WT{i}`` suffix for i>0).
+        Returns the list of per-FOWT BEMData."""
+        from raft_tpu.io.bem_native import available, load_error, solve_bem_fowt
+
+        if not available():
+            raise RuntimeError(
+                f"native BEM core unavailable: {load_error()}")
+        w_bem = np.arange(dw, wMax + 0.5 * dw, dw)
+        out = []
+        for i, fowt in enumerate(self.fowtList):
+            d = mesh_dir if (mesh_dir is None or i == 0) \
+                else f"{mesh_dir}_WT{i}"
+            out.append(solve_bem_fowt(fowt, headings=headings, dz=dz, da=da,
+                                      w_bem=w_bem, mesh_dir=d,
+                                      max_freqs=len(w_bem)))
+        return out
 
     def calcOutputs(self):
         """Fill results['properties'] (reference: raft_model.py:1150-1189)."""

@@ -109,10 +109,26 @@ def write_qtf_12d(path: str, qtf, w, heads_rad, rho: float = 1025.0,
                 for i1 in range(len(w)):
                     for i2 in range(i1, len(w)):
                         F = qtf[i1, i2, ih, idof] / (rho * g * ULEN)
-                        f.write(f"{2*np.pi/w[i1]: 8.4e} {2*np.pi/w[i2]: 8.4e} "
-                                f"{hd: 8.4e} {hd: 8.4e} {idof+1} "
-                                f"{np.abs(F): 8.4e} {np.angle(F): 8.4e} "
-                                f"{F.real: 8.4e} {F.imag: 8.4e}\n")
+                        f.write(f"{2*np.pi/w[i1]: .8e} {2*np.pi/w[i2]: .8e} "
+                                f"{hd: .8e} {hd: .8e} {idof+1} "
+                                f"{np.abs(F): .8e} {np.angle(F): .8e} "
+                                f"{F.real: .8e} {F.imag: .8e}\n")
+
+
+def write_rao_4(path, w, beta_rad, Xi) -> None:
+    """Write first-order RAOs in WAMIT .4 format (reference:
+    raft_fowt.py:1420-1433): period, heading, DOF, |X|, phase, Re, Im —
+    the RAO snapshot the reference drops next to its QTF files so a run
+    can be audited/resumed."""
+    Xi = np.asarray(Xi)
+    w = np.asarray(w)
+    beta = float(np.rad2deg(beta_rad))
+    with open(path, "w") as f:
+        for idof in range(Xi.shape[0]):
+            for w1, x in zip(w, Xi[idof, :]):
+                f.write(f"{2*np.pi/w1: 8.4e} {beta: 8.4e} {idof+1} "
+                        f"{np.abs(x): 8.4e} {np.angle(x): 8.4e} "
+                        f"{x.real: 8.4e} {x.imag: 8.4e}\n")
 
 
 # --------------------------------------------------------------------------

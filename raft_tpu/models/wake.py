@@ -145,8 +145,10 @@ def find_wake_equilibrium(model, case, k_w=0.05, max_iter=100, tol=1e-4,
     n = model.nFOWT
     ws = case.get("wind_speed", 10.0)
     U_inf = float(np.max(ws)) if np.ndim(ws) > 0 else float(ws)
-    wh = case.get("wind_heading", 0.0)
-    wind_dir = float(np.mean(wh)) if np.ndim(wh) > 0 else float(wh)
+    wh = np.atleast_1d(np.asarray(case.get("wind_heading", 0.0), float))
+    # circular mean (arithmetic mean of e.g. [350, 10] deg is wrong)
+    wind_dir = float(np.rad2deg(np.arctan2(
+        np.mean(np.sin(np.deg2rad(wh))), np.mean(np.cos(np.deg2rad(wh))))))
     xy = np.array([[f.x_ref, f.y_ref] for f in model.fowtList])
     rots = [f.rotors[0] for f in model.fowtList]
     D = np.array([2.0 * r.R_rot for r in rots])

@@ -182,3 +182,122 @@ def clean_raft_dict(design):
     if isinstance(design, (np.floating, np.integer)):
         return design.item()
     return design
+
+
+def convert_iea_turbine_yaml(turbine, out_path=None, n_span=30):
+    """IEA wind-turbine-ontology YAML -> RAFT-format turbine dict
+    (reference: helpers.py:777-930 convertIEAturbineYAML2RAFT).
+
+    The reference routes the load through WISDEM's schema validator and
+    writes a hand-formatted ``test.yaml``; here the ontology YAML (path or
+    already-loaded dict) is consumed directly with numpy interpolation —
+    no WISDEM dependency — and the result is returned as a nested dict in
+    the RAFT ``turbine:`` schema, optionally dumped to ``out_path``.
+
+    Extracted fields: hub/nacelle geometry (Rhub, precone, shaft_tilt,
+    overhang, Zhub), blade outer shape resampled to an ``n_span`` even
+    grid (r/chord/twist/precurve/presweep with tip values, scaled so the
+    blade arc length matches ``assembly.rotor_diameter`` when given),
+    spanwise airfoil positions, per-airfoil polars converted to the RAFT
+    [alpha_deg, cl, cd, cm] table form, and the air environment.
+    """
+    import yaml
+
+    if isinstance(turbine, str):
+        with open(turbine) as f:
+            wt = yaml.safe_load(f)
+    else:
+        wt = turbine
+
+    comp = wt["components"]
+    hub = comp["hub"]
+    drv = comp["nacelle"]["drivetrain"]
+    asm = wt["assembly"]
+
+    Rhub = 0.5 * float(hub["diameter"])
+    d = {
+        "nBlades": int(asm["number_of_blades"]),
+        "Rhub": Rhub,
+        "precone": float(np.rad2deg(hub["cone_angle"])),
+        "shaft_tilt": float(np.rad2deg(drv["uptilt"])),
+        "overhang": float(drv["overhang"]),
+        "blade": {},
+        "airfoils": [],
+        "env": {},
+    }
+
+    grid = np.linspace(0.0, 1.0, n_span)
+    blade = comp["blade"]["outer_shape_bem"]
+
+    ax = blade["reference_axis"]
+    ref = np.stack([np.interp(grid, ax[c]["grid"], ax[c]["values"])
+                    for c in ("x", "y", "z")], axis=1)
+    rotor_diameter = float(asm.get("rotor_diameter", 0.0))
+    if rotor_diameter != 0.0:
+        # scale the spanwise (z) coordinate by rotor_radius / (3D arc
+        # length + hub radius).  Deliberately z-only, matching the
+        # reference's normalization (helpers.py:814-816) — for prebent
+        # blades neither scales precurve, so the post-scale arc length is
+        # only approximately the rotor radius.
+        arc = np.concatenate(
+            [[0.0], np.cumsum(np.linalg.norm(np.diff(ref, axis=0), axis=1))])
+        ref[:, 2] *= rotor_diameter / (2.0 * (arc[-1] + Rhub))
+
+    d["blade"]["r"] = ref[1:-1, 2] + Rhub
+    d["blade"]["Rtip"] = float(ref[-1, 2] + Rhub)
+    d["blade"]["chord"] = np.interp(grid[1:-1], blade["chord"]["grid"],
+                                    blade["chord"]["values"])
+    d["blade"]["theta"] = np.rad2deg(np.interp(
+        grid[1:-1], blade["twist"]["grid"], blade["twist"]["values"]))
+    d["blade"]["precurve"] = ref[1:-1, 0]
+    d["blade"]["precurveTip"] = float(ref[-1, 0])
+    d["blade"]["presweep"] = ref[1:-1, 1]
+    d["blade"]["presweepTip"] = float(ref[-1, 1])
+    d["blade"]["geometry"] = np.stack(
+        [d["blade"]["r"], d["blade"]["chord"], d["blade"]["theta"],
+         d["blade"]["precurve"], d["blade"]["presweep"]], axis=1)
+    d["blade"]["airfoils"] = {
+        "grid": list(blade["airfoil_position"]["grid"]),
+        "labels": list(blade["airfoil_position"]["labels"]),
+    }
+
+    if float(asm.get("hub_height", 0.0)) != 0.0:
+        d["Zhub"] = float(asm["hub_height"])
+    else:
+        tower_z = comp["tower"]["outer_shape_bem"]["reference_axis"]["z"]
+        d["Zhub"] = float(tower_z["values"][-1]) + float(
+            drv["distance_tt_hub"])
+
+    env = wt["environment"]
+    d["env"] = {"rho": float(env["air_density"]),
+                "mu": float(env["air_dyn_viscosity"]),
+                "shearExp": float(env["shear_exp"])}
+
+    for af in wt["airfoils"]:
+        pol = af["polars"][0]
+        if len(af["polars"]) > 1:
+            import warnings
+            warnings.warn(f"airfoil {af['name']}: only the first polar "
+                          "entry is used")
+        a_cl = np.asarray(pol["c_l"]["grid"], float)
+        for ch in ("c_d", "c_m"):
+            if not np.allclose(a_cl, np.asarray(pol[ch]["grid"], float)):
+                raise ValueError(
+                    f"airfoil {af['name']}: {ch} is tabulated on a "
+                    "different AOA grid than c_l")
+        data = np.stack([np.rad2deg(a_cl),
+                         np.asarray(pol["c_l"]["values"], float),
+                         np.asarray(pol["c_d"]["values"], float),
+                         np.asarray(pol["c_m"]["values"], float)], axis=1)
+        d["airfoils"].append({
+            "name": af["name"],
+            "relative_thickness": float(af["relative_thickness"]),
+            "key": ["alpha", "c_l", "c_d", "c_m"],
+            "data": data,
+        })
+
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            yaml.safe_dump({"turbine": clean_raft_dict(d)}, f,
+                           sort_keys=False, default_flow_style=None)
+    return d

@@ -213,3 +213,27 @@ def test_wamit_cache_round_trip(tmp_path):
                                rtol=1e-6, atol=1e-3)
     np.testing.assert_allclose(fowt2.bem.X_BEM, fowt.bem.X_BEM,
                                rtol=1e-5, atol=1.0)
+
+
+def test_preprocess_bem_custom_grid(tmp_path):
+    """Model.preprocess_BEM (reference: raft_model.py:1310-1330
+    preprocess_HAMS): re-solves at a user dw/wMax grid and exports WAMIT
+    .1/.3 + mesh files for OpenFAST-style use; a repeat call with a
+    different grid must NOT reuse the first grid's cache."""
+    from raft_tpu.model import Model
+
+    m = Model(_spar_design(2))
+    out = m.preprocess_BEM(dw=0.1, wMax=0.6, mesh_dir=str(tmp_path),
+                           headings=[0.0], dz=4.0, da=4.0)
+    assert len(out) == 1
+    assert os.path.isfile(tmp_path / "Output.1")
+    lines = open(tmp_path / "Output.1").read().split("\n")
+    periods = {ln.split()[0] for ln in lines if ln.strip()}
+    # 6 BEM frequencies (0.1..0.6) plus the zero-frequency pad entries
+    assert len(periods) >= 6
+    mtime = os.path.getmtime(tmp_path / "Output.1")
+
+    # different grid -> cache key must miss -> files rewritten
+    m.preprocess_BEM(dw=0.2, wMax=0.6, mesh_dir=str(tmp_path),
+                     headings=[0.0], dz=4.0, da=4.0)
+    assert os.path.getmtime(tmp_path / "Output.1") != mtime

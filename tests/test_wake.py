@@ -14,12 +14,12 @@ from raft_tpu.models.wake import (calc_aep, find_wake_equilibrium,
 
 def test_gaussian_deficit_shape():
     # no deficit upstream; decays downstream and crosswind; grows with Ct
-    assert gaussian_deficit(-2.0, 0.0, 0.8, 240.0) == 0.0
-    d4 = gaussian_deficit(4.0, 0.0, 0.8, 240.0)
-    d8 = gaussian_deficit(8.0, 0.0, 0.8, 240.0)
+    assert gaussian_deficit(-2.0, 0.0, 0.8) == 0.0
+    d4 = gaussian_deficit(4.0, 0.0, 0.8)
+    d8 = gaussian_deficit(8.0, 0.0, 0.8)
     assert 0 < d8 < d4 < 1
-    assert gaussian_deficit(4.0, 2.0, 0.8, 240.0) < d4
-    assert gaussian_deficit(4.0, 0.0, 0.4, 240.0) < d4
+    assert gaussian_deficit(4.0, 2.0, 0.8) < d4
+    assert gaussian_deficit(4.0, 0.0, 0.4) < d4
 
 
 def test_wake_velocities_alignment():

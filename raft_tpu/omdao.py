@@ -102,10 +102,15 @@ class _ShimComponent:
             self._is_setup = True
         if inputs:
             for k, v in inputs.items():
-                if k not in self._inputs:
+                # route by declaration, like prob[key] = val in openmdao:
+                # a WEIS input dump mixes continuous and discrete keys
+                if k in self._inputs:
+                    self._inputs[k] = np.asarray(v, dtype=float) \
+                        if not np.isscalar(v) else float(v)
+                elif k in self._discrete_inputs:
+                    self._discrete_inputs[k] = v
+                else:
                     raise KeyError(f"unknown input '{k}'")
-                self._inputs[k] = np.asarray(v, dtype=float) \
-                    if not np.isscalar(v) else float(v)
         if discrete_inputs:
             for k, v in discrete_inputs.items():
                 self._discrete_inputs[k] = v

@@ -5,9 +5,12 @@ Tolerances are looser than the reference's own 1e-5 regression because the
 BEM here is an independent reimplementation of Ning (2014) validated
 against CCBlade's *outputs*, not a binding of the same Fortran: thrust and
 torque (and their U/Omega/pitch derivatives, which drive all dynamic
-terms) agree within ~3%; the secondary cross-axis hub loads (Y, Z, My, Mz)
-use a physically-consistent frame convention that does not reproduce
-CCBlade's internal one and are checked only for magnitude scale.
+terms) agree within ~3%.  The cross-axis hub loads (Y, Z, My, Mz) are
+reconciled to CCBlade's hub-frame sign convention (see
+bem_evaluate's docstring) and the full 6-component mean load vector is
+regression-checked across the (speed x heading) envelope in
+test_hub_loads_full_envelope_parity — median deviation 2.4%, bounded by
+the same induction-level difference as T/Q.
 """
 import os
 import pickle
@@ -136,3 +139,72 @@ def test_bem_derivatives_match_fd(rotor_and_truth):
         fd_Q = (float(op["Q"]) - float(om_["Q"])) / (2 * eps)
         assert_allclose(float(J[0, j]), fd_T, rtol=2e-3, atol=1.0)
         assert_allclose(float(J[1, j]), fd_Q, rtol=2e-3, atol=10.0)
+
+
+def test_hub_loads_full_envelope_parity(rotor_and_truth):
+    """Full 6-DOF mean aero load vector vs the reference across the whole
+    yaw_mode-0 pickle grid (6 speeds x 5 headings x 2 TI): per-case error
+    normalized by the largest force/moment component.  With the CCBlade
+    sign reconciliation the envelope is bounded by the ~2.5% BEM
+    induction-level deviation (median 2.4%, max 6.3% measured)."""
+    rot, w, truth = rotor_and_truth
+    errs = []
+    # mean loads are TI-independent: the TI=0 half covers the f0 envelope.
+    # bem_evaluate + R_q reproduces calc_aero's f0 assembly (rotor.py:727)
+    # without the Jacobian/spectral work the comparison doesn't use.
+    for tv in truth:
+        c = tv["case"]
+        if float(c.get("turbulence", 0)) != 0:
+            continue
+        pose = R.rotor_pose(rot, None,
+                            inflow_heading=np.radians(float(c["wind_heading"])),
+                            yaw_command=np.radians(float(c.get("yaw_misalign", 0))))
+        q = np.asarray(pose["q"])
+        Rq = np.asarray(pose["R_q"])
+        yawmis = np.arctan2(q[1], q[0]) - np.radians(float(c["wind_heading"]))
+        tilt = np.arctan2(q[2], np.hypot(q[0], q[1]))
+        U = float(c["wind_speed"])
+        Om = float(np.interp(U, rot.Uhub_ops, rot.Omega_rpm_ops))
+        pi_ = float(np.interp(U, rot.Uhub_ops, rot.pitch_deg_ops))
+        o = R.bem_evaluate(rot, U, Om, pi_, tilt=tilt, yaw=yawmis)
+        f0 = np.concatenate([
+            Rq @ [float(o["T"]), float(o["Y"]), float(o["Z"])],
+            Rq @ [float(o["My"]), float(o["Q"]), float(o["Mz"])]])
+        ref = np.asarray(tv["f_aero0"])
+        sF = np.abs(ref[:3]).max()
+        sM = np.abs(ref[3:]).max()
+        errs.append(max(np.abs(f0[:3] - ref[:3]).max() / sF,
+                        np.abs(f0[3:] - ref[3:]).max() / sM))
+    errs = np.asarray(errs)
+    assert np.median(errs) < 0.04, np.median(errs)
+    assert errs.max() < 0.08, errs.max()
+
+
+def test_yaw_misalign_applied_unlike_reference(rotor_and_truth):
+    """Documents a deliberate deviation: the reference's calcAero never
+    consumes case['yaw_misalign'] — raft_rotor.py:815 calls setYaw() with
+    no argument, so the yaw command stays 0 and its yaw_mode-2/3 pickles
+    are exactly yaw-invariant (verified here from the data).  This
+    framework wires the case yaw command through rotor_pose into the BEM,
+    so thrust genuinely drops with misalignment (~cos^2 scale)."""
+    rot, w, truth = rotor_and_truth
+    p = "/root/reference/tests/test_data/IEA15MW_true_calcAero-yaw_mode2.pkl"
+    t2 = pickle.load(open(p, "rb"))
+    rows = {}
+    for tv in t2:
+        c = tv["case"]
+        if (c["wind_speed"] == 10.0 and c["wind_heading"] == 0
+                and c.get("turbulence") == 0):
+            rows[float(c["yaw_misalign"])] = np.asarray(tv["f_aero0"])
+    # the reference ground truth ignores the yaw command entirely
+    assert_allclose(rows[45.0], rows[0.0], rtol=1e-12)
+    assert_allclose(rows[-90.0], rows[0.0], rtol=1e-12)
+
+    # ours: thrust falls with misalignment, roughly cos^2
+    U = 10.0
+    Om = float(np.interp(U, rot.Uhub_ops, rot.Omega_rpm_ops))
+    pi_ = float(np.interp(U, rot.Uhub_ops, rot.pitch_deg_ops))
+    T0 = float(R.bem_evaluate(rot, U, Om, pi_, tilt=0.0, yaw=0.0)["T"])
+    T45 = float(R.bem_evaluate(rot, U, Om, pi_, tilt=0.0,
+                               yaw=np.radians(45.0))["T"])
+    assert 0.3 * T0 < T45 < 0.75 * T0

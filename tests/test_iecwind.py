@@ -1,0 +1,114 @@
+"""IEC 61400-1 extreme-condition parity vs the reference's pyIECWind.
+
+The reference module is dependency-free and importable here, so the
+sigma-models and gust-magnitude constants are compared numerically
+(ground-truth use of the public reference, like tests/test_qtf.py does
+with helpers.py).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from raft_tpu.models.iecwind import IECWindExtreme
+
+REF_DIR = "/root/reference/raft"
+
+
+@pytest.fixture(scope="module")
+def ref_iec():
+    if not os.path.isfile(os.path.join(REF_DIR, "pyIECWind.py")):
+        pytest.skip("reference pyIECWind not available")
+    sys.path.insert(0, REF_DIR)
+    try:
+        import pyIECWind
+    finally:
+        sys.path.remove(REF_DIR)
+    r = pyIECWind.pyIECWind_extreme()
+    r.z_hub = 150.0
+    r.D = 240.0
+    r.Turbine_Class = "I"
+    r.Turbulence_Class = "B"
+    r.setup()
+    return r
+
+
+@pytest.fixture()
+def ours():
+    return IECWindExtreme(turbine_class="I", turbulence_class="B",
+                          z_hub=150.0, D=240.0)
+
+
+def test_sigma_models_match_reference(ref_iec, ours):
+    for U in (4.0, 10.0, 15.0, 24.0):
+        assert_allclose(ours.NTM(U), ref_iec.NTM(U), rtol=1e-12)
+        assert_allclose(ours.ETM(U), ref_iec.ETM(U), rtol=1e-12)
+        s_o = ours.EWM(U)
+        s_r = ref_iec.EWM(U)
+        assert_allclose(s_o, s_r, rtol=1e-12)
+
+
+def test_class_constants_match_reference(ref_iec, ours):
+    assert ours.V_ref == ref_iec.V_ref
+    assert ours.V_ave == ref_iec.V_ave
+    assert ours.I_ref == ref_iec.I_ref
+    assert ours.Sigma_1 == ref_iec.Sigma_1
+    # low-hub branch of the turbulence scale parameter
+    low = IECWindExtreme(z_hub=40.0)
+    assert low.Sigma_1 == 0.7 * 40.0
+
+
+def test_eog_profile():
+    iec = IECWindExtreme(z_hub=150.0, D=240.0)
+    t, V = iec.EOG(11.0)
+    # gust magnitude equals the IEC minimum of the two candidate formulas
+    sigma = iec.NTM(11.0)
+    Ve1 = 0.8 * 1.4 * iec.V_ref
+    expect = min(1.35 * (Ve1 - 11.0),
+                 3.3 * sigma / (1.0 + 0.1 * 240.0 / iec.Sigma_1))
+    assert_allclose(iec.V_gust, expect, rtol=1e-12)
+    # profile starts/ends at V_hub, dips then overshoots
+    assert_allclose(V[0], 11.0)
+    assert_allclose(V[-1], 11.0, atol=1e-6)
+    assert V.min() < 11.0 - 0.2 * expect
+    assert V.max() > 11.0
+
+
+def test_edc_ecd_ews_profiles():
+    iec = IECWindExtreme(z_hub=150.0, D=240.0)
+    t, th = iec.EDC(10.0)
+    assert th[0] == 0.0
+    assert_allclose(th[-1], iec.theta_e, rtol=1e-9)
+    assert np.all(np.diff(th) >= -1e-12)   # monotone ramp
+
+    t, V, thc = iec.ECD(10.0)
+    assert_allclose(V[-1], 25.0, rtol=1e-9)          # V + 15 m/s coherent
+    assert_allclose(thc[-1], 72.0, rtol=1e-9)        # 720/10 deg
+    t, V, thc = iec.ECD(3.0)
+    assert_allclose(thc[-1], 180.0, rtol=1e-9)       # low-speed branch
+
+    t, sh = iec.EWS(12.0)
+    assert sh[0] == 0.0 and abs(sh[-1]) < 1e-9       # transient closes
+    assert sh.max() > 0
+    with pytest.raises(ValueError):
+        iec.EWS(12.0, mode="diagonal")
+
+
+def test_execute_and_wnd_files(tmp_path):
+    iec = IECWindExtreme(z_hub=150.0, D=240.0, outdir=str(tmp_path))
+    assert iec.execute("NTM", 10.0) == iec.NTM(10.0)
+    s, ve = iec.execute("EWM50", 10.0)
+    assert_allclose(ve, 1.4 * iec.V_ref, rtol=1e-12)
+    s, ve1 = iec.execute("EWM1", 10.0)
+    assert_allclose(ve1, 0.8 * 1.4 * iec.V_ref, rtol=1e-12)
+    for tag in ("EOG", "EDC", "ECD", "EWS"):
+        iec.execute(tag, 11.0)
+        assert os.path.isfile(iec.fpath), tag
+        # numeric block parses: 8 columns, time strictly increasing
+        rows = np.loadtxt(iec.fpath, comments="!")
+        assert rows.shape[1] == 8
+        assert np.all(np.diff(rows[:, 0]) > 0)
+    with pytest.raises(ValueError):
+        iec.execute("XYZ", 10.0)

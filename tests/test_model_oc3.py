@@ -3,9 +3,9 @@
 Case 0 (wave-only, parked-equivalent loading) validates the entire
 strip-theory hydro + mooring + drag-linearization + RAO pipeline: PSDs
 match the reference pickle to ~1e-5 relative.  Case 1 (operating turbine)
-inherits the documented ~2% BEM aero deviation (see tests/test_rotor.py),
-so only loose sanity tolerances apply there pending CCBlade cross-load
-parity.
+is parity-checked at 1-9% bands set by the documented ~2.5% BEM
+induction-level deviation (the hub-load sign convention is reconciled with
+CCBlade — see tests/test_rotor.py); control channels match to <0.1%.
 """
 import os
 import pickle
@@ -48,25 +48,39 @@ def test_wave_only_case_psd_parity(model_and_truth):
     assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=6e-2)
 
 
-def test_operating_case_sanity(model_and_truth):
-    """Loose check: operating-turbine case within ~10% (limited by the
-    reimplemented BEM; see test_rotor.py docstring)."""
+def test_operating_case_parity(model_and_truth):
+    """Operating-turbine case vs the reference pickle.  Tolerances are
+    ~1.5-2x the deviations measured after the CCBlade hub-load sign
+    reconciliation (see tests/test_rotor.py), which are bounded by the
+    documented ~2.5% BEM induction-level difference: mean offsets within
+    1-5%, response stds within 5-9%, control channels < 0.1%."""
     m, truth = model_and_truth
     ours, ref = m.results["case_metrics"][1][0], truth[1][0]
-    for ch, tol in [("surge", 0.05), ("heave", 0.05), ("pitch", 0.10)]:
+    for ch, tol in [("surge", 0.02), ("heave", 0.02), ("roll", 0.02),
+                    ("pitch", 0.04), ("sway", 0.08)]:
         assert_allclose(ours[f"{ch}_avg"], ref[f"{ch}_avg"], rtol=tol,
                         err_msg=f"{ch}_avg")
-        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=0.10,
+    for ch, tol in [("surge", 0.07), ("sway", 0.12), ("heave", 0.02),
+                    ("roll", 0.11), ("pitch", 0.08), ("yaw", 0.05)]:
+        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=tol,
                         err_msg=f"{ch}_std")
-    # yaw + aero-servo control channels: loose guards so regressions in the
-    # aero-servo path are caught (ADVICE r1); tolerances limited by the
-    # reimplemented BEM (~3%).
-    assert_allclose(ours["yaw_std"], ref["yaw_std"], rtol=0.15, atol=1e-3,
-                    err_msg="yaw_std")
+    # mean yaw is the ratio of two small aero cross-moments -> large
+    # relative band; guard absolutely (measured 4.3 deg apart)
+    assert abs(float(np.squeeze(ours["yaw_avg"]))
+               - float(np.squeeze(ref["yaw_avg"]))) < 6.0
+    # aero-servo control channels ride the published closed-form transfer
+    # function and match to <1e-3 (ADVICE r1 asked for these guards)
     for ch in ("omega_std", "torque_std", "bPitch_std"):
-        assert_allclose(ours[ch], ref[ch], rtol=0.25, err_msg=ch)
-    assert_allclose(ours["omega_avg"], ref["omega_avg"], rtol=0.02)
-    assert_allclose(ours["bPitch_avg"], ref["bPitch_avg"], rtol=0.10)
+        assert_allclose(ours[ch], ref[ch], rtol=5e-3, err_msg=ch)
+    assert_allclose(ours["omega_avg"], ref["omega_avg"], rtol=1e-3)
+    assert_allclose(ours["bPitch_avg"], ref["bPitch_avg"], rtol=1e-3)
+    # nacelle acceleration / tower-base moment / mooring tension stats
+    assert_allclose(ours["AxRNA_std"], ref["AxRNA_std"], rtol=0.06,
+                    err_msg="AxRNA_std")
+    assert_allclose(ours["Mbase_std"], ref["Mbase_std"], rtol=0.06,
+                    err_msg="Mbase_std")
+    assert_allclose(ours["Tmoor_avg"], ref["Tmoor_avg"], rtol=0.02)
+    assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=0.18)
 
 
 def test_statics_wave_and_current():

@@ -42,12 +42,11 @@ def weis_replay():
         member_options=opt["member_options"])
     # declaration check BEFORE the run: prime() raises on the first
     # unknown key, so collect the full unmapped list from a bare setup
-    probe = RAFT_OMDAO_Standalone(**kwargs)
-    probe.prime()
-    known = set(probe._inputs) | set(probe._discrete_inputs)
-    unknown = [k for k in inputs if k not in known]
-
     comp = RAFT_OMDAO_Standalone(**kwargs)
+    comp.prime()
+    known = set(comp._inputs) | set(comp._discrete_inputs)
+    unknown = [k for k in inputs if k not in known]
+    # run() re-primes with the overlay on the already-setup vectors
     outputs = comp.run(inputs) if not unknown else None
     return comp, inputs, outputs, unknown
 

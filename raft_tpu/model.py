@@ -35,7 +35,8 @@ import jax.numpy as jnp
 from raft_tpu.models import mooring as mr
 from raft_tpu.models.fowt import (
     FOWTModel, build_fowt, build_seastate, fowt_pose, fowt_statics,
-    fowt_hydro_constants, fowt_hydro_excitation, fowt_hydro_linearization,
+    fowt_hydro_constants, fowt_hydro_excitation, fowt_drag_precompute,
+    fowt_hydro_linearization_pre,
     fowt_drag_excitation, fowt_current_loads, fowt_turbine_constants,
     fowt_bem_excitation,
 )
@@ -516,6 +517,8 @@ class Model:
 
         F_lin = F_BEM[0] + exc["F_hydro_iner"][0] + Fhydro_2nd[0]   # (6, nw)
 
+        drag_pre = fowt_drag_precompute(fowt, pose_eq, u0)
+
         def run_fixed_point(F_lin, Xi_init=None):
             """Drag-linearization fixed point: lax.while_loop around one
             batched complex solve over all frequencies.  ``Xi_init`` warm-
@@ -525,7 +528,8 @@ class Model:
 
             def iteration(carry):
                 XiLast, Xi, Z, Bmat, ii, done = carry
-                B_drag, Bmat = fowt_hydro_linearization(fowt, pose_eq, XiLast, u0)
+                B_drag, Bmat = fowt_hydro_linearization_pre(
+                    fowt, pose_eq, drag_pre, XiLast)
                 F_drag = fowt_drag_excitation(fowt, pose_eq, Bmat, u0)
                 B_tot = B_lin + B_drag[:, :, None]
                 Zn = (-w[None, None, :] ** 2 * M_lin

@@ -130,14 +130,18 @@ class IECWindExtreme:
         (t, shear(t)) — the transient LINEAR shear across the rotor disc
         [1/s-less, expressed as delta-V across D] for the vertical or
         horizontal variant."""
+        if mode not in ("vertical", "horizontal"):
+            raise ValueError("mode must be 'vertical' or 'horizontal'")
         V_hub = float(V_hub)
         sigma_1 = self.NTM(V_hub)
         beta, T = 6.4, 12.0
         t = self._tgrid(T)
+        # IEC gives the same transient amplitude for EWS-V and EWS-H
+        # (eq. 27 vs 28); the mode selects which shear column the .wnd
+        # writer fills (see execute)
         amp = (2.5 + 0.2 * beta * sigma_1 * (self.D / self.Sigma_1) ** 0.25)
         shear = sign * amp * (1.0 - np.cos(2 * np.pi * t / T))
-        if mode not in ("vertical", "horizontal"):
-            raise ValueError("mode must be 'vertical' or 'horizontal'")
+        self._ews_mode = mode
         return t, shear
 
     # ----- uniform-wind file output ------------------------------------
@@ -173,10 +177,12 @@ class IECWindExtreme:
         return path
 
     # ----- dispatcher ---------------------------------------------------
-    def execute(self, condition, V_hub):
+    def execute(self, condition, V_hub, mode="vertical"):
         """Dispatch by IEC condition tag (reference: pyIECWind.py:405-419).
         'NTM'/'ETM' -> sigma; 'EWM50'/'EWM1' -> (sigma, Ve); transient
-        tags ('EOG','EDC','ECD','EWS') -> time histories + a .wnd file."""
+        tags ('EOG','EDC','ECD','EWS') -> time histories + a .wnd file.
+        ``mode`` selects the EWS variant (vertical/horizontal shear
+        column in the .wnd file)."""
         if condition == "NTM":
             return self.NTM(V_hub)
         if condition == "ETM":
@@ -201,8 +207,9 @@ class IECWindExtreme:
             self.write_wnd(f"ECD_U{V_hub:.1f}.wnd", t, V=V, theta=th)
             return t, V, th
         if condition == "EWS":
-            t, sh = self.EWS(V_hub)
-            self.write_wnd(f"EWS_U{V_hub:.1f}.wnd", t,
-                           V=np.full(len(t), float(V_hub)), shear_v=sh)
+            t, sh = self.EWS(V_hub, mode=mode)
+            cols = {"shear_v": sh} if mode == "vertical" else {"shear_h": sh}
+            self.write_wnd(f"EWS{mode[0].upper()}_U{V_hub:.1f}.wnd", t,
+                           V=np.full(len(t), float(V_hub)), **cols)
             return t, sh
         raise ValueError(f"unknown IEC condition '{condition}'")

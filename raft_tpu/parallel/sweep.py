@@ -25,7 +25,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.models import mooring as mr
 from raft_tpu.models.fowt import (
     FOWTModel, fowt_pose, fowt_statics, fowt_hydro_constants,
-    fowt_hydro_excitation, fowt_hydro_linearization, fowt_drag_excitation,
+    fowt_hydro_excitation, fowt_drag_precompute,
+    fowt_hydro_linearization_pre, fowt_drag_excitation,
     fowt_bem_excitation,
 )
 from raft_tpu.ops.linalg import solve_complex
@@ -49,7 +50,7 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
     nw = len(fowt.w)
     dw = float(fowt.w[1] - fowt.w[0])
 
-    def solve(Hs, Tp, beta):
+    def setup(Hs, Tp, beta):
         pose = fowt_pose(fowt, r6)
         stat = fowt_statics(fowt, pose)
         hc = fowt_hydro_constants(fowt, pose)
@@ -68,17 +69,29 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         C_lin = stat["C_struc"] + C_moor + stat["C_hydro"]
         F_lin = F_BEM + exc["F_hydro_iner"][0]
         u0 = exc["u"][0]
+        drag_pre = fowt_drag_precompute(fowt, pose, u0)
+        return dict(pose=pose, drag_pre=drag_pre, u0=u0, B_BEM=B_BEM,
+                    M_lin=M_lin, C_lin=C_lin, F_lin=F_lin)
+
+    def drag_step(st, Xi):
+        """One drag pass + batched RAO solve; rank-polymorphic over an
+        optional leading case-batch axis (see fowt_drag_precompute)."""
+        B_drag6, Bmat = fowt_hydro_linearization_pre(
+            fowt, st["pose"], st["drag_pre"], Xi)
+        F_drag = fowt_drag_excitation(fowt, st["pose"], Bmat, st["u0"])
+        Z = (-w ** 2 * st["M_lin"]
+             + 1j * w * (B_drag6[..., None] + st["B_BEM"])
+             + st["C_lin"][..., None]).astype(complex)
+        Xin = solve_complex(jnp.moveaxis(Z, -1, -3),
+                            jnp.moveaxis(st["F_lin"] + F_drag, -1, -2))
+        return jnp.moveaxis(Xin, -2, -1)
+
+    def solve(Hs, Tp, beta):
+        st = setup(Hs, Tp, beta)
 
         def body(carry):
             XiLast, Xi, ii, done = carry
-            B_drag6, Bmat = fowt_hydro_linearization(fowt, pose, XiLast, u0)
-            F_drag = fowt_drag_excitation(fowt, pose, Bmat, u0)
-            Z = (-w[None, None, :] ** 2 * M_lin
-                 + 1j * w[None, None, :] * (B_drag6[:, :, None] + B_BEM)
-                 + C_lin[:, :, None]).astype(complex)
-            Xin = solve_complex(jnp.moveaxis(Z, -1, 0),
-                                jnp.moveaxis(F_lin + F_drag, -1, 0))
-            Xin = jnp.moveaxis(Xin, 0, -1)
+            Xin = drag_step(st, XiLast)
             conv = jnp.all(jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol) < tol)
             XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xin)
             return (XiNext, Xin, ii + 1, done | conv)
@@ -92,6 +105,32 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         std = jax.vmap(lambda row: get_rms(row))(Xi)
         return dict(Xi=Xi, std=std)
 
+    def solve_batched(Hs, Tp, beta):
+        """Explicitly batched case sweep: vmapped setup + manually batched
+        fixed point (vmap around the loop primitive compiles ~300x slower
+        on XLA:TPU; see make_variant_solver.batched)."""
+        st = jax.vmap(setup)(Hs, Tp, beta)
+        nc = Hs.shape[0]
+
+        def body(i, carry):
+            XiLast, Xi, done = carry
+            Xin = drag_step(st, XiLast)
+            conv = jnp.all(
+                jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol) < tol,
+                axis=(-2, -1))
+            frozen = done[:, None, None]
+            XiNext = jnp.where(frozen | conv[:, None, None], XiLast,
+                               0.2 * XiLast + 0.8 * Xin)
+            Xi_out = jnp.where(frozen, Xi, Xin)
+            return (XiNext, Xi_out, done | conv)
+
+        Xi0 = jnp.zeros((nc, 6, nw), dtype=complex) + XiStart
+        _, Xi, _ = jax.lax.fori_loop(0, nIter, body,
+                                     (Xi0, Xi0, jnp.zeros(nc, bool)))
+        std = get_rms(Xi, axis=-1)
+        return dict(Xi=Xi, std=std)
+
+    solve.batched = solve_batched
     return solve
 
 
@@ -103,7 +142,7 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
     With no mesh, runs as a plain vmap on the default device.
     """
     solver = make_case_solver(fowt, **kw)
-    batched = jax.jit(jax.vmap(solver))
+    batched = jax.jit(solver.batched)
     Hs = jnp.asarray(Hs, float)
     Tp = jnp.asarray(Tp, float)
     beta = jnp.asarray(beta, float)

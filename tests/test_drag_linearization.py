@@ -1,0 +1,95 @@
+"""A/B equivalence of the fast drag-linearization decomposition.
+
+`fowt_hydro_linearization` (the direct node-level RMS computation,
+reference: raft_fowt.py:1152-1266) is kept as the oracle;
+`fowt_drag_precompute` + `fowt_hydro_linearization_pre` (the
+wave-energy / cross-term / motion-quadratic split that removes all
+(node,3,nw) temporaries from the fixed-point iterations) must reproduce
+it to machine precision — unbatched and with a leading batch axis.
+
+Runs on a self-contained spar design (no reference checkout needed), so
+the guard holds everywhere.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.models.fowt import (build_fowt, build_seastate,
+                                  fowt_drag_excitation, fowt_drag_precompute,
+                                  fowt_hydro_constants, fowt_hydro_excitation,
+                                  fowt_hydro_linearization,
+                                  fowt_hydro_linearization_pre, fowt_pose)
+
+
+def _design():
+    return dict(
+        settings=dict(min_freq=0.01, max_freq=0.40),
+        site=dict(water_depth=300.0, rho_water=1025.0, g=9.81),
+        platform=dict(members=[
+            dict(name="spar", type=2, rA=[0, 0, -60], rB=[0, 0, 10],
+                 shape="circ", stations=[0, 70], d=[10.0, 8.0], t=0.05,
+                 l_fill=[30.0], rho_fill=[2500.0], Cd=0.8, Ca=0.97,
+                 CdEnd=0.6, CaEnd=0.6, rho_shell=7850),
+            dict(name="pont", type=2, rA=[0, 0, -55], rB=[30, 0, -55],
+                 shape="rect", stations=[0, 30], d=[[4.0, 3.0], [4.0, 3.0]],
+                 t=0.04, Cd=[1.0, 1.2], Ca=[0.8, 1.0], CdEnd=0.6,
+                 CaEnd=0.6, rho_shell=7850, heading=[0, 120, 240]),
+        ]),
+    )
+
+
+@pytest.fixture(scope="module")
+def fixture():
+    w = np.arange(0.01, 0.40, 0.01) * 2 * np.pi
+    fowt = build_fowt(_design(), w, depth=300.0)
+    pose = fowt_pose(fowt, np.array([1.5, -0.7, -0.3, 0.02, -0.015, 0.01]))
+    case = dict(wave_spectrum="JONSWAP", wave_period=9.0, wave_height=5.0,
+                wave_heading=35.0, wind_speed=0, turbine_status="idle")
+    ss = build_seastate(fowt, case)
+    hc = fowt_hydro_constants(fowt, pose)
+    u0 = fowt_hydro_excitation(fowt, pose, ss, hc)["u"][0]
+    rng = np.random.default_rng(5)
+    Xi = jnp.asarray((rng.standard_normal((6, len(w)))
+                      + 1j * rng.standard_normal((6, len(w)))) * 0.4)
+    return fowt, pose, u0, Xi
+
+
+def test_pre_matches_direct(fixture):
+    fowt, pose, u0, Xi = fixture
+    B1, Bm1 = fowt_hydro_linearization(fowt, pose, Xi, u0)
+    pre = fowt_drag_precompute(fowt, pose, u0)
+    B2, Bm2 = fowt_hydro_linearization_pre(fowt, pose, pre, Xi)
+    scale = float(jnp.max(jnp.abs(B1)))
+    np.testing.assert_allclose(np.asarray(B2), np.asarray(B1),
+                               atol=1e-10 * scale)
+    np.testing.assert_allclose(np.asarray(Bm2), np.asarray(Bm1),
+                               atol=1e-10 * float(jnp.max(jnp.abs(Bm1))))
+    # and the resulting drag excitation
+    F1 = fowt_drag_excitation(fowt, pose, Bm1, u0)
+    F2 = fowt_drag_excitation(fowt, pose, Bm2, u0)
+    np.testing.assert_allclose(np.asarray(F2), np.asarray(F1),
+                               atol=1e-10 * float(jnp.max(jnp.abs(F1))))
+
+
+def test_pre_batched_matches_per_item(fixture):
+    """The rank-polymorphic (ellipsis-batched) path must equal per-item
+    evaluation — this is what the hand-batched TPU fixed point relies on."""
+    fowt, pose, u0, Xi = fixture
+    NB = 4
+    Xib = jnp.stack([Xi * (1.0 + 0.2 * i) for i in range(NB)])
+    poseb = jax.tree.map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x),
+                                   (NB,) + jnp.asarray(x).shape), pose)
+    u0b = jnp.broadcast_to(u0, (NB,) + u0.shape)
+    preb = fowt_drag_precompute(fowt, poseb, u0b)
+    Bb, Bmb = fowt_hydro_linearization_pre(fowt, poseb, preb, Xib)
+    Fb = fowt_drag_excitation(fowt, poseb, Bmb, u0b)
+    pre = fowt_drag_precompute(fowt, pose, u0)
+    for i in range(NB):
+        Bi, Bmi = fowt_hydro_linearization_pre(fowt, pose, pre, Xib[i])
+        Fi = fowt_drag_excitation(fowt, pose, Bmi, u0)
+        np.testing.assert_allclose(np.asarray(Bb[i]), np.asarray(Bi),
+                                   rtol=1e-12, atol=1e-9)
+        np.testing.assert_allclose(np.asarray(Fb[i]), np.asarray(Fi),
+                                   rtol=1e-12, atol=1e-9)

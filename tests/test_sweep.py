@@ -13,6 +13,7 @@ import yaml
 from numpy.testing import assert_allclose
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from raft_tpu.models.fowt import build_fowt
@@ -70,3 +71,26 @@ def test_sharded_output_is_distributed(fowt):
     out = sweep_cases(fowt, Hs, Tp, beta, mesh=mesh, nIter=2)
     sh = out["std"].sharding
     assert len(sh.device_set) == 8
+
+
+def test_case_solver_batched_matches_serial(fowt):
+    """solver.batched (the hand-batched fixed point used by sweep_cases on
+    TPU) must reproduce the serial per-case while_loop solver exactly,
+    including per-case convergence freezing."""
+    import jax
+
+    from raft_tpu.parallel.sweep import make_case_solver
+
+    solver = make_case_solver(fowt, nIter=6, tol=0.01)
+    Hs = jnp.asarray([2.0, 5.0, 8.0, 11.0])
+    Tp = jnp.asarray([7.0, 10.0, 12.0, 15.0])
+    beta = jnp.deg2rad(jnp.asarray([0.0, 30.0, 120.0, 250.0]))
+    out_b = solver.batched(Hs, Tp, beta)
+    for i in range(4):
+        out_i = solver(Hs[i], Tp[i], beta[i])
+        np.testing.assert_allclose(np.asarray(out_b["Xi"][i]),
+                                   np.asarray(out_i["Xi"]),
+                                   rtol=1e-9, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(out_b["std"][i]),
+                                   np.asarray(out_i["std"]),
+                                   rtol=1e-9, atol=1e-12)

@@ -122,3 +122,26 @@ def test_grid_reproduces_reference_shape(volturn_design):
     assert meta["shape"] == (3, 3, 3, 3, 3)
     assert len(meta["grid"]) == 243
     assert thetas["rA0"].shape[0] == 243
+
+
+def test_batched_solver_matches_vmap(base, volturn_design):
+    """solver.batched (manually batched fixed point, the TPU fast path —
+    vmap around a loop primitive compiles ~300x slower on XLA:TPU) must
+    reproduce vmap(solver) exactly: same per-variant convergence
+    decisions, same responses."""
+    import jax
+
+    from raft_tpu.parallel.variants import make_variant_solver, volturn_grid
+
+    thetas0, _ = volturn_grid(volturn_design, factors=(0.9, 1.1))
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, len(thetas0["rA0"]), 6)
+    thetas = {k: jnp.asarray(np.asarray(v)[idx]) for k, v in thetas0.items()}
+    solver = make_variant_solver(base, Hs=6.0, Tp=12.0, ballast=True,
+                                 nIter=5, tol=0.01, newton_iters=8)
+    out_v = jax.vmap(solver)(thetas)
+    out_b = solver.batched(thetas)
+    for key in ("mass", "offset", "pitch_deg", "std", "Xeq", "Xi"):
+        np.testing.assert_allclose(np.asarray(out_b[key]),
+                                   np.asarray(out_v[key]),
+                                   rtol=1e-9, atol=1e-12, err_msg=key)

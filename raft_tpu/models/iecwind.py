@@ -141,7 +141,6 @@ class IECWindExtreme:
         # writer fills (see execute)
         amp = (2.5 + 0.2 * beta * sigma_1 * (self.D / self.Sigma_1) ** 0.25)
         shear = sign * amp * (1.0 - np.cos(2 * np.pi * t / T))
-        self._ews_mode = mode
         return t, shear
 
     # ----- uniform-wind file output ------------------------------------

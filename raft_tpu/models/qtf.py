@@ -297,7 +297,8 @@ def kim_yue_correction(fowt, pose, beta, Nm: int = 10):
 # slender-body QTF  (reference: raft_fowt.py:1385-1648)
 # --------------------------------------------------------------------------
 
-def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None):
+def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None,
+                          rows=None):
     """Slender-body QTF for one wave heading, (nw2, nw2, 6) complex.
 
     Parameters
@@ -308,6 +309,10 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None):
     beta : wave heading [rad]
     Xi0 : (6, nw) motion RAOs on the MODEL grid, or None for a fixed body
     M_struc : (6,6) structural mass matrix for the Pinkster-IV term
+    rows : optional (nr,) array of w1-row indices.  When given, only those
+        rows of the RAW pair grid are computed and returned (nr, nw2, 6) —
+        no Kim&Yue correction and no Hermitian completion — so callers can
+        shard the row axis over a device mesh (`calc_qtf_sharded`).
     """
     w2 = jnp.asarray(fowt.w1_2nd)
     k2 = jnp.asarray(fowt.k1_2nd)
@@ -538,6 +543,10 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None):
 
         return F_rotN + F_side + F_eta
 
+    if rows is not None:
+        return jax.vmap(jax.vmap(pair, in_axes=(None, 0)),
+                        in_axes=(0, None))(jnp.asarray(rows), idx)
+
     Q = jax.vmap(jax.vmap(pair, in_axes=(None, 0)), in_axes=(0, None))(idx, idx)
 
     # Kim & Yue analytical 2nd-order diffraction correction for MCF
@@ -546,6 +555,42 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None):
 
     # keep only the upper triangle (w2 >= w1), then Hermitian-complete
     # (reference :1638-1640)
+    upper = (w2[None, :] >= w2[:, None]).astype(float)
+    Q = Q * upper[:, :, None]
+    eye = jnp.eye(nw2)[:, :, None]
+    return Q + jnp.conj(jnp.swapaxes(Q, 0, 1)) - eye * jnp.conj(Q)
+
+
+def calc_qtf_sharded(fowt, pose, beta, Xi0=None, M_struc=None, mesh=None,
+                     axis_name="qtf_rows"):
+    """QTF pair grid sharded over a device mesh — the framework's
+    context-parallel axis (SURVEY §5.7: the reference handles the
+    2nd-order grid's cost by decimation; here the (w1, w2) pair grid —
+    the "sequence" dimension of this workload — is sharded by w1-row
+    across devices, with the Hermitian completion as the only cross-
+    device exchange).
+
+    Returns the same (nw2, nw2, 6) Hermitian-completed QTF as
+    `calc_qtf_slender_body` (validated to ~1e-12 on an 8-device virtual
+    CPU mesh in tests/test_qtf.py)."""
+    if mesh is None:
+        return calc_qtf_slender_body(fowt, pose, beta, Xi0=Xi0,
+                                     M_struc=M_struc)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nw2 = len(fowt.w1_2nd)
+    ndev = int(np.prod(list(mesh.shape.values())))
+    npad = (-nw2) % ndev
+    # pad with wrapped rows (discarded after the gather)
+    rows_all = jnp.asarray(np.arange(nw2 + npad) % nw2)
+    rows_sh = jax.device_put(rows_all, NamedSharding(mesh, P(axis_name)))
+
+    fn = jax.jit(lambda r: calc_qtf_slender_body(
+        fowt, pose, beta, Xi0=Xi0, M_struc=M_struc, rows=r))
+    Q = fn(rows_sh)[:nw2]
+
+    Q = Q + kim_yue_correction(fowt, pose, beta)
+    w2 = jnp.asarray(fowt.w1_2nd)
     upper = (w2[None, :] >= w2[:, None]).astype(float)
     Q = Q * upper[:, :, None]
     eye = jnp.eye(nw2)[:, :, None]

@@ -90,7 +90,10 @@ _GJ_MIN_BATCH = 4096
 def _use_gauss_jordan(n, batch_elems):
     if n > _GJ_MAX_N or batch_elems < _GJ_MIN_BATCH:
         return False
-    return jax.default_backend() == "tpu"
+    # any accelerator backend (tpu / axon tunnel / gpu): LAPACK-quality
+    # batched LU is only available on cpu, and the TPU LU custom call is
+    # the pathological case this kernel replaces
+    return jax.default_backend() != "cpu"
 
 
 def solve_complex(A, b):

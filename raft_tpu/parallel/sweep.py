@@ -33,6 +33,34 @@ from raft_tpu.ops.linalg import solve_complex
 from raft_tpu.ops.spectra import jonswap, get_rms
 
 
+def unrolled_fixed_point(step, Xi0, nIter, tol):
+    """Shared drag-linearization fixed point for the hand-batched sweep
+    paths: nIter fully UNROLLED passes of ``step`` with per-item
+    convergence freezing (0.2/0.8 under-relaxation, the reference's
+    raft_model.py:961-991 scheme).
+
+    Unrolled rather than lax.fori/while because XLA:TPU streams the big
+    loop-invariant wave arrays through slow S(1) memory on every
+    iteration of a loop primitive (~700 ms/iter at 1024 items vs ~0.5 ms
+    unrolled; profiled with xprof — see parallel/variants.py).
+
+    Returns (XiLast, Xi, done) like the loop carries."""
+    XiLast = Xi0
+    Xi = Xi0
+    done = jnp.zeros(Xi0.shape[0], bool)
+    for _ in range(nIter):
+        Xin = step(XiLast)
+        conv = jnp.all(jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol) < tol,
+                       axis=(-2, -1))
+        frozen = done[:, None, None]
+        XiNext = jnp.where(frozen | conv[:, None, None], XiLast,
+                           0.2 * XiLast + 0.8 * Xin)
+        Xi = jnp.where(frozen, Xi, Xin)
+        done = done | conv
+        XiLast = XiNext
+    return XiLast, Xi, done
+
+
 def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
                      XiStart: float = 0.1, r6=None):
     """Pure per-case response solver (no aero; wave loading) suitable for
@@ -111,22 +139,9 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         on XLA:TPU; see make_variant_solver.batched)."""
         st = jax.vmap(setup)(Hs, Tp, beta)
         nc = Hs.shape[0]
-
-        def body(i, carry):
-            XiLast, Xi, done = carry
-            Xin = drag_step(st, XiLast)
-            conv = jnp.all(
-                jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol) < tol,
-                axis=(-2, -1))
-            frozen = done[:, None, None]
-            XiNext = jnp.where(frozen | conv[:, None, None], XiLast,
-                               0.2 * XiLast + 0.8 * Xin)
-            Xi_out = jnp.where(frozen, Xi, Xin)
-            return (XiNext, Xi_out, done | conv)
-
         Xi0 = jnp.zeros((nc, 6, nw), dtype=complex) + XiStart
-        _, Xi, _ = jax.lax.fori_loop(0, nIter, body,
-                                     (Xi0, Xi0, jnp.zeros(nc, bool)))
+        _, Xi, _ = unrolled_fixed_point(
+            lambda XiLast: drag_step(st, XiLast), Xi0, nIter, tol)
         std = get_rms(Xi, axis=-1)
         return dict(Xi=Xi, std=std)
 

@@ -339,28 +339,13 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
         vmap/fori/while interacts pathologically with XLA:TPU layout
         assignment — measured ~300x slower than the same math written
         with explicit batch axes (see tests/test_variants.py)."""
+        from raft_tpu.parallel.sweep import unrolled_fixed_point
+
         st = jax.vmap(setup)(thetas)
         nv = st["Xeq"].shape[0]
-
-        # UNROLLED fixed point (nIter is static).  A lax while/fori here
-        # makes XLA:TPU stream the big loop-invariant wave arrays through
-        # slow S(1) memory in 64-row chunks every iteration (~700 ms/iter
-        # at 1024 variants vs ~0.5 ms for the same step outside a loop);
-        # unrolling keeps them resident and lets the steps fuse.
-        XiLast = jnp.zeros((nv, 6, nw), dtype=complex) + XiStart
-        Xi = XiLast
-        done = jnp.zeros(nv, bool)
-        for _ in range(nIter + 1):
-            Xin = drag_step(st, XiLast)
-            conv = jnp.all(
-                jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol) < tol,
-                axis=(-2, -1))
-            frozen = done[:, None, None]
-            XiNext = jnp.where(frozen | conv[:, None, None], XiLast,
-                               0.2 * XiLast + 0.8 * Xin)
-            Xi = jnp.where(frozen, Xi, Xin)
-            done = done | conv
-            XiLast = XiNext
+        Xi0 = jnp.zeros((nv, 6, nw), dtype=complex) + XiStart
+        _, Xi, _ = unrolled_fixed_point(
+            lambda XiLast: drag_step(st, XiLast), Xi0, nIter + 1, tol)
         return _finish(st, Xi)
 
     solve.batched = solve_batched

@@ -543,3 +543,39 @@ def test_out_folder_qtf_snapshot_and_resume(tmp_path):
         rtol=1e-6, atol=1e-12)
     np.testing.assert_allclose(res2["mean_offsets"][0],
                                res1["mean_offsets"][0], rtol=1e-6, atol=1e-12)
+
+
+def test_qtf_sharded_matches_unsharded():
+    """calc_qtf_sharded over an 8-device CPU mesh == the single-device QTF
+    (the context-parallel axis of SURVEY §5.7: pair-grid rows sharded,
+    Hermitian completion as the only cross-device exchange)."""
+    import yaml
+    import jax
+    from jax.sharding import Mesh
+
+    from raft_tpu.models.fowt import build_fowt, build_seastate, fowt_pose
+
+    path = "/root/reference/examples/OC4semi-RAFT_QTF.yaml"
+    if not os.path.isfile(path):
+        pytest.skip("reference example not available")
+    design = yaml.safe_load(open(path))
+    design["platform"]["min_freq2nd"] = 0.03
+    design["platform"]["df_freq2nd"] = 0.03
+    design["platform"]["max_freq2nd"] = 0.42    # 14 rows over 8 devices
+    w = np.arange(0.005, 0.25, 0.005) * 2 * np.pi
+    depth = float(design["site"]["water_depth"])
+    fowt = build_fowt(design, w, depth=depth)
+    pose = fowt_pose(fowt, np.zeros(6))
+    rng = np.random.default_rng(2)
+    Xi0 = (rng.standard_normal((6, len(w)))
+           + 1j * rng.standard_normal((6, len(w)))) * 0.2
+    M_struc = np.diag([2e7, 2e7, 2e7, 1e10, 1e10, 1e10]).astype(float)
+
+    Q1 = np.asarray(qt.calc_qtf_slender_body(fowt, pose, 0.0, Xi0=Xi0,
+                                             M_struc=M_struc))
+    mesh = Mesh(np.array(jax.devices("cpu")[:8]), axis_names=("qtf_rows",))
+    Q2 = np.asarray(qt.calc_qtf_sharded(fowt, pose, 0.0, Xi0=Xi0,
+                                        M_struc=M_struc, mesh=mesh))
+    scale = np.abs(Q1).max()
+    assert scale > 0
+    np.testing.assert_allclose(Q2, Q1, atol=1e-9 * scale)

@@ -31,6 +31,21 @@ import numpy as np
 import jax.numpy as jnp
 
 
+def _screen_finite(name, path, **arrays):
+    """Raise with an actionable message if any parsed coefficient array
+    carries NaN/Inf (reference guards its HAMS read-back the same way,
+    raft_fowt.py:708-714) — a corrupt file must not propagate silently."""
+    for label, arr in arrays.items():
+        if arr is None:
+            continue
+        bad = ~np.isfinite(np.asarray(arr))
+        if bad.any():
+            raise ValueError(
+                f"{name} file '{path}': {int(bad.sum())} non-finite "
+                f"value(s) in {label} — the file is corrupt or truncated; "
+                f"re-run the BEM solver or delete the cached output")
+
+
 def read_wamit1(path):
     """Parse a WAMIT `.1` added-mass/damping file.
 
@@ -71,7 +86,9 @@ def read_wamit1(path):
             M[i, j] = v
         return M
 
-    return dict(w=w, A=A, B=B, A0=mat(zero), Ainf=mat(inf))
+    out = dict(w=w, A=A, B=B, A0=mat(zero), Ainf=mat(inf))
+    _screen_finite("WAMIT .1", path, **out)
+    return out
 
 
 def read_wamit3(path):
@@ -104,6 +121,7 @@ def read_wamit3(path):
     # normalize headings to [0,360) and re-sort (reference: raft_fowt.py:669-676)
     headings = np.asarray(heads_raw) % 360.0
     order = np.argsort(headings)
+    _screen_finite("WAMIT .3", path, w=w, X=X, headings=np.asarray(heads_raw))
     return dict(w=w, headings=headings[order], X=X[order])
 
 

@@ -455,6 +455,15 @@ class Model:
             if fowt.potSecOrder > 0:
                 # mean drift feeds the statics re-solve (reference :548-554)
                 st["F_meandrift"] = st["Fhydro_2nd_mean"].sum(axis=0)
+        # sanitize the solved response before it reaches any consumer
+        # (reference guards the same way, raft_model.py:956-957) — a NaN
+        # here means diverged drag linearization or corrupt coefficients
+        bad = ~np.isfinite(np.asarray(Xi_sys))
+        if bad.any():
+            raise FloatingPointError(
+                f"solveDynamics produced {int(bad.sum())} non-finite "
+                f"response value(s) (case={case}); check BEM/QTF input "
+                f"files and drag-linearization convergence")
         self.Xi = Xi_sys
         self.results["response"] = {}
         return Xi_sys
@@ -590,6 +599,29 @@ class Model:
                           stat["M_struc"], fowt.w1_2nd):
                     h.update(np.ascontiguousarray(
                         np.asarray(a, dtype=complex)).tobytes())
+                # fold the DIRECT QTF inputs into the key too — the RAO is
+                # not a perfect proxy for every QTF-affecting quantity (a
+                # geometry edit could leave the first-order response
+                # numerically unchanged): node fields, depth, rho/g, and
+                # the per-member MCF flags (ADVICE r2)
+                import dataclasses as _dc
+                for fld in sorted(f.name for f in _dc.fields(fowt.nodes)):
+                    val = getattr(fowt.nodes, fld)
+                    h.update(fld.encode())
+                    if val is not None:
+                        h.update(np.ascontiguousarray(np.asarray(
+                            val, dtype=float)).tobytes())
+                h.update(np.asarray(
+                    [fowt.depth, fowt.rho_water, fowt.g]).tobytes())
+                h.update(np.asarray(
+                    [bool(getattr(m, "MCF", False)) for m in fowt.members],
+                    dtype=bool).tobytes())
+                # member end positions pin geometry the per-node scalars
+                # can't (a member relocated/re-oriented with unchanged
+                # discretization would otherwise collide)
+                for m in fowt.members:
+                    h.update(np.ascontiguousarray(np.asarray(
+                        [m.rA0, m.rB0], dtype=float)).tobytes())
                 key = h.hexdigest()
                 cache_path = _os.path.join(
                     self.outFolderQTF,

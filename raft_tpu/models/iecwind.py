@@ -103,6 +103,10 @@ class IECWindExtreme:
         (t, theta(t) [deg]) over the 6 s transient."""
         V_hub = float(V_hub)
         sigma_1 = self.NTM(V_hub)
+        # NOTE deliberate deviation: IEC 61400-1 Ed.3 eq. 21 uses
+        # 1 + 0.1*(D/Lambda_1); the reference (pyIECWind.py:156) types
+        # 0.01 instead.  We keep the standard's 0.1 (pinned by
+        # tests/test_iecwind.py::test_edc_uses_iec_coefficient).
         theta_e = np.degrees(4.0 * np.arctan(
             sigma_1 / (V_hub * (1.0 + 0.1 * self.D / self.Sigma_1))))
         T = 6.0
@@ -145,11 +149,17 @@ class IECWindExtreme:
 
     # ----- uniform-wind file output ------------------------------------
     def write_wnd(self, fname, t, V=None, theta=None, shear_v=None,
-                  shear_h=None):
+                  shear_h=None, pwr_shear=0.2):
         """Write an AeroDyn/InflowWind uniform wind file
         (reference: pyIECWind.py:373-403).  Columns: time, wind speed,
         direction [deg], vertical speed, horizontal shear, power-law
-        shear, linear vertical shear, gust speed."""
+        shear, linear vertical shear, gust speed.
+
+        ``shear_v``/``shear_h`` are the NORMALIZED (dimensionless) shear
+        columns InflowWind expects — delta-V across the rotor divided by
+        the wind-speed column.  ``pwr_shear`` fills the power-law
+        vertical-shear column (the reference writes alpha=0.2 for its
+        transient conditions, pyIECWind.py:149)."""
         t = np.asarray(t, float)
         n = len(t)
 
@@ -170,7 +180,7 @@ class IECWindExtreme:
                     "PwrLawVertShear  LinVertShear  GustSpeed\n")
             for i in range(n):
                 f.write(f"{t[i]:10.3f} {V[i]:10.4f} {theta[i]:10.4f} "
-                        f"{0.0:10.4f} {sh[i]:10.4f} {0.0:10.4f} "
+                        f"{0.0:10.4f} {sh[i]:10.4f} {pwr_shear:10.4f} "
                         f"{sv[i]:10.4f} {0.0:10.4f}\n")
         self.fpath = path
         return path
@@ -207,7 +217,12 @@ class IECWindExtreme:
             return t, V, th
         if condition == "EWS":
             t, sh = self.EWS(V_hub, mode=mode)
-            cols = {"shear_v": sh} if mode == "vertical" else {"shear_h": sh}
+            # InflowWind shear columns are normalized by the wind-speed
+            # column — divide the dimensional transient by V_hub before
+            # writing (reference: pyIECWind.py:302-303).
+            sh_wnd = sh / float(V_hub)
+            cols = ({"shear_v": sh_wnd} if mode == "vertical"
+                    else {"shear_h": sh_wnd})
             self.write_wnd(f"EWS{mode[0].upper()}_U{V_hub:.1f}.wnd", t,
                            V=np.full(len(t), float(V_hub)), **cols)
             return t, sh

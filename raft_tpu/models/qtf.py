@@ -92,6 +92,12 @@ def read_qtf_12d(path: str, rho: float = 1025.0, g: float = 9.81,
         qtf[i1, i2, ih, idof] = val
         if i1 != i2:
             qtf[i2, i1, ih, idof] = np.conj(val)
+    nbad = int((~np.isfinite(qtf)).sum())
+    if nbad:
+        raise ValueError(
+            f"QTF .12d file '{path}': {nbad} non-finite value(s) — the "
+            f"file is corrupt or truncated; delete it (and its .key "
+            f"checkpoint) and re-run the QTF computation")
     return QTFData(heads_rad=np.deg2rad(heads), w=w1, qtf=qtf)
 
 

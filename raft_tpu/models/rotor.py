@@ -271,10 +271,17 @@ def build_rotor(turbine: dict, w, ir: int = 0) -> RotorModel:
     cd_bp, cd_c = _pad_ppoly(cd_bps, cd_cs)
     cp_bp, cp_c = _pad_ppoly(cp_bps, cp_cs)
 
-    # blade element geometry (reference :309-320)
+    # blade element geometry (reference :309-320).  NOTE the reference's
+    # element grid spans [Rhub, LAST GEOMETRY RADIUS] (raft_rotor.py:139
+    # `rtip = geometry[-1][0]`, :312-315), NOT [Rhub, Rtip]: for IEA15MW
+    # the geometry table ends at 116.94 m while Rtip=120.97 m, and CCBlade
+    # still uses Rtip for the Prandtl tip loss and the hub/tip-padded
+    # integration.  Replicating this (previously we spanned to Rtip) was
+    # worth ~2.4% on thrust.
     gt = np.array(bl["geometry"], float)
-    dr = (Rtip - Rhub) / nr
-    blade_r = np.linspace(Rhub, Rtip, nr, endpoint=False) + dr / 2
+    rtip_geom = float(gt[-1, 0])
+    dr = (rtip_geom - Rhub) / nr
+    blade_r = np.linspace(Rhub, rtip_geom, nr, endpoint=False) + dr / 2
     chord = np.interp(blade_r, gt[:, 0], gt[:, 1])
     theta = np.interp(blade_r, gt[:, 0], gt[:, 2])
     precurve = np.interp(blade_r, gt[:, 0], gt[:, 3])
@@ -504,7 +511,21 @@ def _distributed_loads(rot: RotorModel, Uinf, Omega_rpm, pitch_deg, azimuth_deg,
 
 def _hub_loads_one_azimuth(rot: RotorModel, Np, Tp, azimuth_deg):
     """Integrate one blade's distributed loads (with hub/tip zero padding)
-    along the curved path and express force/moment in the hub frame."""
+    along the curved path and express force/moment in the hub frame,
+    using CCBlade's exact (somewhat ad-hoc) component conventions.
+
+    CCBlade does NOT form a coherent p x f cross product for the moments.
+    Its azimuth-frame components, identified by exhaustive fit against the
+    reference's IEA15MW_true_calcAero pickles (machine-precision match,
+    8e-16 over the full 30-case speed x heading envelope):
+      F   = trapz over s of (Np cos(cone), -Tp, Np sin(cone))
+      M_x = trapz(Tp * z_az, s)          (shaft torque)
+      M_y = trapz(Np * z_az, s)          (flap bending: raw normal load
+                                          times height — no cone
+                                          projection, no x_az arm)
+      M_z = 0                            (no in-plane moment component)
+    so the hub-frame My/Mz both come from rotating the flap bending
+    moment by the azimuth angle."""
     r = jnp.asarray(rot.blade_r)
     rfull = jnp.concatenate([jnp.array([rot.Rhub]), r, jnp.array([rot.Rtip])])
     curve = jnp.concatenate([jnp.zeros(1), jnp.asarray(rot.precurve),
@@ -517,10 +538,10 @@ def _hub_loads_one_azimuth(rot: RotorModel, Np, Tp, azimuth_deg):
                                                   jnp.radians(rot.precone))
     # force per unit path length in the azimuthal frame
     f = jnp.stack([Npf * jnp.cos(cone), -Tpf, Npf * jnp.sin(cone)], axis=-1)
-    p = jnp.stack([x_az, y_az, z_az], axis=-1)
-    m = jnp.cross(p, f)
     F_az = jnp.trapezoid(f, s, axis=0)
-    M_az = jnp.trapezoid(m, s, axis=0)
+    M_az = jnp.stack([jnp.trapezoid(Tpf * z_az, s),
+                      jnp.trapezoid(Npf * z_az, s),
+                      jnp.zeros(())])
     # azimuthal -> hub frame: rotation about x by the azimuth angle
     psi = jnp.radians(azimuth_deg)
     cpsi, spsi = jnp.cos(psi), jnp.sin(psi)
@@ -538,19 +559,14 @@ def bem_evaluate(rot: RotorModel, Uinf, Omega_rpm, pitch_deg,
     with nSector azimuthal sectors.  Fully differentiable w.r.t.
     (Uinf, Omega_rpm, pitch_deg).
 
-    Sign convention: Y and Mz are negated from this module's internal
-    (right-handed, cross-product) azimuthal integration to land on
-    CCBlade's reported hub loads.  Note this is an EMPIRICAL mapping, not
-    a rigid frame transform (a y-axis flip would also negate Q, which
-    CCBlade does not): CCBlade's azimuth/tangential conventions differ
-    between its T/Q integration and its cross-axis load rotation, and its
-    source is not available here to reconcile analytically.  Validated
-    against the reference's IEA15MW_true_calcAero pickles: all six
-    channels match CCBlade within the ~2.5% induction-level deviation
-    across the (speed x heading) envelope at yaw_mode 0 (median 2.4%,
-    tests/test_rotor.py::test_hub_loads_full_envelope_parity), where the
-    previous self-consistent convention left Y/Mz sign-flipped and the
-    tilt-asymmetry channels ~40% off.
+    Sign convention: Y and Mz are negated from the internal azimuthal
+    integration to land on CCBlade's reported hub loads (CCBlade's y/z
+    component conventions are left-handed relative to the right-handed
+    azimuth frame used here; see _hub_loads_one_azimuth for the exact
+    per-component integrands).  Validated against the reference's
+    IEA15MW_true_calcAero pickles at MACHINE PRECISION (8e-16) on all six
+    channels across the full 30-case (speed x heading) yaw_mode-0
+    envelope (tests/test_rotor.py::test_hub_loads_full_envelope_parity).
     """
     azimuths = jnp.linspace(0.0, 360.0, rot.nSector, endpoint=False)
 
@@ -878,9 +894,10 @@ def calc_cavitation(rot: RotorModel, case: dict, clearance_margin=1.0,
     azimuths = np.atleast_1d(rot.azimuths)
     cav = np.zeros((len(azimuths), len(rot.blade_r)))
     for a, az in enumerate(azimuths):
+        # tilt seen by the BEM is -shaft_tilt (q[2] = -sin(shaft_tilt))
         _, _, W, alpha = _distributed_loads(
             rot, Uhub, Omega_rpm, pitch_deg, float(az),
-            rot.shaft_tilt, 0.0)
+            -rot.shaft_tilt, 0.0)
         cpmin = _ppoly_eval(jnp.asarray(rot.cpmin_bp),
                             jnp.asarray(rot.cpmin_c), alpha)
         # node depths at the zero-offset pose

@@ -88,7 +88,9 @@ def power_thrust_curve(model, speeds=None, ifowt=0):
         Uh = U * rot.speed_gain
         om = float(np.interp(Uh, rot.Uhub_ops, rot.Omega_rpm_ops))
         pi_deg = float(np.interp(Uh, rot.Uhub_ops, rot.pitch_deg_ops))
-        loads = bem_evaluate(rot, Uh, om, pi_deg, tilt=rot.shaft_tilt)
+        # tilt seen by the BEM is -shaft_tilt (q[2] = -sin(shaft_tilt);
+        # same convention calc_aero derives from the pose)
+        loads = bem_evaluate(rot, Uh, om, pi_deg, tilt=-rot.shaft_tilt)
         P[i] = float(loads["P"])
         T[i] = float(loads["T"])
         pitch[i] = pi_deg

@@ -112,3 +112,29 @@ def test_execute_and_wnd_files(tmp_path):
         assert np.all(np.diff(rows[:, 0]) > 0)
     with pytest.raises(ValueError):
         iec.execute("XYZ", 10.0)
+
+
+def test_edc_uses_iec_coefficient():
+    """Pin the DELIBERATE deviation from the reference: IEC Ed.3 eq. 21
+    uses 1 + 0.1*(D/Lambda_1); pyIECWind.py:156 types 0.01."""
+    iec = IECWindExtreme(z_hub=150.0, D=240.0)
+    iec.EDC(10.0)
+    sigma = iec.NTM(10.0)
+    expect = np.degrees(4.0 * np.arctan(
+        sigma / (10.0 * (1.0 + 0.1 * 240.0 / iec.Sigma_1))))
+    assert_allclose(iec.theta_e, expect, rtol=1e-12)
+
+
+def test_ews_wnd_shear_normalized_by_vhub(tmp_path):
+    """The .wnd shear columns are dimensionless (delta-V / V_hub), matching
+    the reference's division by V_hub (pyIECWind.py:302-303); the power-law
+    column carries alpha=0.2 like the reference's transient files."""
+    V_hub = 12.0
+    iec = IECWindExtreme(z_hub=150.0, D=240.0, outdir=str(tmp_path))
+    t, sh = iec.execute("EWS", V_hub)              # dimensional return
+    rows = np.loadtxt(iec.fpath, comments="!")
+    assert_allclose(rows[:, 6], sh / V_hub, atol=5e-5)   # LinVertShear col
+    assert_allclose(rows[:, 5], 0.2, rtol=1e-12)         # PwrLawVertShear
+    t, sh = iec.execute("EWS", V_hub, mode="horizontal")
+    rows = np.loadtxt(iec.fpath, comments="!")
+    assert_allclose(rows[:, 4], sh / V_hub, atol=5e-5)   # HorizShear col

@@ -52,6 +52,30 @@ def test_load_bem_dimensionalization():
     assert np.all(bem.X_BEM == 0)
 
 
+def test_corrupt_wamit_files_raise(tmp_path):
+    """NaN screens on file read-back (reference: raft_fowt.py:708-714) —
+    corrupt coefficients must raise with an actionable message, not
+    propagate silently."""
+    p1 = tmp_path / "bad.1"
+    p1.write_text("10.0 1 1 2.5 nan\n5.0 1 1 1.0 0.5\n")
+    with pytest.raises(ValueError, match="non-finite.*corrupt"):
+        read_wamit1(str(p1))
+    p3 = tmp_path / "bad.3"
+    p3.write_text("10.0 0.0 1 1.0 0.0 inf 0.0\n")
+    with pytest.raises(ValueError, match="non-finite.*corrupt"):
+        read_wamit3(str(p3))
+
+
+def test_corrupt_qtf_12d_raises(tmp_path):
+    from raft_tpu.models.qtf import read_qtf_12d
+
+    p = tmp_path / "bad.12d"
+    p.write_text("10.0 10.0 0.0 0.0 1 1.0 0.0 nan 0.0\n"
+                 "5.0 5.0 0.0 0.0 1 1.0 0.0 2.0 0.0\n")
+    with pytest.raises(ValueError, match="non-finite.*corrupt"):
+        read_qtf_12d(str(p))
+
+
 def test_load_bem_uses_Ainf_above_range(tmp_path):
     """Frequencies above the .1 file's range take the infinite-frequency
     added mass (PER=0 rows) rather than the last finite sample."""

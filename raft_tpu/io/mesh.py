@@ -280,17 +280,20 @@ def lid_disk(builder: _MeshBuilder, cx, cy, R, da_max, z_lid):
                 [z_lid] * 4)
 
 
-def mesh_fowt_members(fowt, dz_max=3.0, da_max=2.0, lid=True) -> PanelMesh:
+def mesh_fowt_members(fowt, dz_max=3.0, da_max=2.0, lid=True,
+                      all_members=False) -> PanelMesh:
     """One combined mesh of all potMod members of a FOWTModel (reference:
     raft_fowt.py:607-614 meshes each potMod member into one shared list).
 
     Member positions are taken at the zero-offset pose (heading patterns
-    already baked into rA0/rB0 at build)."""
+    already baked into rA0/rB0 at build).  ``all_members=True`` meshes
+    every platform member regardless of its potMod flag (for validating
+    the native solver on designs whose run configuration is strip-only)."""
     builder = _MeshBuilder()
     any_pot = False
     piercing = []
-    for m in fowt.members:
-        if not m.potMod:
+    for m in fowt.members[:fowt.nplatmems] if all_members else fowt.members:
+        if not all_members and not m.potMod:
             continue
         if not m.circular:
             raise NotImplementedError(

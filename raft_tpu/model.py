@@ -163,7 +163,30 @@ class Model:
 
         F_env = np.zeros(6)
         if case:
-            tc = fowt_turbine_constants(fowt, case, X0)
+            # statics-time constants use the PREVIOUS case's inflow
+            # heading for the hub->PRP transfer offset (reference
+            # statefulness: setPosition at raft_model.py:527 runs before
+            # calcTurbineConstants refreshes the heading; see
+            # fowt_turbine_constants docstring)
+            stale = state.get("_stored_heading", [0.0] * len(fowt.rotors))
+            tc = fowt_turbine_constants(fowt, case, X0,
+                                        transfer_heading=stale)
+            # the stored heading only advances for rotors whose calcAero
+            # actually ran (operating, aeroServoMod>0, speed>0) — a parked
+            # or zero-wind case leaves the reference rotor's heading (and
+            # hence the next case's stale hub transfer) untouched
+            status = str(get_from_dict(case, "turbine_status", shape=0,
+                                       dtype=str, default="operating"))
+            new_heads = list(stale)
+            for k, rot in enumerate(fowt.rotors):
+                spd = float(get_from_dict(
+                    case, "current_speed" if rot.hubHt < 0 else "wind_speed",
+                    shape=0, default=1.0 if rot.hubHt < 0 else 10.0))
+                if status == "operating" and rot.aeroServoMod > 0 and spd > 0:
+                    new_heads[k] = np.radians(float(get_from_dict(
+                        case, "current_heading" if rot.hubHt < 0
+                        else "wind_heading", shape=0, default=0.0)))
+            state["_stored_heading"] = new_heads
             state["turbine"] = tc
             # cavitation check for operating submerged rotors (reference:
             # raft_fowt.py:826-827 -> raft_rotor.py:639-696)
@@ -277,8 +300,15 @@ class Model:
         db = np.tile(np.array([30, 30, 5, 0.1, 0.1, 0.1]), N)
         tol = np.tile(np.array([0.05, 0.05, 0.05, 5e-3, 5e-3, 5e-3]) * 1e-3, N)
         xf_arg = jnp.zeros((0, 3)) if xf is None else jnp.asarray(xf)
+        # damped Newton with a backtracking line search on |F|^2 — the
+        # same scheme as parallel.variants.statics_newton (one statics
+        # doctrine for the Model path and the sweep path), extended to
+        # 6N DOFs with the array free points re-solved per evaluation.
+        # The reference's plain clip-step loop can oscillate on
+        # pathological designs (raft_model.py:677-767 band-aids).
+        alphas = np.array([1.0, 0.5, 0.25, 0.125, 0.0625])
+        Fj, Kj, xf_arg = eval_FK_j(jnp.asarray(X), xf_arg, F0s, K_hss)
         for it in range(50):
-            Fj, Kj, xf_arg = eval_FK_j(jnp.asarray(X), xf_arg, F0s, K_hss)
             F, K = np.asarray(Fj), np.asarray(Kj).copy()
             # guard zero-stiffness diagonals like the reference (:713-715)
             kmean = np.mean(np.diag(K))
@@ -287,7 +317,32 @@ class Model:
                     K[i, i] = kmean
             dX = np.linalg.solve(K, F)
             dX = np.clip(dX, -db, db)
-            X = X + dX
+            merit0 = float(np.sum(F**2))
+            best = None
+            full_step = None
+            for a in alphas:
+                Fa, Ka, xfa = eval_FK_j(jnp.asarray(X + a * dX), xf_arg,
+                                        F0s, K_hss)
+                if a == 1.0:
+                    full_step = (Fa, Ka, xfa)
+                merit_a = float(np.sum(np.asarray(Fa)**2))
+                if np.isfinite(merit_a) and (best is None
+                                             or merit_a < best[0]):
+                    best = (merit_a, a, Fa, Ka, xfa)
+                if merit_a < merit0:     # first sufficient candidate wins
+                    break
+            if best is not None and best[0] < merit0:
+                _, a, Fj, Kj, xf_arg = best
+                X = X + a * dX
+            else:
+                # no candidate improves the residual: take the full
+                # clipped step once (reference behavior), reusing the
+                # a=1.0 candidate's evaluation
+                X = X + dX
+                Fj, Kj, xf_arg = full_step
+            # convergence on the UNDAMPED Newton step (the reference's
+            # |dX| < tol criterion) — a heavily damped accepted step can
+            # be small while the residual is still far from equilibrium
             if np.all(np.abs(dX) < tol):
                 break
 
@@ -306,7 +361,19 @@ class Model:
             state = self._state[i]
             state["r6"] = X[s]
             state["Xi0"] = X[s] - refs[s]
+            # NOTE: the reference does NOT re-evaluate turbine constants
+            # at the solved pose — the "update values based on offsets"
+            # block (raft_model.py:798-850, incl. the
+            # calcTurbineConstants(ptfm_pitch=Xi0[4]) loop) sits inside a
+            # triple-quoted TODO string and never executes.  Dynamics and
+            # outputs therefore use the statics-time constants: zero
+            # pose, current-case heading, stale-heading hub transfer
+            # (state["turbine"]).
             if fowt.mooring is not None:
+                # analytic/AD stiffness at the equilibrium pose — the
+                # reference's dynamics C_moor is getCoupledStiffnessA from
+                # setPosition (raft_fowt.py:287); only the TENSION
+                # statistics use the FD getCoupledStiffness variant
                 state["C_moor"] = np.asarray(
                     mr.coupled_stiffness(fowt.mooring, X[s]))
                 state["F_moor0"] = np.asarray(mr.body_wrench(fowt.mooring, X[s]))
@@ -511,6 +578,11 @@ class Model:
         B_lin = B_turb + B_gyro[:, :, None] + B_BEM
         C_lin = (jnp.asarray(stat["C_struc"]) + jnp.asarray(state["C_moor"])
                  + jnp.asarray(stat["C_hydro"]))
+        # NOTE: the additional platform yaw stiffness (OC3 crowfoot
+        # surrogate) deliberately does NOT enter the dynamics impedance —
+        # the reference's C_lin is C_struc + C_moor(analytic) + C_hydro
+        # only (raft_model.py:913); yawstiff appears in the eigen solve
+        # (raft_model.py:418) and the statics.
 
         u0 = exc["u"][0]
 
@@ -911,7 +983,9 @@ class Model:
         moor = fowt.mooring
         if moor is not None:
             r6 = state["r6"]
-            J = np.asarray(mr.tension_jacobian(moor, r6))
+            # MoorPy-parity FD Jacobian (see coupled_stiffness_fd): the
+            # reference's Tmoor stats use getCoupledStiffness(tensions=True)
+            J = np.asarray(mr.tension_jacobian_fd(moor, r6))
             T0 = np.asarray(mr.tensions(moor, r6))
             nT = len(T0)
             T_amps = np.einsum("tj,hjw->htw", J, Xi)
@@ -1004,7 +1078,11 @@ class Model:
             speed = float(get_from_dict(case, "current_speed", shape=0, default=1.0)) \
                 if current else float(get_from_dict(case, "wind_speed", shape=0, default=10.0))
             if rot.aeroServoMod > 1 and speed > 0.0:
-                aero = calc_aero(rot, self.w, case, r6=state["r6"], current=current)
+                # the reference's control transfer function comes from the
+                # STATICS-TIME calcAero (zero pose) — the equilibrium
+                # update loop is dead code (see solveStatics note)
+                X0r = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+                aero = calc_aero(rot, self.w, case, r6=X0r, current=current)
                 C = np.asarray(aero["C"])
                 V_w = np.asarray(aero["V_w"])
                 kp_beta = -np.interp(speed, rot.Uhub_ops, rot.kp_0)
@@ -1197,7 +1275,12 @@ class Model:
 
 
 def run_raft(design_or_path, plots=0, ballast=False, station_plot=[]):
-    """Convenience entry point (reference: raft_model.py:2024-2061)."""
+    """Convenience entry point (reference: raft_model.py:2024-2061).
+
+    Farm designs (nFOWT > 1) take the reference's runRAFTFarm path
+    (raft_model.py:2065-2095): analyzeUnloaded and calcOutputs are
+    skipped — both are single-FOWT-only in the reference too — and the
+    case analysis runs directly."""
     import yaml
 
     if isinstance(design_or_path, str):
@@ -1206,9 +1289,12 @@ def run_raft(design_or_path, plots=0, ballast=False, station_plot=[]):
     else:
         design = design_or_path
     model = Model(design)
-    model.analyzeUnloaded(ballast=1 if ballast else 0)
-    model.analyzeCases(display=1 if plots else 0)
-    model.calcOutputs()
+    if model.nFOWT > 1:
+        model.analyzeCases(display=1 if plots else 0)
+    else:
+        model.analyzeUnloaded(ballast=1 if ballast else 0)
+        model.analyzeCases(display=1 if plots else 0)
+        model.calcOutputs()
     if plots:
         model.plot(station_plot=station_plot)
         model.plotResponses()

@@ -933,9 +933,20 @@ def fowt_current_loads(fowt: FOWTModel, pose, speed, heading_deg):
 # turbine constants
 # --------------------------------------------------------------------------
 
-def fowt_turbine_constants(fowt: FOWTModel, case: dict, r6):
+def fowt_turbine_constants(fowt: FOWTModel, case: dict, r6,
+                           transfer_heading=None):
     """Aero-servo matrices/forces about the PRP + gyroscopic damping
-    (reference: raft_fowt.py:773-845)."""
+    (reference: raft_fowt.py:773-845).
+
+    ``transfer_heading`` (rad, per-rotor list or scalar) replicates a
+    reference statefulness quirk: the hub->PRP transfer offset r_hub_rel
+    is only refreshed by Rotor.setPosition, NOT by calcAero's setYaw
+    (raft_rotor.py:376-460 vs :795-800), so the statics-time constants of
+    case i transfer moments with the hub position of the PREVIOUS case's
+    inflow heading (zero pose).  Pass the stale heading here to reproduce
+    that; None uses the current case heading (the post-statics
+    equilibrium update behaves that way because setPosition has run by
+    then)."""
     nw = fowt.nw
     nrot = fowt.nrotors
     A_aero = jnp.zeros((6, 6, nw, nrot))
@@ -957,7 +968,18 @@ def fowt_turbine_constants(fowt: FOWTModel, case: dict, r6):
         if rot.aeroServoMod > 0 and speed > 0.0:
             out = calc_aero(rot, fowt.w, case, r6=r6, current=current)
             pose_r = out["pose"]
-            r_hub_rel = pose_r["r_hub"] - jnp.asarray(r6)[:3]
+            if transfer_heading is None:
+                r_hub_rel = pose_r["r_hub"] - jnp.asarray(r6)[:3]
+            else:
+                th = (transfer_heading[ir]
+                      if np.ndim(transfer_heading) else transfer_heading)
+                pose_t = rotor_pose(
+                    rot, r6, inflow_heading=float(th),
+                    turbine_heading=np.radians(float(get_from_dict(
+                        case, "turbine_heading", shape=0, default=0.0))),
+                    yaw_command=np.radians(float(get_from_dict(
+                        case, "yaw_misalign", shape=0, default=0.0))))
+                r_hub_rel = pose_t["r_hub"] - jnp.asarray(r6)[:3]
             a = jnp.moveaxis(out["a"], -1, 0)   # (nw,6,6)
             b = jnp.moveaxis(out["b"], -1, 0)
             A_aero = A_aero.at[:, :, :, ir].set(

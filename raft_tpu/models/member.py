@@ -274,6 +274,26 @@ def _resolve_caps(geom: MemberGeometry, mi: dict, st_raw: np.ndarray) -> None:
             dAi = dA * _safe_ratio(dBi, dB)
         elif (stations[0] < L < stations[0] + h) or (stations[-1] - h < L < stations[-1]):
             raise ValueError(f"Member {geom.name}: cap at {L} overlaps member end")
+        elif i < ncap - 1 and cap_L[i] == cap_L[i + 1]:
+            # step discontinuity (duplicated cap station): an end cap
+            # going DOWN from the lower segment.  NOTE the reference
+            # indexes the per-station inner-diameter array by the CAP
+            # index here (raft_member.py:584 `dB = d[i]`) — valid only
+            # when caps align 1:1 with stations; replicated verbatim.
+            kind = _CAP_MIDDLE        # positioned like a middle bulkhead
+            dA = interp_d(L - h)
+            dB = d_in[i]
+            dBi = hole
+            dAi = dA * _safe_ratio(dBi, dB)
+        elif i > 0 and cap_L[i] == cap_L[i - 1]:
+            # step discontinuity: the matching end cap going UP from the
+            # upper segment (reference raft_member.py:588-592, same
+            # cap-index quirk)
+            kind = _CAP_MIDDLE
+            dA = d_in[i]
+            dB = interp_d(L + h)
+            dAi = hole
+            dBi = dB * _safe_ratio(dAi, dA)
         else:
             kind = _CAP_MIDDLE
             dA = interp_d(L - h / 2)
@@ -408,15 +428,23 @@ def member_inertia(geom: MemberGeometry, pose, rPRP=jnp.zeros(3),
     Iyy = (IyyO - IyyI) + IyyF - mass_s * hc**2
     Izz = (IzzO - IzzI) + IzzF
 
-    # zero out invalid (zero-length) sections
+    # zero out invalid (zero-length) sections — EXCEPT the local MoI:
+    # the reference's l==0 branch (raft_member.py:420-426) zeroes
+    # mass/center but not the loop-carried Ixx/Iyy/Izz, so the PREVIOUS
+    # segment's local inertia tensor is added a second time with zero
+    # mass and center=0, i.e. untranslated about the PRP
+    # (raft_member.py:539-548).  Replicated verbatim for parity: on
+    # OC4semi's stepped offset columns this phantom term is ~1.6e7 (Ixx)
+    # / 3.0e7 (Izz) kg-m^2 per column and is visible in the example's
+    # regression data.
     mass_s = jnp.where(valid, mass_s, 0.0)
     m_shell = jnp.where(valid, m_shell, 0.0)
     m_fill = jnp.where(valid, m_fill, 0.0)
     v_fill = jnp.where(valid, v_fill, 0.0)
     pfill = jnp.where(valid, rho_fill, 0.0)
-    Ixx = jnp.where(valid, Ixx, 0.0)
-    Iyy = jnp.where(valid, Iyy, 0.0)
-    Izz = jnp.where(valid, Izz, 0.0)
+    Ixx = jnp.where(valid, Ixx, jnp.concatenate([jnp.zeros(1), Ixx[:-1]]))
+    Iyy = jnp.where(valid, Iyy, jnp.concatenate([jnp.zeros(1), Iyy[:-1]]))
+    Izz = jnp.where(valid, Izz, jnp.concatenate([jnp.zeros(1), Izz[:-1]]))
 
     center = pose["rA"] + pose["q"][None, :] * (st[:-1] + hc)[:, None] - rPRP
     center = jnp.where(valid[:, None], center, 0.0)

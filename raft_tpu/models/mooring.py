@@ -391,6 +391,65 @@ def current_wrench(sys_, r6, U, rho: float = _RHO, xf=None):
     return jnp.sum(translate_force_3to6(0.5 * F_line, rF - r6[:3]), axis=0)
 
 
+def coupled_stiffness_fd(sys_, r6, dx=0.1, dth=0.1, tensions_too=False):
+    """MoorPy-parity coupled stiffness (and optionally tension Jacobian)
+    by CENTRAL finite differences with MoorPy's default perturbations
+    (System.getCoupledStiffness: dx=0.1 m, dth=0.1 rad), free DOFs
+    re-equilibrated at every perturbed pose.
+
+    The reference uses this FD variant ONLY for the tension statistics
+    (raft_fowt.py:1881 getCoupledStiffness(tensions=True) -> J_moor);
+    its statics Newton AND the dynamics/eigen C_moor use the analytic
+    getCoupledStiffnessA (raft_fowt.py:287 via setPosition — the
+    model-level FD block at raft_model.py:798-850 is dead code inside a
+    TODO string).  So: keep `coupled_stiffness` (exact AD == analytic)
+    for statics/dynamics/eigen and use `tension_jacobian_fd` for Tmoor
+    stats.  The FD truncation error (notably the 0.1 rad rotational
+    step) is a few percent on rotation-coupled tension sensitivities at
+    loaded offsets, so the exact-AD Jacobian does NOT reproduce the
+    reference's Tmoor_std."""
+    r6 = np.asarray(r6, float)
+    dX = np.array([dx, dx, dx, dth, dth, dth])
+    K = np.zeros((6, 6))
+    J = None
+    for i in range(6):
+        Xp = r6.copy(); Xp[i] += dX[i]
+        Xm = r6.copy(); Xm[i] -= dX[i]
+        # fresh free-point solves at each perturbed pose = MoorPy's
+        # internal re-equilibration of free DOFs
+        Fp = np.asarray(body_wrench(sys_, Xp))
+        Fm = np.asarray(body_wrench(sys_, Xm))
+        K[:, i] = -0.5 * (Fp - Fm) / dX[i]
+        if tensions_too:
+            Tp = np.asarray(tensions(sys_, Xp))
+            Tm = np.asarray(tensions(sys_, Xm))
+            if J is None:
+                J = np.zeros((len(Tp), 6))
+            J[:, i] = 0.5 * (Tp - Tm) / dX[i]
+    if tensions_too:
+        return K, J
+    return K
+
+
+def tension_jacobian_fd(sys_, r6, dx=0.1, dth=0.1):
+    """MoorPy-parity FD tension Jacobian (getCoupledStiffness(...,
+    tensions=True) J_moor) — see :func:`coupled_stiffness_fd`.  Computes
+    only the tensions (no wrench evaluations), with one free-point solve
+    shared per perturbed pose."""
+    r6 = np.asarray(r6, float)
+    dX = np.array([dx, dx, dx, dth, dth, dth])
+    J = None
+    for i in range(6):
+        Xp = r6.copy(); Xp[i] += dX[i]
+        Xm = r6.copy(); Xm[i] -= dX[i]
+        Tp = np.asarray(tensions(sys_, Xp, xf=free_points(sys_, Xp)))
+        Tm = np.asarray(tensions(sys_, Xm, xf=free_points(sys_, Xm)))
+        if J is None:
+            J = np.zeros((len(Tp), 6))
+        J[:, i] = 0.5 * (Tp - Tm) / dX[i]
+    return J
+
+
 def tension_jacobian(sys_, r6, xf=None):
     """d(tensions)/d(pose): (2*nl, 6), the J_moor of the reference's
     getCoupledStiffness(..., tensions=True)."""

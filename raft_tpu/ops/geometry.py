@@ -45,9 +45,16 @@ def frustum_moi_circ(dA, dB, H, p):
     raft/raft_member.py:321-339)."""
     dA, dB, H = jnp.asarray(dA, float), jnp.asarray(dB, float), jnp.asarray(H, float)
     rA, rB = 0.5 * dA, 0.5 * dB
+    # cylinder detection must be a RELATIVE tolerance, not ==: derived cap
+    # diameters like dB*(dAi/dA) can differ from dAi by 1 ulp, and the
+    # tapered closed form divides (rB^5 - rA^5) by (rB - rA) — at
+    # ulp-level taper that quotient is catastrophic-cancellation noise
+    # (the reference's exact dA==dB check has this bug,
+    # raft_member.py:327-336; its OC4semi ring-cap MoI carries ~15% fp
+    # noise as a result)
+    cyl = jnp.abs(rB - rA) <= 1e-9 * jnp.maximum(jnp.abs(rA), jnp.abs(rB))
     m = jnp.where(H > 0, (rB - rA) / jnp.where(H > 0, H, 1.0), 0.0)
-    # uniform-cylinder limit (m==0) vs tapered closed forms; m guarded so the
-    # dead branch stays finite (and differentiable) under jnp.where
+    m = jnp.where(cyl, 0.0, m)
     m_safe = jnp.where(m == 0, 1.0, m)
     Izz_t = (jnp.pi * p / (10.0 * m_safe)) * (rB**5 - rA**5)
     Ixx_t = jnp.pi * p * (

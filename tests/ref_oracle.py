@@ -1,0 +1,99 @@
+"""Import machinery for running ACTUAL reference classes as test oracles.
+
+The reference package (`/root/reference/raft`) imports moorpy and ccblade,
+neither of which is installed here.  For oracle use we only need the parts
+that DON'T touch those dependencies (FOWT hydro/QTF, Rotor polar
+preprocessing), so this module registers minimal stand-ins in sys.modules
+and exposes the reference package without executing raft/__init__ (which
+would pull in raft_model -> moorpy at import time).
+
+The moorpy.helpers.transformPosition stand-in implements the REAL MoorPy
+semantics (rotate by the 3 Euler angles, then translate) — an identity
+stub here would silently freeze the reference members at their zero pose
+and invalidate any pose-dependent comparison.
+"""
+import sys
+import types
+
+import numpy as np
+
+REF_DIR = "/root/reference/raft"
+
+
+def _transform_position(r, x):
+    from math import sin, cos
+
+    x1, x2, x3 = x[3], x[4], x[5]
+    s1, c1 = sin(x1), cos(x1)
+    s2, c2 = sin(x2), cos(x2)
+    s3, c3 = sin(x3), cos(x3)
+    R = np.array([
+        [c2 * c3, c3 * s1 * s2 - c1 * s3, s1 * s3 + c1 * c3 * s2],
+        [c2 * s3, c1 * c3 + s1 * s2 * s3, c1 * s2 * s3 - c3 * s1],
+        [-s2, c2 * s1, c1 * c2]])
+    return np.asarray(x[:3]) + R @ np.asarray(r)
+
+
+def install_reference_stubs():
+    """Register moorpy/ccblade stand-ins + the raft package path.  Safe to
+    call repeatedly; never overwrites a real installed package."""
+    if "moorpy" not in sys.modules:
+        mp = types.ModuleType("moorpy")
+        mp.__path__ = []
+        mph = types.ModuleType("moorpy.helpers")
+        mph.transformPosition = _transform_position
+        mp.helpers = mph
+        mp.System = type("System", (), {})
+        sys.modules["moorpy"] = mp
+        sys.modules["moorpy.helpers"] = mph
+    if "ccblade" not in sys.modules:
+        ccb = types.ModuleType("ccblade")
+        ccb.__path__ = []
+        ccm = types.ModuleType("ccblade.ccblade")
+        ccm.CCAirfoil = type("CCAirfoil", (), {
+            "__init__": lambda self, *a, **k: None})
+        ccm.CCBlade = type("CCBlade", (), {
+            "__init__": lambda self, *a, **k: None})
+        sys.modules["ccblade"] = ccb
+        sys.modules["ccblade.ccblade"] = ccm
+    if "raft" not in sys.modules:
+        pkg = types.ModuleType("raft")
+        pkg.__path__ = [REF_DIR]
+        sys.modules["raft"] = pkg
+    import matplotlib
+    matplotlib.use("Agg")
+
+
+def build_reference_fowt_from_yaml(yaml_path, settings_overrides=None,
+                                   platform_overrides=None):
+    """Instantiate the reference FOWT (mooring stripped) from a design
+    yaml, replicating the reference Model's design prep
+    (raft_model.py:42-68).  Returns (fowt, w, raw_design_dict)."""
+    import yaml
+
+    install_reference_stubs()
+    from raft.raft_fowt import FOWT
+
+    d = yaml.safe_load(open(yaml_path))
+    if settings_overrides:
+        d["settings"].update(settings_overrides)
+    if platform_overrides:
+        d["platform"].update(platform_overrides)
+    design = dict(d)
+    design["mooring"] = None
+    t = design["turbine"]
+    t.setdefault("nrotors", 1)
+    if isinstance(t.get("tower"), dict):
+        t["tower"] = [t["tower"]] * t["nrotors"]
+    site = design["site"]
+    t["rho_air"] = site.get("rho_air", 1.225)
+    t["mu_air"] = site.get("mu_air", 1.81e-5)
+    t["shearExp_air"] = site.get("shearExp_air", site.get("shearExp", 0.12))
+    t["rho_water"] = site.get("rho_water", 1025.0)
+    t["mu_water"] = site.get("mu_water", 1.0e-3)
+    t["shearExp_water"] = site.get("shearExp_water", 0.12)
+    s = design["settings"]
+    w = np.arange(s["min_freq"], s["max_freq"] + 0.5 * s["min_freq"],
+                  s["min_freq"]) * 2 * np.pi
+    fowt = FOWT(design, w, None, depth=site["water_depth"])
+    return fowt, w, d

@@ -20,7 +20,8 @@ from numpy.testing import assert_allclose
 
 from raft_tpu.model import Model
 
-pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+pytestmark = [pytest.mark.filterwarnings("ignore::UserWarning"),
+              pytest.mark.slow]
 
 
 @pytest.fixture(scope="module")
@@ -136,3 +137,26 @@ def test_farm_array_mooring_tensions(farm_results):
         / ref["Tmoor_avg"][:3].max() < 2e-3
     assert _rel_to_peak(am["Tmoor_PSD"], ref["Tmoor_PSD"]) < 1e-1
     assert _rel_to_peak(am["Tmoor_std"], ref["Tmoor_std"]) < 1e-1
+
+
+def test_run_raft_farm_entry(reference_test_data):
+    """run_raft on a farm yaml takes the runRAFTFarm path (reference:
+    raft_model.py:2065-2095) — no analyzeUnloaded/calcOutputs, straight to
+    analyzeCases — instead of raising in analyzeUnloaded."""
+    from raft_tpu.model import run_raft
+
+    path = os.path.join(reference_test_data, "VolturnUS-S_farm.yaml")
+    if not os.path.isfile(path):
+        pytest.skip("farm yaml not available")
+    with open(path) as f:
+        design = yaml.safe_load(f)
+    design["array_mooring"]["file"] = os.path.join(
+        reference_test_data, "shared_mooring_volturnus.dat")
+    # one coarse case for speed
+    design["settings"]["min_freq"] = 0.005
+    design["settings"]["max_freq"] = 0.12
+    design["cases"]["data"] = design["cases"]["data"][:1]
+    m = run_raft(design)
+    assert m.nFOWT > 1
+    met = m.results["case_metrics"][0]
+    assert np.all(np.isfinite(np.squeeze(met[0]["surge_std"])))

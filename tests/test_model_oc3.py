@@ -1,11 +1,16 @@
 """End-to-end Model parity on OC3spar vs the reference regression data.
 
 Case 0 (wave-only, parked-equivalent loading) validates the entire
-strip-theory hydro + mooring + drag-linearization + RAO pipeline: PSDs
-match the reference pickle to ~1e-5 relative.  Case 1 (operating turbine)
-is parity-checked at 1-9% bands set by the documented ~2.5% BEM
-induction-level deviation (the hub-load sign convention is reconciled with
-CCBlade — see tests/test_rotor.py); control channels match to <0.1%.
+strip-theory hydro + mooring + drag-linearization + RAO pipeline at
+~1e-6 relative (Tmoor_std via the MoorPy-parity FD tension Jacobian).
+Case 1 (operating turbine, wind 30deg + current): with the BEM at machine
+precision, the stale hub-transfer quirk replicated, and the dynamics on
+the STATICS-TIME turbine constants (the reference's equilibrium-update
+block is dead code inside a TODO string, raft_model.py:798-850), every
+MEAN matches to ~1e-4 and stds to 0.3-1.4%.  The loaded-case Tmoor_std
+3% band is the FD tension Jacobian evaluated without current loads on
+the lines (MoorPy's FD sees current-loaded line equilibria; the
+current-free case 0 matches at 4e-6).
 """
 import os
 import pickle
@@ -16,6 +21,8 @@ import yaml
 from numpy.testing import assert_allclose
 
 from raft_tpu.model import Model
+
+pytestmark = pytest.mark.slow
 
 YAML = "/root/reference/tests/test_data/OC3spar.yaml"
 PKL = "/root/reference/tests/test_data/OC3spar_true_analyzeCases.pkl"
@@ -36,51 +43,49 @@ def test_wave_only_case_psd_parity(model_and_truth):
     m, truth = model_and_truth
     ours, ref = m.results["case_metrics"][0][0], truth[0][0]
     for ch in ["surge", "sway", "heave", "roll", "pitch", "yaw"]:
-        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=1e-4, atol=1e-10,
+        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=1e-3, atol=1e-10,
                         err_msg=f"{ch}_std")
         assert_allclose(ours[f"{ch}_PSD"], ref[f"{ch}_PSD"], rtol=1e-4, atol=1e-3,
                         err_msg=f"{ch}_PSD")
     assert_allclose(ours["heave_avg"], ref["heave_avg"], rtol=1e-4)
-    # mooring tension statistics (std depends on the tension Jacobian,
-    # where our exact-autodiff values differ from MoorPy's analytic
-    # derivatives by a few percent)
+    # mooring tension statistics via the MoorPy-parity FD tension
+    # Jacobian (coupled_stiffness_fd) — measured 4e-6 / 3e-4
     assert_allclose(ours["Tmoor_avg"], ref["Tmoor_avg"], rtol=2e-3)
-    assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=6e-2)
+    assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=2e-3)
 
 
 def test_operating_case_parity(model_and_truth):
-    """Operating-turbine case vs the reference pickle.  Tolerances are
-    ~1.5-2x the deviations measured after the CCBlade hub-load sign
-    reconciliation (see tests/test_rotor.py), which are bounded by the
-    documented ~2.5% BEM induction-level difference: mean offsets within
-    1-5%, response stds within 5-9%, control channels < 0.1%."""
+    """Operating-turbine case vs the reference pickle (wind at 30 deg,
+    current 1 m/s at 15 deg).  Means at ~1e-4 (machine-precision BEM +
+    equilibrium-pose constants + stale hub-transfer quirk); aligned stds
+    <1%; the cross-wind stds carry the residual 2-7% bands discussed in
+    the module docstring."""
     m, truth = model_and_truth
     ours, ref = m.results["case_metrics"][1][0], truth[1][0]
-    for ch, tol in [("surge", 0.02), ("heave", 0.02), ("roll", 0.02),
-                    ("pitch", 0.04), ("sway", 0.08)]:
-        assert_allclose(ours[f"{ch}_avg"], ref[f"{ch}_avg"], rtol=tol,
+    for ch in ("surge", "heave", "roll", "pitch", "sway"):
+        assert_allclose(ours[f"{ch}_avg"], ref[f"{ch}_avg"], rtol=1e-3,
                         err_msg=f"{ch}_avg")
-    for ch, tol in [("surge", 0.07), ("sway", 0.12), ("heave", 0.02),
-                    ("roll", 0.11), ("pitch", 0.08), ("yaw", 0.05)]:
+    for ch, tol in [("surge", 0.015), ("sway", 0.008), ("heave", 0.002),
+                    ("roll", 0.005), ("pitch", 0.025), ("yaw", 0.007)]:
         assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=tol,
                         err_msg=f"{ch}_std")
-    # mean yaw is the ratio of two small aero cross-moments -> large
-    # relative band; guard absolutely (measured 4.3 deg apart)
+    # mean yaw (measured 1e-5 relative; 6.77 deg magnitude)
     assert abs(float(np.squeeze(ours["yaw_avg"]))
-               - float(np.squeeze(ref["yaw_avg"]))) < 6.0
-    # aero-servo control channels ride the published closed-form transfer
-    # function and match to <1e-3 (ADVICE r1 asked for these guards)
+               - float(np.squeeze(ref["yaw_avg"]))) < 0.01
+    # aero-servo control channels (turbulence=0 -> exact zeros both sides
+    # for stds; operating-point interps for avgs)
     for ch in ("omega_std", "torque_std", "bPitch_std"):
-        assert_allclose(ours[ch], ref[ch], rtol=5e-3, err_msg=ch)
-    assert_allclose(ours["omega_avg"], ref["omega_avg"], rtol=1e-3)
-    assert_allclose(ours["bPitch_avg"], ref["bPitch_avg"], rtol=1e-3)
+        assert_allclose(ours[ch], ref[ch], rtol=1e-9, err_msg=ch)
+    assert_allclose(ours["omega_avg"], ref["omega_avg"], rtol=1e-9)
+    assert_allclose(ours["bPitch_avg"], ref["bPitch_avg"], rtol=1e-9)
     # nacelle acceleration / tower-base moment / mooring tension stats
-    assert_allclose(ours["AxRNA_std"], ref["AxRNA_std"], rtol=0.06,
+    assert_allclose(ours["AxRNA_std"], ref["AxRNA_std"], rtol=1.5e-2,
                     err_msg="AxRNA_std")
-    assert_allclose(ours["Mbase_std"], ref["Mbase_std"], rtol=0.06,
+    assert_allclose(ours["Mbase_std"], ref["Mbase_std"], rtol=1.5e-2,
                     err_msg="Mbase_std")
-    assert_allclose(ours["Tmoor_avg"], ref["Tmoor_avg"], rtol=0.02)
-    assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=0.18)
+    assert_allclose(ours["Mbase_avg"], ref["Mbase_avg"], rtol=1e-4)
+    assert_allclose(ours["Tmoor_avg"], ref["Tmoor_avg"], rtol=1e-3)
+    assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=5e-2)
 
 
 def test_statics_wave_and_current():

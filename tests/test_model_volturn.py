@@ -17,6 +17,8 @@ from numpy.testing import assert_allclose
 
 from raft_tpu.model import Model
 
+pytestmark = pytest.mark.slow
+
 YAML = "/root/reference/tests/test_data/VolturnUS-S.yaml"
 PKL = "/root/reference/tests/test_data/VolturnUS-S_true_analyzeCases.pkl"
 

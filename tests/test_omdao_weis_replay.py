@@ -13,6 +13,8 @@ import os
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 import yaml
 
 from raft_tpu.omdao import RAFT_OMDAO_Standalone

@@ -59,6 +59,13 @@ def _aero_constants(design, base):
     does not vary across the VolturnUS-S platform sweep, so one
     evaluation serves every variant).  Returns mean aero force F_env (6,),
     A_turb (6,6,nw) and B_turb (6,6,nw) incl. gyroscopic damping."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        # one-time host-side build work: the BEM induction solve runs
+        # eager jnp ops the axon TPU tunnel does not implement — compute
+        # in a CPU subprocess and ship the small constant tensors back
+        return _aero_constants_subprocess(design)
     from raft_tpu.models.fowt import fowt_turbine_constants
 
     case = dict(zip(design["cases"]["keys"], design["cases"]["data"][0]))
@@ -68,6 +75,51 @@ def _aero_constants(design, base):
     B_turb = (np.sum(np.asarray(tc["B_aero"]), axis=3)
               + np.sum(np.asarray(tc["B_gyro"]), axis=2)[:, :, None])
     return F_env, A_turb, B_turb
+
+
+def _run_cpu_subprocess(body_lines, out_path, x64):
+    """Run a snippet in a fresh CPU-only jax process (the axon tunnel is
+    single-claim and lacks some eager ops; x64 must be configured before
+    jax initializes) and return the .npz it writes."""
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = "\n".join([
+        "import os, numpy as np",
+        f"import sys; sys.path.insert(0, {here!r})",
+        "import jax; jax.config.update('jax_platforms', 'cpu')",
+        "import bench",
+    ] + body_lines)
+    env = dict(os.environ, RAFT_TPU_X64="1" if x64 else "0",
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=1800)
+    if r.returncode != 0:
+        raise RuntimeError("cpu subprocess failed:\n" + r.stderr[-500:])
+    return np.load(out_path)
+
+
+def _aero_constants_subprocess(design):
+    import json as _json
+    import tempfile
+
+    # the child rebuilds the module-default design (NW rides the
+    # RAFT_BENCH_NW env var it inherits) — guard against a caller passing
+    # anything else, which would silently get constants for the wrong model
+    if _json.dumps(design, sort_keys=True, default=str) != _json.dumps(
+            _design(), sort_keys=True, default=str):
+        raise ValueError("_aero_constants on a non-CPU backend only "
+                         "supports the module-default design")
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "aero.npz")
+        d = _run_cpu_subprocess([
+            "design = bench._design()",
+            "base = bench._base_fowt(design)",
+            "F_env, A_turb, B_turb = bench._aero_constants(design, base)",
+            f"np.savez({out!r}, F_env=F_env, A_turb=A_turb, B_turb=B_turb)",
+        ], out, x64=False)
+        return d["F_env"], d["A_turb"], d["B_turb"]
 
 
 def _thetas(design, base, nv, seed=7):
@@ -123,7 +175,9 @@ def main():
         "value": round(variants_per_hour, 1),
         "unit": "variants/h/chip",
         "vs_baseline": round(variants_per_hour / baseline_vph, 2),
-        "max_rel_dev_f32_vs_f64": acc,
+        "max_rel_dev_f32_vs_f64": (acc["max"]
+                                   if isinstance(acc, dict) else acc),
+        "rel_dev_f32_vs_f64": acc,
     }
     print(json.dumps(result))
 
@@ -133,8 +187,6 @@ def _accuracy_gate(thetas, batched):
     16-variant batch (BASELINE's accuracy target is meaningless without a
     measured on-hardware number).  The f64 reference runs in a
     subprocess because x64 must be configured before jax initializes."""
-    import subprocess
-    import sys
     import tempfile
 
     sub = {k: np.asarray(v)[:16] for k, v in thetas.items()}
@@ -142,38 +194,47 @@ def _accuracy_gate(thetas, batched):
     std32 = np.asarray(out32["std"], dtype=np.float64)
     with tempfile.TemporaryDirectory() as td:
         tin = os.path.join(td, "thetas.npz")
-        tout = os.path.join(td, "std64.npy")
+        tout = os.path.join(td, "std64.npz")
         np.savez(tin, **sub)
-        env = dict(os.environ, RAFT_TPU_X64="1", JAX_PLATFORMS="cpu",
-                   PALLAS_AXON_POOL_IPS="")
-        code = (
-            "import os,sys,numpy as np\n"
-            f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
-            "import jax; jax.config.update('jax_platforms','cpu')\n"
-            "import bench\n"
-            "design = bench._design()\n"
-            "base = bench._base_fowt(design)\n"
-            "F_env, A_turb, B_turb = bench._aero_constants(design, base)\n"
-            "from raft_tpu.parallel.variants import make_variant_solver\n"
-            "solver = make_variant_solver(base, Hs=6.0, Tp=12.0, ballast=True,\n"
-            "    F_env=F_env, A_turb=A_turb, B_turb=B_turb,\n"
-            "    nIter=bench.NITER, tol=-1.0, newton_iters=10)\n"
-            f"d = dict(np.load({tin!r}))\n"
-            "import jax as j\n"
-            "out = j.jit(solver.batched)(d)\n"
-            f"np.save({tout!r}, np.asarray(out['std'], dtype=np.float64))\n"
-        )
-        r = subprocess.run([sys.executable, "-c", code], env=env,
-                           capture_output=True, text=True, timeout=1800)
-        if r.returncode != 0:
-            return f"f64-reference failed: {r.stderr[-300:]}"
-        std64 = np.load(tout)
-    # channel-wise scale: sway/roll/yaw are ~0 for head-sea cases, so a
-    # pointwise relative deviation there is noise/noise — normalize each
-    # channel by its own batch peak instead
-    scale = np.maximum(np.abs(std64).max(axis=0, keepdims=True) * 1e-3,
-                       np.abs(std64))
-    return float(np.max(np.abs(std32 - std64) / scale))
+        try:
+            d = _run_cpu_subprocess([
+                "design = bench._design()",
+                "base = bench._base_fowt(design)",
+                "F_env, A_turb, B_turb = bench._aero_constants(design, base)",
+                "from raft_tpu.parallel.variants import make_variant_solver",
+                "solver = make_variant_solver(base, Hs=6.0, Tp=12.0,"
+                " ballast=True, F_env=F_env, A_turb=A_turb, B_turb=B_turb,"
+                " nIter=bench.NITER, tol=-1.0, newton_iters=10)",
+                f"d = dict(np.load({tin!r}))",
+                "out = jax.jit(solver.batched)(d)",
+                f"np.savez({tout!r}, std=np.asarray(out['std'],"
+                " dtype=np.float64))",
+            ], tout, x64=True)
+        except RuntimeError as e:
+            return f"f64-reference failed: {e}"
+        std64 = d["std"]
+    # unit-safe masking: translations (m) and rotations (rad) are scaled
+    # within their own unit group, each channel against its own batch
+    # peak — a channel whose peak is itself fp noise (exact-zero response
+    # by symmetry) is excluded entirely, but a genuinely responding
+    # small-magnitude channel is kept
+    dev = np.abs(std32 - std64) / np.maximum(np.abs(std64), 1e-12)
+    mask = np.zeros_like(dev, dtype=bool)
+    for grp in (slice(0, 3), slice(3, 6)):
+        gscale = np.abs(std64[:, grp]).max()
+        for j in range(grp.start, grp.stop):
+            peak = np.abs(std64[:, j]).max()
+            if peak > 1e-4 * gscale:
+                mask[:, j] = np.abs(std64[:, j]) > 1e-3 * peak
+    # the max sits on lightly-damped resonance bins (pitch), where f32
+    # natural-frequency rounding moves the sharp peak between frequency
+    # bins; the median and the design-driving surge channel tell the
+    # usable-accuracy story
+    return {
+        "max": float(dev[mask].max()),
+        "median": float(np.median(dev[mask])),
+        "surge_max": float(dev[:, 0].max()),
+    }
 
 
 def _serial_numpy_baseline(fowt, A_turb=None, B_turb=None):

@@ -81,3 +81,27 @@ def test_frustum_moi_rect_prism():
     assert_allclose(float(Ixx), y2 + z2, rtol=1e-10)
     assert_allclose(float(Iyy), x2 + z2, rtol=1e-10)
     assert_allclose(float(Izz), x2 + y2, rtol=1e-10)
+
+
+def test_frustum_moi_ulp_taper_is_cylinder():
+    """Derived cap diameters like dB*(dAi/dA) can carry a 1-ulp taper; the
+    tapered closed form divides (rB^5-rA^5)/(rB-rA) and would return
+    catastrophic-cancellation noise (the reference's exact dA==dB check
+    has this failure, raft_member.py:327-336).  The relative-tolerance
+    cylinder branch must give the exact cylinder values instead."""
+    from raft_tpu.ops.geometry import frustum_moi_circ
+
+    d = 12.0
+    d_ulp = 23.88 * (12.0 / 23.88)      # 12 +/- 1 ulp
+    h, rho = 0.06, 7850.0
+    Ix0, Iz0 = (np.asarray(a) for a in frustum_moi_circ(
+        np.array([d]), np.array([d]), np.array([h]), rho))
+    Ix1, Iz1 = (np.asarray(a) for a in frustum_moi_circ(
+        np.array([d]), np.array([d_ulp]), np.array([h]), rho))
+    assert np.allclose(Ix1, Ix0, rtol=1e-12)
+    assert np.allclose(Iz1, Iz0, rtol=1e-12)
+    # exact cylinder references: m(r^2/4 + h^2/3) about the end, m r^2/2
+    r = d / 2
+    m = rho * np.pi * r**2 * h
+    assert np.allclose(float(Iz0[0]), 0.5 * m * r**2, rtol=1e-12)
+    assert np.allclose(float(Ix0[0]), m * (r**2 / 4 + h**2 / 3), rtol=1e-12)

@@ -61,15 +61,15 @@ def _load():
     lib = ct.CDLL(_LIB_PATH)
     lib.raft_bem_load_tables.argtypes = [ct.c_char_p]
     lib.raft_bem_load_tables.restype = ct.c_int
-    lib.raft_bem_solve.argtypes = [
+    lib.raft_bem_solve2.argtypes = [
         ct.POINTER(ct.c_double), ct.c_int,          # verts
         ct.POINTER(ct.c_int32), ct.c_int, ct.c_int,  # panels, nbody
         ct.POINTER(ct.c_double), ct.c_int,          # omegas
         ct.POINTER(ct.c_double), ct.c_int,          # betas
-        ct.c_double, ct.c_double,                   # rho, g
+        ct.c_double, ct.c_double, ct.c_double,      # rho, g, depth
         ct.POINTER(ct.c_double), ct.POINTER(ct.c_double),
         ct.POINTER(ct.c_double), ct.POINTER(ct.c_double)]
-    lib.raft_bem_solve.restype = ct.c_int
+    lib.raft_bem_solve2.restype = ct.c_int
     if lib.raft_bem_load_tables(_TABLE_PATH.encode()) != 0:
         raise RuntimeError(f"failed to load Green-function tables from "
                            f"{_TABLE_PATH}")
@@ -78,11 +78,14 @@ def _load():
 
 
 def solve_radiation_diffraction(mesh, omegas, betas_deg, rho=1025.0,
-                                g=9.81):
+                                g=9.81, depth=0.0):
     """Run the native solver on a PanelMesh.
 
     Returns (A (nw,6,6), B (nw,6,6), X (nw,nbeta,6) complex) about the
-    origin (PRP), per unit wave amplitude, deep water.
+    origin (PRP), per unit wave amplitude.  ``depth`` > 0 selects the
+    finite-depth Green function (John's eigenfunction series; the solver
+    switches itself to the deep-water kernel above k0*h ~ 25 where the
+    two agree to machine precision); 0 means deep water.
     """
     lib = _load()
     verts = np.ascontiguousarray(mesh.verts, dtype=np.float64)
@@ -99,10 +102,10 @@ def solve_radiation_diffraction(mesh, omegas, betas_deg, rho=1025.0,
     def p(a, t=ct.c_double):
         return a.ctypes.data_as(ct.POINTER(t))
 
-    rc = lib.raft_bem_solve(
+    rc = lib.raft_bem_solve2(
         p(verts), len(verts), p(panels, ct.c_int32), len(panels),
         int(getattr(mesh, "nbody", len(panels))),
-        p(omegas), nw, p(betas), nb, float(rho), float(g),
+        p(omegas), nw, p(betas), nb, float(rho), float(g), float(depth),
         p(A), p(B), p(Xre), p(Xim))
     if rc != 0:
         raise RuntimeError(f"raft_bem_solve failed (rc={rc})")
@@ -138,14 +141,6 @@ def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
         headings = np.arange(0.0, 360.0, 30.0)
     headings = np.asarray(headings, float)
 
-    # the core uses the infinite-depth Green function; warn when the site
-    # is not deep relative to the longest modeled wave (kh < pi)
-    k_min = float(fowt.w[0]) ** 2 / g
-    if k_min * fowt.depth < np.pi:
-        print(f"WARNING: native BEM assumes deep water but k*h = "
-              f"{k_min * fowt.depth:.2f} < pi at the lowest frequency "
-              f"(depth {fowt.depth} m) — low-frequency coefficients will "
-              "deviate from a finite-depth solution")
 
     mesh = None
     key = None
@@ -165,6 +160,9 @@ def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
         h.update(np.array([max_freqs], float).tobytes())
         h.update(headings.tobytes())
         h.update(np.array([rho, g, fowt.depth, mesh.nbody]).tobytes())
+        # physics-version token: cached coefficients solved by an older
+        # kernel (e.g. deep-water-only) must not be silently reloaded
+        h.update(b"raftbem-v2-finite-depth")
         key = h.hexdigest()
         key_path = _os.path.join(mesh_dir, "cache_key.txt")
         if (_os.path.isfile(_os.path.join(mesh_dir, "Output.1"))
@@ -184,7 +182,10 @@ def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
 
     if mesh is None:
         mesh = mesh_fowt_members(fowt, dz_max=dz or 3.0, da_max=da or 2.0)
-    A, B, X = solve_radiation_diffraction(mesh, w_bem, headings, rho, g)
+    # finite-depth Green function below k0*h ~ 25, deep-water kernel above
+    # (the solver switches per frequency; see native/bem/bem.cpp)
+    A, B, X = solve_radiation_diffraction(mesh, w_bem, headings, rho, g,
+                                          depth=float(fowt.depth))
     X = np.conj(X)
 
     # reorder to the WAMIT reader's layout: (6,6,nf) and (nh,6,nf)

@@ -266,9 +266,12 @@ def test_oc4semi_vs_reference_wamit_file():
     mesh = mesh_fowt_members(fowt, dz_max=3.0, da_max=2.4, all_members=True)
     ref = read_wamit1(wpath)
     rho = 1025.0
-    # validation grid: deep-water-valid, hydrodynamically active band
-    sel = [float(w) for w in (0.5, 0.8, 1.2)]
-    A, B, _ = solve_radiation_diffraction(mesh, sel, [0.0], rho=rho, g=9.81)
+    # validation grid now spans the FINITE-DEPTH band too (kh < pi at
+    # w <= 0.39 for the 200 m site): the .1 file is real finite-depth
+    # WAMIT data, so the low bins exercise the John-series kernel
+    sel = [float(w) for w in (0.18, 0.28, 0.5, 0.8, 1.2)]
+    A, B, _ = solve_radiation_diffraction(mesh, sel, [0.0], rho=rho,
+                                          g=9.81, depth=200.0)
     Aref = np.stack([[np.interp(w, ref["w"], rho * ref["A"][i, i])
                       for i in range(6)] for w in sel])      # (nw, 6)
     Bref = np.stack([[np.interp(w, ref["w"], rho * w * ref["B"][i, i])
@@ -283,3 +286,72 @@ def test_oc4semi_vs_reference_wamit_file():
     for i in (0, 1, 2, 3, 4):
         rel = np.abs(Bours[:, i] - Bref[:, i]) / max(np.abs(Bref[:, i]).max(), 1e-3)
         assert rel.max() < 0.10, (i, rel)
+
+
+def test_finite_depth_green_function_properties():
+    """Unit checks on the finite-depth Green function exports: deep-water
+    limit vs the tabulated deep kernel, free-surface and seabed boundary
+    conditions, reciprocity."""
+    import ctypes as ct
+
+    lib = bem_native._load()
+    lib.raft_bem_wave_deep.argtypes = [ct.c_double, ct.POINTER(ct.c_double),
+                                       ct.POINTER(ct.c_double),
+                                       ct.POINTER(ct.c_double)]
+    lib.raft_bem_wave_fd.argtypes = [ct.c_double, ct.c_double,
+                                     ct.POINTER(ct.c_double),
+                                     ct.POINTER(ct.c_double),
+                                     ct.POINTER(ct.c_double)]
+
+    def pd(a):
+        return np.ascontiguousarray(a, float).ctypes.data_as(
+            ct.POINTER(ct.c_double))
+
+    def wave_fd(nu, h, x, xi):
+        out = np.zeros(8)
+        lib.raft_bem_wave_fd(ct.c_double(nu), ct.c_double(h), pd(x), pd(xi),
+                             pd(out))
+        return out
+
+    def wave_deep(k, x, xi):
+        out = np.zeros(8)
+        lib.raft_bem_wave_deep(ct.c_double(k), pd(x), pd(xi), pd(out))
+        return out
+
+    x = np.array([10.0, 3.0, -5.0])
+    xi = np.array([2.0, -1.0, -8.0])
+    nu = 0.05
+    deep = wave_deep(nu, x, xi)
+    fd = wave_fd(nu, 400.0, x, xi)        # k0 h = 20: effectively deep
+    # imaginary parts analytic on both sides; real parts table-limited
+    np.testing.assert_allclose(fd[1::2], deep[1::2], rtol=1e-12)
+    np.testing.assert_allclose(fd[0::2], deep[0::2], rtol=2e-4, atol=1e-8)
+
+    def G_full(nu, h, x, xi):
+        out = wave_fd(nu, h, x, xi)
+        G = out[0] + 1j * out[1]
+        R = np.hypot(x[0] - xi[0], x[1] - xi[1])
+        r1 = np.sqrt(R**2 + (x[2] - xi[2]) ** 2)
+        r2 = np.sqrt(R**2 + (x[2] + xi[2]) ** 2)
+        return G + 1.0 / r1 + 1.0 / r2
+
+    nu, h = 0.08, 150.0
+    src = np.array([0.0, 0.0, -30.0])
+    eps = 1e-4
+    for R in (5.0, 40.0):
+        # free surface: dG/dz = nu G at z = 0
+        Gp = G_full(nu, h, np.array([R, 0, -eps]), src)
+        Gm = G_full(nu, h, np.array([R, 0, -3 * eps]), src)
+        G0 = G_full(nu, h, np.array([R, 0, -2 * eps]), src)
+        dGdz = (Gp - Gm) / (2 * eps)
+        assert abs(dGdz - nu * G0) / abs(nu * G0) < 1e-3
+        # seabed: dG/dz = 0 at z = -h
+        Gp = G_full(nu, h, np.array([R, 0, -h + 2 * eps]), src)
+        Gm = G_full(nu, h, np.array([R, 0, -h + 0.5 * eps]), src)
+        G0 = G_full(nu, h, np.array([R, 0, -h + eps]), src)
+        assert abs((Gp - Gm) / (1.5 * eps)) / (nu * abs(G0)) < 1e-3
+    # reciprocity
+    a = np.array([12.0, 5.0, -20.0])
+    b = np.array([-8.0, 2.0, -60.0])
+    np.testing.assert_allclose(G_full(nu, h, a, b), G_full(nu, h, b, a),
+                               rtol=1e-12)

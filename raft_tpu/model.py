@@ -209,21 +209,32 @@ class Model:
             D_hydro = fowt_current_loads(fowt, pose0, cur_speed, cur_head)
             state["D_hydro"] = np.asarray(D_hydro)
             F_env = np.asarray(jnp.sum(tc["f_aero0"], axis=1)) + np.asarray(D_hydro)
-            # current drag on the mooring lines (reference passes the case
-            # current to MoorPy, raft_model.py:559-578)
+            # current on the mooring lines (reference passes the case
+            # current to MoorPy, raft_model.py:559-578).  Simple-topology
+            # systems model it the MoorPy way — current-loaded line
+            # profiles (tilted-plane catenary, line_forces) whose fairlead
+            # tensions transmit the drag to the body — so the wrench,
+            # stiffness, and tension stats all see the loaded lines.
+            # General (free-point) topologies keep the lumped chord
+            # approximation on F_env.
+            state["moor_current"] = None
             if (self.mooring_currentMod > 0 and cur_speed > 0
                     and fowt.mooring is not None):
                 U = cur_speed * np.array([np.cos(np.deg2rad(cur_head)),
                                           np.sin(np.deg2rad(cur_head)), 0.0])
-                X0 = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
-                F_env = F_env + np.asarray(
-                    mr.current_wrench(fowt.mooring, X0, U))
+                if mr._is_general(fowt.mooring):
+                    X0 = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+                    F_env = F_env + np.asarray(
+                        mr.current_wrench(fowt.mooring, X0, U))
+                else:
+                    state["moor_current"] = U
             if "F_meandrift" in state:
                 F_env = F_env + state["F_meandrift"]
         else:
             state["turbine"] = None
             state["hydro0"] = fowt_hydro_constants(fowt, pose0)
             state["D_hydro"] = np.zeros(6)
+            state["moor_current"] = None
         state["F_env_constant"] = F_env
 
     def _statics_eval_fn(self):
@@ -237,11 +248,13 @@ class Model:
         refs = np.concatenate([
             [f.x_ref, f.y_ref, 0, 0, 0, 0] for f in self.fowtList])
         moors = [f.mooring for f in self.fowtList]
+        _is_general_moor = [m is not None and mr._is_general(m)
+                            for m in moors]
         arr = self.arr_ms
         if arr is not None:
             from raft_tpu.models import mooring_array as ma
 
-        def eval_FK(X, xf, F0s, K_hss):
+        def eval_FK(X, xf, F0s, K_hss, Ucur):
             Fs, Kblocks = [], []
             for i in range(N):
                 s = slice(6 * i, 6 * i + 6)
@@ -250,10 +263,16 @@ class Model:
                 K = K_hss[i]
                 if moors[i] is not None:
                     # general topologies: solve free points once per
-                    # evaluation, share across wrench + stiffness
+                    # evaluation, share across wrench + stiffness.  Simple
+                    # topologies see the case current through the loaded
+                    # line profiles (zero current reduces to the plain
+                    # vertical-plane catenary).
+                    cur = None if _is_general_moor[i] else Ucur[i]
                     xf_i = mr.free_points(moors[i], X[s])
-                    F = F + mr.body_wrench(moors[i], X[s], xf=xf_i)
-                    K = K + mr.coupled_stiffness(moors[i], X[s], xf=xf_i)
+                    F = F + mr.body_wrench(moors[i], X[s], xf=xf_i,
+                                           current=cur)
+                    K = K + mr.coupled_stiffness(moors[i], X[s], xf=xf_i,
+                                                 current=cur)
                 Fs.append(F)
                 Kblocks.append(K)
             Fv = jnp.concatenate(Fs)
@@ -307,7 +326,10 @@ class Model:
         # The reference's plain clip-step loop can oscillate on
         # pathological designs (raft_model.py:677-767 band-aids).
         alphas = np.array([1.0, 0.5, 0.25, 0.125, 0.0625])
-        Fj, Kj, xf_arg = eval_FK_j(jnp.asarray(X), xf_arg, F0s, K_hss)
+        Ucur = jnp.asarray(np.stack([
+            st.get("moor_current") if st.get("moor_current") is not None
+            else np.zeros(3) for st in self._state]))
+        Fj, Kj, xf_arg = eval_FK_j(jnp.asarray(X), xf_arg, F0s, K_hss, Ucur)
         for it in range(50):
             F, K = np.asarray(Fj), np.asarray(Kj).copy()
             # guard zero-stiffness diagonals like the reference (:713-715)
@@ -322,7 +344,7 @@ class Model:
             full_step = None
             for a in alphas:
                 Fa, Ka, xfa = eval_FK_j(jnp.asarray(X + a * dX), xf_arg,
-                                        F0s, K_hss)
+                                        F0s, K_hss, Ucur)
                 if a == 1.0:
                     full_step = (Fa, Ka, xfa)
                 merit_a = float(np.sum(np.asarray(Fa)**2))
@@ -374,9 +396,11 @@ class Model:
                 # reference's dynamics C_moor is getCoupledStiffnessA from
                 # setPosition (raft_fowt.py:287); only the TENSION
                 # statistics use the FD getCoupledStiffness variant
+                cur = state.get("moor_current")
                 state["C_moor"] = np.asarray(
-                    mr.coupled_stiffness(fowt.mooring, X[s]))
-                state["F_moor0"] = np.asarray(mr.body_wrench(fowt.mooring, X[s]))
+                    mr.coupled_stiffness(fowt.mooring, X[s], current=cur))
+                state["F_moor0"] = np.asarray(
+                    mr.body_wrench(fowt.mooring, X[s], current=cur))
             else:
                 state["C_moor"] = np.zeros((6, 6))
                 state["F_moor0"] = np.zeros(6)
@@ -985,8 +1009,9 @@ class Model:
             r6 = state["r6"]
             # MoorPy-parity FD Jacobian (see coupled_stiffness_fd): the
             # reference's Tmoor stats use getCoupledStiffness(tensions=True)
-            J = np.asarray(mr.tension_jacobian_fd(moor, r6))
-            T0 = np.asarray(mr.tensions(moor, r6))
+            cur = state.get("moor_current")
+            J = np.asarray(mr.tension_jacobian_fd(moor, r6, current=cur))
+            T0 = np.asarray(mr.tensions(moor, r6, current=cur))
             nT = len(T0)
             T_amps = np.einsum("tj,hjw->htw", J, Xi)
             results["Tmoor_avg"] = T0

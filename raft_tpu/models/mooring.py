@@ -57,6 +57,7 @@ class MooringSystem:
     m_lin: np.ndarray        # (nl,) mass per length
     Cd_t: np.ndarray         # (nl,) transverse drag coefficient
     Cd_a: np.ndarray         # (nl,) tangential drag coefficient
+    rho: float = _RHO        # water density (for line current drag)
 
     @property
     def n_lines(self) -> int:
@@ -138,7 +139,7 @@ def parse_mooring(moor: dict, rho: float = _RHO, g: float = _G,
             rAnchor=np.array(rAnchor), rFair0=np.array(rFair0),
             L=np.array(L), EA=np.array(EA), w=np.array(w),
             d_vol=np.array(d_vol), m_lin=np.array(m_lin),
-            Cd_t=np.array(Cd_t), Cd_a=np.array(Cd_a),
+            Cd_t=np.array(Cd_t), Cd_a=np.array(Cd_a), rho=rho,
         )
 
     # ----- general topology: single-body ArrayMooring -----
@@ -296,19 +297,55 @@ def fairlead_positions(sys_: MooringSystem, r6):
     return r6[:3] + jnp.asarray(sys_.rFair0) @ R.T
 
 
-def line_forces(sys_: MooringSystem, r6):
+def _safe_norm(x, axis=-1):
+    """|x| with a zero-safe gradient (d|x|/dx = 0 at x = 0 instead of NaN,
+    needed because the current-drag decomposition vanishes identically
+    when U is parallel/perpendicular to the chord)."""
+    return jnp.sqrt(jnp.sum(x * x, axis=axis) + 1e-30)
+
+
+def line_forces(sys_: MooringSystem, r6, current=None):
     """Per-line force on the body at each fairlead, (nl,3) global, plus the
-    solve products (tensions)."""
+    solve products (tensions).
+
+    ``current``: optional uniform current velocity (3,).  When given, each
+    line solves in the plane of its EFFECTIVE weight vector — submerged
+    weight plus chord-direction current drag per unit length — the
+    quasi-static current model of MoorPy's currentMod=1 (the reference
+    passes case currents to MoorPy, raft_model.py:559-578, and its
+    tension statistics FD re-equilibrates the current-loaded lines at
+    every perturbed pose).  The catenary itself is unchanged; only the
+    solve plane tilts and the weight becomes |w_vec|."""
     rF = fairlead_positions(sys_, r6)
     rA = jnp.asarray(sys_.rAnchor)
-    dxy = rF[:, :2] - rA[:, :2]
-    XF = jnp.linalg.norm(dxy, axis=1)
-    ZF = rF[:, 2] - rA[:, 2]
-    sol = catenary_solve(XF, ZF, jnp.asarray(sys_.L), jnp.asarray(sys_.EA),
-                         jnp.asarray(sys_.w))
-    XF_safe = jnp.where(XF > 0, XF, 1.0)[:, None]
-    dir_h = dxy / XF_safe
-    F = jnp.concatenate([-sol["H"][:, None] * dir_h, -sol["V"][:, None]], axis=1)
+    L = jnp.asarray(sys_.L)
+    EA = jnp.asarray(sys_.EA)
+    w = jnp.asarray(sys_.w)
+    if current is None:
+        dxy = rF[:, :2] - rA[:, :2]
+        XF = jnp.linalg.norm(dxy, axis=1)
+        ZF = rF[:, 2] - rA[:, 2]
+        sol = catenary_solve(XF, ZF, L, EA, w)
+        XF_safe = jnp.where(XF > 0, XF, 1.0)[:, None]
+        dir_h = dxy / XF_safe
+        F = jnp.concatenate([-sol["H"][:, None] * dir_h,
+                             -sol["V"][:, None]], axis=1)
+        return F, rF, sol
+
+    from raft_tpu.models.mooring_array import chord_drag_per_length
+    U = jnp.asarray(current, float)
+    dr = rF - rA                                     # (nl,3) anchor->fairlead
+    f_drag = chord_drag_per_length(dr, U, sys_.d_vol, sys_.Cd_t,
+                                   sys_.Cd_a, sys_.rho)   # (nl,3) N/m
+    w_vec = f_drag + w[:, None] * jnp.array([0.0, 0.0, -1.0])
+    w_eff = _safe_norm(w_vec)                        # (nl,)
+    zt = -w_vec / w_eff[:, None]                     # tilted "up"
+    ZF = jnp.sum(dr * zt, axis=1)
+    xvec = dr - ZF[:, None] * zt
+    XF = _safe_norm(xvec)
+    xt = xvec / jnp.where(XF > 0, XF, 1.0)[:, None]
+    sol = catenary_solve(XF, ZF, L, EA, w_eff)
+    F = -sol["H"][:, None] * xt - sol["V"][:, None] * zt
     return F, rF, sol
 
 
@@ -330,21 +367,24 @@ def free_points(sys_, r6, xf0=None):
                                 xf0=xf0)
 
 
-def body_wrench(sys_, r6, xf=None):
+def body_wrench(sys_, r6, xf=None, current=None):
     """Net 6-DOF mooring wrench on the body about its reference point
-    (equivalent of Body.getForces(lines_only=True))."""
+    (equivalent of Body.getForces(lines_only=True)).  ``current`` engages
+    the current-loaded line profiles on the simple path (see
+    line_forces); general topologies model current by the lumped chord
+    approximation in current_wrench instead."""
     if _is_general(sys_):
         from raft_tpu.models import mooring_array as ma
         Xb = jnp.asarray(r6, float)[None, :]
         if xf is None:
             xf = ma.solve_free_points(sys_, Xb)
         return ma.body_wrenches(sys_, Xb, xf)[0]
-    F, rF, _ = line_forces(sys_, r6)
+    F, rF, _ = line_forces(sys_, r6, current=current)
     r6 = jnp.asarray(r6, float)
     return jnp.sum(translate_force_3to6(F, rF - r6[:3]), axis=0)
 
 
-def coupled_stiffness(sys_, r6, xf=None):
+def coupled_stiffness(sys_, r6, xf=None, current=None):
     """6x6 mooring stiffness -dF/dx about the body pose (equivalent of
     getCoupledStiffnessA(lines_only=True)), by exact forward-mode autodiff
     through the catenary Newton solve (free points eliminated by the
@@ -355,10 +395,11 @@ def coupled_stiffness(sys_, r6, xf=None):
         if xf is None:
             xf = ma.solve_free_points(sys_, Xb)
         return ma.coupled_stiffness(sys_, Xb, xf)
-    return -jax.jacfwd(lambda x: body_wrench(sys_, x))(jnp.asarray(r6, float))
+    return -jax.jacfwd(lambda x: body_wrench(sys_, x, current=current))(
+        jnp.asarray(r6, float))
 
 
-def tensions(sys_, r6, xf=None):
+def tensions(sys_, r6, xf=None, current=None):
     """Line end tensions, shape (2*nl,): all anchor-end tensions first,
     then all fairlead-end tensions ([TA_1..TA_n, TB_1..TB_n]), matching
     MoorPy's getTensions ordering used by the reference."""
@@ -368,7 +409,7 @@ def tensions(sys_, r6, xf=None):
         if xf is None:
             xf = ma.solve_free_points(sys_, Xb)
         return ma.tensions(sys_, Xb, xf)
-    _, _, sol = line_forces(sys_, r6)
+    _, _, sol = line_forces(sys_, r6, current=current)
     return jnp.concatenate([sol["TA"], sol["TB"]])
 
 
@@ -431,19 +472,24 @@ def coupled_stiffness_fd(sys_, r6, dx=0.1, dth=0.1, tensions_too=False):
     return K
 
 
-def tension_jacobian_fd(sys_, r6, dx=0.1, dth=0.1):
+def tension_jacobian_fd(sys_, r6, dx=0.1, dth=0.1, current=None):
     """MoorPy-parity FD tension Jacobian (getCoupledStiffness(...,
     tensions=True) J_moor) — see :func:`coupled_stiffness_fd`.  Computes
     only the tensions (no wrench evaluations), with one free-point solve
-    shared per perturbed pose."""
+    shared per perturbed pose.  ``current`` re-solves the CURRENT-LOADED
+    line profiles at every perturbed pose, matching MoorPy's FD under
+    ms.currentMod=1 (without it the loaded-case Tmoor_std carried a
+    3-5e-2 band vs the reference pickles; see tests/test_model_oc3.py)."""
     r6 = np.asarray(r6, float)
     dX = np.array([dx, dx, dx, dth, dth, dth])
     J = None
     for i in range(6):
         Xp = r6.copy(); Xp[i] += dX[i]
         Xm = r6.copy(); Xm[i] -= dX[i]
-        Tp = np.asarray(tensions(sys_, Xp, xf=free_points(sys_, Xp)))
-        Tm = np.asarray(tensions(sys_, Xm, xf=free_points(sys_, Xm)))
+        Tp = np.asarray(tensions(sys_, Xp, xf=free_points(sys_, Xp),
+                                 current=current))
+        Tm = np.asarray(tensions(sys_, Xm, xf=free_points(sys_, Xm),
+                                 current=current))
         if J is None:
             J = np.zeros((len(Tp), 6))
         J[:, i] = 0.5 * (Tp - Tm) / dX[i]

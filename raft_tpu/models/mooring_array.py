@@ -307,21 +307,33 @@ def solve_free_points(ms: ArrayMooring, Xb, xf0=None, iters: int = 40,
     return x.reshape(-1, 3)
 
 
-def chord_drag(rA, rB, U, L, d, Cd_t, Cd_a, rho):
-    """Per-line uniform-current drag on the straight chord rA->rB, (nl,3):
-    transverse 0.5 rho Cd_t d |Un| Un plus tangential
-    0.5 rho Cd_a (pi d) |Ut| Ut per unit length over the unstretched
-    length.  Shared by the single-body and array mooring paths."""
+def chord_drag_per_length(chord, U, d, Cd_t, Cd_a, rho):
+    """Uniform-current drag per unit length on lines with the given chord
+    vectors (nl,3) -> (nl,3) N/m: transverse 0.5 rho Cd_t d |Un| Un plus
+    tangential 0.5 rho Cd_a (pi d) |Ut| Ut.  The single constitutive law
+    shared by the lumped wrench (chord_drag) and the tilted-plane
+    current-loaded catenary (mooring.line_forces).  Norms are zero-safe so
+    autodiff through vanishing components stays finite."""
     U = jnp.asarray(U, float)
-    chord = jnp.asarray(rB) - jnp.asarray(rA)
-    t = chord / jnp.linalg.norm(chord, axis=1, keepdims=True)
+    chord = jnp.asarray(chord)
+    cn = jnp.sqrt(jnp.sum(chord * chord, axis=1, keepdims=True) + 1e-30)
+    t = chord / cn
     Ut = jnp.sum(U[None, :] * t, axis=1, keepdims=True) * t
     Un = U[None, :] - Ut
-    return (0.5 * rho * jnp.asarray(L) * jnp.asarray(d))[:, None] * (
-        jnp.asarray(Cd_t)[:, None]
-        * jnp.linalg.norm(Un, axis=1, keepdims=True) * Un
-        + np.pi * jnp.asarray(Cd_a)[:, None]
-        * jnp.linalg.norm(Ut, axis=1, keepdims=True) * Ut)
+    nUn = jnp.sqrt(jnp.sum(Un * Un, axis=1, keepdims=True) + 1e-30)
+    nUt = jnp.sqrt(jnp.sum(Ut * Ut, axis=1, keepdims=True) + 1e-30)
+    return (0.5 * rho * jnp.asarray(d))[:, None] * (
+        jnp.asarray(Cd_t)[:, None] * nUn * Un
+        + np.pi * jnp.asarray(Cd_a)[:, None] * nUt * Ut)
+
+
+def chord_drag(rA, rB, U, L, d, Cd_t, Cd_a, rho):
+    """Per-line uniform-current drag on the straight chord rA->rB, (nl,3),
+    integrated over the unstretched length (chord_drag_per_length * L).
+    Shared by the single-body and array mooring paths."""
+    f = chord_drag_per_length(jnp.asarray(rB) - jnp.asarray(rA), U,
+                              d, Cd_t, Cd_a, rho)
+    return jnp.asarray(L)[:, None] * f
 
 
 def current_wrenches(ms: ArrayMooring, Xb, xf, U):

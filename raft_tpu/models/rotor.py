@@ -26,6 +26,8 @@ TPU-first replacement for the reference Rotor class and its CCBlade
 """
 from __future__ import annotations
 
+import contextlib
+import functools
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -45,6 +47,51 @@ _RPM2RS = np.pi / 30.0   # exact, used inside the BEM like CCBlade does
 _N_BISECT = 60
 _N_NEWTON = 3
 _EPS_PHI = 1e-6
+
+
+def _tree_cast(tree, from_to):
+    """Cast every jax/numpy float array leaf per the dtype map."""
+    def cast(x):
+        if isinstance(x, (jax.Array, np.ndarray)) and x.dtype in from_to:
+            return jnp.asarray(x, from_to[x.dtype])
+        return x
+    return jax.tree.map(cast, tree)
+
+
+_DOWN = {np.dtype(np.float64): np.float32,
+         np.dtype(np.complex128): np.complex64}
+_UP = {np.dtype(np.float32): np.float64,
+       np.dtype(np.complex64): np.complex128}
+
+
+def f64_host(fn):
+    """Run a BEM/aero entry point in float64 on the host CPU regardless of
+    the global x64 mode, casting inputs up and results down.
+
+    The induction residual's bracket test needs ~1e-12 cancellation
+    resolution at the phi -> 0+ endpoint (two ~1e12-magnitude terms nearly
+    cancel); in f32 the sign flips, the bisection falls into the
+    propeller-brake bracket [pi/2, pi] for every element, and rotor thrust
+    collapses ~400x (measured, round 4).  Rather than chase f32 robustness
+    of a fundamentally ill-conditioned bracket test, the aero-servo stage —
+    a tiny host-side once-per-case computation producing (6,6,nw) tensors —
+    always runs in f64 on CPU, the way the reference runs CCBlade in f64
+    numpy (raft_rotor.py:726), and only the resulting constants travel to
+    the accelerator in the working precision.
+    """
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if jax.config.jax_enable_x64:
+            return fn(*args, **kwargs)
+        try:
+            ctx = jax.default_device(jax.local_devices(backend="cpu")[0])
+        except Exception:   # no cpu backend registered: stay put
+            ctx = contextlib.nullcontext()
+        with jax.enable_x64(), ctx:
+            args, kwargs = _tree_cast((args, kwargs), _UP)
+            out = fn(*args, **kwargs)
+        return _tree_cast(out, _DOWN)
+    return wrapped
 
 
 @dataclass
@@ -551,6 +598,7 @@ def _hub_loads_one_azimuth(rot: RotorModel, Np, Tp, azimuth_deg):
     return Rx @ F_az, Rx @ M_az
 
 
+@f64_host
 def bem_evaluate(rot: RotorModel, Uinf, Omega_rpm, pitch_deg,
                  tilt=0.0, yaw=0.0):
     """Azimuth-averaged hub loads: dict(T, Y, Z, Q, My, Mz, P).
@@ -583,6 +631,7 @@ def bem_evaluate(rot: RotorModel, Uinf, Omega_rpm, pitch_deg,
                 P=M[0] * Omega_rs)
 
 
+@f64_host
 def bem_thrust_torque_derivs(rot: RotorModel, Uinf, Omega_rpm, pitch_deg,
                              tilt=0.0, yaw=0.0):
     """(T, Q) and their Jacobian w.r.t. (Uinf, Omega_rpm, pitch_deg) by
@@ -709,6 +758,7 @@ def rotor_pose(rot: RotorModel, r6=None, inflow_heading=0.0,
 # aero-servo linearization
 # --------------------------------------------------------------------------
 
+@f64_host
 def calc_aero(rot: RotorModel, w, case: dict, r6=None, current=False):
     """Mean loads + frequency-domain aero matrices (reference:
     raft_rotor.py:788-1005).
@@ -868,6 +918,7 @@ def blade_member_dicts(rot: RotorModel):
     return mems
 
 
+@f64_host
 def calc_cavitation(rot: RotorModel, case: dict, clearance_margin=1.0,
                     Patm=101325.0, Pvap=2500.0, error_on_cavitation=False,
                     display=0):

@@ -349,6 +349,10 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
         return _finish(st, Xi)
 
     solve.batched = solve_batched
+    # introspection hooks (precision budgeting, tests)
+    solve.setup = setup
+    solve.drag_step = drag_step
+    solve.finish = _finish
     return solve
 
 

@@ -5,12 +5,16 @@ __graft_entry__ and tests all load the SAME yaml (they previously kept
 three hand-rolled fallback copies that could silently diverge)."""
 import os
 
-#: search roots, in priority order: the reference checkout, then a local
-#: designs/ directory next to the repo root (for standalone deployments)
+#: search roots, in priority order: the reference checkout (parity tests
+#: pin against its copies when present), a designs/ directory next to the
+#: repo root (user overrides in a source checkout), then the yamls
+#: vendored as package data (raft_tpu/designs — works for wheel installs)
 _SEARCH_DIRS = (
     "/root/reference/designs",
     os.path.join(os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))), "designs"),
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "designs"),
 )
 
 

@@ -79,6 +79,12 @@ def f64_host(fn):
     numpy (raft_rotor.py:726), and only the resulting constants travel to
     the accelerator in the working precision.
     """
+    # jax.enable_x64 is the public context manager on recent jax; older
+    # releases only have the jax.experimental spelling
+    _enable_x64 = getattr(jax, "enable_x64", None)
+    if _enable_x64 is None:                      # pragma: no cover
+        from jax.experimental import enable_x64 as _enable_x64
+
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         if jax.config.jax_enable_x64:
@@ -87,7 +93,7 @@ def f64_host(fn):
             ctx = jax.default_device(jax.local_devices(backend="cpu")[0])
         except Exception:   # no cpu backend registered: stay put
             ctx = contextlib.nullcontext()
-        with jax.enable_x64(), ctx:
+        with _enable_x64(), ctx:
             args, kwargs = _tree_cast((args, kwargs), _UP)
             out = fn(*args, **kwargs)
         return _tree_cast(out, _DOWN)

@@ -64,12 +64,17 @@ def wake_velocities(xy, D, Ct, U_inf, wind_dir_deg=0.0, k_w=0.05):
     return U
 
 
-def power_thrust_curve(model, speeds=None, ifowt=0):
+def power_thrust_curve(model, speeds=None, ifowt=0, cut_in=3.0,
+                       cut_out=25.0):
     """Cp/Ct/power/thrust/pitch schedule vs wind speed (reference:
     raft_model.py:1674-1750 powerThrustCurve).
 
     Evaluates the BEM rotor at each operating point; returns a dict of
     arrays keyed like the FLORIS turbine yaml the reference writes.
+    Speeds outside [cut_in, cut_out] are PARKED — zero power/thrust/Cp/Ct
+    and zero rotor speed, like the reference's 'parked' case switch
+    (raft_model.py:1705-1708); np.interp clamping of the operating
+    schedule would otherwise report near-rated loads at storm speeds.
     """
     from raft_tpu.models.rotor import bem_evaluate
 
@@ -85,6 +90,8 @@ def power_thrust_curve(model, speeds=None, ifowt=0):
     pitch = np.zeros_like(speeds)
     omega = np.zeros_like(speeds)
     for i, U in enumerate(speeds):
+        if not (cut_in <= U <= cut_out):
+            continue                    # parked: all-zero row
         Uh = U * rot.speed_gain
         om = float(np.interp(Uh, rot.Uhub_ops, rot.Omega_rpm_ops))
         pi_deg = float(np.interp(Uh, rot.Uhub_ops, rot.pitch_deg_ops))
@@ -195,3 +202,132 @@ def calc_aep(model, wind_rose, k_w=0.05, availability=1.0):
                               farm_power=farm_p, U=eq["U"]))
         aep += prob * farm_p * hours
     return dict(AEP=aep * availability, states=per_state)
+
+
+# --------------------------------------------------------------------------
+# FLORIS interop (optional dependency; reference: raft_model.py:1753-1850)
+# --------------------------------------------------------------------------
+
+def floris_available() -> bool:
+    """True when the optional FLORIS package can be imported."""
+    try:
+        import floris  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def floris_turbine_dict(model, ifowt, turb_template, uhubs=None):
+    """Per-turbine FLORIS turbine-library dict from the BEM power/thrust
+    curve (the body of the reference's florisCoupling turbine loop,
+    raft_model.py:1806-1846): hub height, rotor diameter, air density,
+    power/thrust tables from powerThrustCurve, and the floating tilt
+    table (mean platform pitch schedule) for the Empirical Gaussian wake
+    deflection model.  ``turb_template`` is the base turbine yaml dict to
+    update; pure data — no floris import needed."""
+    fowt = model.fowtList[ifowt]
+    rot = fowt.rotors[0]
+    if uhubs is None:
+        # the reference's grid: 3..24.5 step 0.5 plus 25.02 and 50
+        uhubs = list(np.arange(3.0, 25.0, 0.5)) + [25.02, 50.0]
+    uhubs = np.asarray(uhubs, float)
+    curve = power_thrust_curve(model, speeds=uhubs, ifowt=ifowt)
+    # mean platform pitch at each operating point: thrust at hub height
+    # against the pitch hydrostatic+mooring stiffness about the FOWT's
+    # reference position (anchors are laid out about (x_ref, y_ref) —
+    # evaluating the mooring at the origin would solve km-scale spans)
+    from raft_tpu.models import mooring as mr
+    ref6 = np.array([fowt.x_ref, fowt.y_ref, 0.0, 0.0, 0.0, 0.0])
+    st = model._state[ifowt].get("statics")
+    if st is None:
+        from raft_tpu.models.fowt import fowt_pose, fowt_statics
+        pose0 = fowt_pose(fowt, ref6)
+        st = fowt_statics(fowt, pose0)
+    C55 = float(np.asarray(st["C_struc"] + st["C_hydro"])[4, 4])
+    if fowt.mooring is not None:
+        C55 += float(np.asarray(
+            mr.coupled_stiffness(fowt.mooring, ref6))[4, 4])
+    zhub = rot.r_rel[2]
+    tilt = np.degrees(np.arctan2(curve["thrust"] * zhub, C55))
+
+    out = dict(turb_template)
+    out["hub_height"] = float(zhub)
+    out["rotor_diameter"] = float(2.0 * rot.R_rot)
+    out["ref_density_cp_ct"] = float(rot.rho)
+    out["turbine_type"] = f"turb{ifowt}_floating"
+    # Cp/Ct already carry the floating mean tilt; FLORIS must not re-tilt
+    out["floating_correct_cp_ct_for_tilt"] = False
+    # FLORIS v3 power_thrust_table semantics (matching the reference,
+    # raft_model.py:1837-1839): 'power' is the power COEFFICIENT Cp,
+    # 'thrust' the thrust coefficient Ct — FLORIS dimensionalizes with
+    # 0.5 rho A U^3 itself
+    ptt = dict(out.get("power_thrust_table") or {})
+    ptt["power"] = np.asarray(curve["Cp"]).tolist()
+    ptt["thrust"] = np.asarray(curve["Ct"]).tolist()
+    ptt["wind_speed"] = uhubs.tolist()
+    out["power_thrust_table"] = ptt
+    ftt = dict(out.get("floating_tilt_table") or {})
+    ftt["wind_speeds"] = uhubs.tolist()
+    ftt["tilt"] = tilt.tolist()
+    out["floating_tilt_table"] = ftt
+    return out
+
+
+def floris_coupling(model, config, turbconfig, path):
+    """Set up a FLORIS interface from this model (reference
+    florisCoupling, raft_model.py:1753-1850): write one turbine yaml per
+    unique (turbine, platform, mooring, heading) combination into
+    ``path`` and reinitialize FLORIS with the farm layout and those
+    turbine types.  Requires the optional ``floris`` package — without
+    it, raise ImportError pointing at the built-in Gaussian wake
+    (find_wake_equilibrium / calc_aep), which needs no dependencies.
+
+    config: floris farm config yaml path; turbconfig: list of turbine
+    yaml paths indexed by turbineID; path: output turbine-library dir.
+    Returns the FlorisInterface; also stored as ``model.fi``.
+    """
+    try:
+        from floris.tools import FlorisInterface
+    except ImportError as e:
+        raise ImportError(
+            "floris is not installed — use the built-in wake coupling "
+            "(raft_tpu.models.wake.find_wake_equilibrium / calc_aep), "
+            "or pip install floris for FLORIS-driven wakes") from e
+    import os
+
+    import yaml
+
+    fi = FlorisInterface(config)
+    site = model.design.get("site", {})
+    fi.reinitialize(air_density=site.get("rho_air", 1.225),
+                    wind_shear=site.get("shearExp", 0.12))
+    arr = model.design.get("array")
+    if arr:
+        rows = [dict(zip(arr["keys"], r)) for r in arr["data"]]
+    else:
+        rows = [dict(turbineID=1, platformID=1, mooringID=1,
+                     heading_adjust=0.0, x_location=f.x_ref,
+                     y_location=f.y_ref) for f in model.fowtList]
+    fi.reinitialize(layout_x=[r["x_location"] for r in rows],
+                    layout_y=[r["y_location"] for r in rows])
+
+    turblist, unique = [], []
+    for i, r in enumerate(rows):
+        key = [r.get("turbineID", 1), r.get("platformID", 1),
+               r.get("mooringID", 1), r.get("heading_adjust", 0.0)]
+        if key in unique:
+            ID = unique.index(key)
+        else:
+            unique.append(key)
+            ID = len(unique) - 1
+            with open(turbconfig[r.get("turbineID", 1) - 1]) as f:
+                template = yaml.safe_load(f)
+            td = floris_turbine_dict(model, i, template)
+            td["turbine_type"] = f"turb{ID}_floating"
+            with open(os.path.join(path, f"turb{ID}.yaml"), "w") as f:
+                yaml.dump(td, f, sort_keys=False, default_flow_style=None)
+        turblist.append(f"turb{ID}.yaml")
+    fi.reinitialize(turbine_type=turblist, turbine_library_path=path)
+    model.fi = fi
+    model.turblist = turblist
+    return fi

@@ -86,3 +86,55 @@ def test_calc_aep(pseudo_farm):
     # AEP equals the probability-weighted sum of state powers x hours
     expect = 8760.0 * (0.5 * p_aligned + 0.5 * p_cross)
     assert out["AEP"] == pytest.approx(expect, rel=1e-9)
+
+
+def test_floris_turbine_dict(pseudo_farm):
+    """The FLORIS turbine-library dict builder (no floris needed): keys,
+    curve lengths, tilt table monotone-through-rated, and the reference's
+    floating flags (raft_model.py:1806-1846)."""
+    from raft_tpu.models.wake import floris_turbine_dict
+
+    farm = pseudo_farm
+    farm.design = {"site": {"rho_air": 1.225}}
+    farm._state = [{} for _ in range(farm.nFOWT)]
+    template = dict(power_thrust_table={}, floating_tilt_table={},
+                    TSR=9.0)
+    uhubs = [5.0, 8.0, 11.0, 14.0, 40.0]          # 40 m/s: parked bin
+    td = floris_turbine_dict(farm, 0, template, uhubs=uhubs)
+    rot = farm.fowtList[0].rotors[0]
+    assert td["rotor_diameter"] == pytest.approx(2 * rot.R_rot)
+    assert td["hub_height"] == pytest.approx(rot.r_rel[2])
+    assert td["floating_correct_cp_ct_for_tilt"] is False
+    assert td["TSR"] == 9.0                       # template carried over
+    ptt = td["power_thrust_table"]
+    assert len(ptt["power"]) == len(ptt["thrust"]) == len(
+        ptt["wind_speed"]) == len(uhubs)
+    # FLORIS v3 schema: 'power' is the power COEFFICIENT (reference
+    # writes cp, raft_model.py:1837); beyond cut-out the rotor is parked
+    assert all(0 < p < 0.6 for p in ptt["power"][:4])
+    assert ptt["power"][4] == 0.0 and ptt["thrust"][4] == 0.0
+    ftt = td["floating_tilt_table"]
+    assert len(ftt["tilt"]) == len(uhubs)
+    assert ftt["tilt"][4] == 0.0                  # parked: no mean tilt
+    # mean tilt is positive (thrust pushes the platform) and monotone in
+    # the dimensional thrust it derives from (power_thrust_curve's raw
+    # thrust; ptt["thrust"] holds the Ct coefficient, per FLORIS schema)
+    tilt = np.asarray(ftt["tilt"])
+    assert np.all(tilt[:4] > 0)                   # operating bins tilt
+    from raft_tpu.models.wake import power_thrust_curve
+    thrust = power_thrust_curve(farm, speeds=np.asarray(uhubs),
+                                ifowt=0)["thrust"]
+    assert np.array_equal(np.argsort(tilt), np.argsort(thrust))
+
+
+def test_floris_coupling_optional_import(pseudo_farm, tmp_path):
+    """floris_coupling drives FlorisInterface when floris is importable
+    and raises a clear ImportError pointing at the built-in wake when it
+    is not (this environment has no floris — the adapter must fail
+    cleanly, not crash)."""
+    from raft_tpu.models.wake import floris_available, floris_coupling
+
+    if floris_available():
+        pytest.skip("floris installed — adapter exercised elsewhere")
+    with pytest.raises(ImportError, match="built-in wake"):
+        floris_coupling(pseudo_farm, str(tmp_path / "farm.yaml"), [], str(tmp_path))

@@ -38,6 +38,7 @@ import numpy as np
 
 NW = int(os.environ.get("RAFT_BENCH_NW", 200))   # north-star bins
 NV = int(os.environ.get("RAFT_BENCH_NV", 1024))  # variants per batch
+NW2 = int(os.environ.get("RAFT_BENCH_NW2", 50))  # QTF pair-grid bins
 NITER = 10        # drag-linearization iterations (VolturnUS-S setting)
 
 
@@ -168,6 +169,8 @@ def main():
 
     acc = _accuracy_gate(thetas, batched)
 
+    qtf = _qtf_metric()
+
     dev = jax.devices()[0]
     acc_ok = (isinstance(acc, dict)
               and acc["median"] <= ACC_MEDIAN_TOL
@@ -184,6 +187,7 @@ def main():
         "rel_dev_f32_vs_f64": acc,
         "accuracy_gate": {"median_tol": ACC_MEDIAN_TOL,
                           "surge_max_tol": ACC_SURGE_TOL, "ok": acc_ok},
+        "qtf_pairgrid": qtf,
         "ok": acc_ok,
     }
     print(json.dumps(result))
@@ -195,6 +199,55 @@ def main():
 #: on-hardware f32 response stds deviate from the f64 truth beyond these
 ACC_MEDIAN_TOL = 1e-4
 ACC_SURGE_TOL = 1e-3
+
+
+def _qtf_metric():
+    """Single-chip throughput of the raw slender-body QTF pair kernel —
+    the reference's self-identified hottest kernel (raft_model.py:980-984)
+    and this framework's context-parallel axis (calc_qtf_sharded shards
+    the w1-row dimension).  Times the jitted NW2-row pair-grid evaluation
+    (all Pinkster terms; Kim&Yue + Hermitian completion excluded — they
+    are O(nw2) and O(nw2^2) elementwise postprocessing) at 3 distinct
+    headings (the axon tunnel memoizes identical executions).  Returns a
+    dict for the bench JSON or an error string (never fails the bench)."""
+    import contextlib
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from raft_tpu.models import qtf as qt
+        from raft_tpu.models.fowt import build_fowt, fowt_pose
+
+        design = _design()
+        design["platform"]["potSecOrder"] = 1
+        design["platform"]["min_freq2nd"] = float(np.round(
+            0.25 / NW2, 6))
+        design["platform"]["max_freq2nd"] = 0.25
+        w = np.arange(1, NW + 1) * 0.002 * 2 * np.pi
+        try:
+            ctx = jax.default_device(jax.local_devices(backend="cpu")[0])
+        except Exception:
+            ctx = contextlib.nullcontext()
+        with ctx:   # host-side build + concrete pose (waterline geometry)
+            fowt = build_fowt(design, w,
+                              depth=float(design["site"]["water_depth"]))
+            pose = fowt_pose(fowt, np.zeros(6))
+        nw2 = len(fowt.w1_2nd)
+        rows = jnp.arange(nw2)
+
+        fn = jax.jit(lambda r, b: qt.calc_qtf_slender_body(
+            fowt, pose, b, rows=r))
+        jax.block_until_ready(fn(rows, 0.0))          # compile + warmup
+        betas = (0.1, 0.2, 0.3)
+        t0 = time.perf_counter()
+        for b in betas:
+            jax.block_until_ready(fn(rows, b))
+        dt = (time.perf_counter() - t0) / len(betas)
+        return {"pair_entries_per_s": round(nw2 * nw2 / dt, 1),
+                "nw2": nw2, "wall_s": round(dt, 4)}
+    except Exception as e:                            # pragma: no cover
+        return f"qtf metric failed: {type(e).__name__}: {e}"
 
 
 def _accuracy_gate(thetas, batched):

@@ -46,16 +46,39 @@ def _screen_finite(name, path, **arrays):
                 f"re-run the BEM solver or delete the cached output")
 
 
-def read_wamit1(path):
+def _detect_freq_convention(col1_in_file_order):
+    """'period' (WAMIT standard: column 1 descends in file order — long
+    periods first) vs 'omega' (HAMS/pyhams Wamit_format output with
+    Output_frequency_type 3: column 1 is rad/s, ASCENDING in file order —
+    e.g. the reference's shipped raft/data/cylinder Buoy.* files).  The
+    reference reads both through pyhams; a single sequence check
+    disambiguates every shipped file."""
+    seen = set()
+    vals = []
+    for v in col1_in_file_order:          # first-seen unique positives:
+        if v > 0 and v not in seen:       # multi-heading/multi-ij files
+            seen.add(v)                   # repeat col-1 within a block
+            vals.append(v)
+    if len(vals) >= 2 and all(b > a for a, b in zip(vals, vals[1:])):
+        return "omega"
+    return "period"
+
+
+def read_wamit1(path, freq="auto"):
     """Parse a WAMIT `.1` added-mass/damping file.
+
+    ``freq``: 'period' (WAMIT: column 1 is the wave period; PER<0 rows are
+    zero-frequency, PER=0 infinite-frequency), 'omega' (HAMS Wamit_format:
+    column 1 is rad/s ascending; 0 rows zero-frequency, negative rows
+    infinite-frequency), or 'auto' (detect from the file ordering).
 
     Returns dict(w (nf,) ascending rad/s, A (6,6,nf), B (6,6,nf),
     A0 (6,6) zero-frequency added mass or None, Ainf (6,6) or None).
     A/B are nondimensional (Abar, w*Bbar not yet applied — see load_bem).
     """
     rows = []
-    zero = {}
-    inf = {}
+    special = []
+    order = []
     with open(path) as f:
         for line in f:
             parts = line.split()
@@ -64,19 +87,34 @@ def read_wamit1(path):
             T = float(parts[0])
             i, j = int(parts[1]) - 1, int(parts[2]) - 1
             if len(parts) == 4:
-                (zero if T < 0 else inf)[(i, j)] = float(parts[3])
+                special.append((T, i, j, float(parts[3])))
             else:
                 rows.append((T, i, j, float(parts[3]), float(parts[4])))
+                order.append(T)
 
-    periods = sorted({r[0] for r in rows}, reverse=True)  # descending T = ascending w
-    idx = {T: n for n, T in enumerate(periods)}
-    nf = len(periods)
+    if freq == "auto":
+        freq = _detect_freq_convention(order)
+    zero, inf = {}, {}
+    for T, i, j, v in special:
+        if freq == "omega":
+            (zero if T == 0 else inf)[(i, j)] = v
+        else:
+            (zero if T < 0 else inf)[(i, j)] = v
+
+    if freq == "omega":
+        omegas = sorted({r[0] for r in rows})
+        idx = {o: n for n, o in enumerate(omegas)}
+        w = np.array(omegas)
+    else:
+        periods = sorted({r[0] for r in rows}, reverse=True)
+        idx = {T: n for n, T in enumerate(periods)}
+        w = 2.0 * np.pi / np.array(periods)
+    nf = len(idx)
     A = np.zeros((6, 6, nf))
     B = np.zeros((6, 6, nf))
     for T, i, j, a, b in rows:
         A[i, j, idx[T]] = a
         B[i, j, idx[T]] = b
-    w = 2.0 * np.pi / np.array(periods)
 
     def mat(d):
         if not d:
@@ -91,13 +129,14 @@ def read_wamit1(path):
     return out
 
 
-def read_wamit3(path):
-    """Parse a WAMIT `.3` excitation file.
+def read_wamit3(path, freq="auto"):
+    """Parse a WAMIT `.3` excitation file (``freq`` as in read_wamit1).
 
     Returns dict(w (nf,) ascending rad/s, headings (nh,) deg sorted
     ascending in [0,360), X (nh,6,nf) complex nondimensional).
     """
     rows = []
+    order = []
     with open(path) as f:
         for line in f:
             parts = line.split()
@@ -108,15 +147,22 @@ def read_wamit3(path):
             i = int(parts[2]) - 1
             re, im = float(parts[5]), float(parts[6])
             rows.append((T, head, i, re, im))
+            order.append(T)
 
-    periods = sorted({r[0] for r in rows}, reverse=True)
+    if freq == "auto":
+        freq = _detect_freq_convention(order)
+    if freq == "omega":
+        keys = sorted({r[0] for r in rows})
+        w = np.array(keys)
+    else:
+        keys = sorted({r[0] for r in rows}, reverse=True)
+        w = 2.0 * np.pi / np.array(keys)
     heads_raw = sorted({r[1] for r in rows})
-    tidx = {T: n for n, T in enumerate(periods)}
+    tidx = {T: n for n, T in enumerate(keys)}
     hidx = {h: n for n, h in enumerate(heads_raw)}
-    X = np.zeros((len(heads_raw), 6, len(periods)), dtype=complex)
+    X = np.zeros((len(heads_raw), 6, len(keys)), dtype=complex)
     for T, head, i, re, im in rows:
         X[hidx[head], i, tidx[T]] = re + 1j * im
-    w = 2.0 * np.pi / np.array(periods)
 
     # normalize headings to [0,360) and re-sort (reference: raft_fowt.py:669-676)
     headings = np.asarray(heads_raw) % 360.0
@@ -178,9 +224,14 @@ def rotate_to_wave_frame(X_global, headings):
 
 
 def load_bem(hydro_path: str, w_model, rho: float = 1025.0,
-             g: float = 9.81) -> BEMData:
+             g: float = 9.81, freq: str = "auto") -> BEMData:
     """Read `hydro_path`.1/.3 and interpolate onto the model grid
     (reference: raft_fowt.py:663-768).
+
+    ``freq``: 'period' (WAMIT), 'omega' (HAMS Wamit_format), or 'auto'
+    (detect from file ordering; see read_wamit1).  Exposed through the
+    design dict as ``platform: hydroFreqType`` for files the detection
+    cannot disambiguate (e.g. a WAMIT run with periods listed ascending).
 
     A missing `.3` file yields zero excitation with a single 0-degree
     heading (the strip-theory excitation path still applies) — the
@@ -191,7 +242,7 @@ def load_bem(hydro_path: str, w_model, rho: float = 1025.0,
         raise FileNotFoundError(f"WAMIT file {hydro_path}.1 not found")
 
     w_model = np.asarray(w_model, float)
-    d1 = read_wamit1(path + ".1")
+    d1 = read_wamit1(path + ".1", freq=freq)
     A0 = d1["A0"] if d1["A0"] is not None else d1["A"][:, :, 0]
     A_BEM = rho * _interp_freq(w_model, d1["w"], d1["A"], A0)
     # above the data range, use the file's infinite-frequency limit when
@@ -206,7 +257,7 @@ def load_bem(hydro_path: str, w_model, rho: float = 1025.0,
     B_BEM = rho * _interp_freq(w_model, d1["w"], B_dim, np.zeros((6, 6)))
 
     if os.path.isfile(path + ".3"):
-        d3 = read_wamit3(path + ".3")
+        d3 = read_wamit3(path + ".3", freq=freq)
         X_dim = rho * g * d3["X"]
         X_BEM_global = _interp_freq(w_model, d3["w"], X_dim,
                                     np.zeros_like(X_dim[..., 0]))

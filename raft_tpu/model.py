@@ -1298,6 +1298,68 @@ class Model:
         from raft_tpu.models.wake import calc_aep
         return calc_aep(self, wind_rose, **kw)
 
+    def florisCoupling(self, config, turbconfig, path):
+        """Drive a FLORIS interface from this model (reference:
+        raft_model.py:1753-1850); requires the optional floris package —
+        see raft_tpu.models.wake.floris_coupling."""
+        from raft_tpu.models.wake import floris_coupling
+        return floris_coupling(self, config, turbconfig, path)
+
+    def adjustWISDEM(self, old_wisdem_file, new_wisdem_file):
+        """Write an adjusted WISDEM geometry yaml with ballast volumes
+        updated from this model's trimmed fill levels (reference:
+        raft_model.py:1627-1672 adjustWISDEM — same member matching rule:
+        a WISDEM member maps to the RAFT member whose bottom-node z
+        matches its joint1 z to 5 significant characters and whose first
+        outer diameter matches; only the first ballast entry's volume is
+        updated, assuming a constant-diameter member).  Deviation: the
+        reference's member loop breaks unconditionally after the FIRST
+        RAFT member (raft_model.py:1665), so only one member could ever
+        match; here every member is considered."""
+        try:                        # the reference uses ruamel to preserve
+            import ruamel.yaml as ry     # format; fall back to plain yaml
+            reader = ry.YAML(typ="safe", pure=True)
+            with open(old_wisdem_file, encoding="utf-8") as f:
+                wisdem = reader.load(f)
+            dump = ry.YAML()
+            dump.default_flow_style = None
+
+            def _write(data, f):
+                dump.dump(data, f)
+        except ImportError:
+            import yaml as _yaml
+            with open(old_wisdem_file, encoding="utf-8") as f:
+                wisdem = _yaml.safe_load(f)
+
+            def _write(data, f):
+                _yaml.safe_dump(data, f, sort_keys=False,
+                                default_flow_style=None)
+
+        fowt = self.fowtList[0]
+        plat = wisdem["components"]["floating_platform"]
+        joints = {j["name"]: j for j in plat["joints"]}
+        for wm in plat["members"]:
+            if "ballasts" not in wm.get("internal_structure", {}):
+                continue
+            joint = joints.get(wm.get("joint1"))
+            if joint is None:
+                continue
+            for m in fowt.members:
+                rA = np.asarray(m.rA0, float)
+                d0 = float(np.atleast_1d(m.d)[0]) if np.ndim(m.d) else float(m.d)
+                if (str(joint["location"][2])[0:5] == str(rA[2])[0:5]
+                        and wm["outer_shape"]["outer_diameter"]["values"][0]
+                        == d0):
+                    t0 = float(np.atleast_1d(m.t)[0])
+                    area = np.pi * ((d0 - 2.0 * t0) / 2.0) ** 2
+                    lf = float(np.atleast_1d(m.l_fill)[0])
+                    wm["internal_structure"]["ballasts"][0]["volume"] = \
+                        float(area * lf)
+                    break
+        with open(new_wisdem_file, "w", encoding="utf-8") as f:
+            _write(wisdem, f)
+        return wisdem
+
 
 def run_raft(design_or_path, plots=0, ballast=False, station_plot=[]):
     """Convenience entry point (reference: raft_model.py:2024-2061).

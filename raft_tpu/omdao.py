@@ -17,9 +17,16 @@ rebuild — stays fully usable and testable.
 from __future__ import annotations
 
 import copy
+import os
 from itertools import compress
 
 import numpy as np
+
+#: when truthy (or the RAFT_TPU_DEBUG_OMDAO env var is set), RAFT_OMDAO
+#: dumps its options and inputs as yaml next to the output dir before
+#: each compute — the reference's WEIS debugging hook
+#: (omdao_raft.py:9 DEBUG_OMDAO, :362-386)
+DEBUG_OMDAO = bool(os.environ.get("RAFT_TPU_DEBUG_OMDAO", ""))
 
 ndim = 3
 ndof = 6
@@ -666,11 +673,55 @@ class RAFT_OMDAO(_ComponentBase):
     # ------------------------------------------------------------------
     # compute (reference: omdao_raft.py:698-810)
     # ------------------------------------------------------------------
+    def _debug_dump(self, inputs, out_dir=None):
+        """Dump component options and inputs as yaml for WEIS replay
+        (reference omdao_raft.py:362-386 DEBUG_OMDAO block: writes
+        weis_options.yaml / weis_inputs.yaml into tests/test_data).
+        ``out_dir`` defaults to $RAFT_TPU_DEBUG_OMDAO if it names a
+        directory, else the cwd."""
+        import yaml as _yaml
+
+        env = os.environ.get("RAFT_TPU_DEBUG_OMDAO", "")
+        if out_dir is None:
+            out_dir = env if os.path.isdir(env) else "."
+        opts = {k: copy.deepcopy(self.options[k])
+                for k in ("modeling_options", "turbine_options",
+                          "mooring_options", "member_options",
+                          "analysis_options") if k in self.options}
+        gen = opts.get("analysis_options", {}).get("general")
+        if gen and "folder_output" in gen:
+            gen["folder_output"] = os.path.split(gen["folder_output"])[-1]
+
+        def _plain(v):
+            if isinstance(v, dict):
+                return {k: _plain(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [_plain(x) for x in v]
+            if isinstance(v, np.ndarray):
+                return v.tolist()
+            if isinstance(v, (np.floating, np.integer)):
+                return v.item()
+            return v
+
+        with open(os.path.join(out_dir, "weis_options.yaml"), "w") as f:
+            _yaml.safe_dump(_plain(opts), f, sort_keys=False)
+        try:
+            items = {name: meta["val"] for name, meta in
+                     self.list_inputs(out_stream=None)}
+        except Exception:        # shim component without openmdao
+            items = dict(inputs)
+        with open(os.path.join(out_dir, "weis_inputs.yaml"), "w") as f:
+            _yaml.safe_dump(_plain(items), f, sort_keys=False)
+
     def compute(self, inputs, outputs, discrete_inputs=None,
                 discrete_outputs=None):
         from raft_tpu.model import Model
 
         modeling_opt = self.options['modeling_options']
+
+        if DEBUG_OMDAO or os.environ.get("RAFT_TPU_DEBUG_OMDAO"):
+            self._debug_dump(inputs)
+
         design, case_mask = self.build_design(inputs, discrete_inputs)
 
         model = Model(design)
@@ -790,6 +841,7 @@ class RAFT_OMDAO_Standalone(_ShimComponent):
     _add_member_shape_inputs = RAFT_OMDAO._add_member_shape_inputs
     build_design = RAFT_OMDAO.build_design
     compute = RAFT_OMDAO.compute
+    _debug_dump = RAFT_OMDAO._debug_dump
 
 
 # ----------------------------------------------------------------------

@@ -185,3 +185,112 @@ def save_responses(model, out_path):
                     f.write("\n")
             written.append(path)
     return written
+
+
+_PSD_CHANNELS_EXT = [("surge", "surge", "m"),
+                     ("sway", "sway", "m"),
+                     ("heave", "heave", "m"),
+                     ("pitch", "pitch", "deg"),
+                     ("roll", "roll", "deg"),
+                     ("yaw", "yaw", "deg"),
+                     ("AxRNA", "nac. acc.", "m/s^2"),
+                     ("Mbase", "twr. bend", "N m"),
+                     ("wave", "wave elev.", "m")]
+
+
+def plot_responses_extended(model, cases=None, ifowt=0):
+    """All 9 response-channel PSDs per case (reference:
+    raft_model.py:1262-1306 plotResponses_extended: 6 motion DOFs,
+    nacelle acceleration, tower-base bending, wave spectrum).
+    Returns (fig, axes)."""
+    plt = _mpl()
+    metrics = model.results.get("case_metrics")
+    if not metrics:
+        raise RuntimeError("run analyzeCases before plotting responses")
+    if cases is None:
+        cases = sorted(k for k in metrics if isinstance(k, int))
+
+    fig, axes = plt.subplots(len(_PSD_CHANNELS_EXT), 1, sharex=True,
+                             figsize=(7, 1.6 * len(_PSD_CHANNELS_EXT)))
+    two_pi = 2.0 * np.pi
+    for ic in cases:
+        cm = metrics[ic][ifowt]
+        for ax, (key, label, unit) in zip(axes, _PSD_CHANNELS_EXT):
+            psd = np.squeeze(np.asarray(cm[f"{key}_PSD"]))
+            if psd.ndim > 1:
+                psd = psd[:, 0]
+            # reference plots Hz-based densities: S(f) = 2 pi S(w)
+            ax.plot(np.asarray(model.w) / two_pi, two_pi * psd,
+                    label=f"case {ic + 1}")
+            ax.set_ylabel(f"{label}\n[{unit}$^2$/Hz]")
+    axes[-1].set_xlabel("frequency [Hz]")
+    axes[-1].legend(fontsize=8)
+    fig.suptitle("power spectral densities")
+    return fig, axes
+
+
+def plot_rotor(rot, ax=None, r_ptfm=(0.0, 0.0, 0.0), azimuth=0.0,
+               color="k", draw_circle=False, plot2d=False,
+               Xuvec=(1, 0, 0), Yuvec=(0, 0, 1), R_ptfm=None):
+    """Blade wireframes for one rotor (reference: raft_rotor.py:1008-1122
+    Rotor.plot): generic airfoil sections along each blade, rotated by
+    precone, per-blade azimuth, and the shaft orientation, translated to
+    the hub; optional rotor-circumference circle.  Returns (fig, ax)."""
+    plt = _mpl()
+    from raft_tpu.ops.transforms import rotation_matrix as _rm
+
+    if ax is None:
+        fig = plt.figure(figsize=(7, 7))
+        ax = fig.add_subplot(111) if plot2d else \
+            fig.add_subplot(111, projection="3d")
+    else:
+        fig = ax.get_figure()
+
+    chord = np.asarray(rot.chord)
+    rr = np.asarray(rot.blade_r)
+    # the reference's generic airfoil section outline (raft_rotor.py:1041)
+    afx = np.array([0.0, -0.16, 0.0, 0.0])
+    afy = np.array([-0.25, 0.0, 0.75, -0.25])
+    P = np.concatenate([
+        np.stack([chord[i] * afx, chord[i] * afy,
+                  np.full_like(afx, rr[i])]) for i in range(len(rr))],
+        axis=1)                                       # (3, m*npts)
+
+    R_precone = np.asarray(_rm(0.0, -np.deg2rad(rot.precone), 0.0))
+    R_q = np.asarray(rotor_orientation(rot, R_ptfm))
+    r_hub = np.asarray(r_ptfm, float) + np.asarray(rot.r_rel, float) \
+        + R_q @ np.array([rot.overhang, 0.0, 0.0])
+    Xu, Yu = np.asarray(Xuvec, float), np.asarray(Yuvec, float)
+
+    for ib in range(rot.nBlades):
+        R_az = np.asarray(_rm(azimuth + 2 * np.pi * ib / rot.nBlades,
+                              0.0, 0.0))
+        P2 = R_q @ R_az @ R_precone @ P + r_hub[:, None]
+        if plot2d:
+            ax.plot(Xu @ P2, Yu @ P2, color=color, lw=0.6)
+        else:
+            ax.plot(P2[0], P2[1], P2[2], color=color, lw=0.6)
+
+    if draw_circle:
+        th = np.linspace(0, 2 * np.pi, 90)
+        C = R_q @ np.stack([np.zeros_like(th), rot.R_rot * np.cos(th),
+                            rot.R_rot * np.sin(th)]) + r_hub[:, None]
+        if plot2d:
+            ax.plot(Xu @ C, Yu @ C, color=color, lw=0.5, ls="--")
+        else:
+            ax.plot(C[0], C[1], C[2], color=color, lw=0.5, ls="--")
+    return fig, ax
+
+
+def rotor_orientation(rot, R_ptfm=None):
+    """Shaft orientation matrix at zero yaw for plotting (the reference
+    uses the stored ccblade R_q; here it is rebuilt from shaft tilt/toe
+    and the optional platform rotation, rotor_pose conventions)."""
+    from raft_tpu.models.rotor import rotor_pose
+
+    r6 = np.zeros(6)
+    pose = rotor_pose(rot, r6)
+    R_q = np.asarray(pose["R_q"])
+    if R_ptfm is not None:
+        R_q = np.asarray(R_ptfm) @ R_q
+    return R_q

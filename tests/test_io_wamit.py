@@ -182,3 +182,29 @@ def test_oc4semi_potflow_end_to_end():
     m2.solveStatics(case)
     Xi2 = m2.solveDynamics(case)
     assert not np.allclose(np.abs(Xi), np.abs(Xi2), rtol=1e-3)
+
+
+def test_read_wamit_omega_convention():
+    """The reference's pyHAMS Wamit_format output stores rad/s ASCENDING
+    in column 1 (HAMS Output_frequency_type 3; see
+    raft/data/cylinder/Input/ControlFile.in) while true WAMIT files store
+    periods descending.  The readers must auto-detect both — misreading
+    the Buoy files as periods warps the whole frequency axis (heave
+    excitation then GROWS with frequency, round-4 find)."""
+    buoy = "/root/reference/raft/data/cylinder/Output/Wamit_format/Buoy"
+    if not os.path.isfile(buoy + ".1"):
+        pytest.skip("reference pyHAMS cylinder data not available")
+    from raft_tpu.io.wamit import read_wamit1, read_wamit3
+
+    d1 = read_wamit1(buoy + ".1")
+    assert d1["w"][0] == pytest.approx(0.2) and d1["w"][-1] == pytest.approx(6.0)
+    d3 = read_wamit3(buoy + ".3")
+    X3 = np.abs(d3["X"][0, 2, :])
+    assert X3[0] == pytest.approx(0.3824, rel=1e-3)   # long-wave pi R^2
+    assert X3[-1] < 0.05 * X3[0]                      # decays with freq
+    # the period convention still reads the true WAMIT file unchanged
+    d1m = read_wamit1(HYDRO + ".1")
+    assert d1m["w"][0] < 0.02 and d1m["w"][-1] > 4.9
+    # explicit override beats detection
+    d1f = read_wamit1(buoy + ".1", freq="omega")
+    assert np.allclose(d1f["w"], d1["w"])

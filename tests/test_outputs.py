@@ -226,3 +226,81 @@ def test_convert_iea_turbine_yaml(tmp_path):
     bad["airfoils"][0]["polars"][0]["c_d"]["grid"] = [-3.0, 0.0, 3.0]
     with pytest.raises(ValueError):
         convert_iea_turbine_yaml(bad)
+
+
+def test_plot_responses_extended(small_model, tmp_path):
+    """9-channel PSD figure (reference raft_model.py:1262-1306)."""
+    from raft_tpu.plot import plot_responses_extended
+
+    fig, axes = plot_responses_extended(small_model)
+    assert len(axes) == 9
+    fig.savefig(tmp_path / "psd_ext.png")
+    assert (tmp_path / "psd_ext.png").stat().st_size > 1000
+    import matplotlib.pyplot as plt
+    plt.close("all")
+
+
+def test_plot_rotor(small_model, tmp_path):
+    """Blade wireframe plot (reference raft_rotor.py:1008-1122)."""
+    from raft_tpu.plot import plot_rotor
+
+    rot = small_model.fowtList[0].rotors[0]
+    fig, ax = plot_rotor(rot, draw_circle=True)
+    fig.savefig(tmp_path / "rotor3d.png")
+    fig2, ax2 = plot_rotor(rot, plot2d=True)
+    fig2.savefig(tmp_path / "rotor2d.png")
+    assert (tmp_path / "rotor3d.png").stat().st_size > 1000
+    # the wireframe spans roughly the rotor diameter in z
+    zlo, zhi = ax.get_zlim()
+    assert zhi - zlo > rot.R_rot
+    import matplotlib.pyplot as plt
+    plt.close("all")
+
+
+def test_adjust_wisdem(small_model, tmp_path):
+    """adjustWISDEM ballast-volume update (reference
+    raft_model.py:1627-1672) on a synthetic WISDEM geometry dict matching
+    the model's first ballasted member."""
+    import yaml as _yaml
+
+    fowt = small_model.fowtList[0]
+    m = next(mm for mm in fowt.members
+             if float(np.atleast_1d(mm.l_fill)[0]) > 0)
+    d0 = float(np.atleast_1d(m.d)[0])
+    wis = dict(components=dict(floating_platform=dict(
+        joints=[dict(name="j1", location=[0.0, 0.0,
+                                          float(np.asarray(m.rA0)[2])])],
+        members=[dict(name="col", joint1="j1", joint2="j2",
+                      outer_shape=dict(outer_diameter=dict(values=[d0])),
+                      internal_structure=dict(ballasts=[
+                          dict(volume=1.0)]))])))
+    old = tmp_path / "wis_old.yaml"
+    new = tmp_path / "wis_new.yaml"
+    _yaml.safe_dump(wis, open(old, "w"))
+    small_model.adjustWISDEM(str(old), str(new))
+    out = _yaml.safe_load(open(new))
+    vol = out["components"]["floating_platform"]["members"][0][
+        "internal_structure"]["ballasts"][0]["volume"]
+    t0 = float(np.atleast_1d(m.t)[0])
+    lf = float(np.atleast_1d(m.l_fill)[0])
+    assert vol == pytest.approx(np.pi * ((d0 - 2 * t0) / 2) ** 2 * lf)
+
+
+def test_debug_omdao_dump(tmp_path, monkeypatch):
+    """RAFT_TPU_DEBUG_OMDAO dumps weis_options/weis_inputs yaml
+    (reference omdao_raft.py:362-386 DEBUG_OMDAO)."""
+    import yaml as _yaml
+
+    from test_omdao import _oc3_design
+    from raft_tpu.omdao import RAFT_OMDAO_Standalone, omdao_from_design
+
+    design = _oc3_design()
+    design["settings"]["max_freq"] = 0.10   # keep the replay cheap
+    options, inputs, discrete_inputs = omdao_from_design(design)
+    comp = RAFT_OMDAO_Standalone(**options)
+    monkeypatch.setenv("RAFT_TPU_DEBUG_OMDAO", str(tmp_path))
+    comp.run(inputs, discrete_inputs)
+    opts = _yaml.safe_load(open(tmp_path / "weis_options.yaml"))
+    assert "modeling_options" in opts and "turbine_options" in opts
+    inp = _yaml.safe_load(open(tmp_path / "weis_inputs.yaml"))
+    assert len(inp) > 10

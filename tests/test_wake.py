@@ -103,7 +103,7 @@ def test_floris_turbine_dict(pseudo_farm):
     td = floris_turbine_dict(farm, 0, template, uhubs=uhubs)
     rot = farm.fowtList[0].rotors[0]
     assert td["rotor_diameter"] == pytest.approx(2 * rot.R_rot)
-    assert td["hub_height"] == pytest.approx(rot.r_rel[2])
+    assert td["hub_height"] == pytest.approx(rot.hubHt)
     assert td["floating_correct_cp_ct_for_tilt"] is False
     assert td["TSR"] == 9.0                       # template carried over
     ptt = td["power_thrust_table"]

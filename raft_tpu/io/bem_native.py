@@ -113,7 +113,7 @@ def solve_radiation_diffraction(mesh, omegas, betas_deg, rho=1025.0,
 
 
 def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
-                   mesh_dir=None, max_freqs=48):
+                   mesh_dir=None, max_freqs=48, dw_bem=None):
     """Mesh a FOWT's potMod members, run the native BEM core, and return a
     `BEMData` on the model frequency grid — the in-process replacement for
     the reference's calcBEM/pyHAMS round trip (reference:
@@ -157,7 +157,9 @@ def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
         # must not reload coefficients solved on a different grid
         h.update(np.asarray(w_bem if w_bem is not None else [], float)
                  .tobytes())
-        h.update(np.array([max_freqs], float).tobytes())
+        h.update(np.array([max_freqs,
+                           -1.0 if dw_bem is None else float(dw_bem)],
+                          float).tobytes())
         h.update(headings.tobytes())
         h.update(np.array([rho, g, fowt.depth, mesh.nbody]).tobytes())
         # physics-version token: cached coefficients solved by an older
@@ -172,11 +174,18 @@ def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
                                    fowt.w, rho=rho, g=g)
 
     if w_bem is None:
-        dw = float(fowt.w[0]) if len(fowt.w) < 2 else float(fowt.w[1] - fowt.w[0])
+        # BEM grid: ``dw_bem`` (the reference's min_freq_BEM step,
+        # raft_fowt.py:121-122) or the decimated model grid; either way
+        # the max_freqs cost cap applies
+        if dw_bem is not None:
+            dw = float(dw_bem)
+        else:
+            dw = float(fowt.w[0]) if len(fowt.w) < 2 \
+                else float(fowt.w[1] - fowt.w[0])
         w_bem = np.arange(dw, fowt.w[-1] + 0.5 * dw, dw)
         while len(w_bem) > max_freqs:
             w_bem = w_bem[::2]
-        if w_bem[-1] < fowt.w[-1]:
+        if len(w_bem) == 0 or w_bem[-1] < fowt.w[-1]:
             w_bem = np.r_[w_bem, fowt.w[-1]]
     w_bem = np.asarray(w_bem, float)
 

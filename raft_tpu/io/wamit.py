@@ -242,6 +242,21 @@ def load_bem(hydro_path: str, w_model, rho: float = 1025.0,
         raise FileNotFoundError(f"WAMIT file {hydro_path}.1 not found")
 
     w_model = np.asarray(w_model, float)
+    if freq == "auto":
+        # resolve the convention ONCE from the .1 and reuse it for the .3
+        # so the pair can never land on inconsistent axes; warn when the
+        # ambiguous case fires (a legal WAMIT run can list periods
+        # ascending — set platform: hydroFreqType to override)
+        with open(path + ".1") as f:
+            col1 = [float(ln.split()[0]) for ln in f if ln.split()]
+        freq = _detect_freq_convention(col1)
+        if freq == "omega":
+            import warnings
+            warnings.warn(
+                f"'{hydro_path}.1': column 1 ascends in file order — "
+                "reading as HAMS omega [rad/s] format.  If this is a "
+                "WAMIT period file with ascending PER input, set "
+                "platform: hydroFreqType: period.", stacklevel=2)
     d1 = read_wamit1(path + ".1", freq=freq)
     A0 = d1["A0"] if d1["A0"] is not None else d1["A"][:, :, 0]
     A_BEM = rho * _interp_freq(w_model, d1["w"], d1["A"], A0)

@@ -1339,7 +1339,7 @@ class Model:
         plat = wisdem["components"]["floating_platform"]
         joints = {j["name"]: j for j in plat["joints"]}
         for wm in plat["members"]:
-            if "ballasts" not in wm.get("internal_structure", {}):
+            if not wm.get("internal_structure", {}).get("ballasts"):
                 continue
             joint = joints.get(wm.get("joint1"))
             if joint is None:

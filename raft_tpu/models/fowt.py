@@ -285,14 +285,10 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
         da_BEM = float(get_from_dict(platform, "da_BEM", default=2.0))
         # the reference's BEM grid control: min_freq_BEM [Hz] is both the
         # lowest BEM frequency and the grid step (raft_fowt.py:121-122);
-        # the coefficients are interpolated onto the model grid afterward
+        # grid construction (and its max_freqs cost cap) lives in
+        # solve_bem_fowt
         mf_bem = get_from_dict(platform, "min_freq_BEM", default=0.0)
-        w_bem = None
-        if mf_bem:
-            dw_bem = 2.0 * np.pi * float(mf_bem)
-            w_bem = np.arange(dw_bem, w[-1] + 0.5 * dw_bem, dw_bem)
-            if w_bem[-1] < w[-1]:
-                w_bem = np.r_[w_bem, w[-1]]
+        dw_bem = 2.0 * np.pi * float(mf_bem) if mf_bem else None
         _stub = FOWTModel(
             members=members, member_types=member_types,
             member_names=member_names, rotors=[], mooring=None, nodes=nodes,
@@ -302,7 +298,7 @@ def build_fowt(design: dict, w, depth=600.0, x_ref=0.0, y_ref=0.0,
             heading_adjust=float(heading_adjust), nplatmems=nplatmems,
             ntowers=ntowers, potModMaster=potModMaster)
         bem = bem_native.solve_bem_fowt(
-            _stub, dz=dz_BEM, da=da_BEM, w_bem=w_bem,
+            _stub, dz=dz_BEM, da=da_BEM, dw_bem=dw_bem,
             mesh_dir=platform.get("meshDir"))
 
     return FOWTModel(

@@ -338,14 +338,27 @@ def line_forces(sys_: MooringSystem, r6, current=None):
     f_drag = chord_drag_per_length(dr, U, sys_.d_vol, sys_.Cd_t,
                                    sys_.Cd_a, sys_.rho)   # (nl,3) N/m
     w_vec = f_drag + w[:, None] * jnp.array([0.0, 0.0, -1.0])
-    w_eff = _safe_norm(w_vec)                        # (nl,)
-    zt = -w_vec / w_eff[:, None]                     # tilted "up"
+    # the tilted-plane construction assumes the effective weight points
+    # broadly DOWN; net-buoyant lines (w <= 0, e.g. the FOCTT model-scale
+    # chain at -483 N/m) would get a flipped frame and lose the signed-
+    # weight catenary semantics — they stay on the plain vertical-plane
+    # solve (current tilt unsupported for buoyant lines, documented)
+    sinking = (w > 0.0)
+    w_eff = jnp.where(sinking, _safe_norm(w_vec), w)   # (nl,) signed
+    zt = jnp.where(sinking[:, None],
+                   -w_vec / _safe_norm(w_vec)[:, None],
+                   jnp.array([0.0, 0.0, 1.0]))         # tilted "up"
     ZF = jnp.sum(dr * zt, axis=1)
     xvec = dr - ZF[:, None] * zt
     XF = _safe_norm(xvec)
     xt = xvec / jnp.where(XF > 0, XF, 1.0)[:, None]
     sol = catenary_solve(XF, ZF, L, EA, w_eff)
     F = -sol["H"][:, None] * xt - sol["V"][:, None] * zt
+    # buoyant lines solve in the plain frame (no drag in the profile);
+    # their current drag still loads the body as the lumped half-line
+    # wrench (same doctrine as current_wrenches on the general path)
+    F = F + jnp.where(sinking[:, None], 0.0,
+                      0.5 * L[:, None] * f_drag)
     return F, rF, sol
 
 

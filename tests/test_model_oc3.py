@@ -65,8 +65,17 @@ def test_operating_case_parity(model_and_truth):
     for ch in ("surge", "heave", "roll", "pitch", "sway"):
         assert_allclose(ours[f"{ch}_avg"], ref[f"{ch}_avg"], rtol=1e-3,
                         err_msg=f"{ch}_avg")
-    for ch, tol in [("surge", 0.015), ("sway", 0.008), ("heave", 0.002),
-                    ("roll", 0.005), ("pitch", 0.025), ("yaw", 0.007)]:
+    # round-4 forensics (ROUND4_NOTES): the residual band is confined to
+    # the WAVE band (wind band matches to fp noise), peaks at the
+    # spectral peak (+7% pitch PSD at w~=0.50) with a sign flip at the
+    # w~=0.44 excitation notch, and appears ONLY with the operating
+    # turbine + current (parked case 0 matches at ~1e-6).  Knob
+    # isolation: equilibrium-pose excitation, equilibrium C_moor, and
+    # the aero tensors are each 10-20x movers and our choices are
+    # structurally right; the residual is a fine-scale difference in
+    # one of those pieces, unresolved this round.
+    for ch, tol in [("surge", 0.012), ("sway", 0.008), ("heave", 0.002),
+                    ("roll", 0.005), ("pitch", 0.018), ("yaw", 0.007)]:
         assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=tol,
                         err_msg=f"{ch}_std")
     # mean yaw (measured 1e-5 relative; 6.77 deg magnitude)
@@ -84,8 +93,13 @@ def test_operating_case_parity(model_and_truth):
     assert_allclose(ours["Mbase_std"], ref["Mbase_std"], rtol=1.5e-2,
                     err_msg="Mbase_std")
     assert_allclose(ours["Mbase_avg"], ref["Mbase_avg"], rtol=1e-4)
+    # loaded-case tension stds track the Xi wave-band residual through
+    # J@Xi (measured rel [2.0%, 2.5%, 3.0%]; J itself matches at 3e-4 in
+    # the current-free case) — NOT a missing current-loaded FD Jacobian
+    # as round 3 hypothesized: no reference yaml sets mooring/currentMod,
+    # so the pickles saw no line current at all (see docs/quirks.md #16)
     assert_allclose(ours["Tmoor_avg"], ref["Tmoor_avg"], rtol=1e-3)
-    assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=5e-2)
+    assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=3.5e-2)
 
 
 def test_statics_wave_and_current():

@@ -219,3 +219,36 @@ def test_current_drag_direction_and_magnitude():
     assert total_drag_x > 0
     # the body receives a positive-x share of the drag, bounded by the total
     assert 0.05 * total_drag_x < dW[0] < 1.05 * total_drag_x
+
+
+def test_current_path_buoyant_line_keeps_signed_weight():
+    """Net-buoyant lines (FOCTT model-scale chain: w=-483 N/m) must stay
+    on the plain signed-weight catenary even when a current is passed —
+    the tilted frame is only valid for sinking lines (round-4 regression:
+    the unconditional tilt flipped the frame and diverged FOCTT statics)."""
+    sys_ = mr.MooringSystem(
+        depth=50.0,
+        rAnchor=np.array([[40.0, 0.0, -50.0]]),
+        rFair0=np.array([[1.0, 0.0, -2.0]]),
+        L=np.array([65.0]), EA=np.array([1.0e7]),
+        w=np.array([-483.0]),                      # buoyant
+        d_vol=np.array([0.333]), m_lin=np.array([40.0]),
+        Cd_t=np.array([1.1]), Cd_a=np.array([0.2]),
+    )
+    r6 = np.zeros(6)
+    F0, rF, s0 = mr.line_forces(sys_, r6)
+    U = np.array([1.0, 0.0, 0.0])
+    Fc, _, sc = mr.line_forces(sys_, r6, current=U)
+    # profile/tensions keep the signed-weight solve exactly...
+    assert_allclose(np.asarray(sc["TB"]), np.asarray(s0["TB"]), rtol=1e-9)
+    # ...while the drag still loads the body as the lumped half-line
+    # wrench (general-path doctrine)
+    from raft_tpu.models.mooring_array import chord_drag_per_length
+    f = np.asarray(chord_drag_per_length(np.asarray(rF) - sys_.rAnchor, U,
+                                         sys_.d_vol, sys_.Cd_t, sys_.Cd_a,
+                                         sys_.rho))
+    assert_allclose(np.asarray(Fc), np.asarray(F0) + 0.5 * sys_.L[:, None] * f,
+                    rtol=1e-9, atol=1e-6)
+    # zero current still reduces exactly
+    Fz, _, _ = mr.line_forces(sys_, r6, current=np.zeros(3))
+    assert_allclose(np.asarray(Fz), np.asarray(F0), rtol=1e-12, atol=1e-9)

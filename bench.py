@@ -136,21 +136,144 @@ def _thetas(design, base, nv, seed=7):
     return {k: np.asarray(v)[idx] for k, v in thetas.items()}
 
 
-def main():
+def _want_tpu():
+    """True when this process is expected to land on the TPU backend."""
+    if os.environ.get("RAFT_BENCH_FORCE_CPU") == "1":
+        return False
+    return os.environ.get("JAX_PLATFORMS", "") not in ("cpu",)
+
+
+def _tpu_probe(timeout_s=None, retries=None, backoff_s=None):
+    """Probe TPU backend init in a SUBPROCESS with a hard timeout.
+
+    The axon tunnel has a documented failure mode where a stale remote
+    claim makes every in-process backend init hang forever inside
+    make_c_api_client (ROUND4_NOTES.md) — so the probe must run
+    out-of-process where a hang is boundable.  Retries with backoff
+    because the remote lease can expire between attempts.  Returns
+    (ok: bool, info: dict)."""
+    import subprocess
+    import sys
+
+    timeout_s = timeout_s or int(os.environ.get("RAFT_BENCH_PROBE_TIMEOUT", 240))
+    retries = retries or int(os.environ.get("RAFT_BENCH_PROBE_RETRIES", 3))
+    backoff_s = backoff_s or int(os.environ.get("RAFT_BENCH_PROBE_BACKOFF", 90))
+    code = ("import jax, jax.numpy as jnp;"
+            "d = jax.devices();"
+            "y = (jnp.ones((128,128)) @ jnp.ones((128,128)))"
+            ".block_until_ready();"
+            "print('PROBE_OK', jax.default_backend(), len(d))")
+    attempts = []
+    for i in range(retries):
+        if i:
+            time.sleep(backoff_s)
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+            if r.returncode == 0 and "PROBE_OK" in r.stdout:
+                line = next(ln for ln in r.stdout.splitlines()
+                            if "PROBE_OK" in ln)
+                # a silent CPU fallback must NOT pass as a hardware
+                # probe: the published number would be a CPU timing
+                if line.split()[1] == "cpu":
+                    attempts.append("cpu-fallback: " + line)
+                    continue
+                return True, {"attempts": attempts + ["ok"], "probe": line}
+            attempts.append("error: " + (r.stderr.strip().splitlines()[-1]
+                                         if r.stderr.strip() else
+                                         f"rc={r.returncode}"))
+        except subprocess.TimeoutExpired:
+            attempts.append(f"hang: no backend after {timeout_s}s "
+                            "(stale-claim tunnel wedge?)")
+    return False, {"attempts": attempts}
+
+
+def _emit_tpu_unavailable(info):
+    """Structured bench result when the TPU backend cannot initialize:
+    diagnosable JSON (not a traceback) + the CPU-mode f32-vs-f64
+    accuracy gate so the round still records a correctness signal."""
+    import subprocess
+    import sys
+
+    gate = None
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu", RAFT_TPU_X64="0",
+                   RAFT_BENCH_GATE_ONLY="1", PALLAS_AXON_POOL_IPS="")
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=3600)
+        if r.returncode in (0, 1) and r.stdout.strip():
+            gate = json.loads(r.stdout.strip().splitlines()[-1])
+        else:
+            gate = {"error": r.stderr.strip().splitlines()[-1]
+                    if r.stderr.strip() else f"rc={r.returncode}"}
+    except Exception as e:                            # pragma: no cover
+        gate = {"error": f"{type(e).__name__}: {e}"}
+    result = {
+        "metric": "design-variants/hour/chip (TPU backend unavailable — "
+                  "no hardware number this run)",
+        "value": 0.0,
+        "unit": "variants/h/chip",
+        "vs_baseline": 0.0,
+        "ok": False,
+        "reason": "tpu_unavailable",
+        "probe": info,
+        "cpu_accuracy_gate": gate,
+    }
+    print(json.dumps(result))
+    raise SystemExit(1)
+
+
+def _solver_setup(nv):
+    """Shared bench workload setup (design, base model, nv variant
+    thetas, jitted batched solver) — ONE definition so the TPU bench and
+    the CPU fallback gate always measure the same pipeline."""
     import jax
 
     from raft_tpu.parallel.variants import make_variant_solver
 
     design = _design()
     base = _base_fowt(design)
-    thetas = _thetas(design, base, NV)
+    thetas = _thetas(design, base, nv)
     F_env, A_turb, B_turb = _aero_constants(design, base)
-
     solver = make_variant_solver(base, Hs=6.0, Tp=12.0, ballast=True,
                                  F_env=F_env, A_turb=A_turb, B_turb=B_turb,
                                  nIter=NITER, tol=-1.0,  # full iterations
                                  newton_iters=10)
-    batched = jax.jit(solver.batched)
+    return design, base, thetas, jax.jit(solver.batched), A_turb, B_turb
+
+
+def _acc_ok(acc):
+    return (isinstance(acc, dict)
+            and acc["median"] <= ACC_MEDIAN_TOL
+            and acc["surge_max"] <= ACC_SURGE_TOL)
+
+
+def _gate_only():
+    """CPU-mode accuracy gate (f32 pipeline vs f64 subprocess truth) on
+    the fixed 16-variant batch; the fallback correctness record when the
+    TPU is unavailable.  Prints one JSON line."""
+    _, _, thetas, batched, _, _ = _solver_setup(16)
+    acc = _accuracy_gate(thetas, batched)
+    ok = _acc_ok(acc)
+    print(json.dumps({"device": "cpu", "rel_dev_f32_vs_f64": acc,
+                      "ok": ok}))
+    if not ok:
+        raise SystemExit(1)
+
+
+def main():
+    import jax
+
+    if os.environ.get("RAFT_BENCH_GATE_ONLY") == "1":
+        return _gate_only()
+    if _want_tpu():
+        ok, info = _tpu_probe()
+        if not ok:
+            return _emit_tpu_unavailable(info)
+
+    design, base, thetas, batched, A_turb, B_turb = _solver_setup(NV)
 
     out = batched(thetas)   # compile + warmup
     jax.block_until_ready(out["std"])
@@ -172,9 +295,10 @@ def main():
     qtf = _qtf_metric()
 
     dev = jax.devices()[0]
-    acc_ok = (isinstance(acc, dict)
-              and acc["median"] <= ACC_MEDIAN_TOL
-              and acc["surge_max"] <= ACC_SURGE_TOL)
+    acc_ok = _acc_ok(acc)
+    # a QTF-kernel regression must be visible at the JSON level, not
+    # buried in an error string (VERDICT r4 weak #5)
+    qtf_ok = isinstance(qtf, dict)
     result = {
         "metric": f"design-variants/hour/chip ({NW}-bin VolturnUS-S variant "
                   f"pipeline incl. frozen aero added-mass/damping/gyro + "
@@ -188,10 +312,11 @@ def main():
         "accuracy_gate": {"median_tol": ACC_MEDIAN_TOL,
                           "surge_max_tol": ACC_SURGE_TOL, "ok": acc_ok},
         "qtf_pairgrid": qtf,
-        "ok": acc_ok,
+        "qtf_ok": qtf_ok,
+        "ok": acc_ok and qtf_ok,
     }
     print(json.dumps(result))
-    if not acc_ok:
+    if not result["ok"]:
         raise SystemExit(1)   # a fast-but-wrong number is not a result
 
 
@@ -209,7 +334,8 @@ def _qtf_metric():
     (all Pinkster terms; Kim&Yue + Hermitian completion excluded — they
     are O(nw2) and O(nw2^2) elementwise postprocessing) at 3 distinct
     headings (the axon tunnel memoizes identical executions).  Returns a
-    dict for the bench JSON or an error string (never fails the bench)."""
+    dict for the bench JSON, or an error string — which main() surfaces
+    as qtf_ok=false and a failed bench."""
     import contextlib
 
     import jax

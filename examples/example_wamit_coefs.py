@@ -1,0 +1,59 @@
+"""Run raft_tpu with externally supplied potential-flow coefficients.
+
+Mirror of the reference's examples/example-WAMIT_Coefs.py (OC4 semi with
+WAMIT-format hydrodynamic data, potModMaster=1 + hydroPath).  Two paths:
+
+* If the reference's marin_semi WAMIT files are available (pass a path,
+  or the default below exists), the model loads added mass / damping
+  from the `.1` file — the reference's shipped configuration
+  (`/root/reference/examples/OC4semi-WAMIT_Coefs.yaml:1068-1069`).
+* Otherwise it falls back to this framework's native C++ BEM solver
+  (potModMaster=2): same pipeline, coefficients solved from the member
+  geometry instead of read from files (cached in ``mesh_dir``).
+
+Both `.1`-style period files and HAMS omega-format files are read
+(auto-detected; override with ``platform: hydroFreqType``).
+"""
+import os
+import sys
+
+from raft_tpu.io.designs import load_design
+from raft_tpu.model import Model
+
+DEFAULT_WAMIT = "/root/reference/examples/OC4semi-WAMIT_Coefs/marin_semi"
+
+
+def run_example(wamit_path=DEFAULT_WAMIT, plot_flag=False):
+    design = load_design("OC4semi")
+
+    if wamit_path and os.path.isfile(wamit_path + ".1"):
+        # WAMIT-format coefficients from files (reference configuration:
+        # potFirstOrder reuses the same loader, raft_fowt.py:640-655)
+        design["platform"]["potModMaster"] = 1
+        design["platform"]["potFirstOrder"] = 1
+        design["platform"]["hydroPath"] = wamit_path
+        print(f"using WAMIT coefficients from {wamit_path}.1")
+    else:
+        # no files: solve the coefficients with the native BEM instead
+        design["platform"]["potModMaster"] = 2
+        print("WAMIT files not found - solving with the native BEM "
+              "(potModMaster=2); pass a hydro path to use files")
+
+    model = Model(design)
+    model.analyzeUnloaded()
+    model.analyzeCases(display=1)
+
+    case0 = model.results["case_metrics"][0][0]
+    print(f"case 0: surge_std={float(case0['surge_std']):.3f} m, "
+          f"heave_std={float(case0['heave_std']):.3f} m")
+
+    if plot_flag:
+        import matplotlib.pyplot as plt
+        model.plotResponses()
+        plt.show()
+    return model
+
+
+if __name__ == "__main__":
+    path = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_WAMIT
+    run_example(wamit_path=path, plot_flag=False)

@@ -172,6 +172,17 @@ def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
                 and open(key_path).read().strip() == key):
             return _wamit.load_bem(_os.path.join(mesh_dir, "Output"),
                                    fowt.w, rho=rho, g=g)
+        if _os.path.isfile(key_path):
+            # a stale key means geometry/grid/solver-version changed —
+            # including key-scheme upgrades, which invalidate every older
+            # cache; say so instead of silently re-solving everything
+            # (warn, not print: stdout stays machine-parseable for the
+            # bench's one-JSON-line contract)
+            import warnings
+            warnings.warn(
+                f"raft_tpu bem: cache key changed in '{mesh_dir}' "
+                "(geometry, BEM grid, or solver/key version) — "
+                "re-solving and refreshing the cache")
 
     if w_bem is None:
         # BEM grid: ``dw_bem`` (the reference's min_freq_BEM step,

@@ -24,6 +24,7 @@ X = rho*g*(Re + i*Im).
 from __future__ import annotations
 
 import os
+import warnings
 from dataclasses import dataclass
 from typing import Optional
 
@@ -59,7 +60,15 @@ def _detect_freq_convention(col1_in_file_order):
         if v > 0 and v not in seen:       # multi-heading/multi-ij files
             seen.add(v)                   # repeat col-1 within a block
             vals.append(v)
-    if len(vals) >= 2 and all(b > a for a, b in zip(vals, vals[1:])):
+    if len(vals) < 2:
+        warnings.warn(
+            "WAMIT/HAMS file has fewer than 2 unique positive column-1 "
+            "values — the period-vs-omega convention cannot be detected "
+            "from ordering; assuming WAMIT periods.  A single-frequency "
+            "HAMS omega-format file would be misread (frequency axis "
+            "warped): pass freq='omega' or set platform hydroFreqType.")
+        return "period"
+    if all(b > a for a, b in zip(vals, vals[1:])):
         return "omega"
     return "period"
 
@@ -67,10 +76,11 @@ def _detect_freq_convention(col1_in_file_order):
 def read_wamit1(path, freq="auto"):
     """Parse a WAMIT `.1` added-mass/damping file.
 
-    ``freq``: 'period' (WAMIT: column 1 is the wave period; PER<0 rows are
-    zero-frequency, PER=0 infinite-frequency), 'omega' (HAMS Wamit_format:
-    column 1 is rad/s ascending; 0 rows zero-frequency, negative rows
-    infinite-frequency), or 'auto' (detect from the file ordering).
+    ``freq``: 'period' (WAMIT: column 1 is the wave period), 'omega'
+    (HAMS Wamit_format: column 1 is rad/s ascending), or 'auto' (detect
+    from the file ordering).  4-column special rows are ALWAYS periods
+    per the WAMIT convention regardless of ``freq`` (PER<0 rows are
+    zero-frequency, PER=0 infinite-frequency — raft_fowt.py:644-646).
 
     Returns dict(w (nf,) ascending rad/s, A (6,6,nf), B (6,6,nf),
     A0 (6,6) zero-frequency added mass or None, Ainf (6,6) or None).
@@ -96,10 +106,12 @@ def read_wamit1(path, freq="auto"):
         freq = _detect_freq_convention(order)
     zero, inf = {}, {}
     for T, i, j, v in special:
-        if freq == "omega":
-            (zero if T == 0 else inf)[(i, j)] = v
-        else:
-            (zero if T < 0 else inf)[(i, j)] = v
+        # special rows are ALWAYS periods per the WAMIT convention
+        # (PER < 0 = zero frequency, PER = 0 = infinite frequency; quoted
+        # verbatim by the reference at raft_fowt.py:644-646 and relied on
+        # by pyhams' TFlag read-back) — irrespective of whether the
+        # finite-frequency rows carry periods or rad/s
+        (zero if T < 0 else inf)[(i, j)] = v
 
     if freq == "omega":
         omegas = sorted({r[0] for r in rows})
@@ -251,7 +263,6 @@ def load_bem(hydro_path: str, w_model, rho: float = 1025.0,
             col1 = [float(ln.split()[0]) for ln in f if ln.split()]
         freq = _detect_freq_convention(col1)
         if freq == "omega":
-            import warnings
             warnings.warn(
                 f"'{hydro_path}.1': column 1 ascends in file order — "
                 "reading as HAMS omega [rad/s] format.  If this is a "

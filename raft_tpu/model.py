@@ -374,7 +374,10 @@ class Model:
             Xb = X.reshape(N, 6)
             xf = ma.solve_free_points(arr, Xb, xf0=xf_arg)
             self._arr_xf = np.asarray(xf)
-            self._K_array = np.asarray(ma.coupled_stiffness(arr, Xb, xf))
+            # rotation-vector flavor for the same reason as the
+            # single-body dynamics C_moor below (MoorPy analytic parity)
+            self._K_array = np.asarray(
+                ma.coupled_stiffness_rotvec(arr, Xb, xf))
         else:
             self._arr_xf = None
             self._K_array = None
@@ -392,13 +395,20 @@ class Model:
             # pose, current-case heading, stale-heading hub transfer
             # (state["turbine"]).
             if fowt.mooring is not None:
-                # analytic/AD stiffness at the equilibrium pose — the
-                # reference's dynamics C_moor is getCoupledStiffnessA from
-                # setPosition (raft_fowt.py:287); only the TENSION
-                # statistics use the FD getCoupledStiffness variant
+                # MoorPy-parity analytic stiffness at the equilibrium pose
+                # — the reference's dynamics/eigen C_moor is
+                # getCoupledStiffnessA from setPosition (raft_fowt.py:287),
+                # whose Taylor-series assembly is the ROTATION-VECTOR
+                # linearization, not the Euler-angle jacobian.  At loaded
+                # poses (several degrees mean pitch/yaw) the two differ by
+                # the Euler-rate factor on the roll/pitch columns — the
+                # round-4 operating-case wave-band residual (0.3-1.8% stds)
+                # closed to ~1e-5 when this switched to rotvec (round 5).
+                # Only the TENSION statistics use the FD variant.
                 cur = state.get("moor_current")
                 state["C_moor"] = np.asarray(
-                    mr.coupled_stiffness(fowt.mooring, X[s], current=cur))
+                    mr.coupled_stiffness_rotvec(fowt.mooring, X[s],
+                                                current=cur))
                 state["F_moor0"] = np.asarray(
                     mr.body_wrench(fowt.mooring, X[s], current=cur))
             else:

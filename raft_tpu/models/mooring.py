@@ -304,7 +304,7 @@ def _safe_norm(x, axis=-1):
     return jnp.sqrt(jnp.sum(x * x, axis=axis) + 1e-30)
 
 
-def line_forces(sys_: MooringSystem, r6, current=None):
+def line_forces(sys_: MooringSystem, r6, current=None, rF=None):
     """Per-line force on the body at each fairlead, (nl,3) global, plus the
     solve products (tensions).
 
@@ -315,8 +315,13 @@ def line_forces(sys_: MooringSystem, r6, current=None):
     passes case currents to MoorPy, raft_model.py:559-578, and its
     tension statistics FD re-equilibrates the current-loaded lines at
     every perturbed pose).  The catenary itself is unchanged; only the
-    solve plane tilts and the weight becomes |w_vec|."""
-    rF = fairlead_positions(sys_, r6)
+    solve plane tilts and the weight becomes |w_vec|.
+
+    ``rF`` overrides the fairlead positions (used by the rotation-vector
+    stiffness linearization, which perturbs the orientation directly
+    rather than through the Euler angles in r6)."""
+    if rF is None:
+        rF = fairlead_positions(sys_, r6)
     rA = jnp.asarray(sys_.rAnchor)
     L = jnp.asarray(sys_.L)
     EA = jnp.asarray(sys_.EA)
@@ -398,10 +403,17 @@ def body_wrench(sys_, r6, xf=None, current=None):
 
 
 def coupled_stiffness(sys_, r6, xf=None, current=None):
-    """6x6 mooring stiffness -dF/dx about the body pose (equivalent of
-    getCoupledStiffnessA(lines_only=True)), by exact forward-mode autodiff
-    through the catenary Newton solve (free points eliminated by the
-    implicit-function theorem on the general path)."""
+    """6x6 mooring stiffness -dF/dx about the body pose as the exact
+    EULER-ANGLE jacobian of the wrench, by forward-mode autodiff through
+    the catenary Newton solve (free points eliminated by the
+    implicit-function theorem on the general path).
+
+    This is the consistent jacobian for Newton statics on the Euler pose
+    vector.  For the reference's dynamics/eigen C_moor
+    (getCoupledStiffnessA) use :func:`coupled_stiffness_rotvec` — MoorPy's
+    analytic assembly is the ROTATION-VECTOR linearization, which differs
+    from this jacobian at loaded poses (the two coincide at zero
+    angles)."""
     if _is_general(sys_):
         from raft_tpu.models import mooring_array as ma
         Xb = jnp.asarray(r6, float)[None, :]
@@ -410,6 +422,48 @@ def coupled_stiffness(sys_, r6, xf=None, current=None):
         return ma.coupled_stiffness(sys_, Xb, xf)
     return -jax.jacfwd(lambda x: body_wrench(sys_, x, current=current))(
         jnp.asarray(r6, float))
+
+
+def coupled_stiffness_rotvec(sys_, r6, xf=None, current=None):
+    """MoorPy-parity ANALYTIC coupled stiffness: the exact ROTATION-VECTOR
+    linearization of the mooring wrench about the pose.
+
+    MoorPy's getCoupledStiffnessA (the reference's dynamics/eigen C_moor,
+    raft_fowt.py:287) assembles Body.getStiffnessA from a Taylor series in
+    an infinitesimal GLOBAL-AXIS rotation vector: dr_fairlead = dtheta x r
+    plus the geometric force term d(r x F).  That is the exact derivative
+    with respect to a rotation-vector perturbation of the CURRENT
+    orientation — NOT with respect to the Euler angles in r6.  At a loaded
+    equilibrium with nonzero mean pitch theta the two differ by the
+    Euler-rate matrix E(theta) (K_euler[:,3:] = K_rotvec[:,3:] @ E, with
+    E - I entries of order sin(theta) in the roll/pitch columns; the yaw
+    column is exact because Rz is the outermost rotation), which is
+    exactly the sub-1% rotational-coupling difference class isolated by
+    the round-4 operating-case forensics.  Implemented not by hand-porting
+    MoorPy's formulas but by autodiffing the same wrench under the
+    rotation-vector parameterization R(delta) @ R0 — identical to MoorPy's
+    series to first order, with no sign/term transcription risk."""
+    if _is_general(sys_):
+        from raft_tpu.models import mooring_array as ma
+        Xb = jnp.asarray(r6, float)[None, :]
+        if xf is None:
+            xf = ma.solve_free_points(sys_, Xb)
+        return ma.coupled_stiffness_rotvec(sys_, Xb, xf)
+    r6 = jnp.asarray(r6, float)
+    R0 = rotation_matrix(r6[3], r6[4], r6[5])
+    rfair_rel0 = jnp.asarray(sys_.rFair0) @ R0.T   # body->global, base pose
+
+    def wrench(delta):
+        # rotation_matrix's differential at the identity is the skew of
+        # the rotation vector for every Euler convention, so this is the
+        # exact rotation-vector derivative
+        dR = rotation_matrix(delta[3], delta[4], delta[5])
+        base = r6[:3] + delta[:3]
+        rF = base + rfair_rel0 @ dR.T
+        F, rFo, _ = line_forces(sys_, r6, current=current, rF=rF)
+        return jnp.sum(translate_force_3to6(F, rFo - base), axis=0)
+
+    return -jax.jacfwd(wrench)(jnp.zeros(6))
 
 
 def tensions(sys_, r6, xf=None, current=None):
@@ -456,12 +510,13 @@ def coupled_stiffness_fd(sys_, r6, dx=0.1, dth=0.1, tensions_too=False):
     its statics Newton AND the dynamics/eigen C_moor use the analytic
     getCoupledStiffnessA (raft_fowt.py:287 via setPosition — the
     model-level FD block at raft_model.py:798-850 is dead code inside a
-    TODO string).  So: keep `coupled_stiffness` (exact AD == analytic)
-    for statics/dynamics/eigen and use `tension_jacobian_fd` for Tmoor
-    stats.  The FD truncation error (notably the 0.1 rad rotational
-    step) is a few percent on rotation-coupled tension sensitivities at
-    loaded offsets, so the exact-AD Jacobian does NOT reproduce the
-    reference's Tmoor_std."""
+    TODO string).  So: `coupled_stiffness_rotvec` (MoorPy's analytic
+    flavor) for dynamics/eigen, `coupled_stiffness` (Euler AD) for the
+    statics Newton jacobian, and `tension_jacobian_fd` for Tmoor stats.
+    The FD truncation error (notably the 0.1 rad rotational step) is a
+    few percent on rotation-coupled tension sensitivities at loaded
+    offsets, so the exact-AD Jacobian does NOT reproduce the reference's
+    Tmoor_std."""
     r6 = np.asarray(r6, float)
     dX = np.array([dx, dx, dx, dth, dth, dth])
     K = np.zeros((6, 6))

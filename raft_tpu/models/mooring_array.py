@@ -205,16 +205,25 @@ def parse_moordyn(path: str, nbodies: int, depth: float | None = None,
 # kinematics & forces
 # --------------------------------------------------------------------------
 
-def point_positions(ms: ArrayMooring, Xb, xf):
+def point_positions(ms: ArrayMooring, Xb, xf, delta=None):
     """Global point positions. Xb: (nb,6) body poses; xf: (nf,3) free
-    point positions."""
+    point positions.  ``delta`` ((nb,6), optional) perturbs each body by
+    a translation delta[:, :3] and a left-composed rotation
+    R(delta[:, 3:]) @ R0 — the rotation-vector parameterization used by
+    the MoorPy-parity analytic stiffness (coupled_stiffness_rotvec)."""
     Xb = jnp.asarray(Xb, float)
     xf = jnp.asarray(xf, float)
     r0 = jnp.asarray(ms.r0)
 
     R = jax.vmap(lambda x: rotation_matrix(x[3], x[4], x[5]))(Xb)  # (nb,3,3)
+    base = Xb[:, :3]
+    if delta is not None:
+        delta = jnp.asarray(delta, float)
+        dR = jax.vmap(lambda d: rotation_matrix(d[3], d[4], d[5]))(delta)
+        R = jnp.einsum("bij,bjk->bik", dR, R)
+        base = base + delta[:, :3]
     bidx = jnp.clip(jnp.asarray(ms.attach), 0, ms.nbodies - 1)
-    body_pos = Xb[bidx, :3] + jnp.einsum("pij,pj->pi", R[bidx], r0)
+    body_pos = base[bidx] + jnp.einsum("pij,pj->pi", R[bidx], r0)
     fidx = jnp.clip(jnp.asarray(ms.free_idx), 0, max(ms.n_free - 1, 0))
     free_pos = xf[fidx] if ms.n_free else jnp.zeros_like(r0)
 
@@ -267,11 +276,11 @@ def _point_forces(ms: ArrayMooring, pts):
 _KBOT_POINT = 1e5   # [N/m] seabed normal-contact stiffness for free points
 
 
-def free_net_force(ms: ArrayMooring, Xb, xf):
+def free_net_force(ms: ArrayMooring, Xb, xf, delta=None):
     """Equilibrium residual of the free points: line forces + weight +
     buoyancy + seabed normal contact (linear penalty below z = -depth,
     the MoorDyn kbot analog), (nf,3)."""
-    pts = point_positions(ms, Xb, xf)
+    pts = point_positions(ms, Xb, xf, delta=delta)
     F = _point_forces(ms, pts)
     Wz = (-jnp.asarray(ms.pmass) * ms.g
           + jnp.asarray(ms.pvol) * ms.rho * ms.g)
@@ -367,17 +376,22 @@ def current_wrenches(ms: ArrayMooring, Xb, xf, U):
     return jnp.stack([wrench(b) for b in range(ms.nbodies)])
 
 
-def body_wrenches(ms: ArrayMooring, Xb, xf):
+def body_wrenches(ms: ArrayMooring, Xb, xf, delta=None):
     """6-DOF mooring wrench on each body about its pose reference point,
-    (nb,6) (equivalent of per-body Body.getForces(lines_only=True))."""
+    (nb,6) (equivalent of per-body Body.getForces(lines_only=True)).
+    ``delta`` perturbs the body poses per point_positions (the moment
+    reference point translates with the body)."""
     Xb = jnp.asarray(Xb, float)
-    pts = point_positions(ms, Xb, xf)
+    pts = point_positions(ms, Xb, xf, delta=delta)
+    base = Xb[:, :3]
+    if delta is not None:
+        base = base + jnp.asarray(delta, float)[:, :3]
     F = _point_forces(ms, pts)
     attach = jnp.asarray(ms.attach)
 
     def wrench(b):
         mask = (attach == b).astype(float)[:, None]
-        offs = pts - Xb[b, :3]
+        offs = pts - base[b]
         return jnp.sum(translate_force_3to6(F * mask, offs), axis=0)
 
     return jnp.stack([wrench(b) for b in range(ms.nbodies)])
@@ -387,18 +401,40 @@ def body_wrenches(ms: ArrayMooring, Xb, xf):
 # equilibrium-coupled quantities (implicit-function / Schur complement)
 # --------------------------------------------------------------------------
 
+def _implicit_sensitivity(g, xb_arg, xf_flat, n_free):
+    """d(xf)/d(xb) at equilibrium: -(dg/dxf)^-1 (dg/dxb).  The single
+    regularized free-point elimination behind every equilibrium-coupled
+    quantity (both stiffness flavors and the tension Jacobian), so
+    regularization/solve changes cannot drift between them."""
+    nf3 = n_free * 3
+    dg_dxf = jax.jacfwd(lambda xf: g(xb_arg, xf))(xf_flat)
+    dg_dxb = jax.jacfwd(lambda xb: g(xb, xf_flat))(xb_arg)
+    return -jnp.linalg.solve(dg_dxf + 1e-9 * jnp.eye(nf3), dg_dxb)
+
+
 def _implicit_dxf_dXb(ms: ArrayMooring, Xb_flat, xf_eq):
-    """d(xf)/d(Xb) at equilibrium: -(dg/dxf)^-1 (dg/dXb)."""
-    nf3 = ms.n_free * 3
+    """d(xf)/d(Xb) at equilibrium for the Euler pose parameterization."""
 
     def g(xb, xf):
         return free_net_force(ms, xb.reshape(-1, 6), xf.reshape(-1, 3)
                               ).reshape(-1)
 
     xf_flat = jnp.asarray(xf_eq, float).reshape(-1)
-    dg_dxf = jax.jacfwd(lambda xf: g(Xb_flat, xf))(xf_flat)
-    dg_dxb = jax.jacfwd(lambda xb: g(xb, xf_flat))(Xb_flat)
-    return -jnp.linalg.solve(dg_dxf + 1e-9 * jnp.eye(nf3), dg_dxb)
+    return _implicit_sensitivity(g, Xb_flat, xf_flat, ms.n_free)
+
+
+def _schur_coupled(fb, g, xb_arg, xf_flat, n_free):
+    """-d(fb)/d(xb) at equilibrium with the free points eliminated by the
+    implicit-function theorem (MoorPy's analytic Schur complement over
+    free DOFs) — the single elimination shared by BOTH body
+    parameterizations (Euler pose vector and rotation-vector delta), so
+    regularization/solve changes cannot drift between the two flavors."""
+    dfb_dxb = jax.jacfwd(lambda xb: fb(xb, xf_flat))(xb_arg)
+    if n_free == 0:
+        return -dfb_dxb
+    dxf_dxb = _implicit_sensitivity(g, xb_arg, xf_flat, n_free)
+    dfb_dxf = jax.jacfwd(lambda xf: fb(xb_arg, xf))(xf_flat)
+    return -(dfb_dxb + dfb_dxf @ dxf_dxb)
 
 
 def coupled_stiffness(ms: ArrayMooring, Xb, xf_eq):
@@ -407,18 +443,38 @@ def coupled_stiffness(ms: ArrayMooring, Xb, xf_eq):
     getCoupledStiffnessA(lines_only=True) (reference raft_model.py:
     1029-1031), but by exact autodiff instead of finite differences."""
     Xb_flat = jnp.asarray(Xb, float).reshape(-1)
+    xf_flat = jnp.asarray(xf_eq, float).reshape(-1)
 
     def fb(xb, xf):
         return body_wrenches(ms, xb.reshape(-1, 6), xf.reshape(-1, 3)
                              ).reshape(-1)
 
+    def g(xb, xf):
+        return free_net_force(ms, xb.reshape(-1, 6), xf.reshape(-1, 3)
+                              ).reshape(-1)
+
+    return _schur_coupled(fb, g, Xb_flat, xf_flat, ms.n_free)
+
+
+def coupled_stiffness_rotvec(ms: ArrayMooring, Xb, xf_eq):
+    """(6nb,6nb) MoorPy-parity analytic coupled stiffness: the exact
+    ROTATION-VECTOR linearization of the body wrenches (free points
+    eliminated by the shared Schur complement).  See
+    mooring.coupled_stiffness_rotvec for why this differs from the
+    Euler-angle jacobian at loaded poses."""
+    Xb = jnp.asarray(Xb, float)
     xf_flat = jnp.asarray(xf_eq, float).reshape(-1)
-    dfb_dxb = jax.jacfwd(lambda xb: fb(xb, xf_flat))(Xb_flat)
-    if ms.n_free == 0:
-        return -dfb_dxb
-    dfb_dxf = jax.jacfwd(lambda xf: fb(Xb_flat, xf))(xf_flat)
-    dxf_dxb = _implicit_dxf_dXb(ms, Xb_flat, xf_eq)
-    return -(dfb_dxb + dfb_dxf @ dxf_dxb)
+    d0 = jnp.zeros(Xb.size)
+
+    def fb(d, xf):
+        return body_wrenches(ms, Xb, xf.reshape(-1, 3),
+                             delta=d.reshape(-1, 6)).reshape(-1)
+
+    def g(d, xf):
+        return free_net_force(ms, Xb, xf.reshape(-1, 3),
+                              delta=d.reshape(-1, 6)).reshape(-1)
+
+    return _schur_coupled(fb, g, d0, xf_flat, ms.n_free)
 
 
 def tensions(ms: ArrayMooring, Xb, xf):

@@ -224,7 +224,17 @@ def floris_turbine_dict(model, ifowt, turb_template, uhubs=None):
     power/thrust tables from powerThrustCurve, and the floating tilt
     table (mean platform pitch schedule) for the Empirical Gaussian wake
     deflection model.  ``turb_template`` is the base turbine yaml dict to
-    update; pure data — no floris import needed."""
+    update; pure data — no floris import needed.
+
+    DEVIATION (docs/quirks.md #23): the floating tilt table here is the
+    small-angle linearization atan2(thrust*zhub, C55) about the reference
+    pose, whereas the reference runs a full solveStatics per wind speed
+    and records the equilibrium pitch Xi0[4] (raft_model.py:1722).  The
+    linearization drops the aero pitch moment about the PRP
+    (overhang/hub-moment), mooring nonlinearity at the offset position,
+    and mean drag — adequate for the Empirical Gaussian deflection input
+    (degree-level agreement), but pass explicit equilibrium pitches via a
+    statics sweep if exact reference tilt parity is needed."""
     fowt = model.fowtList[ifowt]
     rot = fowt.rotors[0]
     if uhubs is None:

@@ -82,7 +82,10 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         pose = fowt_pose(fowt, r6)
         stat = fowt_statics(fowt, pose)
         hc = fowt_hydro_constants(fowt, pose)
-        C_moor = (mr.coupled_stiffness(fowt.mooring, r6)
+        # rotvec flavor for MoorPy parity (coincides with the Euler
+        # jacobian at the zero-angle reference pose used here, but keeps
+        # the two sweep paths on the same convention as Model)
+        C_moor = (mr.coupled_stiffness_rotvec(fowt.mooring, r6)
                   if fowt.mooring is not None else jnp.zeros((6, 6)))
 
         S = jonswap(w, Hs, Tp)

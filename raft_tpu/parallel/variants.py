@@ -266,7 +266,10 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
 
         # ----- dynamics: drag fixed point + batched RAO solve -----
         hc = fowt_hydro_constants(fowt, pose0)
-        C_moor = (mr.coupled_stiffness(fowt.mooring, Xeq)
+        # rotation-vector flavor = the reference's MoorPy analytic
+        # getCoupledStiffnessA at the loaded equilibrium (same parity fix
+        # as Model.solveStatics; Euler-vs-rotvec differs at loaded poses)
+        C_moor = (mr.coupled_stiffness_rotvec(fowt.mooring, Xeq)
                   if fowt.mooring is not None else jnp.zeros((6, 6)))
         pose_eq = fowt_pose(fowt, Xeq)
 

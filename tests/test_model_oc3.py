@@ -4,13 +4,20 @@ Case 0 (wave-only, parked-equivalent loading) validates the entire
 strip-theory hydro + mooring + drag-linearization + RAO pipeline at
 ~1e-6 relative (Tmoor_std via the MoorPy-parity FD tension Jacobian).
 Case 1 (operating turbine, wind 30deg + current): with the BEM at machine
-precision, the stale hub-transfer quirk replicated, and the dynamics on
+precision, the stale hub-transfer quirk replicated, the dynamics on
 the STATICS-TIME turbine constants (the reference's equilibrium-update
-block is dead code inside a TODO string, raft_model.py:798-850), every
-MEAN matches to ~1e-4 and stds to 0.3-1.4%.  The loaded-case Tmoor_std
-3% band is the FD tension Jacobian evaluated without current loads on
-the lines (MoorPy's FD sees current-loaded line equilibria; the
-current-free case 0 matches at 4e-6).
+block is dead code inside a TODO string, raft_model.py:798-850), and the
+dynamics C_moor on the ROTATION-VECTOR (MoorPy-analytic) linearization
+(round 5 — this closed the round-3/4 wave-band residual: operating-case
+motion stds went from 0.3-1.8% to ~1e-5), every MEAN matches to ~1e-4
+and every motion std to ~1e-5.  The one remaining loaded-case band is
+Tmoor_std at ~2.8%: round-5 forensics localize it to the LATERAL
+(sway/roll/yaw) block — a PSD-level fit reproduces the reference's
+Tmoor_PSD exactly by scaling the tension Jacobian's roll column ~0.1x,
+but the lateral responses are nearly coherent so the reference-side
+cause (MoorPy J lateral columns vs lateral cross-spectra) is not
+uniquely identifiable from the shipped data.  The longitudinal cross
+spectra are pinned by Mbase_std (4.8e-4) and AxRNA_std (5e-7).
 """
 import os
 import pickle
@@ -43,7 +50,7 @@ def test_wave_only_case_psd_parity(model_and_truth):
     m, truth = model_and_truth
     ours, ref = m.results["case_metrics"][0][0], truth[0][0]
     for ch in ["surge", "sway", "heave", "roll", "pitch", "yaw"]:
-        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=1e-3, atol=1e-10,
+        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=1e-4, atol=1e-10,
                         err_msg=f"{ch}_std")
         assert_allclose(ours[f"{ch}_PSD"], ref[f"{ch}_PSD"], rtol=1e-4, atol=1e-3,
                         err_msg=f"{ch}_PSD")
@@ -65,18 +72,16 @@ def test_operating_case_parity(model_and_truth):
     for ch in ("surge", "heave", "roll", "pitch", "sway"):
         assert_allclose(ours[f"{ch}_avg"], ref[f"{ch}_avg"], rtol=1e-3,
                         err_msg=f"{ch}_avg")
-    # round-4 forensics (ROUND4_NOTES): the residual band is confined to
-    # the WAVE band (wind band matches to fp noise), peaks at the
-    # spectral peak (+7% pitch PSD at w~=0.50) with a sign flip at the
-    # w~=0.44 excitation notch, and appears ONLY with the operating
-    # turbine + current (parked case 0 matches at ~1e-6).  Knob
-    # isolation: equilibrium-pose excitation, equilibrium C_moor, and
-    # the aero tensors are each 10-20x movers and our choices are
-    # structurally right; the residual is a fine-scale difference in
-    # one of those pieces, unresolved this round.
-    for ch, tol in [("surge", 0.012), ("sway", 0.008), ("heave", 0.002),
-                    ("roll", 0.005), ("pitch", 0.018), ("yaw", 0.007)]:
-        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=tol,
+    # the round-3/4 wave-band residual (0.3-1.8% operating stds, bump at
+    # the spectral peak) was the Euler-vs-rotation-vector C_moor
+    # convention: MoorPy's analytic getCoupledStiffnessA is the
+    # rotation-vector linearization, which differs from the Euler-angle
+    # jacobian at a loaded pose by the Euler-rate factor on the
+    # roll/pitch columns (mooring.coupled_stiffness_rotvec).  Post-fix
+    # measured: surge 3.3e-7, sway 1.2e-5, heave 1.5e-6, roll 1.1e-5,
+    # pitch 3.8e-6, yaw 4.5e-6 (tolerance ~10x margin).
+    for ch in ("surge", "sway", "heave", "roll", "pitch", "yaw"):
+        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=1e-4,
                         err_msg=f"{ch}_std")
     # mean yaw (measured 1e-5 relative; 6.77 deg magnitude)
     assert abs(float(np.squeeze(ours["yaw_avg"]))
@@ -87,17 +92,21 @@ def test_operating_case_parity(model_and_truth):
         assert_allclose(ours[ch], ref[ch], rtol=1e-9, err_msg=ch)
     assert_allclose(ours["omega_avg"], ref["omega_avg"], rtol=1e-9)
     assert_allclose(ours["bPitch_avg"], ref["bPitch_avg"], rtol=1e-9)
-    # nacelle acceleration / tower-base moment / mooring tension stats
-    assert_allclose(ours["AxRNA_std"], ref["AxRNA_std"], rtol=1.5e-2,
+    # nacelle acceleration / tower-base moment (longitudinal cross
+    # spectra; measured 5.4e-7 / 4.8e-4 post rotvec fix)
+    assert_allclose(ours["AxRNA_std"], ref["AxRNA_std"], rtol=1e-4,
                     err_msg="AxRNA_std")
-    assert_allclose(ours["Mbase_std"], ref["Mbase_std"], rtol=1.5e-2,
+    assert_allclose(ours["Mbase_std"], ref["Mbase_std"], rtol=2e-3,
                     err_msg="Mbase_std")
     assert_allclose(ours["Mbase_avg"], ref["Mbase_avg"], rtol=1e-4)
-    # loaded-case tension stds track the Xi wave-band residual through
-    # J@Xi (measured rel [2.0%, 2.5%, 3.0%]; J itself matches at 3e-4 in
-    # the current-free case) — NOT a missing current-loaded FD Jacobian
-    # as round 3 hypothesized: no reference yaml sets mooring/currentMod,
-    # so the pickles saw no line current at all (see docs/quirks.md #16)
+    # loaded-case tension stds: the last open band (measured 2.8%).
+    # With Xi now matched at ~1e-5, this is NOT the Xi residual (round-4
+    # attribution obsolete) and no Euler/rotvec secant scheme or step
+    # size of our tension function reproduces it; a PSD-level fit pins
+    # the discrepancy to the lateral (sway/roll/yaw) block, equivalent
+    # to the reference's J roll column being ~0.1x ours, but the
+    # near-coherent lateral responses make the reference-side cause
+    # non-identifiable from the shipped pickles (see module docstring).
     assert_allclose(ours["Tmoor_avg"], ref["Tmoor_avg"], rtol=1e-3)
     assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=3.5e-2)
 

@@ -52,17 +52,18 @@ def test_wave_only_case_parity(model_and_truth):
 
 
 def test_operating_case_parity(model_and_truth):
-    """Operating case at the post-round-3 accuracy level: means ~1e-5,
-    stds to 8e-3 (measured round 4: worst roll_std 3.8e-3 with a ~2x
-    margin per the module tolerance policy; surge/heave 1.3e-5 — an
-    order tighter than OC3's operating case, whose residual band is the
-    current+yaw-coupled-mode sensitivity; see ROUND4_NOTES)."""
+    """Operating case at the post-round-5 accuracy level (dynamics
+    C_moor on the rotation-vector/MoorPy-analytic linearization —
+    mooring.coupled_stiffness_rotvec): measured stds 1.2e-8..2.3e-6,
+    Tmoor_std 2.5e-5, Mbase_std 1.3e-3 (tolerances ~10-40x margin).
+    This case has head-on wind, so unlike OC3's operating case the
+    lateral block is unexcited and even Tmoor closes."""
     m, truth = model_and_truth
     ours, ref = m.results["case_metrics"][1][0], truth[1][0]
     for ch in ("surge", "sway", "heave", "roll", "pitch", "yaw"):
         assert_allclose(ours[f"{ch}_avg"], ref[f"{ch}_avg"], rtol=1e-4,
                         atol=1e-6, err_msg=f"{ch}_avg")
-        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=8e-3,
+        assert_allclose(ours[f"{ch}_std"], ref[f"{ch}_std"], rtol=1e-4,
                         err_msg=f"{ch}_std")
     assert_allclose(ours["Tmoor_avg"], ref["Tmoor_avg"], rtol=1e-4)
     assert_allclose(ours["Tmoor_std"], ref["Tmoor_std"], rtol=1e-3)

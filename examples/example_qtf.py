@@ -42,7 +42,7 @@ def run_example(out_folder="qtf_output", plot_flag=False):
           f"surge_std={float(case0['surge_std']):.3f} m, "
           f"pitch_std={float(case0['pitch_std']):.3f} deg")
     if out_folder:
-        print(f"QTF/.4 snapshots in ./{out_folder}/")
+        print(f"QTF/.4 snapshots in {out_folder}/")
 
     if plot_flag:
         import matplotlib.pyplot as plt

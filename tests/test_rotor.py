@@ -207,3 +207,98 @@ def test_yaw_misalign_applied_unlike_reference(rotor_and_truth):
     T45 = float(R.bem_evaluate(rot, U, Om, pi_, tilt=0.0,
                                yaw=np.radians(45.0))["T"])
     assert 0.3 * T0 < T45 < 0.75 * T0
+
+
+@pytest.fixture(scope="module")
+def servo_rotor():
+    """IEA15MW rotor with aeroServoMod=2 control (gains from the
+    VolturnUS-S test yaml, which carries the ROSCO pitch/torque tables
+    for the same turbine)."""
+    vol = "/root/reference/tests/test_data/VolturnUS-S.yaml"
+    if not (os.path.isfile(YAML) and os.path.isfile(vol)):
+        pytest.skip("reference test data not available")
+    d = yaml.safe_load(open(YAML))
+    dv = yaml.safe_load(open(vol))
+    t = d["turbine"]
+    t["nrotors"] = 1
+    t["aeroServoMod"] = 2
+    t["pitch_control"] = dv["turbine"]["pitch_control"]
+    t["torque_control"] = dv["turbine"]["torque_control"]
+    t["gear_ratio"] = dv["turbine"].get("gear_ratio", 1.0)
+    t["I_drivetrain"] = dv["turbine"]["I_drivetrain"]
+    t["rho_air"] = d["site"].get("rho_air", 1.225)
+    t["mu_air"] = d["site"].get("mu_air", 1.81e-5)
+    t["shearExp_air"] = d["site"].get("shearExp_air", 0.12)
+    t["rho_water"], t["mu_water"], t["shearExp_water"] = 1025.0, 1e-3, 0.12
+    w = np.arange(0.01, 1.0 + 0.005, 0.01) * 2 * np.pi
+    return R.build_rotor(t, w, 0), w
+
+
+def test_hqt_per_term_decomposition(servo_rotor):
+    """Per-term parity of the aeroServoMod-2 closed-loop assembly against
+    an INDEPENDENT transcription of the reference formulas
+    (raft_rotor.py:884-961: D denominator :906, control transfer C :909,
+    H_QT :943-945, excitation f2 :948, damping b2 :949, added mass a2
+    :950) evaluated from the same derivative values, at operating points
+    spanning below-rated, rated, and above-rated.  Pins the closed-loop
+    algebra so a transcription drift cannot hide inside end-to-end
+    regressions (VERDICT r4 item 7)."""
+    rot, w = servo_rotor
+    for U in (6.0, 9.0, 10.59, 12.0, 16.0, 24.0):
+        case = {"wind_speed": U, "wind_heading": 0.0, "turbulence": 0.1,
+                "turbine_status": "operating", "yaw_misalign": 0.0}
+        out = R.calc_aero(rot, w, case)
+        dv = out["derivs"]
+        dT_dU, dT_dOm, dT_dPi = (float(dv["dT_dU"]), float(dv["dT_dOm"]),
+                                 float(dv["dT_dPi"]))
+        dQ_dU, dQ_dOm, dQ_dPi = (float(dv["dQ_dU"]), float(dv["dQ_dOm"]),
+                                 float(dv["dQ_dPi"]))
+        # gain scheduling exactly as the reference (flipped-sign ROSCO,
+        # torque gains only active when the pitch gains are parked)
+        kp_beta = -np.interp(U, rot.Uhub_ops, rot.kp_0)
+        ki_beta = -np.interp(U, rot.Uhub_ops, rot.ki_0)
+        kp_tau = rot.kp_tau * (kp_beta == 0)
+        ki_tau = rot.ki_tau * (ki_beta == 0)
+        # the pitch-speed crossover must actually be exercised on both
+        # sides of rated for the term test to mean anything
+        if U <= 9.0:
+            assert kp_beta == 0 and kp_tau != 0
+        if U >= 12.0:
+            assert kp_beta != 0 and kp_tau == 0
+
+        # --- independent transcription of the reference formulas ---
+        D = (rot.I_drivetrain * w**2
+             + (dQ_dOm + kp_beta * dQ_dPi - rot.Ng * kp_tau) * 1j * w
+             + ki_beta * dQ_dPi - rot.Ng * ki_tau)
+        C_ref = 1j * w * (dQ_dU - rot.k_float * dQ_dPi
+                          / float(np.asarray(out["pose"]["r_hub"])[2])) / D
+        H_QT = ((dT_dOm + kp_beta * dT_dPi) * 1j * w + ki_beta * dT_dPi) / D
+        T_cplx = (dT_dU - rot.k_float * dT_dPi
+                  - H_QT * (dQ_dU - rot.k_float * dQ_dPi))
+        b2 = np.real(T_cplx)
+        a2 = np.real(T_cplx / (1j * w))
+        V_w = np.asarray(out["V_w"])
+        f2 = (dT_dU - H_QT * dQ_dU) * V_w
+
+        # control transfer function exposed for the omega/torque/bPitch
+        # output channels
+        assert_allclose(np.asarray(out["C"]), C_ref, rtol=1e-10)
+        # head-on, zero tilt command: R_q is the shaft rotation only; the
+        # fore-aft (0,0) entry carries cos^2(tilt) of the axis transform
+        Rq = np.asarray(out["pose"]["R_q"])
+        a = np.asarray(out["a"])
+        b = np.asarray(out["b"])
+        f = np.asarray(out["f"])
+        # direct reconstruction: a/b blocks are R_q @ diag(x,0,0) @ R_q^T
+        e1 = np.zeros((3, 3)); e1[0, 0] = 1.0
+        for arr, x in ((a, a2), (b, b2)):
+            expect = np.einsum("ab,w,bc->acw", Rq @ e1, x, Rq.T)
+            assert_allclose(arr[:3, :3, :], expect, rtol=1e-9,
+                            atol=1e-9 * np.abs(expect).max())
+            assert np.all(arr[3:, :, :] == 0) and np.all(arr[:, 3:, :] == 0)
+        expect_f = np.einsum("ab,bw->aw",
+                             Rq.astype(complex),
+                             np.stack([f2, np.zeros_like(f2),
+                                       np.zeros_like(f2)]))
+        assert_allclose(f[:3, :], expect_f, rtol=1e-9,
+                        atol=1e-9 * np.abs(expect_f).max())

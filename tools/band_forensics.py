@@ -1,0 +1,191 @@
+"""Loaded-case band-residual forensics harness.
+
+Scripts the knob-isolation methodology that closed the round-3/4
+operating-case wave-band residual (ROUND4_NOTES / ROUND5_NOTES): given a
+design YAML and the matching reference ``analyzeCases`` pickle, it
+
+1. runs the full analysis and prints per-channel std relatives and the
+   wave-band PSD ratio profile vs the pickle,
+2. re-solves ONLY the dynamics with each ingredient of the impedance
+   perturbed (the "knobs": C_moor flavor/scale, B_gyro, aero tensors,
+   M_struc, A_morison, per-entry C_moor components) and reports how each
+   knob moves the residual bins — minutes per knob instead of a full
+   re-run,
+3. prints the Euler-vs-rotation-vector C_moor difference at the
+   equilibrium pose (the round-5 root cause; see
+   mooring.coupled_stiffness_rotvec).
+
+Usage:
+    python tools/band_forensics.py \
+        /root/reference/tests/test_data/OC3spar.yaml \
+        /root/reference/tests/test_data/OC3spar_true_analyzeCases.pkl \
+        --case 1 --channel pitch
+
+A future band regression replays in minutes: run this, look at which
+knob closes/moves the deviating bins, and chase that ingredient.
+"""
+import argparse
+import copy
+import pickle
+
+import numpy as np
+import yaml
+
+CHANNELS = ["surge", "sway", "heave", "roll", "pitch", "yaw"]
+
+
+def _psd(model, ifowt, idof):
+    from raft_tpu.ops.spectra import get_psd
+    Xi = model._state[ifowt]["Xi"]
+    sig = Xi[:, idof, :]
+    if idof >= 3:
+        sig = sig * (180.0 / np.pi)
+    return np.asarray(get_psd(sig, model.w[1] - model.w[0], source_axis=0))
+
+
+def band_report(model, truth, icase, channel, nbins=12):
+    """Std relatives for all channels + the worst PSD-ratio bins."""
+    ours = model.results["case_metrics"][icase][0]
+    ref = truth[icase][0]
+    print(f"--- case {icase} std relatives:")
+    for ch in CHANNELS:
+        o = float(np.squeeze(ours[f"{ch}_std"]))
+        r = float(np.squeeze(ref[f"{ch}_std"]))
+        rel = abs(o - r) / abs(r) if r else 0.0
+        print(f"  {ch}_std  ours={o:.6g} ref={r:.6g} rel={rel:.2e}")
+    for ch in ("Tmoor_std", "AxRNA_std", "Mbase_std"):
+        o = np.atleast_1d(np.squeeze(ours[ch])).astype(float)
+        r = np.atleast_1d(np.squeeze(ref[ch])).astype(float)
+        print(f"  {ch} rel={np.abs(o - r).max() / np.abs(r).max():.2e}")
+    idof = CHANNELS.index(channel)
+    ref_psd = np.asarray(ref[f"{channel}_PSD"])
+    psd = _psd(model, 0, idof)
+    sel = ref_psd > 1e-3 * ref_psd.max()
+    ratio = np.where(sel, psd / np.where(sel, ref_psd, 1.0), np.nan)
+    worst = np.argsort(np.abs(np.nan_to_num(ratio - 1.0)))[::-1][:nbins]
+    worst = np.sort(worst)
+    print(f"--- worst {channel}-PSD bins (w [rad/s], ours/ref):")
+    for k in worst:
+        print(f"  w={model.w[k]:.3f}  ratio={ratio[k]:.4f}")
+    return worst, ref_psd
+
+
+KNOBS = {
+    # name -> (state path mutator, description)
+    "C_moor*1.01": (lambda st: st.__setitem__(
+        "C_moor", st["C_moor"] * 1.01), "uniform C_moor scale +1%"),
+    "C_moor[5,5]*1.01": (lambda st: st["C_moor"].__setitem__(
+        (5, 5), st["C_moor"][5, 5] * 1.01), "yaw-yaw stiffness +1%"),
+    "C_moor[4,4]*1.01": (lambda st: st["C_moor"].__setitem__(
+        (4, 4), st["C_moor"][4, 4] * 1.01), "pitch-pitch stiffness +1%"),
+    "B_gyro*1.01": (lambda st: st["turbine"].__setitem__(
+        "B_gyro", np.asarray(st["turbine"]["B_gyro"]) * 1.01),
+        "gyroscopic damping +1%"),
+    "B_aero*1.01": (lambda st: st["turbine"].__setitem__(
+        "B_aero", np.asarray(st["turbine"]["B_aero"]) * 1.01),
+        "aero damping +1%"),
+    "A_aero*1.01": (lambda st: st["turbine"].__setitem__(
+        "A_aero", np.asarray(st["turbine"]["A_aero"]) * 1.01),
+        "aero added mass +1%"),
+    "M_struc*1.001": (lambda st: st["statics"].__setitem__(
+        "M_struc", np.asarray(st["statics"]["M_struc"]) * 1.001),
+        "structural mass +0.1%"),
+    "A_morison*1.005": (lambda st: st["hydro0"].__setitem__(
+        "A_hydro_morison",
+        np.asarray(st["hydro0"]["A_hydro_morison"]) * 1.005),
+        "Morison added mass +0.5%"),
+    "C_moor=euler": (None, "Euler-jacobian C_moor instead of rotvec "
+                           "(the pre-round-5 convention)"),
+}
+
+
+def knob_scan(model, case, icase, channel, bins, ref_psd):
+    """Perturb each knob, re-run ONLY solveDynamics, report bin movement."""
+    from raft_tpu.models import mooring as mr
+    idof = CHANNELS.index(channel)
+    st0 = model._state[0]
+    saved = {k: copy.deepcopy(st0[k]) for k in
+             ("C_moor", "turbine", "statics", "hydro0")}
+    base_psd = _psd(model, 0, idof)
+    sel = ref_psd > 1e-3 * ref_psd.max()
+
+    def rms_misfit(psd):
+        r = psd[sel] / ref_psd[sel] - 1.0
+        return float(np.sqrt(np.mean(r**2)))
+
+    print(f"--- knob scan (misfit = rms of {channel} PSD ratio-1 over the "
+          f"significant band; base {rms_misfit(base_psd):.2e}):")
+    for name, (mut, desc) in KNOBS.items():
+        for k in saved:
+            st0[k] = copy.deepcopy(saved[k])
+        if mut is None:   # the C_moor flavor knob
+            st0["C_moor"] = np.asarray(mr.coupled_stiffness(
+                model.fowtList[0].mooring, st0["r6"],
+                current=st0.get("moor_current")))
+        else:
+            mut(st0)
+        model.solveDynamics(case)
+        psd = _psd(model, 0, idof)
+        moved = (psd[bins] - base_psd[bins]) / np.maximum(base_psd[bins],
+                                                          1e-30)
+        print(f"  {name:18s} ({desc}): misfit {rms_misfit(psd):.2e}, "
+              f"worst-bin moves {np.array2string(moved, precision=3)}")
+    for k in saved:
+        st0[k] = saved[k]
+    model.solveDynamics(case)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("design")
+    ap.add_argument("pickle")
+    ap.add_argument("--case", type=int, default=1)
+    ap.add_argument("--channel", default="pitch", choices=CHANNELS)
+    args = ap.parse_args()
+
+    from raft_tpu.model import Model
+    from raft_tpu.models import mooring as mr
+
+    design = yaml.safe_load(open(args.design))
+    truth = pickle.load(open(args.pickle, "rb"))
+    m = Model(design)
+    m.analyzeCases()
+
+    # re-establish THIS case's statics/dynamics state BEFORE reading Xi:
+    # analyzeCases leaves _state at the LAST case.  The replay MUST run
+    # the cases in order from 0: the reference's statics consume the
+    # PREVIOUS case's heading through the stale hub-transfer quirk
+    # (docs/quirks.md), so jumping straight to case i would evaluate the
+    # turbine constants with the wrong staleness and shift the wave band
+    # by ~10% on its own.
+    ncases = len(design["cases"]["data"])
+    if args.case != ncases - 1:
+        for ic in range(args.case + 1):
+            c = dict(zip(design["cases"]["keys"],
+                         design["cases"]["data"][ic]))
+            c["iCase"] = ic
+            m._iCase = ic
+            m.solveStatics(c)
+        m.solveDynamics(c)
+
+    bins, ref_psd = band_report(m, truth, args.case, args.channel)
+
+    moor = m.fowtList[0].mooring
+    if moor is not None:
+        r6 = m._state[0]["r6"]
+        Ke = np.asarray(mr.coupled_stiffness(moor, r6))
+        Kr = np.asarray(mr.coupled_stiffness_rotvec(moor, r6))
+        d = np.abs(Ke - Kr) / np.abs(Ke).max()
+        print(f"--- C_moor euler-vs-rotvec max entry diff "
+              f"{d.max():.2e} of scale (roll/pitch columns; "
+              f"zero at unloaded poses)")
+
+    case = dict(zip(design["cases"]["keys"],
+                    design["cases"]["data"][args.case]))
+    case["iCase"] = args.case
+    m._iCase = args.case
+    knob_scan(m, case, args.case, args.channel, bins, ref_psd)
+
+
+if __name__ == "__main__":
+    main()

@@ -150,10 +150,18 @@ def _tpu_probe(timeout_s=None, retries=None, backoff_s=None):
     claim makes every in-process backend init hang forever inside
     make_c_api_client (ROUND4_NOTES.md) — so the probe must run
     out-of-process where a hang is boundable.  Retries with backoff
-    because the remote lease can expire between attempts.  Returns
-    (ok: bool, info: dict)."""
+    because the remote lease can expire between attempts.
+
+    Returns (ok: bool, info: dict) where ``info["attempts"]`` is a list
+    of structured ``raft_tpu.obs.ProbeAttempt`` records (start/end
+    timestamps, timeout used, outcome, exception class) — these land in
+    the run manifest's ``probe_attempts`` so five rounds of
+    ``tpu_unavailable`` are diagnosable from data, not prose."""
     import subprocess
     import sys
+
+    from raft_tpu.obs import ProbeAttempt
+    from raft_tpu.obs.manifest import _utcnow
 
     timeout_s = timeout_s or int(os.environ.get("RAFT_BENCH_PROBE_TIMEOUT", 240))
     retries = retries or int(os.environ.get("RAFT_BENCH_PROBE_RETRIES", 3))
@@ -167,35 +175,65 @@ def _tpu_probe(timeout_s=None, retries=None, backoff_s=None):
     for i in range(retries):
         if i:
             time.sleep(backoff_s)
+        att = ProbeAttempt(index=i, started_at=_utcnow(),
+                           timeout_s=float(timeout_s))
         try:
             r = subprocess.run([sys.executable, "-c", code],
                                capture_output=True, text=True,
                                timeout=timeout_s)
+            att.finished_at = _utcnow()
             if r.returncode == 0 and "PROBE_OK" in r.stdout:
                 line = next(ln for ln in r.stdout.splitlines()
                             if "PROBE_OK" in ln)
                 # a silent CPU fallback must NOT pass as a hardware
                 # probe: the published number would be a CPU timing
                 if line.split()[1] == "cpu":
-                    attempts.append("cpu-fallback: " + line)
+                    att.outcome = "cpu-fallback"
+                    att.message = line
+                    attempts.append(att.to_dict())
                     continue
-                return True, {"attempts": attempts + ["ok"], "probe": line}
-            attempts.append("error: " + (r.stderr.strip().splitlines()[-1]
-                                         if r.stderr.strip() else
-                                         f"rc={r.returncode}"))
+                att.outcome = "ok"
+                att.message = line
+                attempts.append(att.to_dict())
+                return True, {"attempts": attempts, "probe": line}
+            att.outcome = "error"
+            att.error_class = ("CalledProcessError" if r.returncode
+                               else "ProbeOutputMissing")
+            att.message = (r.stderr.strip().splitlines()[-1]
+                           if r.stderr.strip() else f"rc={r.returncode}")
         except subprocess.TimeoutExpired:
-            attempts.append(f"hang: no backend after {timeout_s}s "
-                            "(stale-claim tunnel wedge?)")
+            att.finished_at = _utcnow()
+            att.outcome = "timeout"
+            att.error_class = "TimeoutExpired"
+            att.message = (f"no backend after {timeout_s}s "
+                           "(stale-claim tunnel wedge?)")
+        attempts.append(att.to_dict())
     return False, {"attempts": attempts}
 
 
-def _emit_tpu_unavailable(info):
+def _obs_default():
+    """The bench writes a run manifest on EVERY invocation: default the
+    obs output directory to ./obs_runs next to this file when neither
+    ``obs.configure()`` nor ``RAFT_TPU_OBS_DIR`` chose one."""
+    from raft_tpu import obs
+    if obs.out_dir() is None:
+        obs.configure(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "obs_runs"))
+    return obs
+
+
+def _emit_tpu_unavailable(info, manifest=None):
     """Structured bench result when the TPU backend cannot initialize:
     diagnosable JSON (not a traceback) + the CPU-mode f32-vs-f64
-    accuracy gate so the round still records a correctness signal."""
+    accuracy gate so the round still records a correctness signal.
+    The run manifest is written here too (status ``tpu_unavailable``)
+    with the structured probe-attempt records attached."""
     import subprocess
     import sys
 
+    obs = _obs_default()
+    if manifest is None:                              # direct-call safety
+        manifest = obs.RunManifest.begin(kind="bench", devices=False)
     gate = None
     try:
         env = dict(os.environ, JAX_PLATFORMS="cpu", RAFT_TPU_X64="0",
@@ -210,6 +248,11 @@ def _emit_tpu_unavailable(info):
                     if r.stderr.strip() else f"rc={r.returncode}"}
     except Exception as e:                            # pragma: no cover
         gate = {"error": f"{type(e).__name__}: {e}"}
+    for att in info.get("attempts", []):
+        manifest.add_probe_attempt(att)
+    manifest.extra["cpu_accuracy_gate"] = gate
+    paths = obs.finish_run(manifest, status="tpu_unavailable",
+                           write_trace=False)
     result = {
         "metric": "design-variants/hour/chip (TPU backend unavailable — "
                   "no hardware number this run)",
@@ -220,6 +263,7 @@ def _emit_tpu_unavailable(info):
         "reason": "tpu_unavailable",
         "probe": info,
         "cpu_accuracy_gate": gate,
+        "manifest": paths["manifest"],
     }
     print(json.dumps(result))
     raise SystemExit(1)
@@ -268,53 +312,85 @@ def main():
 
     if os.environ.get("RAFT_BENCH_GATE_ONLY") == "1":
         return _gate_only()
+
+    # environment is captured WITHOUT touching jax.devices() here — an
+    # in-process backend query can hang forever on the wedged tunnel;
+    # it is re-captured with device facts once the backend is known good
+    obs = _obs_default()
+    obs.install_jax_hooks()
+    manifest = obs.RunManifest.begin(kind="bench", devices=False, config={
+        "NW": NW, "NV": NV, "NW2": NW2, "NITER": NITER,
+        "want_tpu": _want_tpu()})
+
     if _want_tpu():
-        ok, info = _tpu_probe()
+        with obs.span("bench_tpu_probe"):
+            ok, info = _tpu_probe()
         if not ok:
-            return _emit_tpu_unavailable(info)
+            return _emit_tpu_unavailable(info, manifest)
+        for att in info.get("attempts", []):
+            manifest.add_probe_attempt(att)
 
-    design, base, thetas, batched, A_turb, B_turb = _solver_setup(NV)
+    status = "failed"
+    try:
+        with obs.span("bench_setup", nv=NV):
+            design, base, thetas, batched, A_turb, B_turb = _solver_setup(NV)
+        manifest.environment = obs.capture_environment()   # backend is up
 
-    out = batched(thetas)   # compile + warmup
-    jax.block_until_ready(out["std"])
-    # distinct variant batches per rep: the axon tunnel memoizes repeated
-    # identical (program, inputs) executions, which would fake the timing
-    reps = 3
-    batches = [_thetas(design, base, NV, seed=100 + r) for r in range(reps)]
-    t0 = time.perf_counter()
-    for r in range(reps):
-        out = batched(batches[r])
-        jax.block_until_ready(out["std"])
-    dt = (time.perf_counter() - t0) / reps
-    variants_per_hour = NV / dt * 3600.0
+        with obs.span("bench_warmup_compile", nv=NV):
+            out = batched(thetas)   # compile + warmup
+            jax.block_until_ready(out["std"])
+        # distinct variant batches per rep: the axon tunnel memoizes
+        # repeated identical (program, inputs) executions, which would
+        # fake the timing
+        reps = 3
+        batches = [_thetas(design, base, NV, seed=100 + r)
+                   for r in range(reps)]
+        with obs.span("bench_timed_reps", reps=reps, nv=NV):
+            t0 = time.perf_counter()
+            for r in range(reps):
+                out = batched(batches[r])
+                jax.block_until_ready(out["std"])
+            dt = (time.perf_counter() - t0) / reps
+        variants_per_hour = NV / dt * 3600.0
 
-    baseline_vph = _serial_numpy_baseline(base, A_turb, B_turb)
+        with obs.span("bench_serial_baseline"):
+            baseline_vph = _serial_numpy_baseline(base, A_turb, B_turb)
 
-    acc = _accuracy_gate(thetas, batched)
+        with obs.span("bench_accuracy_gate"):
+            acc = _accuracy_gate(thetas, batched)
 
-    qtf = _qtf_metric()
+        with obs.span("bench_qtf_metric", nw2=NW2):
+            qtf = _qtf_metric()
 
-    dev = jax.devices()[0]
-    acc_ok = _acc_ok(acc)
-    # a QTF-kernel regression must be visible at the JSON level, not
-    # buried in an error string (VERDICT r4 weak #5)
-    qtf_ok = isinstance(qtf, dict)
-    result = {
-        "metric": f"design-variants/hour/chip ({NW}-bin VolturnUS-S variant "
-                  f"pipeline incl. frozen aero added-mass/damping/gyro + "
-                  f"mean-thrust statics: geometry+ballast+statics+dynamics, "
-                  f"f32, device={dev.platform}; north-star 8-chip "
-                  f"target=75000/h/chip)",
-        "value": round(variants_per_hour, 1),
-        "unit": "variants/h/chip",
-        "vs_baseline": round(variants_per_hour / baseline_vph, 2),
-        "rel_dev_f32_vs_f64": acc,
-        "accuracy_gate": {"median_tol": ACC_MEDIAN_TOL,
-                          "surge_max_tol": ACC_SURGE_TOL, "ok": acc_ok},
-        "qtf_pairgrid": qtf,
-        "qtf_ok": qtf_ok,
-        "ok": acc_ok and qtf_ok,
-    }
+        dev = jax.devices()[0]
+        acc_ok = _acc_ok(acc)
+        # a QTF-kernel regression must be visible at the JSON level, not
+        # buried in an error string (VERDICT r4 weak #5)
+        qtf_ok = isinstance(qtf, dict)
+        result = {
+            "metric": f"design-variants/hour/chip ({NW}-bin VolturnUS-S "
+                      f"variant pipeline incl. frozen aero "
+                      f"added-mass/damping/gyro + mean-thrust statics: "
+                      f"geometry+ballast+statics+dynamics, "
+                      f"f32, device={dev.platform}; north-star 8-chip "
+                      f"target=75000/h/chip)",
+            "value": round(variants_per_hour, 1),
+            "unit": "variants/h/chip",
+            "vs_baseline": round(variants_per_hour / baseline_vph, 2),
+            "rel_dev_f32_vs_f64": acc,
+            "accuracy_gate": {"median_tol": ACC_MEDIAN_TOL,
+                              "surge_max_tol": ACC_SURGE_TOL, "ok": acc_ok},
+            "qtf_pairgrid": qtf,
+            "qtf_ok": qtf_ok,
+            "ok": acc_ok and qtf_ok,
+        }
+        status = "ok" if result["ok"] else "failed"
+        manifest.extra["result"] = {
+            "value": result["value"], "vs_baseline": result["vs_baseline"],
+            "ok": result["ok"]}
+    finally:
+        paths = obs.finish_run(manifest, status=status)
+    result["manifest"] = paths["manifest"]
     print(json.dumps(result))
     if not result["ok"]:
         raise SystemExit(1)   # a fast-but-wrong number is not a result

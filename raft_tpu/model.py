@@ -47,9 +47,12 @@ from raft_tpu.ops.linalg import inv_complex, solve_complex
 from raft_tpu.ops.transforms import transform_force, translate_matrix_6to6
 from raft_tpu.models.member import member_inertia
 from raft_tpu.utils.dicttools import get_from_dict
-from raft_tpu.utils.profiling import timed
+from raft_tpu import obs
+from raft_tpu.utils.profiling import get_logger, temp_verbosity
 
 RAD2DEG = 180.0 / np.pi
+
+_LOG = get_logger("model")
 
 
 class Model:
@@ -121,6 +124,8 @@ class Model:
         plat = design.get("platform") or (design.get("platforms") or [{}])[0]
         self.outFolderQTF = plat.get("outFolderQTF")
         self._iCase = None
+        #: RunManifest of the most recent analyzeCases invocation
+        self.last_manifest = None
         self.design = design
         self.results = {}
         # per-fowt case state (filled by solveStatics/solveDynamics)
@@ -289,11 +294,21 @@ class Model:
         self._eval_FK_j = jax.jit(eval_FK)
         return self._eval_FK_j
 
+    def _case_label(self) -> str:
+        """Metrics label for the current case ("unloaded" outside the
+        analyzeCases loop)."""
+        return "unloaded" if self._iCase is None else str(self._iCase)
+
     def solveStatics(self, case, display=0):
         """Mean-offset equilibrium over all 6N system DOFs (reference:
         raft_model.py:479-849).  In array mode the shared mooring's free
         points are re-equilibrated every Newton iteration and its coupled
         stiffness couples the FOWT blocks."""
+        with temp_verbosity(display), \
+                obs.span("solveStatics", case=self._case_label()) as sp:
+            return self._solve_statics_impl(case, sp)
+
+    def _solve_statics_impl(self, case, sp):
         N = self.nFOWT
         for i, fowt in enumerate(self.fowtList):
             self._case_constants(fowt, self._case_for_fowt(case, i),
@@ -367,6 +382,17 @@ class Model:
             # be small while the residual is still far from equilibrium
             if np.all(np.abs(dX) < tol):
                 break
+        residual = float(np.sqrt(np.sum(np.asarray(Fj) ** 2)))
+        case_lbl = self._case_label()
+        sp.set(newton_iters=it + 1, residual_norm=residual)
+        obs.histogram(
+            "raft_statics_newton_iterations",
+            "damped-Newton iterations to mean-offset equilibrium",
+            buckets=obs.ITER_BUCKETS).observe(it + 1, case=case_lbl)
+        obs.gauge(
+            "raft_statics_residual_norm",
+            "|F| at the accepted statics equilibrium [N]",
+            ).set(residual, case=case_lbl)
 
         # mooring properties at the FINAL pose (one more free-point solve
         # so xf corresponds to X, not the previous Newton iterate)
@@ -416,8 +442,7 @@ class Model:
                 state["F_moor0"] = np.zeros(6)
         if case and "iCase" in case:
             self.results.setdefault("mean_offsets", []).append(X.copy())
-        if display > 0:
-            print(f"Found mean offsets: {X - refs}")
+        _LOG.info("Found mean offsets: %s", X - refs)
         return X
 
     # ------------------------------------------------------------------
@@ -425,6 +450,19 @@ class Model:
     # ------------------------------------------------------------------
 
     def solveEigen(self, display=0):
+        with temp_verbosity(display), \
+                obs.span("solveEigen", case=self._case_label()) as sp:
+            fns, modes = self._solve_eigen_impl()
+            sp.set(fn_min_hz=float(np.min(fns)), fn_max_hz=float(np.max(fns)))
+            g = obs.gauge("raft_eigen_fn_hz",
+                          "undamped natural frequency per system DOF [Hz]")
+            for idof, fn in enumerate(np.asarray(fns)):
+                g.set(float(fn), dof=str(idof))
+            _LOG.info("natural frequencies [Hz]: %s", np.array2string(
+                np.asarray(fns), precision=4))
+        return fns, modes
+
+    def _solve_eigen_impl(self):
         nDOF = self.nDOF
         M_tot = np.zeros((nDOF, nDOF))
         C_tot = np.zeros((nDOF, nDOF))
@@ -478,11 +516,30 @@ class Model:
         excludes the array-level mooring stiffness from the linearization
         loop); the block-diagonal system impedance plus the shared-mooring
         stiffness then yields the coupled response per heading."""
+        with temp_verbosity(display), \
+                obs.span("solveDynamics", case=self._case_label()) as sp:
+            return self._solve_dynamics_impl(case, tol, display, sp)
+
+    def _record_dyn_residual(self, ih, Z_sys, Xi_h, F_wave):
+        """Relative residual of the block system solve for one heading —
+        ||Z Xi - F|| / ||F|| over all frequencies (a health check on the
+        factor-once Zinv reuse)."""
+        R = np.einsum("wij,jw->iw", Z_sys, Xi_h) - F_wave
+        rel = float(np.linalg.norm(R) / (np.linalg.norm(F_wave) + 1e-300))
+        obs.gauge(
+            "raft_dynamics_solve_residual",
+            "relative residual |Z Xi - F|/|F| of the system RAO solve",
+            ).set(rel, case=self._case_label(), heading=str(ih))
+        return rel
+
+    def _solve_dynamics_impl(self, case, tol, display, sp):
         N = self.nFOWT
         nw = self.nw
         for i in range(N):
-            self._fowt_linearize(i, self._case_for_fowt(case, i), tol=tol,
-                                 display=display)
+            with obs.span("fowt_linearize", fowt=i,
+                          case=self._case_label()):
+                self._fowt_linearize(i, self._case_for_fowt(case, i),
+                                     tol=tol, display=display)
 
         # ----- system assembly (reference: raft_model.py:1021-1031) -----
         Z_sys = np.zeros((nw, 6 * N, 6 * N), dtype=complex)
@@ -494,6 +551,22 @@ class Model:
         # factor once, reuse across headings and 2nd-order re-solves
         # (the reference's Zinv, raft_model.py:1038-1040)
         Zinv = jnp.asarray(inv_complex(jnp.asarray(Z_sys)))
+
+        # solver-health telemetry: conditioning of the complex system
+        # across the frequency axis (a resonance-adjacent near-singular
+        # impedance shows up here long before the response goes bad).
+        # NaN/Inf in Z_sys would make np.linalg.cond raise inside SVD —
+        # telemetry must not preempt the clearer non-finite diagnostic
+        # the solve path raises downstream
+        if np.all(np.isfinite(Z_sys)):
+            cond = np.linalg.cond(Z_sys)
+            sp.set(cond_max=float(cond.max()),
+                   cond_median=float(np.median(cond)))
+            obs.gauge(
+                "raft_dynamics_condition_number",
+                "max condition number of the 6Nx6N impedance over "
+                "frequencies").set(float(cond.max()),
+                                   case=self._case_label())
 
         nWaves = self._state[0]["seastate"]["nWaves"]
         Xi_sys = np.zeros((nWaves + 1, 6 * N, nw), dtype=complex)
@@ -523,6 +596,7 @@ class Model:
                              + np.asarray(exc["F_hydro_iner"][ih])
                              + F_drag_h + st["Fhydro_2nd"][ih])
             Xi_sys[ih] = system_solve(F_wave)
+            self._record_dyn_residual(ih, Z_sys, Xi_sys[ih], F_wave)
 
             # internal-QTF secondary headings: QTF from that heading's
             # first-order RAOs, then a system re-solve with the 2nd-order
@@ -548,6 +622,7 @@ class Model:
                                  + np.asarray(st["excitation"]["F_hydro_iner"][ih])
                                  + st["F_drag"][ih] + st["Fhydro_2nd"][ih])
                 Xi_sys[ih] = system_solve(F_wave)
+                self._record_dyn_residual(ih, Z_sys, Xi_sys[ih], F_wave)
 
         for i, fowt in enumerate(self.fowtList):
             s = slice(6 * i, 6 * i + 6)
@@ -742,7 +817,8 @@ class Model:
                             and np.allclose(qd.w, fowt.w1_2nd, rtol=1e-6)):
                         qtf4 = qd.qtf
             if qtf4 is None:
-                with timed("calcQTF_slenderBody"):
+                with obs.span("calcQTF_slenderBody", fowt=ifowt,
+                              case=self._case_label()):
                     qtf_local = qt.calc_qtf_slender_body(
                         fowt, pose_eq, seastate["beta"][0], Xi0=RAO,
                         M_struc=stat["M_struc"])
@@ -763,6 +839,35 @@ class Model:
             state["qtf"] = qtf4
 
         XiLast, Xi1, Z, Bmat, niter, converged = carry
+
+        # ----- solver-health metrics: the fixed point's convergence -----
+        n_it = int(niter)
+        conv = bool(converged)
+        Xi1_np, XiLast_np = np.asarray(Xi1), np.asarray(XiLast)
+        residual = float(np.max(np.abs(Xi1_np - XiLast_np)
+                                / (np.abs(Xi1_np) + tol)))
+        lbl = dict(fowt=ifowt, case=self._case_label())
+        obs.histogram(
+            "raft_fixed_point_iterations",
+            "drag-linearization fixed-point iterations per load case",
+            buckets=obs.ITER_BUCKETS).observe(n_it, **lbl)
+        obs.gauge(
+            "raft_fixed_point_last_iterations",
+            "iterations of the most recent drag fixed point",
+            ).set(n_it, **lbl)
+        obs.gauge(
+            "raft_fixed_point_residual",
+            "final relative update of the drag fixed point "
+            "(|Xi_n - Xi_{n-1}| / (|Xi_n| + tol), max over DOF x freq)",
+            ).set(residual, **lbl)
+        if not conv:
+            obs.counter(
+                "raft_fixed_point_nonconverged_total",
+                "drag fixed points that hit nIter without converging",
+                ).inc(1, **lbl)
+        cur = obs.current_span()
+        if cur is not None:
+            cur.set(iterations=n_it, residual=residual, converged=conv)
 
         state["Fhydro_2nd"] = Fhydro_2nd
         state["Fhydro_2nd_mean"] = Fhydro_2nd_mean
@@ -848,10 +953,13 @@ class Model:
         raft_model.py:1434-1566).  The reference's 1 cm stepping loop is
         replaced by an exact bisection to the same rounded (2-decimal)
         fill level."""
+        with temp_verbosity(int(display)):
+            return self._adjust_ballast_impl(fowt, heave_tol)
+
+    def _adjust_ballast_impl(self, fowt, heave_tol):
         sumFz, heave, _ = self._heave_imbalance(fowt)
         dmass = sumFz / fowt.g
-        if display:
-            print(f" initial heave imbalance {heave:.3f} m")
+        _LOG.info(" initial heave imbalance %.3f m", heave)
         for group in self._member_groups(fowt):
             geom0 = fowt.members[group[0]]
             rho_fills = np.atleast_1d(np.asarray(geom0.rho_fill, float))
@@ -884,9 +992,8 @@ class Model:
                         np.atleast_1d(fowt.members[imem].l_fill), float)
                     fowt.members[imem].l_fill[j] = l_new
                 sumFz, heave, _ = self._heave_imbalance(fowt)
-                if display:
-                    print(f" member {geom0.name} section {j}: l_fill -> "
-                          f"{l_new:.2f} m, heave {heave:.3f} m")
+                _LOG.info(" member %s section %d: l_fill -> %.2f m, "
+                          "heave %.3f m", geom0.name, j, l_new, heave)
                 if abs(heave) < heave_tol:
                     return heave
                 dmass = sumFz / fowt.g
@@ -918,13 +1025,45 @@ class Model:
             rf = np.asarray(np.atleast_1d(np.asarray(geom.rho_fill, float)))
             geom.rho_fill = np.where(lf > 0.0, rf + delta_rho_fill, rf)
         if display:
-            _, heave_new, _ = self._heave_imbalance(fowt)
-            print(f" ballast density shifted {delta_rho_fill:+.3f} kg/m3; "
-                  f"heave {heave:.3f} -> {heave_new:.3f} m")
+            with temp_verbosity(max(int(display), 1)):
+                _, heave_new, _ = self._heave_imbalance(fowt)
+                _LOG.info(" ballast density shifted %+.3f kg/m3; "
+                          "heave %.3f -> %.3f m", delta_rho_fill, heave,
+                          heave_new)
         return delta_rho_fill
 
     def analyzeCases(self, display=0, RAO_plot=False):
+        """Statics + dynamics + output statistics per load case.  Records
+        nested spans (statics/dynamics/QTF/outputs phases), solver-health
+        metrics, and a :class:`raft_tpu.obs.RunManifest` — kept on
+        ``self.last_manifest`` and written to ``obs.out_dir()`` (the
+        ``RAFT_TPU_OBS_DIR`` env var) when configured."""
+        obs.install_jax_hooks()
         nCases = len(self.design["cases"]["data"])
+        manifest = obs.RunManifest.begin(kind="analyzeCases", config={
+            "nCases": nCases, "nFOWT": self.nFOWT, "nw": self.nw,
+            "nDOF": self.nDOF, "nIter": self.nIter,
+            "depth": self.depth})
+        self.last_manifest = manifest
+        status = "failed"
+        try:
+            with temp_verbosity(display), \
+                    obs.span("analyzeCases", nCases=nCases,
+                             nFOWT=self.nFOWT):
+                self._analyze_cases_impl(nCases, display)
+            status = "ok"
+        finally:
+            # a later direct solveDynamics call must not write its QTF
+            # snapshot under the last case's tag
+            self._iCase = None
+            with temp_verbosity(display):
+                paths = obs.finish_run(manifest, status=status)
+                if paths["manifest"]:
+                    _LOG.info("run manifest: %s  trace: %s",
+                              paths["manifest"], paths["trace"])
+        return self.results
+
+    def _analyze_cases_impl(self, nCases, display):
         self.results["properties"] = {}
         self.results["case_metrics"] = {}
         self.results["mean_offsets"] = []
@@ -935,22 +1074,19 @@ class Model:
             case["iCase"] = iCase
             self._iCase = iCase
             self.results["case_metrics"][iCase] = {}
-            with timed("solveStatics"):
-                self.solveStatics(case, display=display)
-            with timed("solveDynamics"):
-                self.solveDynamics(case, display=display)
+            self.solveStatics(case, display=display)
+            self.solveDynamics(case, display=display)
             # re-solve the operating point with mean wave drift included,
             # then clear it so it can't leak into the next case (reference:
             # raft_model.py:296-303)
             if any(f.potSecOrder > 0 for f in self.fowtList):
                 self.results["mean_offsets"].pop()   # superseded by re-solve
-                with timed("solveStatics"):
-                    self.solveStatics(case, display=display)
+                self.solveStatics(case, display=display)
                 for state in self._state:
                     state.pop("F_meandrift", None)
             for i, fowt in enumerate(self.fowtList):
                 self.results["case_metrics"][iCase][i] = {}
-                with timed("saveTurbineOutputs"):
+                with obs.span("saveTurbineOutputs", fowt=i, case=str(iCase)):
                     self.saveTurbineOutputs(
                         self.results["case_metrics"][iCase][i], i, case)
                 if display > 0:
@@ -981,9 +1117,6 @@ class Model:
                          for iT in range(nT)]),
                 }
                 self.results["case_metrics"][iCase]["array_mooring"] = am
-        # a later direct solveDynamics call must not write its QTF snapshot
-        # under the last case's tag
-        self._iCase = None
         return self.results
 
     # ------------------------------------------------------------------
@@ -1234,35 +1367,46 @@ class Model:
     # ------------------------------------------------------------------
 
     def _print_stats_table(self, iCase, ifowt):
-        """Console response-statistics table (reference:
-        raft_model.py:315-341)."""
+        """Response-statistics table (reference: raft_model.py:315-341),
+        emitted at INFO level through the raft_tpu logger — visible with
+        ``display>0`` (a per-call ``temp_verbosity`` override) or an
+        ambient ``set_verbosity(1)``."""
         m = self.results["case_metrics"][iCase][ifowt]
         fowt = self.fowtList[ifowt]
-        print(f"---------------- FOWT {ifowt+1} Case {iCase+1} "
-              "Statistics ----------------")
-        print("Response channel     Average     RMS         Maximum     "
-              "Minimum")
+        lines = [
+            f"---------------- FOWT {ifowt+1} Case {iCase+1} "
+            "Statistics ----------------",
+            "Response channel     Average     RMS         Maximum     "
+            "Minimum",
+        ]
         for ch, unit in (("surge", "m"), ("sway", "m"), ("heave", "m"),
                          ("roll", "deg"), ("pitch", "deg"), ("yaw", "deg")):
-            print(f"{(ch + ' (' + unit + ')').ljust(19)}"
-                  f"{m[ch + '_avg']:10.2e}  {m[ch + '_std']:10.2e}  "
-                  f"{m[ch + '_max']:10.2e}  {m[ch + '_min']:10.2e}")
+            lines.append(
+                f"{(ch + ' (' + unit + ')').ljust(19)}"
+                f"{m[ch + '_avg']:10.2e}  {m[ch + '_std']:10.2e}  "
+                f"{m[ch + '_max']:10.2e}  {m[ch + '_min']:10.2e}")
         for ir in range(fowt.nrotors):
-            print(f"nacelle acc (m/s2) {m['AxRNA_avg'][ir]:10.2e}  "
-                  f"{m['AxRNA_std'][ir]:10.2e}  {m['AxRNA_max'][ir]:10.2e}  "
-                  f"{m['AxRNA_min'][ir]:10.2e}")
-            print(f"tower bending (Nm) {m['Mbase_avg'][ir]:10.2e}  "
-                  f"{m['Mbase_std'][ir]:10.2e}  {m['Mbase_max'][ir]:10.2e}  "
-                  f"{m['Mbase_min'][ir]:10.2e}")
+            lines.append(
+                f"nacelle acc (m/s2) {m['AxRNA_avg'][ir]:10.2e}  "
+                f"{m['AxRNA_std'][ir]:10.2e}  {m['AxRNA_max'][ir]:10.2e}  "
+                f"{m['AxRNA_min'][ir]:10.2e}")
+            lines.append(
+                f"tower bending (Nm) {m['Mbase_avg'][ir]:10.2e}  "
+                f"{m['Mbase_std'][ir]:10.2e}  {m['Mbase_max'][ir]:10.2e}  "
+                f"{m['Mbase_min'][ir]:10.2e}")
             if m["omega_avg"][ir] != 0.0:
-                print(f"rotor speed (RPM)  {m['omega_avg'][ir]:10.2e}  "
-                      f"{m['omega_std'][ir]:10.2e}  "
-                      f"{m['omega_max'][ir]:10.2e}  "
-                      f"{m['omega_min'][ir]:10.2e}")
-                print(f"blade pitch (deg)  {m['bPitch_avg'][ir]:10.2e}  "
-                      f"{m['bPitch_std'][ir]:10.2e}")
-                print(f"rotor power        {m['power_avg'][ir]:10.2e}")
-        print("-----------------------------------------------------------")
+                lines.append(
+                    f"rotor speed (RPM)  {m['omega_avg'][ir]:10.2e}  "
+                    f"{m['omega_std'][ir]:10.2e}  "
+                    f"{m['omega_max'][ir]:10.2e}  "
+                    f"{m['omega_min'][ir]:10.2e}")
+                lines.append(
+                    f"blade pitch (deg)  {m['bPitch_avg'][ir]:10.2e}  "
+                    f"{m['bPitch_std'][ir]:10.2e}")
+                lines.append(f"rotor power        {m['power_avg'][ir]:10.2e}")
+        lines.append(
+            "-----------------------------------------------------------")
+        _LOG.info("%s", "\n".join(lines))
 
     def saveResponses(self, out_path):
         """Per-case per-FOWT PSD text export (reference:

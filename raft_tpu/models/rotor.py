@@ -968,6 +968,7 @@ def calc_cavitation(rot: RotorModel, case: dict, clearance_margin=1.0,
     if np.any(cav < 0.0):
         if error_on_cavitation:
             raise ValueError("Cavitation occurred at a blade node")
-        print("WARNING: Cavitation check found a blade node with cavitation "
-              "occurring")
+        from raft_tpu.utils.profiling import get_logger
+        get_logger("rotor").warning(
+            "Cavitation check found a blade node with cavitation occurring")
     return cav

@@ -1,0 +1,71 @@
+"""raft_tpu.obs — observability: span tracing, metrics, run manifests.
+
+Three pillars (see docs/observability.md):
+
+- :mod:`raft_tpu.obs.tracing` — nested wall-time spans with attributes,
+  Chrome-trace/Perfetto JSON export, and the name -> (total, calls)
+  aggregate behind ``utils.profiling.timing_report()``.
+- :mod:`raft_tpu.obs.metrics` — process-wide counters/gauges/histograms
+  (drag fixed-point iterations and residuals, dynamics condition
+  numbers, JAX compile events) with JSON and Prometheus text exports.
+- :mod:`raft_tpu.obs.manifest` — ``RunManifest``: one structured JSON
+  record per ``analyzeCases`` / ``sweep_cases`` / ``bench.py`` run.
+
+File output is opt-in: call ``configure(out_dir=...)`` or set the
+``RAFT_TPU_OBS_DIR`` environment variable, and every instrumented entry
+point writes ``<kind>_<run_id>.manifest.json`` plus
+``<kind>_<run_id>.trace.json`` there.  Without it, spans/metrics still
+record in-process (``Model.last_manifest``, ``timing_report()``,
+``obs.snapshot()``) and nothing touches the filesystem.
+
+This package never imports jax at module scope — bench.py must be able
+to import it before deciding which backend to initialize.
+"""
+from __future__ import annotations
+
+import os
+
+from raft_tpu.obs.tracing import (                              # noqa: F401
+    span, current_span, spans, aggregate, reset as reset_tracing,
+    chrome_trace, export_chrome_trace, dropped_spans,
+)
+from raft_tpu.obs.metrics import (                              # noqa: F401
+    REGISTRY, counter, gauge, histogram, snapshot, to_prometheus,
+    install_jax_hooks, sample_jit_cache, ITER_BUCKETS,
+)
+from raft_tpu.obs.manifest import (                             # noqa: F401
+    SCHEMA, RunManifest, ProbeAttempt, capture_environment,
+    validate_manifest, git_sha,
+)
+
+_OUT_DIR: str | None = None
+
+
+def configure(out_dir: str | None):
+    """Set (or clear, with None) the observability output directory —
+    overrides the ``RAFT_TPU_OBS_DIR`` environment variable."""
+    global _OUT_DIR
+    _OUT_DIR = out_dir
+
+
+def out_dir() -> str | None:
+    """Active output directory, or None when file output is disabled."""
+    return _OUT_DIR or os.environ.get("RAFT_TPU_OBS_DIR") or None
+
+
+def finish_run(manifest: RunManifest, status: str = "ok",
+               write_trace: bool = True) -> dict:
+    """Finish ``manifest`` and, when an output directory is configured,
+    write the manifest JSON (and the Chrome trace).  Returns
+    ``{"manifest": path|None, "trace": path|None}``."""
+    manifest.finish(status)
+    paths = {"manifest": None, "trace": None}
+    d = out_dir()
+    if d:
+        stem = f"{manifest.kind}_{manifest.run_id}"
+        paths["manifest"] = manifest.write(
+            os.path.join(d, stem + ".manifest.json"))
+        if write_trace:
+            paths["trace"] = export_chrome_trace(
+                os.path.join(d, stem + ".trace.json"))
+    return paths

@@ -1,0 +1,220 @@
+"""Structured run manifests: one JSON record per solve/sweep/bench run.
+
+A ``RunManifest`` captures what ran, where, and how it behaved:
+environment (JAX version/backend/device count/x64 flag/git SHA), the run
+config, per-phase wall times (from the span aggregate), a metrics
+snapshot, and — for the bench TPU probe — structured attempt records
+(timestamps, timeout, error class) replacing free-text failure strings.
+
+Schema (``raft_tpu.run_manifest/v1``) — every manifest has exactly these
+top-level keys; see ``REQUIRED_KEYS`` and ``validate_manifest()``:
+
+    schema, run_id, kind, status, started_at, finished_at, duration_s,
+    environment, config, phases, metrics, probe_attempts, extra
+
+Writers: ``Model.analyzeCases``, ``parallel.sweep.sweep_cases``, and
+every ``bench.py`` invocation (including the ``tpu_unavailable`` early
+exit).  See docs/observability.md for the field-by-field reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import os
+import socket
+import subprocess
+import sys
+import uuid
+
+SCHEMA = "raft_tpu.run_manifest/v1"
+
+#: exactly the top-level keys of a serialized v1 manifest
+REQUIRED_KEYS = (
+    "schema", "run_id", "kind", "status", "started_at", "finished_at",
+    "duration_s", "environment", "config", "phases", "metrics",
+    "probe_attempts", "extra",
+)
+
+_STATUSES = ("running", "ok", "failed", "tpu_unavailable")
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def git_sha() -> str | None:
+    """HEAD SHA of the checkout this package runs from, or None."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        r = subprocess.run(["git", "-C", root, "rev-parse", "HEAD"],
+                           capture_output=True, text=True, timeout=10)
+        if r.returncode == 0:
+            return r.stdout.strip()
+    except Exception:
+        pass
+    return None
+
+
+def capture_environment(devices: bool = True) -> dict:
+    """Environment block: python/host/jax/git facts.
+
+    ``devices=False`` skips everything that would initialize a JAX
+    backend — REQUIRED on the bench ``tpu_unavailable`` path, where an
+    in-process ``jax.devices()`` can hang forever on the wedged tunnel.
+    """
+    env = {
+        "python": sys.version.split()[0],
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+        "git_sha": git_sha(),
+    }
+    try:
+        import jax
+        env["jax_version"] = jax.__version__
+        env["x64"] = bool(jax.config.jax_enable_x64)
+        if devices:
+            ds = jax.devices()
+            env["backend"] = jax.default_backend()
+            env["device_count"] = len(ds)
+            env["devices"] = [str(d) for d in ds[:8]]
+        else:
+            env["backend"] = None
+            env["device_count"] = None
+    except Exception as e:                      # pragma: no cover
+        env["jax_error"] = f"{type(e).__name__}: {e}"
+    return env
+
+
+@dataclasses.dataclass
+class ProbeAttempt:
+    """One structured TPU-probe attempt record (bench.py)."""
+    index: int
+    started_at: str
+    finished_at: str | None = None
+    timeout_s: float | None = None
+    outcome: str | None = None      # ok | timeout | error | cpu-fallback
+    error_class: str | None = None  # e.g. TimeoutExpired, CalledProcessError
+    message: str | None = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class RunManifest:
+    kind: str
+    run_id: str = dataclasses.field(
+        default_factory=lambda: uuid.uuid4().hex[:12])
+    status: str = "running"
+    started_at: str = dataclasses.field(default_factory=_utcnow)
+    finished_at: str | None = None
+    duration_s: float | None = None
+    environment: dict = dataclasses.field(default_factory=dict)
+    config: dict = dataclasses.field(default_factory=dict)
+    phases: list = dataclasses.field(default_factory=list)
+    metrics: dict = dataclasses.field(default_factory=dict)
+    probe_attempts: list = dataclasses.field(default_factory=list)
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def begin(cls, kind: str, config: dict = None,
+              devices: bool = True) -> "RunManifest":
+        """Start a manifest: stamps run id, start time, environment, and
+        a baseline of the span aggregate so ``finish()`` reports phase
+        times for THIS run only (the aggregate is process-cumulative)."""
+        m = cls(kind=kind, config=dict(config or {}),
+                environment=capture_environment(devices=devices))
+        from raft_tpu.obs import tracing as _tracing
+        m._phase_baseline = _tracing.aggregate()
+        return m
+
+    def add_probe_attempt(self, attempt: ProbeAttempt | dict):
+        if isinstance(attempt, ProbeAttempt):
+            attempt = attempt.to_dict()
+        self.probe_attempts.append(dict(attempt))
+
+    def finish(self, status: str = "ok", metrics: dict = None,
+               phases: list = None) -> "RunManifest":
+        """Stamp the end time and fold in the metrics snapshot and the
+        per-phase wall times.  Defaults: the process-wide registry
+        (snapshots are cumulative, Prometheus-style) and the span
+        aggregate MINUS the baseline captured by ``begin()`` — so
+        ``phases`` covers this run only even when several runs share
+        the process."""
+        if status not in _STATUSES:
+            raise ValueError(f"status {status!r} not in {_STATUSES}")
+        self.finished_at = _utcnow()
+        t0 = datetime.datetime.fromisoformat(self.started_at)
+        t1 = datetime.datetime.fromisoformat(self.finished_at)
+        self.duration_s = (t1 - t0).total_seconds()
+        self.status = status
+        if metrics is None:
+            from raft_tpu.obs import metrics as _metrics
+            if _metrics._JAX_HOOKS.get("mode") == "jit-cache-poll":
+                # the fallback compile-telemetry path has no listener to
+                # push events — pull one sample so manifests still carry
+                # compile counts on jax builds without jax.monitoring
+                _metrics.sample_jit_cache()
+            metrics = _metrics.snapshot()
+        self.metrics = metrics
+        if phases is None:
+            from raft_tpu.obs import tracing as _tracing
+            base = getattr(self, "_phase_baseline", {})
+            phases = []
+            for name, (tot, calls) in _tracing.aggregate().items():
+                tot0, calls0 = base.get(name, (0.0, 0))
+                if calls > calls0:
+                    phases.append({"name": name, "total_s": tot - tot0,
+                                   "calls": calls - calls0})
+            phases.sort(key=lambda p: -p["total_s"])
+        self.phases = phases
+        return self
+
+    def to_dict(self) -> dict:
+        d = {"schema": SCHEMA}
+        d.update(dataclasses.asdict(self))
+        return {k: d[k] for k in REQUIRED_KEYS}
+
+    def write(self, path: str) -> str:
+        """Serialize to JSON at ``path``; returns the path."""
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, default=str)
+        os.replace(tmp, path)
+        return path
+
+
+def validate_manifest(doc: dict) -> list[str]:
+    """Structural check of a serialized manifest against the v1 schema;
+    returns a list of problems (empty == valid)."""
+    problems = []
+    if not isinstance(doc, dict):
+        return ["manifest is not an object"]
+    if doc.get("schema") != SCHEMA:
+        problems.append(f"schema is {doc.get('schema')!r}, expected {SCHEMA}")
+    for k in REQUIRED_KEYS:
+        if k not in doc:
+            problems.append(f"missing key {k!r}")
+    extra_keys = set(doc) - set(REQUIRED_KEYS)
+    if extra_keys:
+        problems.append(f"unknown top-level keys {sorted(extra_keys)}")
+    if doc.get("status") not in _STATUSES:
+        problems.append(f"status {doc.get('status')!r} not in {_STATUSES}")
+    for k in ("environment", "config", "metrics", "extra"):
+        if k in doc and not isinstance(doc[k], dict):
+            problems.append(f"{k} is not an object")
+    for k in ("phases", "probe_attempts"):
+        if k in doc and not isinstance(doc[k], list):
+            problems.append(f"{k} is not a list")
+    for i, att in enumerate(doc.get("probe_attempts") or []):
+        if not isinstance(att, dict):
+            problems.append(f"probe_attempts[{i}] is not an object")
+            continue
+        for k in ("index", "started_at", "outcome"):
+            if k not in att:
+                problems.append(f"probe_attempts[{i}] missing {k!r}")
+    return problems

@@ -1,0 +1,312 @@
+"""Process-wide metrics: counters, gauges, histograms with labels.
+
+Solver-health series the frequency-domain stack actually needs — drag
+fixed-point iteration counts/residuals per load case, dynamics-solve
+condition numbers, JAX compile events — recorded through one locked
+registry and exported two ways:
+
+- ``snapshot()``: a plain-JSON dict (embedded in run manifests);
+- ``to_prometheus()``: Prometheus text exposition format (label-value
+  escaping, cumulative histogram buckets, ``_sum``/``_count``).
+
+``install_jax_hooks()`` wires JAX compile/retrace telemetry into the
+registry via ``jax.monitoring`` listeners when that API exists, falling
+back to polling the jit cache-miss counters where it does not.
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0)
+#: iteration-count shaped buckets (drag fixed points, Newton loops)
+ITER_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 50.0)
+
+
+def _labelkey(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+
+    def _bump(self, labels: dict, amount: float, absolute: bool):
+        key = _labelkey(labels)
+        with self._lock:
+            if absolute:
+                self._values[key] = float(amount)
+            else:
+                self._values[key] = self._values.get(key, 0.0) + float(amount)
+
+    def series(self) -> list[dict]:
+        with self._lock:
+            return [{"labels": dict(k), "value": v}
+                    for k, v in sorted(self._values.items())]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels):
+        if amount < 0:
+            raise ValueError("counters only go up")
+        self._bump(labels, amount, absolute=False)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels):
+        self._bump(labels, value, absolute=True)
+
+    def inc(self, amount: float = 1.0, **labels):
+        self._bump(labels, amount, absolute=False)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        # per label set: [bucket_counts..., +Inf count is implicit via n]
+        self._hist: dict[tuple, dict] = {}
+
+    def observe(self, value: float, **labels):
+        value = float(value)
+        key = _labelkey(labels)
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = {
+                    "counts": [0] * len(self.buckets), "sum": 0.0, "n": 0}
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    h["counts"][i] += 1
+            h["sum"] += value
+            h["n"] += 1
+
+    def observe_many(self, values, **labels):
+        for v in values:
+            self.observe(v, **labels)
+
+    def series(self) -> list[dict]:
+        with self._lock:
+            out = []
+            for key, h in sorted(self._hist.items()):
+                cum = {}
+                running = 0
+                for i, b in enumerate(self.buckets):
+                    # counts[] is already cumulative per bucket boundary
+                    running = h["counts"][i]
+                    cum[_fmt_float(b)] = running
+                cum["+Inf"] = h["n"]
+                out.append({"labels": dict(key), "count": h["n"],
+                            "sum": h["sum"], "buckets": cum})
+            return out
+
+
+def _fmt_float(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(float(v))
+    return repr(float(v))
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _labelstr(labels: dict, extra: dict = None) -> str:
+    items = dict(labels)
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(v)}"'
+                    for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+    def snapshot(self) -> dict:
+        """JSON-able {name: {kind, help, series}} of everything recorded."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: {"kind": m.kind, "help": m.help,
+                         "series": m.series()} for m in metrics}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (version 0.0.4)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines = []
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            if isinstance(m, Histogram):
+                for s in m.series():
+                    labels = s["labels"]
+                    for le, c in s["buckets"].items():
+                        lines.append(
+                            f"{m.name}_bucket"
+                            f"{_labelstr(labels, {'le': le})} {c}")
+                    lines.append(f"{m.name}_sum{_labelstr(labels)} "
+                                 f"{_fmt_value(s['sum'])}")
+                    lines.append(f"{m.name}_count{_labelstr(labels)} "
+                                 f"{s['count']}")
+            else:
+                for s in m.series():
+                    lines.append(f"{m.name}{_labelstr(s['labels'])} "
+                                 f"{_fmt_value(s['value'])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+#: the process-wide registry every raft_tpu component records into
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+    return REGISTRY.histogram(name, help, buckets=buckets)
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()
+
+
+def to_prometheus() -> str:
+    return REGISTRY.to_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# JAX compile/retrace telemetry
+# ---------------------------------------------------------------------------
+
+_JAX_HOOKS = {"installed": False, "mode": None}
+_HOOK_LOCK = threading.Lock()
+
+
+def install_jax_hooks() -> str:
+    """Wire JAX compile/retrace telemetry into the registry (idempotent).
+
+    Preferred: ``jax.monitoring`` event listeners — every recorded event
+    (``/jax/core/compile`` etc.) increments
+    ``raft_jax_events_total{event=...}`` and duration events accumulate
+    into ``raft_jax_event_duration_seconds_total``.  Fallback when that
+    API is missing: ``sample_jit_cache()`` polls jit cache hit/miss
+    counts into gauges.  Returns the active mode string.
+    """
+    with _HOOK_LOCK:
+        if _JAX_HOOKS["installed"]:
+            return _JAX_HOOKS["mode"]
+        mode = "unavailable"
+        try:
+            from jax import monitoring
+
+            # the counters are resolved through the registry on EVERY
+            # event (not captured at install time) so telemetry survives
+            # a REGISTRY.reset() between runs — the listeners themselves
+            # cannot be uninstalled
+            def _on_event(event, *a, **kw):
+                counter(
+                    "raft_jax_events_total",
+                    "JAX monitoring events (compiles, retraces) by "
+                    "event name").inc(1.0, event=event)
+
+            def _on_duration(event, duration=0.0, *a, **kw):
+                try:
+                    counter(
+                        "raft_jax_event_duration_seconds_total",
+                        "Cumulative duration of JAX monitoring duration "
+                        "events").inc(float(duration), event=event)
+                except (TypeError, ValueError):    # pragma: no cover
+                    pass
+
+            monitoring.register_event_listener(_on_event)
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            mode = "jax.monitoring"
+        except Exception:
+            mode = "jit-cache-poll"
+        _JAX_HOOKS.update(installed=True, mode=mode)
+        return mode
+
+
+def sample_jit_cache() -> dict | None:
+    """Poll jit cache hit/miss counters into gauges — the fallback
+    compile-telemetry path for JAX builds without ``jax.monitoring``
+    (and a cheap on-demand sample anywhere).  Returns the stats dict or
+    None when no known cache-info hook exists in this JAX build."""
+    try:
+        import jax
+        info = jax._src.pjit._infer_params_cached.cache_info()  # noqa: SLF001
+    except Exception:
+        try:
+            import jax
+            info = jax._src.pjit._create_pjit_jaxpr.cache_info()  # noqa: SLF001
+        except Exception:
+            return None
+    stats = {"hits": int(info.hits), "misses": int(info.misses)}
+    gauge("raft_jit_cache_hits",
+          "jit cache hits sampled from the pjit lowering cache"
+          ).set(stats["hits"])
+    gauge("raft_jit_cache_misses",
+          "jit cache misses (each miss is a trace+compile)"
+          ).set(stats["misses"])
+    return stats

@@ -1,0 +1,183 @@
+"""Span-based tracing: nested wall-time spans with attributes.
+
+The process-wide tracer records every finished span into (a) a bounded
+event buffer exportable as Chrome-trace/Perfetto JSON and (b) a locked
+name -> (total_seconds, calls) aggregate that subsumes the old
+``utils.profiling`` flat timing registry (``timed()`` is now a shim over
+``span()`` and ``timing_report()`` reads ``aggregate()``).
+
+Usage::
+
+    from raft_tpu import obs
+
+    with obs.span("solveDynamics", case=3) as sp:
+        ...
+        sp.set(cond_max=1.2e4)          # attach attributes mid-span
+
+    obs.export_chrome_trace("trace.json")   # load in ui.perfetto.dev
+
+Spans nest through a thread-local stack, so concurrent host threads (the
+pmapped sweep) each get their own correctly-nested stack while sharing
+the global buffer/aggregate under a lock.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+#: hard cap on buffered span events — a runaway sweep must not OOM the
+#: host; past the cap spans still feed the aggregate but drop from the
+#: Chrome-trace buffer (`dropped_spans()` reports how many)
+MAX_SPANS = 200_000
+
+_LOCK = threading.Lock()
+_SPANS: list[dict] = []
+_AGG: dict[str, list] = {}          # name -> [total_seconds, calls]
+_DROPPED = 0
+_T0 = time.perf_counter()           # trace time origin (relative us in export)
+_LOCAL = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_LOCAL, "stack", None)
+    if st is None:
+        st = _LOCAL.stack = []
+    return st
+
+
+def _jsonable(v):
+    """Best-effort JSON-safe conversion for span attributes (numpy and
+    jax scalars become Python numbers, everything else falls back to str)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    try:
+        import numpy as np
+        if isinstance(v, np.generic):
+            return v.item()
+    except ImportError:                      # pragma: no cover
+        pass
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+class ActiveSpan:
+    """Handle yielded by ``span()``: carries the name/attrs and accepts
+    late attributes via ``set(**attrs)`` while the span is open."""
+
+    __slots__ = ("name", "attrs", "t0", "depth", "parent")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = {k: _jsonable(v) for k, v in attrs.items()}
+        self.t0 = 0.0
+        self.depth = 0
+        self.parent = None
+
+    def set(self, **attrs):
+        for k, v in attrs.items():
+            self.attrs[k] = _jsonable(v)
+        return self
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Open a nested, attributed wall-time span around a code block."""
+    global _DROPPED
+    sp = ActiveSpan(name, attrs)
+    stack = _stack()
+    sp.parent = stack[-1].name if stack else None
+    sp.depth = len(stack)
+    stack.append(sp)
+    sp.t0 = time.perf_counter()
+    try:
+        yield sp
+    finally:
+        dur = time.perf_counter() - sp.t0
+        if stack and stack[-1] is sp:
+            stack.pop()
+        event = {
+            "name": name,
+            "ts": sp.t0 - _T0,
+            "dur": dur,
+            "tid": threading.get_ident(),
+            "depth": sp.depth,
+            "parent": sp.parent,
+            "attrs": dict(sp.attrs),
+        }
+        with _LOCK:
+            entry = _AGG.setdefault(name, [0.0, 0])
+            entry[0] += dur
+            entry[1] += 1
+            if len(_SPANS) < MAX_SPANS:
+                _SPANS.append(event)
+            else:
+                _DROPPED += 1
+
+
+def current_span() -> ActiveSpan | None:
+    """The innermost open span on this thread, or None."""
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+def spans() -> list[dict]:
+    """Snapshot of the finished-span buffer (oldest first)."""
+    with _LOCK:
+        return [dict(e) for e in _SPANS]
+
+
+def dropped_spans() -> int:
+    with _LOCK:
+        return _DROPPED
+
+
+def aggregate(reset: bool = False) -> dict:
+    """{name: (total_seconds, calls)} across all finished spans."""
+    with _LOCK:
+        out = {k: tuple(v) for k, v in _AGG.items()}
+        if reset:
+            _AGG.clear()
+    return out
+
+
+def reset():
+    """Clear the span buffer AND the aggregate (open spans unaffected)."""
+    global _DROPPED
+    with _LOCK:
+        _SPANS.clear()
+        _AGG.clear()
+        _DROPPED = 0
+
+
+def chrome_trace() -> dict:
+    """The finished spans as a Chrome Trace Event Format object
+    (``{"traceEvents": [...]}``, "X" complete events, microsecond
+    timestamps) — loadable in ui.perfetto.dev or chrome://tracing."""
+    pid = os.getpid()
+    events = []
+    for e in spans():
+        events.append({
+            "name": e["name"],
+            "cat": "raft_tpu",
+            "ph": "X",
+            "ts": e["ts"] * 1e6,
+            "dur": e["dur"] * 1e6,
+            "pid": pid,
+            "tid": e["tid"],
+            "args": e["attrs"],
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write ``chrome_trace()`` as JSON; returns the path."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(chrome_trace(), f)
+    return path

@@ -347,7 +347,7 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
         st = jax.vmap(setup)(thetas)
         nv = st["Xeq"].shape[0]
         Xi0 = jnp.zeros((nv, 6, nw), dtype=complex) + XiStart
-        _, Xi, _ = unrolled_fixed_point(
+        _, Xi, _, _ = unrolled_fixed_point(
             lambda XiLast: drag_step(st, XiLast), Xi0, nIter + 1, tol)
         return _finish(st, Xi)
 

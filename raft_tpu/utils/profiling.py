@@ -1,27 +1,33 @@
-"""Structured timing, logging and jax-profiler hooks.
+"""Logging helpers + thin compatibility shim over :mod:`raft_tpu.obs`.
 
-The reference has one ad-hoc `time.perf_counter` pair around its QTF
-kernel and bare prints everywhere (reference: raft_model.py:980-984;
-SURVEY §5.1 asks for real tracing as a feature, not a port).  This module
-provides:
+The real observability layer now lives in ``raft_tpu.obs`` (span-based
+tracing with Chrome-trace export, a metrics registry with Prometheus
+exposition, structured run manifests) — new code should use
+``obs.span(...)`` / ``obs.counter(...)`` directly.  This module keeps
+the original flat-timing API working on top of it:
 
-- `timed(name)`: context manager accumulating wall time per section into
-  a process-wide registry (`timing_report()` to dump it); used around the
-  Model phases (statics / dynamics / QTF / outputs).
-- `trace(dir)`: context manager around `jax.profiler.start_trace` /
-  `stop_trace` for XLA-level traces viewable in TensorBoard/Perfetto.
-- `get_logger(name)`: namespaced loggers under "raft_tpu" with a single
-  stderr handler; `set_verbosity(n)` maps the reference's integer
-  `display` levels onto logging levels.
+- `timed(name)`: now a shim over ``obs.span(name)``; every span feeds a
+  LOCKED process-wide name -> (total_s, calls) aggregate, so the old
+  registry is thread-safe under the pmapped sweep's host threads (it
+  previously lost counts to unlocked read-modify-write).
+- `timing_report()` / `print_timing_report()`: read that aggregate —
+  they now also see every ``obs.span`` (``solveStatics``,
+  ``solveDynamics``, ``calcQTF_slenderBody``, ...), not just ``timed``.
+- `trace(dir)`: XLA-level ``jax.profiler`` trace (TensorBoard/Perfetto).
+- `get_logger(name)` / `set_verbosity(n)`: namespaced loggers under
+  "raft_tpu"; ``set_verbosity`` maps the reference's integer `display`
+  levels onto logging levels.
 """
 from __future__ import annotations
 
 import contextlib
 import logging
-import time
-from collections import defaultdict
 
-_TIMINGS = defaultdict(lambda: [0.0, 0])     # name -> [total_s, calls]
+from raft_tpu.obs import tracing as _tracing
+
+#: backward-compat alias: the (now lock-guarded) accumulate registry —
+#: the storage itself lives in obs.tracing and is shared with spans
+_TIMINGS = _tracing._AGG
 
 _ROOT = "raft_tpu"
 
@@ -43,43 +49,57 @@ def set_verbosity(display: int):
     (0 = warnings only, 1 = info, 2+ = debug)."""
     level = (logging.WARNING if display <= 0
              else logging.INFO if display == 1 else logging.DEBUG)
+    get_logger()   # ensure the handler exists (it installs WARNING)
     logging.getLogger(_ROOT).setLevel(level)
-    get_logger()   # ensure the handler exists
+
+
+@contextlib.contextmanager
+def temp_verbosity(display: int):
+    """Per-call verbosity override mirroring the reference's ``display``
+    arguments: ``display > 0`` raises the raft_tpu logger for the block
+    and RESTORES the previous level after; ``display <= 0`` leaves the
+    ambient verbosity (a user's ``set_verbosity``) untouched."""
+    if display <= 0:
+        yield
+        return
+    root = logging.getLogger(_ROOT)
+    prev = root.level
+    set_verbosity(display)
+    try:
+        yield
+    finally:
+        root.setLevel(prev)
 
 
 @contextlib.contextmanager
 def timed(name: str, logger: logging.Logger = None):
-    """Accumulate wall time for a named section; optionally log it at
-    DEBUG (the reference's QTF timing print, raft_model.py:980-984,
-    becomes `timed('qtf')`)."""
+    """Accumulate wall time for a named section (shim over
+    ``obs.span``); optionally log it at DEBUG."""
+    import time
     t0 = time.perf_counter()
     try:
-        yield
+        with _tracing.span(name):
+            yield
     finally:
-        dt = time.perf_counter() - t0
-        entry = _TIMINGS[name]
-        entry[0] += dt
-        entry[1] += 1
-        (logger or get_logger("timing")).debug("%s: %.4f s", name, dt)
+        (logger or get_logger("timing")).debug(
+            "%s: %.4f s", name, time.perf_counter() - t0)
 
 
 def timing_report(reset: bool = False) -> dict:
-    """{section: (total_seconds, calls)} accumulated so far."""
-    out = {k: tuple(v) for k, v in _TIMINGS.items()}
-    if reset:
-        _TIMINGS.clear()
-    return out
+    """{section: (total_seconds, calls)} accumulated so far — fed by
+    both ``timed()`` and every ``obs.span``."""
+    return _tracing.aggregate(reset=reset)
 
 
 def print_timing_report():
     rep = timing_report()
     if not rep:
-        print("no timed sections recorded")
+        print("no timed sections recorded")          # print-ok: report printer
         return
     width = max(len(k) for k in rep)
-    print(f"{'section'.ljust(width)}  total [s]   calls   per-call [s]")
+    print(f"{'section'.ljust(width)}  total [s]   calls   per-call [s]")  # print-ok: report printer
     for k, (tot, n) in sorted(rep.items(), key=lambda kv: -kv[1][0]):
-        print(f"{k.ljust(width)}  {tot:9.4f}   {n:5d}   {tot / max(n, 1):10.5f}")
+        print(f"{k.ljust(width)}  {tot:9.4f}   {n:5d}   {tot / max(n, 1):10.5f}")  # print-ok: report printer
 
 
 @contextlib.contextmanager

@@ -1,0 +1,39 @@
+"""Lint tier-1 guard: no bare ``print(`` in raft_tpu/ library code.
+
+Library output goes through ``utils.profiling.get_logger`` (honoring
+``set_verbosity``) or the obs layer.  Exempt: ``plot.py`` (interactive
+plotting module) and explicit report-printer lines tagged with a
+``# print-ok`` comment (e.g. ``print_timing_report``, whose whole job is
+writing a table to stdout)."""
+import os
+import re
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "raft_tpu")
+
+#: a call of the print builtin (not e.g. ``print_timing_report(`` or a
+#: ``.print(`` method)
+BARE_PRINT = re.compile(r"(?<![\w.])print\(")
+
+EXEMPT_FILES = {"plot.py"}
+EXEMPT_MARK = "# print-ok"
+
+
+def test_no_bare_prints_in_library():
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(PKG):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py") or fname in EXEMPT_FILES:
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    if EXEMPT_MARK in line:
+                        continue
+                    if BARE_PRINT.search(line):
+                        rel = os.path.relpath(path, os.path.dirname(PKG))
+                        offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "bare print() calls in library code (use profiling.get_logger or "
+        "tag a deliberate report printer with '# print-ok'):\n"
+        + "\n".join(offenders))

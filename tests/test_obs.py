@@ -1,0 +1,386 @@
+"""Unit tests for the raft_tpu.obs observability layer.
+
+Covers the tentpole guarantees: span nesting and Chrome-trace JSON
+round-trip, Prometheus text-exposition correctness (label escaping,
+cumulative histogram buckets, _sum/_count), run-manifest schema
+stability, the thread-safety of the utils.profiling ``timed()`` shim,
+and the bench TPU-probe structured attempt records + manifest writes on
+both exit paths (subprocesses monkeypatched — no backend init).
+"""
+import json
+import os
+import threading
+
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs import manifest as obs_manifest
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs import tracing as obs_tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Each test sees an empty tracer/registry and no output dir."""
+    obs.reset_tracing()
+    obs.REGISTRY.reset()
+    obs.configure(None)
+    old_env = os.environ.pop("RAFT_TPU_OBS_DIR", None)
+    yield
+    obs.reset_tracing()
+    obs.REGISTRY.reset()
+    obs.configure(None)
+    if old_env is not None:
+        os.environ["RAFT_TPU_OBS_DIR"] = old_env
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_depth_and_parent():
+    with obs.span("outer", case=0):
+        with obs.span("middle"):
+            with obs.span("inner", x=1.5):
+                cur = obs.current_span()
+                assert cur.name == "inner"
+        with obs.span("middle2"):
+            pass
+    by_name = {e["name"]: e for e in obs.spans()}
+    assert by_name["outer"]["depth"] == 0
+    assert by_name["outer"]["parent"] is None
+    assert by_name["middle"]["depth"] == 1
+    assert by_name["middle"]["parent"] == "outer"
+    assert by_name["inner"]["depth"] == 2
+    assert by_name["inner"]["parent"] == "middle"
+    assert by_name["middle2"]["parent"] == "outer"
+    # children finish before parents; buffer is completion-ordered
+    names = [e["name"] for e in obs.spans()]
+    assert names.index("inner") < names.index("outer")
+
+
+def test_span_attributes_and_late_set():
+    with obs.span("s", a=1, b="x") as sp:
+        sp.set(c=2.5)
+    (e,) = obs.spans()
+    assert e["attrs"] == {"a": 1, "b": "x", "c": 2.5}
+
+
+def test_span_attrs_jsonable():
+    import numpy as np
+    with obs.span("s", n=np.int64(3), f=np.float32(1.5), o=object()):
+        pass
+    (e,) = obs.spans()
+    assert e["attrs"]["n"] == 3
+    assert e["attrs"]["f"] == 1.5
+    assert isinstance(e["attrs"]["o"], str)
+    json.dumps(e)        # everything serializable
+
+
+def test_span_records_even_on_exception():
+    with pytest.raises(RuntimeError):
+        with obs.span("boom"):
+            raise RuntimeError("x")
+    assert obs.aggregate()["boom"][1] == 1
+
+
+def test_chrome_trace_roundtrip(tmp_path):
+    with obs.span("outer", case=1):
+        with obs.span("inner"):
+            pass
+    path = obs.export_chrome_trace(str(tmp_path / "t.json"))
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    assert {e["name"] for e in events} == {"outer", "inner"}
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["pid"] == os.getpid()
+        assert e["dur"] >= 0.0
+    outer = next(e for e in events if e["name"] == "outer")
+    inner = next(e for e in events if e["name"] == "inner")
+    # nesting is encoded by time containment on the same tid (what
+    # Perfetto renders as stacked slices)
+    assert outer["tid"] == inner["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"case": 1}
+
+
+def test_span_buffer_cap_feeds_aggregate(monkeypatch):
+    monkeypatch.setattr(obs_tracing, "MAX_SPANS", 3)
+    for _ in range(5):
+        with obs.span("s"):
+            pass
+    assert len(obs.spans()) == 3
+    assert obs.dropped_spans() == 2
+    assert obs.aggregate()["s"][1] == 5     # aggregate never drops
+
+
+def test_timed_shim_feeds_spans_and_is_thread_safe():
+    from raft_tpu.utils.profiling import timed, timing_report
+
+    n_threads, n_each = 8, 200
+
+    def work():
+        for _ in range(n_each):
+            with timed("hot"):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep = timing_report()
+    assert rep["hot"][1] == n_threads * n_each     # no lost counts
+    # the shim and the span aggregate are the same storage
+    assert obs.aggregate()["hot"] == rep["hot"]
+    assert timing_report(reset=True)["hot"][1] == n_threads * n_each
+    assert "hot" not in timing_report()
+
+
+def test_set_verbosity_first_call_in_fresh_process():
+    """set_verbosity must win over get_logger's WARNING default even when
+    it is the first profiling call in the process (the handler install
+    used to run after setLevel and clobber it)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run([sys.executable, "-c", (
+        "import logging\n"
+        "from raft_tpu.utils.profiling import set_verbosity\n"
+        "set_verbosity(1)\n"
+        "print(logging.getLogger('raft_tpu').level)\n")],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": ""})
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "20"      # INFO
+
+
+def test_temp_verbosity_restores_and_respects_ambient():
+    """display>0 raises the level for the block and restores it after;
+    display=0 leaves a user's ambient set_verbosity untouched."""
+    import logging
+
+    from raft_tpu.utils.profiling import set_verbosity, temp_verbosity
+
+    root = logging.getLogger("raft_tpu")
+    set_verbosity(2)                      # user-chosen ambient: DEBUG
+    try:
+        with temp_verbosity(0):           # display=0 call: no clobber
+            assert root.level == logging.DEBUG
+        with temp_verbosity(1):           # display=1 call: INFO inside...
+            assert root.level == logging.INFO
+        assert root.level == logging.DEBUG   # ...restored after
+    finally:
+        set_verbosity(0)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    c = obs.counter("t_total", "help text")
+    c.inc()
+    c.inc(2, case="0")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = obs.gauge("t_gauge")
+    g.set(1.5, case="0")
+    g.set(2.5, case="0")            # absolute overwrite
+    snap = obs.snapshot()
+    assert snap["t_total"]["kind"] == "counter"
+    values = {tuple(s["labels"].items()): s["value"]
+              for s in snap["t_total"]["series"]}
+    assert values[()] == 1.0
+    assert values[(("case", "0"),)] == 2.0
+    assert snap["t_gauge"]["series"] == [
+        {"labels": {"case": "0"}, "value": 2.5}]
+
+
+def test_metric_kind_collision_raises():
+    obs.counter("t_kind")
+    with pytest.raises(TypeError):
+        obs.gauge("t_kind")
+
+
+def test_histogram_buckets_cumulative():
+    h = obs.histogram("t_hist", buckets=(1.0, 2.0, 5.0))
+    for v in (0.5, 1.0, 1.5, 3.0, 10.0):
+        h.observe(v)
+    (s,) = h.series()
+    assert s["count"] == 5
+    assert s["sum"] == pytest.approx(16.0)
+    assert s["buckets"] == {"1.0": 2, "2.0": 3, "5.0": 4, "+Inf": 5}
+    # cumulativity invariant: each bucket count >= the previous
+    counts = list(s["buckets"].values())
+    assert counts == sorted(counts)
+
+
+def test_prometheus_exposition_format():
+    c = obs.counter("t_req_total", 'requests with "quotes"\nand newline')
+    c.inc(3, path='va"l\\ue')
+    h = obs.histogram("t_lat", "latency", buckets=(0.1, 1.0))
+    h.observe(0.25)
+    h.observe(0.5)
+    text = obs.to_prometheus()
+    lines = text.splitlines()
+    assert '# HELP t_req_total requests with "quotes"\\nand newline' in lines
+    assert "# TYPE t_req_total counter" in lines
+    assert 't_req_total{path="va\\"l\\\\ue"} 3' in lines
+    assert "# TYPE t_lat histogram" in lines
+    assert 't_lat_bucket{le="0.1"} 0' in lines
+    assert 't_lat_bucket{le="1.0"} 2' in lines
+    assert 't_lat_bucket{le="+Inf"} 2' in lines
+    assert "t_lat_sum 0.75" in lines
+    assert "t_lat_count 2" in lines
+    assert text.endswith("\n")
+
+
+def test_observe_many():
+    h = obs.histogram("t_iters", buckets=obs.ITER_BUCKETS)
+    h.observe_many([1, 2, 3, 4], case="0")
+    (s,) = h.series()
+    assert s["count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# manifests
+# ---------------------------------------------------------------------------
+
+def test_manifest_schema_stability(tmp_path):
+    m = obs.RunManifest.begin("unit", config={"a": 1}, devices=False)
+    obs.counter("t_c").inc()
+    with obs.span("phase1"):
+        pass
+    m.finish("ok")
+    doc = m.to_dict()
+    # exact top-level key set is the schema contract
+    assert tuple(doc.keys()) == obs_manifest.REQUIRED_KEYS
+    assert obs.validate_manifest(doc) == []
+    assert doc["schema"] == obs.SCHEMA
+    assert doc["config"] == {"a": 1}
+    assert [p["name"] for p in doc["phases"]] == ["phase1"]
+    assert "t_c" in doc["metrics"]
+    assert doc["duration_s"] >= 0.0
+    # round-trips through JSON and still validates
+    path = m.write(str(tmp_path / "m.json"))
+    assert obs.validate_manifest(json.load(open(path))) == []
+
+
+def test_manifest_phases_are_per_run():
+    """Back-to-back manifests in one process must not leak the first
+    run's span totals into the second's phases (the aggregate is
+    process-cumulative; begin() snapshots a baseline)."""
+    m1 = obs.RunManifest.begin("unit", devices=False)
+    with obs.span("work"):
+        pass
+    m1.finish("ok")
+    m2 = obs.RunManifest.begin("unit", devices=False)
+    with obs.span("work"):
+        pass
+    with obs.span("extra"):
+        pass
+    m2.finish("ok")
+    p1 = {p["name"]: p for p in m1.phases}
+    p2 = {p["name"]: p for p in m2.phases}
+    assert p1["work"]["calls"] == 1
+    assert p2["work"]["calls"] == 1          # not 2: per-run delta
+    assert p2["extra"]["calls"] == 1
+    assert p2["work"]["total_s"] <= p1["work"]["total_s"] + m2.duration_s
+
+
+def test_manifest_validation_catches_problems():
+    m = obs.RunManifest.begin("unit", devices=False).finish("ok")
+    doc = m.to_dict()
+    bad = dict(doc)
+    del bad["phases"]
+    bad["status"] = "nope"
+    bad["surprise"] = 1
+    problems = obs.validate_manifest(bad)
+    assert any("phases" in p for p in problems)
+    assert any("status" in p for p in problems)
+    assert any("surprise" in p for p in problems)
+    with pytest.raises(ValueError):
+        obs.RunManifest.begin("unit", devices=False).finish("bogus")
+
+
+def test_manifest_probe_attempts():
+    m = obs.RunManifest.begin("bench", devices=False)
+    m.add_probe_attempt(obs.ProbeAttempt(
+        index=0, started_at="2026-08-03T00:00:00+00:00", timeout_s=240.0,
+        outcome="timeout", error_class="TimeoutExpired"))
+    m.add_probe_attempt({"index": 1,
+                         "started_at": "2026-08-03T00:05:00+00:00",
+                         "outcome": "ok"})
+    doc = m.finish("tpu_unavailable").to_dict()
+    assert obs.validate_manifest(doc) == []
+    assert doc["probe_attempts"][0]["error_class"] == "TimeoutExpired"
+    assert doc["status"] == "tpu_unavailable"
+
+
+def test_environment_capture_no_devices():
+    env = obs.capture_environment(devices=False)
+    assert env["backend"] is None and env["device_count"] is None
+    assert "jax_version" in env
+    env2 = obs.capture_environment(devices=True)   # cpu backend in tests
+    assert env2["backend"] == "cpu"
+    assert env2["device_count"] >= 1
+
+
+def test_finish_run_writes_manifest_and_trace(tmp_path):
+    obs.configure(str(tmp_path))
+    m = obs.RunManifest.begin("unit", devices=False)
+    with obs.span("p"):
+        pass
+    paths = obs.finish_run(m, status="ok")
+    assert os.path.isfile(paths["manifest"])
+    assert os.path.isfile(paths["trace"])
+    assert obs.validate_manifest(json.load(open(paths["manifest"]))) == []
+    assert json.load(open(paths["trace"]))["traceEvents"]
+
+
+def test_finish_run_without_dir_writes_nothing(tmp_path):
+    m = obs.RunManifest.begin("unit", devices=False)
+    paths = obs.finish_run(m, status="ok")
+    assert paths == {"manifest": None, "trace": None}
+    assert m.status == "ok"
+
+
+# ---------------------------------------------------------------------------
+# metrics registry defaults referenced by the instrumented stack
+# ---------------------------------------------------------------------------
+
+def test_install_jax_hooks_idempotent():
+    mode1 = obs.install_jax_hooks()
+    mode2 = obs.install_jax_hooks()
+    assert mode1 == mode2
+    assert mode1 in ("jax.monitoring", "jit-cache-poll", "unavailable")
+
+
+def test_sweep_iteration_metrics_recorded():
+    """sweep_cases must histogram per-case fixed-point iterations and
+    finish a sweep_cases manifest (no file output configured here)."""
+    import numpy as np
+
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.models.fowt import build_fowt
+    from raft_tpu.parallel.sweep import sweep_cases
+
+    design = load_design("OC3spar")
+    w = np.arange(0.05, 0.4, 0.05) * 2 * np.pi
+    fowt = build_fowt(design, w,
+                      depth=float(design["site"]["water_depth"]))
+    out = sweep_cases(fowt, [4.0, 6.0], [9.0, 11.0], [0.0, 0.5], nIter=4)
+    iters = np.asarray(out["iters"])
+    assert iters.shape == (2,)
+    assert (iters >= 1).all() and (iters <= 4).all()
+    snap = obs.snapshot()
+    (s,) = snap["raft_sweep_fixed_point_iterations"]["series"]
+    assert s["count"] == 2
+    assert "raft_sweep_converged_cases" in snap
+    agg = obs.aggregate()
+    for name in ("sweep_cases", "sweep_build", "sweep_execute"):
+        assert name in agg

@@ -1,0 +1,114 @@
+"""bench.py observability: structured TPU-probe attempt records and run
+manifests on BOTH exit paths (success is exercised end-to-end by the
+driver; here the probe/unavailable machinery runs with every subprocess
+monkeypatched so no test ever initializes a backend or sleeps through
+retry backoff)."""
+import json
+import os
+import subprocess
+
+import pytest
+
+import raft_tpu  # noqa: F401  (x64 config before bench's setdefault)
+from raft_tpu import obs
+
+import bench
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(tmp_path):
+    obs.reset_tracing()
+    obs.REGISTRY.reset()
+    obs.configure(str(tmp_path))
+    yield tmp_path
+    obs.reset_tracing()
+    obs.REGISTRY.reset()
+    obs.configure(None)
+
+
+class _FakeCompleted:
+    def __init__(self, returncode=0, stdout="", stderr=""):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def test_probe_timeout_produces_structured_attempts(monkeypatch):
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    ok, info = bench._tpu_probe(timeout_s=7, retries=2, backoff_s=0.01)
+    assert not ok
+    atts = info["attempts"]
+    assert len(atts) == 2
+    for i, att in enumerate(atts):
+        assert att["index"] == i
+        assert att["outcome"] == "timeout"
+        assert att["error_class"] == "TimeoutExpired"
+        assert att["timeout_s"] == 7.0
+        assert att["started_at"] and att["finished_at"]
+    json.dumps(atts)     # manifest-embeddable
+
+
+def test_probe_cpu_fallback_and_error_classified(monkeypatch):
+    outs = [_FakeCompleted(0, "PROBE_OK cpu 1\n"),
+            _FakeCompleted(1, "", "boom\nRuntimeError: tunnel dead")]
+
+    monkeypatch.setattr(subprocess, "run",
+                        lambda cmd, **kw: outs.pop(0))
+    ok, info = bench._tpu_probe(timeout_s=5, retries=2, backoff_s=0.01)
+    assert not ok
+    a0, a1 = info["attempts"]
+    assert a0["outcome"] == "cpu-fallback"
+    assert "PROBE_OK cpu" in a0["message"]
+    assert a1["outcome"] == "error"
+    assert a1["error_class"] == "CalledProcessError"
+    assert a1["message"] == "RuntimeError: tunnel dead"
+
+
+def test_probe_success_records_ok_attempt(monkeypatch):
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda cmd, **kw: _FakeCompleted(0, "PROBE_OK tpu 8\n"))
+    ok, info = bench._tpu_probe(timeout_s=5, retries=3, backoff_s=0.01)
+    assert ok
+    assert info["probe"] == "PROBE_OK tpu 8"
+    assert info["attempts"][-1]["outcome"] == "ok"
+
+
+def test_emit_tpu_unavailable_writes_manifest(monkeypatch, capsys,
+                                              _clean_obs):
+    # the CPU accuracy-gate subprocess is faked too: one JSON line out
+    monkeypatch.setattr(
+        subprocess, "run",
+        lambda cmd, **kw: _FakeCompleted(
+            0, json.dumps({"device": "cpu", "ok": True}) + "\n"))
+    manifest = obs.RunManifest.begin(kind="bench", devices=False)
+    info = {"attempts": [{"index": 0, "started_at": "t", "outcome": "timeout",
+                          "error_class": "TimeoutExpired"}]}
+    with pytest.raises(SystemExit):
+        bench._emit_tpu_unavailable(info, manifest)
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert result["reason"] == "tpu_unavailable"
+    assert result["manifest"] and os.path.isfile(result["manifest"])
+    doc = json.load(open(result["manifest"]))
+    assert obs.validate_manifest(doc) == []
+    assert doc["status"] == "tpu_unavailable"
+    assert doc["kind"] == "bench"
+    assert doc["probe_attempts"][0]["error_class"] == "TimeoutExpired"
+    assert doc["extra"]["cpu_accuracy_gate"] == {"device": "cpu",
+                                                 "ok": True}
+    # the unavailable path must never query devices in-process (a wedged
+    # tunnel hangs there) — environment is captured device-free
+    assert doc["environment"]["backend"] is None
+
+
+def test_obs_default_dir(monkeypatch, tmp_path):
+    obs.configure(None)
+    monkeypatch.delenv("RAFT_TPU_OBS_DIR", raising=False)
+    bench._obs_default()
+    assert obs.out_dir().endswith("obs_runs")
+    obs.configure(str(tmp_path))
+    bench._obs_default()
+    assert obs.out_dir() == str(tmp_path)

@@ -1,0 +1,104 @@
+"""Model.analyzeCases observability smoke test (acceptance criterion).
+
+One coarse-grid run of the full statics/dynamics/QTF/outputs pipeline
+must produce (a) a Chrome trace with correctly nested phase spans, (b) a
+metrics snapshot with per-case fixed-point iteration/residual series and
+a dynamics condition-number gauge, and (c) a schema-valid run manifest —
+written to the configured obs directory.
+
+Uses the vendored Vertical_cylinder design (no turbine — keeps the
+compile budget small) with internal second-order forces switched on so
+the calcQTF_slenderBody span is exercised too.  The OC3 spar runs the
+same instrumentation end-to-end in tests/test_model_oc3.py (slow tier).
+"""
+import json
+import os
+
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.io.designs import load_design
+from raft_tpu.model import Model
+
+
+@pytest.fixture(scope="module")
+def analyzed(tmp_path_factory):
+    out_dir = str(tmp_path_factory.mktemp("obs_out"))
+    obs.reset_tracing()
+    obs.REGISTRY.reset()
+    obs.configure(out_dir)
+    design = load_design("Vertical_cylinder")
+    design.setdefault("settings", {})
+    design["settings"]["min_freq"] = 0.05
+    design["settings"]["max_freq"] = 0.5
+    design["platform"]["potSecOrder"] = 1      # exercise the QTF phase
+    design["platform"]["min_freq2nd"] = 0.05
+    design["platform"]["max_freq2nd"] = 0.25
+    model = Model(design)
+    model.analyzeCases()
+    yield model, out_dir
+    obs.configure(None)
+    obs.reset_tracing()
+    obs.REGISTRY.reset()
+
+
+def test_phase_spans_recorded(analyzed):
+    model, _ = analyzed
+    agg = obs.aggregate()
+    for phase in ("analyzeCases", "solveStatics", "solveDynamics",
+                  "fowt_linearize", "calcQTF_slenderBody",
+                  "saveTurbineOutputs"):
+        assert phase in agg, f"missing span {phase!r}"
+        assert agg[phase][1] >= 1
+    # nesting: the linearization span is a child of solveDynamics
+    spans = {e["name"]: e for e in obs.spans()}
+    assert spans["fowt_linearize"]["parent"] == "solveDynamics"
+    assert spans["solveDynamics"]["parent"] == "analyzeCases"
+    assert spans["solveStatics"]["parent"] == "analyzeCases"
+
+
+def test_fixed_point_and_condition_metrics(analyzed):
+    snap = obs.snapshot()
+    hist = snap["raft_fixed_point_iterations"]
+    assert hist["kind"] == "histogram"
+    series = hist["series"]
+    assert series and all(s["count"] >= 1 for s in series)
+    # per-load-case labelling
+    assert any(s["labels"].get("case") == "0" for s in series)
+    res = snap["raft_fixed_point_residual"]
+    assert all(s["value"] >= 0.0 for s in res["series"])
+    cond = snap["raft_dynamics_condition_number"]
+    assert all(s["value"] >= 1.0 for s in cond["series"])
+    dyn_res = snap["raft_dynamics_solve_residual"]
+    assert all(s["value"] < 1e-4 for s in dyn_res["series"])
+    stat = snap["raft_statics_newton_iterations"]
+    assert stat["series"][0]["count"] >= 1
+    # the Prometheus view renders without error and carries the series
+    text = obs.to_prometheus()
+    assert "raft_fixed_point_iterations_bucket" in text
+    assert "raft_dynamics_condition_number" in text
+
+
+def test_manifest_and_trace_written(analyzed):
+    model, out_dir = analyzed
+    manifest = model.last_manifest
+    assert manifest is not None and manifest.status == "ok"
+    doc = manifest.to_dict()
+    assert obs.validate_manifest(doc) == []
+    assert doc["kind"] == "analyzeCases"
+    assert doc["config"]["nCases"] == 1
+    assert doc["environment"]["backend"] == "cpu"
+    phase_names = {p["name"] for p in doc["phases"]}
+    assert {"solveStatics", "solveDynamics",
+            "calcQTF_slenderBody"} <= phase_names
+    assert "raft_fixed_point_iterations" in doc["metrics"]
+
+    files = sorted(os.listdir(out_dir))
+    mani_files = [f for f in files if f.endswith(".manifest.json")]
+    trace_files = [f for f in files if f.endswith(".trace.json")]
+    assert len(mani_files) == 1 and len(trace_files) == 1
+    on_disk = json.load(open(os.path.join(out_dir, mani_files[0])))
+    assert obs.validate_manifest(on_disk) == []
+    trace = json.load(open(os.path.join(out_dir, trace_files[0])))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"analyzeCases", "solveStatics", "solveDynamics"} <= names

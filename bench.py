@@ -251,6 +251,7 @@ def _emit_tpu_unavailable(info, manifest=None):
     for att in info.get("attempts", []):
         manifest.add_probe_attempt(att)
     manifest.extra["cpu_accuracy_gate"] = gate
+    self_cmp = _self_compare(obs, manifest, "tpu_unavailable")
     paths = obs.finish_run(manifest, status="tpu_unavailable",
                            write_trace=False)
     result = {
@@ -263,10 +264,68 @@ def _emit_tpu_unavailable(info, manifest=None):
         "reason": "tpu_unavailable",
         "probe": info,
         "cpu_accuracy_gate": gate,
+        "self_compare": self_cmp,
         "manifest": paths["manifest"],
     }
     print(json.dumps(result))
     raise SystemExit(1)
+
+
+def _previous_manifest(obs, current_run_id, config=None):
+    """Newest previously-written COMPARABLE bench manifest in the obs
+    directory (the self-compare baseline), or None on the first run.
+
+    Comparable = status "ok" with the same bench config: a healthy run
+    after a ``tpu_unavailable`` round (or after resizing via
+    RAFT_BENCH_NV etc.) must not be reported as a regression against an
+    incomparable baseline."""
+    import glob
+
+    d = obs.out_dir()
+    if not d or not os.path.isdir(d):
+        return None
+    cands = [p for p in glob.glob(os.path.join(d, "bench_*.manifest.json"))
+             if current_run_id not in os.path.basename(p)]
+    cands.sort(key=os.path.getmtime, reverse=True)
+    for p in cands:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if doc.get("status") != "ok":
+            continue
+        if config is not None and doc.get("config") != config:
+            continue
+        return os.path.basename(p), doc
+    return None
+
+
+def _self_compare(obs, manifest, status):
+    """Regression-sentinel hook: compare THIS run's manifest against the
+    previous bench manifest in the obs dir and embed the verdict in
+    ``manifest.extra["self_compare"]`` (and the printed bench JSON).
+    Numeric facts at 1e-6, wall-time facts at the loose perf tolerance;
+    never raises — a broken baseline must not take down the bench."""
+    try:
+        prev = _previous_manifest(obs, manifest.run_id,
+                                  config=dict(manifest.config))
+        if prev is None:
+            verdict = {"baseline": None, "ok": None,
+                       "note": "no comparable previous bench manifest"}
+        else:
+            name, prev_doc = prev
+            manifest.finish(status)       # re-stamped by finish_run later
+            report = obs.compare_manifests(prev_doc, manifest.to_dict())
+            verdict = {"baseline": name, "ok": report["ok"],
+                       "n_compared": report["n_compared"],
+                       "n_regressions": len(report["regressions"]),
+                       "regressions": report["regressions"][:10]}
+    except Exception as e:                            # pragma: no cover
+        verdict = {"baseline": None, "ok": None,
+                   "note": f"self-compare failed: {type(e).__name__}: {e}"}
+    manifest.extra["self_compare"] = verdict
+    return verdict
 
 
 def _solver_setup(nv):
@@ -388,6 +447,7 @@ def main():
         manifest.extra["result"] = {
             "value": result["value"], "vs_baseline": result["vs_baseline"],
             "ok": result["ok"]}
+        result["self_compare"] = _self_compare(obs, manifest, status)
     finally:
         paths = obs.finish_run(manifest, status=status)
     result["manifest"] = paths["manifest"]

@@ -55,6 +55,14 @@ RAD2DEG = 180.0 / np.pi
 _LOG = get_logger("model")
 
 
+@jax.jit
+def _apply_zinv_j(Zinv, F_wave):
+    """Batched system RAO solve: apply the factored inverse impedance to
+    one heading's excitation, (nw,6N,6N) x (6N,nw) -> (6N,nw)."""
+    Xi_h = jnp.einsum("wij,wj->wi", Zinv, jnp.moveaxis(F_wave, -1, 0))
+    return jnp.moveaxis(Xi_h, 0, -1)
+
+
 class Model:
     """Single- or (later) multi-FOWT frequency-domain model.
 
@@ -126,6 +134,13 @@ class Model:
         self._iCase = None
         #: RunManifest of the most recent analyzeCases invocation
         self.last_manifest = None
+        #: result ledger (raft_tpu.ledger/v1) of the most recent
+        #: analyzeCases invocation — the regression sentinel's input
+        self.last_ledger = None
+        # per-case solver facts (Newton/drag iterations, residuals,
+        # condition numbers) accumulated for the ledger
+        self._case_records = {}
+        self._dyn_cost_recorded = False
         self.design = design
         self.results = {}
         # per-fowt case state (filled by solveStatics/solveDynamics)
@@ -393,6 +408,9 @@ class Model:
             "raft_statics_residual_norm",
             "|F| at the accepted statics equilibrium [N]",
             ).set(residual, case=case_lbl)
+        rec = self._case_records.setdefault(case_lbl, {})
+        rec["statics_iters"] = it + 1
+        rec["statics_residual"] = residual
 
         # mooring properties at the FINAL pose (one more free-point solve
         # so xf corresponds to X, not the previous Newton iterate)
@@ -530,6 +548,8 @@ class Model:
             "raft_dynamics_solve_residual",
             "relative residual |Z Xi - F|/|F| of the system RAO solve",
             ).set(rel, case=self._case_label(), heading=str(ih))
+        rec = self._case_records.setdefault(self._case_label(), {})
+        rec.setdefault("dyn_solve_residual", []).append(rel)
         return rel
 
     def _solve_dynamics_impl(self, case, tol, display, sp):
@@ -567,14 +587,23 @@ class Model:
                 "max condition number of the 6Nx6N impedance over "
                 "frequencies").set(float(cond.max()),
                                    case=self._case_label())
+            self._case_records.setdefault(self._case_label(), {})[
+                "cond_max"] = float(cond.max())
 
         nWaves = self._state[0]["seastate"]["nWaves"]
         Xi_sys = np.zeros((nWaves + 1, 6 * N, nw), dtype=complex)
 
         def system_solve(F_wave):
-            Xi_h = jnp.einsum("wij,wj->wi", Zinv,
-                              jnp.moveaxis(jnp.asarray(F_wave), -1, 0))
-            return np.asarray(jnp.moveaxis(Xi_h, 0, -1))
+            F = jnp.asarray(F_wave)
+            if not self._dyn_cost_recorded:
+                # static HLO cost analysis of the batched dynamics
+                # solve (a trace, not an XLA compile) — once per
+                # analyzeCases run, folded into the metrics registry
+                # and thence the run manifest
+                self._dyn_cost_recorded = True
+                obs.device.cost_analysis(_apply_zinv_j, Zinv, F,
+                                         kernel="dynamics_system_solve")
+            return np.asarray(_apply_zinv_j(Zinv, F))
 
         for ih in range(nWaves):
             F_wave = np.zeros((6 * N, nw), dtype=complex)
@@ -868,6 +897,10 @@ class Model:
         cur = obs.current_span()
         if cur is not None:
             cur.set(iterations=n_it, residual=residual, converged=conv)
+        rec = self._case_records.setdefault(self._case_label(), {})
+        rec[f"fowt{ifowt}"] = {"drag_iters": n_it,
+                               "drag_residual": residual,
+                               "drag_converged": conv}
 
         state["Fhydro_2nd"] = Fhydro_2nd
         state["Fhydro_2nd_mean"] = Fhydro_2nd_mean
@@ -1039,12 +1072,16 @@ class Model:
         ``self.last_manifest`` and written to ``obs.out_dir()`` (the
         ``RAFT_TPU_OBS_DIR`` env var) when configured."""
         obs.install_jax_hooks()
+        obs.record_build_info()
+        obs.device.jit_cache_delta(scope="analyzeCases")   # baseline
         nCases = len(self.design["cases"]["data"])
         manifest = obs.RunManifest.begin(kind="analyzeCases", config={
             "nCases": nCases, "nFOWT": self.nFOWT, "nw": self.nw,
             "nDOF": self.nDOF, "nIter": self.nIter,
             "depth": self.depth})
         self.last_manifest = manifest
+        self._case_records = {}
+        self._dyn_cost_recorded = False
         status = "failed"
         try:
             with temp_verbosity(display), \
@@ -1056,11 +1093,19 @@ class Model:
             # a later direct solveDynamics call must not write its QTF
             # snapshot under the last case's tag
             self._iCase = None
+            ledger = None
+            if status == "ok":
+                obs.device.collect(manifest, scope="analyzeCases")
+                ledger = obs.ledger_from_model(
+                    self, run_id=manifest.run_id)
+                self.last_ledger = ledger
             with temp_verbosity(display):
-                paths = obs.finish_run(manifest, status=status)
+                paths = obs.finish_run(manifest, status=status,
+                                       ledger=ledger)
                 if paths["manifest"]:
-                    _LOG.info("run manifest: %s  trace: %s",
-                              paths["manifest"], paths["trace"])
+                    _LOG.info("run manifest: %s  trace: %s  ledger: %s",
+                              paths["manifest"], paths["trace"],
+                              paths["ledger"])
         return self.results
 
     def _analyze_cases_impl(self, nCases, display):
@@ -1145,6 +1190,21 @@ class Model:
             results[f"{ch}_min"] = mean - 3 * std
             results[f"{ch}_PSD"] = np.asarray(get_psd(sig, dw, source_axis=0))
             results[f"{ch}_RA"] = np.asarray(sig)
+
+        # first-heading RAO magnitude/phase summaries per DOF — the
+        # compact response fingerprint the result ledger digests
+        # (rotational DOFs kept in rad/m, matching get_rao's output)
+        RAO0 = np.asarray(get_rao(Xi[0], state["seastate"]["zeta"][0]))
+        mag = np.abs(RAO0)
+        for idof, ch in enumerate(chans):
+            ipk = int(np.argmax(mag[idof]))
+            results[f"{ch}_RAO_mag_max"] = float(mag[idof, ipk])
+            results[f"{ch}_RAO_mag_mean"] = float(mag[idof].mean())
+            # phase of a symmetry-zero channel is fp noise — pin it
+            results[f"{ch}_RAO_phase_peak"] = (
+                float(np.angle(RAO0[idof, ipk]))
+                if mag[idof, ipk] > 1e-12 else 0.0)
+            results[f"{ch}_RAO_w_peak"] = float(self.w[ipk])
 
         # mooring tensions through the tension Jacobian (reference :1877-1898)
         moor = fowt.mooring

@@ -1,6 +1,6 @@
-"""raft_tpu.obs — observability: span tracing, metrics, run manifests.
+"""raft_tpu.obs — observability: tracing, metrics, manifests, ledgers.
 
-Three pillars (see docs/observability.md):
+Five pillars (see docs/observability.md):
 
 - :mod:`raft_tpu.obs.tracing` — nested wall-time spans with attributes,
   Chrome-trace/Perfetto JSON export, and the name -> (total, calls)
@@ -10,12 +10,22 @@ Three pillars (see docs/observability.md):
   numbers, JAX compile events) with JSON and Prometheus text exports.
 - :mod:`raft_tpu.obs.manifest` — ``RunManifest``: one structured JSON
   record per ``analyzeCases`` / ``sweep_cases`` / ``bench.py`` run.
+- :mod:`raft_tpu.obs.ledger` — content-addressed physics-result
+  digests (RAO summaries, eigenfrequencies, mean offsets, solver
+  iteration counts) diffable across runs: the regression sentinel's
+  ground truth, driven by the ``tools/obsctl.py`` CLI.
+- :mod:`raft_tpu.obs.device` — per-device memory stats, live-array
+  accounting, jit cache hit/miss deltas, static HLO cost analysis.
 
 File output is opt-in: call ``configure(out_dir=...)`` or set the
 ``RAFT_TPU_OBS_DIR`` environment variable, and every instrumented entry
 point writes ``<kind>_<run_id>.manifest.json`` plus
-``<kind>_<run_id>.trace.json`` there.  Without it, spans/metrics still
-record in-process (``Model.last_manifest``, ``timing_report()``,
+``<kind>_<run_id>.trace.json`` (and, for ledger-emitting entry points,
+``<kind>_<run_id>.ledger.json``) there.  ``configure(...,
+max_runs=N)`` (or ``RAFT_TPU_OBS_MAX_RUNS``) bounds the directory: after
+each write the oldest runs' artifact triples are pruned so at most N
+runs remain.  Without an output directory, spans/metrics still record
+in-process (``Model.last_manifest``, ``timing_report()``,
 ``obs.snapshot()``) and nothing touches the filesystem.
 
 This package never imports jax at module scope — bench.py must be able
@@ -31,21 +41,35 @@ from raft_tpu.obs.tracing import (                              # noqa: F401
 )
 from raft_tpu.obs.metrics import (                              # noqa: F401
     REGISTRY, counter, gauge, histogram, snapshot, to_prometheus,
-    install_jax_hooks, sample_jit_cache, ITER_BUCKETS,
+    install_jax_hooks, sample_jit_cache, record_build_info, ITER_BUCKETS,
 )
 from raft_tpu.obs.manifest import (                             # noqa: F401
     SCHEMA, RunManifest, ProbeAttempt, capture_environment,
-    validate_manifest, git_sha,
+    validate_manifest, git_sha, collapse_probe_attempts,
 )
+from raft_tpu.obs.ledger import (                               # noqa: F401
+    LEDGER_SCHEMA, ledger_from_model, ledger_from_sweep, write_ledger,
+    load_ledger, validate_ledger, diff_ledgers, format_diff,
+    compare_manifests,
+)
+from raft_tpu.obs import device  # noqa: F401
 
 _OUT_DIR: str | None = None
+_MAX_RUNS: int | None = None
 
 
-def configure(out_dir: str | None):
+def configure(out_dir: str | None, max_runs: int | None = None):
     """Set (or clear, with None) the observability output directory —
-    overrides the ``RAFT_TPU_OBS_DIR`` environment variable."""
-    global _OUT_DIR
+    overrides the ``RAFT_TPU_OBS_DIR`` environment variable.
+
+    ``max_runs`` bounds artifact growth: after every ``finish_run``
+    write, only the newest ``max_runs`` runs' ``*.manifest.json`` /
+    ``*.trace.json`` / ``*.ledger.json`` triples are kept (falls back
+    to the ``RAFT_TPU_OBS_MAX_RUNS`` env var; None/0 = unbounded).
+    """
+    global _OUT_DIR, _MAX_RUNS
     _OUT_DIR = out_dir
+    _MAX_RUNS = int(max_runs) if max_runs else None
 
 
 def out_dir() -> str | None:
@@ -53,13 +77,60 @@ def out_dir() -> str | None:
     return _OUT_DIR or os.environ.get("RAFT_TPU_OBS_DIR") or None
 
 
+def max_runs() -> int | None:
+    """Active retention bound (runs kept on disk), or None (unbounded)."""
+    if _MAX_RUNS:
+        return _MAX_RUNS
+    try:
+        n = int(os.environ.get("RAFT_TPU_OBS_MAX_RUNS", "0"))
+    except ValueError:
+        return None
+    return n or None
+
+
+#: artifact suffixes that make up one run's on-disk record
+_RUN_SUFFIXES = (".manifest.json", ".trace.json", ".ledger.json")
+
+
+def prune_runs(directory: str, keep: int) -> list[str]:
+    """Delete the oldest runs' artifact triples from ``directory`` so at
+    most ``keep`` runs (identified by their ``*.manifest.json``) remain.
+    Returns the removed paths."""
+    try:
+        manifests = [f for f in os.listdir(directory)
+                     if f.endswith(".manifest.json")]
+    except OSError:
+        return []
+    if keep <= 0 or len(manifests) <= keep:
+        return []
+    def _mtime(f):
+        try:
+            return os.path.getmtime(os.path.join(directory, f))
+        except OSError:
+            return 0.0
+    manifests.sort(key=_mtime)
+    removed = []
+    for f in manifests[:len(manifests) - keep]:
+        stem = f[:-len(".manifest.json")]
+        for suffix in _RUN_SUFFIXES:
+            path = os.path.join(directory, stem + suffix)
+            try:
+                os.remove(path)
+                removed.append(path)
+            except OSError:
+                pass
+    return removed
+
+
 def finish_run(manifest: RunManifest, status: str = "ok",
-               write_trace: bool = True) -> dict:
+               write_trace: bool = True, ledger: dict = None) -> dict:
     """Finish ``manifest`` and, when an output directory is configured,
-    write the manifest JSON (and the Chrome trace).  Returns
-    ``{"manifest": path|None, "trace": path|None}``."""
+    write the manifest JSON (plus the Chrome trace and, when given, the
+    result ledger), then apply the ``max_runs`` retention bound.
+    Returns ``{"manifest": path|None, "trace": path|None,
+    "ledger": path|None}``."""
     manifest.finish(status)
-    paths = {"manifest": None, "trace": None}
+    paths = {"manifest": None, "trace": None, "ledger": None}
     d = out_dir()
     if d:
         stem = f"{manifest.kind}_{manifest.run_id}"
@@ -68,4 +139,23 @@ def finish_run(manifest: RunManifest, status: str = "ok",
         if write_trace:
             paths["trace"] = export_chrome_trace(
                 os.path.join(d, stem + ".trace.json"))
+        if ledger is not None:
+            paths["ledger"] = write_ledger(
+                ledger, os.path.join(d, stem + ".ledger.json"))
+        keep = max_runs()
+        if keep:
+            prune_runs(d, keep)
     return paths
+
+
+def reset_all():
+    """Reset every in-process observability pillar in one call: span
+    buffer + aggregate, metrics registry, jit-cache delta baselines,
+    AND the configured output directory/retention.  Built for test
+    isolation (the autouse conftest fixture); a long-running service
+    that calls it between logical runs must call ``configure(...)``
+    again afterwards or artifact output silently stops."""
+    reset_tracing()
+    REGISTRY.reset()
+    device.reset_jit_cache_baseline()
+    configure(None)

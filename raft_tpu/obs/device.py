@@ -1,0 +1,173 @@
+"""Device & compiler telemetry: what the hardware and XLA actually did.
+
+Four probes, all cheap and all optional (every JAX API touched here is
+guarded — a missing API degrades to an absent field, never an error):
+
+- :func:`device_memory` — per-device ``memory_stats()`` (bytes in use,
+  peak bytes, limit; TPU/GPU backends only — CPU devices report none).
+- :func:`live_arrays_summary` — ``jax.live_arrays()`` count and total
+  bytes: the host-visible picture of what is pinned on devices.
+- :func:`jit_cache_delta` — jit cache hit/miss counters as a DELTA
+  since the previous sample, so a recompile storm inside one run is a
+  nonzero ``misses`` where steady state is 0 (the absolute counters in
+  ``metrics.sample_jit_cache`` are process-cumulative).
+- :func:`cost_analysis` — static HLO cost analysis of a jitted
+  function (FLOPs / bytes-accessed estimates via
+  ``Lowered.cost_analysis()``; no XLA compile is triggered).
+
+:func:`collect` runs the first three, folds everything into Prometheus
+gauges (``raft_device_memory_bytes``, ``raft_live_arrays``,
+``raft_jit_cache_delta``) and returns one JSON-able dict that the
+instrumented entry points attach to ``RunManifest.extra
+["device_telemetry"]``.
+
+This module never imports jax at module scope (same contract as the
+rest of ``raft_tpu.obs``).
+"""
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+_LAST_CACHE: dict = {}     # previous jit cache sample, for deltas
+
+
+def _gauge(name, help):
+    from raft_tpu.obs import metrics as _metrics
+    return _metrics.gauge(name, help)
+
+
+def device_memory() -> list[dict]:
+    """Per-local-device memory stats: ``[{device, platform, stats}]``
+    where ``stats`` is the backend's ``memory_stats()`` dict or None
+    (CPU).  Byte-valued stats are exported as
+    ``raft_device_memory_bytes{device,stat}`` gauges."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out = []
+    g = _gauge("raft_device_memory_bytes",
+               "per-device allocator stats (bytes_in_use, "
+               "peak_bytes_in_use, bytes_limit) from memory_stats()")
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        rec = {"device": str(d), "platform": getattr(d, "platform", None),
+               "stats": dict(stats) if stats else None}
+        if stats:
+            for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                      "largest_alloc_size"):
+                if k in stats:
+                    g.set(float(stats[k]), device=str(d), stat=k)
+        out.append(rec)
+    return out
+
+
+def live_arrays_summary() -> dict | None:
+    """{count, total_bytes} over ``jax.live_arrays()`` — what Python
+    still holds on devices; a leak across cases shows up as growth."""
+    try:
+        import jax
+        arrs = jax.live_arrays()
+    except Exception:
+        return None
+    total = 0
+    for a in arrs:
+        try:
+            total += int(a.nbytes)
+        except Exception:
+            pass
+    summary = {"count": len(arrs), "total_bytes": total}
+    _gauge("raft_live_arrays",
+           "count of live jax arrays on devices").set(len(arrs))
+    _gauge("raft_live_arrays_bytes",
+           "total bytes of live jax arrays on devices").set(total)
+    return summary
+
+
+def jit_cache_delta(scope: str = "run") -> dict | None:
+    """Jit cache hit/miss counts since the previous sample for
+    ``scope`` (None when this JAX build exposes no cache-info hook).
+    A steady-state run has ``misses == 0``; nonzero misses between two
+    samples is a retrace/recompile storm made visible."""
+    from raft_tpu.obs import metrics as _metrics
+
+    stats = _metrics.sample_jit_cache()
+    if stats is None:
+        return None
+    with _LOCK:
+        prev = _LAST_CACHE.get(scope)
+        _LAST_CACHE[scope] = dict(stats)
+    if prev is None:
+        delta = {"hits": None, "misses": None, "first_sample": True,
+                 **{f"total_{k}": v for k, v in stats.items()}}
+        return delta
+    delta = {"hits": stats["hits"] - prev["hits"],
+             "misses": stats["misses"] - prev["misses"],
+             **{f"total_{k}": v for k, v in stats.items()}}
+    g = _gauge("raft_jit_cache_delta",
+               "jit cache hit/miss delta since the previous sample "
+               "(misses > 0 at steady state = recompile storm)")
+    g.set(delta["hits"], kind="hits", scope=scope)
+    g.set(delta["misses"], kind="misses", scope=scope)
+    return delta
+
+
+def reset_jit_cache_baseline():
+    """Forget previous jit-cache samples (test isolation)."""
+    with _LOCK:
+        _LAST_CACHE.clear()
+
+
+def cost_analysis(target, *args, kernel: str = "kernel",
+                  **kwargs) -> dict | None:
+    """Static HLO cost analysis: {flops, bytes_accessed, ...} estimates
+    via ``Lowered.cost_analysis()`` — a trace, not an XLA compile.
+
+    ``target`` is either a jitted function (lowered here at ``*args``)
+    or an already-lowered ``jax.stages.Lowered`` (args ignored).
+    Exported as ``raft_hlo_flops{kernel}`` /
+    ``raft_hlo_bytes_accessed{kernel}`` gauges.  None when the API (or
+    the lowering) is unavailable."""
+    try:
+        lowered = (target if hasattr(target, "cost_analysis")
+                   else target.lower(*args, **kwargs))
+        costs = lowered.cost_analysis()
+        if isinstance(costs, (list, tuple)):   # per-partition list
+            costs = costs[0] if costs else None
+    except Exception:
+        return None
+    if not isinstance(costs, dict):
+        return None
+    out = {"kernel": kernel}
+    for k in ("flops", "bytes accessed", "transcendentals",
+              "optimal_seconds"):
+        if k in costs:
+            out[k.replace(" ", "_")] = float(costs[k])
+    if "flops" in out:
+        _gauge("raft_hlo_flops",
+               "static HLO cost analysis: estimated FLOPs per call"
+               ).set(out["flops"], kernel=kernel)
+    if "bytes_accessed" in out:
+        _gauge("raft_hlo_bytes_accessed",
+               "static HLO cost analysis: estimated bytes accessed "
+               "per call").set(out["bytes_accessed"], kernel=kernel)
+    return out
+
+
+def collect(manifest=None, scope: str = "run") -> dict:
+    """One-call telemetry sample: device memory + live arrays + jit
+    cache delta, folded into the metrics registry and (when given)
+    ``manifest.extra["device_telemetry"]``."""
+    telemetry = {
+        "devices": device_memory(),
+        "live_arrays": live_arrays_summary(),
+        "jit_cache": jit_cache_delta(scope=scope),
+    }
+    if manifest is not None:
+        manifest.extra["device_telemetry"] = telemetry
+    return telemetry

@@ -1,0 +1,520 @@
+"""Result ledger: content-addressed physics digests for cross-run diffing.
+
+A ledger (schema ``raft_tpu.ledger/v1``) is the numeric fingerprint of
+one run's physics outputs — small enough to compute on every run and
+stable enough to diff across runs: per-case RAO magnitude/phase
+summaries per DOF, response means/stds, eigenfrequencies, mean offsets,
+drag fixed-point iteration counts, dynamics condition numbers.  Each
+entry carries a SHA-256 digest of its canonicalized metrics, and the
+ledger carries a digest over the entry digests, so "did anything move?"
+is a string compare and "what moved, by how much?" is :func:`diff`.
+
+Writers: ``Model.analyzeCases`` (kept on ``model.last_ledger``, written
+as ``<kind>_<run_id>.ledger.json`` next to the manifest when an obs dir
+is configured) and ``parallel.sweep.sweep_cases``.  Readers: the
+``tools/obsctl.py`` CLI (``diff`` / ``check`` / ``trend``), the bench
+self-compare, and the ``tests/test_regression_sentinel.py`` canary
+against the golden ledgers under ``tests/golden/``.
+
+Ledger document::
+
+    schema, run_id, kind, created_at, environment, config,
+    entries: [{key, metrics: {name: scalar | [scalars]}, digest}],
+    digest
+
+:func:`diff` compares two ledgers entry-by-entry, metric-by-metric with
+a relative tolerance (per-metric overrides via fnmatch patterns) and
+returns a structured report; :func:`compare_manifests` applies the same
+machinery to two run manifests (numeric vs wall-time/perf classes).
+"""
+from __future__ import annotations
+
+import datetime
+import fnmatch
+import hashlib
+import json
+import math
+import os
+import uuid
+
+SCHEMA = "raft_tpu.ledger/v1"
+
+REQUIRED_KEYS = ("schema", "run_id", "kind", "created_at", "environment",
+                 "config", "entries", "digest")
+
+#: manifest metric families that legitimately vary between identical
+#: runs (compile-event counts depend on the persistent compilation
+#: cache; jit cache stats on process history) — skipped by
+#: compare_manifests unless the caller passes ignore=()
+DEFAULT_MANIFEST_IGNORE = ("raft_jax_*", "raft_jit_cache_*",
+                           "raft_device_*", "raft_live_arrays*",
+                           "raft_tpu_build_info")
+
+#: manifest scalar patterns that measure wall time / throughput — they
+#: jitter between identical runs, so they get the looser perf tolerance
+PERF_PATTERNS = ("duration_s", "phase:*:total_s", "*_seconds_total",
+                 "extra:result:value", "extra:result:vs_baseline")
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+def _scalar(v):
+    """Canonical JSON scalar for a metric value (floats kept full
+    precision; numpy scalars unwrapped)."""
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, str)):
+        return v
+    f = float(v)
+    if math.isnan(f):
+        return "nan"
+    if math.isinf(f):
+        return "inf" if f > 0 else "-inf"
+    return f
+
+
+def canonical_metrics(metrics: dict) -> dict:
+    """Metrics dict with every value a JSON scalar or flat list of them
+    (arrays flattened), keys sorted — the digest input."""
+    out = {}
+    for k in sorted(metrics):
+        v = metrics[k]
+        if hasattr(v, "tolist"):
+            v = v.tolist()
+        if isinstance(v, (list, tuple)):
+            flat = []
+            for x in v:
+                flat.extend(x if isinstance(x, (list, tuple)) else [x])
+            out[str(k)] = [_scalar(x) for x in flat]
+        else:
+            out[str(k)] = _scalar(v)
+    return out
+
+
+def digest_metrics(metrics: dict) -> str:
+    """``sha256:<hex>`` of the canonical JSON of ``metrics`` — full
+    float precision (repr round-trip), so digest equality means the
+    numbers are bitwise-identical."""
+    payload = json.dumps(canonical_metrics(metrics), sort_keys=True,
+                         separators=(",", ":"))
+    return "sha256:" + hashlib.sha256(payload.encode()).hexdigest()
+
+
+def new_ledger(kind: str, run_id: str = None, config: dict = None,
+               environment: dict = None) -> dict:
+    return {
+        "schema": SCHEMA,
+        "run_id": run_id or uuid.uuid4().hex[:12],
+        "kind": kind,
+        "created_at": _utcnow(),
+        "environment": dict(environment or {}),
+        "config": dict(config or {}),
+        "entries": [],
+        "digest": None,
+    }
+
+
+def add_entry(ledger: dict, key: str, metrics: dict) -> dict:
+    """Append one content-addressed entry; returns the entry."""
+    entry = {"key": str(key), "metrics": canonical_metrics(metrics),
+             "digest": digest_metrics(metrics)}
+    ledger["entries"].append(entry)
+    return entry
+
+
+def finalize(ledger: dict) -> dict:
+    """Stamp the ledger-level digest (over the sorted entry digests)."""
+    body = json.dumps(sorted((e["key"], e["digest"])
+                             for e in ledger["entries"]),
+                      separators=(",", ":"))
+    ledger["digest"] = "sha256:" + hashlib.sha256(body.encode()).hexdigest()
+    return ledger
+
+
+def write_ledger(ledger: dict, path: str) -> str:
+    if ledger.get("digest") is None:
+        finalize(ledger)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ledger, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_ledger(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def validate_ledger(doc: dict) -> list[str]:
+    """Structural check against the v1 schema; [] == valid."""
+    problems = []
+    if not isinstance(doc, dict):
+        return ["ledger is not an object"]
+    if doc.get("schema") != SCHEMA:
+        problems.append(f"schema is {doc.get('schema')!r}, expected {SCHEMA}")
+    for k in REQUIRED_KEYS:
+        if k not in doc:
+            problems.append(f"missing key {k!r}")
+    if not isinstance(doc.get("entries"), list):
+        problems.append("entries is not a list")
+        return problems
+    seen = set()
+    for i, e in enumerate(doc["entries"]):
+        if not isinstance(e, dict) or not {"key", "metrics", "digest"} <= set(e):
+            problems.append(f"entries[{i}] missing key/metrics/digest")
+            continue
+        if e["key"] in seen:
+            problems.append(f"duplicate entry key {e['key']!r}")
+        seen.add(e["key"])
+        if digest_metrics(e["metrics"]) != e["digest"]:
+            problems.append(f"entries[{i}] ({e['key']!r}) digest mismatch")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# builders for the instrumented entry points
+# ---------------------------------------------------------------------------
+
+_CHANS = ("surge", "sway", "heave", "roll", "pitch", "yaw")
+
+
+def ledger_from_model(model, run_id: str = None) -> dict:
+    """Ledger of a completed ``Model.analyzeCases`` run.
+
+    One entry per (case, fowt) with response means/stds and RAO
+    magnitude/phase summaries per DOF, one system entry per case (mean
+    offsets, statics Newton iterations, dynamics condition number and
+    solve residuals, drag fixed-point counts), plus an ``eigen`` entry
+    when ``solveEigen`` has run.
+    """
+    from raft_tpu.obs import manifest as _manifest
+
+    led = new_ledger(
+        kind="analyzeCases", run_id=run_id,
+        config={"nCases": len(model.results.get("case_metrics", {})),
+                "nFOWT": model.nFOWT, "nw": model.nw, "nDOF": model.nDOF},
+        environment=_manifest.capture_environment(devices=False))
+    records = getattr(model, "_case_records", {})
+    for iCase in sorted(model.results.get("case_metrics", {})):
+        per_case = model.results["case_metrics"][iCase]
+        rec = records.get(str(iCase), {})
+        for ifowt in sorted(k for k in per_case if isinstance(k, int)):
+            m = per_case[ifowt]
+            metrics = {}
+            for ch in _CHANS:
+                metrics[f"mean_{ch}"] = m[f"{ch}_avg"]
+                metrics[f"std_{ch}"] = m[f"{ch}_std"]
+                if f"{ch}_RAO_mag_max" in m:
+                    metrics[f"rao_mag_max_{ch}"] = m[f"{ch}_RAO_mag_max"]
+                    metrics[f"rao_mag_mean_{ch}"] = m[f"{ch}_RAO_mag_mean"]
+                    metrics[f"rao_phase_peak_{ch}"] = m[f"{ch}_RAO_phase_peak"]
+            if "Tmoor_avg" in m:
+                metrics["tmoor_avg"] = m["Tmoor_avg"]
+                metrics["tmoor_std"] = m["Tmoor_std"]
+            frec = rec.get(f"fowt{ifowt}", {})
+            for k in ("drag_iters", "drag_residual", "drag_converged"):
+                if k in frec:
+                    metrics[k] = frec[k]
+            add_entry(led, f"case{iCase}/fowt{ifowt}", metrics)
+        sysm = {}
+        offsets = model.results.get("mean_offsets", [])
+        if iCase < len(offsets):
+            sysm["mean_offset"] = offsets[iCase]
+        for k in ("statics_iters", "statics_residual", "cond_max",
+                  "dyn_solve_residual"):
+            if k in rec:
+                sysm[k] = rec[k]
+        if sysm:
+            add_entry(led, f"case{iCase}/system", sysm)
+    if "eigen" in model.results:
+        add_entry(led, "eigen",
+                  {"fn_hz": model.results["eigen"]["frequencies"]})
+    return finalize(led)
+
+
+def ledger_from_sweep(out: dict, config: dict = None,
+                      run_id: str = None) -> dict:
+    """Ledger of one ``sweep_cases`` output batch: per-case response
+    stds + fixed-point iteration counts, and a batch summary entry."""
+    import numpy as np
+
+    from raft_tpu.obs import manifest as _manifest
+
+    led = new_ledger(kind="sweep_cases", run_id=run_id,
+                     config=dict(config or {}),
+                     environment=_manifest.capture_environment(devices=False))
+    std = np.asarray(out["std"])
+    iters = np.asarray(out["iters"])
+    conv = np.asarray(out["converged"])
+    for i in range(std.shape[0]):
+        add_entry(led, f"case{i}", {
+            "std": std[i], "iters": int(iters[i]),
+            "converged": bool(conv[i])})
+    add_entry(led, "summary", {
+        "ncases": int(std.shape[0]),
+        "n_converged": int(conv.sum()),
+        "iters_max": int(iters.max(initial=0)),
+        "std_norm": float(np.linalg.norm(std))})
+    return finalize(led)
+
+
+# ---------------------------------------------------------------------------
+# diffing
+# ---------------------------------------------------------------------------
+
+def _tol_for(metric: str, tol_rel: float, per_metric: dict) -> float:
+    for pat, t in (per_metric or {}).items():
+        if fnmatch.fnmatch(metric, pat):
+            return float(t)
+    return tol_rel
+
+
+def _rel(a, b) -> float:
+    if a == b:
+        return 0.0
+    try:
+        fa, fb = float(a), float(b)
+    except (TypeError, ValueError):
+        return math.inf           # non-numeric mismatch
+    if math.isnan(fa) and math.isnan(fb):
+        return 0.0
+    denom = max(abs(fa), abs(fb))
+    if denom == 0.0:
+        return 0.0
+    if not (math.isfinite(fa) and math.isfinite(fb)):
+        return math.inf
+    return abs(fa - fb) / denom
+
+
+def _compare_values(va, vb):
+    """Max elementwise relative deviation between two metric values
+    (scalar or list); inf on shape/type mismatch."""
+    la = va if isinstance(va, list) else [va]
+    lb = vb if isinstance(vb, list) else [vb]
+    if len(la) != len(lb):
+        return math.inf, -1
+    worst, worst_i = 0.0, -1
+    for i, (a, b) in enumerate(zip(la, lb)):
+        r = _rel(a, b)
+        if r > worst:
+            worst, worst_i = r, i
+    return worst, worst_i
+
+
+def diff(a: dict, b: dict, tol_rel: float = 1e-6,
+         per_metric: dict = None, ignore: tuple = ()) -> dict:
+    """Compare ledger ``b`` (current) against ``a`` (baseline).
+
+    Returns a report dict: ``regressions`` lists every metric whose max
+    elementwise relative deviation exceeds its tolerance (``tol_rel``,
+    overridable per metric-name fnmatch pattern via ``per_metric``);
+    ``added``/``removed`` list entry/metric keys present on one side
+    only (also regressions — a silently vanished output is a drift).
+    ``ok`` is True iff nothing regressed.
+    """
+    ea = {e["key"]: e for e in a.get("entries", [])}
+    eb = {e["key"]: e for e in b.get("entries", [])}
+    report = {
+        "a": a.get("run_id"), "b": b.get("run_id"),
+        "kind": (a.get("kind"), b.get("kind")),
+        "tol_rel": tol_rel,
+        "identical": (a.get("digest") is not None
+                      and a.get("digest") == b.get("digest")),
+        "added": sorted(set(eb) - set(ea)),
+        "removed": sorted(set(ea) - set(eb)),
+        "n_compared": 0, "n_entries": len(set(ea) & set(eb)),
+        "regressions": [],
+    }
+    for key in sorted(set(ea) & set(eb)):
+        ma, mb = ea[key]["metrics"], eb[key]["metrics"]
+        if ea[key]["digest"] == eb[key]["digest"]:
+            report["n_compared"] += len(ma)
+            continue
+        for name in sorted(set(ma) | set(mb)):
+            full = f"{key}:{name}"
+            if any(fnmatch.fnmatch(full, p) or fnmatch.fnmatch(name, p)
+                   for p in ignore):
+                continue
+            if name not in ma or name not in mb:
+                report["regressions"].append({
+                    "entry": key, "metric": name,
+                    "a": ma.get(name), "b": mb.get(name),
+                    "rel": math.inf,
+                    "why": "missing in " + ("baseline" if name not in ma
+                                            else "current")})
+                continue
+            report["n_compared"] += 1
+            rel, idx = _compare_values(ma[name], mb[name])
+            tol = _tol_for(name, tol_rel, per_metric)
+            if rel > tol:
+                report["regressions"].append({
+                    "entry": key, "metric": name, "index": idx,
+                    "a": ma[name], "b": mb[name], "rel": rel, "tol": tol})
+    report["ok"] = (not report["regressions"] and not report["added"]
+                    and not report["removed"])
+    return report
+
+
+def _fmt_val(v):
+    if isinstance(v, list):
+        head = ", ".join(f"{x:.6g}" if isinstance(x, float) else str(x)
+                         for x in v[:4])
+        return f"[{head}{', ...' if len(v) > 4 else ''}]"
+    if isinstance(v, float):
+        return f"{v:.9g}"
+    return str(v)
+
+
+def format_diff(report: dict, max_rows: int = 40) -> str:
+    """Human-readable rendering of a :func:`diff` report."""
+    lines = [f"ledger diff: {report['a']} -> {report['b']} "
+             f"(tol_rel={report['tol_rel']:g})"]
+    if report.get("identical"):
+        lines.append("  digests identical — nothing moved")
+    for key in report["removed"]:
+        lines.append(f"  REMOVED entry {key}")
+    for key in report["added"]:
+        lines.append(f"  ADDED   entry {key}")
+    regs = report["regressions"]
+    for r in regs[:max_rows]:
+        why = r.get("why")
+        if why:
+            lines.append(f"  REGRESSION {r['entry']}:{r['metric']} — {why}")
+        else:
+            lines.append(
+                f"  REGRESSION {r['entry']}:{r['metric']} "
+                f"rel={r['rel']:.3g} (tol {r['tol']:g}): "
+                f"{_fmt_val(r['a'])} -> {_fmt_val(r['b'])}")
+    if len(regs) > max_rows:
+        lines.append(f"  ... and {len(regs) - max_rows} more")
+    lines.append(
+        f"  {'OK' if report['ok'] else 'REGRESSED'}: "
+        f"{len(regs)} regression(s) over {report['n_compared']} compared "
+        f"metric(s) in {report['n_entries']} shared entrie(s)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# manifest comparison (same engine, perf-aware)
+# ---------------------------------------------------------------------------
+
+def _manifest_scalars(doc: dict) -> dict:
+    """Flatten a run manifest to comparable scalars.
+
+    Keys: ``status``, ``duration_s``, ``phase:<name>:total_s`` /
+    ``:calls``, ``metric:<name>{labels}`` for gauge/counter series and
+    histogram count/sum, ``extra:result:*`` numeric leaves.
+    """
+    out = {"status": doc.get("status")}
+    if isinstance(doc.get("duration_s"), (int, float)):
+        out["duration_s"] = float(doc["duration_s"])
+    for p in doc.get("phases") or []:
+        out[f"phase:{p['name']}:total_s"] = float(p["total_s"])
+        out[f"phase:{p['name']}:calls"] = int(p["calls"])
+    for name, m in (doc.get("metrics") or {}).items():
+        for s in m.get("series", []):
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(s.get("labels", {}).items()))
+            base = f"metric:{name}{{{lbl}}}"
+            if m.get("kind") == "histogram":
+                out[base + ":count"] = s.get("count")
+                out[base + ":sum"] = s.get("sum")
+            else:
+                out[base] = s.get("value")
+    res = (doc.get("extra") or {}).get("result") or {}
+    for k, v in res.items():
+        if isinstance(v, bool):
+            out[f"extra:result:{k}"] = int(v)
+        elif isinstance(v, (int, float)):
+            out[f"extra:result:{k}"] = v
+    return out
+
+
+def _is_perf(key: str) -> bool:
+    return any(fnmatch.fnmatch(key, p) or p in key for p in PERF_PATTERNS)
+
+
+def compare_manifests(a: dict, b: dict, tol_rel: float = 1e-6,
+                      tol_perf: float = 0.5, per_metric: dict = None,
+                      ignore: tuple = DEFAULT_MANIFEST_IGNORE) -> dict:
+    """Diff two run manifests: numeric facts at ``tol_rel``, wall-time /
+    throughput facts at the looser ``tol_perf`` (fractional change —
+    0.5 flags a >50% slowdown/speedup).  ``per_metric`` maps fnmatch
+    patterns over the flattened keys (``duration_s``,
+    ``phase:solve:total_s``, ``metric:raft_...{...}``) to tolerance
+    overrides.  Metric families that legitimately vary between
+    identical runs are ignored by default.  Returns the same report
+    shape as :func:`diff`."""
+    sa, sb = _manifest_scalars(a), _manifest_scalars(b)
+    report = {
+        "a": a.get("run_id"), "b": b.get("run_id"),
+        "kind": (a.get("kind"), b.get("kind")),
+        "tol_rel": tol_rel, "tol_perf": tol_perf,
+        "identical": False,
+        "added": [], "removed": [],
+        "n_compared": 0, "n_entries": 1,
+        "regressions": [],
+    }
+    keys = set(sa) | set(sb)
+    worst_rel = 0.0
+    for key in sorted(keys):
+        name = key.split("{")[0].removeprefix("metric:")
+        if any(fnmatch.fnmatch(name, p) or fnmatch.fnmatch(key, p)
+               for p in ignore):
+            continue
+        if key not in sa or key not in sb:
+            (report["removed"] if key not in sb
+             else report["added"]).append(key)
+            continue
+        report["n_compared"] += 1
+        va, vb = sa[key], sb[key]
+        if key == "status":
+            if va != vb:
+                report["regressions"].append({
+                    "entry": "manifest", "metric": key, "a": va, "b": vb,
+                    "rel": math.inf, "tol": 0.0, "why": "status changed"})
+            continue
+        rel, idx = _compare_values(va, vb)
+        worst_rel = max(worst_rel, rel)
+        tol = _tol_for(key, tol_perf if _is_perf(key) else tol_rel,
+                       per_metric)
+        if rel > tol:
+            report["regressions"].append({
+                "entry": "manifest", "metric": key, "index": idx,
+                "a": va, "b": vb, "rel": rel, "tol": tol,
+                "class": "perf" if _is_perf(key) else "numeric"})
+    # a vanished metric/phase is a drift (same stance as diff()); keys
+    # only ADDED by the newer run are fine — new instrumentation must
+    # not flag its own introduction
+    report["ok"] = not report["regressions"] and not report["removed"]
+    report["identical"] = (report["ok"] and not report["added"]
+                           and worst_rel == 0.0)
+    return report
+
+
+#: aliases exported through raft_tpu.obs (where ``SCHEMA``/``diff``
+#: would collide with the manifest schema / builtins)
+LEDGER_SCHEMA = SCHEMA
+diff_ledgers = diff
+
+
+def load_any(path: str) -> tuple[str, dict]:
+    """Load ``path`` and classify it: ('ledger'|'manifest', doc)."""
+    with open(path) as f:
+        doc = json.load(f)
+    schema = doc.get("schema", "")
+    if schema == SCHEMA:
+        return "ledger", doc
+    if schema.startswith("raft_tpu.run_manifest/"):
+        return "manifest", doc
+    raise ValueError(f"{path}: unrecognized schema {schema!r} "
+                     "(expected a raft_tpu ledger or run manifest)")

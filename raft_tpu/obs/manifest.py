@@ -43,18 +43,42 @@ def _utcnow() -> str:
     return datetime.datetime.now(datetime.timezone.utc).isoformat()
 
 
-def git_sha() -> str | None:
-    """HEAD SHA of the checkout this package runs from, or None."""
+#: process-lifetime cache of the git probes — every run emits them
+#: (environment capture, build-info gauge, ledger), and spawning a git
+#: subprocess (plus a full working-tree scan for the dirty flag) per
+#: sweep batch is pure overhead for facts that don't change mid-process
+_GIT_CACHE: dict = {}
+
+
+def _git(key: str, argv: list[str]):
+    if key in _GIT_CACHE:
+        return _GIT_CACHE[key]
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    out = None
     try:
-        r = subprocess.run(["git", "-C", root, "rev-parse", "HEAD"],
+        r = subprocess.run(["git", "-C", root] + argv,
                            capture_output=True, text=True, timeout=10)
         if r.returncode == 0:
-            return r.stdout.strip()
+            out = r.stdout
     except Exception:
         pass
-    return None
+    _GIT_CACHE[key] = out
+    return out
+
+
+def git_sha() -> str | None:
+    """HEAD SHA of the checkout this package runs from, or None.
+    Cached for the process lifetime."""
+    out = _git("sha", ["rev-parse", "HEAD"])
+    return out.strip() if out is not None else None
+
+
+def git_dirty() -> bool | None:
+    """True when the checkout has uncommitted changes, None when git is
+    unavailable.  Cached for the process lifetime."""
+    out = _git("dirty", ["status", "--porcelain"])
+    return bool(out.strip()) if out is not None else None
 
 
 def capture_environment(devices: bool = True) -> dict:
@@ -89,7 +113,13 @@ def capture_environment(devices: bool = True) -> dict:
 
 @dataclasses.dataclass
 class ProbeAttempt:
-    """One structured TPU-probe attempt record (bench.py)."""
+    """One structured TPU-probe attempt record (bench.py).
+
+    ``attempts`` counts how many identical consecutive tries this
+    record stands for — :func:`collapse_probe_attempts` merges runs of
+    same-outcome records (the r01–r05 benches logged the same hang
+    string 3x each) into one with the combined count and time span.
+    """
     index: int
     started_at: str
     finished_at: str | None = None
@@ -97,9 +127,38 @@ class ProbeAttempt:
     outcome: str | None = None      # ok | timeout | error | cpu-fallback
     error_class: str | None = None  # e.g. TimeoutExpired, CalledProcessError
     message: str | None = None
+    attempts: int = 1
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+
+#: fields that define probe-attempt identity for collapsing (timestamps
+#: and index vary between identical retries; outcome facts must not)
+_PROBE_IDENTITY = ("outcome", "error_class", "message", "timeout_s")
+
+
+def collapse_probe_attempts(attempts: list) -> list[dict]:
+    """Collapse identical CONSECUTIVE probe-attempt records into one.
+
+    Merged record: first record's ``index``/``started_at``, last
+    record's ``finished_at``, summed ``attempts``.  Non-consecutive or
+    differing records are preserved in order — the collapse only
+    removes pure retry noise, never reorders the probe history.
+    """
+    out: list[dict] = []
+    for att in attempts:
+        att = att.to_dict() if isinstance(att, ProbeAttempt) else dict(att)
+        att.setdefault("attempts", 1)
+        prev = out[-1] if out else None
+        if prev is not None and all(
+                prev.get(k) == att.get(k) for k in _PROBE_IDENTITY):
+            prev["attempts"] += att["attempts"]
+            if att.get("finished_at"):
+                prev["finished_at"] = att["finished_at"]
+        else:
+            out.append(att)
+    return out
 
 
 @dataclasses.dataclass
@@ -131,9 +190,12 @@ class RunManifest:
         return m
 
     def add_probe_attempt(self, attempt: ProbeAttempt | dict):
+        """Append a probe attempt, collapsing it into the previous
+        record when it is an identical consecutive retry."""
         if isinstance(attempt, ProbeAttempt):
             attempt = attempt.to_dict()
-        self.probe_attempts.append(dict(attempt))
+        self.probe_attempts = collapse_probe_attempts(
+            self.probe_attempts + [dict(attempt)])
 
     def finish(self, status: str = "ok", metrics: dict = None,
                phases: list = None) -> "RunManifest":

@@ -235,6 +235,32 @@ def to_prometheus() -> str:
     return REGISTRY.to_prometheus()
 
 
+def record_build_info() -> dict:
+    """Info-style ``raft_tpu_build_info`` gauge (value 1, facts as
+    labels: git SHA, dirty working tree, package and jax versions) so
+    every scraped metrics page / embedded manifest snapshot is
+    attributable to a commit.  Returns the label dict."""
+    from raft_tpu.obs.manifest import git_dirty, git_sha
+
+    labels = {"git_sha": git_sha() or "unknown"}
+    dirty = git_dirty()
+    labels["dirty"] = "unknown" if dirty is None else str(dirty).lower()
+    try:
+        import raft_tpu
+        labels["version"] = getattr(raft_tpu, "__version__", "unknown")
+    except Exception:                            # pragma: no cover
+        labels["version"] = "unknown"
+    try:
+        import jax
+        labels["jax_version"] = jax.__version__
+    except Exception:
+        labels["jax_version"] = "unavailable"
+    gauge("raft_tpu_build_info",
+          "build/commit identity of the running raft_tpu "
+          "(info-style gauge, always 1)").set(1.0, **labels)
+    return labels
+
+
 # ---------------------------------------------------------------------------
 # JAX compile/retrace telemetry
 # ---------------------------------------------------------------------------

@@ -179,7 +179,10 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
         "sharded": mesh is not None,
         "mesh_devices": 0 if mesh is None else int(mesh.devices.size),
         **{k: v for k, v in kw.items() if isinstance(v, (int, float, str))}})
+    obs.record_build_info()
+    obs.device.jit_cache_delta(scope="sweep_cases")      # delta baseline
     status = "failed"
+    ledger = None
     try:
         with obs.span("sweep_cases", ncases=ncases,
                       sharded=mesh is not None) as sp:
@@ -194,8 +197,16 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                     Hs = jax.device_put(Hs, sh)
                     Tp = jax.device_put(Tp, sh)
                     beta = jax.device_put(beta, sh)
+            # AOT: lower once (static HLO cost analysis of the sweep
+            # kernel rides along for free), compile, execute — the same
+            # single trace+compile a plain jitted call would do
+            with obs.span("sweep_lower", ncases=ncases):
+                lowered = batched.lower(Hs, Tp, beta)
+                obs.device.cost_analysis(lowered, kernel="sweep_batched")
+            with obs.span("sweep_compile", ncases=ncases):
+                compiled = lowered.compile()
             with obs.span("sweep_execute", ncases=ncases):
-                out = batched(Hs, Tp, beta)
+                out = compiled(Hs, Tp, beta)
                 jax.block_until_ready(out["std"])
             iters = np.asarray(out["iters"])
             n_conv = int(np.asarray(out["converged"]).sum())
@@ -212,7 +223,11 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                 "raft_sweep_batch_cases",
                 "case-batch size of the most recent sweep",
                 ).set(ncases, sharded=str(mesh is not None).lower())
+        obs.device.collect(manifest, scope="sweep_cases")
+        ledger = obs.ledger_from_sweep(out, config=dict(manifest.config),
+                                       run_id=manifest.run_id)
         status = "ok"
         return out
     finally:
-        obs.finish_run(manifest, status=status, write_trace=False)
+        obs.finish_run(manifest, status=status, write_trace=False,
+                       ledger=ledger)

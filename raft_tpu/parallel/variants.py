@@ -364,22 +364,42 @@ def sweep_variants(base: FOWTModel, thetas: dict, mesh: Optional[Mesh] = None,
     """vmap the per-variant pipeline over a θ batch, sharding the variant
     axis over ``mesh`` (the reference's serial parametersweep loop
     collapsed onto the device mesh)."""
+    from raft_tpu import obs
+
     solver = make_variant_solver(base, **kw)
     batched = jax.jit(solver.batched)
     thetas = {k: jnp.asarray(v) if not isinstance(v, list) else
               [jnp.asarray(x) for x in v] for k, v in thetas.items()}
     nv = len(jax.tree.leaves(thetas)[0])
-    if mesh is not None:
-        ndev = int(np.prod(list(mesh.shape.values())))
-        # pad the variant axis to a device multiple (repeat the last row)
-        npad = (-nv) % ndev
-        if npad:
-            thetas = jax.tree.map(
-                lambda x: jnp.concatenate(
-                    [x, jnp.repeat(x[-1:], npad, axis=0)]), thetas)
-        sh = NamedSharding(mesh, P(axis_name))
-        thetas = jax.tree.map(lambda x: jax.device_put(x, sh), thetas)
-    out = batched(thetas)
+    with obs.span("sweep_variants", nv=nv, sharded=mesh is not None) as sp:
+        if mesh is not None:
+            ndev = int(np.prod(list(mesh.shape.values())))
+            # pad the variant axis to a device multiple (repeat the last row)
+            npad = (-nv) % ndev
+            if npad:
+                thetas = jax.tree.map(
+                    lambda x: jnp.concatenate(
+                        [x, jnp.repeat(x[-1:], npad, axis=0)]), thetas)
+            sh = NamedSharding(mesh, P(axis_name))
+            thetas = jax.tree.map(lambda x: jax.device_put(x, sh), thetas)
+        # AOT lower/compile: the same single trace+compile a jitted call
+        # would do, with the static HLO cost analysis (FLOPs / bytes
+        # estimates for the variant kernel) riding along for free
+        with obs.span("variants_lower", nv=nv):
+            lowered = batched.lower(thetas)
+            cost = obs.device.cost_analysis(lowered,
+                                            kernel="variant_batched")
+            if cost:
+                sp.set(hlo_flops=cost.get("flops"))
+        with obs.span("variants_compile", nv=nv):
+            compiled = lowered.compile()
+        with obs.span("variants_execute", nv=nv):
+            out = compiled(thetas)
+            jax.block_until_ready(out["std"])
+        obs.gauge(
+            "raft_variant_batch_size",
+            "variant-batch size of the most recent sweep_variants call",
+            ).set(nv, sharded=str(mesh is not None).lower())
     return jax.tree.map(lambda x: x[:nv], out)
 
 

@@ -23,7 +23,23 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+from raft_tpu import obs  # noqa: E402
+
 REFERENCE_DIR = "/root/reference"
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation(monkeypatch):
+    """Observability state is process-global (span buffer, metrics
+    registry, jit-cache baselines, output dir) — reset ALL of it around
+    every test so no test can leak spans/metrics/artifacts into another.
+    Module-scoped fixtures that run instrumented pipelines must capture
+    whatever obs state they assert on at fixture time."""
+    monkeypatch.delenv("RAFT_TPU_OBS_DIR", raising=False)
+    monkeypatch.delenv("RAFT_TPU_OBS_MAX_RUNS", raising=False)
+    obs.reset_all()
+    yield
+    obs.reset_all()
 
 
 @pytest.fixture(scope="session")

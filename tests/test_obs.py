@@ -19,20 +19,8 @@ from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.obs import tracing as obs_tracing
 
 
-@pytest.fixture(autouse=True)
-def _clean_obs():
-    """Each test sees an empty tracer/registry and no output dir."""
-    obs.reset_tracing()
-    obs.REGISTRY.reset()
-    obs.configure(None)
-    old_env = os.environ.pop("RAFT_TPU_OBS_DIR", None)
-    yield
-    obs.reset_tracing()
-    obs.REGISTRY.reset()
-    obs.configure(None)
-    if old_env is not None:
-        os.environ["RAFT_TPU_OBS_DIR"] = old_env
-
+# per-test isolation (tracer/registry/output dir) comes from the autouse
+# obs.reset_all() fixture in conftest.py
 
 # ---------------------------------------------------------------------------
 # tracing
@@ -345,8 +333,98 @@ def test_finish_run_writes_manifest_and_trace(tmp_path):
 def test_finish_run_without_dir_writes_nothing(tmp_path):
     m = obs.RunManifest.begin("unit", devices=False)
     paths = obs.finish_run(m, status="ok")
-    assert paths == {"manifest": None, "trace": None}
+    assert paths == {"manifest": None, "trace": None, "ledger": None}
     assert m.status == "ok"
+
+
+def test_reset_all_clears_every_pillar(tmp_path):
+    obs.configure(str(tmp_path), max_runs=3)
+    obs.counter("t_reset").inc()
+    with obs.span("t_span"):
+        pass
+    obs.reset_all()
+    assert obs.snapshot() == {}
+    assert obs.spans() == []
+    assert obs.aggregate() == {}
+    assert obs.out_dir() is None
+    assert obs.max_runs() is None
+
+
+def test_max_runs_retention_prunes_oldest(tmp_path):
+    """configure(max_runs=N) keeps only the newest N runs' artifact
+    triples (manifest + trace + ledger) on disk."""
+    import time as _time
+
+    obs.configure(str(tmp_path), max_runs=2)
+    run_ids = []
+    for i in range(4):
+        m = obs.RunManifest.begin("unit", devices=False)
+        run_ids.append(m.run_id)
+        with obs.span("p"):
+            pass
+        ledger = {"schema": "raft_tpu.ledger/v1", "run_id": m.run_id,
+                  "kind": "unit", "created_at": "t", "environment": {},
+                  "config": {}, "entries": [], "digest": None}
+        obs.finish_run(m, status="ok", ledger=ledger)
+        _time.sleep(0.02)            # distinct mtimes for the prune order
+    files = sorted(os.listdir(tmp_path))
+    manifests = [f for f in files if f.endswith(".manifest.json")]
+    assert len(manifests) == 2
+    # the two NEWEST runs survive, each with its full artifact triple
+    for rid in run_ids[2:]:
+        assert f"unit_{rid}.manifest.json" in files
+        assert f"unit_{rid}.trace.json" in files
+        assert f"unit_{rid}.ledger.json" in files
+    for rid in run_ids[:2]:
+        assert not any(rid in f for f in files)
+
+
+def test_build_info_gauge():
+    labels = obs.record_build_info()
+    assert set(labels) == {"git_sha", "dirty", "version", "jax_version"}
+    assert labels["dirty"] in ("true", "false", "unknown")
+    snap = obs.snapshot()
+    (s,) = snap["raft_tpu_build_info"]["series"]
+    assert s["value"] == 1.0
+    assert s["labels"]["git_sha"] == labels["git_sha"]
+    assert "raft_tpu_build_info{" in obs.to_prometheus()
+
+
+def test_collapse_probe_attempts():
+    base = {"started_at": "t0", "finished_at": "t1", "timeout_s": 240.0,
+            "outcome": "timeout", "error_class": "TimeoutExpired",
+            "message": "no backend after 240s"}
+    atts = [dict(base, index=i, started_at=f"t{2 * i}",
+                 finished_at=f"t{2 * i + 1}") for i in range(3)]
+    collapsed = obs.collapse_probe_attempts(atts)
+    assert len(collapsed) == 1
+    assert collapsed[0]["attempts"] == 3
+    assert collapsed[0]["started_at"] == "t0"      # first try's start
+    assert collapsed[0]["finished_at"] == "t5"     # last try's end
+    # a differing record breaks the run — order is preserved
+    atts.append(dict(base, index=3, outcome="error",
+                     error_class="CalledProcessError"))
+    atts.append(dict(base, index=4))
+    collapsed = obs.collapse_probe_attempts(atts)
+    assert [a["outcome"] for a in collapsed] == ["timeout", "error",
+                                                 "timeout"]
+    assert [a["attempts"] for a in collapsed] == [3, 1, 1]
+
+
+def test_manifest_collapses_identical_retries():
+    """The r01–r05 benches logged the same hang string 3x — through
+    add_probe_attempt those now fold into ONE record with attempts=3."""
+    m = obs.RunManifest.begin("bench", devices=False)
+    for i in range(3):
+        m.add_probe_attempt(obs.ProbeAttempt(
+            index=i, started_at=f"s{i}", finished_at=f"f{i}",
+            timeout_s=240.0, outcome="timeout",
+            error_class="TimeoutExpired",
+            message="no backend after 240s (stale-claim tunnel wedge?)"))
+    assert len(m.probe_attempts) == 1
+    assert m.probe_attempts[0]["attempts"] == 3
+    doc = m.finish("tpu_unavailable").to_dict()
+    assert obs.validate_manifest(doc) == []
 
 
 # ---------------------------------------------------------------------------

@@ -17,13 +17,10 @@ import bench
 
 @pytest.fixture(autouse=True)
 def _clean_obs(tmp_path):
-    obs.reset_tracing()
-    obs.REGISTRY.reset()
+    """Full pillar reset comes from the conftest autouse fixture; here
+    each test additionally gets a throwaway output directory."""
     obs.configure(str(tmp_path))
     yield tmp_path
-    obs.reset_tracing()
-    obs.REGISTRY.reset()
-    obs.configure(None)
 
 
 class _FakeCompleted:
@@ -112,3 +109,99 @@ def test_obs_default_dir(monkeypatch, tmp_path):
     obs.configure(str(tmp_path))
     bench._obs_default()
     assert obs.out_dir() == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# bench self-compare (regression sentinel hook)
+# ---------------------------------------------------------------------------
+
+def _write_prev_manifest(out_dir, value=1000.0, duration=10.0):
+    prev = obs.RunManifest.begin(kind="bench", devices=False)
+    prev.config = {"NV": 64}
+    prev.extra["result"] = {"value": value, "vs_baseline": 2.0, "ok": True}
+    prev.finish("ok")
+    prev.duration_s = duration
+    return prev.write(os.path.join(
+        out_dir, f"bench_{prev.run_id}.manifest.json"))
+
+
+def _begin_current(duration=10.0):
+    """A current-run manifest whose finish()-computed duration lands on
+    ``duration`` seconds, so wall-time jitter can't trip the perf
+    tolerance in these tests."""
+    import datetime
+
+    m = obs.RunManifest.begin(kind="bench", devices=False)
+    m.started_at = (datetime.datetime.now(datetime.timezone.utc)
+                    - datetime.timedelta(seconds=duration)).isoformat()
+    return m
+
+
+def test_self_compare_no_baseline(_clean_obs):
+    """First bench run in a fresh obs dir: verdict says so, never fails."""
+    m = obs.RunManifest.begin(kind="bench", devices=False)
+    verdict = bench._self_compare(obs, m, "ok")
+    assert verdict["ok"] is None
+    assert "previous bench manifest" in verdict["note"]
+    assert m.extra["self_compare"] is verdict
+
+
+def test_self_compare_clean_against_previous(_clean_obs):
+    prev_path = _write_prev_manifest(str(_clean_obs), value=1000.0)
+    m = _begin_current()
+    m.config = {"NV": 64}
+    m.extra["result"] = {"value": 1001.0, "vs_baseline": 2.0, "ok": True}
+    verdict = bench._self_compare(obs, m, "ok")
+    assert verdict["ok"] is True
+    assert verdict["baseline"] == os.path.basename(prev_path)
+    assert verdict["n_regressions"] == 0
+    # the verdict rides inside the manifest written to disk
+    paths = obs.finish_run(m, status="ok", write_trace=False)
+    doc = json.load(open(paths["manifest"]))
+    assert doc["extra"]["self_compare"]["ok"] is True
+
+
+def test_self_compare_flags_perf_collapse(_clean_obs):
+    """A >50% throughput drop against the previous bench manifest flips
+    the embedded verdict to not-ok."""
+    _write_prev_manifest(str(_clean_obs), value=1000.0)
+    m = _begin_current()
+    m.config = {"NV": 64}
+    m.extra["result"] = {"value": 100.0, "vs_baseline": 0.2, "ok": True}
+    verdict = bench._self_compare(obs, m, "ok")
+    assert verdict["ok"] is False
+    metrics = {r["metric"] for r in verdict["regressions"]}
+    assert "extra:result:value" in metrics
+
+
+def test_self_compare_skips_incomparable_baselines(_clean_obs):
+    """A tpu_unavailable round or a different-config run in the obs dir
+    must not become the baseline — the first healthy run after either
+    compares against the last comparable ok manifest (or none)."""
+    import time
+
+    # oldest: a comparable ok run — this is the right baseline
+    _write_prev_manifest(str(_clean_obs), value=1000.0)
+    time.sleep(0.02)
+    # newer: a probe-failure round (status tpu_unavailable, ~0 duration)
+    failed = obs.RunManifest.begin(kind="bench", devices=False)
+    failed.config = {"NV": 64}
+    failed.finish("tpu_unavailable")
+    failed.write(os.path.join(str(_clean_obs),
+                              f"bench_{failed.run_id}.manifest.json"))
+    time.sleep(0.02)
+    # newest: ok but a different bench size — not comparable either
+    resized = obs.RunManifest.begin(kind="bench", devices=False)
+    resized.config = {"NV": 16}
+    resized.extra["result"] = {"value": 10.0, "ok": True}
+    resized.finish("ok")
+    resized.duration_s = 10.0
+    resized.write(os.path.join(str(_clean_obs),
+                               f"bench_{resized.run_id}.manifest.json"))
+
+    m = _begin_current()
+    m.config = {"NV": 64}
+    m.extra["result"] = {"value": 1001.0, "vs_baseline": 2.0, "ok": True}
+    verdict = bench._self_compare(obs, m, "ok")
+    assert verdict["ok"] is True, verdict
+    assert verdict["n_regressions"] == 0

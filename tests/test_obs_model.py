@@ -3,13 +3,20 @@
 One coarse-grid run of the full statics/dynamics/QTF/outputs pipeline
 must produce (a) a Chrome trace with correctly nested phase spans, (b) a
 metrics snapshot with per-case fixed-point iteration/residual series and
-a dynamics condition-number gauge, and (c) a schema-valid run manifest —
-written to the configured obs directory.
+a dynamics condition-number gauge, (c) a schema-valid run manifest —
+written to the configured obs directory — and (d) a schema-valid result
+ledger with per-case RAO/response digests (the regression sentinel's
+input).
 
 Uses the vendored Vertical_cylinder design (no turbine — keeps the
 compile budget small) with internal second-order forces switched on so
 the calcQTF_slenderBody span is exercised too.  The OC3 spar runs the
-same instrumentation end-to-end in tests/test_model_oc3.py (slow tier).
+same instrumentation end-to-end in tests/test_regression_sentinel.py
+(slow tier).
+
+The conftest autouse fixture resets ALL obs state around every test, so
+the module-scoped run below captures everything it asserts on (spans,
+aggregate, metrics snapshot, ledger) at fixture time.
 """
 import json
 import os
@@ -24,8 +31,7 @@ from raft_tpu.model import Model
 @pytest.fixture(scope="module")
 def analyzed(tmp_path_factory):
     out_dir = str(tmp_path_factory.mktemp("obs_out"))
-    obs.reset_tracing()
-    obs.REGISTRY.reset()
+    obs.reset_all()
     obs.configure(out_dir)
     design = load_design("Vertical_cylinder")
     design.setdefault("settings", {})
@@ -36,29 +42,34 @@ def analyzed(tmp_path_factory):
     design["platform"]["max_freq2nd"] = 0.25
     model = Model(design)
     model.analyzeCases()
-    yield model, out_dir
-    obs.configure(None)
-    obs.reset_tracing()
-    obs.REGISTRY.reset()
+    state = {
+        "model": model,
+        "out_dir": out_dir,
+        "agg": obs.aggregate(),
+        "spans": obs.spans(),
+        "snap": obs.snapshot(),
+        "prom": obs.to_prometheus(),
+    }
+    yield state
+    obs.reset_all()
 
 
 def test_phase_spans_recorded(analyzed):
-    model, _ = analyzed
-    agg = obs.aggregate()
+    agg = analyzed["agg"]
     for phase in ("analyzeCases", "solveStatics", "solveDynamics",
                   "fowt_linearize", "calcQTF_slenderBody",
                   "saveTurbineOutputs"):
         assert phase in agg, f"missing span {phase!r}"
         assert agg[phase][1] >= 1
     # nesting: the linearization span is a child of solveDynamics
-    spans = {e["name"]: e for e in obs.spans()}
+    spans = {e["name"]: e for e in analyzed["spans"]}
     assert spans["fowt_linearize"]["parent"] == "solveDynamics"
     assert spans["solveDynamics"]["parent"] == "analyzeCases"
     assert spans["solveStatics"]["parent"] == "analyzeCases"
 
 
 def test_fixed_point_and_condition_metrics(analyzed):
-    snap = obs.snapshot()
+    snap = analyzed["snap"]
     hist = snap["raft_fixed_point_iterations"]
     assert hist["kind"] == "histogram"
     series = hist["series"]
@@ -74,13 +85,13 @@ def test_fixed_point_and_condition_metrics(analyzed):
     stat = snap["raft_statics_newton_iterations"]
     assert stat["series"][0]["count"] >= 1
     # the Prometheus view renders without error and carries the series
-    text = obs.to_prometheus()
+    text = analyzed["prom"]
     assert "raft_fixed_point_iterations_bucket" in text
     assert "raft_dynamics_condition_number" in text
 
 
 def test_manifest_and_trace_written(analyzed):
-    model, out_dir = analyzed
+    model, out_dir = analyzed["model"], analyzed["out_dir"]
     manifest = model.last_manifest
     assert manifest is not None and manifest.status == "ok"
     doc = manifest.to_dict()
@@ -102,3 +113,47 @@ def test_manifest_and_trace_written(analyzed):
     trace = json.load(open(os.path.join(out_dir, trace_files[0])))
     names = {e["name"] for e in trace["traceEvents"]}
     assert {"analyzeCases", "solveStatics", "solveDynamics"} <= names
+
+
+def test_build_info_and_device_telemetry_in_manifest(analyzed):
+    snap = analyzed["snap"]
+    (s,) = snap["raft_tpu_build_info"]["series"]
+    assert s["value"] == 1.0 and "git_sha" in s["labels"]
+    doc = analyzed["model"].last_manifest.to_dict()
+    telem = doc["extra"]["device_telemetry"]
+    assert "devices" in telem and "live_arrays" in telem
+    la = telem["live_arrays"]
+    assert la is None or (la["count"] >= 0 and la["total_bytes"] >= 0)
+    # the batched dynamics solve got a static HLO cost analysis
+    assert "raft_hlo_flops" in snap
+    assert any(s["labels"].get("kernel") == "dynamics_system_solve"
+               for s in snap["raft_hlo_flops"]["series"])
+
+
+def test_ledger_written_and_valid(analyzed):
+    from raft_tpu.obs import ledger as L
+
+    model, out_dir = analyzed["model"], analyzed["out_dir"]
+    led = model.last_ledger
+    assert led is not None
+    assert L.validate_ledger(led) == []
+    keys = [e["key"] for e in led["entries"]]
+    assert "case0/fowt0" in keys and "case0/system" in keys
+    fowt0 = next(e for e in led["entries"] if e["key"] == "case0/fowt0")
+    # the RAO fingerprint and the solver facts both made it in
+    assert "rao_mag_max_surge" in fowt0["metrics"]
+    assert "std_heave" in fowt0["metrics"]
+    assert "drag_iters" in fowt0["metrics"]
+    system = next(e for e in led["entries"] if e["key"] == "case0/system")
+    assert "cond_max" in system["metrics"]
+    assert "statics_iters" in system["metrics"]
+    # on-disk copy next to the manifest, identical digest
+    ledger_files = [f for f in os.listdir(out_dir)
+                    if f.endswith(".ledger.json")]
+    assert len(ledger_files) == 1
+    on_disk = L.load_ledger(os.path.join(out_dir, ledger_files[0]))
+    assert L.validate_ledger(on_disk) == []
+    assert on_disk["digest"] == led["digest"]
+    # a self-diff of the persisted ledger reports zero regressions
+    report = L.diff(led, on_disk)
+    assert report["ok"] and report["identical"]

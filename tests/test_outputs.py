@@ -117,6 +117,9 @@ def small_model():
     m = Model(design)
     m.analyzeUnloaded()
     m.analyzeCases(display=1)
+    # the autouse obs-isolation fixture resets the span aggregate around
+    # every test — capture the timing view now, at fixture time
+    m.timing_at_fixture = timing_report()
     return m
 
 
@@ -148,8 +151,9 @@ def test_plots(small_model, tmp_path):
     import matplotlib.pyplot as plt
     plt.close("all")
 
-    # timing registry was fed by analyzeCases
-    rep = timing_report()
+    # timing registry was fed by analyzeCases (captured at fixture time;
+    # the autouse obs reset clears the live aggregate between tests)
+    rep = small_model.timing_at_fixture
     assert "solveDynamics" in rep and rep["solveDynamics"][1] >= 1
 
 

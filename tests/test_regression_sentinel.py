@@ -1,0 +1,219 @@
+"""Cross-run regression sentinel canary (acceptance criterion).
+
+The fast tier pins the sentinel's machinery against the two committed
+golden ledgers under ``tests/golden/`` (OC3 spar + VolturnUS-S, coarse
+frequency grids): the goldens must stay schema-valid and
+content-addressed, ``obsctl diff`` of a golden against itself must
+report zero regressions, perturbing one RAO digest beyond tolerance
+must make ``obsctl`` exit nonzero, and ``obsctl selfcheck`` must pass —
+so CI catches both physics drift and sentinel rot.
+
+The slow tier closes the loop end-to-end: it reruns the exact coarse
+OC3 configuration the golden was generated from, diffs the live ledger
+against the golden, and runs the model twice back-to-back asserting the
+two ledgers diff to zero regressions through the real ``obsctl`` exit
+path.
+"""
+import copy
+import importlib.util
+import json
+import os
+
+import pytest
+
+from raft_tpu.obs import ledger as L
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+GOLDENS = {
+    "OC3spar": os.path.join(GOLDEN_DIR, "oc3spar_coarse.ledger.json"),
+    "VolturnUS-S": os.path.join(GOLDEN_DIR, "volturnus_coarse.ledger.json"),
+}
+#: the coarse grid the goldens were generated on (one load case)
+GOLDEN_FREQ = {"min_freq": 0.02, "max_freq": 0.2}
+
+
+def _load_obsctl():
+    """Import tools/obsctl.py (tools/ is not a package) once per session."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "obsctl.py")
+    spec = importlib.util.spec_from_file_location("obsctl", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def obsctl():
+    return _load_obsctl()
+
+
+def _run_coarse(name):
+    """One analyzeCases run of design ``name`` on the golden grid;
+    returns the resulting ledger."""
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.model import Model
+
+    design = load_design(name)
+    design.setdefault("settings", {})
+    design["settings"].update(GOLDEN_FREQ)
+    design["cases"]["data"] = design["cases"]["data"][:1]
+    model = Model(design)
+    model.analyzeCases()
+    return model.last_ledger
+
+
+# ---------------------------------------------------------------------------
+# fast tier: the committed goldens and the obsctl exit paths
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(GOLDENS))
+def test_golden_ledger_is_valid(name):
+    led = L.load_ledger(GOLDENS[name])
+    assert L.validate_ledger(led) == []
+    keys = {e["key"] for e in led["entries"]}
+    assert "case0/fowt0" in keys and "case0/system" in keys
+    fowt0 = next(e for e in led["entries"] if e["key"] == "case0/fowt0")
+    for metric in ("rao_mag_max_surge", "rao_phase_peak_pitch",
+                   "mean_heave", "std_surge", "drag_iters"):
+        assert metric in fowt0["metrics"], f"golden lost {metric}"
+
+
+def test_goldens_are_distinct_designs():
+    a = L.load_ledger(GOLDENS["OC3spar"])
+    b = L.load_ledger(GOLDENS["VolturnUS-S"])
+    assert a["digest"] != b["digest"]
+    assert not L.diff(a, b)["ok"]      # different platforms must not diff clean
+
+
+@pytest.mark.parametrize("name", sorted(GOLDENS))
+def test_obsctl_diff_golden_vs_itself_is_clean(obsctl, name, capsys):
+    rc = obsctl.main(["diff", GOLDENS[name], GOLDENS[name]])
+    assert rc == 0
+    assert "digests identical" in capsys.readouterr().out
+
+
+def test_perturbed_rao_digest_exits_nonzero(obsctl, tmp_path, capsys):
+    """Acceptance: perturbing one RAO metric by > tolerance makes obsctl
+    exit nonzero; the same perturbation passes under a loose tolerance."""
+    led = L.load_ledger(GOLDENS["OC3spar"])
+    bad = copy.deepcopy(led)
+    e = next(x for x in bad["entries"] if x["key"] == "case0/fowt0")
+    e["metrics"]["rao_mag_max_surge"] *= 1.0 + 1e-4     # >> 1e-6 tol
+    e["digest"] = L.digest_metrics(e["metrics"])
+    bad["digest"] = None
+    path = L.write_ledger(bad, str(tmp_path / "perturbed.ledger.json"))
+
+    rc = obsctl.main(["diff", GOLDENS["OC3spar"], path, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    (reg,) = report["regressions"]
+    assert reg["metric"] == "rao_mag_max_surge"
+    # check mode agrees, and a per-metric tolerance override clears it
+    assert obsctl.main(["check", "--baseline", GOLDENS["OC3spar"],
+                        path]) == 1
+    assert obsctl.main(["check", "--baseline", GOLDENS["OC3spar"], path,
+                        "--tol", "rao_mag_*=1e-3"]) == 0
+    capsys.readouterr()
+
+
+def test_tampered_golden_fails_check(obsctl, tmp_path, capsys):
+    """Content addressing: editing metrics without re-digesting is
+    caught by `obsctl check` even when the values would be in tolerance."""
+    led = L.load_ledger(GOLDENS["VolturnUS-S"])
+    led["entries"][0]["metrics"]["drag_iters"] = 999
+    path = str(tmp_path / "tampered.ledger.json")
+    with open(path, "w") as f:
+        json.dump(led, f)
+    rc = obsctl.main(["check", "--baseline", GOLDENS["VolturnUS-S"], path])
+    assert rc == 1
+    assert "digest mismatch" in capsys.readouterr().out
+
+
+def test_obsctl_trend_over_goldens(obsctl, capsys):
+    rc = obsctl.main(["trend", GOLDEN_DIR])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "oc3spar_coarse.ledger.json" in out
+    assert "ledger/analyzeCases" in out
+
+
+def test_obsctl_selfcheck(obsctl, capsys):
+    """CI guard: the synthetic round-trip through diff/check/trend."""
+    rc = obsctl.main(["selfcheck"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "obsctl selfcheck: OK" in out
+
+
+# ---------------------------------------------------------------------------
+# slow tier: live reruns against the goldens (the actual canary)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(GOLDENS))
+def test_live_run_matches_golden(name):
+    """Physics drift canary: rerunning the exact golden configuration
+    must reproduce every digested metric to 1e-6 relative."""
+    led = _run_coarse(name)
+    golden = L.load_ledger(GOLDENS[name])
+    report = L.diff(golden, led, tol_rel=1e-6)
+    assert report["ok"], L.format_diff(report)
+
+
+@pytest.mark.slow
+def test_back_to_back_runs_diff_clean_through_obsctl(obsctl, tmp_path,
+                                                    capsys):
+    """Acceptance: obsctl diff on two ledgers from back-to-back identical
+    CPU runs of the OC3 example reports zero regressions."""
+    pa = L.write_ledger(_run_coarse("OC3spar"),
+                        str(tmp_path / "run_a.ledger.json"))
+    pb = L.write_ledger(_run_coarse("OC3spar"),
+                        str(tmp_path / "run_b.ledger.json"))
+    rc = obsctl.main(["diff", pa, pb])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 regression(s)" in out
+
+
+def test_check_rejects_invalid_baseline(obsctl, tmp_path, capsys):
+    """A tampered BASELINE is bad input (exit 2), not a regression."""
+    led = L.load_ledger(GOLDENS["OC3spar"])
+    led["entries"][0]["metrics"]["drag_iters"] = 999   # no re-digest
+    bad_base = str(tmp_path / "bad_base.ledger.json")
+    with open(bad_base, "w") as f:
+        json.dump(led, f)
+    with pytest.raises(SystemExit) as exc:
+        obsctl.main(["check", "--baseline", bad_base, GOLDENS["OC3spar"]])
+    assert exc.value.code == 2
+    assert "baseline ledger is invalid" in capsys.readouterr().err
+
+
+def test_diff_directory_arg_is_bad_invocation(obsctl, capsys):
+    """A directory where a file is expected exits 2, not 1."""
+    with pytest.raises(SystemExit) as exc:
+        obsctl.main(["diff", GOLDEN_DIR, GOLDENS["OC3spar"]])
+    assert exc.value.code == 2
+    capsys.readouterr()
+
+
+def test_manifest_removed_key_is_regression(obsctl, tmp_path, capsys):
+    """A metric/phase the newer run LOST flags the manifest diff; one it
+    gained does not."""
+    man_a = {"schema": "raft_tpu.run_manifest/v1", "run_id": "a",
+             "kind": "bench", "status": "ok", "duration_s": 10.0,
+             "phases": [{"name": "solve", "total_s": 8.0, "calls": 1}],
+             "metrics": {}, "extra": {}}
+    man_b = json.loads(json.dumps(man_a))
+    man_b["run_id"] = "b"
+    man_b["phases"] = [{"name": "other", "total_s": 8.0, "calls": 1}]
+    pa, pb = str(tmp_path / "a.manifest.json"), str(tmp_path /
+                                                   "b.manifest.json")
+    json.dump(man_a, open(pa, "w"))
+    json.dump(man_b, open(pb, "w"))
+    assert obsctl.main(["diff", pa, pb]) == 1    # solve phase vanished
+    assert obsctl.main(["diff", pb, pa]) == 1    # other phase vanished
+    man_b["phases"].insert(0, {"name": "solve", "total_s": 8.0,
+                               "calls": 1})
+    json.dump(man_b, open(pb, "w"))
+    assert obsctl.main(["diff", pa, pb]) == 0    # superset: added only
+    capsys.readouterr()

@@ -1,0 +1,398 @@
+#!/usr/bin/env python
+"""obsctl — the cross-run regression sentinel's command line.
+
+Diffs, checks, and trends the artifacts the `raft_tpu.obs` layer writes:
+result ledgers (``raft_tpu.ledger/v1`` — content-addressed physics
+digests), run manifests (``raft_tpu.run_manifest/v1``), and the
+historical bench round files (``BENCH_r0*.json`` / ``MULTICHIP_r0*.json``).
+
+Subcommands::
+
+    obsctl diff A B                 # ledger-vs-ledger or manifest-vs-
+                                    # manifest; exit 1 on any regression
+    obsctl check --baseline L CUR   # CUR ledger against a golden/baseline
+                                    # ledger with per-metric tolerances
+    obsctl trend <dir | files...>   # text trend table over a run series
+    obsctl selfcheck                # round-trip a synthetic ledger through
+                                    # diff/check/trend; exit 1 on failure
+
+Exit codes: 0 = no regression, 1 = regression (or selfcheck failure),
+2 = bad invocation / unreadable input.
+
+Pure stdlib + raft_tpu.obs.ledger — never initializes a JAX backend, so
+it is safe to run on a host whose TPU tunnel is wedged.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.obs import ledger as L  # noqa: E402
+
+
+def _fail(msg: str, code: int = 2):
+    print(f"obsctl: {msg}", file=sys.stderr)
+    raise SystemExit(code)
+
+
+def _parse_tols(pairs: list[str]) -> dict:
+    """['rao_*=1e-4', 'drag_iters=0'] -> {pattern: tol}."""
+    out = {}
+    for p in pairs or []:
+        if "=" not in p:
+            _fail(f"--tol expects PATTERN=TOL, got {p!r}")
+        pat, _, tol = p.partition("=")
+        try:
+            out[pat] = float(tol)
+        except ValueError:
+            _fail(f"--tol {p!r}: {tol!r} is not a number")
+    return out
+
+
+def _load(path: str) -> tuple[str, dict]:
+    try:
+        return L.load_any(path)
+    except OSError as e:
+        _fail(f"{path}: {e.strerror or e}")
+    except (ValueError, json.JSONDecodeError) as e:
+        _fail(str(e))
+
+
+# ---------------------------------------------------------------------------
+# diff / check
+# ---------------------------------------------------------------------------
+
+def cmd_diff(args) -> int:
+    kind_a, a = _load(args.a)
+    kind_b, b = _load(args.b)
+    if kind_a != kind_b:
+        _fail(f"cannot diff a {kind_a} against a {kind_b} "
+              f"({args.a} vs {args.b})")
+    per_metric = _parse_tols(args.tol)
+    if kind_a == "ledger":
+        report = L.diff(a, b, tol_rel=args.tol_rel, per_metric=per_metric,
+                        ignore=tuple(args.ignore or ()))
+    else:
+        report = L.compare_manifests(
+            a, b, tol_rel=args.tol_rel, tol_perf=args.tol_perf,
+            per_metric=per_metric,
+            ignore=L.DEFAULT_MANIFEST_IGNORE + tuple(args.ignore or ()))
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(L.format_diff(report))
+    return 0 if report["ok"] else 1
+
+
+def cmd_check(args) -> int:
+    kind_base, base = _load(args.baseline)
+    kind_cur, cur = _load(args.current)
+    if kind_base != "ledger" or kind_cur != "ledger":
+        _fail("check compares ledgers; use `obsctl diff` for manifests")
+    base_problems = L.validate_ledger(base)
+    if base_problems:
+        # a corrupted/tampered baseline is bad input, not a regression
+        _fail("baseline ledger is invalid: " + "; ".join(base_problems))
+    problems = L.validate_ledger(cur)
+    if problems:
+        print("current ledger is invalid:")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    report = L.diff(base, cur, tol_rel=args.tol_rel,
+                    per_metric=_parse_tols(args.tol),
+                    ignore=tuple(args.ignore or ()))
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(L.format_diff(report))
+    return 0 if report["ok"] else 1
+
+
+# ---------------------------------------------------------------------------
+# trend
+# ---------------------------------------------------------------------------
+
+def _last_json_line(text: str) -> dict | None:
+    """The bench round files wrap the bench's single JSON output line in
+    a free-text ``tail`` — recover the last parseable JSON object."""
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _trend_row(path: str, doc: dict) -> dict:
+    name = os.path.basename(path)
+    schema = doc.get("schema", "")
+    if schema == L.SCHEMA:
+        return {"file": name, "kind": f"ledger/{doc.get('kind')}",
+                "status": "-", "value": len(doc.get("entries", [])),
+                "vs_baseline": None,
+                "digest": (doc.get("digest") or "")[7:19],
+                "when": (doc.get("created_at") or "")[:19]}
+    if schema.startswith("raft_tpu.run_manifest/"):
+        res = (doc.get("extra") or {}).get("result") or {}
+        sc = (doc.get("extra") or {}).get("self_compare") or {}
+        status = doc.get("status")
+        if sc:
+            ok = sc.get("ok")
+            status = f"{status}/" + ("n/a" if ok is None
+                                     else "ok" if ok else "REGR")
+        return {"file": name, "kind": f"manifest/{doc.get('kind')}",
+                "status": status, "value": res.get("value"),
+                "vs_baseline": res.get("vs_baseline"),
+                "digest": f"{doc.get('duration_s', 0) or 0:.1f}s",
+                "when": (doc.get("started_at") or "")[:19]}
+    if "tail" in doc and ("cmd" in doc or "n" in doc):    # BENCH_r0*.json
+        inner = _last_json_line(doc.get("tail", "")) or {}
+        status = "ok" if inner.get("ok") else (
+            inner.get("reason") or f"rc={doc.get('rc')}")
+        return {"file": name, "kind": "bench-round", "status": status,
+                "value": inner.get("value"),
+                "vs_baseline": inner.get("vs_baseline"),
+                "digest": inner.get("unit", "-"), "when": "-"}
+    if "n_devices" in doc:                                # MULTICHIP_r0*.json
+        status = ("skipped" if doc.get("skipped")
+                  else "ok" if doc.get("ok") else f"rc={doc.get('rc')}")
+        return {"file": name, "kind": "multichip", "status": status,
+                "value": doc.get("n_devices"), "vs_baseline": None,
+                "digest": "devices", "when": "-"}
+    return {"file": name, "kind": "unknown", "status": "-", "value": None,
+            "vs_baseline": None, "digest": "-", "when": "-"}
+
+
+def _expand_trend_paths(paths: list[str]) -> list[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            entries = [os.path.join(p, f) for f in os.listdir(p)
+                       if f.endswith((".manifest.json", ".ledger.json"))
+                       or (f.startswith(("BENCH_r", "MULTICHIP_r"))
+                           and f.endswith(".json"))]
+            entries.sort(key=lambda f: (os.path.getmtime(f), f))
+            out.extend(entries)
+        else:
+            out.append(p)
+    return out
+
+
+_TREND_COLS = ("file", "kind", "status", "value", "vs_baseline", "digest",
+               "when")
+
+
+def cmd_trend(args) -> int:
+    paths = _expand_trend_paths(args.paths)
+    if not paths:
+        _fail("trend: no inputs (empty directory?)")
+    rows = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"file": os.path.basename(p), "kind": "unreadable",
+                         "status": type(e).__name__, "value": None,
+                         "vs_baseline": None, "digest": "-", "when": "-"})
+            continue
+        rows.append(_trend_row(p, doc))
+    if args.json:
+        print(json.dumps(rows, indent=1))
+        return 0
+    cells = [[_fmt(r[c]) for c in _TREND_COLS] for r in rows]
+    widths = [max(len(c[i]) for c in cells + [list(_TREND_COLS)])
+              for i in range(len(_TREND_COLS))]
+    print("  ".join(h.ljust(w) for h, w in zip(_TREND_COLS, widths)))
+    print("  ".join("-" * w for w in widths))
+    for row in cells:
+        print("  ".join(v.ljust(w) for v, w in zip(row, widths)))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# selfcheck
+# ---------------------------------------------------------------------------
+
+def cmd_selfcheck(args) -> int:
+    """Round-trip a synthetic ledger and manifest pair through every
+    sentinel code path; any broken invariant exits 1."""
+    import contextlib
+    import copy
+    import io
+    import tempfile
+
+    checks = []
+
+    def check(name, cond):
+        checks.append((name, bool(cond)))
+        if not cond:
+            print(f"selfcheck FAIL: {name}")
+
+    led = L.new_ledger("selfcheck", run_id="self000000a",
+                       config={"nCases": 2})
+    L.add_entry(led, "case0/fowt0", {"rao_mag_max_surge": 1.2345,
+                                     "std_heave": [0.1, 0.2, 0.3],
+                                     "drag_iters": 7})
+    L.add_entry(led, "case0/system", {"cond_max": 1.5e4,
+                                      "statics_iters": 4})
+    L.finalize(led)
+    check("ledger validates", L.validate_ledger(led) == [])
+    check("self-diff ok", L.diff(led, led)["ok"])
+    check("self-diff identical", L.diff(led, led)["identical"])
+
+    # a >tolerance numeric drift must be flagged, with the right name
+    drifted = copy.deepcopy(led)
+    drifted["entries"][0]["metrics"]["rao_mag_max_surge"] *= 1.0 + 1e-3
+    drifted["entries"][0]["digest"] = L.digest_metrics(
+        drifted["entries"][0]["metrics"])
+    drifted["digest"] = None
+    L.finalize(drifted)
+    rep = L.diff(led, drifted, tol_rel=1e-6)
+    check("drift flagged", not rep["ok"] and len(rep["regressions"]) == 1)
+    check("drift named",
+          rep["regressions"][0]["metric"] == "rao_mag_max_surge")
+    check("drift within loose tol ok", L.diff(led, drifted,
+                                              tol_rel=1e-2)["ok"])
+    check("per-metric tol override",
+          L.diff(led, drifted, tol_rel=1e-6,
+                 per_metric={"rao_*": 1e-2})["ok"])
+
+    # vanished entries are regressions too
+    shrunk = copy.deepcopy(led)
+    shrunk["entries"] = shrunk["entries"][:1]
+    shrunk["digest"] = None
+    L.finalize(shrunk)
+    check("removed entry flagged", not L.diff(led, shrunk)["ok"])
+
+    # tampered metrics must fail validation (content addressing)
+    tampered = copy.deepcopy(led)
+    tampered["entries"][1]["metrics"]["cond_max"] = 1.0
+    check("tamper detected",
+          any("digest mismatch" in p
+              for p in L.validate_ledger(tampered)))
+
+    man_a = {"schema": "raft_tpu.run_manifest/v1", "run_id": "a", "kind":
+             "bench", "status": "ok", "duration_s": 10.0,
+             "phases": [{"name": "solve", "total_s": 8.0, "calls": 1}],
+             "metrics": {"raft_statics_residual_norm": {
+                 "kind": "gauge", "series": [
+                     {"labels": {"case": "0"}, "value": 1e-8}]}},
+             "extra": {"result": {"value": 1000.0, "ok": True}}}
+    man_b = copy.deepcopy(man_a)
+    man_b["run_id"] = "b"
+    man_b["duration_s"] = 11.0                 # wall jitter: within perf tol
+    check("manifest self-compare ok",
+          L.compare_manifests(man_a, man_b)["ok"])
+    man_b["status"] = "failed"
+    man_b["extra"]["result"]["value"] = 100.0  # >50% perf regression
+    rep = L.compare_manifests(man_a, man_b)
+    names = {r["metric"] for r in rep["regressions"]}
+    check("manifest status change flagged", "status" in names)
+    check("manifest perf collapse flagged",
+          "extra:result:value" in names)
+
+    with tempfile.TemporaryDirectory() as td:
+        pa = L.write_ledger(copy.deepcopy(led),
+                            os.path.join(td, "a.ledger.json"))
+        pb = L.write_ledger(drifted, os.path.join(td, "b.ledger.json"))
+        kind, loaded = L.load_any(pa)
+        check("write/load round trip",
+              kind == "ledger" and loaded["digest"] == led["digest"])
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc_diff = cmd_diff(argparse.Namespace(
+                a=pa, b=pb, tol_rel=1e-6, tol_perf=0.5, tol=[],
+                ignore=[], json=True))
+        check("diff exit path", rc_diff == 1)
+        with open(os.path.join(td, "BENCH_r99.json"), "w") as f:
+            json.dump({"n": 1, "cmd": "python bench.py", "rc": 0,
+                       "tail": "noise\n" + json.dumps(
+                           {"value": 123.0, "vs_baseline": 2.0,
+                            "ok": True, "unit": "v/h"})}, f)
+        trend_buf = io.StringIO()
+        with contextlib.redirect_stdout(trend_buf):
+            rc_trend = cmd_trend(argparse.Namespace(paths=[td], json=True))
+        check("trend renders",
+              rc_trend == 0 and "bench-round" in trend_buf.getvalue())
+
+    n_fail = sum(1 for _, ok in checks if not ok)
+    print(f"obsctl selfcheck: {'OK' if not n_fail else 'FAILED'} "
+          f"({len(checks) - n_fail}/{len(checks)} checks passed)")
+    return 1 if n_fail else 0
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def _add_tol_args(p):
+    p.add_argument("--tol-rel", type=float, default=1e-6,
+                   help="relative tolerance for numeric metrics "
+                        "(default 1e-6)")
+    p.add_argument("--tol", action="append", metavar="PATTERN=TOL",
+                   help="per-metric tolerance override (fnmatch pattern), "
+                        "repeatable")
+    p.add_argument("--ignore", action="append", metavar="PATTERN",
+                   help="skip metrics matching this fnmatch pattern, "
+                        "repeatable")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw report as JSON")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obsctl", description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("diff", help="diff two ledgers or two manifests")
+    p.add_argument("a", help="baseline ledger/manifest JSON")
+    p.add_argument("b", help="current ledger/manifest JSON")
+    p.add_argument("--tol-perf", type=float, default=0.5,
+                   help="fractional tolerance for wall-time/perf facts in "
+                        "manifest mode (default 0.5)")
+    _add_tol_args(p)
+    p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser("check",
+                       help="check a ledger against a baseline/golden")
+    p.add_argument("--baseline", required=True,
+                   help="baseline (golden) ledger JSON")
+    p.add_argument("current", help="ledger JSON to check")
+    _add_tol_args(p)
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("trend",
+                       help="text trend table over manifests/ledgers/"
+                            "bench rounds")
+    p.add_argument("paths", nargs="+",
+                   help="obs output directory, or JSON files "
+                        "(BENCH_r0*.json, *.manifest.json, *.ledger.json)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_trend)
+
+    p = sub.add_parser("selfcheck",
+                       help="round-trip a synthetic ledger through "
+                            "diff/check/trend")
+    p.set_defaults(fn=cmd_selfcheck)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

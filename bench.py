@@ -426,6 +426,21 @@ def main():
         # a QTF-kernel regression must be visible at the JSON level, not
         # buried in an error string (VERDICT r4 weak #5)
         qtf_ok = isinstance(qtf, dict)
+        # solver-backend + executable-cache + fixed-point facts: which
+        # kernel actually solved the impedance systems, and whether the
+        # warm-start machinery engaged (docs/performance.md)
+        from raft_tpu import _config as _cfg
+        from raft_tpu.ops import linalg as _linalg
+        from raft_tpu.parallel import exec_cache as _exec_cache
+        solver_facts = {
+            "dispatch": _linalg.last_dispatch(),
+            "pallas_mode": _cfg.pallas_mode(),
+            "exec_cache": {"enabled": _exec_cache.enabled(),
+                           **_exec_cache.stats()},
+            "fixed_point_chunks_run": int(np.asarray(out["fp_chunks"]))
+            if "fp_chunks" in out else None,
+        }
+        manifest.extra["solver"] = solver_facts
         result = {
             "metric": f"design-variants/hour/chip ({NW}-bin VolturnUS-S "
                       f"variant pipeline incl. frozen aero "
@@ -441,6 +456,7 @@ def main():
                               "surge_max_tol": ACC_SURGE_TOL, "ok": acc_ok},
             "qtf_pairgrid": qtf,
             "qtf_ok": qtf_ok,
+            "solver": solver_facts,
             "ok": acc_ok and qtf_ok,
         }
         status = "ok" if result["ok"] else "failed"

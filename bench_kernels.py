@@ -1,0 +1,168 @@
+"""Microbenchmark: the batched small-system solve kernels in isolation.
+
+bench.py times the whole variant pipeline; this harness times ONLY the
+solver backends at the real hot-path shapes — the 12x12 real-embedded
+impedance blocks (6x6 complex through the block embedding) at sweep-scale
+batches — so a kernel regression is attributable to the kernel, not the
+physics around it:
+
+- ``jnp_gj``:   ops.linalg.gauss_jordan_solve (the unrolled XLA graph)
+- ``pallas``:   ops.pallas.gj_solve.gj_solve (VMEM-resident kernel;
+                interpret mode on CPU — a correctness path, not a perf
+                number there)
+- ``lu``:       jnp.linalg.solve (LAPACK on CPU, the LU custom call on
+                accelerator backends — the pathological case on TPU)
+
+Batch sizes default to 4096 / 65536 / 262144 (the 1024-variant x 200-bin
+regime); override with RAFT_BENCH_KERNELS_B="1024,4096".  On CPU the
+default shrinks to 1024/4096 (interpret-mode Pallas at 262144 systems is
+a correctness exercise, not a timing).
+
+Prints ONE json line (same shape as bench.py: metric/value/unit/ok) and
+writes a run manifest (kind ``bench_kernels``) so ``tools/obsctl.py
+trend`` charts kernel history next to the sweep manifests.
+"""
+import json
+import os
+import time
+
+# match bench.py: f32 unless the caller opts back into x64
+os.environ.setdefault("RAFT_TPU_X64", "0")
+
+import numpy as np
+
+N = int(os.environ.get("RAFT_BENCH_KERNELS_N", 12))   # real-embedded 2n
+K = int(os.environ.get("RAFT_BENCH_KERNELS_K", 1))    # RHS columns
+REPS = int(os.environ.get("RAFT_BENCH_KERNELS_REPS", 3))
+
+
+def _batch_sizes(backend: str):
+    env = os.environ.get("RAFT_BENCH_KERNELS_B")
+    if env:
+        return [int(x) for x in env.split(",") if x.strip()]
+    if backend == "cpu":
+        return [1024, 4096]
+    return [4096, 65536, 262144]
+
+
+def _systems(rng, B):
+    """Well-conditioned random systems at the hot-path shape.  (The
+    mixed force/moment row-scale stressor lives in tests/test_linalg.py
+    — a throughput benchmark must compare kernels on systems where f32
+    parity is meaningful.)"""
+    A = rng.standard_normal((B, N, N)) + 5.0 * np.eye(N)
+    b = rng.standard_normal((B, N, K))
+    return A, b
+
+
+def _time(fn, *args):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)          # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / REPS, out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu import obs
+    from raft_tpu.ops.linalg import gauss_jordan_solve
+    from raft_tpu.ops.pallas.gj_solve import gj_solve
+
+    if obs.out_dir() is None:
+        obs.configure(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "obs_runs"))
+    backend = jax.default_backend()
+    x64 = bool(jax.config.jax_enable_x64)
+    sizes = _batch_sizes(backend)
+    manifest = obs.RunManifest.begin(kind="bench_kernels", config={
+        "N": N, "K": K, "REPS": REPS, "backend": backend, "x64": x64,
+        "batches": ",".join(map(str, sizes))})
+    obs.record_build_info()
+
+    backends = {
+        "jnp_gj": jax.jit(gauss_jordan_solve),
+        "pallas": jax.jit(gj_solve),
+        "lu": jax.jit(jnp.linalg.solve),
+    }
+    # the accuracy gate: pallas may not be LESS accurate than the jnp
+    # Gauss-Jordan it replaces, measured against the f64 LAPACK truth
+    # (solver-vs-solver elementwise parity in f32 is dominated by the
+    # f32 solve error itself, ~1e-4 on the worst element; the strict
+    # 1e-6 interpret-mode parity gate lives in tests/test_pallas_gj.py
+    # and the golden-ledger CI gate, both f64)
+    acc_margin = 2.0
+    rng = np.random.default_rng(17)
+    rows = []
+    worst_parity = 0.0
+    acc_ok = True
+    status = "failed"
+    try:
+        for B in sizes:
+            A, b = _systems(rng, B)
+            truth = np.linalg.solve(A, b)            # f64 LAPACK truth
+            Aj = jnp.asarray(A, jnp.float64 if x64 else jnp.float32)
+            bj = jnp.asarray(b, Aj.dtype)
+            ref = None
+            for name, fn in backends.items():
+                with obs.span("bench_kernel", kernel=name, batch=B):
+                    dt, out = _time(fn, Aj, bj)
+                out = np.asarray(out, np.float64)
+                err = np.max(np.abs(out - truth)
+                             / np.maximum(np.abs(truth), 1e-12))
+                row = {"kernel": name, "batch": B,
+                       "systems_per_s": round(B / dt, 1),
+                       "wall_s": round(dt, 6),
+                       "rel_dev_vs_f64_lapack": float(err)}
+                if name == "jnp_gj":
+                    ref = out
+                    err_gj = err
+                else:
+                    dev = np.max(np.abs(out - ref)
+                                 / np.maximum(np.abs(ref), 1e-12))
+                    row["rel_dev_vs_jnp_gj"] = float(dev)
+                    if name == "pallas":
+                        worst_parity = max(worst_parity, float(dev))
+                        acc_ok = acc_ok and bool(
+                            err <= acc_margin * err_gj + 1e-12)
+                rows.append(row)
+                obs.gauge(
+                    "raft_kernel_systems_per_s",
+                    "batched small-system solve throughput by kernel "
+                    "and batch size").set(row["systems_per_s"],
+                                          kernel=name, batch=str(B))
+        best = max((r["systems_per_s"] for r in rows
+                    if r["kernel"] == "pallas"), default=0.0)
+        ok = acc_ok
+        result = {
+            "metric": f"pallas {N}x{N}+{K} real-embedded GJ solve "
+                      f"throughput (backend={backend}, "
+                      f"{'f64' if x64 else 'f32'}"
+                      f"{', interpret' if backend == 'cpu' else ''}; "
+                      f"gate: pallas error vs f64 truth <= "
+                      f"{acc_margin:g}x jnp_gj error)",
+            "value": best,
+            "unit": "systems/s",
+            "rows": rows,
+            "pallas_parity_max_rel_dev": worst_parity,
+            "ok": ok,
+        }
+        status = "ok" if ok else "failed"
+        manifest.extra["result"] = {"value": best, "ok": ok}
+        manifest.extra["rows"] = rows
+    finally:
+        paths = obs.finish_run(manifest, status=status, write_trace=False)
+    result["manifest"] = paths["manifest"]
+    print(json.dumps(result))
+    if not result["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,23 @@ import jax
 if os.environ.get("RAFT_TPU_X64", "1") != "0":
     jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: warm-start processes skip the XLA
+# compile of any program they have compiled before (the executable-cache
+# layer in parallel/exec_cache.py additionally skips trace+lower via
+# jax.export).  Opt out with RAFT_TPU_JAX_CACHE=0; relocate with
+# RAFT_TPU_JAX_CACHE_DIR.  Never fatal: an unwritable cache dir must not
+# take down the solver.
+if os.environ.get("RAFT_TPU_JAX_CACHE", "1") != "0":
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("RAFT_TPU_JAX_CACHE_DIR")
+            or os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu",
+                            "jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:                                 # pragma: no cover
+        pass
+
 import jax.numpy as jnp  # noqa: E402  (after x64 flag)
 
 #: default real/complex dtypes used when building model arrays
@@ -24,3 +41,38 @@ def real_dtype():
 
 def complex_dtype():
     return jnp.complex128 if jax.config.jax_enable_x64 else jnp.complex64
+
+
+# ---------------------------------------------------------------------------
+# solve-kernel backend selection (ops/linalg.py, ops/pallas/gj_solve.py)
+# ---------------------------------------------------------------------------
+
+#: RAFT_TPU_PALLAS values: "0" never use the Pallas kernel, "1" always
+#: (interpret mode on CPU — how CI exercises the identical kernel code
+#: path without TPU hardware), "auto" (default) compiled Pallas on
+#: accelerator backends for the qualifying small-n/large-batch shapes
+#: and the pre-existing jnp paths everywhere else.
+_PALLAS_MODES = ("0", "1", "auto")
+_pallas_override: str | None = None
+
+
+def pallas_mode() -> str:
+    """Active Pallas dispatch mode ("0" | "1" | "auto").
+
+    Programmatic override (``set_pallas_mode``) beats the
+    ``RAFT_TPU_PALLAS`` environment variable; unknown values fall back
+    to "auto".  Read lazily at solve-dispatch (trace) time so tests can
+    flip it without re-importing."""
+    if _pallas_override is not None:
+        return _pallas_override
+    mode = os.environ.get("RAFT_TPU_PALLAS", "auto").strip().lower()
+    return mode if mode in _PALLAS_MODES else "auto"
+
+
+def set_pallas_mode(mode: str | None):
+    """Override the Pallas dispatch mode in-process (None clears the
+    override and returns control to ``RAFT_TPU_PALLAS``)."""
+    global _pallas_override
+    if mode is not None and str(mode) not in _PALLAS_MODES:
+        raise ValueError(f"pallas mode {mode!r} not in {_PALLAS_MODES}")
+    _pallas_override = None if mode is None else str(mode)

@@ -43,7 +43,7 @@ from raft_tpu.models.fowt import (
 from raft_tpu.models.rotor import calc_aero
 from raft_tpu.models import qtf as qt
 from raft_tpu.ops.spectra import get_psd, get_rao, get_rms
-from raft_tpu.ops.linalg import inv_complex, solve_complex
+from raft_tpu.ops.linalg import impedance_solve, inv_complex
 from raft_tpu.ops.transforms import transform_force, translate_matrix_6to6
 from raft_tpu.models.member import member_inertia
 from raft_tpu.utils.dicttools import get_from_dict
@@ -755,10 +755,12 @@ class Model:
                       + 1j * w[None, None, :] * B_tot
                       + C_lin[:, :, None]).astype(complex)
                 # batched complex 6x6 solve over all frequencies at once
-                # (real block embedding keeps this TPU-compatible)
-                Xin = solve_complex(jnp.moveaxis(Zn, -1, 0),
-                                    jnp.moveaxis(F_lin + F_drag, -1, 0))
-                Xin = jnp.moveaxis(Xin, 0, -1)   # (6, nw)
+                # (real block embedding keeps this TPU-compatible); the
+                # converged Zn itself is still carried out of the loop —
+                # the system assembly needs it — so only the solve goes
+                # through the fused dispatch (XLA CSEs the assembly)
+                Xin = impedance_solve(w, M_lin, B_tot, C_lin,
+                                      F_lin + F_drag)
                 tolCheck = jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
                 conv = jnp.all(tolCheck < tol)
                 XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xin)

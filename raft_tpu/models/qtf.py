@@ -104,21 +104,34 @@ def read_qtf_12d(path: str, rho: float = 1025.0, g: float = 9.81,
 def write_qtf_12d(path: str, qtf, w, heads_rad, rho: float = 1025.0,
                   g: float = 9.81) -> None:
     """Write the upper triangle of a (nw,nw,nh,6) QTF in .12d format
-    (reference: raft_fowt.py:1703-1725)."""
+    (reference: raft_fowt.py:1703-1725).
+
+    Row assembly is vectorized (the quadruple Python loop it replaces
+    executed O(nh*6*nw^2) interpreted iterations — minutes at the dense
+    pair grids) and the file is emitted through numpy's C formatter;
+    the ``% .8e`` / ``%d`` row format is byte-identical to the previous
+    per-value f-strings, ih-major / DOF / upper-triangle row order
+    preserved."""
     w = np.asarray(w)
     qtf = np.asarray(qtf)
+    heads = np.atleast_1d(heads_rad)
+    ULEN = 1.0
+    nh = len(heads)
+    i1, i2 = np.triu_indices(len(w))
+    F = np.moveaxis(qtf[i1, i2, :, :], 0, -1) / (rho * g * ULEN)
+    rows = np.empty((nh, 6, i1.size, 9), float)
+    rows[..., 0] = 2.0 * np.pi / w[i1]
+    rows[..., 1] = 2.0 * np.pi / w[i2]
+    rows[..., 2] = np.rad2deg(heads)[:, None, None]
+    rows[..., 3] = rows[..., 2]
+    rows[..., 4] = (np.arange(6) + 1.0)[None, :, None]
+    rows[..., 5] = np.abs(F)
+    rows[..., 6] = np.angle(F)
+    rows[..., 7] = F.real
+    rows[..., 8] = F.imag
     with open(path, "w") as f:
-        ULEN = 1.0
-        for ih in range(len(np.atleast_1d(heads_rad))):
-            hd = np.rad2deg(np.atleast_1d(heads_rad)[ih])
-            for idof in range(6):
-                for i1 in range(len(w)):
-                    for i2 in range(i1, len(w)):
-                        F = qtf[i1, i2, ih, idof] / (rho * g * ULEN)
-                        f.write(f"{2*np.pi/w[i1]: .8e} {2*np.pi/w[i2]: .8e} "
-                                f"{hd: .8e} {hd: .8e} {idof+1} "
-                                f"{np.abs(F): .8e} {np.angle(F): .8e} "
-                                f"{F.real: .8e} {F.imag: .8e}\n")
+        np.savetxt(f, rows.reshape(-1, 9),
+                   fmt="% .8e % .8e % .8e % .8e %d % .8e % .8e % .8e % .8e")
 
 
 def write_rao_4(path, w, beta_rad, Xi) -> None:

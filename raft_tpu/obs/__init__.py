@@ -42,6 +42,7 @@ from raft_tpu.obs.tracing import (                              # noqa: F401
 from raft_tpu.obs.metrics import (                              # noqa: F401
     REGISTRY, counter, gauge, histogram, snapshot, to_prometheus,
     install_jax_hooks, sample_jit_cache, record_build_info, ITER_BUCKETS,
+    record_solve_dispatch, record_exec_cache_event,
 )
 from raft_tpu.obs.manifest import (                             # noqa: F401
     SCHEMA, RunManifest, ProbeAttempt, capture_environment,

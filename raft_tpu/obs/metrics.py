@@ -261,6 +261,30 @@ def record_build_info() -> dict:
     return labels
 
 
+def record_solve_dispatch(backend: str, n, batch_elems, fused: bool = False):
+    """Count a solve-backend dispatch decision (made at trace time by
+    ``ops.linalg``): which kernel (``pallas_fused`` / ``pallas_gj`` /
+    ``jnp_gj`` / ``lu``) was chosen for a real-embedded system of size
+    ``n``.  Batch size travels as a gauge, not a label, to keep the
+    series cardinality bounded."""
+    counter("raft_solve_dispatch_total",
+            "solve-backend dispatch decisions at trace time, by backend "
+            "and real-embedded system size").inc(
+        1.0, backend=str(backend), n=str(int(n)),
+        fused=str(bool(fused)).lower())
+    gauge("raft_solve_dispatch_batch_elems",
+          "batch elements of the most recent solve dispatch per backend",
+          ).set(float(batch_elems), backend=str(backend))
+
+
+def record_exec_cache_event(event: str):
+    """Count a persistent executable-cache event (hit/miss/store/error),
+    from ``parallel.exec_cache``."""
+    counter("raft_exec_cache_events_total",
+            "persistent executable cache events (hit / miss / store / "
+            "error)").inc(1.0, event=str(event))
+
+
 # ---------------------------------------------------------------------------
 # JAX compile/retrace telemetry
 # ---------------------------------------------------------------------------

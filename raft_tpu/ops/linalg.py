@@ -19,6 +19,16 @@ lane-batched, fully unrolled Gauss-Jordan elimination with partial
 pivoting whose ops are all elementwise over the batch — ~100x faster for
 this shape regime.  It is used automatically for small n with a large
 batch; LAPACK/LU handles everything else.
+
+On top of that sits the Pallas twin (ops/pallas/gj_solve.py): the same
+algorithm as one VMEM-resident kernel (no HBM round-trip per pivot
+step), with the impedance assembly Z = -w^2 M + i w B + C fused into
+the kernel's load stage via `impedance_solve` so Z never reaches HBM.
+Dispatch is governed by the RAFT_TPU_PALLAS knob (_config.pallas_mode):
+"auto" picks it exactly where the jnp Gauss-Jordan would have been
+picked, "1" forces it everywhere (interpret mode on CPU — the CI parity
+path), "0" disables it.  Every decision is recorded for the run
+manifests via `last_dispatch()`.
 """
 from __future__ import annotations
 
@@ -96,6 +106,44 @@ def _use_gauss_jordan(n, batch_elems):
     return jax.default_backend() != "cpu"
 
 
+def _use_pallas(n, batch_elems):
+    """Whether the Pallas VMEM-resident kernel handles this (real
+    embedded) shape, per the RAFT_TPU_PALLAS mode: "1" forces it (CI
+    runs the kernel under interpret mode on CPU), "0" forbids it, and
+    "auto" uses it exactly where the jnp Gauss-Jordan would have been
+    picked (accelerator backend, small n, large batch)."""
+    from raft_tpu import _config
+
+    mode = _config.pallas_mode()
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    return _use_gauss_jordan(n, batch_elems)
+
+
+#: trace-time record of the most recent backend dispatch — the solver
+#: fact the run manifests and bench JSON report
+_LAST_DISPATCH: dict = {}
+
+
+def last_dispatch() -> dict:
+    """Most recent solve-backend dispatch decision (made at trace time):
+    ``{"backend", "n", "batch_elems", "fused"}``.  Empty before any
+    solve has been traced in this process."""
+    return dict(_LAST_DISPATCH)
+
+
+def _record_dispatch(backend: str, n, batch_elems, fused: bool = False):
+    _LAST_DISPATCH.update(backend=backend, n=int(n),
+                          batch_elems=int(batch_elems), fused=bool(fused))
+    try:
+        from raft_tpu import obs
+        obs.record_solve_dispatch(backend, n, batch_elems, fused=fused)
+    except Exception:                                 # pragma: no cover
+        pass
+
+
 def solve_complex(A, b):
     """Solve A x = b for complex A (..., n, n) and b (..., n) or (..., n, k)
     via the real block embedding (TPU-safe)."""
@@ -112,12 +160,50 @@ def solve_complex(A, b):
     ], axis=-2)
     rhs = jnp.concatenate([jnp.real(b), jnp.imag(b)], axis=-2)
     batch_elems = int(np.prod(A.shape[:-2])) if A.ndim > 2 else 1
-    if _use_gauss_jordan(2 * n, batch_elems):
+    if _use_pallas(2 * n, batch_elems):
+        from raft_tpu.ops.pallas.gj_solve import gj_solve
+        _record_dispatch("pallas_gj", 2 * n, batch_elems)
+        x = gj_solve(M, rhs)
+    elif _use_gauss_jordan(2 * n, batch_elems):
+        _record_dispatch("jnp_gj", 2 * n, batch_elems)
         x = gauss_jordan_solve(M, rhs)
     else:
+        _record_dispatch("lu", 2 * n, batch_elems)
         x = jnp.linalg.solve(M, rhs)
     out = x[..., :n, :] + 1j * x[..., n:, :]
     return out[..., 0] if vec else out
+
+
+def impedance_solve(w, M, B, C, F):
+    """Solve the frequency-domain impedance system
+
+        [-w^2 M + i w B + C] X(w) = F(w)
+
+    over the trailing frequency axis: w (nw,) real, M/B (..., n, n, nw)
+    real, C (..., n, n) real, F (..., n, nw) complex -> X (..., n, nw)
+    complex.
+
+    Dispatch: the fused Pallas kernel when enabled for the shape (the
+    assembly happens in the kernel's VMEM load stage — Z is never
+    written to HBM), otherwise the pre-existing assemble-then-
+    ``solve_complex`` path, kept bitwise identical to the inline
+    assembly the sweep/variant/model callers used to carry."""
+    w = jnp.asarray(w)
+    M = jnp.asarray(M)
+    B = jnp.asarray(B)
+    C = jnp.asarray(C)
+    F = jnp.asarray(F)
+    n = M.shape[-3]
+    nw = M.shape[-1]
+    batch = M.shape[:-3]
+    batch_elems = (int(np.prod(batch)) if batch else 1) * nw
+    if _use_pallas(2 * n, batch_elems):
+        from raft_tpu.ops.pallas.gj_solve import impedance_gj_solve
+        _record_dispatch("pallas_fused", 2 * n, batch_elems, fused=True)
+        return impedance_gj_solve(w, M, B, C, F)
+    Z = (-w ** 2 * M + 1j * w * B + C[..., None]).astype(complex)
+    Xin = solve_complex(jnp.moveaxis(Z, -1, -3), jnp.moveaxis(F, -1, -2))
+    return jnp.moveaxis(Xin, -2, -1)
 
 
 def inv_complex(A):

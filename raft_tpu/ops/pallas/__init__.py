@@ -1,0 +1,9 @@
+"""TPU Pallas kernels for the frequency-domain hot path.
+
+One kernel family so far: the fused impedance-assembly + batched
+real-embedded Gauss-Jordan solve (:mod:`raft_tpu.ops.pallas.gj_solve`)
+behind the ``RAFT_TPU_PALLAS`` dispatch knob in :mod:`raft_tpu._config`.
+Import is lazy everywhere (``from raft_tpu.ops.pallas import gj_solve``
+inside the dispatch branch) so backends without Pallas support never
+touch it.
+"""

@@ -1,0 +1,256 @@
+"""Fused impedance-assembly + batched Gauss-Jordan solve, as Pallas TPU
+kernels.
+
+The sweep/variant hot path is ~2e5 independent 6x6 complex solves per
+drag-linearization pass (1024 cases x 200 frequency bins), run through
+the real 2n x 2n block embedding of ops/linalg.py.  The jnp
+``gauss_jordan_solve`` already replaced XLA:TPU's pathological
+tiny-matrix LU custom call, but as a graph of ~50 unrolled XLA ops it
+round-trips the full (2n, 2n+k, B) augmented block through HBM on every
+pivot step, and the impedance
+
+    Z = -w^2 M + i w B + C
+
+is materialized to HBM by the caller before the solve ever sees it.
+
+The kernels here keep each (2n, 2n+k, tile_B) augmented block resident
+in VMEM across ALL pivot steps, fuse row equilibration and the
+iterative-refinement pass into the same kernel invocation, and (for
+:func:`impedance_gj_solve`) fuse the Z assembly into the kernel's load
+stage so Z never exists in HBM at all — the kernel reads the real
+M/B/C/w/F factors and writes only X.
+
+Batch layout is lane-last, exactly like ``ops.linalg._gj_core``: every
+elimination op is elementwise/broadcast over the trailing batch axis
+(the TPU lane dimension), so the VPU sees dense (sublane, lane) tiles.
+The same kernel body runs under ``interpret=True`` on CPU — that is how
+CI proves kernel parity without TPU hardware (``RAFT_TPU_PALLAS=1``).
+
+Numerical behavior matches ``ops.linalg.gauss_jordan_solve``: row
+equilibration (1/max|row|), partial pivoting, ``refine`` steps of
+residual re-solve.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+#: default lane-batch tile: 2 full 128-lane registers per op
+DEFAULT_TILE_B = 256
+
+
+def _default_interpret(interpret):
+    """Pallas interpret mode unless explicitly chosen: compiled on
+    accelerator backends, interpreted on CPU (identical kernel code)."""
+    if interpret is not None:
+        return bool(interpret)
+    return jax.default_backend() == "cpu"
+
+
+def _tile(tile_b, B):
+    tb = int(tile_b or DEFAULT_TILE_B)
+    # small batches: one 128-lane tile is plenty (and the minimum lane
+    # granularity); everything else uses the requested tile
+    return 128 if B <= 128 else tb
+
+
+# ---------------------------------------------------------------------------
+# kernel bodies (pure functions of VMEM-resident values, lane-last)
+# ---------------------------------------------------------------------------
+
+def _gj_elim(A, rhs, n, k):
+    """Unrolled Gauss-Jordan elimination with partial pivoting on
+    lane-last blocks: A (n, n, tB), rhs (n, k, tB) -> x (n, k, tB).
+
+    Same algorithm (and op order) as ``ops.linalg._gj_core``, with the
+    iotas 2-D for Mosaic.  The augmented block M stays a single VMEM
+    value across all n pivot steps."""
+    tB = A.shape[-1]
+    M = jnp.concatenate([A, rhs], axis=1)              # (n, n+k, tB)
+    rows1 = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+    rowsB = jax.lax.broadcasted_iota(jnp.int32, (n, tB), 0)
+    for kk in range(n):                                # static unroll
+        col = M[:, kk, :]                              # (n, tB)
+        mag = jnp.where(rows1 >= kk, jnp.abs(col), -jnp.inf)
+        p = jnp.argmax(mag, axis=0)                    # (tB,) pivot row
+        sel = (rowsB == p[None, :]).astype(M.dtype)    # (n, tB)
+        ek = (rows1 == kk).astype(M.dtype)             # (n, 1)
+        pivrow = jnp.sum(sel[:, None, :] * M, axis=0)  # (n+k, tB)
+        rowk = M[kk, :, :]                             # (n+k, tB)
+        # swap rows kk <-> p (no-op when p == kk)
+        M = (M + ek[:, :, None] * (pivrow - rowk)[None, :, :]
+             + sel[:, None, :] * (rowk - pivrow)[None, :, :])
+        piv = pivrow[kk, :]                            # (tB,)
+        rowk_n = pivrow / piv[None, :]
+        colk = M[:, kk, :] * (1.0 - ek)                # exclude pivot row
+        M = M - colk[:, None, :] * rowk_n[None, :, :]
+        M = M.at[kk, :, :].set(rowk_n)
+    return M[:, n:, :]                                 # (n, k, tB)
+
+
+def _matmul_bl(A, x):
+    """A @ x with the batch on the last axis: (n,n,tB),(n,k,tB)->(n,k,tB).
+    Broadcast-sum rather than dot_general — n,k are tiny (<=16) so this
+    is a pure VPU op with no layout change."""
+    return jnp.sum(A[:, :, None, :] * x[None, :, :, :], axis=1)
+
+
+def _gj_batchlast(A, rhs, n, k, refine):
+    """Equilibrate + eliminate + refine, all on VMEM-resident values."""
+    eps = 1e-300 if A.dtype == jnp.float64 else 1e-30
+    scale = 1.0 / jnp.maximum(jnp.max(jnp.abs(A), axis=1, keepdims=True),
+                              eps)
+    A = A * scale
+    rhs = rhs * scale
+    x = _gj_elim(A, rhs, n, k)
+    for _ in range(refine):
+        r = rhs - _matmul_bl(A, x)
+        x = x + _gj_elim(A, r, n, k)
+    return x
+
+
+def _gj_kernel(a_ref, b_ref, out_ref, *, n, k, refine):
+    out_ref[:] = _gj_batchlast(a_ref[:], b_ref[:], n, k, refine)
+
+
+def _impedance_kernel(w_ref, m_ref, b_ref, c_ref, fre_ref, fim_ref,
+                      out_ref, *, n, k, refine):
+    """Fused load stage: assemble the real block embedding of
+    Z = -w^2 M + i w B + C from its real factors, then solve — Z never
+    leaves VMEM."""
+    w = w_ref[0, :]                                    # (tB,)
+    reZ = c_ref[:] - (w * w)[None, None, :] * m_ref[:]
+    imZ = w[None, None, :] * b_ref[:]
+    A = jnp.concatenate([
+        jnp.concatenate([reZ, -imZ], axis=1),
+        jnp.concatenate([imZ, reZ], axis=1),
+    ], axis=0)                                         # (2n, 2n, tB)
+    rhs = jnp.concatenate([fre_ref[:], fim_ref[:]], axis=0)  # (2n, k, tB)
+    out_ref[:] = _gj_batchlast(A, rhs, 2 * n, k, refine)
+
+
+# ---------------------------------------------------------------------------
+# public wrappers
+# ---------------------------------------------------------------------------
+
+def _pad_lanes(x, pad, fill):
+    if pad == 0:
+        return x
+    tail = jnp.broadcast_to(jnp.asarray(fill, x.dtype)[..., None],
+                            x.shape[:-1] + (pad,))
+    return jnp.concatenate([x, tail], axis=-1)
+
+
+def gj_solve(A, b, refine: int = 1, tile_b: int = None, interpret=None):
+    """Pallas batched Gauss-Jordan solve of real A (..., n, n) x = b
+    (..., n, k); semantics match ``ops.linalg.gauss_jordan_solve`` (row
+    equilibration, partial pivoting, ``refine`` refinement passes).
+
+    The flattened batch is tiled over the grid; each (n, n+k, tile_b)
+    augmented block stays VMEM-resident through all pivot steps.
+    ``interpret=None`` auto-selects interpret mode on CPU."""
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    n = A.shape[-1]
+    k = b.shape[-1]
+    batch = A.shape[:-2]
+    B = int(np.prod(batch)) if batch else 1
+    Af = jnp.moveaxis(A.reshape(B, n, n), 0, -1)       # (n, n, B)
+    bf = jnp.moveaxis(b.reshape(B, n, k), 0, -1)       # (n, k, B)
+    tB = _tile(tile_b, B)
+    Bp = -(-B // tB) * tB
+    if Bp != B:
+        # identity-pad the dead lanes so the elimination stays finite
+        pad = Bp - B
+        Af = jnp.concatenate(
+            [Af, jnp.broadcast_to(jnp.eye(n, dtype=Af.dtype)[:, :, None],
+                                  (n, n, pad))], axis=-1)
+        bf = _pad_lanes(bf, pad, 0.0)
+    kern = functools.partial(_gj_kernel, n=n, k=k, refine=int(refine))
+    x = pl.pallas_call(
+        kern,
+        grid=(Bp // tB,),
+        in_specs=[pl.BlockSpec((n, n, tB), lambda i: (0, 0, i)),
+                  pl.BlockSpec((n, k, tB), lambda i: (0, 0, i))],
+        out_specs=pl.BlockSpec((n, k, tB), lambda i: (0, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((n, k, Bp), Af.dtype),
+        interpret=_default_interpret(interpret),
+    )(Af, bf)
+    return jnp.moveaxis(x[..., :B], -1, 0).reshape(*batch, n, k)
+
+
+def impedance_gj_solve(w, M, B, C, F, refine: int = 1, tile_b: int = None,
+                       interpret=None):
+    """Solve [-w^2 M + i w B + C] X = F without materializing Z.
+
+    w (nw,) real; M, B (..., n, n, nw) real; C (..., n, n) real;
+    F (..., n, nw) complex.  Returns X (..., n, nw) complex.
+
+    The (case, frequency) product is flattened to one lane batch; the
+    kernel assembles the real 2n x 2n block embedding of Z in its VMEM
+    load stage and runs the equilibrated, partially-pivoted Gauss-Jordan
+    elimination with ``refine`` refinement passes in-place."""
+    M = jnp.asarray(M)
+    B = jnp.asarray(B)
+    C = jnp.asarray(C)
+    F = jnp.asarray(F)
+    w = jnp.asarray(w, M.dtype)
+    n = M.shape[-3]
+    nw = M.shape[-1]
+    batch = M.shape[:-3]
+    nb = int(np.prod(batch)) if batch else 1
+    Bt = nb * nw
+
+    def flat_ml(x):
+        """(..., n, n, nw) -> (n, n, B) with the (batch, nw) product
+        flattened case-major / frequency-minor (the same element order
+        as moveaxis(Z, -1, -3).reshape(B, n, n) on the jnp path)."""
+        x = jnp.broadcast_to(x, batch + (n, n, nw))
+        x = jnp.moveaxis(x, -1, -3).reshape(Bt, n, n)
+        return jnp.moveaxis(x, 0, -1)
+
+    Mf = flat_ml(M)
+    Bf = flat_ml(B)
+    Cf = flat_ml(C[..., None])
+    wf = jnp.broadcast_to(w, batch + (nw,)).reshape(1, Bt)
+    Ff = jnp.moveaxis(jnp.broadcast_to(F, batch + (n, nw)),
+                      -1, -2).reshape(Bt, n, 1)
+    Ff = jnp.moveaxis(Ff, 0, -1)                       # (n, 1, B)
+    Fre = jnp.real(Ff).astype(M.dtype)
+    Fim = jnp.imag(Ff).astype(M.dtype)
+
+    tB = _tile(tile_b, Bt)
+    Bp = -(-Bt // tB) * tB
+    pad = Bp - Bt
+    if pad:
+        # dead lanes solve I x = 0: M=B=w=F=0, C=I
+        Mf = _pad_lanes(Mf, pad, 0.0)
+        Bf = _pad_lanes(Bf, pad, 0.0)
+        Cf = jnp.concatenate(
+            [Cf, jnp.broadcast_to(jnp.eye(n, dtype=Cf.dtype)[:, :, None],
+                                  (n, n, pad))], axis=-1)
+        wf = _pad_lanes(wf, pad, 0.0)
+        Fre = _pad_lanes(Fre, pad, 0.0)
+        Fim = _pad_lanes(Fim, pad, 0.0)
+
+    kern = functools.partial(_impedance_kernel, n=n, k=1,
+                             refine=int(refine))
+    spec_nn = pl.BlockSpec((n, n, tB), lambda i: (0, 0, i))
+    spec_nk = pl.BlockSpec((n, 1, tB), lambda i: (0, 0, i))
+    x = pl.pallas_call(
+        kern,
+        grid=(Bp // tB,),
+        in_specs=[pl.BlockSpec((1, tB), lambda i: (0, i)),
+                  spec_nn, spec_nn, spec_nn, spec_nk, spec_nk],
+        out_specs=pl.BlockSpec((2 * n, 1, tB), lambda i: (0, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((2 * n, 1, Bp), Mf.dtype),
+        interpret=_default_interpret(interpret),
+    )(wf, Mf, Bf, Cf, Fre, Fim)
+    x = x[..., :Bt]                                    # (2n, 1, B)
+    X = (x[:n, 0, :] + 1j * x[n:, 0, :])               # (n, B) complex
+    X = jnp.moveaxis(X, -1, 0).reshape(batch + (nw, n))
+    return jnp.moveaxis(X, -1, -2)                     # (..., n, nw)

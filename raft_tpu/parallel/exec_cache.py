@@ -1,0 +1,220 @@
+"""Persistent executable cache for the AOT-compiled sweep programs.
+
+``sweep_cases`` / ``sweep_variants`` trace, lower and compile one large
+batched program per (model, batch-shape, dtype, mesh) combination — tens
+of seconds of host work that is bitwise-identical across runs of the
+same model.  This module serializes the exported program (via
+``jax.export``) keyed by a content digest of the model pytree (computed
+with the PR-2 ledger digest machinery) plus the shape/dtype/mesh/
+environment facts, so a warm-start process skips the ``sweep_lower`` and
+``sweep_compile`` phases entirely; the XLA compile that remains inside
+the deserialized call is served by JAX's persistent compilation cache
+(enabled in ``_config.py``).
+
+Opt-in: set ``RAFT_TPU_EXEC_CACHE=1`` (cache under
+``~/.cache/raft_tpu/executables``) or point ``RAFT_TPU_EXEC_CACHE_DIR``
+at a directory; ``RAFT_TPU_EXEC_CACHE=0`` forces it off.  Every lookup/
+store outcome is counted in-process (:func:`stats`), recorded in the
+``raft_exec_cache_events_total`` Prometheus counter, and embedded in the
+entry point's run manifest (``extra["exec_cache"]``).
+
+Keys include the git SHA (+dirty flag), jax version, backend, and x64
+flag, so a code change invalidates the cache rather than serving a stale
+executable.  Failures are never fatal — any error falls back to the
+normal lower/compile path and is counted as ``error``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+import numpy as np
+
+from raft_tpu.obs.ledger import digest_metrics
+
+_LOCK = threading.Lock()
+_STATS = {"hits": 0, "misses": 0, "stores": 0, "errors": 0}
+
+
+def enabled() -> bool:
+    """Cache active?  ``RAFT_TPU_EXEC_CACHE`` 1/0 wins; default: on iff
+    ``RAFT_TPU_EXEC_CACHE_DIR`` names a directory."""
+    v = os.environ.get("RAFT_TPU_EXEC_CACHE", "auto").strip().lower()
+    if v in ("0", "off", "false"):
+        return False
+    if v in ("1", "on", "true"):
+        return True
+    return bool(os.environ.get("RAFT_TPU_EXEC_CACHE_DIR"))
+
+
+def cache_dir() -> str:
+    return (os.environ.get("RAFT_TPU_EXEC_CACHE_DIR")
+            or os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu",
+                            "executables"))
+
+
+def stats() -> dict:
+    with _LOCK:
+        return dict(_STATS)
+
+
+def reset_stats():
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _count(event: str):
+    key = event + ("es" if event.endswith("s") else "s")
+    with _LOCK:
+        _STATS[key] = _STATS.get(key, 0) + 1
+    try:
+        from raft_tpu import obs
+        obs.record_exec_cache_event(event)
+    except Exception:                                 # pragma: no cover
+        pass
+
+
+# ---------------------------------------------------------------------------
+# content digests and keys
+# ---------------------------------------------------------------------------
+
+def _flatten(obj, path, out):
+    """Recursive walk of a model object into {path: scalar|1-D array}
+    for the ledger digest machinery — arrays by value, dataclasses by
+    field, callables by qualified name (never by repr, which would embed
+    a memory address and break digest stability)."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        out[path] = "None" if obj is None else obj
+    elif callable(obj) and not hasattr(obj, "__array__"):
+        out[path] = f"callable:{getattr(obj, '__qualname__', type(obj).__name__)}"
+    elif dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            _flatten(getattr(obj, f.name), f"{path}.{f.name}", out)
+    elif isinstance(obj, dict):
+        for k in sorted(obj, key=str):
+            _flatten(obj[k], f"{path}[{k}]", out)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _flatten(v, f"{path}[{i}]", out)
+    elif hasattr(obj, "__array__"):
+        arr = np.asarray(obj)
+        out[path] = arr.ravel()
+        out[path + ".meta"] = f"{arr.shape}:{arr.dtype}"
+    else:
+        out[path] = f"{type(obj).__module__}.{type(obj).__qualname__}"
+
+
+def model_digest(obj) -> str:
+    """Content digest of a model pytree (FOWTModel, theta dict, ...):
+    ``sha256:<hex>`` over every array leaf by value — the ledger-style
+    content address that keys the executable cache."""
+    flat: dict = {}
+    _flatten(obj, "", flat)
+    return digest_metrics(flat)
+
+
+def _env_facts() -> dict:
+    import jax
+
+    import raft_tpu
+    from raft_tpu import _config
+    from raft_tpu.obs.manifest import git_dirty, git_sha
+
+    sha = git_sha() or "unknown"
+    if git_dirty():
+        sha += "+dirty"
+    return {"jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "x64": bool(jax.config.jax_enable_x64),
+            # the solve path is baked into the exported program — an
+            # executable traced under one RAFT_TPU_PALLAS mode must not
+            # be served under another
+            "pallas": _config.pallas_mode(),
+            "raft": getattr(raft_tpu, "__version__", "unknown"),
+            "git": sha}
+
+
+def make_key(**facts) -> str:
+    """Cache key: sha256 over the canonical JSON of the caller's facts
+    (model digest, nw, batch shape, dtypes, mesh shape, solver config)
+    merged with the environment facts (git SHA, jax version, backend,
+    x64) that must invalidate stale executables."""
+    payload = {"env": _env_facts(), **facts}
+    return digest_metrics({"key": json.dumps(payload, sort_keys=True,
+                                             default=str)})[7:][:32]
+
+
+# ---------------------------------------------------------------------------
+# load / store
+# ---------------------------------------------------------------------------
+
+def _paths(key: str) -> tuple[str, str]:
+    d = cache_dir()
+    return os.path.join(d, key + ".bin"), os.path.join(d, key + ".json")
+
+
+def load(key: str):
+    """Deserialize the cached executable for ``key``; None on miss or on
+    any deserialization error (counted separately)."""
+    from jax import export as jexport
+
+    bin_path, _ = _paths(key)
+    try:
+        with open(bin_path, "rb") as f:
+            data = f.read()
+    except OSError:
+        _count("miss")
+        return None
+    try:
+        exe = jexport.deserialize(bytearray(data))
+    except Exception:
+        _count("error")
+        return None
+    _count("hit")
+    return exe
+
+
+def store(fn_jitted, args, key: str, meta: dict = None) -> str | None:
+    """Export ``fn_jitted`` at ``args`` and persist it (plus a JSON meta
+    sidecar) under ``key``.  Returns the written path, or None when the
+    export/serialize/write failed (never raises).
+
+    ``jax.export.export`` re-traces/lowers the program the caller just
+    lowered for compilation; jax's internal jaxpr/lowering caches
+    absorb most of that (measured ~1.4 s store vs ~4 s first lower on
+    the coarse OC3 sweep), and it only runs on the miss path, inside
+    the caller's ``*_cache_store`` span where it stays visible."""
+    from jax import export as jexport
+
+    bin_path, meta_path = _paths(key)
+    try:
+        exported = jexport.export(fn_jitted)(*args)
+        data = bytes(exported.serialize())
+        os.makedirs(cache_dir(), exist_ok=True)
+        tmp = bin_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, bin_path)
+        doc = {"key": key, "bytes": len(data), **(meta or {})}
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+        os.replace(tmp, meta_path)
+    except Exception:
+        _count("error")
+        return None
+    _count("store")
+    return bin_path
+
+
+def load_meta(key: str) -> dict | None:
+    """The JSON meta sidecar written next to a stored executable."""
+    _, meta_path = _paths(key)
+    try:
+        with open(meta_path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None

@@ -29,11 +29,11 @@ from raft_tpu.models.fowt import (
     fowt_hydro_linearization_pre, fowt_drag_excitation,
     fowt_bem_excitation,
 )
-from raft_tpu.ops.linalg import solve_complex
+from raft_tpu.ops.linalg import impedance_solve
 from raft_tpu.ops.spectra import jonswap, get_rms
 
 
-def unrolled_fixed_point(step, Xi0, nIter, tol):
+def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2):
     """Shared drag-linearization fixed point for the hand-batched sweep
     paths: nIter fully UNROLLED passes of ``step`` with per-item
     convergence freezing (0.2/0.8 under-relaxation, the reference's
@@ -44,29 +44,50 @@ def unrolled_fixed_point(step, Xi0, nIter, tol):
     iteration of a loop primitive (~700 ms/iter at 1024 items vs ~0.5 ms
     unrolled; profiled with xprof — see parallel/variants.py).
 
-    Returns (XiLast, Xi, done, iters) like the loop carries; ``iters``
-    is the per-item count of executed (non-frozen) passes — the
-    solver-convergence series the sweep observability layer histograms."""
-    XiLast = Xi0
-    Xi = Xi0
-    done = jnp.zeros(Xi0.shape[0], bool)
-    iters = jnp.zeros(Xi0.shape[0], jnp.int32)
-    for _ in range(nIter):
-        Xin = step(XiLast)
-        conv = jnp.all(jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol) < tol,
-                       axis=(-2, -1))
-        frozen = done[:, None, None]
-        XiNext = jnp.where(frozen | conv[:, None, None], XiLast,
-                           0.2 * XiLast + 0.8 * Xin)
-        Xi = jnp.where(frozen, Xi, Xin)
-        iters = iters + jnp.where(done, 0, 1)
-        done = done | conv
-        XiLast = XiNext
-    return XiLast, Xi, done, iters
+    Adaptive scheduling: the unroll is cut into blocks of ``chunk``
+    passes, each wrapped in a ``lax.cond`` on ``all(done)`` — once every
+    item has converged the remaining chunks skip their drag+solve work
+    entirely instead of executing frozen passes and discarding the
+    result.  Exactness: a frozen pass is an identity on the whole carry
+    (Xi, done, iters all unchanged), so skipping it cannot change any
+    output; ``chunk=nIter`` (or 0) recovers the plain full unroll.
+
+    Returns (XiLast, Xi, done, iters, chunks_run); ``iters`` is the
+    per-item count of executed (non-frozen) passes — the solver-
+    convergence series the sweep observability layer histograms — and
+    ``chunks_run`` the number of chunks that actually executed (the
+    fixed-point trip count the run manifest records)."""
+    chunk = int(chunk) if chunk else nIter
+
+    def passes(count, carry):
+        XiLast, Xi, done, iters, chunks_run = carry
+        for _ in range(count):
+            Xin = step(XiLast)
+            conv = jnp.all(jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
+                           < tol, axis=(-2, -1))
+            frozen = done[:, None, None]
+            XiNext = jnp.where(frozen | conv[:, None, None], XiLast,
+                               0.2 * XiLast + 0.8 * Xin)
+            Xi = jnp.where(frozen, Xi, Xin)
+            iters = iters + jnp.where(done, 0, 1)
+            done = done | conv
+            XiLast = XiNext
+        return (XiLast, Xi, done, iters, chunks_run + 1)
+
+    carry = (Xi0, Xi0, jnp.zeros(Xi0.shape[0], bool),
+             jnp.zeros(Xi0.shape[0], jnp.int32), jnp.zeros((), jnp.int32))
+    remaining = int(nIter)
+    while remaining > 0:
+        count = min(chunk, remaining)
+        remaining -= count
+        carry = jax.lax.cond(
+            jnp.all(carry[2]), lambda c: c,
+            lambda c, _n=count: passes(_n, c), carry)
+    return carry
 
 
 def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
-                     XiStart: float = 0.1, r6=None):
+                     XiStart: float = 0.1, r6=None, fp_chunk: int = 2):
     """Pure per-case response solver (no aero; wave loading) suitable for
     jit/vmap.  Returns fn(Hs, Tp, beta_rad) -> dict(Xi (6,nw) complex,
     std (6,))."""
@@ -114,12 +135,12 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         B_drag6, Bmat = fowt_hydro_linearization_pre(
             fowt, st["pose"], st["drag_pre"], Xi)
         F_drag = fowt_drag_excitation(fowt, st["pose"], Bmat, st["u0"])
-        Z = (-w ** 2 * st["M_lin"]
-             + 1j * w * (B_drag6[..., None] + st["B_BEM"])
-             + st["C_lin"][..., None]).astype(complex)
-        Xin = solve_complex(jnp.moveaxis(Z, -1, -3),
-                            jnp.moveaxis(st["F_lin"] + F_drag, -1, -2))
-        return jnp.moveaxis(Xin, -2, -1)
+        # impedance assembly + batched RAO solve; with the Pallas kernel
+        # enabled, Z is assembled in the kernel's VMEM load stage and
+        # never materialized to HBM (ops/pallas/gj_solve.py)
+        return impedance_solve(w, st["M_lin"],
+                               B_drag6[..., None] + st["B_BEM"],
+                               st["C_lin"], st["F_lin"] + F_drag)
 
     def solve(Hs, Tp, beta):
         st = setup(Hs, Tp, beta)
@@ -147,10 +168,12 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         st = jax.vmap(setup)(Hs, Tp, beta)
         nc = Hs.shape[0]
         Xi0 = jnp.zeros((nc, 6, nw), dtype=complex) + XiStart
-        _, Xi, done, iters = unrolled_fixed_point(
-            lambda XiLast: drag_step(st, XiLast), Xi0, nIter, tol)
+        _, Xi, done, iters, chunks = unrolled_fixed_point(
+            lambda XiLast: drag_step(st, XiLast), Xi0, nIter, tol,
+            chunk=fp_chunk)
         std = get_rms(Xi, axis=-1)
-        return dict(Xi=Xi, std=std, converged=done, iters=iters)
+        return dict(Xi=Xi, std=std, converged=done, iters=iters,
+                    fp_chunks=chunks)
 
     solve.batched = solve_batched
     return solve
@@ -169,9 +192,20 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
     (``sweep_cases`` -> build/execute), the per-case iteration counts
     feed the ``raft_sweep_fixed_point_iterations`` histogram, and a
     ``RunManifest`` (kind ``sweep_cases``) is finished at the end —
-    written to ``obs.out_dir()`` when configured.
+    written to ``obs.out_dir()`` when configured.  The manifest also
+    records the solve-backend dispatch, the fixed-point chunk trip
+    count, and the executable-cache outcome.
+
+    Executable cache: when ``parallel.exec_cache`` is enabled, the
+    AOT-compiled batched program is looked up by (model content digest,
+    nw, batch shape, dtype, mesh shape) — a hit skips the
+    ``sweep_lower``/``sweep_compile`` phases entirely and runs the
+    deserialized executable; a miss compiles as usual and stores the
+    export for the next process.
     """
     from raft_tpu import obs
+    from raft_tpu.ops import linalg as _linalg
+    from raft_tpu.parallel import exec_cache
 
     ncases = int(jnp.asarray(Hs).shape[0])
     manifest = obs.RunManifest.begin(kind="sweep_cases", config={
@@ -197,20 +231,68 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                     Hs = jax.device_put(Hs, sh)
                     Tp = jax.device_put(Tp, sh)
                     beta = jax.device_put(beta, sh)
-            # AOT: lower once (static HLO cost analysis of the sweep
-            # kernel rides along for free), compile, execute — the same
-            # single trace+compile a plain jitted call would do
-            with obs.span("sweep_lower", ncases=ncases):
-                lowered = batched.lower(Hs, Tp, beta)
-                obs.device.cost_analysis(lowered, kernel="sweep_batched")
-            with obs.span("sweep_compile", ncases=ncases):
-                compiled = lowered.compile()
-            with obs.span("sweep_execute", ncases=ncases):
-                out = compiled(Hs, Tp, beta)
-                jax.block_until_ready(out["std"])
+            # persistent executable cache: a warm start skips
+            # sweep_lower + sweep_compile entirely
+            key = None
+            exe = None
+            cache_info = {"state": "disabled"}
+            if exec_cache.enabled():
+                with obs.span("sweep_cache_key", ncases=ncases):
+                    key = exec_cache.make_key(
+                        fn="sweep_cases",
+                        model=exec_cache.model_digest(fowt),
+                        nw=len(fowt.w), batch_shape=[ncases],
+                        dtype=str(Hs.dtype),
+                        mesh=(None if mesh is None
+                              else sorted(mesh.shape.items())),
+                        kw={k: v for k, v in kw.items()
+                            if isinstance(v, (int, float, str, bool))},
+                        # array-valued kwargs (r6) are baked into the
+                        # compiled program — key them by content
+                        kw_arrays=exec_cache.model_digest(
+                            {k: v for k, v in kw.items()
+                             if not isinstance(v, (int, float, str,
+                                                   bool))}))
+                exe = exec_cache.load(key)
+                cache_info = {"state": "hit" if exe is not None else "miss",
+                              "key": key}
+            out = None
+            if exe is not None:
+                try:
+                    with obs.span("sweep_execute", ncases=ncases,
+                                  cached=True):
+                        out = exe.call(Hs, Tp, beta)
+                        jax.block_until_ready(out["std"])
+                except Exception as e:
+                    cache_info = {"state": "error", "key": key,
+                                  "error": f"{type(e).__name__}: {e}"[:200]}
+                    out = None
+            if out is None:
+                # AOT: lower once (static HLO cost analysis of the sweep
+                # kernel rides along for free), compile, execute — the
+                # same single trace+compile a plain jitted call would do
+                with obs.span("sweep_lower", ncases=ncases):
+                    lowered = batched.lower(Hs, Tp, beta)
+                    obs.device.cost_analysis(lowered, kernel="sweep_batched")
+                with obs.span("sweep_compile", ncases=ncases):
+                    compiled = lowered.compile()
+                with obs.span("sweep_execute", ncases=ncases):
+                    out = compiled(Hs, Tp, beta)
+                    jax.block_until_ready(out["std"])
+                if key is not None:
+                    with obs.span("sweep_cache_store", ncases=ncases):
+                        stored = exec_cache.store(
+                            batched, (Hs, Tp, beta), key,
+                            meta={"fn": "sweep_cases", "ncases": ncases,
+                                  "nw": len(fowt.w),
+                                  "solver": _linalg.last_dispatch()})
+                    cache_info["stored"] = stored is not None
             iters = np.asarray(out["iters"])
             n_conv = int(np.asarray(out["converged"]).sum())
-            sp.set(converged=n_conv, iters_max=int(iters.max(initial=0)))
+            fp_chunks = int(np.asarray(out["fp_chunks"]))
+            sp.set(converged=n_conv, iters_max=int(iters.max(initial=0)),
+                   fp_chunks=fp_chunks,
+                   exec_cache=cache_info["state"])
             obs.histogram(
                 "raft_sweep_fixed_point_iterations",
                 "per-case drag fixed-point iterations in the batched sweep",
@@ -223,6 +305,22 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                 "raft_sweep_batch_cases",
                 "case-batch size of the most recent sweep",
                 ).set(ncases, sharded=str(mesh is not None).lower())
+            obs.gauge(
+                "raft_sweep_fixed_point_chunks",
+                "drag fixed-point chunks actually executed by the "
+                "adaptive unroll (chunked early exit)",
+                ).set(fp_chunks)
+        manifest.extra["exec_cache"] = cache_info
+        # on a warm start nothing traced in-process, so last_dispatch()
+        # is empty/stale — the meta sidecar stored next to the
+        # executable carries the backend that was baked into it
+        solver = _linalg.last_dispatch()
+        if cache_info["state"] == "hit":
+            solver = (exec_cache.load_meta(key) or {}).get("solver", solver)
+        manifest.extra["solver"] = solver
+        manifest.extra["fixed_point"] = {"chunks_run": fp_chunks,
+                                         "iters_max": int(
+                                             iters.max(initial=0))}
         obs.device.collect(manifest, scope="sweep_cases")
         ledger = obs.ledger_from_sweep(out, config=dict(manifest.config),
                                        run_id=manifest.run_id)

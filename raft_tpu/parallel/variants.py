@@ -40,7 +40,7 @@ from raft_tpu.models.fowt import (
     fowt_drag_excitation, member_node_cols,
 )
 from raft_tpu.models.member import member_inertia
-from raft_tpu.ops.linalg import solve_complex
+from raft_tpu.ops.linalg import impedance_solve
 from raft_tpu.ops.spectra import jonswap, get_rms
 
 
@@ -196,7 +196,7 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
                         F_env=None, A_turb=None, B_turb=None,
                         ballast: bool = True, nIter: int = 10,
                         tol: float = 0.01, XiStart: float = 0.1,
-                        newton_iters: int = 20):
+                        newton_iters: int = 20, fp_chunk: int = 2):
     """Build the pure per-variant function θ -> outputs.
 
     F_env: constant environmental force (aero mean thrust + current drag),
@@ -302,12 +302,10 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
         B_drag6, Bmat = fowt_hydro_linearization_pre(
             base, st["pose_eq"], st["drag_pre"], Xi)
         F_drag = fowt_drag_excitation(base, st["pose_eq"], Bmat, st["u0"])
-        Z = (-w ** 2 * st["M_lin"]
-             + 1j * w * (B_t + B_drag6[..., None])
-             + st["C_lin"][..., None]).astype(complex)
-        Xin = solve_complex(jnp.moveaxis(Z, -1, -3),
-                            jnp.moveaxis(st["F_lin"] + F_drag, -1, -2))
-        return jnp.moveaxis(Xin, -2, -1)
+        # impedance assembly + batched RAO solve; with the Pallas kernel
+        # enabled, Z never leaves VMEM (ops/pallas/gj_solve.py)
+        return impedance_solve(w, st["M_lin"], B_t + B_drag6[..., None],
+                               st["C_lin"], st["F_lin"] + F_drag)
 
     def _finish(st, Xi):
         out = {k: st[k] for k in ("mass", "displacement", "GMT", "offset",
@@ -347,9 +345,12 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
         st = jax.vmap(setup)(thetas)
         nv = st["Xeq"].shape[0]
         Xi0 = jnp.zeros((nv, 6, nw), dtype=complex) + XiStart
-        _, Xi, _, _ = unrolled_fixed_point(
-            lambda XiLast: drag_step(st, XiLast), Xi0, nIter + 1, tol)
-        return _finish(st, Xi)
+        _, Xi, _, _, chunks = unrolled_fixed_point(
+            lambda XiLast: drag_step(st, XiLast), Xi0, nIter + 1, tol,
+            chunk=fp_chunk)
+        out = _finish(st, Xi)
+        out["fp_chunks"] = chunks
+        return out
 
     solve.batched = solve_batched
     # introspection hooks (precision budgeting, tests)
@@ -363,8 +364,14 @@ def sweep_variants(base: FOWTModel, thetas: dict, mesh: Optional[Mesh] = None,
                    axis_name: str = "designs", **kw):
     """vmap the per-variant pipeline over a θ batch, sharding the variant
     axis over ``mesh`` (the reference's serial parametersweep loop
-    collapsed onto the device mesh)."""
+    collapsed onto the device mesh).
+
+    When ``parallel.exec_cache`` is enabled, the AOT-compiled variant
+    program is cached persistently (keyed by base-model + θ-shape
+    digest); a warm start skips ``variants_lower``/``variants_compile``.
+    """
     from raft_tpu import obs
+    from raft_tpu.parallel import exec_cache
 
     solver = make_variant_solver(base, **kw)
     batched = jax.jit(solver.batched)
@@ -382,25 +389,72 @@ def sweep_variants(base: FOWTModel, thetas: dict, mesh: Optional[Mesh] = None,
                         [x, jnp.repeat(x[-1:], npad, axis=0)]), thetas)
             sh = NamedSharding(mesh, P(axis_name))
             thetas = jax.tree.map(lambda x: jax.device_put(x, sh), thetas)
-        # AOT lower/compile: the same single trace+compile a jitted call
-        # would do, with the static HLO cost analysis (FLOPs / bytes
-        # estimates for the variant kernel) riding along for free
-        with obs.span("variants_lower", nv=nv):
-            lowered = batched.lower(thetas)
-            cost = obs.device.cost_analysis(lowered,
-                                            kernel="variant_batched")
-            if cost:
-                sp.set(hlo_flops=cost.get("flops"))
-        with obs.span("variants_compile", nv=nv):
-            compiled = lowered.compile()
-        with obs.span("variants_execute", nv=nv):
-            out = compiled(thetas)
-            jax.block_until_ready(out["std"])
+        key = None
+        exe = None
+        if exec_cache.enabled():
+            with obs.span("variants_cache_key", nv=nv):
+                key = exec_cache.make_key(
+                    fn="sweep_variants",
+                    model=exec_cache.model_digest(base),
+                    # theta values may be ragged LISTS of arrays
+                    # (l_fill/rho_fill) — describe every leaf
+                    theta_shapes={k: str([(jnp.shape(x), str(x.dtype))
+                                          for x in jax.tree.leaves(v)])
+                                  for k, v in sorted(thetas.items())},
+                    mesh=(None if mesh is None
+                          else sorted(mesh.shape.items())),
+                    kw={k: v for k, v in kw.items()
+                        if isinstance(v, (int, float, str, bool))},
+                    # array-valued kwargs (F_env, A_turb, B_turb) are
+                    # baked into the compiled program as constants —
+                    # they must key the cache too
+                    kw_arrays=exec_cache.model_digest(
+                        {k: v for k, v in kw.items()
+                         if not isinstance(v, (int, float, str, bool))}))
+            exe = exec_cache.load(key)
+            sp.set(exec_cache="hit" if exe is not None else "miss")
+        out = None
+        if exe is not None:
+            try:
+                with obs.span("variants_execute", nv=nv, cached=True):
+                    out = exe.call(thetas)
+                    jax.block_until_ready(out["std"])
+            except Exception:
+                # a deserialized-but-unrunnable executable is a cache
+                # ERROR, not a hit — count it and fall through to the
+                # normal compile path (same stance as sweep_cases)
+                exec_cache._count("error")
+                sp.set(exec_cache="error")
+                out = None
+        if out is None:
+            # AOT lower/compile: the same single trace+compile a jitted
+            # call would do, with the static HLO cost analysis (FLOPs /
+            # bytes estimates for the variant kernel) riding along free
+            with obs.span("variants_lower", nv=nv):
+                lowered = batched.lower(thetas)
+                cost = obs.device.cost_analysis(lowered,
+                                                kernel="variant_batched")
+                if cost:
+                    sp.set(hlo_flops=cost.get("flops"))
+            with obs.span("variants_compile", nv=nv):
+                compiled = lowered.compile()
+            with obs.span("variants_execute", nv=nv):
+                out = compiled(thetas)
+                jax.block_until_ready(out["std"])
+            if key is not None:
+                with obs.span("variants_cache_store", nv=nv):
+                    exec_cache.store(batched, (thetas,), key,
+                                     meta={"fn": "sweep_variants", "nv": nv})
         obs.gauge(
             "raft_variant_batch_size",
             "variant-batch size of the most recent sweep_variants call",
             ).set(nv, sharded=str(mesh is not None).lower())
-    return jax.tree.map(lambda x: x[:nv], out)
+    out = dict(out)
+    fp_chunks = out.pop("fp_chunks", None)
+    out = jax.tree.map(lambda x: x[:nv], out)
+    if fp_chunks is not None:
+        out["fp_chunks"] = fp_chunks
+    return out
 
 
 # --------------------------------------------------------------------------

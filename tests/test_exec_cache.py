@@ -1,0 +1,167 @@
+"""Persistent executable cache: digests, keys, and the warm-start path.
+
+Acceptance: a warm-start ``sweep_cases`` on a cached executable skips
+the ``sweep_lower`` and ``sweep_compile`` phases entirely (asserted via
+the existing spans) and reproduces the cold-run outputs exactly.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import obs
+from raft_tpu.parallel import exec_cache
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::DeprecationWarning")
+
+
+@pytest.fixture(scope="module")
+def fowt():
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.models.fowt import build_fowt
+
+    design = load_design("OC3spar")
+    w = np.arange(0.05, 0.25, 0.05) * 2 * np.pi     # 4 coarse bins
+    return build_fowt(design, w, depth=float(design["site"]["water_depth"]))
+
+
+# ---------------------------------------------------------------------------
+# digests and keys
+# ---------------------------------------------------------------------------
+
+def test_enabled_knob(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_EXEC_CACHE", raising=False)
+    monkeypatch.delenv("RAFT_TPU_EXEC_CACHE_DIR", raising=False)
+    assert exec_cache.enabled() is False             # off by default
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", "/tmp/x")
+    assert exec_cache.enabled() is True
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", "0")   # explicit off wins
+    assert exec_cache.enabled() is False
+    monkeypatch.delenv("RAFT_TPU_EXEC_CACHE_DIR")
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", "1")
+    assert exec_cache.enabled() is True
+
+
+def test_model_digest_stable_and_content_sensitive(fowt):
+    import dataclasses
+
+    d1 = exec_cache.model_digest(fowt)
+    d2 = exec_cache.model_digest(fowt)
+    assert d1 == d2 and d1.startswith("sha256:")
+    # a geometry change must change the digest
+    m0 = fowt.members[0]
+    changed = dataclasses.replace(
+        fowt, members=[dataclasses.replace(m0, d=np.asarray(m0.d) * 1.01)]
+        + list(fowt.members[1:]))
+    assert exec_cache.model_digest(changed) != d1
+
+
+def test_model_digest_ignores_identity_of_callables():
+    """Callables digest by qualified name, not repr (which embeds a
+    memory address and would break digest stability across processes)."""
+    d1 = exec_cache.model_digest({"f": test_enabled_knob, "x": 1.0})
+    d2 = exec_cache.model_digest({"f": test_enabled_knob, "x": 1.0})
+    assert d1 == d2
+
+
+def test_make_key_sensitivity():
+    k1 = exec_cache.make_key(fn="sweep_cases", model="sha256:aa", nw=10)
+    assert k1 == exec_cache.make_key(fn="sweep_cases", model="sha256:aa",
+                                     nw=10)
+    assert k1 != exec_cache.make_key(fn="sweep_cases", model="sha256:aa",
+                                     nw=20)
+    assert k1 != exec_cache.make_key(fn="sweep_cases", model="sha256:bb",
+                                     nw=10)
+
+
+def test_store_load_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    fn = jax.jit(lambda a: {"y": a * 2.0, "s": jnp.sum(a)})
+    x = jnp.arange(8.0)
+    key = exec_cache.make_key(fn="toy", shape=str(x.shape))
+    assert exec_cache.load(key) is None              # cold
+    assert exec_cache.store(fn, (x,), key, meta={"fn": "toy"}) is not None
+    exe = exec_cache.load(key)
+    assert exe is not None
+    out = exe.call(x)
+    np.testing.assert_array_equal(np.asarray(out["y"]), np.arange(8.0) * 2)
+    meta = exec_cache.load_meta(key)
+    assert meta["fn"] == "toy" and meta["bytes"] > 0
+    st = exec_cache.stats()
+    assert st["misses"] == 1 and st["stores"] == 1 and st["hits"] == 1
+
+
+def test_corrupt_cache_entry_is_an_error_not_a_crash(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    key = exec_cache.make_key(fn="corrupt")
+    with open(os.path.join(str(tmp_path), key + ".bin"), "wb") as f:
+        f.write(b"not an executable")
+    assert exec_cache.load(key) is None
+    assert exec_cache.stats()["errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# acceptance: warm-start sweep skips lower+compile
+# ---------------------------------------------------------------------------
+
+def test_sweep_cases_warm_start_skips_lower_and_compile(
+        fowt, tmp_path, monkeypatch):
+    from raft_tpu.parallel.sweep import sweep_cases
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    Hs = np.array([3.0, 6.0, 9.0])
+    Tp = np.array([8.0, 10.0, 12.0])
+    beta = np.zeros(3)
+
+    out1 = sweep_cases(fowt, Hs, Tp, beta, nIter=3)
+    agg1 = obs.aggregate()
+    assert agg1["sweep_lower"][1] == 1
+    assert agg1["sweep_compile"][1] == 1
+    assert agg1["sweep_cache_store"][1] == 1
+    st = exec_cache.stats()
+    assert st["misses"] == 1 and st["stores"] == 1
+
+    obs.reset_all()
+    out2 = sweep_cases(fowt, Hs, Tp, beta, nIter=3)
+    agg2 = obs.aggregate()
+    assert "sweep_lower" not in agg2                 # the acceptance bar
+    assert "sweep_compile" not in agg2
+    assert agg2["sweep_execute"][1] == 1
+    assert exec_cache.stats()["hits"] == 1
+
+    # the cached executable runs the same program: outputs identical
+    np.testing.assert_array_equal(np.asarray(out1["Xi"]),
+                                  np.asarray(out2["Xi"]))
+    np.testing.assert_array_equal(np.asarray(out1["iters"]),
+                                  np.asarray(out2["iters"]))
+    assert int(np.asarray(out1["fp_chunks"])) == \
+        int(np.asarray(out2["fp_chunks"]))
+
+    # and the run manifest records the cache outcome
+    # (manifest itself finished inside sweep_cases; exec-cache facts are
+    # counted in the registry snapshot metrics too)
+    snap = obs.snapshot()
+    events = {tuple(s["labels"].items()): s["value"]
+              for s in snap["raft_exec_cache_events_total"]["series"]}
+    assert events[(("event", "hit"),)] == 1
+
+
+def test_sweep_cases_different_batch_is_a_miss(fowt, tmp_path, monkeypatch):
+    """The key covers the batch shape: a different ncases must not reuse
+    the cached executable."""
+    from raft_tpu.parallel.sweep import sweep_cases
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    sweep_cases(fowt, [6.0, 7.0], [10.0, 11.0], [0.0, 0.0], nIter=2)
+    assert exec_cache.stats()["misses"] == 1
+    sweep_cases(fowt, [6.0], [10.0], [0.0], nIter=2)
+    assert exec_cache.stats()["misses"] == 2
+    assert exec_cache.stats()["hits"] == 0

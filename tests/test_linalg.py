@@ -69,6 +69,59 @@ def test_solve_complex_roundtrip(rng):
                     rtol=1e-8, atol=1e-8)
 
 
+def test_solve_complex_multi_rhs_and_rank_split(rng):
+    """Edge paths: k>1 matrix RHS, and the vec/matrix rank split — a
+    (..., n) vector RHS must equal its (..., n, 1) matrix twin."""
+    n, k, B = 6, 4, 50
+    A = (rng.standard_normal((B, n, n)) + 1j * rng.standard_normal((B, n, n))
+         + 4.0 * np.eye(n))
+    bmat = rng.standard_normal((B, n, k)) + 1j * rng.standard_normal((B, n, k))
+    x = np.asarray(solve_complex(jnp.asarray(A), jnp.asarray(bmat)))
+    assert x.shape == (B, n, k)
+    assert_allclose(np.einsum("bij,bjk->bik", A, x), bmat,
+                    rtol=1e-8, atol=1e-10)
+    bvec = bmat[..., 0]
+    xv = np.asarray(solve_complex(jnp.asarray(A), jnp.asarray(bvec)))
+    assert xv.shape == (B, n)
+    # LAPACK's blocked multi-RHS solve may differ from the k=1 solve in
+    # the last bits — parity, not bit-identity, is the contract here
+    assert_allclose(xv, x[..., 0], rtol=1e-12, atol=1e-14)
+
+
+def test_solve_complex_unbatched(rng):
+    """No leading batch at all (batch_elems == 1 dispatch path)."""
+    n = 6
+    A = (rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+         + 4.0 * np.eye(n))
+    b = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    x = np.asarray(solve_complex(jnp.asarray(A), jnp.asarray(b)))
+    assert x.shape == (n,)
+    assert_allclose(A @ x, b, rtol=1e-8, atol=1e-10)
+
+
+def test_impedance_solve_fallback_is_bitwise_assembly(rng, monkeypatch):
+    """On the default CPU path impedance_solve must be BITWISE the old
+    inline assembly + solve_complex (the golden ledgers depend on it)."""
+    from raft_tpu.ops.linalg import impedance_solve
+
+    # the CI parity job exports RAFT_TPU_PALLAS=1; this test is about
+    # the default (auto) fallback path
+    monkeypatch.delenv("RAFT_TPU_PALLAS", raising=False)
+
+    nc, n, nw = 3, 6, 8
+    w = np.linspace(0.2, 1.4, nw)
+    M = rng.standard_normal((nc, n, n, nw)) + 5.0 * np.eye(n)[None, :, :, None]
+    B = 0.1 * rng.standard_normal((nc, n, n, nw))
+    C = rng.standard_normal((nc, n, n)) + 10.0 * np.eye(n)
+    F = rng.standard_normal((nc, n, nw)) + 1j * rng.standard_normal((nc, n, nw))
+    Z = (-w ** 2 * M + 1j * w * B + C[..., None]).astype(complex)
+    Xref = np.moveaxis(np.asarray(solve_complex(
+        jnp.moveaxis(jnp.asarray(Z), -1, -3),
+        jnp.moveaxis(jnp.asarray(F), -1, -2))), -2, -1)
+    X = np.asarray(impedance_solve(w, M, B, C, F))
+    assert np.array_equal(X, Xref)
+
+
 def test_solve_complex_gj_dispatch_path(rng, monkeypatch):
     """Force the Gauss-Jordan dispatch inside solve_complex (on CPU the
     backend gate would pick LAPACK) so the integrated embedding + GJ shape

@@ -1,0 +1,275 @@
+"""Pallas solve-kernel parity and the adaptive fixed-point schedule.
+
+The kernels in ops/pallas/gj_solve.py run here under interpret mode
+(conftest forces the CPU backend) — the IDENTICAL kernel code path a TPU
+would compile, which is how CI proves parity without hardware.  The
+acceptance bar is <= 1e-6 max relative deviation against the jnp
+Gauss-Jordan path; in f64 the two agree to ~1e-12 because they are the
+same algorithm in the same op order.
+"""
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import _config
+from raft_tpu.ops import linalg as L
+from raft_tpu.ops.pallas.gj_solve import gj_solve, impedance_gj_solve
+
+PARITY = 1e-6     # the acceptance tolerance; f64 actuals are ~1e-12
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def forced_pallas():
+    """RAFT_TPU_PALLAS=1 for the duration of a test, then restored."""
+    _config.set_pallas_mode("1")
+    yield
+    _config.set_pallas_mode(None)
+
+
+def _rel(a, b):
+    return np.max(np.abs(a - b) / np.maximum(np.abs(b), 1e-12))
+
+
+# ---------------------------------------------------------------------------
+# kernel parity vs the jnp Gauss-Jordan and LAPACK
+# ---------------------------------------------------------------------------
+
+def test_gj_solve_matches_jnp_gj_multi_rhs(rng):
+    n, k, B = 12, 3, 300
+    A = rng.standard_normal((B, n, n)) + 5.0 * np.eye(n)
+    b = rng.standard_normal((B, n, k))
+    x_pl = np.asarray(gj_solve(jnp.asarray(A), jnp.asarray(b)))
+    x_gj = np.asarray(L.gauss_jordan_solve(jnp.asarray(A), jnp.asarray(b)))
+    assert _rel(x_pl, x_gj) < PARITY
+    assert _rel(x_pl, np.linalg.solve(A, b)) < PARITY
+
+
+def test_gj_solve_needs_pivoting():
+    A = np.array([[0.0, 2.0, 1.0],
+                  [1.0, 0.0, 3.0],
+                  [2.0, 1.0, 0.0]])
+    b = np.array([[1.0], [2.0], [3.0]])
+    x = np.asarray(gj_solve(jnp.asarray(A[None]), jnp.asarray(b[None])))[0]
+    assert_allclose(x, np.linalg.solve(A, b), rtol=1e-10)
+
+
+def test_gj_solve_lane_padding_paths(rng):
+    """Batch sizes off the 128-lane tile exercise the identity padding;
+    dead lanes must not contaminate live ones."""
+    n = 8
+    for B in (1, 7, 127, 129, 130):
+        A = rng.standard_normal((B, n, n)) + 4.0 * np.eye(n)
+        b = rng.standard_normal((B, n, 1))
+        x = np.asarray(gj_solve(jnp.asarray(A), jnp.asarray(b)))
+        assert _rel(x, np.linalg.solve(A, b)) < PARITY, B
+
+
+def test_gj_solve_mixed_row_scales(rng):
+    """The impedance blocks mix ~1e7 force rows and ~1e12 moment rows;
+    the in-kernel equilibration + refinement must hold parity there."""
+    n, B = 12, 200
+    A = (0.1 * rng.standard_normal((B, n, n)) + np.eye(n)) \
+        * 10.0 ** rng.uniform(3, 10, (B, n, 1))
+    b = rng.standard_normal((B, n, 1)) * 1e6
+    x = np.asarray(gj_solve(jnp.asarray(A), jnp.asarray(b)))
+    assert _rel(x, np.linalg.solve(A, b)) < PARITY
+
+
+def test_fused_impedance_matches_assembled_reference(rng):
+    """The fused kernel (Z assembled in the VMEM load stage) against the
+    materialize-Z-then-solve_complex path, batched over cases."""
+    nc, n, nw = 4, 6, 9
+    w = np.linspace(0.2, 1.5, nw)
+    M = rng.standard_normal((nc, n, n, nw)) + 5.0 * np.eye(n)[None, :, :, None]
+    B = 0.1 * rng.standard_normal((nc, n, n, nw))
+    C = rng.standard_normal((nc, n, n)) + 10.0 * np.eye(n)
+    F = rng.standard_normal((nc, n, nw)) + 1j * rng.standard_normal((nc, n, nw))
+
+    Z = (-w ** 2 * M + 1j * w * B + C[..., None]).astype(complex)
+    Xref = np.moveaxis(np.asarray(L.solve_complex(
+        jnp.moveaxis(jnp.asarray(Z), -1, -3),
+        jnp.moveaxis(jnp.asarray(F), -1, -2))), -2, -1)
+    Xfused = np.asarray(impedance_gj_solve(w, M, B, C, F))
+    assert _rel(Xfused, Xref) < PARITY
+
+
+def test_fused_impedance_unbatched_rank(rng):
+    """Rank-polymorphism: the model path calls with no case batch —
+    (n, n, nw) factors and a (n, nw) force."""
+    n, nw = 6, 11
+    w = np.linspace(0.1, 2.0, nw)
+    M = rng.standard_normal((n, n, nw)) + 5.0 * np.eye(n)[:, :, None]
+    B = 0.1 * rng.standard_normal((n, n, nw))
+    C = rng.standard_normal((n, n)) + 10.0 * np.eye(n)
+    F = rng.standard_normal((n, nw)) + 1j * rng.standard_normal((n, nw))
+    Z = (-w ** 2 * M + 1j * w * B + C[..., None]).astype(complex)
+    Xref = np.moveaxis(np.asarray(L.solve_complex(
+        jnp.moveaxis(jnp.asarray(Z), -1, -3),
+        jnp.moveaxis(jnp.asarray(F), -1, -2))), -2, -1)
+    Xfused = np.asarray(impedance_gj_solve(w, M, B, C, F))
+    assert Xfused.shape == (n, nw)
+    assert _rel(Xfused, Xref) < PARITY
+
+
+def test_impedance_solve_dispatch_parity(rng, forced_pallas):
+    """impedance_solve under RAFT_TPU_PALLAS=1 (interpret mode on this
+    CPU backend) must agree with the mode-0 jnp path to <= 1e-6, and the
+    dispatch record must name the fused kernel."""
+    nc, n, nw = 3, 6, 7
+    w = np.linspace(0.3, 1.2, nw)
+    M = rng.standard_normal((nc, n, n, nw)) + 5.0 * np.eye(n)[None, :, :, None]
+    B = 0.1 * rng.standard_normal((nc, n, n, nw))
+    C = rng.standard_normal((nc, n, n)) + 10.0 * np.eye(n)
+    F = rng.standard_normal((nc, n, nw)) + 1j * rng.standard_normal((nc, n, nw))
+    Xp = np.asarray(L.impedance_solve(w, M, B, C, F))
+    assert L.last_dispatch()["backend"] == "pallas_fused"
+    _config.set_pallas_mode("0")
+    Xj = np.asarray(L.impedance_solve(w, M, B, C, F))
+    assert L.last_dispatch()["backend"] in ("lu", "jnp_gj")
+    assert _rel(Xp, Xj) < PARITY
+
+
+def test_solve_complex_forced_pallas_vec_and_matrix(rng, forced_pallas):
+    """The vec/matrix rank split of solve_complex through the Pallas
+    backend: a (..., n) vector RHS and its (..., n, 1) matrix twin must
+    produce the same solution."""
+    n, B = 6, 40
+    A = (rng.standard_normal((B, n, n)) + 1j * rng.standard_normal((B, n, n))
+         + 4.0 * np.eye(n))
+    b = rng.standard_normal((B, n)) + 1j * rng.standard_normal((B, n))
+    xv = np.asarray(L.solve_complex(jnp.asarray(A), jnp.asarray(b)))
+    assert L.last_dispatch()["backend"] == "pallas_gj"
+    xm = np.asarray(L.solve_complex(jnp.asarray(A), jnp.asarray(b[..., None])))
+    assert xv.shape == (B, n) and xm.shape == (B, n, 1)
+    assert_allclose(xv, xm[..., 0], rtol=0, atol=0)   # identical path
+    assert _rel(np.einsum("bij,bj->bi", A, xv), b) < 1e-8
+
+
+def test_inv_complex_forced_pallas(rng, forced_pallas):
+    """inv_complex is the k=n multi-RHS path (the model's factor-once
+    Zinv); residual against the identity through the Pallas kernel."""
+    n, B = 6, 20
+    A = (rng.standard_normal((B, n, n)) + 1j * rng.standard_normal((B, n, n))
+         + 4.0 * np.eye(n))
+    Ainv = np.asarray(L.inv_complex(jnp.asarray(A)))
+    eye = np.broadcast_to(np.eye(n), (B, n, n))
+    assert np.max(np.abs(np.einsum("bij,bjk->bik", A, Ainv) - eye)) < 1e-8
+
+
+def test_gj_solve_under_jit(rng):
+    n, B = 12, 150
+    A = rng.standard_normal((B, n, n)) + 5.0 * np.eye(n)
+    b = rng.standard_normal((B, n, 2))
+    x = np.asarray(jax.jit(gj_solve)(jnp.asarray(A), jnp.asarray(b)))
+    assert _rel(x, np.linalg.solve(A, b)) < PARITY
+
+
+# ---------------------------------------------------------------------------
+# backend dispatch table
+# ---------------------------------------------------------------------------
+
+def test_dispatch_table(monkeypatch):
+    """The (backend, n, batch) -> kernel table behind solve_complex."""
+    # the CI parity job exports RAFT_TPU_PALLAS=1 — this test probes the
+    # auto table, so neutralize the env first
+    monkeypatch.delenv("RAFT_TPU_PALLAS", raising=False)
+    # CPU backend: auto never picks an accelerator kernel
+    assert L._use_gauss_jordan(12, 100000) is False
+    assert L._use_pallas(12, 100000) is False
+    # accelerator backend
+    monkeypatch.setattr(L.jax, "default_backend", lambda: "tpu")
+    assert L._use_gauss_jordan(12, 4096) is True
+    assert L._use_pallas(12, 4096) is True
+    assert L._use_gauss_jordan(12, 4095) is False      # batch floor
+    assert L._use_pallas(12, 4095) is False
+    assert L._use_gauss_jordan(18, 10 ** 6) is False   # size ceiling
+    assert L._use_pallas(18, 10 ** 6) is False
+    # explicit modes override the table on any backend
+    _config.set_pallas_mode("1")
+    try:
+        assert L._use_pallas(18, 1) is True
+        _config.set_pallas_mode("0")
+        assert L._use_pallas(12, 10 ** 6) is False
+    finally:
+        _config.set_pallas_mode(None)
+
+
+def test_pallas_mode_env(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_PALLAS", raising=False)
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+    assert _config.pallas_mode() == "1"
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "bogus")
+    assert _config.pallas_mode() == "auto"
+    monkeypatch.delenv("RAFT_TPU_PALLAS")
+    assert _config.pallas_mode() == "auto"
+
+
+# ---------------------------------------------------------------------------
+# adaptive fixed-point scheduling
+# ---------------------------------------------------------------------------
+
+def _mixed_step(rng, nb, nw, slow=0.9):
+    """Per-item linear contraction toward distinct fixed points with
+    mixed rates — items converge at different iterations."""
+    rates = np.linspace(0.05, slow, nb)
+    target = (rng.standard_normal((nb, 6, nw))
+              + 1j * rng.standard_normal((nb, 6, nw)))
+
+    def step(X):
+        return (jnp.asarray(target)
+                + jnp.asarray(rates)[:, None, None] * (X - jnp.asarray(target)))
+    return step
+
+
+def test_chunked_fixed_point_is_exact(rng):
+    """Acceptance: chunked early-exit returns identical Xi / iters /
+    converged to the full unroll on a mixed-convergence batch."""
+    from raft_tpu.parallel.sweep import unrolled_fixed_point
+
+    nb, nw, nIter = 8, 5, 12
+    step = _mixed_step(rng, nb, nw)
+    Xi0 = jnp.zeros((nb, 6, nw), complex) + 0.1
+    full = unrolled_fixed_point(step, Xi0, nIter, 0.01, chunk=nIter)
+    for chunk in (1, 2, 3, 5):
+        got = unrolled_fixed_point(step, Xi0, nIter, 0.01, chunk=chunk)
+        assert np.array_equal(np.asarray(full[1]), np.asarray(got[1])), chunk
+        assert np.array_equal(np.asarray(full[2]), np.asarray(got[2]))
+        assert np.array_equal(np.asarray(full[3]), np.asarray(got[3]))
+
+
+def test_chunked_fixed_point_early_exit(rng):
+    """A fast-converging batch must actually skip trailing chunks."""
+    from raft_tpu.parallel.sweep import unrolled_fixed_point
+
+    nb, nw, nIter = 6, 4, 10
+    step = _mixed_step(rng, nb, nw, slow=0.2)   # everything converges fast
+    Xi0 = jnp.zeros((nb, 6, nw), complex) + 0.1
+    _, _, done, iters, chunks = unrolled_fixed_point(step, Xi0, nIter,
+                                                     0.01, chunk=2)
+    assert bool(np.all(np.asarray(done)))
+    max_iters = int(np.asarray(iters).max())
+    assert int(chunks) == -(-max_iters // 2)            # ceil(iters/2)
+    assert int(chunks) < -(-nIter // 2)                 # skipped some
+
+
+def test_chunked_fixed_point_under_jit(rng):
+    from raft_tpu.parallel.sweep import unrolled_fixed_point
+
+    nb, nw, nIter = 4, 3, 6
+    step = _mixed_step(rng, nb, nw, slow=0.3)
+    Xi0 = jnp.zeros((nb, 6, nw), complex) + 0.1
+
+    fn = jax.jit(lambda x: unrolled_fixed_point(step, x, nIter, 0.01,
+                                                chunk=2))
+    ref = unrolled_fixed_point(step, Xi0, nIter, 0.01, chunk=2)
+    got = fn(Xi0)
+    assert_allclose(np.asarray(got[1]), np.asarray(ref[1]), rtol=1e-12)
+    assert np.array_equal(np.asarray(got[3]), np.asarray(ref[3]))

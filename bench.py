@@ -421,6 +421,9 @@ def main():
         with obs.span("bench_qtf_metric", nw2=NW2):
             qtf = _qtf_metric()
 
+        with obs.span("bench_analyze_cases"):
+            ac = _analyze_cases_metric()
+
         dev = jax.devices()[0]
         acc_ok = _acc_ok(acc)
         # a QTF-kernel regression must be visible at the JSON level, not
@@ -456,6 +459,7 @@ def main():
                               "surge_max_tol": ACC_SURGE_TOL, "ok": acc_ok},
             "qtf_pairgrid": qtf,
             "qtf_ok": qtf_ok,
+            "analyze_cases": ac,
             "solver": solver_facts,
             "ok": acc_ok and qtf_ok,
         }
@@ -463,6 +467,11 @@ def main():
         manifest.extra["result"] = {
             "value": result["value"], "vs_baseline": result["vs_baseline"],
             "ok": result["ok"]}
+        if isinstance(ac, dict):
+            # per-case wall time of the flagship analyzeCases path —
+            # a perf-class manifest fact (obsctl trend / self-compare)
+            manifest.extra["result"]["analyze_cases_s_per_case"] = \
+                ac["s_per_case"]
         result["self_compare"] = _self_compare(obs, manifest, status)
     finally:
         paths = obs.finish_run(manifest, status=status)
@@ -526,6 +535,44 @@ def _qtf_metric():
                 "nw2": nw2, "wall_s": round(dt, 4)}
     except Exception as e:                            # pragma: no cover
         return f"qtf metric failed: {type(e).__name__}: {e}"
+
+
+def _analyze_cases_metric():
+    """Wall time per case through the flagship device-resident
+    ``Model.analyzeCases`` path (coarse OC3 golden config, one case,
+    cold start) — the ``analyze_cases_s_per_case`` fact ``obsctl trend``
+    tracks across rounds.  Runs in an f64 CPU subprocess: the case
+    pipeline's accuracy contract is f64, and the in-process bench may
+    be f32/TPU.  Returns a dict for the bench JSON, or an error
+    string."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "ac.npz")
+        try:
+            d = _run_cpu_subprocess([
+                "import time",
+                "from raft_tpu.io.designs import load_design",
+                "from raft_tpu.model import Model",
+                "design = load_design('OC3spar')",
+                "design.setdefault('settings', {})",
+                "design['settings'].update(min_freq=0.02, max_freq=0.2)",
+                "design['cases']['data'] = design['cases']['data'][:1]",
+                "m = Model(design)",
+                "t0 = time.perf_counter()",
+                "m.analyzeCases()",
+                "dt = time.perf_counter() - t0",
+                "x = (m.last_manifest.extra or {}).get("
+                "'host_transfers', {}).get('total', {})",
+                f"np.savez({out!r}, dt=dt, "
+                "events=x.get('events', -1), bytes=x.get('bytes', -1))",
+            ], out, x64=True)
+        except RuntimeError as e:
+            return f"analyze_cases metric failed: {e}"
+        return {"s_per_case": round(float(d["dt"]), 3), "n_cases": 1,
+                "design": "OC3spar",
+                "host_transfer_events": int(d["events"]),
+                "host_transfer_bytes": int(d["bytes"])}
 
 
 def _accuracy_gate(thetas, batched):

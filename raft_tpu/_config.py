@@ -76,3 +76,67 @@ def set_pallas_mode(mode: str | None):
     if mode is not None and str(mode) not in _PALLAS_MODES:
         raise ValueError(f"pallas mode {mode!r} not in {_PALLAS_MODES}")
     _pallas_override = None if mode is None else str(mode)
+
+
+# ---------------------------------------------------------------------------
+# solver-health telemetry placement (model.py dynamics/statics hot path)
+# ---------------------------------------------------------------------------
+
+#: RAFT_TPU_TELEMETRY values: "fast" (default) computes the dynamics
+#: solve residual and the impedance condition estimate ON DEVICE inside
+#: the batched solve program (jnp SVD / einsum, a handful of scalar
+#: pulls per case); "full" restores the host-side telemetry — the whole
+#: (nw, 6N, 6N) impedance stack is pulled to host and run through
+#: ``np.linalg.cond`` / ``np.einsum`` (opt-in: it parks a large
+#: device→host transfer plus a host SVD on the critical path).
+_TELEMETRY_MODES = ("fast", "full")
+_telemetry_override: str | None = None
+
+
+def telemetry_mode() -> str:
+    """Active telemetry placement ("fast" | "full"); programmatic
+    override beats the ``RAFT_TPU_TELEMETRY`` environment variable,
+    unknown values fall back to "fast"."""
+    if _telemetry_override is not None:
+        return _telemetry_override
+    mode = os.environ.get("RAFT_TPU_TELEMETRY", "fast").strip().lower()
+    return mode if mode in _TELEMETRY_MODES else "fast"
+
+
+def set_telemetry_mode(mode: str | None):
+    """Override the telemetry placement in-process (None clears)."""
+    global _telemetry_override
+    if mode is not None and str(mode) not in _TELEMETRY_MODES:
+        raise ValueError(
+            f"telemetry mode {mode!r} not in {_TELEMETRY_MODES}")
+    _telemetry_override = None if mode is None else str(mode)
+
+
+# ---------------------------------------------------------------------------
+# statics Newton backend (model.py:_solve_statics_impl)
+# ---------------------------------------------------------------------------
+
+#: RAFT_TPU_STATICS values: "device" (default) runs the damped-Newton
+#: equilibrium as one jitted ``lax.while_loop`` with the 5-alpha line
+#: search evaluated in a single vmapped call and exactly one host sync
+#: at convergence; "host" restores the Python-driven loop (one
+#: device→host pull per Newton iteration plus a serial line search) —
+#: kept as the parity reference for tests and as an escape hatch.
+_STATICS_MODES = ("device", "host")
+_statics_override: str | None = None
+
+
+def statics_mode() -> str:
+    """Active statics Newton backend ("device" | "host")."""
+    if _statics_override is not None:
+        return _statics_override
+    mode = os.environ.get("RAFT_TPU_STATICS", "device").strip().lower()
+    return mode if mode in _STATICS_MODES else "device"
+
+
+def set_statics_mode(mode: str | None):
+    """Override the statics backend in-process (None clears)."""
+    global _statics_override
+    if mode is not None and str(mode) not in _STATICS_MODES:
+        raise ValueError(f"statics mode {mode!r} not in {_STATICS_MODES}")
+    _statics_override = None if mode is None else str(mode)

@@ -177,12 +177,20 @@ def solve_bem_fowt(fowt, headings=None, dz=None, da=None, w_bem=None,
             # including key-scheme upgrades, which invalidate every older
             # cache; say so instead of silently re-solving everything
             # (warn, not print: stdout stays machine-parseable for the
-            # bench's one-JSON-line contract)
-            import warnings
-            warnings.warn(
-                f"raft_tpu bem: cache key changed in '{mesh_dir}' "
-                "(geometry, BEM grid, or solver/key version) — "
-                "re-solving and refreshing the cache")
+            # bench's one-JSON-line contract).  Only when the STORED key
+            # actually differs: a matching key with the coefficient
+            # files themselves missing (partial cache wipe) is a plain
+            # re-solve, not a key change
+            try:
+                stored_key = open(key_path).read().strip()
+            except OSError:
+                stored_key = None
+            if stored_key != key:
+                import warnings
+                warnings.warn(
+                    f"raft_tpu bem: cache key changed in '{mesh_dir}' "
+                    "(geometry, BEM grid, or solver/key version) — "
+                    "re-solving and refreshing the cache")
 
     if w_bem is None:
         # BEM grid: ``dw_bem`` (the reference's min_freq_BEM step,

@@ -7,11 +7,19 @@ paths are pure-jnp:
 - `solveStatics` (reference :479-849): damped-Newton equilibrium on the
   6N-DOF pose with the linearized-hydrostatics + constant-forcing scheme
   (statics_mod=0 / forcing_mod=0, the reference's hard-coded modes), with
-  mooring reactions/stiffness from the differentiable catenary.
+  mooring reactions/stiffness from the differentiable catenary.  The
+  Newton itself is a device-resident `lax.while_loop` (all line-search
+  alphas in one vmapped evaluation, ONE host sync at convergence);
+  `RAFT_TPU_STATICS=host` keeps the Python-loop reference backend.
 - `solveDynamics` (reference :852-1146): the drag-linearization fixed point
   as a `lax.while_loop` whose inner step solves ALL frequencies in one
   batched complex 6x6 `jnp.linalg.solve` (the reference's per-frequency
-  loop at raft_model.py:942-947 collapsed).
+  loop at raft_model.py:942-947 collapsed), then ONE heading-batched
+  system solve over the `(nWaves, 6N, nw)` excitation stack — the
+  reference's per-heading loop at raft_model.py:1042-1083 collapsed,
+  with solver telemetry computed on device (`RAFT_TPU_TELEMETRY`).
+  Host pulls happen only at sanctioned counted exit points
+  (`obs.transfers`; see docs/performance.md for the per-case budget).
 - `solveEigen` (reference :391-476) with the same DOF-claiming mode sort.
 - `analyzeCases`/`saveTurbineOutputs` (reference :244-388 and
   raft_fowt.py:1821-2109): statistics of each response channel.
@@ -58,9 +66,56 @@ _LOG = get_logger("model")
 @jax.jit
 def _apply_zinv_j(Zinv, F_wave):
     """Batched system RAO solve: apply the factored inverse impedance to
-    one heading's excitation, (nw,6N,6N) x (6N,nw) -> (6N,nw)."""
+    one heading's excitation, (nw,6N,6N) x (6N,nw) -> (6N,nw).  Kept as
+    the single-heading reference kernel (parity tests); the case
+    pipeline itself runs the heading-batched ``_dyn_solve_batched``."""
     Xi_h = jnp.einsum("wij,wj->wi", Zinv, jnp.moveaxis(F_wave, -1, 0))
     return jnp.moveaxis(Xi_h, 0, -1)
+
+
+def _dyn_solve_core(Zinv, Z_sys, F_all):
+    """Heading-batched system RAO solve + solve-health residual, one
+    device program: apply the factored inverse impedance to EVERY
+    heading's excitation at once ((nw,6N,6N) x (nH,6N,nw) -> (nH,6N,nw))
+    and compute the per-heading relative residual |Z Xi - F|/|F| of the
+    factor-once Zinv reuse on device — two scalars per heading cross the
+    host boundary instead of the full response stack."""
+    Xi = jnp.einsum("wij,hjw->hiw", Zinv, F_all)
+    R = jnp.einsum("wij,hjw->hiw", Z_sys, Xi) - F_all
+    num = jnp.sqrt(jnp.sum(jnp.abs(R) ** 2, axis=(1, 2)))
+    den = jnp.sqrt(jnp.sum(jnp.abs(F_all) ** 2, axis=(1, 2)))
+    return Xi, num / (den + 1e-300)
+
+
+def _cond_core(Z_sys):
+    """Device-side conditioning telemetry of the impedance stack:
+    (all-finite flag, max cond, median cond over frequencies).  A
+    non-finite stack short-circuits to an identity so the SVD cannot
+    blow up — the caller skips recording when the flag is False and the
+    solve path downstream raises its clearer non-finite diagnostic."""
+    finite = jnp.all(jnp.isfinite(Z_sys.real) & jnp.isfinite(Z_sys.imag))
+    eye = jnp.eye(Z_sys.shape[-1], dtype=Z_sys.dtype)
+    safe = jnp.where(finite, Z_sys, eye)
+    c = jnp.linalg.cond(safe)
+    return finite, jnp.max(c), jnp.median(c)
+
+
+#: lazily-built jitted instances (donation is decided by the active
+#: backend, which must not be queried at import time)
+_DYN_JITS: dict = {}
+
+
+def _dyn_solve_jit():
+    if "solve" not in _DYN_JITS:
+        donate = (2,) if jax.default_backend() != "cpu" else ()
+        _DYN_JITS["solve"] = jax.jit(_dyn_solve_core, donate_argnums=donate)
+    return _DYN_JITS["solve"]
+
+
+def _cond_jit():
+    if "cond" not in _DYN_JITS:
+        _DYN_JITS["cond"] = jax.jit(_cond_core)
+    return _DYN_JITS["cond"]
 
 
 class Model:
@@ -257,13 +312,15 @@ class Model:
             state["moor_current"] = None
         state["F_env_constant"] = F_env
 
-    def _statics_eval_fn(self):
-        """Jitted (net force, tangent stiffness, free points) evaluation,
-        built ONCE per Model and reused across Newton iterations, cases,
-        and the potSecOrder statics re-solves — the per-case constants
-        (F0, K_hs) are traced arguments, not baked-in constants."""
-        if getattr(self, "_eval_FK_j", None) is not None:
-            return self._eval_FK_j
+    def _statics_eval_raw(self):
+        """Un-jitted (net force, tangent stiffness, free points)
+        evaluation closure, built ONCE per Model — the shared body of
+        both the jitted per-call evaluator (host Newton) and the
+        device-resident ``lax.while_loop`` Newton (which vmaps it over
+        the line-search alphas).  The per-case constants (F0, K_hs) are
+        traced arguments, not baked-in constants."""
+        if getattr(self, "_eval_FK_raw", None) is not None:
+            return self._eval_FK_raw
         N = self.nFOWT
         refs = np.concatenate([
             [f.x_ref, f.y_ref, 0, 0, 0, 0] for f in self.fowtList])
@@ -306,8 +363,84 @@ class Model:
                 Km = Km + ma.coupled_stiffness(arr, Xb, xf)
             return Fv, Km, xf
 
-        self._eval_FK_j = jax.jit(eval_FK)
+        self._eval_FK_raw = eval_FK
+        return self._eval_FK_raw
+
+    def _statics_eval_fn(self):
+        """Jitted per-call wrapper of :meth:`_statics_eval_raw` (the
+        host-loop Newton and the band-forensics replay call it once per
+        evaluation)."""
+        if getattr(self, "_eval_FK_j", None) is None:
+            self._eval_FK_j = jax.jit(self._statics_eval_raw())
         return self._eval_FK_j
+
+    #: line-search candidates of the damped Newton (both backends)
+    _NEWTON_ALPHAS = (1.0, 0.5, 0.25, 0.125, 0.0625)
+    _NEWTON_MAX_ITERS = 50
+
+    def _statics_newton_fn(self):
+        """Device-resident damped Newton: one jitted ``lax.while_loop``
+        whose body evaluates ALL line-search alphas in a single vmapped
+        ``eval_FK`` call, merit-selects and clips on device, and carries
+        X/F/K/xf device-resident across iterations — the host syncs
+        exactly once, at convergence (the sanctioned
+        ``obs.transfers.device_get`` in ``_solve_statics_impl``).
+
+        Algorithmically identical to the host loop in
+        ``_statics_newton_host`` (same candidate order, same
+        first-sufficient-wins selection, same full-step fallback, same
+        |dX| < tol convergence test), so iteration counts and accepted
+        poses match bit-for-bit-ish — the golden-ledger gate holds the
+        rewrite to 1e-6 including the integer ``statics_iters``.
+
+        Built once per Model; traced once and reused across cases (the
+        per-case constants are arguments).  Input buffers are donated on
+        accelerator backends so the pose/free-point carries reuse device
+        memory (CPU has no donation — donating there only warns)."""
+        if getattr(self, "_newton_j", None) is not None:
+            return self._newton_j
+        eval_FK = self._statics_eval_raw()
+        alphas = jnp.asarray(np.array(self._NEWTON_ALPHAS))
+        max_iters = self._NEWTON_MAX_ITERS
+
+        def newton(X0, xf0, F0s, K_hss, Ucur, db, tol):
+            F0, K0, xf1 = eval_FK(X0, xf0, F0s, K_hss, Ucur)
+
+            def body(carry):
+                X, F, K, xf, it, done = carry
+                # guard zero-stiffness diagonals like the reference
+                # (raft_model.py:713-715)
+                kdiag = jnp.diagonal(K)
+                kfix = jnp.where(kdiag == 0.0, jnp.mean(kdiag), kdiag)
+                Kg = K + jnp.diag(kfix - kdiag)
+                dX = jnp.clip(jnp.linalg.solve(Kg, F), -db, db)
+                merit0 = jnp.sum(F ** 2)
+                Fa, Ka, xfa = jax.vmap(
+                    lambda a: eval_FK(X + a * dX, xf, F0s, K_hss, Ucur)
+                )(alphas)
+                merits = jnp.sum(Fa ** 2, axis=1)
+                # first sufficient candidate wins (argmax of a boolean
+                # vector is the first True); no candidate improving the
+                # residual -> full clipped step, i.e. candidate 0 (a=1)
+                suff = jnp.isfinite(merits) & (merits < merit0)
+                idx = jnp.where(jnp.any(suff), jnp.argmax(suff), 0)
+                X = X + jnp.where(jnp.any(suff), alphas[idx], 1.0) * dX
+                # convergence on the UNDAMPED Newton step (see the host
+                # loop): checked on this iteration's dX, applied next
+                conv = jnp.all(jnp.abs(dX) < tol)
+                return (X, Fa[idx], Ka[idx], xfa[idx], it + 1, conv)
+
+            def cond(carry):
+                return (carry[4] < max_iters) & (~carry[5])
+
+            X, F, _K, xf, it, _ = jax.lax.while_loop(
+                cond, body,
+                (X0, F0, K0, xf1, jnp.zeros((), jnp.int32), False))
+            return X, xf, it, jnp.sqrt(jnp.sum(F ** 2))
+
+        donate = (0, 1) if jax.default_backend() != "cpu" else ()
+        self._newton_j = jax.jit(newton, donate_argnums=donate)
+        return self._newton_j
 
     def _case_label(self) -> str:
         """Metrics label for the current case ("unloaded" outside the
@@ -342,8 +475,6 @@ class Model:
         if arr is not None and xf is None:
             xf = arr.r0[arr.attach == -2]
 
-        eval_FK_j = self._statics_eval_fn()
-
         F0s = jnp.asarray(np.stack(F0))
         K_hss = jnp.asarray(np.stack(K_hs))
         db = np.tile(np.array([30, 30, 5, 0.1, 0.1, 0.1]), N)
@@ -355,61 +486,40 @@ class Model:
         # 6N DOFs with the array free points re-solved per evaluation.
         # The reference's plain clip-step loop can oscillate on
         # pathological designs (raft_model.py:677-767 band-aids).
-        alphas = np.array([1.0, 0.5, 0.25, 0.125, 0.0625])
         Ucur = jnp.asarray(np.stack([
             st.get("moor_current") if st.get("moor_current") is not None
             else np.zeros(3) for st in self._state]))
-        Fj, Kj, xf_arg = eval_FK_j(jnp.asarray(X), xf_arg, F0s, K_hss, Ucur)
-        for it in range(50):
-            F, K = np.asarray(Fj), np.asarray(Kj).copy()
-            # guard zero-stiffness diagonals like the reference (:713-715)
-            kmean = np.mean(np.diag(K))
-            for i in range(6 * N):
-                if K[i, i] == 0:
-                    K[i, i] = kmean
-            dX = np.linalg.solve(K, F)
-            dX = np.clip(dX, -db, db)
-            merit0 = float(np.sum(F**2))
-            best = None
-            full_step = None
-            for a in alphas:
-                Fa, Ka, xfa = eval_FK_j(jnp.asarray(X + a * dX), xf_arg,
-                                        F0s, K_hss, Ucur)
-                if a == 1.0:
-                    full_step = (Fa, Ka, xfa)
-                merit_a = float(np.sum(np.asarray(Fa)**2))
-                if np.isfinite(merit_a) and (best is None
-                                             or merit_a < best[0]):
-                    best = (merit_a, a, Fa, Ka, xfa)
-                if merit_a < merit0:     # first sufficient candidate wins
-                    break
-            if best is not None and best[0] < merit0:
-                _, a, Fj, Kj, xf_arg = best
-                X = X + a * dX
-            else:
-                # no candidate improves the residual: take the full
-                # clipped step once (reference behavior), reusing the
-                # a=1.0 candidate's evaluation
-                X = X + dX
-                Fj, Kj, xf_arg = full_step
-            # convergence on the UNDAMPED Newton step (the reference's
-            # |dX| < tol criterion) — a heavily damped accepted step can
-            # be small while the residual is still far from equilibrium
-            if np.all(np.abs(dX) < tol):
-                break
-        residual = float(np.sqrt(np.sum(np.asarray(Fj) ** 2)))
+        from raft_tpu import _config
+        if _config.statics_mode() == "host":
+            X, xf_arg, n_iters, residual = self._statics_newton_host(
+                X, xf_arg, F0s, K_hss, Ucur, db, tol)
+        else:
+            # device-resident lax.while_loop Newton: exactly ONE host
+            # sync per statics solve, through the sanctioned counted
+            # exit point
+            newton = self._statics_newton_fn()
+            Xd, xfd, itd, resd = newton(jnp.asarray(X), xf_arg, F0s,
+                                        K_hss, Ucur, jnp.asarray(db),
+                                        jnp.asarray(tol))
+            X, xf_np, n_iters, residual = obs.transfers.device_get(
+                (Xd, xfd, itd, resd), what="statics_newton",
+                phase="statics")
+            X = np.asarray(X, float)
+            xf_arg = jnp.asarray(xf_np)
+            n_iters = int(n_iters)
+            residual = float(residual)
         case_lbl = self._case_label()
-        sp.set(newton_iters=it + 1, residual_norm=residual)
+        sp.set(newton_iters=n_iters, residual_norm=residual)
         obs.histogram(
             "raft_statics_newton_iterations",
             "damped-Newton iterations to mean-offset equilibrium",
-            buckets=obs.ITER_BUCKETS).observe(it + 1, case=case_lbl)
+            buckets=obs.ITER_BUCKETS).observe(n_iters, case=case_lbl)
         obs.gauge(
             "raft_statics_residual_norm",
             "|F| at the accepted statics equilibrium [N]",
             ).set(residual, case=case_lbl)
         rec = self._case_records.setdefault(case_lbl, {})
-        rec["statics_iters"] = it + 1
+        rec["statics_iters"] = n_iters
         rec["statics_residual"] = residual
 
         # mooring properties at the FINAL pose (one more free-point solve
@@ -462,6 +572,55 @@ class Model:
             self.results.setdefault("mean_offsets", []).append(X.copy())
         _LOG.info("Found mean offsets: %s", X - refs)
         return X
+
+    def _statics_newton_host(self, X, xf_arg, F0s, K_hss, Ucur, db, tol):
+        """Host-driven damped Newton (the ``RAFT_TPU_STATICS=host``
+        escape hatch and the parity reference for the device
+        ``lax.while_loop`` backend): a Python loop with one device→host
+        sync and a SERIAL 5-alpha line search per iteration.  Returns
+        ``(X, xf_arg, n_iters, residual)``."""
+        eval_FK_j = self._statics_eval_fn()
+        alphas = np.array(self._NEWTON_ALPHAS)
+        Fj, Kj, xf_arg = eval_FK_j(jnp.asarray(X), xf_arg, F0s, K_hss, Ucur)
+        for it in range(self._NEWTON_MAX_ITERS):
+            F, K = np.asarray(Fj), np.asarray(Kj).copy()
+            # guard zero-stiffness diagonals like the reference (:713-715)
+            kmean = np.mean(np.diag(K))
+            for i in range(len(F)):
+                if K[i, i] == 0:
+                    K[i, i] = kmean
+            dX = np.linalg.solve(K, F)
+            dX = np.clip(dX, -db, db)
+            merit0 = float(np.sum(F**2))
+            best = None
+            full_step = None
+            for a in alphas:
+                Fa, Ka, xfa = eval_FK_j(jnp.asarray(X + a * dX), xf_arg,
+                                        F0s, K_hss, Ucur)
+                if a == 1.0:
+                    full_step = (Fa, Ka, xfa)
+                merit_a = float(np.sum(np.asarray(Fa)**2))
+                if np.isfinite(merit_a) and (best is None
+                                             or merit_a < best[0]):
+                    best = (merit_a, a, Fa, Ka, xfa)
+                if merit_a < merit0:     # first sufficient candidate wins
+                    break
+            if best is not None and best[0] < merit0:
+                _, a, Fj, Kj, xf_arg = best
+                X = X + a * dX
+            else:
+                # no candidate improves the residual: take the full
+                # clipped step once (reference behavior), reusing the
+                # a=1.0 candidate's evaluation
+                X = X + dX
+                Fj, Kj, xf_arg = full_step
+            # convergence on the UNDAMPED Newton step (the reference's
+            # |dX| < tol criterion) — a heavily damped accepted step can
+            # be small while the residual is still far from equilibrium
+            if np.all(np.abs(dX) < tol):
+                break
+        residual = float(np.sqrt(np.sum(np.asarray(Fj) ** 2)))
+        return X, xf_arg, it + 1, residual
 
     # ------------------------------------------------------------------
     # eigen
@@ -538,12 +697,12 @@ class Model:
                 obs.span("solveDynamics", case=self._case_label()) as sp:
             return self._solve_dynamics_impl(case, tol, display, sp)
 
-    def _record_dyn_residual(self, ih, Z_sys, Xi_h, F_wave):
-        """Relative residual of the block system solve for one heading —
-        ||Z Xi - F|| / ||F|| over all frequencies (a health check on the
-        factor-once Zinv reuse)."""
-        R = np.einsum("wij,jw->iw", Z_sys, Xi_h) - F_wave
-        rel = float(np.linalg.norm(R) / (np.linalg.norm(F_wave) + 1e-300))
+    def _record_dyn_residual(self, ih, rel):
+        """Record one heading's system-solve relative residual
+        ||Z Xi - F|| / ||F||, computed on device by ``_dyn_solve_core``
+        (in both telemetry modes) — a health check on the factor-once
+        Zinv reuse."""
+        rel = float(rel)
         obs.gauge(
             "raft_dynamics_solve_residual",
             "relative residual |Z Xi - F|/|F| of the system RAO solve",
@@ -553,6 +712,7 @@ class Model:
         return rel
 
     def _solve_dynamics_impl(self, case, tol, display, sp):
+        from raft_tpu import _config
         N = self.nFOWT
         nw = self.nw
         for i in range(N):
@@ -561,83 +721,125 @@ class Model:
                 self._fowt_linearize(i, self._case_for_fowt(case, i),
                                      tol=tol, display=display)
 
-        # ----- system assembly (reference: raft_model.py:1021-1031) -----
-        Z_sys = np.zeros((nw, 6 * N, 6 * N), dtype=complex)
-        for i in range(N):
-            s = slice(6 * i, 6 * i + 6)
-            Z_sys[:, s, s] = np.moveaxis(self._state[i]["Z"], -1, 0)
+        # ----- system assembly — ON DEVICE (reference :1021-1031); the
+        # converged per-FOWT impedances never leave the device between
+        # the drag fixed point and the factored solve -----
+        if N == 1:
+            Z_sys = jnp.moveaxis(jnp.asarray(self._state[0]["Z"]), -1, 0)
+        else:
+            Z_sys = jnp.zeros((nw, 6 * N, 6 * N), dtype=complex)
+            for i in range(N):
+                s = slice(6 * i, 6 * i + 6)
+                Z_sys = Z_sys.at[:, s, s].set(
+                    jnp.moveaxis(jnp.asarray(self._state[i]["Z"]), -1, 0))
         if self._K_array is not None:
-            Z_sys = Z_sys + self._K_array[None, :, :]
+            Z_sys = Z_sys + jnp.asarray(self._K_array)[None, :, :]
         # factor once, reuse across headings and 2nd-order re-solves
         # (the reference's Zinv, raft_model.py:1038-1040)
-        Zinv = jnp.asarray(inv_complex(jnp.asarray(Z_sys)))
+        Zinv = inv_complex(Z_sys)
 
         # solver-health telemetry: conditioning of the complex system
         # across the frequency axis (a resonance-adjacent near-singular
         # impedance shows up here long before the response goes bad).
-        # NaN/Inf in Z_sys would make np.linalg.cond raise inside SVD —
-        # telemetry must not preempt the clearer non-finite diagnostic
-        # the solve path raises downstream
-        if np.all(np.isfinite(Z_sys)):
-            cond = np.linalg.cond(Z_sys)
-            sp.set(cond_max=float(cond.max()),
-                   cond_median=float(np.median(cond)))
+        # Default ("fast"): the SVD runs ON DEVICE and three scalars
+        # cross to host; RAFT_TPU_TELEMETRY=full restores the host
+        # np.linalg.cond over the pulled stack (a counted, sanctioned
+        # transfer).  A non-finite stack records nothing — telemetry
+        # must not preempt the clearer non-finite diagnostic the solve
+        # path raises downstream
+        if _config.telemetry_mode() == "full":
+            Z_host = obs.transfers.device_get(
+                Z_sys, what="impedance_stack", phase="dynamics")
+            finite = bool(np.all(np.isfinite(Z_host)))
+            if finite:
+                cond = np.linalg.cond(Z_host)
+                cond_max = float(cond.max())
+                cond_med = float(np.median(cond))
+        else:
+            finite, cond_max, cond_med = obs.transfers.device_get(
+                _cond_jit()(Z_sys), what="cond_estimate", phase="dynamics")
+            finite = bool(finite)
+        if finite:
+            cond_max, cond_med = float(cond_max), float(cond_med)
+            sp.set(cond_max=cond_max, cond_median=cond_med)
             obs.gauge(
                 "raft_dynamics_condition_number",
                 "max condition number of the 6Nx6N impedance over "
-                "frequencies").set(float(cond.max()),
-                                   case=self._case_label())
+                "frequencies").set(cond_max, case=self._case_label())
             self._case_records.setdefault(self._case_label(), {})[
-                "cond_max"] = float(cond.max())
+                "cond_max"] = cond_max
 
         nWaves = self._state[0]["seastate"]["nWaves"]
-        Xi_sys = np.zeros((nWaves + 1, 6 * N, nw), dtype=complex)
 
-        def system_solve(F_wave):
-            F = jnp.asarray(F_wave)
-            if not self._dyn_cost_recorded:
-                # static HLO cost analysis of the batched dynamics
-                # solve (a trace, not an XLA compile) — once per
-                # analyzeCases run, folded into the metrics registry
-                # and thence the run manifest
-                self._dyn_cost_recorded = True
-                obs.device.cost_analysis(_apply_zinv_j, Zinv, F,
-                                         kernel="dynamics_system_solve")
-            return np.asarray(_apply_zinv_j(Zinv, F))
-
-        for ih in range(nWaves):
-            F_wave = np.zeros((6 * N, nw), dtype=complex)
-            for i, fowt in enumerate(self.fowtList):
-                s = slice(6 * i, 6 * i + 6)
-                st = self._state[i]
-                exc = st["excitation"]
-                F_drag_h = np.asarray(fowt_drag_excitation(
-                    fowt, st["pose_eq"], st["Bmat"], exc["u"][ih]))
-                st["F_drag"][ih] = F_drag_h
-                if fowt.potSecOrder == 2 and ih > 0:
-                    qd = fowt.qtf_data
+        # ----- heading-batched excitation assembly (device) -----
+        # linearized drag excitation for ALL headings in one batched
+        # call per FOWT (fowt_drag_excitation is rank-polymorphic over
+        # the leading heading axis); the potSecOrder==2 second-order
+        # forces stay host-side QTF math, exactly as before
+        for i, fowt in enumerate(self.fowtList):
+            st = self._state[i]
+            st["F_drag"] = fowt_drag_excitation(
+                fowt, st["pose_eq"], st["Bmat"],
+                st["excitation"]["u"][:nWaves])
+            if fowt.potSecOrder == 2:
+                qd = fowt.qtf_data
+                for ih in range(1, nWaves):
                     st["Fhydro_2nd_mean"][ih], f2h = (np.asarray(a) for a in
                         qt.hydro_force_2nd(qd.qtf, qd.heads_rad, qd.w,
                                            st["seastate"]["beta"][ih],
                                            st["seastate"]["S"][ih], self.w))
                     st["Fhydro_2nd"][ih] = f2h
-                F_wave[s] = (np.asarray(st["F_BEM"][ih])
-                             + np.asarray(exc["F_hydro_iner"][ih])
-                             + F_drag_h + st["Fhydro_2nd"][ih])
-            Xi_sys[ih] = system_solve(F_wave)
-            self._record_dyn_residual(ih, Z_sys, Xi_sys[ih], F_wave)
 
-            # internal-QTF secondary headings: QTF from that heading's
-            # first-order RAOs, then a system re-solve with the 2nd-order
-            # forces included (reference: raft_model.py:1066-1083)
-            if ih > 0 and any(f.potSecOrder == 1 for f in self.fowtList):
+        def assemble_F():
+            """(nWaves, 6N, nw) excitation stack, device-resident."""
+            if N == 1:
+                st = self._state[0]
+                return (jnp.asarray(st["F_BEM"])[:nWaves]
+                        + jnp.asarray(st["excitation"]["F_hydro_iner"])[:nWaves]
+                        + st["F_drag"]
+                        + jnp.asarray(st["Fhydro_2nd"])).astype(complex)
+            F_all = jnp.zeros((nWaves, 6 * N, nw), dtype=complex)
+            for i in range(N):
+                st = self._state[i]
+                s = slice(6 * i, 6 * i + 6)
+                F_all = F_all.at[:, s, :].set(
+                    jnp.asarray(st["F_BEM"])[:nWaves]
+                    + jnp.asarray(st["excitation"]["F_hydro_iner"])[:nWaves]
+                    + st["F_drag"]
+                    + jnp.asarray(st["Fhydro_2nd"]))
+            return F_all
+
+        F_all = assemble_F()
+        if not self._dyn_cost_recorded:
+            # static HLO cost analysis of the heading-batched dynamics
+            # solve (a trace, not an XLA compile) — once per
+            # analyzeCases run, folded into the metrics registry and
+            # thence the run manifest
+            self._dyn_cost_recorded = True
+            obs.device.cost_analysis(_dyn_solve_jit(), Zinv, Z_sys, F_all,
+                                     kernel="dynamics_system_solve")
+        # ONE batched solve over every heading; the per-heading solve
+        # residuals come back as nWaves scalars in the same pull
+        Xi_d, rel_d = _dyn_solve_jit()(Zinv, Z_sys, F_all)
+        rel = obs.transfers.device_get(rel_d, what="solve_residual",
+                                       phase="dynamics")
+        rel2 = None
+
+        # internal-QTF secondary headings: QTF from each heading's
+        # first-order RAOs, then ONE batched re-solve with the 2nd-order
+        # forces included (reference: raft_model.py:1066-1083) — the
+        # factored Zinv is reused on device, never re-pulled to host
+        if nWaves > 1 and any(f.potSecOrder == 1 for f in self.fowtList):
+            Xi_first = obs.transfers.device_get(
+                Xi_d, what="first_order_rao", phase="dynamics")
+            for ih in range(1, nWaves):
                 for i, fowt in enumerate(self.fowtList):
                     if fowt.potSecOrder != 1:
                         continue
                     s = slice(6 * i, 6 * i + 6)
                     st = self._state[i]
                     RAO_h = np.asarray(get_rao(
-                        Xi_sys[ih, s, :], st["seastate"]["zeta"][ih]))
+                        Xi_first[ih, s, :], st["seastate"]["zeta"][ih]))
                     qtf_h = np.asarray(qt.calc_qtf_slender_body(
                         fowt, st["pose_eq"], st["seastate"]["beta"][ih],
                         Xi0=RAO_h, M_struc=st["statics"]["M_struc"]))[:, :, None, :]
@@ -647,11 +849,24 @@ class Model:
                                            fowt.w1_2nd, st["seastate"]["beta"][ih],
                                            st["seastate"]["S"][ih], self.w))
                     st["Fhydro_2nd"][ih] = f2h
-                    F_wave[s] = (np.asarray(st["F_BEM"][ih])
-                                 + np.asarray(st["excitation"]["F_hydro_iner"][ih])
-                                 + st["F_drag"][ih] + st["Fhydro_2nd"][ih])
-                Xi_sys[ih] = system_solve(F_wave)
-                self._record_dyn_residual(ih, Z_sys, Xi_sys[ih], F_wave)
+            Xi2_d, rel2_d = _dyn_solve_jit()(Zinv, Z_sys, assemble_F())
+            # heading 0's converged first-order solution is kept; the
+            # secondary headings take the re-solved response
+            Xi_d = jnp.concatenate([Xi_d[:1], Xi2_d[1:]], axis=0)
+            rel2 = obs.transfers.device_get(
+                rel2_d, what="solve_residual", phase="dynamics")
+        # residual cadence matches the old per-heading loop: first-order
+        # solve, then (when present) that heading's re-solve
+        for ih in range(nWaves):
+            self._record_dyn_residual(ih, rel[ih])
+            if rel2 is not None and ih > 0:
+                self._record_dyn_residual(ih, rel2[ih])
+
+        # ----- final write-back: the ONE response pull per case -----
+        Xi_np = obs.transfers.device_get(Xi_d, what="response",
+                                         phase="dynamics")
+        Xi_sys = np.zeros((nWaves + 1, 6 * N, nw), dtype=complex)
+        Xi_sys[:nWaves] = np.asarray(Xi_np)
 
         for i, fowt in enumerate(self.fowtList):
             s = slice(6 * i, 6 * i + 6)
@@ -776,6 +991,15 @@ class Model:
                 Xi0c = jnp.asarray(Xi_init)
             Z0 = jnp.zeros((6, 6, nw), dtype=complex)
             Bmat0 = jnp.zeros((fowt.nodes.n, 3, 3))
+            if jax.default_backend() != "cpu":
+                # donate the warm-start buffer so the Xi carry reuses
+                # device memory (CPU has no donation — it would only
+                # warn); the while_loop traces per call either way
+                fp = jax.jit(
+                    lambda x0: jax.lax.while_loop(
+                        cond, iteration, (x0, x0, Z0, Bmat0, 0, False)),
+                    donate_argnums=0)
+                return fp(Xi0c)
             return jax.lax.while_loop(cond, iteration,
                                       (Xi0c, Xi0c, Z0, Bmat0, 0, False))
 
@@ -785,7 +1009,8 @@ class Model:
             # internal QTF from the drag-converged first-order RAOs, then
             # re-converge with the 2nd-order forces included (reference:
             # raft_model.py:966-989)
-            Xi1 = np.asarray(carry[1])
+            Xi1 = np.asarray(obs.transfers.device_get(
+                carry[1], what="first_order_rao", phase="dynamics"))
             RAO = np.asarray(get_rao(Xi1, seastate["zeta"][0]))
             # outFolderQTF: drop .4 RAO + .12d QTF snapshots and reload the
             # QTF as a checkpoint when inputs are unchanged (reference
@@ -872,9 +1097,16 @@ class Model:
         XiLast, Xi1, Z, Bmat, niter, converged = carry
 
         # ----- solver-health metrics: the fixed point's convergence -----
-        n_it = int(niter)
-        conv = bool(converged)
-        Xi1_np, XiLast_np = np.asarray(Xi1), np.asarray(XiLast)
+        # one sanctioned pull for the whole carry summary (iteration
+        # count, convergence flag, last two iterates); the converged
+        # impedance Z and drag matrix Bmat STAY on device for the
+        # system assembly / heading-batched drag excitation
+        n_it, conv, Xi1_np, XiLast_np = obs.transfers.device_get(
+            (niter, converged, Xi1, XiLast), what="drag_fixed_point",
+            phase="dynamics")
+        n_it = int(n_it)
+        conv = bool(conv)
+        Xi1_np, XiLast_np = np.asarray(Xi1_np), np.asarray(XiLast_np)
         residual = float(np.max(np.abs(Xi1_np - XiLast_np)
                                 / (np.abs(Xi1_np) + tol)))
         lbl = dict(fowt=ifowt, case=self._case_label())
@@ -906,8 +1138,10 @@ class Model:
 
         state["Fhydro_2nd"] = Fhydro_2nd
         state["Fhydro_2nd_mean"] = Fhydro_2nd_mean
-        state["F_drag"] = np.zeros((nWaves, 6, nw), dtype=complex)
-        state["Z"] = np.asarray(Z)
+        # the converged impedance stays a DEVICE array: the dynamics
+        # system assembly and the heading-batched solve consume it
+        # without a host round-trip (state["F_drag"] is filled there)
+        state["Z"] = Z
         state["Bmat"] = Bmat
 
     # ------------------------------------------------------------------
@@ -1084,6 +1318,7 @@ class Model:
         self.last_manifest = manifest
         self._case_records = {}
         self._dyn_cost_recorded = False
+        transfers0 = obs.transfers.snapshot()
         status = "failed"
         try:
             with temp_verbosity(display), \
@@ -1096,10 +1331,20 @@ class Model:
             # snapshot under the last case's tag
             self._iCase = None
             ledger = None
+            # host-transfer accounting for THIS run (per-phase pull
+            # counts/bytes through the sanctioned exit points), folded
+            # into the manifest and — on success — the ledger extra
+            xfers = obs.transfers.delta(transfers0,
+                                        obs.transfers.snapshot())
+            xfers["per_case"] = {
+                ph: round(rec["events"] / max(nCases, 1), 3)
+                for ph, rec in xfers["phases"].items()}
+            manifest.extra["host_transfers"] = xfers
             if status == "ok":
                 obs.device.collect(manifest, scope="analyzeCases")
                 ledger = obs.ledger_from_model(
                     self, run_id=manifest.run_id)
+                ledger["extra"] = {"host_transfers": xfers}
                 self.last_ledger = ledger
             with temp_verbosity(display):
                 paths = obs.finish_run(manifest, status=status,

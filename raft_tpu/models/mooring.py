@@ -442,8 +442,22 @@ def coupled_stiffness_rotvec(sys_, r6, xf=None, current=None):
     the round-4 operating-case forensics.  Implemented not by hand-porting
     MoorPy's formulas but by autodiffing the same wrench under the
     rotation-vector parameterization R(delta) @ R0 — identical to MoorPy's
-    series to first order, with no sign/term transcription risk."""
+    series to first order, with no sign/term transcription risk.
+
+    Limitation: the general (free-point) topology path does NOT model
+    line current — a non-None ``current`` is dropped there (the
+    mooring_array stiffness has no current-loaded line profiles; only
+    the simple-topology catenary does) and a UserWarning is emitted so
+    the approximation is visible instead of silent."""
     if _is_general(sys_):
+        if current is not None:
+            import warnings
+            warnings.warn(
+                "coupled_stiffness_rotvec: 'current' is ignored on "
+                "general (free-point) mooring topologies — the stiffness "
+                "is evaluated with unloaded line profiles (current only "
+                "enters general topologies through the lumped "
+                "current_wrench on F_env)", stacklevel=2)
         from raft_tpu.models import mooring_array as ma
         Xb = jnp.asarray(r6, float)[None, :]
         if xf is None:

@@ -1,6 +1,6 @@
 """raft_tpu.obs — observability: tracing, metrics, manifests, ledgers.
 
-Five pillars (see docs/observability.md):
+Six pillars (see docs/observability.md):
 
 - :mod:`raft_tpu.obs.tracing` — nested wall-time spans with attributes,
   Chrome-trace/Perfetto JSON export, and the name -> (total, calls)
@@ -16,6 +16,9 @@ Five pillars (see docs/observability.md):
   ground truth, driven by the ``tools/obsctl.py`` CLI.
 - :mod:`raft_tpu.obs.device` — per-device memory stats, live-array
   accounting, jit cache hit/miss deltas, static HLO cost analysis.
+- :mod:`raft_tpu.obs.transfers` — host-transfer accounting: counted
+  sanctioned ``device_get`` exit points, per-phase budgets, and a
+  transfer-guard wrapper that traps unsanctioned device→host pulls.
 
 File output is opt-in: call ``configure(out_dir=...)`` or set the
 ``RAFT_TPU_OBS_DIR`` environment variable, and every instrumented entry
@@ -54,6 +57,7 @@ from raft_tpu.obs.ledger import (                               # noqa: F401
     compare_manifests,
 )
 from raft_tpu.obs import device  # noqa: F401
+from raft_tpu.obs import transfers  # noqa: F401
 
 _OUT_DIR: str | None = None
 _MAX_RUNS: int | None = None
@@ -152,11 +156,13 @@ def finish_run(manifest: RunManifest, status: str = "ok",
 def reset_all():
     """Reset every in-process observability pillar in one call: span
     buffer + aggregate, metrics registry, jit-cache delta baselines,
-    AND the configured output directory/retention.  Built for test
+    host-transfer accounting, AND the configured output
+    directory/retention.  Built for test
     isolation (the autouse conftest fixture); a long-running service
     that calls it between logical runs must call ``configure(...)``
     again afterwards or artifact output silently stops."""
     reset_tracing()
     REGISTRY.reset()
     device.reset_jit_cache_baseline()
+    transfers.reset()
     configure(None)

@@ -57,7 +57,8 @@ DEFAULT_MANIFEST_IGNORE = ("raft_jax_*", "raft_jit_cache_*",
 #: manifest scalar patterns that measure wall time / throughput — they
 #: jitter between identical runs, so they get the looser perf tolerance
 PERF_PATTERNS = ("duration_s", "phase:*:total_s", "*_seconds_total",
-                 "extra:result:value", "extra:result:vs_baseline")
+                 "extra:result:value", "extra:result:vs_baseline",
+                 "extra:result:analyze_cases_s_per_case")
 
 
 def _utcnow() -> str:

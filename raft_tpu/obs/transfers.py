@@ -1,0 +1,174 @@
+"""Host-transfer accounting: device→host pulls as counted, budgeted events.
+
+The device-resident ``analyzeCases`` path (model.py) treats a host pull
+the way JAX training stacks do — something that happens only at a small
+set of *sanctioned exit points*, each of which goes through
+:func:`device_get` here.  Every sanctioned pull is
+
+- counted (events, arrays, bytes) against the innermost active
+  accounting *phase* (:func:`phase`, nestable),
+- exported to the metrics registry as
+  ``raft_tpu_host_transfers_total{phase,what}`` /
+  ``raft_tpu_host_transfer_bytes_total{phase}``, and
+- available as a process snapshot (:func:`snapshot`) that
+  ``Model.analyzeCases`` folds into the run manifest
+  (``extra["host_transfers"]``) and the result ledger
+  (``ledger["extra"]["host_transfers"]``).
+
+That makes the steady-state per-case host-pull count a *pinned* number:
+``tests/test_device_resident.py`` asserts the documented budget (see
+docs/performance.md) and any new ``np.asarray`` sneaking onto the hot
+path shows up as an uncounted slowdown — or, under :func:`guard`, as a
+hard error.
+
+:func:`guard` wraps ``jax.transfer_guard_device_to_host("disallow")``:
+inside it, any implicit device→host transfer raises, while
+:func:`device_get` remains legal (it re-allows around its own pull).
+This is the ``jax.transfer_guard("log")``-style interception with
+teeth, used by the budget test to prove the hot path has no unsanctioned
+pulls.
+
+Like the rest of ``raft_tpu.obs``, this module never imports jax at
+module scope.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_LOCK = threading.Lock()
+#: per-phase totals: {phase: {"events": int, "arrays": int, "bytes": int}}
+_PHASES: dict[str, dict] = {}
+#: stack of active phase names (thread-shared: the solve path is
+#: host-single-threaded; nested phases label the innermost)
+_STACK: list[str] = []
+
+_UNPHASED = "unphased"
+
+
+def reset():
+    """Forget all accumulated transfer accounting (test isolation)."""
+    with _LOCK:
+        _PHASES.clear()
+        del _STACK[:]
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Attribute sanctioned pulls inside the block to ``name``."""
+    with _LOCK:
+        _STACK.append(str(name))
+    try:
+        yield
+    finally:
+        with _LOCK:
+            if _STACK and _STACK[-1] == str(name):
+                _STACK.pop()
+            elif str(name) in _STACK:          # pragma: no cover
+                _STACK.remove(str(name))
+
+
+def current_phase() -> str:
+    with _LOCK:
+        return _STACK[-1] if _STACK else _UNPHASED
+
+
+def _leaf_stats(tree) -> tuple[int, int]:
+    """(arrays, bytes) over the jax array leaves of ``tree``."""
+    import jax
+
+    arrays = 0
+    nbytes = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arrays += 1
+        try:
+            nbytes += int(leaf.nbytes)
+        except (AttributeError, TypeError):
+            pass
+    return arrays, nbytes
+
+
+def device_get(tree, what: str = "", phase: str = None):
+    """Sanctioned device→host pull: ``jax.device_get`` counted as ONE
+    transfer event against ``phase`` (default: the innermost active
+    :func:`phase`).  Legal inside :func:`guard`.  Returns the host
+    pytree (numpy leaves)."""
+    import jax
+
+    from raft_tpu.obs import metrics as _metrics
+
+    ph = str(phase) if phase is not None else current_phase()
+    arrays, nbytes = _leaf_stats(tree)
+    with jax.transfer_guard_device_to_host("allow"):
+        out = jax.device_get(tree)
+    with _LOCK:
+        rec = _PHASES.setdefault(
+            ph, {"events": 0, "arrays": 0, "bytes": 0})
+        rec["events"] += 1
+        rec["arrays"] += arrays
+        rec["bytes"] += nbytes
+    _metrics.counter(
+        "raft_tpu_host_transfers_total",
+        "sanctioned device->host transfer events on the solve path, "
+        "by accounting phase and exit point").inc(
+        1.0, phase=ph, what=str(what) or "-")
+    _metrics.counter(
+        "raft_tpu_host_transfer_bytes_total",
+        "bytes pulled device->host through sanctioned exit points"
+        ).inc(float(nbytes), phase=ph)
+    return out
+
+
+@contextlib.contextmanager
+def guard(mode: str = "disallow"):
+    """Trap *unsanctioned* device→host transfers: inside the block any
+    implicit transfer (``np.asarray`` on a device array, ``float(x)``,
+    iteration) follows ``mode`` (``"disallow"`` raises, ``"log"`` logs —
+    jax's transfer-guard semantics), while :func:`device_get` stays
+    legal.  Degrades to a no-op on jax builds without the API — and is
+    vacuous on the CPU backend, where device memory IS host memory and
+    jax never classifies the read as a transfer (the budget there rests
+    on the counted events, not the guard)."""
+    import jax
+
+    try:
+        ctx = jax.transfer_guard_device_to_host(mode)
+    except Exception:                                  # pragma: no cover
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+def snapshot() -> dict:
+    """JSON-able accounting snapshot:
+    ``{"total": {...}, "phases": {name: {events, arrays, bytes}}}``."""
+    with _LOCK:
+        phases = {k: dict(v) for k, v in sorted(_PHASES.items())}
+    total = {"events": 0, "arrays": 0, "bytes": 0}
+    for rec in phases.values():
+        for k in total:
+            total[k] += rec[k]
+    return {"total": total, "phases": phases}
+
+
+def delta(before: dict, after: dict) -> dict:
+    """Per-phase difference of two :func:`snapshot` dicts — the
+    accounting attributable to one run in a process that may have run
+    others before it."""
+    out = {"total": {}, "phases": {}}
+    for ph, rec in after.get("phases", {}).items():
+        prev = before.get("phases", {}).get(ph, {})
+        d = {k: rec[k] - prev.get(k, 0) for k in rec}
+        if any(d.values()):
+            out["phases"][ph] = d
+    for k in after.get("total", {}):
+        out["total"][k] = (after["total"][k]
+                           - before.get("total", {}).get(k, 0))
+    return out
+
+
+def counts(phase: str = None) -> dict:
+    """One phase's totals (zeros when it never pulled)."""
+    with _LOCK:
+        rec = _PHASES.get(str(phase) if phase else _UNPHASED)
+        return dict(rec) if rec else {"events": 0, "arrays": 0, "bytes": 0}

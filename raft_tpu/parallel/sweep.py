@@ -215,6 +215,7 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
         **{k: v for k, v in kw.items() if isinstance(v, (int, float, str))}})
     obs.record_build_info()
     obs.device.jit_cache_delta(scope="sweep_cases")      # delta baseline
+    transfers0 = obs.transfers.snapshot()
     status = "failed"
     ledger = None
     try:
@@ -287,9 +288,14 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                                   "nw": len(fowt.w),
                                   "solver": _linalg.last_dispatch()})
                     cache_info["stored"] = stored is not None
-            iters = np.asarray(out["iters"])
-            n_conv = int(np.asarray(out["converged"]).sum())
-            fp_chunks = int(np.asarray(out["fp_chunks"]))
+            # ONE sanctioned counted pull for the batch summary facts
+            # (the response stds stay on device until the ledger digest)
+            iters, conv_np, chunks_np = obs.transfers.device_get(
+                (out["iters"], out["converged"], out["fp_chunks"]),
+                what="sweep_summary", phase="sweep")
+            iters = np.asarray(iters)
+            n_conv = int(np.asarray(conv_np).sum())
+            fp_chunks = int(chunks_np)
             sp.set(converged=n_conv, iters_max=int(iters.max(initial=0)),
                    fp_chunks=fp_chunks,
                    exec_cache=cache_info["state"])
@@ -321,6 +327,8 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
         manifest.extra["fixed_point"] = {"chunks_run": fp_chunks,
                                          "iters_max": int(
                                              iters.max(initial=0))}
+        manifest.extra["host_transfers"] = obs.transfers.delta(
+            transfers0, obs.transfers.snapshot())
         obs.device.collect(manifest, scope="sweep_cases")
         ledger = obs.ledger_from_sweep(out, config=dict(manifest.config),
                                        run_id=manifest.run_id)

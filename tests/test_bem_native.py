@@ -531,7 +531,6 @@ def _buoy_design(pm, hydro=None):
     return d
 
 
-@pytest.mark.slow
 def _assert_std_parity(ref, ours, tol):
     """Per-DOF response-std agreement, symmetric near-zero DOFs scaled
     by the surge response."""
@@ -599,6 +598,7 @@ def _oc4_ab_end_to_end(tmp_path, dz, da, tol):
     _assert_std_parity(ref, ours, tol)
 
 
+@pytest.mark.slow
 def test_cylinder_native_vs_pyhams_end_to_end():
     """The 'HAMS-equivalent' claim measured END-TO-END with full
     potential-flow excitation: the same cylinder model run (a) from the

@@ -1,0 +1,238 @@
+"""Device-resident analyzeCases gates (fast tier).
+
+Three contracts of the device-resident case pipeline (docs/performance.md,
+"Device-resident analyzeCases"):
+
+- **Statics parity** — the device ``lax.while_loop`` damped Newton
+  (``RAFT_TPU_STATICS=device``, the default) must reproduce the host
+  Python-loop Newton (``host``, the retained reference backend) on the
+  OC3 coarse golden config: positions to 1e-8, iteration counts ±1.
+- **Heading-batched dynamics parity** — the one-shot
+  ``(nWaves, 6N, nw)`` batched system solve must match the per-heading
+  reference kernel applied heading by heading, and the response written
+  back by ``solveDynamics`` must satisfy the per-heading per-frequency
+  linear system directly (``Z Xi = F`` rebuilt on host from the model
+  state).
+- **Transfer budget** — one coarse ``analyzeCases`` case makes exactly
+  the documented number of sanctioned device→host pulls (statics: 1,
+  dynamics: 4 for a single-FOWT no-potSecOrder case), the counts are
+  exported as ``raft_tpu_host_transfers_total`` and recorded in the run
+  manifest and ledger extra, and the whole hot path survives
+  ``obs.transfers.guard('disallow')``-style accounting (the counted
+  helper is the only sanctioned exit).
+
+The module-scoped OC3 model is built once (coarse grid, one case) and
+shared; obs state the tests assert on is captured at fixture time (the
+conftest autouse fixture resets obs around every test).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import _config, obs
+from raft_tpu.io.designs import load_design
+from raft_tpu.model import Model, _apply_zinv_j, _dyn_solve_core
+
+GOLDEN_FREQ = {"min_freq": 0.02, "max_freq": 0.2}
+
+
+def _coarse_design(name="OC3spar"):
+    design = load_design(name)
+    design.setdefault("settings", {})
+    design["settings"].update(GOLDEN_FREQ)
+    design["cases"]["data"] = design["cases"]["data"][:1]
+    return design
+
+
+@pytest.fixture(scope="module")
+def oc3_run():
+    """One coarse OC3 analyzeCases through the device-resident path,
+    with the obs facts the tests assert on captured at fixture time."""
+    obs.reset_all()
+    design = _coarse_design()
+    model = Model(design)
+    model.analyzeCases()
+    state = {
+        "model": model,
+        "design": design,
+        "ledger": model.last_ledger,
+        "manifest": model.last_manifest.to_dict(),
+        "transfers": obs.transfers.snapshot(),
+        "snap": obs.snapshot(),
+    }
+    yield state
+    obs.reset_all()
+
+
+# ---------------------------------------------------------------------------
+# statics: device lax.while_loop Newton vs the host reference loop
+# ---------------------------------------------------------------------------
+
+def test_statics_device_vs_host_parity(oc3_run):
+    """Same equilibrium (1e-8 on positions), same iteration count (±1),
+    same residual scale from both statics backends on the same Model."""
+    model = oc3_run["model"]
+    case = dict(zip(model.design["cases"]["keys"],
+                    model.design["cases"]["data"][0]))
+    out = {}
+    try:
+        for mode in ("device", "host"):
+            _config.set_statics_mode(mode)
+            X = np.asarray(model.solveStatics(case))
+            rec = model._case_records["unloaded"]
+            out[mode] = (X, rec["statics_iters"], rec["statics_residual"])
+    finally:
+        _config.set_statics_mode(None)
+    Xd, itd, rd = out["device"]
+    Xh, ith, rh = out["host"]
+    scale = np.maximum(np.abs(Xh), 1.0)
+    assert np.all(np.abs(Xd - Xh) / scale < 1e-8), (Xd, Xh)
+    assert abs(itd - ith) <= 1, (itd, ith)
+    # both residuals sit at the converged-equilibrium scale
+    assert rd < 1e-3 and rh < 1e-3
+
+
+def test_statics_iteration_count_in_ledger(oc3_run):
+    """The device Newton's per-case iteration count and residual reach
+    the ledger exactly as the host loop's did (golden-gate contract)."""
+    led = oc3_run["ledger"]
+    system = next(e for e in led["entries"] if e["key"] == "case0/system")
+    assert system["metrics"]["statics_iters"] >= 1
+    assert system["metrics"]["statics_residual"] < 1e-3
+    assert "cond_max" in system["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# dynamics: heading-batched solve vs the per-heading reference kernel
+# ---------------------------------------------------------------------------
+
+def test_heading_batched_solve_matches_per_heading(rng):
+    """The (nH, 6N, nw) batched kernel == the single-heading kernel
+    applied per heading, and its device residuals match the host
+    definition."""
+    nw, n, nH = 7, 6, 3
+    Z = (rng.standard_normal((nw, n, n))
+         + 1j * rng.standard_normal((nw, n, n))
+         + 10.0 * np.eye(n))          # well-conditioned
+    F = (rng.standard_normal((nH, n, nw))
+         + 1j * rng.standard_normal((nH, n, nw)))
+    from raft_tpu.ops.linalg import inv_complex
+    Zinv = inv_complex(jnp.asarray(Z))
+    Xi_b, rel_b = _dyn_solve_core(Zinv, jnp.asarray(Z), jnp.asarray(F))
+    Xi_b, rel_b = np.asarray(Xi_b), np.asarray(rel_b)
+    for ih in range(nH):
+        Xi_h = np.asarray(_apply_zinv_j(Zinv, jnp.asarray(F[ih])))
+        assert np.allclose(Xi_b[ih], Xi_h, rtol=1e-12, atol=1e-12)
+        R = np.einsum("wij,jw->iw", Z, Xi_h) - F[ih]
+        rel_ref = np.linalg.norm(R) / (np.linalg.norm(F[ih]) + 1e-300)
+        assert abs(rel_b[ih] - rel_ref) < 1e-12 + 0.1 * rel_ref
+
+
+def test_dynamics_response_satisfies_system(oc3_run):
+    """End-to-end: the response solveDynamics wrote back satisfies the
+    per-heading per-frequency system Z Xi = F rebuilt on host from the
+    model state (the old serial path's defining equation)."""
+    model = oc3_run["model"]
+    st = model._state[0]
+    nWaves = st["seastate"]["nWaves"]
+    Z = np.moveaxis(np.asarray(st["Z"]), -1, 0)       # (nw, 6, 6)
+    F = (np.asarray(st["F_BEM"])[:nWaves]
+         + np.asarray(st["excitation"]["F_hydro_iner"])[:nWaves]
+         + np.asarray(st["F_drag"])
+         + np.asarray(st["Fhydro_2nd"]))
+    Xi = model.Xi[:nWaves]
+    for ih in range(nWaves):
+        lhs = np.einsum("wij,jw->iw", Z, Xi[ih])
+        assert np.allclose(lhs, F[ih], rtol=1e-8, atol=1e-8 * np.abs(F).max())
+    # the trailing (wind) row stays zero, as in the serial path
+    assert np.all(model.Xi[nWaves:] == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# transfer budget
+# ---------------------------------------------------------------------------
+
+#: documented steady-state sanctioned host-pull budget per case for a
+#: single-FOWT case without potSecOrder (docs/performance.md):
+#: statics — 1 (Newton result sync at convergence); dynamics — 4
+#: (fixed-point carry summary, condition estimate, solve residuals,
+#: response write-back)
+STATICS_BUDGET = 1
+DYNAMICS_BUDGET = 4
+
+
+def test_transfer_budget_per_case(oc3_run):
+    xfers = oc3_run["transfers"]
+    phases = xfers["phases"]
+    assert phases["statics"]["events"] == STATICS_BUDGET
+    assert phases["dynamics"]["events"] == DYNAMICS_BUDGET
+    # every counted pull carries bytes and arrays
+    for rec in phases.values():
+        assert rec["arrays"] >= rec["events"]
+        assert rec["bytes"] > 0
+
+
+def test_transfer_metrics_and_manifest(oc3_run):
+    snap = oc3_run["snap"]
+    total = snap["raft_tpu_host_transfers_total"]
+    assert total["kind"] == "counter"
+    by_phase = {}
+    for s in total["series"]:
+        by_phase.setdefault(s["labels"]["phase"], 0)
+        by_phase[s["labels"]["phase"]] += s["value"]
+    assert by_phase["statics"] == STATICS_BUDGET
+    assert by_phase["dynamics"] == DYNAMICS_BUDGET
+    assert "raft_tpu_host_transfer_bytes_total" in snap
+    # manifest + ledger extra carry the per-phase accounting
+    mani = oc3_run["manifest"]["extra"]["host_transfers"]
+    assert mani["phases"]["statics"]["events"] == STATICS_BUDGET
+    assert mani["per_case"]["dynamics"] == DYNAMICS_BUDGET
+    led_x = oc3_run["ledger"]["extra"]["host_transfers"]
+    assert led_x["phases"]["dynamics"]["events"] == DYNAMICS_BUDGET
+
+
+def test_sanctioned_device_get_counts_and_guards():
+    """obs.transfers.device_get counts events/arrays/bytes against the
+    active phase and stays legal under the disallow transfer guard."""
+    obs.transfers.reset()
+    x = jnp.arange(8, dtype=jnp.float64)
+    with obs.transfers.guard("disallow"):
+        with obs.transfers.phase("unit"):
+            host = obs.transfers.device_get((x, x * 2), what="pair")
+    assert np.all(np.asarray(host[1]) == 2 * np.asarray(host[0]))
+    rec = obs.transfers.counts("unit")
+    assert rec == {"events": 1, "arrays": 2, "bytes": 128}
+    snap = obs.transfers.snapshot()
+    assert snap["total"]["events"] == 1
+    # delta accounting subtracts a baseline
+    before = obs.transfers.snapshot()
+    with obs.transfers.phase("unit"):
+        obs.transfers.device_get(x, what="single")
+    d = obs.transfers.delta(before, obs.transfers.snapshot())
+    assert d["phases"]["unit"]["events"] == 1
+    assert d["total"]["bytes"] == 64
+    obs.transfers.reset()
+
+
+def test_unsanctioned_pull_trips_guard():
+    """An implicit device->host transfer inside the guard raises — the
+    teeth behind the budget: nothing off the sanctioned exits.  The
+    guard is vacuous on the CPU backend (device memory IS host memory,
+    so jax never classifies the read as a transfer): there the test
+    only pins that the guard machinery is inert and device_get stays
+    legal; on accelerator backends the raise is asserted."""
+    import jax
+
+    x = jnp.arange(4, dtype=jnp.float64) + 1.0
+    y = x * 3.0                    # committed device value
+    try:
+        with obs.transfers.guard("disallow"):
+            if jax.default_backend() == "cpu":
+                np.asarray(y)      # free on CPU: no transfer, no raise
+            else:                  # pragma: no cover (accelerator only)
+                with pytest.raises(Exception):
+                    np.asarray(y)
+            assert float(obs.transfers.device_get(y, what="ok")[0]) == 3.0
+    finally:
+        obs.transfers.reset()

@@ -157,16 +157,33 @@ def main():
     # PREVIOUS case's heading through the stale hub-transfer quirk
     # (docs/quirks.md), so jumping straight to case i would evaluate the
     # turbine constants with the wrong staleness and shift the wave band
-    # by ~10% on its own.
+    # by ~10% on its own.  The cross-case state analyzeCases left behind
+    # must be dropped first for the same reason: a replayed case 0 would
+    # otherwise see the LAST case's stored hub-transfer heading (and, on
+    # potSecOrder designs, its mean-drift force) instead of the fresh
+    # defaults analyzeCases started from.
     ncases = len(design["cases"]["data"])
     if args.case != ncases - 1:
+        for st in m._state:
+            st.pop("_stored_heading", None)
+            st.pop("F_meandrift", None)
+        second_order = any(f.potSecOrder > 0 for f in m.fowtList)
         for ic in range(args.case + 1):
             c = dict(zip(design["cases"]["keys"],
                          design["cases"]["data"][ic]))
             c["iCase"] = ic
             m._iCase = ic
             m.solveStatics(c)
-        m.solveDynamics(c)
+            if second_order:
+                # mirror analyzeCases' operating-point re-solve: the
+                # dynamics fill F_meandrift, statics re-solve with it,
+                # then it is cleared so it cannot leak into the next case
+                m.solveDynamics(c)
+                m.solveStatics(c)
+                for st in m._state:
+                    st.pop("F_meandrift", None)
+        if not second_order:
+            m.solveDynamics(c)
 
     bins, ref_psd = band_report(m, truth, args.case, args.channel)
 

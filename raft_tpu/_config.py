@@ -140,3 +140,34 @@ def set_statics_mode(mode: str | None):
     if mode is not None and str(mode) not in _STATICS_MODES:
         raise ValueError(f"statics mode {mode!r} not in {_STATICS_MODES}")
     _statics_override = None if mode is None else str(mode)
+
+
+# ---------------------------------------------------------------------------
+# automatic recovery (recovery.py ladder + model.py case quarantine)
+# ---------------------------------------------------------------------------
+
+#: RAFT_TPU_RECOVERY values: "1" (default) — typed solver failures walk
+#: the degradation ladder and unrecoverable cases are quarantined so the
+#: rest of the sweep completes; "0" — pre-recovery behavior: the first
+#: typed failure propagates out of analyzeCases/sweep_cases unchanged.
+_RECOVERY_MODES = ("0", "1")
+_recovery_override: str | None = None
+
+
+def recovery_mode() -> str:
+    """Active recovery mode ("0" | "1"); programmatic override beats
+    the ``RAFT_TPU_RECOVERY`` environment variable."""
+    if _recovery_override is not None:
+        return _recovery_override
+    mode = os.environ.get("RAFT_TPU_RECOVERY", "1").strip().lower()
+    if mode in ("off", "false"):
+        mode = "0"
+    return mode if mode in _RECOVERY_MODES else "1"
+
+
+def set_recovery_mode(mode: str | None):
+    """Override the recovery mode in-process (None clears)."""
+    global _recovery_override
+    if mode is not None and str(mode) not in _RECOVERY_MODES:
+        raise ValueError(f"recovery mode {mode!r} not in {_RECOVERY_MODES}")
+    _recovery_override = None if mode is None else str(mode)

@@ -1,0 +1,137 @@
+"""Typed error taxonomy for the case-execution layer.
+
+Every failure the solver stack can diagnose gets a typed exception that
+carries *structured* context (case index, phase, FOWT index, active
+solver configuration) instead of a bare ``Exception``/``RuntimeError``
+with the facts baked into the message string.  The recovery layer
+(:mod:`raft_tpu.recovery`) keys its degradation ladder off these types,
+the per-case quarantine in ``Model.analyzeCases`` serializes their
+:meth:`RaftError.context` into the run manifest and result ledger
+(``extra["failed_cases"]``), and tests can assert on the class rather
+than regex-matching messages.
+
+Back-compat: callers that caught the old builtin classes keep working —
+:class:`NonFiniteResult` is a ``FloatingPointError`` *and* a
+``ValueError`` (the two builtins it replaces in ``model.py`` and
+``io/wamit.py``), :class:`StaticsDivergence`/:class:`DynamicsSingular`/
+:class:`EigenFailure` are ``RuntimeError``\\ s, and
+:class:`ModelConfigError` is a ``ValueError``.
+"""
+from __future__ import annotations
+
+
+class RaftError(Exception):
+    """Base of the raft_tpu error taxonomy.
+
+    ``context`` keyword arguments are retained verbatim on the instance
+    (``err.ctx``) and rendered into the message; :meth:`context` returns
+    the JSON-able record the quarantine/manifest layers persist.
+    """
+
+    #: phase tag the recovery ladder dispatches on; subclasses override
+    phase = "unknown"
+
+    def __init__(self, message: str = "", **context):
+        self.ctx = dict(context)
+        self.injected = bool(self.ctx.pop("injected", False))
+        super().__init__(message)
+
+    def __str__(self):
+        base = super().__str__()
+        facts = ", ".join(f"{k}={v}" for k, v in sorted(self.ctx.items()))
+        inj = " [injected]" if self.injected else ""
+        return f"{base}{inj}" + (f" ({facts})" if facts else "")
+
+    def context(self) -> dict:
+        """JSON-able structured record of this failure.  Non-finite
+        floats become the strings ``"nan"``/``"inf"`` — ``json.dump``
+        would otherwise emit bare ``NaN`` literals (invalid strict
+        JSON) into the run manifest for exactly the failed runs the
+        record documents."""
+        import math
+
+        out = {"error": type(self).__name__, "phase": self.phase,
+               "message": Exception.__str__(self),
+               "injected": self.injected}
+        for k, v in self.ctx.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                v = "nan" if math.isnan(v) else (
+                    "inf" if v > 0 else "-inf")
+            out[str(k)] = v if isinstance(v, (bool, int, float, str,
+                                              type(None))) else str(v)
+        return out
+
+
+class StaticsDivergence(RaftError, RuntimeError):
+    """The mean-offset Newton produced a non-finite pose or diverged."""
+
+    phase = "statics"
+
+
+class DynamicsSingular(RaftError, RuntimeError):
+    """The frequency-domain impedance system is singular or otherwise
+    unsolvable (near-singular factor, solve blow-up)."""
+
+    phase = "dynamics"
+
+
+class NonFiniteResult(RaftError, FloatingPointError, ValueError):
+    """A solver output or parsed input carries NaN/Inf.
+
+    Subclasses both ``FloatingPointError`` (the old ``solveDynamics``
+    sanitizer raise) and ``ValueError`` (the old ``io.wamit``
+    corrupt-file raise) so pre-taxonomy ``except`` clauses keep
+    working.
+    """
+
+    phase = "dynamics"
+
+
+class KernelFailure(RaftError, RuntimeError):
+    """A solve kernel (Pallas / XLA program) failed to trace, compile,
+    or execute — the ladder's cue to degrade Pallas -> jnp -> host."""
+
+    phase = "dynamics"
+
+
+class CacheCorruption(RaftError, RuntimeError):
+    """A persisted artifact (executable cache entry, QTF snapshot)
+    failed its integrity check.  The caches recover by delete-and-miss;
+    this type surfaces only when a caller opts into strict mode."""
+
+    phase = "cache"
+
+
+class EigenFailure(RaftError, RuntimeError):
+    """The eigen solve produced unusable system matrices or
+    non-positive eigenvalues."""
+
+    phase = "eigen"
+
+
+class MooringSingular(RaftError, RuntimeError):
+    """A mooring tension Jacobian / stiffness evaluation is singular —
+    degraded to NaN tension channels by the case loop."""
+
+    phase = "outputs"
+
+
+class ModelConfigError(RaftError, ValueError):
+    """The model/design configuration cannot be analyzed as requested
+    (not recoverable by the ladder — the input itself is wrong)."""
+
+    phase = "setup"
+
+
+class FaultInjected(RaftError, RuntimeError):
+    """Raised by :mod:`raft_tpu.testing.faults` for ``raise@...`` specs
+    at sites without a more specific mapped type."""
+
+    phase = "injected"
+
+
+#: failure types the degradation ladder may retry (everything a solver
+#: can plausibly survive by changing backend/precision/damping);
+#: configuration errors and cache corruption are excluded on purpose
+RECOVERABLE = (StaticsDivergence, DynamicsSingular, NonFiniteResult,
+               KernelFailure, FaultInjected)

@@ -35,16 +35,23 @@ import jax.numpy as jnp
 def _screen_finite(name, path, **arrays):
     """Raise with an actionable message if any parsed coefficient array
     carries NaN/Inf (reference guards its HAMS read-back the same way,
-    raft_fowt.py:708-714) — a corrupt file must not propagate silently."""
+    raft_fowt.py:708-714) — a corrupt file must not propagate silently.
+
+    The raise is the typed :class:`raft_tpu.errors.NonFiniteResult`
+    (still a ``ValueError``, so pre-taxonomy callers keep working) with
+    the file/field facts as structured context."""
+    from raft_tpu.errors import NonFiniteResult
+
     for label, arr in arrays.items():
         if arr is None:
             continue
         bad = ~np.isfinite(np.asarray(arr))
         if bad.any():
-            raise ValueError(
+            raise NonFiniteResult(
                 f"{name} file '{path}': {int(bad.sum())} non-finite "
                 f"value(s) in {label} — the file is corrupt or truncated; "
-                f"re-run the BEM solver or delete the cached output")
+                f"re-run the BEM solver or delete the cached output",
+                file=str(path), field=str(label), n_bad=int(bad.sum()))
 
 
 def _detect_freq_convention(col1_in_file_order):

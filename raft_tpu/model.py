@@ -55,7 +55,8 @@ from raft_tpu.ops.linalg import impedance_solve, inv_complex
 from raft_tpu.ops.transforms import transform_force, translate_matrix_6to6
 from raft_tpu.models.member import member_inertia
 from raft_tpu.utils.dicttools import get_from_dict
-from raft_tpu import obs
+from raft_tpu import errors, obs, recovery
+from raft_tpu.testing import faults
 from raft_tpu.utils.profiling import get_logger, temp_verbosity
 
 RAD2DEG = 180.0 / np.pi
@@ -477,7 +478,10 @@ class Model:
 
         F0s = jnp.asarray(np.stack(F0))
         K_hss = jnp.asarray(np.stack(K_hs))
-        db = np.tile(np.array([30, 30, 5, 0.1, 0.1, 0.1]), N)
+        # the degradation ladder's damped retry shrinks the Newton step
+        # clip (recovery.override("clip_scale")); 1.0 outside a retry
+        db = np.tile(np.array([30, 30, 5, 0.1, 0.1, 0.1]), N) \
+            * float(recovery.current("clip_scale", 1.0))
         tol = np.tile(np.array([0.05, 0.05, 0.05, 5e-3, 5e-3, 5e-3]) * 1e-3, N)
         xf_arg = jnp.zeros((0, 3)) if xf is None else jnp.asarray(xf)
         # damped Newton with a backtracking line search on |F|^2 — the
@@ -508,6 +512,16 @@ class Model:
             xf_arg = jnp.asarray(xf_np)
             n_iters = int(n_iters)
             residual = float(residual)
+        # fault-injection seam + divergence screen: a Newton that walked
+        # the pose into NaN/Inf (or an injected statics fault) surfaces
+        # as a typed StaticsDivergence the degradation ladder can act on
+        if faults.maybe_raise("statics", case=self._iCase) == "nan":
+            X = np.full_like(np.asarray(X, float), np.nan)
+        if not np.all(np.isfinite(X)) or not np.isfinite(residual):
+            raise errors.StaticsDivergence(
+                "statics Newton produced a non-finite pose",
+                case=self._iCase, iters=n_iters, residual=residual,
+                backend=_config.statics_mode())
         case_lbl = self._case_label()
         sp.set(newton_iters=n_iters, residual_norm=residual)
         obs.histogram(
@@ -658,12 +672,15 @@ class Model:
 
         for i in range(nDOF):
             if M_tot[i, i] < 1.0 or C_tot[i, i] < 1.0:
-                raise RuntimeError(
-                    f"small/negative diagonal in system matrices at DOF {i}")
+                raise errors.EigenFailure(
+                    "small/negative diagonal in system matrices",
+                    dof=i, M_ii=float(M_tot[i, i]), C_ii=float(C_tot[i, i]))
 
         eigenvals, eigenvectors = np.linalg.eig(np.linalg.solve(M_tot, C_tot))
         if any(eigenvals <= 0.0):
-            raise RuntimeError("zero or negative system eigenvalues detected")
+            raise errors.EigenFailure(
+                "zero or negative system eigenvalues detected",
+                n_nonpositive=int(np.sum(eigenvals <= 0.0)))
 
         # DOF-claiming sort (reference: raft_model.py:441-456)
         ind_list = []
@@ -880,10 +897,12 @@ class Model:
         # here means diverged drag linearization or corrupt coefficients
         bad = ~np.isfinite(np.asarray(Xi_sys))
         if bad.any():
-            raise FloatingPointError(
+            raise errors.NonFiniteResult(
                 f"solveDynamics produced {int(bad.sum())} non-finite "
-                f"response value(s) (case={case}); check BEM/QTF input "
-                f"files and drag-linearization convergence")
+                "response value(s); check BEM/QTF input files and "
+                "drag-linearization convergence",
+                case=self._iCase, n_bad=int(bad.sum()),
+                nWaves=int(nWaves))
         self.Xi = Xi_sys
         self.results["response"] = {}
         return Xi_sys
@@ -893,7 +912,12 @@ class Model:
         6x6 impedance (reference: raft_model.py:877-1013)."""
         fowt = self.fowtList[ifowt]
         state = self._state[ifowt]
-        nIter = self.nIter + 1
+        # the ladder's damped restart doubles the iteration budget and
+        # strengthens the under-relaxation (recovery.override); the
+        # defaults reproduce the reference 0.2/0.8 scheme bitwise
+        nIter = self.nIter * int(recovery.current("fp_iter_mult", 1)) + 1
+        keep, relax = recovery.relax_weights(
+            recovery.current("fp_relax", 0.8))
         w = jnp.asarray(self.w)
         nw = self.nw
 
@@ -978,7 +1002,8 @@ class Model:
                                       F_lin + F_drag)
                 tolCheck = jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
                 conv = jnp.all(tolCheck < tol)
-                XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xin)
+                XiNext = jnp.where(conv, XiLast,
+                                   keep * XiLast + relax * Xin)
                 return (XiNext, Xin, Zn, Bmat, ii + 1, done | conv)
 
             def cond(carry):
@@ -1003,7 +1028,23 @@ class Model:
             return jax.lax.while_loop(cond, iteration,
                                       (Xi0c, Xi0c, Z0, Bmat0, 0, False))
 
-        carry = run_fixed_point(jnp.asarray(F_lin))
+        def run_fixed_point_guarded(F_lin, Xi_init=None):
+            """Trace/compile/execute failures of the solve kernel become
+            typed KernelFailures the degradation ladder can step down
+            (Pallas -> jnp -> damped restart)."""
+            try:
+                return run_fixed_point(F_lin, Xi_init=Xi_init)
+            except errors.RaftError:
+                raise
+            except (FloatingPointError, RuntimeError) as e:
+                from raft_tpu.ops import linalg as _linalg
+                raise errors.KernelFailure(
+                    "drag fixed-point solve kernel failed",
+                    case=self._iCase, fowt=ifowt,
+                    dispatch=_linalg.last_dispatch().get("backend"),
+                ) from e
+
+        carry = run_fixed_point_guarded(jnp.asarray(F_lin))
 
         if fowt.potSecOrder == 1:
             # internal QTF from the drag-converged first-order RAOs, then
@@ -1091,7 +1132,7 @@ class Model:
                 seastate["S"][0], self.w))
             Fhydro_2nd[0] = f2
             F_lin = F_lin + Fhydro_2nd[0]
-            carry = run_fixed_point(jnp.asarray(F_lin), Xi_init=Xi1)
+            carry = run_fixed_point_guarded(jnp.asarray(F_lin), Xi_init=Xi1)
             state["qtf"] = qtf4
 
         XiLast, Xi1, Z, Bmat, niter, converged = carry
@@ -1138,6 +1179,12 @@ class Model:
 
         state["Fhydro_2nd"] = Fhydro_2nd
         state["Fhydro_2nd_mean"] = Fhydro_2nd_mean
+        # fault-injection seam: nan@dynamics poisons the converged
+        # impedance so the non-finite sanitizer (and thence the
+        # ladder/quarantine) sees a realistic corrupt-solve signature
+        if faults.maybe_raise("dynamics", case=self._iCase,
+                              fowt=ifowt) == "nan":
+            Z = Z * jnp.nan
         # the converged impedance stays a DEVICE array: the dynamics
         # system assembly and the heading-batched solve consume it
         # without a host round-trip (state["F_drag"] is filled there)
@@ -1153,9 +1200,9 @@ class Model:
         (reference: raft_model.py:184-241; ballast==1 walks fill levels,
         ballast==2 shifts fill densities uniformly)."""
         if self.nFOWT > 1:
-            raise Exception(
+            raise errors.ModelConfigError(
                 "analyzeUnloaded only works for a single FOWT (reference: "
-                "raft_model.py:191-192)")
+                "raft_model.py:191-192)", nFOWT=self.nFOWT)
         fowt = self.fowtList[0]
         if ballast == 1:
             self.adjustBallast(fowt, heave_tol=heave_tol)
@@ -1286,7 +1333,7 @@ class Model:
                                rPRP=ref[:3])
             ballast_volume += float(np.sum(np.asarray(mi["vfill"])))
         if ballast_volume <= 0:
-            raise Exception(
+            raise errors.ModelConfigError(
                 "adjustBallastDensity needs a platform with ballast volume")
         delta_rho_fill = sumFz / fowt.g / ballast_volume
         for geom in fowt.members:
@@ -1301,12 +1348,21 @@ class Model:
                           heave_new)
         return delta_rho_fill
 
-    def analyzeCases(self, display=0, RAO_plot=False):
+    def analyzeCases(self, display=0, RAO_plot=False, resume=False):
         """Statics + dynamics + output statistics per load case.  Records
         nested spans (statics/dynamics/QTF/outputs phases), solver-health
         metrics, and a :class:`raft_tpu.obs.RunManifest` — kept on
         ``self.last_manifest`` and written to ``obs.out_dir()`` (the
-        ``RAFT_TPU_OBS_DIR`` env var) when configured."""
+        ``RAFT_TPU_OBS_DIR`` env var) when configured.
+
+        Fault tolerance (docs/robustness.md): typed solver failures walk
+        the degradation ladder; a case the ladder cannot save is
+        quarantined — a structured record lands in ``self.failed_cases``,
+        the manifest, and the ledger ``extra["failed_cases"]`` while the
+        remaining cases still run.  Completed cases are journaled (keyed
+        by the model content digest) so ``resume=True`` after a crash or
+        preemption re-runs only the missing/failed cases.  Set
+        ``RAFT_TPU_RECOVERY=0`` to restore fail-fast behavior."""
         obs.install_jax_hooks()
         obs.record_build_info()
         obs.device.jit_cache_delta(scope="analyzeCases")   # baseline
@@ -1318,13 +1374,17 @@ class Model:
         self.last_manifest = manifest
         self._case_records = {}
         self._dyn_cost_recorded = False
+        #: structured quarantine records of this run's unrecoverable cases
+        self.failed_cases = []
+        self._recovery_attempts = []
+        self._resumed_cases = []
         transfers0 = obs.transfers.snapshot()
         status = "failed"
         try:
             with temp_verbosity(display), \
                     obs.span("analyzeCases", nCases=nCases,
                              nFOWT=self.nFOWT):
-                self._analyze_cases_impl(nCases, display)
+                self._analyze_cases_impl(nCases, display, resume=resume)
             status = "ok"
         finally:
             # a later direct solveDynamics call must not write its QTF
@@ -1340,11 +1400,19 @@ class Model:
                 ph: round(rec["events"] / max(nCases, 1), 3)
                 for ph, rec in xfers["phases"].items()}
             manifest.extra["host_transfers"] = xfers
+            manifest.extra["failed_cases"] = list(self.failed_cases)
+            if self._recovery_attempts:
+                manifest.extra["recovery"] = {
+                    "attempts": [a.to_dict()
+                                 for a in self._recovery_attempts]}
+            if self._resumed_cases:
+                manifest.extra["resumed_cases"] = list(self._resumed_cases)
             if status == "ok":
                 obs.device.collect(manifest, scope="analyzeCases")
                 ledger = obs.ledger_from_model(
                     self, run_id=manifest.run_id)
-                ledger["extra"] = {"host_transfers": xfers}
+                ledger["extra"] = {"host_transfers": xfers,
+                                   "failed_cases": list(self.failed_cases)}
                 self.last_ledger = ledger
             with temp_verbosity(display):
                 paths = obs.finish_run(manifest, status=status,
@@ -1355,61 +1423,248 @@ class Model:
                               paths["ledger"])
         return self.results
 
-    def _analyze_cases_impl(self, nCases, display):
+    # ---- cross-case carry state (resume/retry bookkeeping) ----------
+
+    def _snapshot_carry(self) -> dict:
+        """Copy of the state one case hands the next: the stale-heading
+        hub-transfer quirk, any pending mean-drift forcing, and the
+        array free-point warm start.  Restored before a ladder retry of
+        statics (so the retry sees the same stale heading the first
+        attempt did) and journaled after each case (so a resumed run
+        reproduces a continuous run)."""
+        return {
+            "stored_heading": [
+                None if st.get("_stored_heading") is None
+                else list(st["_stored_heading"]) for st in self._state],
+            "F_meandrift": [
+                None if "F_meandrift" not in st
+                else np.array(st["F_meandrift"], float)
+                for st in self._state],
+            "arr_xf": (None if self._arr_xf is None
+                       else np.array(self._arr_xf, float)),
+        }
+
+    def _restore_carry(self, carry: dict):
+        for st, heads, fmd in zip(self._state, carry["stored_heading"],
+                                  carry["F_meandrift"]):
+            if heads is None:
+                st.pop("_stored_heading", None)
+            else:
+                st["_stored_heading"] = list(heads)
+            if fmd is None:
+                st.pop("F_meandrift", None)
+            else:
+                st["F_meandrift"] = np.array(fmd, float)
+        self._arr_xf = (None if carry["arr_xf"] is None
+                        else np.array(carry["arr_xf"], float))
+
+    def _case_journal(self):
+        """Journal for this model's case table, or None when journaling
+        is disabled (``RAFT_TPU_JOURNAL=0``)."""
+        if not recovery.journal_enabled():
+            return None
+        try:
+            return recovery.CaseJournal.for_model(self)
+        except Exception as e:                        # pragma: no cover
+            _LOG.warning("case journal unavailable: %s", e)
+            return None
+
+    def _analyze_cases_impl(self, nCases, display, resume=False):
         self.results["properties"] = {}
         self.results["case_metrics"] = {}
         self.results["mean_offsets"] = []
+        journal = self._case_journal()
+        quarantine = recovery.enabled()
+        last_err = None
 
         for iCase in range(nCases):
             case = dict(zip(self.design["cases"]["keys"],
                             self.design["cases"]["data"][iCase]))
             case["iCase"] = iCase
             self._iCase = iCase
+            if resume and journal is not None:
+                entry = journal.load_case(iCase)
+                if entry is not None:
+                    self._resume_case(iCase, entry)
+                    continue
             self.results["case_metrics"][iCase] = {}
-            self.solveStatics(case, display=display)
-            self.solveDynamics(case, display=display)
-            # re-solve the operating point with mean wave drift included,
-            # then clear it so it can't leak into the next case (reference:
-            # raft_model.py:296-303)
-            if any(f.potSecOrder > 0 for f in self.fowtList):
-                self.results["mean_offsets"].pop()   # superseded by re-solve
-                self.solveStatics(case, display=display)
-                for state in self._state:
-                    state.pop("F_meandrift", None)
-            for i, fowt in enumerate(self.fowtList):
-                self.results["case_metrics"][iCase][i] = {}
-                with obs.span("saveTurbineOutputs", fowt=i, case=str(iCase)):
-                    self.saveTurbineOutputs(
-                        self.results["case_metrics"][iCase][i], i, case)
-                if display > 0:
-                    self._print_stats_table(iCase, i)
-
-            # array-level mooring tension statistics through the coupled
-            # tension Jacobian (reference: raft_model.py:345-388)
-            if self.arr_ms is not None:
-                from raft_tpu.models import mooring_array as ma
-                Xb = np.stack([self._state[i]["r6"]
-                               for i in range(self.nFOWT)])
-                xf = self._arr_xf
-                J = np.asarray(ma.tension_jacobian(self.arr_ms, Xb, xf))
-                T0 = np.asarray(ma.tensions(self.arr_ms, Xb, xf))
-                T_amps = np.einsum("tj,hjw->htw", J, self.Xi)
-                dw = self.w[1] - self.w[0]
-                nT = len(T0)
-                TRMS = np.array([float(get_rms(T_amps[:, iT, :]))
-                                 for iT in range(nT)])
-                am = {
-                    "Tmoor_avg": T0,
-                    "Tmoor_std": TRMS,
-                    "Tmoor_max": T0 + 3 * TRMS,
-                    "Tmoor_min": T0 - 3 * TRMS,
-                    "Tmoor_PSD": np.stack(
-                        [np.asarray(get_psd(T_amps[:, iT, :], dw,
-                                            source_axis=0))
-                         for iT in range(nT)]),
-                }
-                self.results["case_metrics"][iCase]["array_mooring"] = am
+            carry0 = self._snapshot_carry()
+            ok = False
+            try:
+                with faults.context(case=iCase):
+                    self._run_one_case(iCase, case, display, carry0)
+                ok = True
+            except errors.RECOVERABLE as e:
+                if not quarantine:
+                    raise
+                last_err = e
+                self._quarantine_case(iCase, e)
+            finally:
+                # keep the mean-offset list aligned with the case index
+                # (a failed case may have appended 0 or 1 entries)
+                offs = self.results["mean_offsets"]
+                del offs[iCase + 1:]
+                while len(offs) < iCase + 1:
+                    offs.append(np.full(self.nDOF, np.nan))
+            if ok and journal is not None:
+                journal.store_case(iCase, {
+                    "case_metrics": self.results["case_metrics"][iCase],
+                    "mean_offset": np.array(
+                        self.results["mean_offsets"][iCase], float),
+                    "case_record": self._case_records.get(str(iCase), {}),
+                    "carry": self._snapshot_carry(),
+                })
+        if self.failed_cases and len(self.failed_cases) == nCases:
+            # nothing survived: surface the failure instead of returning
+            # an all-quarantined result set
+            raise last_err
         return self.results
+
+    def _run_one_case(self, iCase, case, display, carry0):
+        """One load case end to end: statics and dynamics through the
+        degradation ladder, optional mean-drift statics re-solve, output
+        statistics, and the (guarded) array tension statistics."""
+
+        def statics_fn():
+            # a retry must see the same cross-case carry the first
+            # attempt did (the stale-heading quirk advances inside
+            # _case_constants)
+            self._restore_carry(carry0)
+            return self.solveStatics(case, display=display)
+
+        recovery.run_ladder(
+            "statics", str(iCase), statics_fn, recovery.statics_ladder(),
+            recorder=self._recovery_attempts.append)
+        recovery.run_ladder(
+            "dynamics", str(iCase),
+            lambda: self.solveDynamics(case, display=display),
+            recovery.dynamics_ladder(),
+            recorder=self._recovery_attempts.append)
+        # re-solve the operating point with mean wave drift included,
+        # then clear it so it can't leak into the next case (reference:
+        # raft_model.py:296-303)
+        if any(f.potSecOrder > 0 for f in self.fowtList):
+            self.results["mean_offsets"].pop()   # superseded by re-solve
+            recovery.run_ladder(
+                "statics", str(iCase),
+                lambda: self.solveStatics(case, display=display),
+                recovery.statics_ladder(),
+                recorder=self._recovery_attempts.append)
+            for state in self._state:
+                state.pop("F_meandrift", None)
+        for i, fowt in enumerate(self.fowtList):
+            self.results["case_metrics"][iCase][i] = {}
+            with obs.span("saveTurbineOutputs", fowt=i, case=str(iCase)):
+                self.saveTurbineOutputs(
+                    self.results["case_metrics"][iCase][i], i, case)
+            if display > 0:
+                self._print_stats_table(iCase, i)
+
+        if self.arr_ms is not None:
+            self.results["case_metrics"][iCase]["array_mooring"] = \
+                self._array_tension_stats(iCase)
+
+    def _quarantine_case(self, iCase, err: errors.RaftError):
+        """Record an unrecoverable case and keep the run alive: a
+        structured failure record replaces the case metrics and is
+        surfaced through the manifest and ledger extras."""
+        rec = {"case": int(iCase), **err.context()}
+        self.failed_cases.append(rec)
+        self.results["case_metrics"][iCase] = {"failed": rec}
+        self._case_records.pop(str(iCase), None)
+        # a failed case's mean-offset slot is ALWAYS the NaN marker —
+        # a case that passed statics but died in dynamics must not
+        # leave its partial equilibrium looking like a converged result
+        offs = self.results["mean_offsets"]
+        if len(offs) > iCase:
+            offs[iCase] = np.full(self.nDOF, np.nan)
+        # a completed case never hands F_meandrift to its successor (the
+        # clean flow pops it after the mean-drift statics re-solve) — a
+        # case quarantined mid-dynamics must not either, or the next
+        # case's statics would see the failed case's drift forcing and
+        # converge to a different equilibrium than a clean run.  The
+        # advanced _stored_heading is deliberately KEPT: the clean flow
+        # advances it in _case_constants regardless of how the case ends.
+        for state in self._state:
+            state.pop("F_meandrift", None)
+        obs.counter(
+            "raft_tpu_cases_failed_total",
+            "load cases quarantined by analyzeCases after the "
+            "degradation ladder was exhausted, by phase").inc(
+            1.0, phase=rec.get("phase", "unknown"))
+        cur = obs.current_span()
+        if cur is not None:
+            cur.set(failed_cases=len(self.failed_cases))
+        _LOG.error("case %d quarantined: %s", iCase, err)
+
+    def _resume_case(self, iCase, entry):
+        """Restore one journaled case: results, ledger record, and the
+        cross-case carry — the solve phases are skipped entirely (no
+        solveStatics/solveDynamics spans for this case)."""
+        with obs.span("case_resumed", case=str(iCase)):
+            self.results["case_metrics"][iCase] = entry["case_metrics"]
+            offs = self.results["mean_offsets"]
+            del offs[iCase:]
+            while len(offs) < iCase:
+                offs.append(np.full(self.nDOF, np.nan))
+            offs.append(np.array(entry["mean_offset"], float))
+            if entry.get("case_record"):
+                self._case_records[str(iCase)] = entry["case_record"]
+            self._restore_carry(entry["carry"])
+        self._resumed_cases.append(int(iCase))
+        obs.counter(
+            "raft_tpu_cases_resumed_total",
+            "load cases restored from the per-case journal instead of "
+            "re-solved").inc(1.0)
+        _LOG.info("case %d restored from journal (resume)", iCase)
+
+    def _array_tension_stats(self, iCase) -> dict:
+        """Array-level mooring tension statistics through the coupled
+        tension Jacobian (reference: raft_model.py:345-388), degraded to
+        NaN-filled channels when the Jacobian is singular/non-finite —
+        a bad tension linearization must not take down the case loop."""
+        from raft_tpu.models import mooring_array as ma
+        dw = self.w[1] - self.w[0]
+        nT = 2 * len(self.arr_ms.iA)
+        Xb = np.stack([self._state[i]["r6"]
+                       for i in range(self.nFOWT)])
+        xf = self._arr_xf
+        try:
+            J = np.asarray(ma.tension_jacobian(self.arr_ms, Xb, xf))
+            T0 = np.asarray(ma.tensions(self.arr_ms, Xb, xf))
+            if not (np.all(np.isfinite(J)) and np.all(np.isfinite(T0))):
+                raise errors.MooringSingular(
+                    "array tension Jacobian/tensions non-finite",
+                    case=iCase)
+            T_amps = np.einsum("tj,hjw->htw", J, self.Xi)
+            nT = len(T0)
+            TRMS = np.array([float(get_rms(T_amps[:, iT, :]))
+                             for iT in range(nT)])
+            return {
+                "Tmoor_avg": T0,
+                "Tmoor_std": TRMS,
+                "Tmoor_max": T0 + 3 * TRMS,
+                "Tmoor_min": T0 - 3 * TRMS,
+                "Tmoor_PSD": np.stack(
+                    [np.asarray(get_psd(T_amps[:, iT, :], dw,
+                                        source_axis=0))
+                     for iT in range(nT)]),
+            }
+        except (errors.MooringSingular, np.linalg.LinAlgError,
+                FloatingPointError) as e:
+            _LOG.warning(
+                "case %d: array mooring tension statistics degraded to "
+                "NaN (%s) — singular/non-finite tension Jacobian", iCase, e)
+            obs.counter(
+                "raft_tpu_tension_stats_degraded_total",
+                "array tension-statistics blocks degraded to NaN "
+                "channels by a singular tension Jacobian").inc(1.0)
+            nan_t = np.full(nT, np.nan)
+            return {
+                "Tmoor_avg": nan_t, "Tmoor_std": nan_t.copy(),
+                "Tmoor_max": nan_t.copy(), "Tmoor_min": nan_t.copy(),
+                "Tmoor_PSD": np.full((nT, self.nw), np.nan),
+            }
 
     # ------------------------------------------------------------------
     # outputs

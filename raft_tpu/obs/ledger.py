@@ -211,6 +211,15 @@ def ledger_from_model(model, run_id: str = None) -> dict:
     records = getattr(model, "_case_records", {})
     for iCase in sorted(model.results.get("case_metrics", {})):
         per_case = model.results["case_metrics"][iCase]
+        if "failed" in per_case:
+            # quarantined case: a structured failure entry stands in for
+            # the physics digests (the full record also rides in
+            # ledger["extra"]["failed_cases"])
+            frec = per_case["failed"]
+            add_entry(led, f"case{iCase}/failed", {
+                k: v for k, v in sorted(frec.items())
+                if isinstance(v, (bool, int, float, str))})
+            continue
         rec = records.get(str(iCase), {})
         for ifowt in sorted(k for k in per_case if isinstance(k, int)):
             m = per_case[ifowt]

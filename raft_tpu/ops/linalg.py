@@ -188,6 +188,12 @@ def impedance_solve(w, M, B, C, F):
     written to HBM), otherwise the pre-existing assemble-then-
     ``solve_complex`` path, kept bitwise identical to the inline
     assembly the sweep/variant/model callers used to carry."""
+    # fault-injection seam (trace time): raise@kernel makes this
+    # dispatch fail as a typed KernelFailure so the degradation ladder
+    # (Pallas -> jnp -> host) is testable on CPU without breaking a
+    # real kernel.  Ambient case context is pushed by the case loop.
+    from raft_tpu.testing import faults
+    faults.maybe_raise("kernel")
     w = jnp.asarray(w)
     M = jnp.asarray(M)
     B = jnp.asarray(B)

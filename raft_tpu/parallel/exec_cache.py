@@ -35,7 +35,7 @@ import numpy as np
 from raft_tpu.obs.ledger import digest_metrics
 
 _LOCK = threading.Lock()
-_STATS = {"hits": 0, "misses": 0, "stores": 0, "errors": 0}
+_STATS = {"hits": 0, "misses": 0, "stores": 0, "errors": 0, "corrupts": 0}
 
 
 def enabled() -> bool:
@@ -156,10 +156,30 @@ def _paths(key: str) -> tuple[str, str]:
     return os.path.join(d, key + ".bin"), os.path.join(d, key + ".json")
 
 
+def _purge(key: str):
+    """Delete a corrupt entry's artifact pair (never raises)."""
+    for path in _paths(key):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
 def load(key: str):
-    """Deserialize the cached executable for ``key``; None on miss or on
-    any deserialization error (counted separately)."""
+    """Deserialize the cached executable for ``key``; None on miss.
+
+    Entries are validated BEFORE deserialization against the size and
+    content digest recorded in the meta sidecar at store time — a
+    truncated/bit-rotted entry is deleted and counted as ``corrupt``
+    (one more miss next time, never a runtime error at ``exe.call``).
+    Deserialization failures of a digest-valid entry (e.g. a jax
+    version change that slipped past the key) still count as ``error``
+    and also purge the entry."""
+    import hashlib
+
     from jax import export as jexport
+
+    from raft_tpu.testing import faults
 
     bin_path, _ = _paths(key)
     try:
@@ -168,10 +188,21 @@ def load(key: str):
     except OSError:
         _count("miss")
         return None
+    data = faults.corrupt_bytes("exec_cache", data)
+    meta = load_meta(key) or {}
+    want_bytes = meta.get("bytes")
+    want_digest = meta.get("sha256")
+    if ((want_bytes is not None and want_bytes != len(data))
+            or (want_digest is not None
+                and want_digest != hashlib.sha256(data).hexdigest())):
+        _count("corrupt")
+        _purge(key)
+        return None
     try:
         exe = jexport.deserialize(bytearray(data))
     except Exception:
         _count("error")
+        _purge(key)
         return None
     _count("hit")
     return exe
@@ -186,7 +217,12 @@ def store(fn_jitted, args, key: str, meta: dict = None) -> str | None:
     lowered for compilation; jax's internal jaxpr/lowering caches
     absorb most of that (measured ~1.4 s store vs ~4 s first lower on
     the coarse OC3 sweep), and it only runs on the miss path, inside
-    the caller's ``*_cache_store`` span where it stays visible."""
+    the caller's ``*_cache_store`` span where it stays visible.
+
+    The meta sidecar records the payload size and sha256 so ``load``
+    can reject a truncated/corrupt entry before deserializing it."""
+    import hashlib
+
     from jax import export as jexport
 
     bin_path, meta_path = _paths(key)
@@ -198,7 +234,8 @@ def store(fn_jitted, args, key: str, meta: dict = None) -> str | None:
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, bin_path)
-        doc = {"key": key, "bytes": len(data), **(meta or {})}
+        doc = {"key": key, "bytes": len(data),
+               "sha256": hashlib.sha256(data).hexdigest(), **(meta or {})}
         tmp = meta_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1, default=str)

@@ -29,11 +29,23 @@ from raft_tpu.models.fowt import (
     fowt_hydro_linearization_pre, fowt_drag_excitation,
     fowt_bem_excitation,
 )
+from raft_tpu import errors
 from raft_tpu.ops.linalg import impedance_solve
 from raft_tpu.ops.spectra import jonswap, get_rms
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("sweep")
+
+#: failure types a cached-executable call can legitimately raise
+#: (deserialization drift, XLA runtime errors incl. jaxlib's
+#: XlaRuntimeError — a RuntimeError subclass — and truncated payloads);
+#: anything outside this tuple is a bug and propagates
+_CACHED_CALL_ERRORS = (RuntimeError, ValueError, TypeError, KeyError,
+                       OSError)
 
 
-def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2):
+def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2,
+                         relax: float = 0.8):
     """Shared drag-linearization fixed point for the hand-batched sweep
     paths: nIter fully UNROLLED passes of ``step`` with per-item
     convergence freezing (0.2/0.8 under-relaxation, the reference's
@@ -56,8 +68,16 @@ def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2):
     per-item count of executed (non-frozen) passes — the solver-
     convergence series the sweep observability layer histograms — and
     ``chunks_run`` the number of chunks that actually executed (the
-    fixed-point trip count the run manifest records)."""
+    fixed-point trip count the run manifest records).
+
+    ``relax`` is the under-relaxation weight on the new iterate; the
+    default 0.8 reproduces the reference 0.2/0.8 scheme bitwise, and
+    the batch-quarantine ladder re-solves diverged lanes with stronger
+    damping (e.g. 0.5)."""
+    from raft_tpu.recovery import relax_weights
+
     chunk = int(chunk) if chunk else nIter
+    keep, relax = relax_weights(relax)
 
     def passes(count, carry):
         XiLast, Xi, done, iters, chunks_run = carry
@@ -67,7 +87,7 @@ def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2):
                            < tol, axis=(-2, -1))
             frozen = done[:, None, None]
             XiNext = jnp.where(frozen | conv[:, None, None], XiLast,
-                               0.2 * XiLast + 0.8 * Xin)
+                               keep * XiLast + relax * Xin)
             Xi = jnp.where(frozen, Xi, Xin)
             iters = iters + jnp.where(done, 0, 1)
             done = done | conv
@@ -87,7 +107,8 @@ def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2):
 
 
 def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
-                     XiStart: float = 0.1, r6=None, fp_chunk: int = 2):
+                     XiStart: float = 0.1, r6=None, fp_chunk: int = 2,
+                     relax: float = 0.8):
     """Pure per-case response solver (no aero; wave loading) suitable for
     jit/vmap.  Returns fn(Hs, Tp, beta_rad) -> dict(Xi (6,nw) complex,
     std (6,))."""
@@ -99,6 +120,8 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
             "excitation that Model.solveDynamics includes", stacklevel=2)
     if r6 is None:
         r6 = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+    from raft_tpu.recovery import relax_weights
+    _keep, _relax = relax_weights(relax)
     w = jnp.asarray(fowt.w)
     nw = len(fowt.w)
     dw = float(fowt.w[1] - fowt.w[0])
@@ -149,7 +172,8 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
             XiLast, Xi, ii, done = carry
             Xin = drag_step(st, XiLast)
             conv = jnp.all(jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol) < tol)
-            XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xin)
+            XiNext = jnp.where(conv, XiLast,
+                               _keep * XiLast + _relax * Xin)
             return (XiNext, Xin, ii + 1, done | conv)
 
         def cond(carry):
@@ -170,7 +194,7 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         Xi0 = jnp.zeros((nc, 6, nw), dtype=complex) + XiStart
         _, Xi, done, iters, chunks = unrolled_fixed_point(
             lambda XiLast: drag_step(st, XiLast), Xi0, nIter, tol,
-            chunk=fp_chunk)
+            chunk=fp_chunk, relax=relax)
         std = get_rms(Xi, axis=-1)
         return dict(Xi=Xi, std=std, converged=done, iters=iters,
                     fp_chunks=chunks)
@@ -179,8 +203,101 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
     return solve
 
 
+def _lane_finite(Xi):
+    """(ncases,) bool device array: lane has an all-finite response."""
+    return jnp.all(jnp.isfinite(Xi.real) & jnp.isfinite(Xi.imag),
+                   axis=(-2, -1))
+
+
+#: batch-quarantine ladder: same-config re-solve through the jnp path
+#: first (clears transient poisoning / kernel trouble at exact parity),
+#: then a damped restart (stronger under-relaxation, doubled iteration
+#: budget, chunk=1) for genuinely diverged drag fixed points
+_LANE_LADDER = (
+    ("re_solve", {}),
+    ("damped_restart", {"nIter_mult": 2, "fp_chunk": 1, "relax": 0.5}),
+)
+
+
+def _quarantine_lanes(fowt, Hs, Tp, beta, out, bad, kw, iters, conv_np):
+    """Re-solve only the offending lanes of a sweep batch down the
+    ladder, splicing recovered (finite) lanes back into ``out``; lanes
+    no rung can make finite stay NaN and are reported as quarantined.
+    Returns ``(out, iters, conv_np, info)``."""
+    from raft_tpu import _config, obs, recovery
+
+    info = {"lanes": [int(i) for i in bad], "ladder": [],
+            "recovered": [], "quarantined": []}
+    out = dict(out)
+    remaining = np.asarray(bad, int)
+    step_from = "batched"
+    for name, mods in _LANE_LADDER:
+        if remaining.size == 0:
+            break
+        kw2 = dict(kw)
+        if "nIter_mult" in mods:
+            kw2["nIter"] = int(kw.get("nIter", 10)) * mods["nIter_mult"]
+        if "fp_chunk" in mods:
+            kw2["fp_chunk"] = mods["fp_chunk"]
+        if "relax" in mods:
+            kw2["relax"] = mods["relax"]
+        prev_pallas = _config._pallas_override
+        _config.set_pallas_mode("0")
+        try:
+            with obs.span("sweep_quarantine_resolve", step=name,
+                          lanes=int(remaining.size)):
+                solver = make_case_solver(fowt, **kw2)
+                idx = jnp.asarray(remaining)
+                sub = jax.jit(solver.batched)(Hs[idx], Tp[idx], beta[idx])
+                # the one extra counted pull the quarantine path is
+                # allowed (docs/robustness.md budget note)
+                ok, sconv, siters = obs.transfers.device_get(
+                    (_lane_finite(sub["Xi"]), sub["converged"],
+                     sub["iters"]),
+                    what="quarantine_summary", phase="sweep")
+        finally:
+            _config._pallas_override = prev_pallas
+        ok = np.asarray(ok)
+        sconv = np.asarray(sconv)
+        saved = remaining[ok]           # finite result: splice it back
+        if saved.size:
+            gsel = jnp.asarray(np.flatnonzero(ok))
+            gidx = jnp.asarray(saved)
+            out["Xi"] = out["Xi"].at[gidx].set(sub["Xi"][gsel])
+            out["std"] = out["std"].at[gidx].set(sub["std"][gsel])
+            iters[saved] = np.asarray(siters)[ok]
+            conv_np[saved] = sconv[ok]
+            info["recovered"] = sorted(set(info["recovered"])
+                                       | set(int(i) for i in saved))
+        outcome = "recovered" if saved.size else "failed"
+        attempt = recovery.RecoveryAttempt(
+            phase="sweep", case=",".join(str(int(i)) for i in remaining),
+            step_from=step_from, step_to=name, outcome=outcome,
+            error="NonFiniteResult",
+            detail=f"{int(saved.size)}/{int(remaining.size)} lanes "
+                   "recovered")
+        recovery.record_attempt(attempt)
+        info["ladder"].append(attempt.to_dict())
+        step_from = name
+        # keep walking the ladder for lanes that are still non-finite
+        # or whose re-solve did not converge (the damped restart may
+        # still improve them)
+        remaining = remaining[~(ok & sconv)]
+    # the returned batch dict must agree with the spliced host copies —
+    # ledger_from_sweep digests out["converged"]/out["iters"] directly
+    out["converged"] = jnp.asarray(conv_np)
+    out["iters"] = jnp.asarray(iters)
+    info["quarantined"] = sorted(set(info["lanes"])
+                                 - set(info["recovered"]))
+    if info["quarantined"]:
+        _LOG.warning("sweep quarantine: lanes %s unrecoverable "
+                     "(left NaN)", info["quarantined"])
+    return out, iters, conv_np, info
+
+
 def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
-                axis_name: str = "cases", **kw):
+                axis_name: str = "cases", quarantine: str = "nonfinite",
+                **kw):
     """Solve a batch of cases, sharding the case axis over ``mesh``.
 
     Hs/Tp/beta: (ncases,) arrays.  Returns dict with batched outputs
@@ -264,7 +381,16 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                                   cached=True):
                         out = exe.call(Hs, Tp, beta)
                         jax.block_until_ready(out["std"])
-                except Exception as e:
+                except _CACHED_CALL_ERRORS as e:
+                    # expected executable-call failures only (shape/
+                    # dtype drift past the key, XLA runtime errors,
+                    # truncated payloads) — anything else is a bug and
+                    # propagates.  The outcome is logged, counted, and
+                    # recorded in the manifest's cache_info.
+                    _LOG.warning(
+                        "cached sweep executable %s failed (%s: %s) — "
+                        "recompiling", key, type(e).__name__, e)
+                    obs.record_exec_cache_event("call_error")
                     cache_info = {"state": "error", "key": key,
                                   "error": f"{type(e).__name__}: {e}"[:200]}
                     out = None
@@ -288,13 +414,62 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                                   "nw": len(fowt.w),
                                   "solver": _linalg.last_dispatch()})
                     cache_info["stored"] = stored is not None
+            # fault-injection seam: nan@sweep[:lane=K] poisons lanes so
+            # the quarantine detection below sees a corrupt-solve batch;
+            # raise@sweep fails the batch as a typed KernelFailure
+            # (fail-fast injection).  The per-lane matching only runs
+            # when a spec is active — the clean path costs one check.
+            from raft_tpu.testing import faults
+            if faults.any_active():
+                inject = []
+                for i in range(ncases):
+                    action = faults.fire("sweep", lane=i)
+                    if action == "raise":
+                        raise errors.KernelFailure(
+                            "injected sweep failure", injected=True,
+                            lane=i)
+                    if action == "nan":
+                        inject.append(i)
+                if inject:
+                    ij = jnp.asarray(inject)
+                    out = dict(out)
+                    out["Xi"] = out["Xi"].at[ij].set(jnp.nan)
+                    out["std"] = out["std"].at[ij].set(jnp.nan)
+                    out["converged"] = out["converged"].at[ij].set(False)
             # ONE sanctioned counted pull for the batch summary facts
-            # (the response stds stay on device until the ledger digest)
-            iters, conv_np, chunks_np = obs.transfers.device_get(
-                (out["iters"], out["converged"], out["fp_chunks"]),
+            # (the response stds stay on device until the ledger
+            # digest); the per-lane finite flags ride in the same pull
+            iters, conv_np, chunks_np, lane_ok = obs.transfers.device_get(
+                (out["iters"], out["converged"], out["fp_chunks"],
+                 _lane_finite(out["Xi"])),
                 what="sweep_summary", phase="sweep")
-            iters = np.asarray(iters)
-            n_conv = int(np.asarray(conv_np).sum())
+            iters = np.asarray(iters).copy()
+            conv_np = np.asarray(conv_np).copy()
+            # ----- batch quarantine: re-solve only the offending lanes
+            # through the ladder instead of poisoning/aborting the
+            # batch.  Default trigger is NON-FINITE lanes only — merely
+            # non-converged lanes are legitimate tolerance-drift outputs
+            # (reported via raft_sweep_converged_cases as before);
+            # quarantine="all" re-solves those too, "off" disables.
+            if quarantine == "all":
+                bad = np.flatnonzero(~np.asarray(lane_ok) | ~conv_np)
+            elif quarantine == "off":
+                bad = np.zeros(0, int)
+            else:
+                bad = np.flatnonzero(~np.asarray(lane_ok))
+            quarantine_info = None
+            if bad.size:
+                from raft_tpu import recovery
+                if recovery.enabled():
+                    out, iters, conv_np, quarantine_info = \
+                        _quarantine_lanes(fowt, Hs, Tp, beta, out,
+                                          bad, kw, iters, conv_np)
+                else:
+                    quarantine_info = {"lanes": [int(i) for i in bad],
+                                       "recovered": [], "ladder": [],
+                                       "quarantined": [int(i)
+                                                       for i in bad]}
+            n_conv = int(conv_np.sum())
             fp_chunks = int(chunks_np)
             sp.set(converged=n_conv, iters_max=int(iters.max(initial=0)),
                    fp_chunks=fp_chunks,
@@ -316,7 +491,16 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                 "drag fixed-point chunks actually executed by the "
                 "adaptive unroll (chunked early exit)",
                 ).set(fp_chunks)
+            # set every sweep (0 when clean) so a healthy batch clears
+            # the previous run's quarantine reading in a shared process
+            obs.gauge(
+                "raft_tpu_sweep_quarantined_lanes",
+                "sweep lanes the batch-quarantine ladder could not "
+                "recover (left NaN in the batch outputs)").set(float(
+                    len((quarantine_info or {}).get("quarantined", []))))
         manifest.extra["exec_cache"] = cache_info
+        if quarantine_info is not None:
+            manifest.extra["quarantine"] = quarantine_info
         # on a warm start nothing traced in-process, so last_dispatch()
         # is empty/stale — the meta sidecar stored next to the
         # executable carries the backend that was baked into it

@@ -1,0 +1,414 @@
+"""Degradation ladder + per-case resume journal for fault-tolerant runs.
+
+PRs 3–4 built the *manual* escape hatches — ``RAFT_TPU_PALLAS=0``,
+``RAFT_TPU_STATICS=host``, smaller ``fp_chunk`` — for when a solve path
+misbehaves.  This module composes them into an *automatic* recovery
+layer:
+
+- :func:`run_ladder` retries a failing phase down a configurable chain
+  of :class:`LadderStep`\\ s (each step applies a solver-config override
+  for the duration of the retry), recording every transition as a
+  :class:`RecoveryAttempt` (-> run manifest ``extra["recovery"]``) and
+  a ``raft_tpu_recovery_attempts_total{phase,from,to,outcome}`` metric.
+- The built-in ladders: ``statics`` degrades the device
+  ``lax.while_loop`` Newton to the host loop, then to a damped host
+  loop (step clip scaled down, see ``override("clip_scale")``);
+  ``dynamics`` degrades Pallas to the jnp ``impedance_solve``, then to
+  a damped fixed-point restart (stronger under-relaxation, doubled
+  iteration budget), then to an f64 re-solve when running f32.
+- :class:`CaseJournal` persists each completed case of
+  ``Model.analyzeCases`` (keyed by the exec-cache model content digest)
+  so ``analyzeCases(resume=True)`` skips already-completed cases after
+  a crash/preemption and re-runs only what is missing or failed.
+
+Knobs: ``RAFT_TPU_RECOVERY=0`` disables the ladder *and* the per-case
+quarantine (typed errors then propagate exactly as before this layer
+existed); ``RAFT_TPU_JOURNAL=0`` disables journaling;
+``RAFT_TPU_JOURNAL_DIR`` relocates the journal (default
+``~/.cache/raft_tpu/journal``).  See docs/robustness.md.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import pickle
+import threading
+
+import numpy as np
+
+from raft_tpu import _config, errors
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("recovery")
+
+
+def enabled() -> bool:
+    """Automatic recovery (ladder + quarantine) active?  Programmatic
+    override beats ``RAFT_TPU_RECOVERY``; default on."""
+    return _config.recovery_mode() != "0"
+
+
+def journal_enabled() -> bool:
+    return os.environ.get("RAFT_TPU_JOURNAL", "1").strip() != "0"
+
+
+def journal_dir() -> str:
+    return (os.environ.get("RAFT_TPU_JOURNAL_DIR")
+            or os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu",
+                            "journal"))
+
+
+# ---------------------------------------------------------------------------
+# solver-config overrides consulted by the retry targets
+# ---------------------------------------------------------------------------
+
+_OVR_LOCK = threading.Lock()
+_OVERRIDES: dict[str, float] = {}
+
+
+@contextlib.contextmanager
+def override(**kw):
+    """Apply ladder-step solver overrides for the duration of a retry
+    (``clip_scale``, ``fp_relax``, ``fp_iter_mult``).  The solve
+    implementations read them through :func:`current`."""
+    with _OVR_LOCK:
+        saved = dict(_OVERRIDES)
+        _OVERRIDES.update(kw)
+    try:
+        yield
+    finally:
+        with _OVR_LOCK:
+            _OVERRIDES.clear()
+            _OVERRIDES.update(saved)
+
+
+def current(name: str, default):
+    with _OVR_LOCK:
+        return _OVERRIDES.get(name, default)
+
+
+def relax_weights(relax) -> tuple[float, float]:
+    """(keep, relax) weights of the drag fixed-point under-relaxation
+    ``keep*XiLast + relax*Xin``.  The default 0.8 must keep the literal
+    0.2 complement — ``1.0 - 0.8`` is ``0.19999...96`` in float64 and
+    golden-ledger parity is bitwise — so the pair is derived here, once,
+    for every solve path (model drag loop, sweep unroll, sweep scalar
+    path)."""
+    relax = float(relax)
+    return (0.2 if relax == 0.8 else 1.0 - relax), relax
+
+
+# ---------------------------------------------------------------------------
+# attempts: the structured record + metric
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RecoveryAttempt:
+    """One ladder transition: phase failed under ``step_from``, was
+    retried under ``step_to``, with ``outcome`` recovered/failed."""
+
+    phase: str
+    case: str
+    step_from: str
+    step_to: str
+    outcome: str            # recovered | failed
+    error: str              # exception class name that triggered the step
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def record_attempt(attempt: RecoveryAttempt, recorder=None):
+    try:
+        from raft_tpu import obs
+        obs.counter(
+            "raft_tpu_recovery_attempts_total",
+            "degradation-ladder retries by phase, from/to step, and "
+            "outcome").inc(1.0, phase=attempt.phase,
+                           **{"from": attempt.step_from,
+                              "to": attempt.step_to},
+                           outcome=attempt.outcome)
+    except Exception:                                 # pragma: no cover
+        pass
+    if recorder is not None:
+        recorder(attempt)
+    log = _LOG.warning if attempt.outcome == "failed" else _LOG.info
+    log("recovery[%s case=%s]: %s -> %s (%s) after %s%s",
+        attempt.phase, attempt.case, attempt.step_from, attempt.step_to,
+        attempt.outcome, attempt.error,
+        f": {attempt.detail}" if attempt.detail else "")
+
+
+# ---------------------------------------------------------------------------
+# ladder steps and the engine
+# ---------------------------------------------------------------------------
+
+class SkipStep(Exception):
+    """Raised by a step's context factory when the step does not apply
+    in the current configuration (e.g. f64 re-solve while already f64)."""
+
+
+@dataclasses.dataclass
+class LadderStep:
+    name: str
+    ctx_factory: object      # () -> context manager (may raise SkipStep)
+
+
+@contextlib.contextmanager
+def _ctx_statics_host():
+    prev = _config._statics_override
+    _config.set_statics_mode("host")
+    try:
+        yield
+    finally:
+        _config._statics_override = prev
+
+
+@contextlib.contextmanager
+def _ctx_statics_damped():
+    prev = _config._statics_override
+    _config.set_statics_mode("host")
+    try:
+        with override(clip_scale=0.2):
+            yield
+    finally:
+        _config._statics_override = prev
+
+
+@contextlib.contextmanager
+def _ctx_jnp_solve():
+    prev = _config._pallas_override
+    _config.set_pallas_mode("0")
+    try:
+        yield
+    finally:
+        _config._pallas_override = prev
+
+
+@contextlib.contextmanager
+def _ctx_damped_restart():
+    # stronger under-relaxation + doubled iteration budget; the sweep
+    # lane ladder additionally shrinks fp_chunk, but it passes solver
+    # kwargs explicitly (parallel/sweep.py:_LANE_LADDER) rather than
+    # through these overrides
+    prev = _config._pallas_override
+    _config.set_pallas_mode("0")
+    try:
+        with override(fp_relax=0.5, fp_iter_mult=2):
+            yield
+    finally:
+        _config._pallas_override = prev
+
+
+def _ctx_f64_resolve():
+    import jax
+
+    if jax.config.jax_enable_x64:
+        raise SkipStep("already f64")
+
+    @contextlib.contextmanager
+    def ctx():
+        jax.config.update("jax_enable_x64", True)
+        try:
+            yield
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    return ctx()
+
+
+def statics_ladder() -> list[LadderStep]:
+    """configured -> host Newton -> damped host Newton."""
+    return [LadderStep("configured", contextlib.nullcontext),
+            LadderStep("host_statics", _ctx_statics_host),
+            LadderStep("host_statics_damped", _ctx_statics_damped)]
+
+
+def dynamics_ladder() -> list[LadderStep]:
+    """configured -> jnp impedance_solve -> damped fixed-point restart
+    -> f64 re-solve (skipped when already running f64).
+
+    The jnp rung deliberately runs even where Pallas is already
+    inactive (CPU auto): it then acts as the plain-retry rung that
+    clears *transient* failures (a one-shot kernel/XLA error) at exact
+    parity — skipping it would leave only the physics-changing damped
+    restart between a hiccup and quarantine."""
+    return [LadderStep("configured", contextlib.nullcontext),
+            LadderStep("jnp_solve", _ctx_jnp_solve),
+            LadderStep("damped_restart", _ctx_damped_restart),
+            LadderStep("f64_resolve", _ctx_f64_resolve)]
+
+
+def run_ladder(phase: str, case: str, fn, steps: list[LadderStep],
+               recoverable=errors.RECOVERABLE, recorder=None):
+    """Run ``fn`` down ``steps`` until one succeeds.
+
+    The first step is the as-configured attempt.  A recoverable typed
+    failure moves to the next applicable step; every transition is
+    recorded (metric + ``recorder`` callback).  Exhausting the ladder
+    re-raises the *last* failure — the caller (per-case quarantine)
+    decides what an unrecoverable case means.  With recovery disabled
+    the baseline attempt runs bare.
+    """
+    if not enabled():
+        return fn()
+    last_err = None
+    failed_step = None
+    for step in steps:
+        try:
+            ctx = step.ctx_factory()
+        except SkipStep:
+            continue
+        try:
+            with ctx:
+                result = fn()
+        except recoverable as e:
+            if last_err is not None:
+                record_attempt(RecoveryAttempt(
+                    phase=phase, case=str(case),
+                    step_from=failed_step, step_to=step.name,
+                    outcome="failed", error=type(last_err).__name__,
+                    detail=str(e)[:200]), recorder)
+            last_err, failed_step = e, step.name
+            continue
+        if last_err is not None:
+            record_attempt(RecoveryAttempt(
+                phase=phase, case=str(case), step_from=failed_step,
+                step_to=step.name, outcome="recovered",
+                error=type(last_err).__name__), recorder)
+        return result
+    assert last_err is not None
+    raise last_err
+
+
+# ---------------------------------------------------------------------------
+# per-case resume journal
+# ---------------------------------------------------------------------------
+
+class CaseJournal:
+    """Per-case completion journal for ``Model.analyzeCases``.
+
+    One pickle per completed case under
+    ``<journal_dir>/<model-digest>/case<N>.pkl`` holding the case's
+    result metrics, its mean offset, the ledger solver record, and the
+    cross-case carry state (the stale-heading quirk, array free
+    points) so a resumed run reproduces a continuous run bit-for-bit.
+    The digest covers the FOWT models, the case table, and the
+    frequency grid — any model edit starts a fresh journal directory.
+    """
+
+    def __init__(self, key: str, base_dir: str = None):
+        self.key = key
+        self.dir = os.path.join(base_dir or journal_dir(), key)
+
+    @classmethod
+    def for_model(cls, model, base_dir: str = None) -> "CaseJournal":
+        from raft_tpu.parallel import exec_cache
+
+        import jax
+
+        # solver settings belong in the key: restoring a case computed
+        # under different nIter/XiStart/statics backend/precision would
+        # silently mix physics in one "resumed" result set
+        digest = exec_cache.model_digest({
+            "fowts": model.fowtList,
+            "cases": model.design.get("cases"),
+            "w": np.asarray(model.w),
+            "nFOWT": model.nFOWT,
+            "mooring_currentMod": model.mooring_currentMod,
+            "nIter": model.nIter,
+            "XiStart": model.XiStart,
+            "statics_mode": _config.statics_mode(),
+            "pallas_mode": _config.pallas_mode(),
+            "x64": bool(jax.config.jax_enable_x64),
+        })
+        j = cls(digest.removeprefix("sha256:")[:32], base_dir=base_dir)
+        prune_journals(base_dir or journal_dir(), keep=j.key)
+        return j
+
+    def _path(self, iCase: int) -> str:
+        return os.path.join(self.dir, f"case{int(iCase)}.pkl")
+
+    def load_case(self, iCase: int) -> dict | None:
+        """The journaled record of a completed case, or None (missing
+        or unreadable — an unreadable entry is deleted and treated as
+        a miss, like a corrupt executable-cache entry)."""
+        path = self._path(iCase)
+        try:
+            with open(path, "rb") as f:
+                doc = pickle.load(f)
+        except OSError:
+            return None
+        except Exception:
+            _LOG.warning("journal: corrupt entry %s — deleting", path)
+            with contextlib.suppress(OSError):
+                os.remove(path)
+            return None
+        if not isinstance(doc, dict) or doc.get("iCase") != int(iCase):
+            return None
+        return doc
+
+    def store_case(self, iCase: int, record: dict):
+        """Atomically persist one completed case (never raises — a
+        read-only filesystem must not fail the run)."""
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            path = self._path(iCase)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump({"iCase": int(iCase), **record}, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        except Exception as e:                        # pragma: no cover
+            _LOG.warning("journal: could not store case %d: %s", iCase, e)
+
+    def completed(self) -> list[int]:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if n.startswith("case") and n.endswith(".pkl"):
+                with contextlib.suppress(ValueError):
+                    out.append(int(n[4:-4]))
+        return sorted(out)
+
+    def clear(self):
+        for i in self.completed():
+            with contextlib.suppress(OSError):
+                os.remove(self._path(i))
+
+
+def journal_max_models() -> int:
+    """Retention bound on per-model journal directories (newest-kept;
+    ``RAFT_TPU_JOURNAL_MAX_MODELS``, default 16, 0 = unbounded)."""
+    try:
+        return int(os.environ.get("RAFT_TPU_JOURNAL_MAX_MODELS", "16"))
+    except ValueError:
+        return 16
+
+
+def prune_journals(base_dir: str, keep: str = None):
+    """Delete the oldest per-model journal directories so at most
+    ``journal_max_models()`` remain — every model/case-table edit keys
+    a fresh digest directory, and without retention a long-lived host
+    accumulates stale pickle trees forever.  ``keep`` (the digest being
+    opened) is never pruned.  Runs on journal open; never raises."""
+    bound = journal_max_models()
+    if bound <= 0:
+        return
+    try:
+        entries = [(e.path, e.stat().st_mtime) for e in os.scandir(base_dir)
+                   if e.is_dir() and e.name != keep]
+    except OSError:
+        return
+    for path, _ in sorted(entries, key=lambda t: t[1])[:max(
+            0, len(entries) + 1 - bound)]:
+        with contextlib.suppress(OSError):
+            for name in os.listdir(path):
+                with contextlib.suppress(OSError):
+                    os.remove(os.path.join(path, name))
+            os.rmdir(path)

@@ -1,0 +1,218 @@
+"""Deterministic fault injection at the sanctioned solver seams.
+
+The recovery layer (error taxonomy, degradation ladder, per-case
+quarantine, batch quarantine, resume) is only trustworthy if every one
+of its paths can be *driven* on CPU in CI.  This module turns the
+``RAFT_TPU_FAULTS`` environment variable (or a programmatic
+:func:`install`) into deterministic failures at a small set of seams
+the solver code exposes explicitly:
+
+========  ==========================================================
+site      seam
+========  ==========================================================
+statics   ``Model._solve_statics_impl`` after the Newton solve
+dynamics  ``Model._fowt_linearize`` after the drag fixed point
+kernel    ``ops.linalg.impedance_solve`` dispatch (trace time)
+sweep     ``parallel.sweep.sweep_cases`` after the batched solve
+exec_cache  ``parallel.exec_cache.load`` on the deserialized bytes
+========  ==========================================================
+
+Spec grammar (comma-separated specs)::
+
+    RAFT_TPU_FAULTS="<action>@<site>[:qualifier]*[,...]"
+
+    action     nan | raise | corrupt
+    qualifier  case=N | lane=N | fowt=N | once | times=K
+
+Examples: ``nan@dynamics:case=2`` poisons case 2's converged impedance
+with NaN (exercising the non-finite sanitizer and the ladder);
+``raise@statics:case=0:once`` raises a ``StaticsDivergence`` exactly
+once (the ladder's first retry then succeeds); ``corrupt@exec_cache``
+truncates every cache entry read (exercising delete-and-miss).
+
+Everything is spec-driven — no randomness — so an injected run is
+exactly reproducible.  Matching context comes from the explicit
+keyword arguments at the seam plus the ambient :func:`context` stack
+(``Model`` pushes ``case=...`` around each case so the trace-time
+kernel seam can match per-case specs).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+from raft_tpu import errors
+
+_LOCK = threading.Lock()
+#: programmatic override (None -> parse the env var per call)
+_OVERRIDE: list | None = None
+#: fire counts keyed by spec identity, shared env/override
+_FIRED: dict[tuple, int] = {}
+#: ambient matching context (case/fowt/lane) — host-single-threaded
+_CONTEXT: list[dict] = []
+
+_ACTIONS = ("nan", "raise", "corrupt")
+_SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache")
+
+#: exception class raised per site for ``raise@<site>`` specs.  Site/
+#: action support: statics, dynamics, kernel take ``nan`` and ``raise``;
+#: sweep takes ``nan`` (lane poisoning) and ``raise`` (fails the batch
+#: as a KernelFailure, handled at the seam itself); exec_cache takes
+#: ``corrupt`` only — its load path must never raise, so a
+#: ``raise@exec_cache`` spec is rejected at parse time.
+_RAISES = {
+    "statics": errors.StaticsDivergence,
+    "dynamics": errors.DynamicsSingular,
+    "kernel": errors.KernelFailure,
+    "sweep": errors.KernelFailure,
+}
+
+#: (action, site) combinations with no seam behavior — dropped at parse
+#: time so a spec can never silently no-op while consuming fire budget
+_UNSUPPORTED = {("raise", "exec_cache"), ("corrupt", "statics"),
+                ("corrupt", "dynamics"), ("corrupt", "kernel"),
+                ("corrupt", "sweep"), ("nan", "exec_cache"),
+                ("nan", "kernel")}
+
+
+def _parse_one(spec: str) -> dict | None:
+    head, _, quals = spec.strip().partition(":")
+    action, _, site = head.partition("@")
+    action = action.strip().lower()
+    site = site.strip().lower()
+    if action not in _ACTIONS or site not in _SITES \
+            or (action, site) in _UNSUPPORTED:
+        return None
+    fault = {"action": action, "site": site, "match": {}, "times": None,
+             "spec": spec.strip()}
+    for q in filter(None, (s.strip() for s in quals.split(":"))):
+        if q == "once":
+            fault["times"] = 1
+        elif q.startswith("times="):
+            try:
+                fault["times"] = int(q[6:])
+            except ValueError:
+                return None          # malformed spec: drop, never crash
+        elif "=" in q:
+            k, v = q.split("=", 1)
+            try:
+                fault["match"][k.strip()] = int(v)
+            except ValueError:
+                fault["match"][k.strip()] = v.strip()
+    return fault
+
+
+def parse(spec: str) -> list[dict]:
+    """Parse a ``RAFT_TPU_FAULTS`` value; malformed specs are dropped
+    (fault injection must never take down a production run)."""
+    return [f for f in (_parse_one(s) for s in spec.split(",") if s.strip())
+            if f is not None]
+
+
+def install(spec: str | None):
+    """Programmatically set the active fault specs (None returns
+    control to the environment variable) and reset fire counts."""
+    global _OVERRIDE
+    with _LOCK:
+        _OVERRIDE = None if spec is None else parse(spec)
+        _FIRED.clear()
+
+
+def clear():
+    """Remove all programmatic faults and forget fire counts."""
+    install(None)
+
+
+#: parse cache for the env path keyed by the raw spec string (the
+#: programmatic path caches in _OVERRIDE) — fire() runs per sweep lane
+#: and per kernel trace, so re-parsing per call is pure waste
+_ENV_CACHE: tuple[str, list] = ("", [])
+
+
+def _active() -> list[dict]:
+    global _ENV_CACHE
+    with _LOCK:
+        if _OVERRIDE is not None:
+            return list(_OVERRIDE)
+        env = os.environ.get("RAFT_TPU_FAULTS", "").strip()
+        if env != _ENV_CACHE[0]:
+            _ENV_CACHE = (env, parse(env) if env else [])
+        return list(_ENV_CACHE[1])
+
+
+def any_active() -> bool:
+    """Cheap guard for hot-path seams that would otherwise call
+    :func:`fire` in a loop (one env lookup, no matching)."""
+    return bool(_active())
+
+
+@contextlib.contextmanager
+def context(**ctx):
+    """Push ambient matching facts (``case=...``) for seams that cannot
+    receive them as arguments (the trace-time kernel dispatch)."""
+    _CONTEXT.append({k: v for k, v in ctx.items() if v is not None})
+    try:
+        yield
+    finally:
+        _CONTEXT.pop()
+
+
+def _ambient() -> dict:
+    out = {}
+    for frame in _CONTEXT:
+        out.update(frame)
+    return out
+
+
+def fire(site: str, **ctx) -> str | None:
+    """Return the action of the first active fault matching ``site`` and
+    the (explicit + ambient) context, honoring ``once``/``times=``;
+    None when nothing matches.  The caller applies the action."""
+    faults = _active()
+    if not faults:
+        return None
+    facts = _ambient()
+    facts.update({k: v for k, v in ctx.items() if v is not None})
+    for f in faults:
+        if f["site"] != site:
+            continue
+        if any(facts.get(k) != v for k, v in f["match"].items()):
+            continue
+        key = (f["spec"],)
+        with _LOCK:
+            n = _FIRED.get(key, 0)
+            if f["times"] is not None and n >= f["times"]:
+                continue
+            _FIRED[key] = n + 1
+        return f["action"]
+    return None
+
+
+def maybe_raise(site: str, **ctx):
+    """Raise the site's mapped typed exception when a ``raise@<site>``
+    fault matches; also returns the action for non-raise matches so a
+    seam can handle ``nan`` itself."""
+    action = fire(site, **ctx)
+    if action == "raise":
+        cls = _RAISES.get(site, errors.FaultInjected)
+        raise cls(f"injected fault at {site}", injected=True,
+                  **_clean_ctx(ctx))
+    return action
+
+
+def _clean_ctx(ctx: dict) -> dict:
+    merged = _ambient()
+    merged.update({k: v for k, v in ctx.items() if v is not None})
+    return merged
+
+
+def corrupt_bytes(site: str, data: bytes, **ctx) -> bytes:
+    """Deterministically damage ``data`` when a ``corrupt@<site>`` fault
+    matches (truncate + flip the first byte); unchanged otherwise."""
+    if fire(site, **ctx) == "corrupt":
+        if not data:
+            return b"\x00"
+        head = bytes([data[0] ^ 0xFF])
+        return head + data[1: max(1, len(data) - 16)]
+    return data

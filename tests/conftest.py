@@ -29,16 +29,27 @@ REFERENCE_DIR = "/root/reference"
 
 
 @pytest.fixture(autouse=True)
-def _obs_isolation(monkeypatch):
+def _obs_isolation(monkeypatch, tmp_path):
     """Observability state is process-global (span buffer, metrics
     registry, jit-cache baselines, output dir) — reset ALL of it around
     every test so no test can leak spans/metrics/artifacts into another.
     Module-scoped fixtures that run instrumented pipelines must capture
-    whatever obs state they assert on at fixture time."""
+    whatever obs state they assert on at fixture time.
+
+    The per-case resume journal and fault-injection knobs are likewise
+    isolated: the journal writes under this test's tmp dir (never the
+    user's ~/.cache) and no ambient fault spec leaks in or out."""
+    from raft_tpu.testing import faults
+
     monkeypatch.delenv("RAFT_TPU_OBS_DIR", raising=False)
     monkeypatch.delenv("RAFT_TPU_OBS_MAX_RUNS", raising=False)
+    monkeypatch.delenv("RAFT_TPU_FAULTS", raising=False)
+    monkeypatch.delenv("RAFT_TPU_RECOVERY", raising=False)
+    monkeypatch.setenv("RAFT_TPU_JOURNAL_DIR", str(tmp_path / "journal"))
+    faults.clear()
     obs.reset_all()
     yield
+    faults.clear()
     obs.reset_all()
 
 

@@ -1,0 +1,657 @@
+"""Fault-tolerant case execution: taxonomy, ladder, quarantine, resume.
+
+Cheap unit tests cover the fault-spec grammar, the typed-error
+taxonomy's back-compat contracts, the ladder engine, the executable
+cache's corrupt-entry delete-and-miss, and the journal round trip.
+
+The module-scoped ``cyl_runs`` fixture drives the full machinery through
+one coarse Vertical_cylinder model (the cheapest vendored design):
+
+- clean 3-case run (the parity baseline),
+- fault-injected run (``nan@dynamics:case=1`` persistent -> ladder
+  exhausted -> case 1 quarantined, cases 0/2 complete),
+- ``resume=True`` run against the faulted run's journal (cases 0/2
+  restored without re-solving, case 1 re-run clean),
+- ``raise@kernel:case=0:once`` single-case run (ladder fires
+  configured -> jnp_solve and recovers at exact parity).
+
+The ISSUE acceptance scenario on the 3-case OC3 spar runs the same
+assertions end-to-end in the slow tier
+(``test_oc3_three_case_acceptance``).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import _config, errors, obs, recovery
+from raft_tpu.io.designs import load_design
+from raft_tpu.model import Model
+from raft_tpu.testing import faults
+
+NW_SETTINGS = {"min_freq": 0.05, "max_freq": 0.5}
+
+
+def _cyl_design(ncases=3):
+    design = load_design("Vertical_cylinder")
+    design.setdefault("settings", {})
+    design["settings"].update(NW_SETTINGS)
+    row0 = list(design["cases"]["data"][0])
+    ih = design["cases"]["keys"].index("wave_height")
+    rows = []
+    for i in range(ncases):
+        row = list(row0)
+        row[ih] = 1.0 + 0.5 * i
+        rows.append(row)
+    design["cases"]["data"] = rows
+    return design
+
+
+def _digests(ledger):
+    return {e["key"]: e["digest"] for e in ledger["entries"]}
+
+
+def _entry(ledger, key):
+    return next(e for e in ledger["entries"] if e["key"] == key)
+
+
+# ---------------------------------------------------------------------------
+# unit: fault-spec grammar
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing():
+    specs = faults.parse(
+        "nan@dynamics:case=2,raise@statics:case=0:once,"
+        "corrupt@exec_cache,raise@kernel:times=3,bogus@nowhere,garbage")
+    assert [f["action"] for f in specs] == ["nan", "raise", "corrupt",
+                                           "raise"]
+    assert specs[0]["match"] == {"case": 2} and specs[0]["times"] is None
+    assert specs[1]["times"] == 1
+    assert specs[3]["times"] == 3
+    # malformed qualifiers and unsupported action/site combinations are
+    # dropped, never raised — injection must not take down a run
+    assert faults.parse("nan@dynamics:times=2x") == []
+    assert faults.parse("raise@exec_cache") == []
+    assert faults.parse("nan@kernel") == []
+
+
+def test_fault_fire_matching_and_exhaustion():
+    faults.install("raise@statics:case=0:once,nan@dynamics:case=2")
+    try:
+        assert faults.fire("statics", case=1) is None
+        assert faults.fire("dynamics", case=2) == "nan"
+        assert faults.fire("dynamics", case=2) == "nan"   # unlimited
+        with pytest.raises(errors.StaticsDivergence) as exc:
+            faults.maybe_raise("statics", case=0)
+        assert exc.value.injected
+        assert faults.fire("statics", case=0) is None     # once: spent
+        # ambient context reaches sites that can't pass kwargs
+        faults.install("raise@kernel:case=5")
+        with faults.context(case=5):
+            assert faults.fire("kernel") == "raise"
+        assert faults.fire("kernel") is None
+    finally:
+        faults.clear()
+
+
+def test_corrupt_bytes_deterministic():
+    faults.install("corrupt@exec_cache")
+    try:
+        data = b"x" * 64
+        c1 = faults.corrupt_bytes("exec_cache", data)
+        faults.install("corrupt@exec_cache")
+        c2 = faults.corrupt_bytes("exec_cache", data)
+        assert c1 == c2 != data
+        faults.clear()
+        assert faults.corrupt_bytes("exec_cache", data) == data
+    finally:
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# unit: taxonomy back-compat and structured context
+# ---------------------------------------------------------------------------
+
+def test_error_taxonomy_compat():
+    e = errors.NonFiniteResult("bad", case=3, n_bad=7)
+    assert isinstance(e, FloatingPointError)    # old solveDynamics raise
+    assert isinstance(e, ValueError)            # old io.wamit raise
+    ctx = e.context()
+    assert ctx["error"] == "NonFiniteResult" and ctx["case"] == 3
+    assert isinstance(errors.StaticsDivergence("x"), RuntimeError)
+    assert isinstance(errors.ModelConfigError("x"), ValueError)
+    assert all(issubclass(c, errors.RaftError)
+               for c in errors.RECOVERABLE)
+    assert errors.CacheCorruption not in errors.RECOVERABLE
+
+
+def test_wamit_screen_raises_typed(tmp_path):
+    from raft_tpu.io.wamit import read_wamit1
+
+    p = tmp_path / "bad.1"
+    p.write_text("10.0 1 1 0.5\n5.0 1 1 nan\n")
+    with pytest.raises(errors.NonFiniteResult, match="non-finite"):
+        read_wamit1(str(p))
+
+
+# ---------------------------------------------------------------------------
+# unit: ladder engine
+# ---------------------------------------------------------------------------
+
+def test_run_ladder_walks_and_records():
+    calls = []
+    attempts = []
+
+    def fn():
+        calls.append(_config.statics_mode())
+        if len(calls) < 3:
+            raise errors.StaticsDivergence("nope", case=0)
+        return "ok"
+
+    out = recovery.run_ladder("statics", "0", fn,
+                              recovery.statics_ladder(),
+                              recorder=attempts.append)
+    assert out == "ok"
+    # attempt 1 device, attempt 2 host, attempt 3 damped host succeeded
+    assert calls == ["device", "host", "host"]
+    assert [(a.step_from, a.step_to, a.outcome) for a in attempts] == [
+        ("configured", "host_statics", "failed"),
+        ("host_statics", "host_statics_damped", "recovered")]
+    snap = obs.snapshot()
+    series = snap["raft_tpu_recovery_attempts_total"]["series"]
+    assert any(s["labels"]["outcome"] == "recovered" for s in series)
+    # the damped rung exposed its clip override only inside the retry
+    assert recovery.current("clip_scale", 1.0) == 1.0
+
+
+def test_run_ladder_exhaustion_reraises():
+    def fn():
+        raise errors.NonFiniteResult("always")
+
+    with pytest.raises(errors.NonFiniteResult):
+        recovery.run_ladder("dynamics", "0", fn,
+                            recovery.dynamics_ladder())
+
+
+def test_run_ladder_disabled_is_bare():
+    _config.set_recovery_mode("0")
+    try:
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise errors.NonFiniteResult("x")
+
+        with pytest.raises(errors.NonFiniteResult):
+            recovery.run_ladder("dynamics", "0", fn,
+                                recovery.dynamics_ladder())
+        assert calls == [1]          # no retries with recovery off
+    finally:
+        _config.set_recovery_mode(None)
+
+
+# ---------------------------------------------------------------------------
+# unit: exec-cache corrupt entry -> delete-and-miss
+# ---------------------------------------------------------------------------
+
+def test_exec_cache_corrupt_entry_is_miss(tmp_path, monkeypatch):
+    from raft_tpu.parallel import exec_cache
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", "1")
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    fn = jax.jit(lambda x: x * 2.0)
+    args = (jnp.arange(4.0),)
+    key = exec_cache.make_key(fn="unit", model="sha256:t", nw=4)
+    assert exec_cache.store(fn, args, key) is not None
+    meta = exec_cache.load_meta(key)
+    assert meta["bytes"] > 0 and len(meta["sha256"]) == 64
+    assert exec_cache.load(key) is not None           # intact -> hit
+
+    bin_path = os.path.join(str(tmp_path), key + ".bin")
+    with open(bin_path, "r+b") as f:
+        f.truncate(max(1, meta["bytes"] // 2))        # bit-rot
+    assert exec_cache.load(key) is None               # corrupt -> miss
+    assert exec_cache.stats()["corrupts"] == 1
+    assert not os.path.exists(bin_path)               # purged
+    assert exec_cache.load(key) is None               # plain miss now
+    snap = obs.snapshot()
+    events = {s["labels"]["event"]: s["value"]
+              for s in snap["raft_exec_cache_events_total"]["series"]}
+    assert events.get("corrupt") == 1
+
+
+def test_exec_cache_injected_corruption(tmp_path, monkeypatch):
+    from raft_tpu.parallel import exec_cache
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", "1")
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    fn = jax.jit(lambda x: x + 1.0)
+    key = exec_cache.make_key(fn="unit2", model="sha256:t", nw=4)
+    assert exec_cache.store(fn, (jnp.arange(4.0),), key) is not None
+    faults.install("corrupt@exec_cache:once")
+    try:
+        assert exec_cache.load(key) is None
+        assert exec_cache.stats()["corrupts"] == 1
+    finally:
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# unit: journal round trip
+# ---------------------------------------------------------------------------
+
+def test_journal_retention_prunes_old_models(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_JOURNAL_MAX_MODELS", "2")
+    base = str(tmp_path)
+    for i, key in enumerate(("aaa", "bbb", "ccc")):
+        j = recovery.CaseJournal(key, base_dir=base)
+        j.store_case(0, {"case_metrics": {}, "mean_offset": np.zeros(6)})
+        os.utime(j.dir, (i + 1, i + 1))      # deterministic age order
+    # opening a NEW digest ("ddd") reserves its slot: of the 3 existing
+    # dirs only the newest survives next to it
+    recovery.prune_journals(base, keep="ddd")
+    assert sorted(os.listdir(base)) == ["ccc"]
+    j = recovery.CaseJournal("bbb", base_dir=base)
+    j.store_case(0, {"case_metrics": {}, "mean_offset": np.zeros(6)})
+    # re-opening an EXISTING digest prunes nothing while within bounds,
+    # and the opened digest itself is never a pruning candidate
+    recovery.prune_journals(base, keep="ccc")
+    assert sorted(os.listdir(base)) == ["bbb", "ccc"]
+
+
+def test_journal_roundtrip(tmp_path):
+    j = recovery.CaseJournal("unitkey", base_dir=str(tmp_path))
+    assert j.completed() == [] and j.load_case(0) is None
+    j.store_case(0, {"case_metrics": {0: {"surge_std": 1.25}},
+                     "mean_offset": np.arange(6.0)})
+    j.store_case(2, {"case_metrics": {}, "mean_offset": np.zeros(6)})
+    assert j.completed() == [0, 2]
+    doc = j.load_case(0)
+    assert doc["case_metrics"][0]["surge_std"] == 1.25
+    assert np.all(doc["mean_offset"] == np.arange(6.0))
+    # corrupt entry: deleted and treated as a miss
+    with open(j._path(2), "wb") as f:
+        f.write(b"not a pickle")
+    assert j.load_case(2) is None
+    assert j.completed() == [0]
+    j.clear()
+    assert j.completed() == []
+
+
+# ---------------------------------------------------------------------------
+# integration: quarantine / ladder / resume on the coarse cylinder
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cyl_runs(tmp_path_factory):
+    """Clean, faulted, resumed, and ladder-recovered runs of the coarse
+    Vertical_cylinder model, with the obs facts captured per run."""
+    journal_dir = str(tmp_path_factory.mktemp("journal"))
+    os.environ["RAFT_TPU_JOURNAL_DIR"] = journal_dir
+    state = {}
+    try:
+        obs.reset_all()
+        faults.clear()
+
+        m = Model(_cyl_design())
+        m.analyzeCases()
+        state["clean"] = {"ledger": m.last_ledger,
+                          "manifest": m.last_manifest.to_dict(),
+                          "results": m.results}
+        # the clean run journaled everything — resume must exercise the
+        # faulted run's journal, so start it fresh
+        recovery.CaseJournal.for_model(m).clear()
+
+        faults.install("nan@dynamics:case=1")      # persistent: no rung
+        obs.reset_all()                            # can save case 1
+        m = Model(_cyl_design())
+        m.analyzeCases()
+        faults.clear()
+        state["faulted"] = {"ledger": m.last_ledger,
+                            "manifest": m.last_manifest.to_dict(),
+                            "snap": obs.snapshot(),
+                            "transfers": obs.transfers.snapshot(),
+                            "failed_cases": list(m.failed_cases)}
+
+        obs.reset_all()
+        m = Model(_cyl_design())
+        m.analyzeCases(resume=True)
+        state["resumed"] = {"ledger": m.last_ledger,
+                            "manifest": m.last_manifest.to_dict(),
+                            "agg": obs.aggregate(),
+                            "snap": obs.snapshot()}
+
+        faults.install("raise@kernel:case=0:once")
+        obs.reset_all()
+        m = Model(_cyl_design(ncases=1))
+        m.analyzeCases()
+        faults.clear()
+        state["kernel_once"] = {"ledger": m.last_ledger,
+                                "manifest": m.last_manifest.to_dict(),
+                                "snap": obs.snapshot()}
+
+        obs.reset_all()
+        m = Model(_cyl_design(ncases=1))
+        m.analyzeCases()
+        state["clean1"] = {"ledger": m.last_ledger}
+        yield state
+    finally:
+        os.environ.pop("RAFT_TPU_JOURNAL_DIR", None)
+        faults.clear()
+        obs.reset_all()
+
+
+def test_quarantine_isolates_case(cyl_runs):
+    """Acceptance: the faulted run completes, case 1 fails structured,
+    cases 0/2 reproduce the clean run's ledger digests exactly."""
+    clean, faulted = cyl_runs["clean"], cyl_runs["faulted"]
+    failed = faulted["failed_cases"]
+    assert len(failed) == 1 and failed[0]["case"] == 1
+    assert failed[0]["error"] == "NonFiniteResult"
+    assert failed[0]["phase"] == "dynamics"
+    # structured record reaches manifest AND ledger extra
+    assert faulted["manifest"]["extra"]["failed_cases"] == failed
+    assert faulted["ledger"]["extra"]["failed_cases"] == failed
+    # quarantined case appears as a structured ledger entry
+    fe = _entry(faulted["ledger"], "case1/failed")
+    assert fe["metrics"]["error"] == "NonFiniteResult"
+    # neighbors completed with digests matching the clean run (1e-6
+    # would suffice; the isolation is exact on CPU)
+    dc, df = _digests(clean["ledger"]), _digests(faulted["ledger"])
+    for key in ("case0/fowt0", "case0/system",
+                "case2/fowt0", "case2/system"):
+        assert dc[key] == df[key], key
+    # the failed-case metric fired
+    snap = cyl_runs["faulted"]["snap"]
+    series = snap["raft_tpu_cases_failed_total"]["series"]
+    assert series[0]["labels"]["phase"] == "dynamics"
+    assert series[0]["value"] == 1.0
+
+
+def test_ladder_attempts_recorded(cyl_runs):
+    """The dynamics ladder walked jnp_solve -> damped_restart on the
+    poisoned case, every transition recorded in the manifest and the
+    raft_tpu_recovery_attempts_total metric."""
+    mani = cyl_runs["faulted"]["manifest"]
+    attempts = mani["extra"]["recovery"]["attempts"]
+    chain = [(a["step_from"], a["step_to"], a["outcome"])
+             for a in attempts if a["phase"] == "dynamics"]
+    assert ("configured", "jnp_solve", "failed") in chain
+    assert ("jnp_solve", "damped_restart", "failed") in chain
+    snap = cyl_runs["faulted"]["snap"]
+    series = snap["raft_tpu_recovery_attempts_total"]["series"]
+    assert {(s["labels"]["from"], s["labels"]["to"])
+            for s in series} >= {("configured", "jnp_solve"),
+                                 ("jnp_solve", "damped_restart")}
+
+
+def test_transfer_budget_with_quarantine(cyl_runs):
+    """The faulted 3-case run stays within the per-case budget: the
+    clean cases pull statics=1 / dynamics=4; the quarantined case's
+    ladder attempts each pull through the same sanctioned exits (no
+    unsanctioned pulls appear anywhere)."""
+    xfers = cyl_runs["faulted"]["transfers"]["phases"]
+    assert set(xfers) <= {"statics", "dynamics"}
+    assert xfers["statics"]["events"] == 3          # one per statics solve
+    # 2 clean cases x 4 + 3 attempts on the poisoned case x 4
+    assert xfers["dynamics"]["events"] == 2 * 4 + 3 * 4
+
+
+def test_resume_skips_completed(cyl_runs):
+    """resume=True restores the journaled cases 0/2 (span-asserted: no
+    statics/dynamics solves for them) and re-runs only failed case 1 —
+    converging to the clean run's full ledger."""
+    agg = cyl_runs["resumed"]["agg"]
+    assert agg["case_resumed"][1] == 2
+    assert agg["solveStatics"][1] == 1       # only case 1 re-solved
+    assert agg["solveDynamics"][1] == 1
+    mani = cyl_runs["resumed"]["manifest"]
+    assert mani["extra"]["resumed_cases"] == [0, 2]
+    assert mani["extra"]["failed_cases"] == []
+    dc = _digests(cyl_runs["clean"]["ledger"])
+    dr = _digests(cyl_runs["resumed"]["ledger"])
+    assert set(dc) == set(dr)
+    for key, dig in dc.items():
+        assert dr[key] == dig, key
+    snap = cyl_runs["resumed"]["snap"]
+    assert snap["raft_tpu_cases_resumed_total"]["series"][0]["value"] == 2
+
+
+def test_kernel_ladder_recovers_at_parity(cyl_runs):
+    """A one-shot kernel failure degrades to the jnp solve and recovers
+    with physics identical to a clean run (ladder parity gate)."""
+    mani = cyl_runs["kernel_once"]["manifest"]
+    attempts = mani["extra"]["recovery"]["attempts"]
+    assert [(a["step_from"], a["step_to"], a["outcome"])
+            for a in attempts] == [("configured", "jnp_solve",
+                                    "recovered")]
+    assert attempts[0]["error"] == "KernelFailure"
+    assert mani["extra"]["failed_cases"] == []
+    d1 = _digests(cyl_runs["clean1"]["ledger"])
+    d2 = _digests(cyl_runs["kernel_once"]["ledger"])
+    assert d1 == d2
+    series = cyl_runs["kernel_once"]["snap"][
+        "raft_tpu_recovery_attempts_total"]["series"]
+    (s,) = series
+    assert s["labels"] == {"from": "configured", "to": "jnp_solve",
+                           "outcome": "recovered", "phase": "dynamics"}
+
+
+def test_recovery_off_propagates(cyl_runs):
+    """RAFT_TPU_RECOVERY=0 restores fail-fast: the typed error escapes
+    analyzeCases and the manifest records a failed run."""
+    _config.set_recovery_mode("0")
+    faults.install("nan@dynamics:case=0")
+    try:
+        m = Model(_cyl_design(ncases=1))
+        with pytest.raises(errors.NonFiniteResult):
+            m.analyzeCases()
+        assert m.last_manifest.status == "failed"
+    finally:
+        faults.clear()
+        _config.set_recovery_mode(None)
+
+
+def test_quarantine_clears_meandrift_for_next_case():
+    """A potSecOrder case quarantined mid-dynamics must not leak its
+    F_meandrift into the next case's statics — the neighbor's digest
+    must match a clean run (the clean flow pops the drift forcing after
+    the mean-drift statics re-solve; quarantine must too)."""
+    def build():
+        design = _cyl_design(ncases=2)
+        design["platform"]["potSecOrder"] = 1
+        design["platform"]["min_freq2nd"] = 0.05
+        design["platform"]["max_freq2nd"] = 0.25
+        ik = design["cases"]["keys"].index("wave_spectrum")
+        for row in design["cases"]["data"]:
+            row[ik] = "JONSWAP"      # a still sea has no drift forcing
+        return design
+
+    m = Model(build())
+    m.analyzeCases()
+    clean = _digests(m.last_ledger)
+
+    faults.install("nan@dynamics:case=0")
+    try:
+        m = Model(build())
+        m.analyzeCases()
+    finally:
+        faults.clear()
+    assert [f["case"] for f in m.failed_cases] == [0]
+    faulted = _digests(m.last_ledger)
+    for key in ("case1/fowt0", "case1/system"):
+        assert faulted[key] == clean[key], key
+
+
+# ---------------------------------------------------------------------------
+# integration: sweep batch quarantine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cyl_fowt():
+    from raft_tpu.models.fowt import build_fowt
+
+    design = load_design("Vertical_cylinder")
+    w = np.arange(0.05, 0.5, 0.05) * 2 * np.pi
+    return build_fowt(design, w,
+                      depth=float(design["site"]["water_depth"]))
+
+
+def test_sweep_lane_quarantine_parity(cyl_fowt):
+    """A poisoned lane is detected on device, re-solved alone through
+    the ladder, and spliced back at <=1e-6 parity with a clean batch;
+    the healthy lanes and the clean-path pull budget are untouched."""
+    from raft_tpu.parallel.sweep import sweep_cases
+
+    rng = np.random.default_rng(7)
+    nc = 4
+    Hs = 2.0 + rng.random(nc)
+    Tp = 8.0 + 2.0 * rng.random(nc)
+    beta = np.deg2rad(rng.integers(0, 360, nc).astype(float))
+
+    clean = sweep_cases(cyl_fowt, Hs, Tp, beta, nIter=6)
+    clean_pulls = obs.transfers.counts("sweep")
+    assert clean_pulls["events"] == 1               # one summary pull
+
+    faults.install("nan@sweep:lane=2")
+    try:
+        out = sweep_cases(cyl_fowt, Hs, Tp, beta, nIter=6)
+    finally:
+        faults.clear()
+    std_c = np.asarray(clean["std"])
+    std_f = np.asarray(out["std"])
+    assert np.all(np.isfinite(std_f))
+    rel = np.abs(std_f - std_c) / np.maximum(np.abs(std_c), 1e-300)
+    assert rel.max() <= 1e-6
+    rel_xi = np.max(np.abs(np.asarray(out["Xi"])
+                           - np.asarray(clean["Xi"])))
+    assert rel_xi <= 1e-6 * max(1.0, np.abs(np.asarray(clean["Xi"])).max())
+    # the faulted sweep used exactly one extra quarantine pull
+    assert obs.transfers.counts("sweep")["events"] == clean_pulls[
+        "events"] + 2
+    snap = obs.snapshot()
+    series = snap["raft_tpu_recovery_attempts_total"]["series"]
+    assert any(s["labels"] == {"from": "batched", "to": "re_solve",
+                               "outcome": "recovered", "phase": "sweep"}
+               for s in series)
+
+
+def test_sweep_quarantine_off_leaves_nan(cyl_fowt):
+    from raft_tpu.parallel.sweep import sweep_cases
+
+    faults.install("nan@sweep:lane=0")
+    try:
+        out = sweep_cases(cyl_fowt, np.array([2.0, 2.5]),
+                          np.array([8.0, 8.5]), np.zeros(2),
+                          nIter=6, quarantine="off")
+    finally:
+        faults.clear()
+    std = np.asarray(out["std"])
+    assert np.all(np.isnan(std[0])) and np.all(np.isfinite(std[1]))
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the ISSUE acceptance scenario on the 3-case OC3 spar
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_oc3_three_case_acceptance(tmp_path):
+    """With a fault injected into one case of a 3-case OC3 run: the run
+    completes, the failed case appears as a structured record in
+    manifest + ledger extra, the other cases' ledger digests match a
+    clean run at 1e-6, and analyzeCases(resume=True) re-runs only the
+    failed case — all within the pinned per-case transfer budget."""
+    from raft_tpu.obs import ledger as L
+
+    os.environ["RAFT_TPU_JOURNAL_DIR"] = str(tmp_path / "journal")
+    try:
+        def build():
+            design = load_design("OC3spar")
+            design.setdefault("settings", {})
+            design["settings"].update({"min_freq": 0.02, "max_freq": 0.2})
+            row0 = list(design["cases"]["data"][0])
+            ih = design["cases"]["keys"].index("wave_height")
+            rows = []
+            for i in range(3):
+                row = list(row0)
+                row[ih] = float(row0[ih]) + 0.5 * i
+                rows.append(row)
+            design["cases"]["data"] = rows
+            return design
+
+        m = Model(build())
+        m.analyzeCases()
+        led_clean = m.last_ledger
+        recovery.CaseJournal.for_model(m).clear()
+
+        faults.install("nan@dynamics:case=1")
+        obs.reset_all()
+        transfers0 = obs.transfers.snapshot()
+        m = Model(build())
+        m.analyzeCases()
+        faults.clear()
+        led_faulted = m.last_ledger
+        failed = m.failed_cases
+        assert [f["case"] for f in failed] == [1]
+        assert m.last_manifest.extra["failed_cases"] == failed
+        assert led_faulted["extra"]["failed_cases"] == failed
+        # clean-path budget holds for the surviving cases: statics=1
+        # per statics solve and dynamics=4 per attempt
+        xf = obs.transfers.delta(transfers0, obs.transfers.snapshot())
+        assert xf["phases"]["statics"]["events"] == 3
+        assert xf["phases"]["dynamics"]["events"] == 2 * 4 + 3 * 4
+
+        report = L.diff(led_clean, led_faulted, tol_rel=1e-6)
+        offending = {r["entry"] for r in report["regressions"]}
+        # every moved/missing entry belongs to the quarantined case
+        assert offending <= {"case1/fowt0", "case1/system",
+                             "case1/failed"}
+        assert set(report["added"]) == {"case1/failed"}
+        assert set(report["removed"]) == {"case1/fowt0", "case1/system"}
+
+        obs.reset_all()
+        m = Model(build())
+        m.analyzeCases(resume=True)
+        agg = obs.aggregate()
+        assert agg["case_resumed"][1] == 2
+        assert agg["solveStatics"][1] == 1
+        assert agg["solveDynamics"][1] == 1
+        report = L.diff(led_clean, m.last_ledger, tol_rel=1e-6)
+        assert report["ok"], report
+    finally:
+        os.environ.pop("RAFT_TPU_JOURNAL_DIR", None)
+        faults.clear()
+
+
+@pytest.mark.slow
+def test_oc3_statics_ladder_host_fallback():
+    """Statics divergence degrades device -> host Newton and recovers:
+    the ladder records the transition and the recovered equilibrium
+    matches a clean solve at 1e-6."""
+    design = load_design("OC3spar")
+    design.setdefault("settings", {})
+    design["settings"].update({"min_freq": 0.02, "max_freq": 0.2})
+    design["cases"]["data"] = design["cases"]["data"][:1]
+    m = Model(design)
+    case = dict(zip(design["cases"]["keys"], design["cases"]["data"][0]))
+    X_clean = np.asarray(m.solveStatics(dict(case)))
+
+    faults.install("raise@statics:case=0:once")
+    attempts = []
+    try:
+        m._iCase = 0
+        X = recovery.run_ladder(
+            "statics", "0", lambda: m.solveStatics(dict(case)),
+            recovery.statics_ladder(), recorder=attempts.append)
+    finally:
+        m._iCase = None
+        faults.clear()
+    assert [(a.step_from, a.step_to, a.outcome) for a in attempts] == [
+        ("configured", "host_statics", "recovered")]
+    scale = np.maximum(np.abs(X_clean), 1.0)
+    assert np.all(np.abs(np.asarray(X) - X_clean) / scale < 1e-6)

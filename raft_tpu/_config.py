@@ -29,7 +29,9 @@ if os.environ.get("RAFT_TPU_JAX_CACHE", "1") != "0":
             or os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu",
                             "jax_cache"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:                                 # pragma: no cover
+    # an unwritable cache dir / older jax without the knob must not
+    # take down the solver at import time — the cache is an optimization
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
         pass
 
 import jax.numpy as jnp  # noqa: E402  (after x64 flag)

@@ -18,6 +18,8 @@ import subprocess
 
 import numpy as np
 
+from raft_tpu import errors
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native", "bem")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libraftbem.so")
@@ -38,7 +40,11 @@ def available() -> bool:
     except subprocess.CalledProcessError as e:
         _load_error = (e.stderr or b"").decode(errors="replace")[-2000:]
         return False
-    except Exception as e:
+    # building/ctypes-loading a C core fails in arbitrary ways (missing
+    # toolchain, ABI drift, bad ELF); ANY of them just means "native
+    # core unavailable" — captured verbatim for load_error(), and the
+    # caller raises the typed KernelFailure with it
+    except Exception as e:  # raftlint: disable=RTL004
         _load_error = str(e)
         return False
 
@@ -71,8 +77,10 @@ def _load():
         ct.POINTER(ct.c_double), ct.POINTER(ct.c_double)]
     lib.raft_bem_solve2.restype = ct.c_int
     if lib.raft_bem_load_tables(_TABLE_PATH.encode()) != 0:
-        raise RuntimeError(f"failed to load Green-function tables from "
-                           f"{_TABLE_PATH}")
+        # IS a RuntimeError — pre-taxonomy catchers keep working
+        raise errors.KernelFailure(
+            f"failed to load Green-function tables from {_TABLE_PATH}",
+            kernel="bem_native")
     _lib = lib
     return lib
 
@@ -108,7 +116,8 @@ def solve_radiation_diffraction(mesh, omegas, betas_deg, rho=1025.0,
         p(omegas), nw, p(betas), nb, float(rho), float(g), float(depth),
         p(A), p(B), p(Xre), p(Xim))
     if rc != 0:
-        raise RuntimeError(f"raft_bem_solve failed (rc={rc})")
+        raise errors.KernelFailure(f"raft_bem_solve failed (rc={rc})",
+                                   kernel="bem_native", rc=int(rc))
     return A, B, Xre + 1j * Xim
 
 

@@ -19,6 +19,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from raft_tpu.errors import ModelConfigError
+
 
 @dataclass
 class PanelMesh:
@@ -315,7 +317,8 @@ def mesh_fowt_members(fowt, dz_max=3.0, da_max=2.0, lid=True,
                 dwl = float(np.interp(0.0, z_st, dd))
             piercing.append((rA[0], rA[1], 0.5 * dwl))
     if not any_pot:
-        raise ValueError("FOWT has no potMod members to mesh")
+        # IS a ValueError — pre-taxonomy catchers keep working
+        raise ModelConfigError("FOWT has no potMod members to mesh")
     n_body = len(builder.panels)
     if lid:
         for cx, cy, R in piercing:

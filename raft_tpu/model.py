@@ -55,7 +55,7 @@ from raft_tpu.ops.linalg import impedance_solve, inv_complex
 from raft_tpu.ops.transforms import transform_force, translate_matrix_6to6
 from raft_tpu.models.member import member_inertia
 from raft_tpu.utils.dicttools import get_from_dict
-from raft_tpu import errors, obs, recovery
+from raft_tpu import _config, errors, obs, recovery
 from raft_tpu.testing import faults
 from raft_tpu.utils.profiling import get_logger, temp_verbosity
 
@@ -155,7 +155,8 @@ class Model:
             if "array_mooring" in design:
                 from raft_tpu.models import mooring_array as ma
                 if not design["array_mooring"].get("file"):
-                    raise ValueError(
+                    # IS a ValueError — pre-taxonomy catchers keep working
+                    raise errors.ModelConfigError(
                         "'array_mooring' requires a MoorDyn-style input "
                         "file as 'file'")
                 self.arr_ms = ma.parse_moordyn(
@@ -214,11 +215,11 @@ class Model:
             v = case.get(key)
             if isinstance(v, (list, tuple, np.ndarray)):
                 if i >= len(v):
-                    raise ValueError(
+                    raise errors.ModelConfigError(
                         f"case list for '{key}' has {len(v)} entries but "
                         f"FOWT {i+1} exists — per-turbine lists must match "
                         "the number of turbines (reference: "
-                        "raft_model.py:517-519)")
+                        "raft_model.py:517-519)", key=key, fowt=i)
                 case_i[key] = v[i]
         return case_i
 
@@ -354,7 +355,7 @@ class Model:
                 Fs.append(F)
                 Kblocks.append(K)
             Fv = jnp.concatenate(Fs)
-            Km = jnp.zeros((6 * N, 6 * N))
+            Km = jnp.zeros((6 * N, 6 * N), dtype=_config.real_dtype())
             for i in range(N):
                 Km = Km.at[6 * i:6 * i + 6, 6 * i:6 * i + 6].set(Kblocks[i])
             if arr is not None:
@@ -483,7 +484,8 @@ class Model:
         db = np.tile(np.array([30, 30, 5, 0.1, 0.1, 0.1]), N) \
             * float(recovery.current("clip_scale", 1.0))
         tol = np.tile(np.array([0.05, 0.05, 0.05, 5e-3, 5e-3, 5e-3]) * 1e-3, N)
-        xf_arg = jnp.zeros((0, 3)) if xf is None else jnp.asarray(xf)
+        xf_arg = (jnp.zeros((0, 3), dtype=_config.real_dtype())
+                  if xf is None else jnp.asarray(xf))
         # damped Newton with a backtracking line search on |F|^2 — the
         # same scheme as parallel.variants.statics_newton (one statics
         # doctrine for the Model path and the sweep path), extended to
@@ -493,7 +495,6 @@ class Model:
         Ucur = jnp.asarray(np.stack([
             st.get("moor_current") if st.get("moor_current") is not None
             else np.zeros(3) for st in self._state]))
-        from raft_tpu import _config
         if _config.statics_mode() == "host":
             X, xf_arg, n_iters, residual = self._statics_newton_host(
                 X, xf_arg, F0s, K_hss, Ucur, db, tol)
@@ -729,7 +730,6 @@ class Model:
         return rel
 
     def _solve_dynamics_impl(self, case, tol, display, sp):
-        from raft_tpu import _config
         N = self.nFOWT
         nw = self.nw
         for i in range(N):
@@ -938,9 +938,9 @@ class Model:
             B_turb = jnp.sum(tc["B_aero"], axis=3)
             B_gyro = jnp.sum(tc["B_gyro"], axis=2)
         else:
-            M_turb = jnp.zeros((6, 6, nw))
-            B_turb = jnp.zeros((6, 6, nw))
-            B_gyro = jnp.zeros((6, 6))
+            M_turb = jnp.zeros((6, 6, nw), dtype=_config.real_dtype())
+            B_turb = jnp.zeros((6, 6, nw), dtype=_config.real_dtype())
+            B_gyro = jnp.zeros((6, 6), dtype=_config.real_dtype())
 
         # potential-flow coefficients (reference: raft_model.py:911-914 —
         # A_BEM/B_BEM always enter the linear system once loaded; F_BEM per
@@ -1015,7 +1015,8 @@ class Model:
             else:
                 Xi0c = jnp.asarray(Xi_init)
             Z0 = jnp.zeros((6, 6, nw), dtype=complex)
-            Bmat0 = jnp.zeros((fowt.nodes.n, 3, 3))
+            Bmat0 = jnp.zeros((fowt.nodes.n, 3, 3),
+                              dtype=_config.real_dtype())
             if jax.default_backend() != "cpu":
                 # donate the warm-start buffer so the Xi carry reuses
                 # device memory (CPU has no donation — it would only
@@ -1465,7 +1466,9 @@ class Model:
             return None
         try:
             return recovery.CaseJournal.for_model(self)
-        except Exception as e:                        # pragma: no cover
+        # an unwritable/corrupt journal dir must never take down
+        # analyzeCases — journaling is an optional resilience feature
+        except Exception as e:  # pragma: no cover  # raftlint: disable=RTL004
             _LOG.warning("case journal unavailable: %s", e)
             return None
 
@@ -1855,8 +1858,10 @@ class Model:
         from raft_tpu.io.bem_native import available, load_error, solve_bem_fowt
 
         if not available():
-            raise RuntimeError(
-                f"native BEM core unavailable: {load_error()}")
+            # IS a RuntimeError — pre-taxonomy catchers keep working
+            raise errors.KernelFailure(
+                f"native BEM core unavailable: {load_error()}",
+                kernel="bem_native")
         w_bem = np.arange(dw, wMax + 0.5 * dw, dw)
         out = []
         for i, fowt in enumerate(self.fowtList):

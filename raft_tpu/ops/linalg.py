@@ -72,7 +72,7 @@ def _gj_core(Af, bf, n, k):
     B = Af.shape[0]
     M = jnp.concatenate([Af, bf], axis=-1)
     M = jnp.moveaxis(M, 0, -1)                     # (n, n+k, B)
-    rows = jnp.arange(n)
+    rows = jnp.arange(n, dtype=jnp.int32)
     for kk in range(n):                            # static unroll
         col = M[:, kk, :]                          # (n, B)
         mag = jnp.where((rows >= kk)[:, None], jnp.abs(col), -jnp.inf)
@@ -140,7 +140,8 @@ def _record_dispatch(backend: str, n, batch_elems, fused: bool = False):
     try:
         from raft_tpu import obs
         obs.record_solve_dispatch(backend, n, batch_elems, fused=fused)
-    except Exception:                                 # pragma: no cover
+    # telemetry emission must never fail a solve (obs layer contract)
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
         pass
 
 

@@ -37,6 +37,14 @@ from raft_tpu.obs.ledger import digest_metrics
 _LOCK = threading.Lock()
 _STATS = {"hits": 0, "misses": 0, "stores": 0, "errors": 0, "corrupts": 0}
 
+#: failure types a deserialized-executable call can legitimately raise
+#: (deserialization drift past the key, XLA runtime errors incl.
+#: jaxlib's XlaRuntimeError — a RuntimeError subclass — and truncated
+#: payloads); anything outside this tuple is a bug and must propagate.
+#: Single source of truth for every cached-``exe.call`` except clause
+#: (sweep_cases, sweep_variants).
+CALL_ERRORS = (RuntimeError, ValueError, TypeError, KeyError, OSError)
+
 
 def enabled() -> bool:
     """Cache active?  ``RAFT_TPU_EXEC_CACHE`` 1/0 wins; default: on iff
@@ -73,7 +81,8 @@ def _count(event: str):
     try:
         from raft_tpu import obs
         obs.record_exec_cache_event(event)
-    except Exception:                                 # pragma: no cover
+    # metric emission must never fail the cache layer (obs contract)
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
         pass
 
 
@@ -200,7 +209,10 @@ def load(key: str):
         return None
     try:
         exe = jexport.deserialize(bytearray(data))
-    except Exception:
+    # jax.export deserialization raises arbitrary types on drifted/
+    # corrupt payloads; delete-and-miss IS the documented recovery
+    # (errors.CacheCorruption) — strictness lives at the caller
+    except Exception:  # raftlint: disable=RTL004
         _count("error")
         _purge(key)
         return None
@@ -240,7 +252,9 @@ def store(fn_jitted, args, key: str, meta: dict = None) -> str | None:
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1, default=str)
         os.replace(tmp, meta_path)
-    except Exception:
+    # the store is best-effort: an unwritable/full cache dir must not
+    # take down the solve that just compiled successfully
+    except Exception:  # raftlint: disable=RTL004
         _count("error")
         return None
     _count("store")

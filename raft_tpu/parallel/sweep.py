@@ -29,19 +29,17 @@ from raft_tpu.models.fowt import (
     fowt_hydro_linearization_pre, fowt_drag_excitation,
     fowt_bem_excitation,
 )
-from raft_tpu import errors
+from raft_tpu import _config, errors
 from raft_tpu.ops.linalg import impedance_solve
 from raft_tpu.ops.spectra import jonswap, get_rms
 from raft_tpu.utils.profiling import get_logger
 
 _LOG = get_logger("sweep")
 
-#: failure types a cached-executable call can legitimately raise
-#: (deserialization drift, XLA runtime errors incl. jaxlib's
-#: XlaRuntimeError — a RuntimeError subclass — and truncated payloads);
-#: anything outside this tuple is a bug and propagates
-_CACHED_CALL_ERRORS = (RuntimeError, ValueError, TypeError, KeyError,
-                       OSError)
+#: failure types a cached-executable call can legitimately raise;
+#: anything outside this tuple is a bug and propagates (single source:
+#: parallel/exec_cache.py, shared with sweep_variants)
+from raft_tpu.parallel.exec_cache import CALL_ERRORS as _CACHED_CALL_ERRORS
 
 
 def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2,
@@ -134,7 +132,8 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         # jacobian at the zero-angle reference pose used here, but keeps
         # the two sweep paths on the same convention as Model)
         C_moor = (mr.coupled_stiffness_rotvec(fowt.mooring, r6)
-                  if fowt.mooring is not None else jnp.zeros((6, 6)))
+                  if fowt.mooring is not None
+                  else jnp.zeros((6, 6), dtype=_config.real_dtype()))
 
         S = jonswap(w, Hs, Tp)
         zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
@@ -224,7 +223,7 @@ def _quarantine_lanes(fowt, Hs, Tp, beta, out, bad, kw, iters, conv_np):
     ladder, splicing recovered (finite) lanes back into ``out``; lanes
     no rung can make finite stay NaN and are reported as quarantined.
     Returns ``(out, iters, conv_np, info)``."""
-    from raft_tpu import _config, obs, recovery
+    from raft_tpu import obs, recovery  # _config is module-level
 
     info = {"lanes": [int(i) for i in bad], "ladder": [],
             "recovered": [], "quarantined": []}
@@ -248,7 +247,11 @@ def _quarantine_lanes(fowt, Hs, Tp, beta, out, bad, kw, iters, conv_np):
                           lanes=int(remaining.size)):
                 solver = make_case_solver(fowt, **kw2)
                 idx = jnp.asarray(remaining)
-                sub = jax.jit(solver.batched)(Hs[idx], Tp[idx], beta[idx])
+                # a fresh trace per rung is inherent: every rung builds
+                # a NEW solver with different static config (nIter/
+                # chunk/relax), and the ladder is a <=2-rung cold path
+                sub = jax.jit(solver.batched)(  # raftlint: disable=RTL002
+                    Hs[idx], Tp[idx], beta[idx])
                 # the one extra counted pull the quarantine path is
                 # allowed (docs/robustness.md budget note)
                 ok, sconv, siters = obs.transfers.device_get(

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from raft_tpu import _config
 from raft_tpu.models import mooring as mr
 from raft_tpu.models.fowt import (
     FOWTModel, NodeSet, build_fowt, fowt_pose, fowt_statics,
@@ -42,6 +43,9 @@ from raft_tpu.models.fowt import (
 from raft_tpu.models.member import member_inertia
 from raft_tpu.ops.linalg import impedance_solve
 from raft_tpu.ops.spectra import jonswap, get_rms
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("variants")
 
 
 # --------------------------------------------------------------------------
@@ -211,15 +215,19 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
     w = jnp.asarray(base.w)
     nw = len(base.w)
     dw = float(base.w[1] - base.w[0])
-    F_env = jnp.zeros(6) if F_env is None else jnp.asarray(F_env)
-    A_t = jnp.zeros((6, 6, nw)) if A_turb is None else jnp.asarray(A_turb)
-    B_t = jnp.zeros((6, 6, nw)) if B_turb is None else jnp.asarray(B_turb)
+    rdt = _config.real_dtype()
+    F_env = (jnp.zeros(6, dtype=rdt) if F_env is None
+             else jnp.asarray(F_env))
+    A_t = (jnp.zeros((6, 6, nw), dtype=rdt) if A_turb is None
+           else jnp.asarray(A_turb))
+    B_t = (jnp.zeros((6, 6, nw), dtype=rdt) if B_turb is None
+           else jnp.asarray(B_turb))
     g = base.g
     rho = base.rho_water
 
     def setup(theta):
         fowt = variant_fowt(base, theta)
-        ref = jnp.zeros(6)
+        ref = jnp.zeros(6, dtype=_config.real_dtype())
         pose0 = fowt_pose(fowt, ref)
         stat = fowt_statics(fowt, pose0)
 
@@ -270,7 +278,8 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
         # getCoupledStiffnessA at the loaded equilibrium (same parity fix
         # as Model.solveStatics; Euler-vs-rotvec differs at loaded poses)
         C_moor = (mr.coupled_stiffness_rotvec(fowt.mooring, Xeq)
-                  if fowt.mooring is not None else jnp.zeros((6, 6)))
+                  if fowt.mooring is not None
+                  else jnp.zeros((6, 6), dtype=_config.real_dtype()))
         pose_eq = fowt_pose(fowt, Xeq)
 
         S = jonswap(w, Hs, Tp)
@@ -419,10 +428,16 @@ def sweep_variants(base: FOWTModel, thetas: dict, mesh: Optional[Mesh] = None,
                 with obs.span("variants_execute", nv=nv, cached=True):
                     out = exe.call(thetas)
                     jax.block_until_ready(out["std"])
-            except Exception:
+            except exec_cache.CALL_ERRORS as e:
                 # a deserialized-but-unrunnable executable is a cache
-                # ERROR, not a hit — count it and fall through to the
-                # normal compile path (same stance as sweep_cases)
+                # ERROR, not a hit — expected call failures only (the
+                # shared exec_cache.CALL_ERRORS contract; anything else
+                # is a bug and propagates): count it and fall through
+                # to the normal compile path (same stance as
+                # sweep_cases)
+                _LOG.warning(
+                    "cached variant executable %s failed (%s: %s) — "
+                    "recompiling", key, type(e).__name__, e)
                 exec_cache._count("error")
                 sp.set(exec_cache="error")
                 out = None

@@ -1,39 +1,38 @@
 """Lint tier-1 guard: no bare ``print(`` in raft_tpu/ library code.
 
-Library output goes through ``utils.profiling.get_logger`` (honoring
-``set_verbosity``) or the obs layer.  Exempt: ``plot.py`` (interactive
-plotting module) and explicit report-printer lines tagged with a
-``# print-ok`` comment (e.g. ``print_timing_report``, whose whole job is
-writing a table to stdout)."""
+Since the raftlint PR this is a thin wrapper over the real AST rule —
+``tools/raftlint`` RTL005 — so the exemption list lives in ONE place
+(``[tool.raftlint.rtl005]`` in pyproject.toml plus inline
+``# print-ok`` / ``# raftlint: disable=RTL005`` suppressions, which the
+rule honors as aliases of each other).  Library output goes through
+``utils.profiling.get_logger`` (honoring ``set_verbosity``) or the obs
+layer; ``plot.py`` (interactive plotting) stays exempt wholesale.
+
+The old regex guard lived right here; ``tests/test_raftlint.py`` proves
+the AST rule is strictly better (no false hits on
+``print_timing_report(`` or ``.print(`` methods).
+"""
 import os
-import re
+import sys
 
-PKG = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "raft_tpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-#: a call of the print builtin (not e.g. ``print_timing_report(`` or a
-#: ``.print(`` method)
-BARE_PRINT = re.compile(r"(?<![\w.])print\(")
-
-EXEMPT_FILES = {"plot.py"}
-EXEMPT_MARK = "# print-ok"
+from tools.raftlint import lint, load_config  # noqa: E402
 
 
 def test_no_bare_prints_in_library():
-    offenders = []
-    for dirpath, _dirnames, filenames in os.walk(PKG):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py") or fname in EXEMPT_FILES:
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path) as f:
-                for lineno, line in enumerate(f, 1):
-                    if EXEMPT_MARK in line:
-                        continue
-                    if BARE_PRINT.search(line):
-                        rel = os.path.relpath(path, os.path.dirname(PKG))
-                        offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    report = lint(paths=["raft_tpu"], root=REPO, config=load_config(REPO),
+                  select={"RTL005"}, baseline_path="")
+    offenders = [f"{f.path}:{f.line}: {f.line_text.strip()}"
+                 for f in report.all_reported()]
     assert not offenders, (
         "bare print() calls in library code (use profiling.get_logger or "
         "tag a deliberate report printer with '# print-ok'):\n"
         + "\n".join(offenders))
+    # the guard must actually have scanned the package, and the known
+    # deliberate report printers must ride the suppression path
+    assert report.checked_files > 40
+    assert any(f.path.endswith("utils/profiling.py")
+               for f in report.suppressed)

@@ -1,0 +1,6 @@
+"""Operator tooling for raft_tpu (obsctl, raftlint, golden gate, forensics).
+
+Plain scripts (``tools/obsctl.py`` etc.) manage ``sys.path`` themselves;
+this package marker exists so the AST linter can be invoked as
+``python -m tools.raftlint`` from the repository root.
+"""

@@ -15,6 +15,10 @@ Subcommands::
     obsctl trend <dir | files...>   # text trend table over a run series
     obsctl selfcheck                # round-trip a synthetic ledger through
                                     # diff/check/trend; exit 1 on failure
+    obsctl lint [raftlint args...]  # static JAX/TPU discipline checks
+                                    # (tools/raftlint — the compile-time
+                                    # sibling of `check`; exit 1 on
+                                    # findings, docs/static_analysis.md)
 
 Exit codes: 0 = no regression, 1 = regression (or selfcheck failure),
 2 = bad invocation / unreadable input.
@@ -337,6 +341,31 @@ def cmd_selfcheck(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# lint
+# ---------------------------------------------------------------------------
+
+def cmd_lint(args) -> int:
+    """Shell into the raftlint CLI (tools/raftlint) so one operator
+    entry point covers runtime regressions (`check`/`diff`) and static
+    contract violations alike.  Arguments pass through verbatim, except
+    a relative ``--output`` is resolved against the INVOKER's cwd
+    before the child runs from the repo root (module resolution needs
+    that cwd; the report should still land where the operator asked)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fwd = list(args.raftlint_args)
+    for i, a in enumerate(fwd):
+        if a == "--output" and i + 1 < len(fwd):
+            fwd[i + 1] = os.path.abspath(fwd[i + 1])
+        elif a.startswith("--output="):
+            fwd[i] = "--output=" + os.path.abspath(a.split("=", 1)[1])
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.raftlint", *fwd], cwd=repo)
+    return proc.returncode
+
+
+# ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
 
@@ -355,6 +384,12 @@ def _add_tol_args(p):
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `lint` forwards EVERYTHING verbatim (argparse.REMAINDER refuses
+    # to swallow leading --options after a subcommand), so short-
+    # circuit before argparse sees raftlint's flags
+    if argv[:1] == ["lint"]:
+        return cmd_lint(argparse.Namespace(raftlint_args=argv[1:]))
     ap = argparse.ArgumentParser(
         prog="obsctl", description=__doc__.splitlines()[0])
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -389,6 +424,14 @@ def main(argv=None) -> int:
                        help="round-trip a synthetic ledger through "
                             "diff/check/trend")
     p.set_defaults(fn=cmd_selfcheck)
+
+    p = sub.add_parser("lint",
+                       help="run the raftlint static discipline checks "
+                            "(args pass through to tools/raftlint)")
+    p.add_argument("raftlint_args", nargs=argparse.REMAINDER,
+                   help="arguments forwarded to `python -m "
+                        "tools.raftlint` (e.g. --format json raft_tpu)")
+    p.set_defaults(fn=cmd_lint)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,28 @@
+"""raftlint — AST-level JAX/TPU discipline checker for raft_tpu.
+
+Static twins of the repo's runtime contracts (docs/static_analysis.md):
+
+- RTL001 host-transfer escape  <-> obs/transfers.py pinned pull budget
+- RTL002 recompile hazard      <-> exec_cache warm-start economics
+- RTL003 dtype discipline      <-> precision ladder (ROADMAP item 5)
+- RTL004 exception discipline  <-> errors.py taxonomy + recovery ladder
+- RTL005 logging discipline    <-> obs logging layer (bare-print guard)
+
+Run ``python -m tools.raftlint [paths...]`` from the repository root, or
+``python tools/obsctl.py lint``.  Pure stdlib: safe anywhere, fast
+everywhere.
+"""
+from tools.raftlint.config import (Config, ConfigError, find_root,  # noqa: F401
+                                   load_config)
+from tools.raftlint.core import (Finding, Report, baseline_doc,  # noqa: F401
+                                 format_text, lint, load_baseline)
+from tools.raftlint.rules import ALL_RULES, RULES_BY_CODE  # noqa: F401
+
+__all__ = ["Config", "ConfigError", "Finding", "Report", "ALL_RULES",
+           "RULES_BY_CODE", "lint", "load_config", "find_root",
+           "baseline_doc", "load_baseline", "format_text", "main"]
+
+
+def main(argv=None) -> int:
+    from tools.raftlint.__main__ import main as _main
+    return _main(argv)

@@ -1,0 +1,120 @@
+"""raftlint CLI: ``python -m tools.raftlint [options] [paths...]``.
+
+Exit codes: 0 = clean (after suppressions and baseline), 1 = reported
+findings, 2 = bad invocation / unreadable or unparseable input
+(including modules the analyzer could not parse — reported as RTL000).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# repository-root invocation without installation (obsctl does the same)
+_HERE = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
+from tools.raftlint import config as _config          # noqa: E402
+from tools.raftlint import core as _core              # noqa: E402
+from tools.raftlint import rules as _rules            # noqa: E402
+
+
+def _fail(msg: str) -> int:
+    print(f"raftlint: {msg}", file=sys.stderr)
+    return 2
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="raftlint",
+        description="AST-level JAX/TPU discipline checker "
+                    "(docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: "
+                         "[tool.raftlint] paths, else raft_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="report format on stdout (default text)")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="baseline file of grandfathered findings "
+                         "(default: [tool.raftlint] baseline; pass an "
+                         "empty string to disable)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current unsuppressed findings to "
+                         "the baseline file and exit 0")
+    ap.add_argument("--output", metavar="FILE", default=None,
+                    help="also write the report (in --format) to FILE "
+                         "(CI artifact)")
+    ap.add_argument("--select", metavar="CODES", default=None,
+                    help="comma-separated rule codes to run exclusively "
+                         "(e.g. RTL005)")
+    ap.add_argument("--disable", metavar="CODES", default=None,
+                    help="comma-separated rule codes to skip")
+    ap.add_argument("--root", metavar="DIR", default=None,
+                    help="project root (default: nearest ancestor with "
+                         "a pyproject.toml)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in _rules.ALL_RULES:
+            print(f"{rule.code}  {rule.name:24s} {rule.summary}")
+        return 0
+
+    root = args.root or _config.find_root(
+        args.paths[0] if args.paths else os.getcwd())
+    try:
+        cfg = _config.load_config(root)
+    except _config.ConfigError as e:
+        return _fail(str(e))
+
+    select = ({c.strip().upper() for c in args.select.split(",")
+               if c.strip()} if args.select else None)
+    disable = ({c.strip().upper() for c in args.disable.split(",")
+                if c.strip()} if args.disable else None)
+    try:
+        report = _core.lint(paths=args.paths or None, root=root,
+                            config=cfg, select=select, disable=disable,
+                            baseline_path=args.baseline)
+    except FileNotFoundError as e:
+        return _fail(str(e))
+    except ValueError as e:                 # malformed baseline
+        return _fail(str(e))
+
+    if args.write_baseline:
+        bl = args.baseline if args.baseline is not None else cfg.baseline
+        if not bl:
+            return _fail("--write-baseline needs --baseline FILE or a "
+                         "configured [tool.raftlint] baseline")
+        bl_abs = bl if os.path.isabs(bl) else os.path.join(root, bl)
+        # re-baseline everything currently reported (plus what the old
+        # baseline still covers — shrink on rewrite only when fixed)
+        doc = _core.baseline_doc(report.findings + report.baselined)
+        os.makedirs(os.path.dirname(bl_abs) or ".", exist_ok=True)
+        with open(bl_abs, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"raftlint: wrote {len(doc['findings'])} baseline "
+              f"fingerprint(s) to {bl}")
+        return 0
+
+    rendered = (json.dumps(report.to_dict(), indent=1)
+                if args.format == "json"
+                else _core.format_text(report))
+    print(rendered)
+    if args.output:
+        out_abs = args.output if os.path.isabs(args.output) \
+            else os.path.join(os.getcwd(), args.output)
+        with open(out_abs, "w") as f:
+            f.write(rendered)
+            f.write("\n")
+    if report.parse_errors:      # broken INPUT, not a contract finding
+        return 2
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,239 @@
+"""raftlint configuration: ``[tool.raftlint]`` in pyproject.toml.
+
+Python 3.11+ parses pyproject with :mod:`tomllib`.  On 3.10 (which this
+repo still supports in CI) there is no stdlib TOML parser and raftlint
+must not grow a dependency, so a minimal line-based fallback parser
+covers the subset the ``[tool.raftlint*]`` tables actually use: section
+headers, bare/quoted keys, strings, booleans, numbers, and (possibly
+multi-line) arrays of those.  Anything fancier (inline tables, dotted
+keys, escapes beyond ``\\"``) is out of scope for the config schema and
+rejected loudly rather than misread silently.
+"""
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+try:                                                  # py >= 3.11
+    import tomllib as _toml
+except ImportError:                                   # py 3.10 fallback
+    _toml = None
+
+
+class ConfigError(Exception):
+    """Unreadable or malformed raftlint configuration."""
+
+
+# ---------------------------------------------------------------------------
+# minimal TOML-subset parser (3.10 fallback)
+# ---------------------------------------------------------------------------
+
+_SECTION = re.compile(r"^\[([^\]]+)\]\s*(?:#.*)?$")
+_KEYVAL = re.compile(r'^("(?:[^"\\]|\\.)*"|[A-Za-z0-9_-]+)\s*=\s*(.*)$')
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a ``#`` comment that is not inside a double-quoted string."""
+    out = []
+    in_str = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == '"' and (i == 0 or line[i - 1] != "\\"):
+            in_str = not in_str
+        elif c == "#" and not in_str:
+            break
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_scalar(tok: str):
+    tok = tok.strip()
+    if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+        return tok[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+    if tok in ("true", "false"):
+        return tok == "true"
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        pass
+    raise ConfigError(f"unsupported TOML value {tok!r} "
+                      "(raftlint fallback parser)")
+
+
+def _split_array_items(body: str) -> list[str]:
+    items, cur, in_str = [], [], False
+    for i, c in enumerate(body):
+        if c == '"' and (i == 0 or body[i - 1] != "\\"):
+            in_str = not in_str
+        if c == "," and not in_str:
+            items.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    items.append("".join(cur))
+    return [s for s in (x.strip() for x in items) if s]
+
+
+def _parse_value(tok: str):
+    tok = tok.strip()
+    if tok.startswith("["):
+        if not tok.endswith("]"):
+            raise ConfigError(f"unterminated array in {tok!r}")
+        return [_parse_scalar(s) for s in _split_array_items(tok[1:-1])]
+    return _parse_scalar(tok)
+
+
+def _bracket_delta(line: str) -> int:
+    """Net ``[``/``]`` count outside double-quoted strings — brackets
+    inside string values must not confuse the multi-line-array join."""
+    delta = 0
+    in_str = False
+    for i, c in enumerate(line):
+        if c == '"' and (i == 0 or line[i - 1] != "\\"):
+            in_str = not in_str
+        elif not in_str:
+            delta += (c == "[") - (c == "]")
+    return delta
+
+
+def _parse_toml_minimal(text: str) -> dict:
+    """Subset parser: only what the [tool.raftlint] schema needs."""
+    root: dict = {}
+    section = root
+    pending_key = None
+    pending_parts: list[str] = []
+    depth = 0
+    for raw in text.splitlines():
+        line = _strip_comment(raw).rstrip()
+        if pending_key is not None:
+            pending_parts.append(line)
+            depth += _bracket_delta(line)
+            if depth <= 0:
+                try:
+                    section[pending_key] = _parse_value(
+                        " ".join(pending_parts))
+                except ConfigError:
+                    # a value kind we don't support in a FOREIGN table
+                    # (inline tables etc.) — same tolerance as the
+                    # single-line path; our own schema never hits this
+                    pass
+                pending_key = None
+                pending_parts = []
+            continue
+        line = line.strip()
+        if not line:
+            continue
+        m = _SECTION.match(line)
+        if m:
+            section = root
+            for part in m.group(1).strip().split("."):
+                part = part.strip().strip('"')
+                nxt = section.setdefault(part, {})
+                if not isinstance(nxt, dict):
+                    raise ConfigError(
+                        f"section [{m.group(1)}] collides with a value")
+                section = nxt
+            continue
+        m = _KEYVAL.match(line)
+        if not m:
+            # unsupported syntax OUTSIDE our tables is fine — we only
+            # ever read tool.raftlint.*; inside them it would already
+            # have matched.  Skip silently.
+            continue
+        key = m.group(1).strip('"')
+        val = m.group(2).strip()
+        if val.startswith("[") and not val.endswith("]"):
+            pending_key = key
+            pending_parts = [val]
+            depth = _bracket_delta(val)
+            continue
+        try:
+            section[key] = _parse_value(val)
+        except ConfigError:
+            # a value kind we don't support in a foreign table (e.g.
+            # an inline table under [project]) — irrelevant to us
+            continue
+    return root
+
+
+def _load_pyproject(path: str) -> dict:
+    with open(path, "rb") as f:
+        data = f.read()
+    if _toml is not None:
+        try:
+            return _toml.loads(data.decode("utf-8"))
+        except Exception as e:
+            raise ConfigError(f"{path}: {e}") from e
+    return _parse_toml_minimal(data.decode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# config object
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Config:
+    """Resolved raftlint configuration (defaults + pyproject overrides)."""
+
+    root: str = "."
+    #: default lint targets when the CLI gets no paths
+    paths: list = field(default_factory=lambda: ["raft_tpu"])
+    #: committed baseline of grandfathered findings (None = no baseline)
+    baseline: str | None = None
+    #: rule codes disabled wholesale
+    disable: set = field(default_factory=set)
+    #: per-rule option tables, keyed by lowercase rule code
+    rule_options: dict = field(default_factory=dict)
+
+    def options(self, code: str) -> dict:
+        return self.rule_options.get(code.lower(), {})
+
+    def enabled(self, code: str) -> bool:
+        return code.upper() not in self.disable
+
+
+def find_root(start: str) -> str:
+    """Nearest ancestor of ``start`` holding a pyproject.toml (falls
+    back to ``start`` itself)."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        if os.path.isfile(os.path.join(d, "pyproject.toml")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.path.abspath(start if os.path.isdir(start)
+                                   else os.path.dirname(start))
+        d = parent
+
+
+def load_config(root: str) -> Config:
+    """Read ``[tool.raftlint]`` from ``root``'s pyproject.toml (all keys
+    optional; a missing file or section yields pure defaults)."""
+    cfg = Config(root=os.path.abspath(root))
+    pp = os.path.join(cfg.root, "pyproject.toml")
+    if not os.path.isfile(pp):
+        return cfg
+    doc = _load_pyproject(pp)
+    table = (doc.get("tool") or {}).get("raftlint") or {}
+    if not isinstance(table, dict):
+        raise ConfigError("[tool.raftlint] must be a table")
+    for key, val in table.items():
+        if isinstance(val, dict):                      # [tool.raftlint.rtl00x]
+            cfg.rule_options[key.lower()] = dict(val)
+        elif key == "paths":
+            cfg.paths = [str(p) for p in val]
+        elif key == "baseline":
+            cfg.baseline = str(val) or None
+        elif key == "disable":
+            cfg.disable = {str(c).upper() for c in val}
+        # unknown scalar keys are tolerated (forward compatibility)
+    return cfg

@@ -1,0 +1,326 @@
+"""raftlint engine: file discovery, suppressions, baseline, reporting.
+
+The analyzer is pure stdlib ``ast`` — it never imports jax or raft_tpu,
+so it runs in any environment (pre-commit, CI fail-fast, a host with a
+wedged TPU tunnel) in milliseconds.
+
+Finding lifecycle::
+
+    rule emits Finding
+      -> inline suppression?   (# raftlint: disable=RTL0xx / # print-ok)
+      -> baseline match?       (committed grandfather list)
+      -> reported              (nonzero exit)
+
+Suppressions attach to the *reported line* of the finding, mirroring
+``noqa`` semantics.  The baseline matches on (rule, path, stripped line
+text) with per-fingerprint counts, so findings keep matching when
+unrelated edits shift line numbers, and a *new* duplicate of a
+baselined pattern still fails.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+from tools.raftlint.config import Config
+
+BASELINE_SCHEMA = "raftlint.baseline/v1"
+REPORT_SCHEMA = "raftlint.report/v1"
+
+#: ``# raftlint: disable`` (all rules) or ``disable=RTL001,RTL004``;
+#: free-text justification after the codes is encouraged and ignored.
+#: The lookahead rejects ``disabled=...``-style typos outright, and the
+#: tail is parsed strictly below so a malformed directive reports the
+#: finding instead of silently widening to a blanket suppression.
+_SUPPRESS = re.compile(r"#\s*raftlint:\s*disable(?![A-Za-z])([^#]*)")
+_SUPPRESS_CODES = re.compile(
+    r"^\s*((?:[A-Za-z]+\d+)(?:\s*,\s*[A-Za-z]+\d+)*)")
+#: legacy print-guard exemption — honored as an RTL005 suppression alias
+_PRINT_OK = re.compile(r"#\s*print-ok\b")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # project-root-relative, posix separators
+    line: int            # 1-based
+    col: int             # 0-based
+    message: str
+    line_text: str = ""
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "line_text": self.line_text}
+
+    def fingerprint(self) -> str:
+        key = f"{self.rule}::{self.path}::{self.line_text.strip()}"
+        return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+
+@dataclass
+class Module:
+    """One parsed source file handed to every rule."""
+
+    path: str            # absolute
+    relpath: str         # root-relative posix
+    tree: ast.Module
+    lines: list
+    #: cross-rule caches (e.g. the RTL001/RTL002 device-function index)
+    cache: dict = field(default_factory=dict)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        return Finding(rule=rule, path=self.relpath, line=lineno,
+                       col=getattr(node, "col_offset", 0), message=message,
+                       line_text=self.line_text(lineno).rstrip())
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def suppressions_for(lines: list) -> dict:
+    """{lineno: set of suppressed rule codes} — ``{"ALL"}`` for blanket
+    ``# raftlint: disable`` comments."""
+    out: dict = {}
+    for i, line in enumerate(lines, 1):
+        if "#" not in line:
+            continue
+        m = _SUPPRESS.search(line)
+        if m:
+            tail = (m.group(1) or "").strip()
+            if tail.startswith("="):
+                cm = _SUPPRESS_CODES.match(tail[1:])
+                if cm:     # `disable=` with no codes: malformed, no-op
+                    out.setdefault(i, set()).update(
+                        c.strip().upper()
+                        for c in cm.group(1).split(",") if c.strip())
+            elif not tail or not tail[0].isalnum():
+                # bare `disable` (optionally followed by a `— reason`):
+                # blanket; `disable RTL004` (missing =) is malformed
+                # and deliberately does NOT suppress
+                out.setdefault(i, set()).add("ALL")
+        if _PRINT_OK.search(line):
+            out.setdefault(i, set()).add("RTL005")
+    return out
+
+
+def is_suppressed(f: Finding, supp: dict) -> bool:
+    codes = supp.get(f.line)
+    return bool(codes) and ("ALL" in codes or f.rule.upper() in codes)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> dict:
+    """{fingerprint: remaining_count} from a committed baseline file."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(f"{path}: not a {BASELINE_SCHEMA} document")
+    out: dict = {}
+    for n, rec in enumerate(doc.get("findings", [])):
+        if not isinstance(rec, dict) or "rule" not in rec \
+                or "path" not in rec:
+            raise ValueError(
+                f"{path}: baseline finding #{n} must be an object with "
+                "'rule' and 'path' keys")
+        f = Finding(rule=rec["rule"], path=rec["path"], line=0, col=0,
+                    message="", line_text=rec.get("line_text", ""))
+        try:
+            count = int(rec.get("count", 1))
+        except (TypeError, ValueError):
+            raise ValueError(f"{path}: baseline finding #{n} has a "
+                             f"non-integer count {rec.get('count')!r}")
+        out[f.fingerprint()] = out.get(f.fingerprint(), 0) + count
+    return out
+
+
+def baseline_doc(findings: list) -> dict:
+    """Serializable baseline covering ``findings`` (for
+    ``--write-baseline``)."""
+    counts: dict = {}
+    for f in findings:
+        key = (f.rule, f.path, f.line_text.strip())
+        counts[key] = counts.get(key, 0) + 1
+    return {"schema": BASELINE_SCHEMA,
+            "comment": "grandfathered raftlint findings — shrink, "
+                       "never grow (docs/static_analysis.md)",
+            "findings": [
+                {"rule": r, "path": p, "line_text": t, "count": n}
+                for (r, p, t), n in sorted(counts.items())]}
+
+
+def apply_baseline(findings: list, baseline: dict) -> tuple:
+    """Split ``findings`` into (reported, baselined)."""
+    remaining = dict(baseline)
+    reported, baselined = [], []
+    for f in findings:
+        fp = f.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            baselined.append(f)
+        else:
+            reported.append(f)
+    return reported, baselined
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths: list, root: str):
+    """Yield absolute paths of .py files under ``paths`` exactly once
+    each, even for overlapping arguments like ``raft_tpu
+    raft_tpu/model.py`` (files pass through; directories are walked,
+    skipping __pycache__/hidden)."""
+    seen = set()
+
+    def emit(path):
+        key = os.path.realpath(path)
+        if key not in seen:
+            seen.add(key)
+            yield path
+
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap):
+            yield from emit(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith("."))
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        yield from emit(os.path.join(dirpath, fname))
+        else:
+            raise FileNotFoundError(f"lint path not found: {p}")
+
+
+def parse_module(path: str, root: str) -> Module:
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    tree = ast.parse(source, filename=path)
+    return Module(path=path, relpath=rel, tree=tree,
+                  lines=source.splitlines())
+
+
+@dataclass
+class Report:
+    findings: list = field(default_factory=list)    # reported (unsuppressed,
+    #                                                 unbaselined)
+    suppressed: list = field(default_factory=list)
+    baselined: list = field(default_factory=list)
+    parse_errors: list = field(default_factory=list)  # Finding (RTL000)
+    checked_files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def all_reported(self) -> list:
+        return self.parse_errors + self.findings
+
+    def to_dict(self) -> dict:
+        return {"schema": REPORT_SCHEMA, "ok": self.ok,
+                "checked_files": self.checked_files,
+                "counts": {"reported": len(self.all_reported()),
+                           "suppressed": len(self.suppressed),
+                           "baselined": len(self.baselined)},
+                "findings": [f.to_dict() for f in self.all_reported()],
+                "suppressed": [f.to_dict() for f in self.suppressed],
+                "baselined": [f.to_dict() for f in self.baselined]}
+
+
+def lint(paths: list = None, root: str = None, config: Config = None,
+         select: set = None, disable: set = None,
+         baseline_path: str = None, rules: list = None) -> Report:
+    """Run the rule set over ``paths`` and return a :class:`Report`.
+
+    ``select``/``disable`` are rule-code sets layered over the config's
+    enable table; ``baseline_path`` overrides the configured baseline
+    (pass ``""`` to force no baseline).
+    """
+    from tools.raftlint import rules as _rules
+    from tools.raftlint.config import load_config
+
+    if config is None:
+        config = load_config(root or ".")
+    root = os.path.abspath(root or config.root)
+    paths = list(paths) if paths else list(config.paths)
+    active = []
+    for rule in (rules if rules is not None else _rules.ALL_RULES):
+        code = rule.code.upper()
+        if select is not None and code not in {c.upper() for c in select}:
+            continue
+        if disable is not None and code in {c.upper() for c in disable}:
+            continue
+        if select is None and not config.enabled(code):
+            continue
+        active.append(rule)
+
+    report = Report()
+    raw: list = []
+    for path in iter_py_files(paths, root):
+        try:
+            mod = parse_module(path, root)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            report.parse_errors.append(Finding(
+                rule="RTL000", path=rel,
+                line=getattr(e, "lineno", 0) or 0, col=0,
+                message=f"unparseable module: {e}"))
+            continue
+        report.checked_files += 1
+        supp = suppressions_for(mod.lines)
+        for rule in active:
+            for f in rule.check(mod, config.options(rule.code)):
+                if is_suppressed(f, supp):
+                    report.suppressed.append(f)
+                else:
+                    raw.append(f)
+
+    raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    bl_path = baseline_path if baseline_path is not None \
+        else config.baseline
+    baseline = {}
+    if bl_path:
+        ap = bl_path if os.path.isabs(bl_path) else os.path.join(root,
+                                                                 bl_path)
+        if os.path.isfile(ap):
+            baseline = load_baseline(ap)
+    report.findings, report.baselined = apply_baseline(raw, baseline)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# output
+# ---------------------------------------------------------------------------
+
+def format_text(report: Report, rules_by_code: dict = None) -> str:
+    out = []
+    for f in report.all_reported():
+        out.append(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}")
+        if f.line_text.strip():
+            out.append(f"    {f.line_text.strip()}")
+    n = len(report.all_reported())
+    out.append(
+        f"raftlint: {report.checked_files} files, "
+        f"{n} finding{'s' if n != 1 else ''} "
+        f"({len(report.suppressed)} suppressed, "
+        f"{len(report.baselined)} baselined)")
+    return "\n".join(out)

@@ -1,0 +1,708 @@
+"""The raftlint rule set — static twins of raft_tpu's runtime contracts.
+
+========  =====================================================  ==============================
+code      checks                                                 runtime twin
+========  =====================================================  ==============================
+RTL001    host-transfer escape inside device code                obs/transfers.py pinned budget
+RTL002    recompile hazards (traced branch, static args, jit     exec_cache warm-start economics
+          built in hot Python loops)
+RTL003    dtype discipline in device-code modules                precision ladder (ROADMAP 5)
+RTL004    exception discipline on solve paths                    errors.py taxonomy + recovery
+RTL005    bare ``print`` in library code                         obs logging/tracing layer
+========  =====================================================  ==============================
+
+All rules are stdlib-``ast`` visitors over one parsed module at a time.
+Cross-module dataflow is intentionally out of scope: the rules
+over-approximate *lexically* (anything defined inside a jitted function
+is device code; any name handed to ``jax.jit``/``lax.*`` is a device
+function) which keeps them fast, deterministic, and explainable.  Known
+limits are documented per rule in docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+def _prefix_match(relpath: str, prefixes) -> bool:
+    """True when root-relative posix ``relpath`` is one of ``prefixes``
+    or lives under a directory prefix."""
+    for p in prefixes or ():
+        p = str(p).rstrip("/")
+        if relpath == p or relpath.startswith(p + "/"):
+            return True
+    return False
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.lax.while_loop' for an Attribute/Name chain, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _import_aliases(tree: ast.Module) -> dict:
+    """Map local alias -> canonical dotted module for plain imports
+    (``import numpy as np`` -> {"np": "numpy"}; ``from jax import
+    numpy as jnp`` -> {"jnp": "jax.numpy"})."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _canonical(dotted: str, aliases: dict) -> str:
+    """Resolve the head of a dotted path through the import aliases:
+    ``jnp.zeros`` -> ``jax.numpy.zeros``."""
+    if not dotted:
+        return dotted
+    head, _, rest = dotted.partition(".")
+    base = aliases.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+def _aliases(mod) -> dict:
+    """Module import aliases, computed once per file (mod.cache)."""
+    if "aliases" not in mod.cache:
+        mod.cache["aliases"] = _import_aliases(mod.tree)
+    return mod.cache["aliases"]
+
+
+class _ParentedWalk:
+    """ast.walk with an ancestor stack (for loop/function containment)."""
+
+    def __init__(self, tree):
+        self.parents: dict = {}
+        stack = [(tree, None)]
+        while stack:
+            node, parent = stack.pop()
+            self.parents[id(node)] = parent
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, node))
+
+    def ancestors(self, node):
+        p = self.parents.get(id(node))
+        while p is not None:
+            yield p
+            p = self.parents.get(id(p))
+
+
+# ---------------------------------------------------------------------------
+# device-function index (shared by RTL001/RTL002)
+# ---------------------------------------------------------------------------
+
+_LAX_TRANSFORMS = {"scan", "while_loop", "cond", "fori_loop", "map",
+                   "switch", "associated_scan", "associative_scan"}
+_FN_TRANSFORMS = {"vmap", "pmap", "checkpoint", "remat", "grad",
+                  "value_and_grad"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Expression that evaluates to a jit transform: ``jax.jit``,
+    ``jit``, ``partial(jax.jit, ...)``, ``jax.jit(**opts)`` used as a
+    decorator factory."""
+    dotted = _dotted(node)
+    if dotted and (dotted == "jit" or dotted.endswith(".jit")):
+        return True
+    if isinstance(node, ast.Call):
+        fdot = _dotted(node.func)
+        if fdot and (fdot == "jit" or fdot.endswith(".jit")):
+            return True            # jax.jit(static_argnums=...) factory
+        if fdot in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def _jit_static_info(call_or_deco: ast.AST) -> tuple:
+    """(static_argnums tuple-or-None, static_argnames tuple-or-None)
+    pulled out of a jit call/decorator expression (literals only).
+    ``partial(jax.jit, static_argnums=...)`` needs no special case: the
+    partial call IS the Call examined, so its keywords are read below."""
+    node = call_or_deco
+    if not isinstance(node, ast.Call):
+        return None, None
+    nums = names = None
+    for kw in node.keywords:
+        if kw.arg == "static_argnums":
+            nums = _literal_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _literal_strs(kw.value)
+    return nums, names
+
+
+def _literal_ints(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _literal_strs(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return None
+
+
+@dataclass
+class DeviceIndex:
+    """Which functions in a module are device (traced) code, and with
+    what static-argument exemptions."""
+
+    #: id(FunctionDef|Lambda) -> (static_argnums, static_argnames)
+    nodes: dict = field(default_factory=dict)
+    #: id -> the AST node itself (nodes holds only statics)
+    node_by_id: dict = field(default_factory=dict)
+    #: every FunctionDef in the module, by name (marking is by name,
+    #: over-approximating shadowed defs)
+    defs: dict = field(default_factory=dict)
+    walk: _ParentedWalk = None
+
+    def device_functions(self):
+        """Yield (fn_node, statics) for every marked function/lambda."""
+        for fnid, statics in self.nodes.items():
+            yield self.node_by_id[fnid], statics
+
+    def is_device_scope(self, node) -> bool:
+        """Node (any AST node) lies lexically inside a device function."""
+        if id(node) in self.nodes:
+            return True
+        for anc in self.walk.ancestors(node):
+            if id(anc) in self.nodes:
+                return True
+        return False
+
+    def owning_device_fn(self, node):
+        if id(node) in self.nodes:
+            return node
+        for anc in self.walk.ancestors(node):
+            if id(anc) in self.nodes:
+                return anc
+        return None
+
+
+def device_index(mod) -> DeviceIndex:
+    """Build (and cache) the module's device-function index."""
+    if "device_index" in mod.cache:
+        return mod.cache["device_index"]
+    tree = mod.tree
+    aliases = _aliases(mod)
+    idx = DeviceIndex(walk=_ParentedWalk(tree))
+
+    attr_aliases: dict = {}        # "solve.batched" -> "solve_batched"
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            idx.defs.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Attribute) \
+                and isinstance(node.value, ast.Name):
+            tgt = _dotted(node.targets[0])
+            if tgt:
+                attr_aliases[tgt] = node.value.id
+
+    marked_names: dict = {}        # name -> (static_nums, static_names)
+
+    def mark_name(name, statics=(None, None)):
+        marked_names.setdefault(name, statics)
+
+    def mark_arg(arg, statics=(None, None)):
+        if isinstance(arg, ast.Name):
+            if arg.id in idx.defs:
+                mark_name(arg.id, statics)
+            elif arg.id in attr_aliases.values():
+                mark_name(arg.id, statics)
+        elif isinstance(arg, ast.Lambda):
+            idx.nodes[id(arg)] = statics
+            idx.node_by_id[id(arg)] = arg
+        elif isinstance(arg, ast.Attribute):
+            target = attr_aliases.get(_dotted(arg))
+            if target:
+                mark_name(target, statics)
+
+    for node in ast.walk(tree):
+        # decorated defs: @jax.jit / @partial(jax.jit, ...) / @jit(...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_expr(deco):
+                    idx.nodes[id(node)] = _jit_static_info(deco)
+                    idx.node_by_id[id(node)] = node
+        elif isinstance(node, ast.Call):
+            fdot = _dotted(node.func)
+            if _is_jit_expr(node.func) or (
+                    fdot and (fdot == "jit" or fdot.endswith(".jit"))):
+                statics = _jit_static_info(node)
+                for arg in node.args:
+                    mark_arg(arg, statics)
+            else:
+                # resolve through the import aliases so ONLY genuine
+                # jax transforms match — a bare `map(...)`/local
+                # `cond(...)` must not mark host code as device scope
+                canon = _canonical(fdot, aliases) if fdot else ""
+                tail = canon.rsplit(".", 1)[-1] if canon else ""
+                is_lax = tail in _LAX_TRANSFORMS and (
+                    f".{canon}".find(".lax.") >= 0
+                    or canon.startswith("lax."))
+                is_fn_tf = tail in _FN_TRANSFORMS and \
+                    canon.startswith(("jax.", "lax."))
+                if is_lax or is_fn_tf:
+                    for arg in node.args:
+                        mark_arg(arg)
+
+    for name, statics in marked_names.items():
+        for d in idx.defs.get(name, []):
+            idx.nodes.setdefault(id(d), statics)
+            idx.node_by_id.setdefault(id(d), d)
+
+    mod.cache["device_index"] = idx
+    return idx
+
+
+def _static_param_names(fn, statics) -> set:
+    """Parameter names exempt from traced-value checks (static under
+    jit)."""
+    if isinstance(fn, ast.Lambda):
+        params = [a.arg for a in fn.args.args]
+    else:
+        params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)]
+    nums, names = statics if statics else (None, None)
+    out = set(names or ())
+    for i in nums or ():
+        if 0 <= i < len(params):
+            out.add(params[i])
+    return out
+
+
+def _param_names(fn) -> list:
+    if isinstance(fn, ast.Lambda):
+        return [a.arg for a in fn.args.args]
+    return [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                            + fn.args.kwonlyargs)]
+
+
+# ---------------------------------------------------------------------------
+# RTL001 — host-transfer escape
+# ---------------------------------------------------------------------------
+
+class RTL001:
+    code = "RTL001"
+    name = "host-transfer-escape"
+    summary = ("device->host pulls inside traced code, or raw "
+               "jax.device_get outside obs/transfers.py")
+
+    _BUILTIN_CASTS = {"float", "int", "bool", "complex"}
+    _NP_PULLS = {"asarray", "array"}
+
+    def check(self, mod, opts):
+        if _prefix_match(mod.relpath, opts.get("sanctioned",
+                                               ["raft_tpu/obs/transfers.py"])):
+            return
+        aliases = _aliases(mod)
+        idx = device_index(mod)
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = _canonical(_dotted(node.func), aliases)
+            # raw jax.device_get ANYWHERE in library code: the counted
+            # wrapper exists precisely so this never appears raw
+            if canon == "jax.device_get":
+                yield mod.finding(
+                    self.code, node,
+                    "raw jax.device_get — route device->host pulls "
+                    "through obs.transfers.device_get so they are "
+                    "counted against the pinned per-case budget")
+                continue
+            if not idx.is_device_scope(node):
+                continue
+            fn = idx.owning_device_fn(node)
+            static = _static_param_names(fn, idx.nodes.get(id(fn)))
+            msg = self._transfer_call(node, canon, aliases, static)
+            if msg:
+                yield mod.finding(self.code, node, msg)
+
+    def _transfer_call(self, node, canon, aliases, static_params):
+        fdot = _dotted(node.func)
+        # builtin casts force a concrete value => trace-time transfer
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self._BUILTIN_CASTS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant):
+                return None
+            if isinstance(arg, ast.Name) and arg.id in static_params:
+                return None
+            if self._is_static_shape_expr(arg):
+                return None
+            return (f"{node.func.id}() on a traced value inside a "
+                    "jitted/lax-transformed function forces a host "
+                    "transfer at trace time")
+        if canon.startswith("numpy.") and \
+                canon.split(".")[-1] in self._NP_PULLS:
+            return (f"{fdot}() inside device code materializes the "
+                    "traced operand on host — keep the math in jnp or "
+                    "pull through obs.transfers.device_get outside "
+                    "the jit boundary")
+        if canon == "jax.device_get" or fdot.endswith(".device_get"):
+            return ("device_get inside a traced function — pulls "
+                    "belong outside the jit boundary, via "
+                    "obs.transfers.device_get")
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "item" and not node.args:
+                return (".item() inside device code is a blocking "
+                        "device->host transfer")
+            if node.func.attr == "block_until_ready":
+                return (".block_until_ready() inside a traced function "
+                        "is a sync point — it belongs to the host "
+                        "orchestration layer")
+        return None
+
+    @staticmethod
+    def _is_static_shape_expr(arg) -> bool:
+        """``int(x.shape[0])`` / ``float(len(xs))`` / ``x.ndim`` are
+        legal transfer-free trace-time constants — exempt expressions
+        mentioning a shape/ndim/size attribute or a len() call (a
+        documented over-exemption for mixed expressions; real escapes
+        pull array VALUES, which never ride a shape access)."""
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Attribute) and n.attr in ("shape",
+                                                           "ndim",
+                                                           "size"):
+                return True
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Name) and n.func.id == "len":
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# RTL002 — recompile hazard
+# ---------------------------------------------------------------------------
+
+class RTL002:
+    code = "RTL002"
+    name = "recompile-hazard"
+    summary = ("Python control flow on traced values, unusable "
+               "static_argnums, jit construction in hot loops")
+
+    def check(self, mod, opts):
+        idx = device_index(mod)
+        yield from self._traced_branches(mod, idx)
+        yield from self._static_arg_hazards(mod, idx)
+        yield from self._jit_in_loop(mod, idx)
+
+    # --- (a) Python if/while/assert on a traced parameter ---------------
+    def _traced_branches(self, mod, idx):
+        for fn, statics in idx.device_functions():
+            if isinstance(fn, ast.Lambda):
+                continue
+            params = set(_param_names(fn)) - {"self", "cls"} \
+                - _static_param_names(fn, statics)
+            if not params:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While, ast.Assert)):
+                    continue
+                test = node.test
+                if self._is_static_test(test):
+                    continue
+                used = {n.id for n in ast.walk(test)
+                        if isinstance(n, ast.Name)}
+                hit = used & params
+                if hit:
+                    kind = {ast.If: "if", ast.While: "while",
+                            ast.Assert: "assert"}[type(node)]
+                    yield mod.finding(
+                        self.code, node,
+                        f"Python `{kind}` on traced parameter(s) "
+                        f"{sorted(hit)} of jitted function "
+                        f"`{getattr(fn, 'name', '<lambda>')}` — "
+                        "concretizes the tracer (error) or recompiles "
+                        "per value; use lax.cond/jnp.where or mark the "
+                        "argument static")
+
+    @staticmethod
+    def _is_static_test(test) -> bool:
+        """Tests that are legitimately static even on a traced name:
+        None-ness and isinstance dispatch (decided at trace time on the
+        python structure, not the array values)."""
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot))
+                for op in test.ops):
+            return True
+        if isinstance(test, ast.Call) and \
+                _dotted(test.func) in ("isinstance", "callable",
+                                       "hasattr"):
+            return True
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return RTL002._is_static_test(test.operand)
+        if isinstance(test, ast.BoolOp):
+            return all(RTL002._is_static_test(v) for v in test.values)
+        return False
+
+    # --- (b) static_argnums/argnames hazards ----------------------------
+    def _static_arg_hazards(self, mod, idx):
+        for fn, statics in idx.device_functions():
+            if isinstance(fn, ast.Lambda) or not statics:
+                continue
+            nums, names = statics
+            params = _param_names(fn)
+            defaults = self._defaults_by_name(fn)
+            for i in nums or ():
+                if i >= len(params) or i < -len(params):
+                    yield mod.finding(
+                        self.code, fn,
+                        f"static_argnums index {i} is out of range for "
+                        f"`{fn.name}` ({len(params)} parameters)")
+                    continue
+                for f in self._unhashable_default(mod, defaults,
+                                                  params[i], fn):
+                    yield f
+            for nm in names or ():
+                if nm not in params:
+                    yield mod.finding(
+                        self.code, fn,
+                        f"static_argnames {nm!r} does not name a "
+                        f"parameter of `{fn.name}`")
+                    continue
+                for f in self._unhashable_default(mod, defaults, nm, fn):
+                    yield f
+
+    @staticmethod
+    def _defaults_by_name(fn) -> dict:
+        args = fn.args.posonlyargs + fn.args.args
+        out = {}
+        for a, d in zip(args[len(args) - len(fn.args.defaults):],
+                        fn.args.defaults):
+            out[a.arg] = d
+        for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if d is not None:
+                out[a.arg] = d
+        return out
+
+    def _unhashable_default(self, mod, defaults, name, fn):
+        d = defaults.get(name)
+        if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and _dotted(d.func) in ("list", "dict", "set")):
+            yield mod.finding(
+                self.code, d,
+                f"parameter {name!r} of `{fn.name}` is marked static "
+                "but defaults to an unhashable "
+                f"{type(d).__name__.lower()} — jit will raise at call "
+                "time; use a tuple/frozen value")
+
+    # --- (c) jit built inside a Python loop -----------------------------
+    def _jit_in_loop(self, mod, idx):
+        for node in ast.walk(mod.tree):
+            # direct jit construction only (func is jax.jit/jit itself);
+            # the immediate application `jax.jit(f)(x)` must not count
+            # the outer call a second time
+            if not isinstance(node, ast.Call):
+                continue
+            fdot = _dotted(node.func)
+            if not (fdot and (fdot == "jit" or fdot.endswith(".jit"))):
+                continue
+            for anc in idx.walk.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    break      # loop must be in the SAME function scope
+                if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                    yield mod.finding(
+                        self.code, node,
+                        "jax.jit constructed inside a Python loop — a "
+                        "fresh wrapper (and, for bound methods/new "
+                        "closures, a fresh trace+compile) every "
+                        "iteration; hoist the jit out of the loop or "
+                        "cache the compiled callable")
+                    break
+
+
+# ---------------------------------------------------------------------------
+# RTL003 — dtype discipline
+# ---------------------------------------------------------------------------
+
+class RTL003:
+    code = "RTL003"
+    name = "dtype-discipline"
+    summary = ("dtype-less jnp constructors / hard numpy dtype literals "
+               "in device-code modules")
+
+    #: constructor -> index of the dtype positional parameter
+    _CTORS = {"zeros": 1, "ones": 1, "empty": 1, "arange": 3,
+              "linspace": 5}
+    _NP_LITERALS = {"float64", "float32", "float16", "complex128",
+                    "complex64"}
+
+    def check(self, mod, opts):
+        device_modules = opts.get("device-modules",
+                                  ["raft_tpu/ops", "raft_tpu/parallel",
+                                   "raft_tpu/model.py"])
+        if not _prefix_match(mod.relpath, device_modules):
+            return
+        aliases = _aliases(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                canon = _canonical(_dotted(node.func), aliases)
+                tail = canon.rsplit(".", 1)[-1]
+                if canon.startswith(("jax.numpy.", "jnp.")) \
+                        and tail in self._CTORS:
+                    if not self._has_dtype(node, self._CTORS[tail]):
+                        yield mod.finding(
+                            self.code, node,
+                            f"jnp.{tail} without an explicit dtype in a "
+                            "device-code module — the result silently "
+                            "follows the ambient x64 flag; pin it "
+                            "(e.g. _config.real_dtype()/complex_dtype(),"
+                            " jnp.int32) so the precision ladder stays "
+                            "auditable")
+            elif isinstance(node, ast.Attribute):
+                canon = _canonical(_dotted(node), aliases)
+                if canon.startswith("numpy.") and \
+                        canon.rsplit(".", 1)[-1] in self._NP_LITERALS:
+                    yield mod.finding(
+                        self.code, node,
+                        f"hard numpy dtype literal `{_dotted(node)}` in "
+                        "a device-code module — use the jnp dtype or "
+                        "_config.real_dtype()/complex_dtype() so "
+                        "precision is governed in one place")
+
+    @staticmethod
+    def _has_dtype(call, dtype_pos) -> bool:
+        if any(kw.arg == "dtype" for kw in call.keywords):
+            return True
+        return len(call.args) > dtype_pos
+
+
+# ---------------------------------------------------------------------------
+# RTL004 — exception discipline
+# ---------------------------------------------------------------------------
+
+class RTL004:
+    code = "RTL004"
+    name = "exception-discipline"
+    summary = ("non-taxonomy raises on solve paths; broad/bare except "
+               "outside the recovery seams")
+
+    _DEFAULT_BANNED_RAISES = [
+        "Exception", "BaseException", "RuntimeError", "ValueError",
+        "TypeError", "KeyError", "IndexError", "ArithmeticError",
+        "FloatingPointError", "ZeroDivisionError", "AssertionError",
+        "StopIteration",
+    ]
+    _BROAD = {"Exception", "BaseException"}
+
+    def check(self, mod, opts):
+        solve_modules = opts.get("solve-modules",
+                                 ["raft_tpu/model.py", "raft_tpu/ops",
+                                  "raft_tpu/parallel", "raft_tpu/io",
+                                  "raft_tpu/recovery.py"])
+        banned = set(opts.get("raise-banned",
+                              self._DEFAULT_BANNED_RAISES)) \
+            - set(opts.get("raise-allowed", []))
+        if _prefix_match(mod.relpath, solve_modules):
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                name = None
+                if isinstance(node.exc, ast.Call) and \
+                        isinstance(node.exc.func, ast.Name):
+                    name = node.exc.func.id
+                elif isinstance(node.exc, ast.Name) and \
+                        node.exc.id in banned:
+                    # `raise SomeVar` re-raises are fine unless the
+                    # name IS a builtin exception class
+                    name = node.exc.id
+                if name in banned:
+                    yield mod.finding(
+                        self.code, node,
+                        f"raise {name} on a solve path — use the typed "
+                        "taxonomy in raft_tpu/errors.py (RaftError "
+                        "subclasses carry structured context for the "
+                        "recovery ladder, quarantine, and manifests)")
+        sanctioned = opts.get("except-sanctioned",
+                              ["raft_tpu/recovery.py",
+                               "raft_tpu/testing/faults.py"])
+        if _prefix_match(mod.relpath, sanctioned):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield mod.finding(
+                    self.code, node,
+                    "bare `except:` swallows everything including "
+                    "KeyboardInterrupt — catch the expected types, or "
+                    "move the recovery into the sanctioned "
+                    "recovery.py/faults.py seams")
+            else:
+                names = self._except_names(node.type)
+                broad = names & self._BROAD
+                if broad:
+                    yield mod.finding(
+                        self.code, node,
+                        f"over-broad `except {'/'.join(sorted(broad))}` "
+                        "outside the sanctioned recovery seams — catch "
+                        "the expected failure types (see "
+                        "errors.RECOVERABLE) so real bugs propagate")
+
+    @staticmethod
+    def _except_names(type_node) -> set:
+        nodes = type_node.elts if isinstance(type_node, ast.Tuple) \
+            else [type_node]
+        out = set()
+        for n in nodes:
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                out.add(n.attr)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# RTL005 — logging discipline
+# ---------------------------------------------------------------------------
+
+class RTL005:
+    code = "RTL005"
+    name = "no-bare-print"
+    summary = "bare print() in library code (use obs/get_logger)"
+
+    def check(self, mod, opts):
+        exempt = opts.get("exempt-files", ["plot.py"])
+        base = mod.relpath.rsplit("/", 1)[-1]
+        if base in exempt or _prefix_match(mod.relpath, [
+                p for p in exempt if "/" in str(p)]):
+            return
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                yield mod.finding(
+                    self.code, node,
+                    "bare print() in library code — route output "
+                    "through utils.profiling.get_logger / the obs "
+                    "layer (tag deliberate report printers with "
+                    "`# print-ok`)")
+
+
+ALL_RULES = [RTL001(), RTL002(), RTL003(), RTL004(), RTL005()]
+RULES_BY_CODE = {r.code: r for r in ALL_RULES}

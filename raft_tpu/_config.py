@@ -145,6 +145,42 @@ def set_statics_mode(mode: str | None):
 
 
 # ---------------------------------------------------------------------------
+# on-device probe channel (obs/probes.py — live in-flight telemetry)
+# ---------------------------------------------------------------------------
+
+#: RAFT_TPU_PROBES values: "off" — probes are trace-time no-ops (the
+#: compiled programs are bit-identical to the pre-probe stack);
+#: "sampled" (default) — coarse sites stream through jax.debug.callback
+#: (statics Newton counts, drag fixed-point residuals per iteration,
+#: sweep chunk residuals, per-lane finite flags); "full" — adds the
+#: high-rate sites tagged level="full".  Read at TRACE time: functions
+#: traced under one mode keep their instrumentation until retraced.
+_PROBE_MODES = ("off", "sampled", "full")
+_probes_override: str | None = None
+
+
+def probes_mode() -> str:
+    """Active probe mode ("off" | "sampled" | "full"); programmatic
+    override beats the ``RAFT_TPU_PROBES`` environment variable,
+    unknown values fall back to "sampled"."""
+    if _probes_override is not None:
+        return _probes_override
+    mode = os.environ.get("RAFT_TPU_PROBES", "sampled").strip().lower()
+    if mode in ("0", "false"):
+        mode = "off"
+    return mode if mode in _PROBE_MODES else "sampled"
+
+
+def set_probes_mode(mode: str | None):
+    """Override the probe mode in-process (None clears).  Only affects
+    functions traced AFTER the change."""
+    global _probes_override
+    if mode is not None and str(mode) not in _PROBE_MODES:
+        raise ValueError(f"probes mode {mode!r} not in {_PROBE_MODES}")
+    _probes_override = None if mode is None else str(mode)
+
+
+# ---------------------------------------------------------------------------
 # automatic recovery (recovery.py ladder + model.py case quarantine)
 # ---------------------------------------------------------------------------
 

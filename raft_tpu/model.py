@@ -34,6 +34,7 @@ solve, and :885 where excitation runs after it).
 from __future__ import annotations
 
 import copy
+import time
 from functools import partial
 
 import numpy as np
@@ -438,7 +439,12 @@ class Model:
             X, F, _K, xf, it, _ = jax.lax.while_loop(
                 cond, body,
                 (X0, F0, K0, xf1, jnp.zeros((), jnp.int32), False))
-            return X, xf, it, jnp.sqrt(jnp.sum(F ** 2))
+            res = jnp.sqrt(jnp.sum(F ** 2))
+            # on-device probe: the Newton trip count/residual stream to
+            # the host DURING execution (RAFT_TPU_PROBES knob; its own
+            # budget — the sanctioned device_get below is untouched)
+            obs.probes.probe("statics_newton", iters=it, residual=res)
+            return X, xf, it, res
 
         donate = (0, 1) if jax.default_backend() != "cpu" else ()
         self._newton_j = jax.jit(newton, donate_argnums=donate)
@@ -1002,6 +1008,10 @@ class Model:
                                       F_lin + F_drag)
                 tolCheck = jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
                 conv = jnp.all(tolCheck < tol)
+                # per-iteration residual streamed live off the device
+                # (trace-time no-op under RAFT_TPU_PROBES=off)
+                obs.probes.probe("drag_fixed_point", it=ii,
+                                 residual=jnp.max(tolCheck))
                 XiNext = jnp.where(conv, XiLast,
                                    keep * XiLast + relax * Xin)
                 return (XiNext, Xin, Zn, Bmat, ii + 1, done | conv)
@@ -1365,13 +1375,15 @@ class Model:
         preemption re-runs only the missing/failed cases.  Set
         ``RAFT_TPU_RECOVERY=0`` to restore fail-fast behavior."""
         obs.install_jax_hooks()
-        obs.record_build_info()
         obs.device.jit_cache_delta(scope="analyzeCases")   # baseline
         nCases = len(self.design["cases"]["data"])
         manifest = obs.RunManifest.begin(kind="analyzeCases", config={
             "nCases": nCases, "nFOWT": self.nFOWT, "nw": self.nw,
             "nDOF": self.nDOF, "nIter": self.nIter,
             "depth": self.depth})
+        # run-scoped process identity: a scrape during this run carries
+        # pid/hostname/run_id on the build-info series
+        obs.record_build_info(run_id=manifest.run_id)
         self.last_manifest = manifest
         self._case_records = {}
         self._dyn_cost_recorded = False
@@ -1415,6 +1427,13 @@ class Model:
                 ledger["extra"] = {"host_transfers": xfers,
                                    "failed_cases": list(self.failed_cases)}
                 self.last_ledger = ledger
+            # drain pending probe callbacks (unordered jax.debug
+            # effects) BEFORE the flight recorder closes — on async
+            # backends the final case's samples may still be in flight
+            try:
+                jax.effects_barrier()
+            except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+                pass
             with temp_verbosity(display):
                 paths = obs.finish_run(manifest, status=status,
                                        ledger=ledger)
@@ -1492,6 +1511,10 @@ class Model:
                     continue
             self.results["case_metrics"][iCase] = {}
             carry0 = self._snapshot_carry()
+            # per-case progress on the flight recorder: a tailed (or
+            # killed) run shows exactly how far it got, as it happens
+            obs.events.emit("case_start", case=iCase, n_cases=nCases)
+            t_case = time.perf_counter()
             ok = False
             try:
                 with faults.context(case=iCase):
@@ -1503,6 +1526,9 @@ class Model:
                 last_err = e
                 self._quarantine_case(iCase, e)
             finally:
+                obs.events.emit(
+                    "case_end", case=iCase, n_cases=nCases, ok=ok,
+                    s=round(time.perf_counter() - t_case, 3))
                 # keep the mean-offset list aligned with the case index
                 # (a failed case may have appended 0 or 1 entries)
                 offs = self.results["mean_offsets"]
@@ -1595,6 +1621,10 @@ class Model:
             "load cases quarantined by analyzeCases after the "
             "degradation ladder was exhausted, by phase").inc(
             1.0, phase=rec.get("phase", "unknown"))
+        obs.events.emit(
+            "quarantine", case=int(iCase),
+            phase=rec.get("phase", "unknown"),
+            error=rec.get("error", type(err).__name__))
         cur = obs.current_span()
         if cur is not None:
             cur.set(failed_cases=len(self.failed_cases))
@@ -1615,6 +1645,9 @@ class Model:
                 self._case_records[str(iCase)] = entry["case_record"]
             self._restore_carry(entry["carry"])
         self._resumed_cases.append(int(iCase))
+        obs.events.emit("case_end", case=int(iCase), ok=True,
+                        resumed=True, s=0.0,
+                        n_cases=len(self.design["cases"]["data"]))
         obs.counter(
             "raft_tpu_cases_resumed_total",
             "load cases restored from the per-case journal instead of "

@@ -1,6 +1,6 @@
 """raft_tpu.obs — observability: tracing, metrics, manifests, ledgers.
 
-Six pillars (see docs/observability.md):
+Nine pillars (see docs/observability.md):
 
 - :mod:`raft_tpu.obs.tracing` — nested wall-time spans with attributes,
   Chrome-trace/Perfetto JSON export, and the name -> (total, calls)
@@ -19,15 +19,29 @@ Six pillars (see docs/observability.md):
 - :mod:`raft_tpu.obs.transfers` — host-transfer accounting: counted
   sanctioned ``device_get`` exit points, per-phase budgets, and a
   transfer-guard wrapper that traps unsanctioned device→host pulls.
+- :mod:`raft_tpu.obs.events` — the flight recorder: a crash-safe,
+  append-only JSONL stream of span/case/probe/recovery/quarantine
+  events flushed *as they happen*, replayable after a kill.
+- :mod:`raft_tpu.obs.probes` — the sanctioned on-device instrumentation
+  channel (``jax.debug.callback``) streaming solver health out of
+  jitted code during execution, on its own counted budget.
+- :mod:`raft_tpu.obs.trendstore` — persistent SQLite run history every
+  finished manifest is appended to, with declarative SLO rules
+  (``obsctl slo``) gating CI and the future serving loop.
 
 File output is opt-in: call ``configure(out_dir=...)`` or set the
 ``RAFT_TPU_OBS_DIR`` environment variable, and every instrumented entry
 point writes ``<kind>_<run_id>.manifest.json`` plus
 ``<kind>_<run_id>.trace.json`` (and, for ledger-emitting entry points,
-``<kind>_<run_id>.ledger.json``) there.  ``configure(...,
+``<kind>_<run_id>.ledger.json``) there — and, live, a
+``status="running"`` manifest stub at ``begin`` (atomically replaced at
+finish; a killed run stays discoverable), the flight recorder's
+``<kind>_<run_id>.events.jsonl`` stream, and a ``trend.sqlite``
+run-history append at finish.  ``configure(...,
 max_runs=N)`` (or ``RAFT_TPU_OBS_MAX_RUNS``) bounds the directory: after
-each write the oldest runs' artifact triples are pruned so at most N
-runs remain.  Without an output directory, spans/metrics still record
+each write the oldest runs' artifact sets are pruned so at most N
+runs remain (the trend store is deliberately exempt — it IS the long
+history).  Without an output directory, spans/metrics still record
 in-process (``Model.last_manifest``, ``timing_report()``,
 ``obs.snapshot()``) and nothing touches the filesystem.
 
@@ -58,6 +72,14 @@ from raft_tpu.obs.ledger import (                               # noqa: F401
 )
 from raft_tpu.obs import device  # noqa: F401
 from raft_tpu.obs import transfers  # noqa: F401
+from raft_tpu.obs import events  # noqa: F401
+from raft_tpu.obs import probes  # noqa: F401
+from raft_tpu.obs import trendstore  # noqa: F401
+from raft_tpu.obs import tracing as _tracing_mod
+
+# stream span open/close into the flight recorder whenever one is
+# active (a cheap no-op check per span otherwise)
+_tracing_mod.set_sink(events._tracing_sink)
 
 _OUT_DIR: str | None = None
 _MAX_RUNS: int | None = None
@@ -93,17 +115,36 @@ def max_runs() -> int | None:
     return n or None
 
 
-#: artifact suffixes that make up one run's on-disk record
-_RUN_SUFFIXES = (".manifest.json", ".trace.json", ".ledger.json")
+#: artifact suffixes that make up one run's on-disk record (the event
+#: file may additionally carry rotated ``.events.jsonl.N`` siblings —
+#: prune_runs removes those by prefix)
+_RUN_SUFFIXES = (".manifest.json", ".trace.json", ".ledger.json",
+                 ".events.jsonl")
+
+
+def _is_running_stub(path: str) -> bool:
+    """True when ``path`` is a ``status="running"`` manifest — an
+    in-flight (or killed) run whose forensic record retention must
+    never delete out from under it."""
+    import json as _json
+    try:
+        with open(path) as f:
+            return _json.load(f).get("status") == "running"
+    except (OSError, ValueError):
+        return False
 
 
 def prune_runs(directory: str, keep: int) -> list[str]:
-    """Delete the oldest runs' artifact triples from ``directory`` so at
+    """Delete the oldest runs' artifact sets from ``directory`` so at
     most ``keep`` runs (identified by their ``*.manifest.json``) remain.
+    ``status="running"`` stubs are exempt: an active run writes its
+    stub at begin (the oldest mtime in the directory by construction),
+    and a killed run's stub+events pair IS the crash-safety record.
     Returns the removed paths."""
     try:
         manifests = [f for f in os.listdir(directory)
-                     if f.endswith(".manifest.json")]
+                     if f.endswith(".manifest.json")
+                     and not _is_running_stub(os.path.join(directory, f))]
     except OSError:
         return []
     if keep <= 0 or len(manifests) <= keep:
@@ -117,8 +158,15 @@ def prune_runs(directory: str, keep: int) -> list[str]:
     removed = []
     for f in manifests[:len(manifests) - keep]:
         stem = f[:-len(".manifest.json")]
-        for suffix in _RUN_SUFFIXES:
-            path = os.path.join(directory, stem + suffix)
+        victims = [stem + suffix for suffix in _RUN_SUFFIXES]
+        # rotated flight-recorder generations (stem.events.jsonl.N)
+        try:
+            victims += [n for n in os.listdir(directory)
+                        if n.startswith(stem + ".events.jsonl.")]
+        except OSError:                              # pragma: no cover
+            pass
+        for name in victims:
+            path = os.path.join(directory, name)
             try:
                 os.remove(path)
                 removed.append(path)
@@ -127,15 +175,50 @@ def prune_runs(directory: str, keep: int) -> list[str]:
     return removed
 
 
+def begin_run(manifest: RunManifest) -> dict:
+    """Crash-safety + live-telemetry hook ``RunManifest.begin`` fires.
+
+    When an output directory is configured this (a) atomically writes a
+    ``status="running"`` manifest stub — so a killed run leaves a
+    discoverable record that ``finish_run`` later replaces — and (b)
+    starts the flight recorder on ``<kind>_<run_id>.events.jsonl``,
+    registering the event file in ``manifest.extra["events"]``.
+    Returns ``{"manifest": path|None, "events": path|None}``; never
+    raises (telemetry must not take down the run it documents)."""
+    paths = {"manifest": None, "events": None}
+    try:
+        d = out_dir()
+        if not d:
+            return paths
+        stem = f"{manifest.kind}_{manifest.run_id}"
+        paths["manifest"] = manifest.write(
+            os.path.join(d, stem + ".manifest.json"))
+        if events.enabled():
+            rec = events.start(os.path.join(d, stem + ".events.jsonl"),
+                               run_id=manifest.run_id,
+                               kind=manifest.kind)
+            if rec is not None:
+                manifest.extra["events"] = {"schema": events.SCHEMA,
+                                            "path": rec.path}
+                paths["events"] = rec.path
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+        pass
+    return paths
+
+
 def finish_run(manifest: RunManifest, status: str = "ok",
                write_trace: bool = True, ledger: dict = None) -> dict:
     """Finish ``manifest`` and, when an output directory is configured,
-    write the manifest JSON (plus the Chrome trace and, when given, the
-    result ledger), then apply the ``max_runs`` retention bound.
+    write the manifest JSON (atomically replacing the ``running`` stub
+    ``begin_run`` left, plus the Chrome trace and, when given, the
+    result ledger), close the run's flight recorder, append the run to
+    the trend store, and apply the ``max_runs`` retention bound.
     Returns ``{"manifest": path|None, "trace": path|None,
-    "ledger": path|None}``."""
+    "ledger": path|None, "events": path|None, "trend": path|None}``."""
     manifest.finish(status)
-    paths = {"manifest": None, "trace": None, "ledger": None}
+    paths = {"manifest": None, "trace": None, "ledger": None,
+             "events": None, "trend": None}
+    paths["events"] = events.finish(manifest.run_id, status=status)
     d = out_dir()
     if d:
         stem = f"{manifest.kind}_{manifest.run_id}"
@@ -147,6 +230,8 @@ def finish_run(manifest: RunManifest, status: str = "ok",
         if ledger is not None:
             paths["ledger"] = write_ledger(
                 ledger, os.path.join(d, stem + ".ledger.json"))
+    paths["trend"] = trendstore.append_manifest(manifest.to_dict())
+    if d:
         keep = max_runs()
         if keep:
             prune_runs(d, keep)
@@ -165,4 +250,5 @@ def reset_all():
     REGISTRY.reset()
     device.reset_jit_cache_baseline()
     transfers.reset()
+    events.stop_all()
     configure(None)

@@ -182,20 +182,39 @@ class RunManifest:
               devices: bool = True) -> "RunManifest":
         """Start a manifest: stamps run id, start time, environment, and
         a baseline of the span aggregate so ``finish()`` reports phase
-        times for THIS run only (the aggregate is process-cumulative)."""
+        times for THIS run only (the aggregate is process-cumulative).
+
+        When an obs output directory is configured this also fires
+        ``obs.begin_run``: a ``status="running"`` manifest stub is
+        written (atomically replaced by ``finish_run`` — a killed run
+        therefore leaves a discoverable record) and the flight recorder
+        opens the run's event file."""
         m = cls(kind=kind, config=dict(config or {}),
                 environment=capture_environment(devices=devices))
         from raft_tpu.obs import tracing as _tracing
         m._phase_baseline = _tracing.aggregate()
+        # the metrics snapshot embedded at finish is process-cumulative;
+        # baseline the probe budget now so the trend store can attribute
+        # probe volume to THIS run (trendstore.facts_from_manifest)
+        from raft_tpu.obs import metrics as _metrics
+        m.extra["probe_events_at_begin"] = _metrics.counter_total(
+            "raft_tpu_probe_events_total")
+        from raft_tpu import obs as _obs
+        _obs.begin_run(m)
         return m
 
     def add_probe_attempt(self, attempt: ProbeAttempt | dict):
         """Append a probe attempt, collapsing it into the previous
-        record when it is an identical consecutive retry."""
+        record when it is an identical consecutive retry.  The attempt
+        also streams to the flight recorder as a ``probe_attempt``
+        event — bench TPU probes are exactly the in-flight phase an
+        operator tails."""
         if isinstance(attempt, ProbeAttempt):
             attempt = attempt.to_dict()
         self.probe_attempts = collapse_probe_attempts(
             self.probe_attempts + [dict(attempt)])
+        from raft_tpu.obs import events as _events
+        _events.emit("probe_attempt", **dict(attempt))
 
     def finish(self, status: str = "ok", metrics: dict = None,
                phases: list = None) -> "RunManifest":

@@ -16,6 +16,7 @@ back to polling the jit cache-miss counters where it does not.
 from __future__ import annotations
 
 import math
+import os
 import threading
 
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
@@ -44,6 +45,13 @@ class _Metric:
                 self._values[key] = float(amount)
             else:
                 self._values[key] = self._values.get(key, 0.0) + float(amount)
+
+    def clear(self):
+        """Drop every series of this metric (info-style gauges whose
+        label VALUES carry the facts — build info with a per-run
+        ``run_id`` — re-record instead of accumulating stale series)."""
+        with self._lock:
+            self._values.clear()
 
     def series(self) -> list[dict]:
         with self._lock:
@@ -231,18 +239,36 @@ def snapshot() -> dict:
     return REGISTRY.snapshot()
 
 
+def counter_total(name: str) -> float:
+    """Summed value across one counter's series (0.0 when unrecorded) —
+    the per-run baselining hook for process-cumulative counters."""
+    m = REGISTRY.snapshot().get(name) or {}
+    return float(sum(s.get("value", 0.0) for s in m.get("series", [])))
+
+
 def to_prometheus() -> str:
     return REGISTRY.to_prometheus()
 
 
-def record_build_info() -> dict:
+def record_build_info(run_id: str = None) -> dict:
     """Info-style ``raft_tpu_build_info`` gauge (value 1, facts as
-    labels: git SHA, dirty working tree, package and jax versions) so
-    every scraped metrics page / embedded manifest snapshot is
-    attributable to a commit.  Returns the label dict."""
+    labels: git SHA, dirty working tree, package and jax versions, plus
+    the PROCESS identity — ``pid``/``hostname`` and, when given, the
+    active ``run_id``) so every scraped metrics page / embedded
+    manifest snapshot is attributable to a commit AND disambiguable in
+    multi-process scrapes (pod-scale runs scrape many workers into one
+    Prometheus).  Exactly one series exists at a time: re-recording
+    clears the previous one instead of accumulating per-run series.
+    Returns the label dict."""
+    import socket
+
     from raft_tpu.obs.manifest import git_dirty, git_sha
 
-    labels = {"git_sha": git_sha() or "unknown"}
+    labels = {"git_sha": git_sha() or "unknown",
+              "pid": str(os.getpid()),
+              "hostname": socket.gethostname()}
+    if run_id:
+        labels["run_id"] = str(run_id)
     dirty = git_dirty()
     labels["dirty"] = "unknown" if dirty is None else str(dirty).lower()
     try:
@@ -255,10 +281,27 @@ def record_build_info() -> dict:
         labels["jax_version"] = jax.__version__
     except Exception:
         labels["jax_version"] = "unavailable"
-    gauge("raft_tpu_build_info",
-          "build/commit identity of the running raft_tpu "
-          "(info-style gauge, always 1)").set(1.0, **labels)
+    g = gauge("raft_tpu_build_info",
+              "build/commit identity and process identity of the "
+              "running raft_tpu (info-style gauge, always 1)")
+    g.clear()
+    g.set(1.0, **labels)
     return labels
+
+
+def exposition(run_id: str = None) -> str:
+    """The Prometheus text page with a process-identity header comment
+    (pid, hostname, optional run id) ahead of the samples — so a
+    multi-process scrape (or a saved page) identifies its producer even
+    before the ``raft_tpu_build_info`` sample.  Comment lines that are
+    not HELP/TYPE are legal exposition-format noise to every parser."""
+    import socket
+
+    head = (f"# raft_tpu exposition pid={os.getpid()} "
+            f"hostname={socket.gethostname()}")
+    if run_id:
+        head += f" run_id={run_id}"
+    return head + "\n" + to_prometheus()
 
 
 def record_solve_dispatch(backend: str, n, batch_elems, fused: bool = False):
@@ -279,10 +322,13 @@ def record_solve_dispatch(backend: str, n, batch_elems, fused: bool = False):
 
 def record_exec_cache_event(event: str):
     """Count a persistent executable-cache event (hit/miss/store/error),
-    from ``parallel.exec_cache``."""
+    from ``parallel.exec_cache`` — also streamed to the flight recorder
+    so warm-start behavior is visible in a tailed run."""
     counter("raft_exec_cache_events_total",
             "persistent executable cache events (hit / miss / store / "
             "error)").inc(1.0, event=str(event))
+    from raft_tpu.obs import events as _events
+    _events.emit("exec_cache", event=str(event))
 
 
 # ---------------------------------------------------------------------------

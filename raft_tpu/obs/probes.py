@@ -1,0 +1,164 @@
+"""On-device probes: the sanctioned host-callback instrumentation channel.
+
+The device-resident solve paths (PR 4) pull results to host only
+through the counted ``obs.transfers`` exit points, whose per-case
+budget is test-pinned.  That leaves no legal way to *watch* a jitted
+solve from the host while it executes — per-iteration fixed-point
+residuals, statics Newton trip counts, and per-lane health flags live
+and die inside the compiled program.  This module is the one sanctioned
+escape: :func:`probe` plants a ``jax.debug.callback`` inside traced
+code that streams small diagnostic values to the host **during**
+execution, feeding the metrics registry and the flight recorder
+(``obs.events``) without touching the pinned transfer budget — probe
+traffic is counted in its own ``raft_tpu_probe_events_total`` ledger
+instead.
+
+Knob (``raft_tpu._config.probes_mode``): ``RAFT_TPU_PROBES`` =
+
+- ``off`` — :func:`probe` is a trace-time no-op: the compiled program
+  is bit-identical to the pre-probe stack and zero probe events exist.
+- ``sampled`` (default) — coarse-grained sites compile in: one sample
+  per statics Newton solve, per drag fixed-point iteration, per
+  adaptive-unroll chunk, per sweep batch (lane flags).
+- ``full`` — everything ``sampled`` has plus any site tagged
+  ``level="full"`` (reserved for high-rate diagnostics).
+
+The mode is read at *trace* time: functions traced under one mode keep
+their instrumentation until retraced (a fresh ``Model`` / process picks
+up a changed knob).  Probes never alter numerics — the callback
+receives copies and returns nothing — so golden-ledger gates hold with
+any mode.
+
+AOT interaction: ``jax.export`` cannot serialize host callbacks, so the
+executable-cache entry points (``sweep_cases`` / ``sweep_variants``)
+build their cacheable programs inside :func:`suppress` — cached sweeps
+are probe-free by construction and one cache entry serves every probe
+mode.  The statically enforced twin of this contract is raftlint
+RTL001: ``jax.debug.callback`` / ``io_callback`` may appear in
+``raft_tpu`` only in this module (``[tool.raftlint.rtl001]
+probe-sanctioned``).
+
+Like the rest of ``raft_tpu.obs``, nothing here imports jax at module
+scope.
+"""
+from __future__ import annotations
+
+import threading
+
+_LEVELS = {"off": 0, "sampled": 1, "full": 2}
+
+_LOCAL = threading.local()
+
+
+def mode() -> str:
+    """Active probe mode ("off" | "sampled" | "full")."""
+    from raft_tpu import _config
+    return _config.probes_mode()
+
+
+def enabled(level: str = "sampled") -> bool:
+    """Trace-time gate: would a probe at ``level`` compile in right
+    now?  False inside :func:`suppress` blocks regardless of mode."""
+    if getattr(_LOCAL, "suppressed", 0) > 0:
+        return False
+    return _LEVELS.get(mode(), 0) >= _LEVELS.get(str(level), 1)
+
+
+class suppress:
+    """Context manager that forces probes off for code traced inside it
+    — wraps the AOT lower/export of cacheable programs, which
+    ``jax.export`` could not serialize with callbacks embedded."""
+
+    def __init__(self, why: str = ""):
+        self.why = str(why)
+
+    def __enter__(self):
+        _LOCAL.suppressed = getattr(_LOCAL, "suppressed", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _LOCAL.suppressed = max(0, getattr(_LOCAL, "suppressed", 1) - 1)
+        return False
+
+
+def probe(name: str, level: str = "sampled", **values):
+    """Stream ``values`` (scalars or small arrays) out of traced code.
+
+    Call this *inside* jitted / ``lax``-transformed functions; at trace
+    time it either compiles to nothing (knob below ``level``) or plants
+    an unordered ``jax.debug.callback`` whose host half records the
+    sample:
+
+    - ``raft_tpu_probe_events_total{probe}`` counts every arrival (the
+      probe channel's own budget — the pinned ``obs.transfers``
+      host-transfer budget is untouched);
+    - scalar values land in ``raft_tpu_probe_value{probe,field}``;
+    - the full sample is appended to the flight recorder as a
+      ``probe`` event when one is active.
+
+    The callback is unordered: samples may arrive out of program order
+    (the flight recorder's ``seq``/``t`` stamp arrival, not issue).
+    Never raises and never changes the computation's values.
+    """
+    if not enabled(level):
+        return
+    import jax
+
+    def _sink(**host_values):
+        _record(name, host_values)
+
+    try:
+        jax.debug.callback(_sink, ordered=False, **values)
+    # an unprobeable context (e.g. a transform debug.callback does not
+    # support) must degrade to "no sample", never to a failed solve
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+        pass
+
+
+def _summarize(v):
+    """Host-side payload shaping: scalars pass through, small arrays
+    become lists, large arrays become {n, finite, min, max} summaries."""
+    import numpy as np
+
+    arr = np.asarray(v)
+    if arr.ndim == 0:
+        return arr.item()
+    if arr.size <= 32:
+        return arr.tolist()
+    if np.issubdtype(arr.dtype, np.floating):
+        finite_mask = np.isfinite(arr)
+        finite = arr[finite_mask]
+        return {"n": int(arr.size), "finite": int(finite_mask.sum()),
+                "min": float(finite.min()) if finite.size else None,
+                "max": float(finite.max()) if finite.size else None}
+    return {"n": int(arr.size), "finite": int(arr.size),
+            "min": float(arr.min()) if arr.size else None,
+            "max": float(arr.max()) if arr.size else None}
+
+
+def _record(name: str, host_values: dict):
+    """Host half of the probe channel (runs on callback arrival)."""
+    try:
+        from raft_tpu.obs import events as _events
+        from raft_tpu.obs import metrics as _metrics
+
+        _metrics.counter(
+            "raft_tpu_probe_events_total",
+            "on-device probe samples streamed through the sanctioned "
+            "jax.debug.callback channel, by probe name (the probe "
+            "channel's own budget — separate from "
+            "raft_tpu_host_transfers_total)").inc(1.0, probe=str(name))
+        fields = {}
+        for k, v in host_values.items():
+            s = _summarize(v)
+            fields[k] = s
+            if isinstance(s, (int, float)) and not isinstance(s, bool):
+                _metrics.gauge(
+                    "raft_tpu_probe_value",
+                    "most recent scalar value per probe field"
+                    ).set(float(s), probe=str(name), field=str(k))
+        _events.emit("probe", probe=str(name), values=fields)
+    # the probe sink is telemetry: it must never propagate into the
+    # runtime's callback machinery (which would poison the solve)
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+        pass

@@ -39,6 +39,28 @@ _AGG: dict[str, list] = {}          # name -> [total_seconds, calls]
 _DROPPED = 0
 _T0 = time.perf_counter()           # trace time origin (relative us in export)
 _LOCAL = threading.local()
+#: optional live event sink fn(kind, payload) — the flight recorder
+#: (obs.events) registers here so span open/close stream to disk as
+#: they happen; exceptions are swallowed (telemetry never fails a span)
+_SINK = None
+
+
+def set_sink(fn):
+    """Install (or clear, with None) the live span-event sink."""
+    global _SINK
+    _SINK = fn
+
+
+def _to_sink(kind: str, payload: dict):
+    sink = _SINK
+    if sink is None:
+        return
+    try:
+        sink(kind, payload)
+    # the sink is best-effort telemetry; a failing recorder must never
+    # break the span protocol around solver code
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+        pass
 
 
 def _stack() -> list:
@@ -94,6 +116,11 @@ def span(name: str, **attrs):
     sp.depth = len(stack)
     stack.append(sp)
     sp.t0 = time.perf_counter()
+    if _SINK is not None:
+        _to_sink("span_open", {
+            "name": name, "ts": sp.t0 - _T0,
+            "tid": threading.get_ident(), "depth": sp.depth,
+            "parent": sp.parent, "attrs": dict(sp.attrs)})
     try:
         yield sp
     finally:
@@ -117,6 +144,7 @@ def span(name: str, **attrs):
                 _SPANS.append(event)
             else:
                 _DROPPED += 1
+        _to_sink("span_close", event)
 
 
 def current_span() -> ActiveSpan | None:

@@ -1,0 +1,477 @@
+"""Persistent run-history trend store (SQLite) + declarative SLO rules.
+
+``obsctl trend`` originally re-scanned a directory of JSON artifacts on
+every invocation — fine for a dozen bench rounds, useless as the
+durable substrate for SLO reporting (ROADMAP item 1 needs
+admission/backpressure decisions driven by run history).  This module
+replaces that model with one SQLite file every instrumented entry point
+appends to: ``obs.finish_run`` folds each finished manifest into a row
+(identity columns + a flat JSON ``facts`` blob of the SLO-relevant
+scalars extracted by :func:`facts_from_manifest`), and ``obsctl
+slo``/``serve`` read it back.
+
+Location: ``RAFT_TPU_TREND_DB`` names the database file explicitly;
+otherwise it defaults to ``<obs out_dir>/trend.sqlite`` whenever an obs
+output directory is configured (no out dir, no store — same opt-in
+stance as every other obs artifact).  ``RAFT_TPU_TREND=0`` disables
+appends outright.  Every write is best-effort: a locked or unwritable
+database must never take down the run it is recording.
+
+SLO rules are plain JSON (see :data:`DEFAULT_SLO_RULES`)::
+
+    {"name": "warm_s_per_case_p50",     # report label
+     "kind": "analyzeCases",            # manifest kind filter
+     "fact": "s_per_case",              # facts key (numeric)
+     "agg": "p50",                      # p50|p90|mean|max|min|last|sum|
+                                        #   count|ratio (ratio needs
+                                        #   "denom": other facts key)
+     "op": "<=", "threshold": 120.0,    # the gate
+     "window": 20,                      # newest N qualifying runs
+     "status": "ok"}                    # row status filter (default ok)
+
+:func:`evaluate_slo` runs a rule list over trend rows and returns a
+structured report with a single ``ok`` verdict — ``obsctl slo`` turns
+that into an exit code for CI.  Rules with no qualifying data are
+*skipped*, not failed (a fresh checkout must not fail its first gate),
+unless the rule says ``"required": true``.
+
+Stdlib only (sqlite3/json) — never imports jax; safe on a wedged host.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sqlite3
+
+SCHEMA_VERSION = 1
+
+_DDL = """
+CREATE TABLE IF NOT EXISTS runs (
+    run_id      TEXT PRIMARY KEY,
+    kind        TEXT,
+    status      TEXT,
+    started_at  TEXT,
+    finished_at TEXT,
+    duration_s  REAL,
+    git_sha     TEXT,
+    hostname    TEXT,
+    pid         INTEGER,
+    facts       TEXT
+);
+CREATE INDEX IF NOT EXISTS runs_kind ON runs (kind, started_at);
+"""
+
+
+def enabled() -> bool:
+    """Trend-store appends active?  ``RAFT_TPU_TREND=0`` disables."""
+    return os.environ.get("RAFT_TPU_TREND", "1").strip() != "0"
+
+
+def db_path() -> str | None:
+    """Active database path: ``RAFT_TPU_TREND_DB``, else
+    ``<obs out_dir>/trend.sqlite`` when an obs dir is configured, else
+    None (store disabled)."""
+    if not enabled():
+        return None
+    explicit = os.environ.get("RAFT_TPU_TREND_DB")
+    if explicit:
+        return explicit
+    from raft_tpu import obs
+    d = obs.out_dir()
+    return os.path.join(d, "trend.sqlite") if d else None
+
+
+# ---------------------------------------------------------------------------
+# facts extraction
+# ---------------------------------------------------------------------------
+
+def _num(v):
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, float)) and math.isfinite(float(v)):
+        return v
+    return None
+
+
+def facts_from_manifest(doc: dict) -> dict:
+    """Flatten one run manifest to the scalar facts the SLO rules gate
+    on.  Missing structure yields missing facts, never errors — rules
+    simply skip runs that lack their fact."""
+    facts: dict = {}
+    extra = doc.get("extra") or {}
+    config = doc.get("config") or {}
+    dur = _num(doc.get("duration_s"))
+    n_cases = _num(config.get("nCases") if "nCases" in config
+                   else config.get("ncases"))
+    if n_cases is not None:
+        facts["cases_total"] = n_cases
+    if dur is not None:
+        facts["duration_s"] = dur
+        if n_cases:
+            facts["s_per_case"] = dur / n_cases
+    failed = extra.get("failed_cases")
+    if isinstance(failed, list):
+        facts["cases_failed"] = len(failed)
+    quar = extra.get("quarantine") or {}
+    if isinstance(quar.get("quarantined"), list):
+        facts["quarantined_lanes"] = len(quar["quarantined"])
+    resumed = extra.get("resumed_cases")
+    if isinstance(resumed, list):
+        facts["cases_resumed"] = len(resumed)
+    attempts = (extra.get("recovery") or {}).get("attempts")
+    if isinstance(attempts, list):
+        facts["recovery_attempts"] = len(attempts)
+        facts["recovery_recovered"] = sum(
+            1 for a in attempts if a.get("outcome") == "recovered")
+    xfers = extra.get("host_transfers") or {}
+    total = (xfers.get("total") or {}).get("events")
+    if _num(total) is not None:
+        facts["transfer_events"] = total
+    for ph, per in (xfers.get("per_case") or {}).items():
+        if _num(per) is not None:
+            facts[f"transfers_per_case_{ph}"] = per
+    cache_state = (extra.get("exec_cache") or {}).get("state")
+    if cache_state:
+        facts["exec_cache_warm"] = int(cache_state == "hit")
+    res = extra.get("result") or {}
+    for k in ("value", "vs_baseline", "analyze_cases_s_per_case"):
+        if _num(res.get(k)) is not None:
+            facts[f"result_{k}"] = res[k]
+    # probe-channel volume (its own budget, distinct from transfers):
+    # the embedded metrics snapshot is process-cumulative, so subtract
+    # the baseline RunManifest.begin recorded for THIS run
+    probe = (doc.get("metrics") or {}).get("raft_tpu_probe_events_total")
+    if probe:
+        total = sum(s.get("value", 0) for s in probe.get("series", []))
+        base = _num(extra.get("probe_events_at_begin")) or 0
+        facts["probe_events"] = max(0.0, total - base)
+    return facts
+
+
+def row_from_manifest(doc: dict) -> dict:
+    env = doc.get("environment") or {}
+    return {
+        "run_id": doc.get("run_id"),
+        "kind": doc.get("kind"),
+        "status": doc.get("status"),
+        "started_at": doc.get("started_at"),
+        "finished_at": doc.get("finished_at"),
+        "duration_s": _num(doc.get("duration_s")),
+        "git_sha": env.get("git_sha"),
+        "hostname": env.get("hostname"),
+        "pid": env.get("pid"),
+        "facts": facts_from_manifest(doc),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+_COLS = ("run_id", "kind", "status", "started_at", "finished_at",
+         "duration_s", "git_sha", "hostname", "pid", "facts")
+
+
+class TrendStore:
+    """One SQLite run-history file.  Connections are opened per
+    operation (short transactions, 5 s busy timeout) so a solver
+    appending and an ``obsctl serve`` scraping never deadlock."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        with self._connect() as con:
+            con.executescript(_DDL)
+
+    def _connect(self) -> sqlite3.Connection:
+        con = sqlite3.connect(self.path, timeout=5.0)
+        con.row_factory = sqlite3.Row
+        return con
+
+    _INSERT = (f"INSERT OR REPLACE INTO runs ({','.join(_COLS)}) "
+               f"VALUES ({','.join('?' * len(_COLS))})")
+
+    @staticmethod
+    def _row_values(row: dict) -> list:
+        return [row.get(c) if c != "facts"
+                else json.dumps(row.get("facts") or {}) for c in _COLS]
+
+    def append(self, manifest_doc: dict) -> dict:
+        """Fold one finished manifest into the store (upsert by
+        run_id).  Returns the stored row."""
+        row = row_from_manifest(manifest_doc)
+        with self._connect() as con:
+            con.execute(self._INSERT, self._row_values(row))
+        return row
+
+    def rows(self, kind: str = None, status: str = None,
+             limit: int = None) -> list[dict]:
+        """Rows newest-first (by started_at, then rowid)."""
+        q = "SELECT * FROM runs"
+        clauses, params = [], []
+        if kind:
+            clauses.append("kind = ?")
+            params.append(kind)
+        if status:
+            clauses.append("status = ?")
+            params.append(status)
+        if clauses:
+            q += " WHERE " + " AND ".join(clauses)
+        q += " ORDER BY started_at DESC, rowid DESC"
+        if limit:
+            q += " LIMIT ?"
+            params.append(int(limit))
+        with self._connect() as con:
+            out = []
+            for r in con.execute(q, params):
+                d = dict(r)
+                try:
+                    d["facts"] = json.loads(d.get("facts") or "{}")
+                except (TypeError, json.JSONDecodeError):
+                    d["facts"] = {}
+                out.append(d)
+            return out
+
+    def count(self) -> int:
+        with self._connect() as con:
+            return int(con.execute(
+                "SELECT COUNT(*) FROM runs").fetchone()[0])
+
+    def ingest(self, paths: list[str]) -> int:
+        """Load manifest JSON files and/or JSONL row files (the
+        committed trend fixtures) into the store.  Returns rows added.
+        Unreadable entries are skipped — ingestion is for operators and
+        CI fixtures, not a validation gate."""
+        rows = [row for path in paths for row in load_rows(path)]
+        if rows:
+            with self._connect() as con:
+                con.executemany(self._INSERT,
+                                [self._row_values(r) for r in rows])
+        return len(rows)
+
+
+def load_rows(path: str) -> list[dict]:
+    """Rows from a manifest JSON file or a JSONL fixture of row dicts
+    (``{"run_id", "kind", "status", ..., "facts": {...}}``)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return []
+    text = text.strip()
+    if not text:
+        return []
+    if text.startswith("{") and "\n{" not in text:
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            return []
+        if str(doc.get("schema", "")).startswith("raft_tpu.run_manifest/"):
+            return [row_from_manifest(doc)]
+        return [doc] if "run_id" in doc else []
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict) and "run_id" in doc:
+            if str(doc.get("schema", "")).startswith(
+                    "raft_tpu.run_manifest/"):
+                doc = row_from_manifest(doc)
+            rows.append(doc)
+    return rows
+
+
+def append_manifest(manifest_doc: dict, path: str = None) -> str | None:
+    """Best-effort append of one manifest to the active store; returns
+    the db path written, or None when the store is disabled/broken.
+    The call ``obs.finish_run`` makes on every finished run."""
+    try:
+        db = path or db_path()
+        if not db:
+            return None
+        TrendStore(db).append(manifest_doc)
+        return db
+    # a locked/unwritable trend db must never take down the run that
+    # just finished (obs contract)
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+        return None
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluation
+# ---------------------------------------------------------------------------
+
+#: the four gates the ISSUE names, with deliberately loose default
+#: thresholds — operators tighten them per deployment via --rules
+DEFAULT_SLO_RULES = [
+    {"name": "warm_s_per_case_p50", "kind": "analyzeCases",
+     "fact": "s_per_case", "agg": "p50", "op": "<=", "threshold": 120.0,
+     "window": 20},
+    {"name": "recovery_rate", "kind": "analyzeCases",
+     "fact": "recovery_recovered", "denom": "recovery_attempts",
+     "agg": "ratio", "op": ">=", "threshold": 0.5, "window": 50},
+    {"name": "cases_failed_ratio", "kind": "analyzeCases",
+     "fact": "cases_failed", "denom": "cases_total", "agg": "ratio",
+     "op": "<=", "threshold": 0.25, "window": 50},
+    {"name": "transfers_per_case_statics", "kind": "analyzeCases",
+     "fact": "transfers_per_case_statics", "agg": "max", "op": "<=",
+     "threshold": 1.0, "window": 20},
+    {"name": "transfers_per_case_dynamics", "kind": "analyzeCases",
+     "fact": "transfers_per_case_dynamics", "agg": "max", "op": "<=",
+     "threshold": 4.0, "window": 20},
+]
+
+_OPS = {
+    "<=": lambda v, t: v <= t,
+    "<": lambda v, t: v < t,
+    ">=": lambda v, t: v >= t,
+    ">": lambda v, t: v > t,
+    "==": lambda v, t: v == t,
+}
+
+
+def _percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile (deterministic, no interpolation)."""
+    vs = sorted(values)
+    k = max(0, min(len(vs) - 1, math.ceil(q / 100.0 * len(vs)) - 1))
+    return vs[k]
+
+
+def _aggregate(rule: dict, rows: list[dict]):
+    """(value, n) of the rule's aggregate over the qualifying rows;
+    (None, n) when the aggregate is undefined on this data."""
+    fact = rule.get("fact")
+    vals = [float(r["facts"][fact]) for r in rows
+            if _num(r.get("facts", {}).get(fact)) is not None]
+    agg = str(rule.get("agg", "last")).lower()
+    if agg == "ratio":
+        denom_key = rule.get("denom")
+        num = sum(vals)
+        den = sum(float(r["facts"][denom_key]) for r in rows
+                  if _num(r.get("facts", {}).get(denom_key)) is not None)
+        return (None if den == 0 else num / den), len(vals)
+    if agg == "count":
+        return float(len(vals)), len(vals)
+    if not vals:
+        return None, 0
+    if agg in ("p50", "p90", "p95", "p99"):
+        return _percentile(vals, float(agg[1:])), len(vals)
+    if agg == "mean":
+        return sum(vals) / len(vals), len(vals)
+    if agg == "max":
+        return max(vals), len(vals)
+    if agg == "min":
+        return min(vals), len(vals)
+    if agg == "sum":
+        return sum(vals), len(vals)
+    return vals[0], len(vals)          # "last": rows are newest-first
+
+
+def evaluate_slo(rows: list[dict], rules: list[dict] = None) -> dict:
+    """Run ``rules`` (default :data:`DEFAULT_SLO_RULES`) over trend
+    rows (as :meth:`TrendStore.rows` returns them, newest first).
+
+    Returns ``{"ok": bool, "results": [{name, value, n, op, threshold,
+    ok, skipped}]}``; a rule with no qualifying data is skipped (ok)
+    unless it carries ``"required": true``."""
+    results = []
+    all_ok = True
+    for rule in (DEFAULT_SLO_RULES if rules is None else rules):
+        sel = [r for r in rows
+               if (not rule.get("kind") or r.get("kind") == rule["kind"])
+               and r.get("status") == rule.get("status", "ok")]
+        window = rule.get("window")
+        if window:
+            sel = sel[:int(window)]
+        value, n = _aggregate(rule, sel)
+        res = {"name": rule.get("name", rule.get("fact")),
+               "fact": rule.get("fact"), "agg": rule.get("agg"),
+               "op": rule.get("op", "<="),
+               "threshold": rule.get("threshold"),
+               "value": value, "n": n, "skipped": value is None}
+        if value is None:
+            res["ok"] = not rule.get("required", False)
+        else:
+            op = _OPS.get(str(rule.get("op", "<=")))
+            res["ok"] = bool(op and op(float(value),
+                                       float(rule.get("threshold", 0))))
+        all_ok = all_ok and res["ok"]
+        results.append(res)
+    return {"ok": all_ok, "results": results}
+
+
+# ---------------------------------------------------------------------------
+# live-metrics evaluation (obsctl slo --url against obsctl serve)
+# ---------------------------------------------------------------------------
+
+def parse_prometheus(text: str) -> dict:
+    """Minimal Prometheus text-exposition parser:
+    ``{name: [(labels_dict, value), ...]}`` — enough to gate on the
+    pages ``obs.metrics.to_prometheus`` / ``obsctl serve`` produce."""
+    import re
+
+    sample = re.compile(
+        r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(-?[\d.eE+-]+|NaN)$")
+    label = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = sample.match(line)
+        if not m:
+            continue
+        name, labelstr, value = m.groups()
+        labels = {k: v.replace('\\"', '"').replace("\\\\", "\\")
+                  for k, v in label.findall(labelstr or "")}
+        try:
+            out.setdefault(name, []).append((labels, float(value)))
+        except ValueError:                       # pragma: no cover
+            continue
+    return out
+
+
+def evaluate_metric_rules(series: dict, rules: list[dict]) -> dict:
+    """Gate live scraped metrics: each rule names a ``metric`` (and an
+    optional ``labels`` subset to match); ``agg`` sum|max|min|count
+    over the matching samples (default sum).  Same report shape as
+    :func:`evaluate_slo`."""
+    results = []
+    all_ok = True
+    for rule in rules:
+        name = rule.get("metric")
+        want = rule.get("labels") or {}
+        samples = [v for labels, v in series.get(name, [])
+                   if all(labels.get(k) == str(v2)
+                          for k, v2 in want.items())]
+        agg = str(rule.get("agg", "sum")).lower()
+        if not samples:
+            value = None
+        elif agg == "max":
+            value = max(samples)
+        elif agg == "min":
+            value = min(samples)
+        elif agg == "count":
+            value = float(len(samples))
+        else:
+            value = sum(samples)
+        res = {"name": rule.get("name", name), "metric": name,
+               "op": rule.get("op", ">="),
+               "threshold": rule.get("threshold"), "value": value,
+               "n": len(samples), "skipped": value is None}
+        if value is None:
+            res["ok"] = not rule.get("required", False)
+        else:
+            op = _OPS.get(str(rule.get("op", ">=")))
+            res["ok"] = bool(op and op(float(value),
+                                       float(rule.get("threshold", 0))))
+        all_ok = all_ok and res["ok"]
+        results.append(res)
+    return {"ok": all_ok, "results": results}

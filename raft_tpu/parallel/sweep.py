@@ -17,6 +17,8 @@ over the devices of a 1-D mesh.
 """
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -72,17 +74,23 @@ def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2,
     default 0.8 reproduces the reference 0.2/0.8 scheme bitwise, and
     the batch-quarantine ladder re-solves diverged lanes with stronger
     damping (e.g. 0.5)."""
+    from raft_tpu.obs import probes
     from raft_tpu.recovery import relax_weights
 
     chunk = int(chunk) if chunk else nIter
     keep, relax = relax_weights(relax)
+    # trace-time gate: under RAFT_TPU_PROBES>=sampled (and outside
+    # probes.suppress, i.e. not in an AOT-exported program) each chunk
+    # streams its residual/convergence state off-device as it runs
+    probing = probes.enabled("sampled")
 
     def passes(count, carry):
         XiLast, Xi, done, iters, chunks_run = carry
+        rel = None
         for _ in range(count):
             Xin = step(XiLast)
-            conv = jnp.all(jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
-                           < tol, axis=(-2, -1))
+            rel = jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
+            conv = jnp.all(rel < tol, axis=(-2, -1))
             frozen = done[:, None, None]
             XiNext = jnp.where(frozen | conv[:, None, None], XiLast,
                                keep * XiLast + relax * Xin)
@@ -90,6 +98,9 @@ def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2,
             iters = iters + jnp.where(done, 0, 1)
             done = done | conv
             XiLast = XiNext
+        if probing:
+            probes.probe("sweep_fp_chunk", chunk=chunks_run,
+                         n_done=jnp.sum(done), residual=jnp.max(rel))
         return (XiLast, Xi, done, iters, chunks_run + 1)
 
     carry = (Xi0, Xi0, jnp.zeros(Xi0.shape[0], bool),
@@ -195,6 +206,12 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
             lambda XiLast: drag_step(st, XiLast), Xi0, nIter, tol,
             chunk=fp_chunk, relax=relax)
         std = get_rms(Xi, axis=-1)
+        # per-lane health streamed out of the batched program while it
+        # runs — the finite/converged flags an operator tails to see a
+        # lane go bad before the batch summary pull lands
+        from raft_tpu.obs import probes
+        probes.probe("sweep_lanes", finite=_lane_finite(Xi),
+                     converged=done, iters=iters)
         return dict(Xi=Xi, std=std, converged=done, iters=iters,
                     fp_chunks=chunks)
 
@@ -292,6 +309,9 @@ def _quarantine_lanes(fowt, Hs, Tp, beta, out, bad, kw, iters, conv_np):
     out["iters"] = jnp.asarray(iters)
     info["quarantined"] = sorted(set(info["lanes"])
                                  - set(info["recovered"]))
+    obs.events.emit("quarantine", phase="sweep", lanes=info["lanes"],
+                    recovered=info["recovered"],
+                    quarantined=info["quarantined"])
     if info["quarantined"]:
         _LOG.warning("sweep quarantine: lanes %s unrecoverable "
                      "(left NaN)", info["quarantined"])
@@ -333,7 +353,7 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
         "sharded": mesh is not None,
         "mesh_devices": 0 if mesh is None else int(mesh.devices.size),
         **{k: v for k, v in kw.items() if isinstance(v, (int, float, str))}})
-    obs.record_build_info()
+    obs.record_build_info(run_id=manifest.run_id)
     obs.device.jit_cache_delta(scope="sweep_cases")      # delta baseline
     transfers0 = obs.transfers.snapshot()
     status = "failed"
@@ -400,8 +420,15 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
             if out is None:
                 # AOT: lower once (static HLO cost analysis of the sweep
                 # kernel rides along for free), compile, execute — the
-                # same single trace+compile a plain jitted call would do
-                with obs.span("sweep_lower", ncases=ncases):
+                # same single trace+compile a plain jitted call would do.
+                # Cacheable programs are traced with probes suppressed:
+                # jax.export cannot serialize host callbacks, so the
+                # stored executable is probe-free by construction (and
+                # one entry serves every RAFT_TPU_PROBES mode).
+                probe_gate = (obs.probes.suppress("aot-exported program")
+                              if key is not None
+                              else contextlib.nullcontext())
+                with obs.span("sweep_lower", ncases=ncases), probe_gate:
                     lowered = batched.lower(Hs, Tp, beta)
                     obs.device.cost_analysis(lowered, kernel="sweep_batched")
                 with obs.span("sweep_compile", ncases=ncases):
@@ -410,7 +437,8 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                     out = compiled(Hs, Tp, beta)
                     jax.block_until_ready(out["std"])
                 if key is not None:
-                    with obs.span("sweep_cache_store", ncases=ncases):
+                    with obs.span("sweep_cache_store", ncases=ncases), \
+                            obs.probes.suppress("aot-exported program"):
                         stored = exec_cache.store(
                             batched, (Hs, Tp, beta), key,
                             meta={"fn": "sweep_cases", "ncases": ncases,
@@ -522,5 +550,10 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
         status = "ok"
         return out
     finally:
+        # drain pending probe callbacks before the recorder closes
+        try:
+            jax.effects_barrier()
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
         obs.finish_run(manifest, status=status, write_trace=False,
                        ledger=ledger)

@@ -444,8 +444,14 @@ def sweep_variants(base: FOWTModel, thetas: dict, mesh: Optional[Mesh] = None,
         if out is None:
             # AOT lower/compile: the same single trace+compile a jitted
             # call would do, with the static HLO cost analysis (FLOPs /
-            # bytes estimates for the variant kernel) riding along free
-            with obs.span("variants_lower", nv=nv):
+            # bytes estimates for the variant kernel) riding along free.
+            # Cacheable programs trace with probes suppressed — the
+            # jax.export serialization cannot carry host callbacks
+            # (same stance as sweep_cases).
+            import contextlib
+            probe_gate = (obs.probes.suppress("aot-exported program")
+                          if key is not None else contextlib.nullcontext())
+            with obs.span("variants_lower", nv=nv), probe_gate:
                 lowered = batched.lower(thetas)
                 cost = obs.device.cost_analysis(lowered,
                                                 kernel="variant_batched")
@@ -457,7 +463,8 @@ def sweep_variants(base: FOWTModel, thetas: dict, mesh: Optional[Mesh] = None,
                 out = compiled(thetas)
                 jax.block_until_ready(out["std"])
             if key is not None:
-                with obs.span("variants_cache_store", nv=nv):
+                with obs.span("variants_cache_store", nv=nv), \
+                        obs.probes.suppress("aot-exported program"):
                     exec_cache.store(batched, (thetas,), key,
                                      meta={"fn": "sweep_variants", "nv": nv})
         obs.gauge(

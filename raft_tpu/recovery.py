@@ -130,6 +130,9 @@ def record_attempt(attempt: RecoveryAttempt, recorder=None):
                            **{"from": attempt.step_from,
                               "to": attempt.step_to},
                            outcome=attempt.outcome)
+        # the flight recorder streams every ladder transition as it
+        # happens — a tailed run shows recovery in flight, not post-hoc
+        obs.events.emit("recovery", **attempt.to_dict())
     except Exception:                                 # pragma: no cover
         pass
     if recorder is not None:

@@ -45,6 +45,12 @@ def _obs_isolation(monkeypatch, tmp_path):
     monkeypatch.delenv("RAFT_TPU_OBS_MAX_RUNS", raising=False)
     monkeypatch.delenv("RAFT_TPU_FAULTS", raising=False)
     monkeypatch.delenv("RAFT_TPU_RECOVERY", raising=False)
+    monkeypatch.delenv("RAFT_TPU_TREND", raising=False)
+    monkeypatch.delenv("RAFT_TPU_TREND_DB", raising=False)
+    monkeypatch.delenv("RAFT_TPU_EVENTS", raising=False)
+    monkeypatch.delenv("RAFT_TPU_EVENTS_MAX_BYTES", raising=False)
+    monkeypatch.delenv("RAFT_TPU_EVENTS_KEEP", raising=False)
+    monkeypatch.delenv("RAFT_TPU_PROBES", raising=False)
     monkeypatch.setenv("RAFT_TPU_JOURNAL_DIR", str(tmp_path / "journal"))
     faults.clear()
     obs.reset_all()

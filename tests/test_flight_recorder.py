@@ -1,0 +1,513 @@
+"""Flight recorder (obs/events.py) + on-device probes (obs/probes.py).
+
+Cheap units cover the recorder lifecycle (line-flushed JSONL, size
+rotation, torn-tail tolerance, validation, progress/ETA) and the probe
+channel on a tiny jitted function (mode gating, the separate probe
+budget, ``suppress`` for AOT-exported programs).
+
+The crash-safety acceptance runs in a *subprocess killed with
+``os._exit``* (no finally blocks, no atexit — the honest SIGKILL
+shape): the ``status="running"`` manifest stub and the line-flushed
+event file must be the only survivors, and replaying the JSONL must
+reconstruct per-case progress up to the kill point.
+
+The model integration (module-scoped, one coarse Vertical_cylinder
+case each) proves the ISSUE acceptance criterion: under the default
+``RAFT_TPU_PROBES=sampled`` a clean run streams fixed-point-residual
+and statics-Newton probe events while the pinned PR 4 host-transfer
+budget (statics=1, dynamics=4 pulls/case) still holds *exactly*, and a
+fault-injected failing run leaves a replayable event stream whose span
+tree matches what ``tracing.export`` produced in-process.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from raft_tpu import _config, errors, obs
+from raft_tpu.obs import events, probes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# unit: recorder lifecycle
+# ---------------------------------------------------------------------------
+
+def test_recorder_writes_line_flushed_jsonl(tmp_path):
+    path = tmp_path / "run.events.jsonl"
+    rec = events.FlightRecorder(str(path), run_id="r1", kind="unit")
+    rec.emit("case_start", case=0, n_cases=2)
+    # the begin + case_start lines are already ON DISK before close —
+    # that is the crash-safety contract
+    evs = events.read(str(path))
+    assert [e["type"] for e in evs] == ["begin", "case_start"]
+    assert evs[0]["schema"] == events.SCHEMA
+    assert evs[0]["run_id"] == "r1" and evs[0]["pid"] == os.getpid()
+    rec.close(status="ok")
+    evs = events.read(str(path))
+    assert evs[-1] == {**evs[-1], "type": "end", "status": "ok"}
+    assert events.validate(evs) == []
+    rec.close()                                   # idempotent
+
+
+def test_recorder_rotates_by_size(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_EVENTS_MAX_BYTES", "400")
+    path = tmp_path / "run.events.jsonl"
+    rec = events.FlightRecorder(str(path), run_id="r2", kind="unit")
+    for i in range(60):
+        rec.emit("tick", i=i, pad="x" * 40)
+    rec.close()
+    assert os.path.isfile(str(path) + ".1")
+    assert os.path.isfile(str(path) + ".2")
+    assert not os.path.isfile(str(path) + ".3")   # keep bound (default 2)
+    evs = events.read(str(path))
+    # every generation restarts with its own begin header + part number
+    assert evs[0]["type"] == "begin" and evs[0]["part"] > 0
+    prev = events.read(str(path) + ".1")
+    assert prev[0]["type"] == "begin"
+    assert prev[0]["part"] == evs[0]["part"] - 1
+
+
+def test_read_tolerates_torn_tail_and_validate_flags_gaps(tmp_path):
+    path = tmp_path / "t.events.jsonl"
+    rec = events.FlightRecorder(str(path), run_id="r3", kind="unit")
+    rec.emit("case_start", case=0)
+    rec.close()
+    with open(path, "a") as f:
+        f.write('{"seq": 99, "t": 1.0, "type": "torn', )
+    evs = events.read(str(path))
+    assert [e["type"] for e in evs] == ["begin", "case_start", "end"]
+    assert events.validate(evs) == []
+    # a gap (dropped line) is flagged, as is an alien header
+    gappy = [evs[0], evs[2]]
+    assert any("seq" in p for p in events.validate(gappy))
+    assert any("begin" in p for p in events.validate(evs[1:]))
+    assert events.validate([]) == ["no events"]
+
+
+def test_read_incremental_offsets_and_torn_line(tmp_path):
+    path = tmp_path / "inc.events.jsonl"
+    with open(path, "w") as f:
+        f.write('{"seq": 0, "t": 1.0, "type": "begin"}\n')
+        f.write('{"seq": 1, "t": 2.0, "type": "case_start"')   # torn
+    evs, off = events.read_incremental(str(path), 0)
+    assert [e["type"] for e in evs] == ["begin"]
+    with open(path, "a") as f:                 # the torn line completes
+        f.write(', "case": 0}\n')
+    more, off2 = events.read_incremental(str(path), off)
+    assert [e["type"] for e in more] == ["case_start"]
+    assert more[0]["case"] == 0
+    assert off2 == os.path.getsize(path)
+    # no growth: nothing parsed, offset unchanged
+    again, off3 = events.read_incremental(str(path), off2)
+    assert again == [] and off3 == off2
+
+
+def test_progress_excludes_resumed_from_eta():
+    t0 = 1754300000.0
+    evs = [
+        {"seq": 0, "t": t0, "type": "begin", "schema": events.SCHEMA,
+         "run_id": "r", "kind": "analyzeCases", "pid": 1},
+        {"seq": 1, "t": t0, "type": "case_end", "case": 0, "ok": True,
+         "resumed": True, "s": 0.0, "n_cases": 3},
+        {"seq": 2, "t": t0 + 20, "type": "case_end", "case": 1,
+         "ok": True, "s": 20.0, "n_cases": 3},
+    ]
+    p = events.progress(evs)
+    # the restored case's s=0.0 must not drag the average (and thence
+    # the ETA) toward zero
+    assert p["resumed"] == 1 and p["done"] == 2
+    assert p["avg_case_s"] == pytest.approx(20.0)
+    assert p["eta_s"] == pytest.approx(20.0)      # 1 case left
+
+
+def test_progress_incremental_fold_matches_batch():
+    t0 = 1754300000.0
+    evs = [
+        {"seq": 0, "t": t0, "type": "begin", "schema": events.SCHEMA,
+         "run_id": "r", "kind": "analyzeCases", "pid": 1},
+        {"seq": 1, "t": t0 + 1, "type": "case_start", "case": 0,
+         "n_cases": 3},
+        {"seq": 2, "t": t0 + 9, "type": "case_end", "case": 0,
+         "n_cases": 3, "ok": True, "s": 8.0},
+        {"seq": 3, "t": t0 + 9, "type": "probe", "probe": "p",
+         "values": {}},
+        {"seq": 4, "t": t0 + 10, "type": "case_start", "case": 1,
+         "n_cases": 3},
+        {"seq": 5, "t": t0 + 22, "type": "case_end", "case": 1,
+         "n_cases": 3, "ok": True, "s": 12.0},
+    ]
+    batch = events.public_progress(events.progress(evs))
+    folded = events.progress(evs[:2])
+    for e in evs[2:]:
+        folded = events.progress([e], state=folded)
+    assert events.public_progress(folded) == batch
+    assert batch["eta_s"] == pytest.approx(10.0)   # 1 left x avg 10 s
+    assert "_" not in batch
+
+
+def test_prune_runs_spares_running_stubs(tmp_path):
+    obs.configure(str(tmp_path), max_runs=2)
+    stub = obs.RunManifest.begin(kind="unit", devices=False)  # never
+    finished = []                                             # finished
+    for _ in range(3):
+        m = obs.RunManifest.begin(kind="unit", devices=False)
+        obs.finish_run(m, status="ok")
+        finished.append(m.run_id)
+    names = set(os.listdir(tmp_path))
+    # retention kept the 2 newest FINISHED runs and the oldest-mtime
+    # running stub survived untouched (it is the active/killed run's
+    # forensic record)
+    assert f"unit_{stub.run_id}.manifest.json" in names
+    assert f"unit_{stub.run_id}.events.jsonl" in names
+    assert not any(finished[0] in n for n in names)
+    assert all(any(rid in n for n in names) for rid in finished[1:])
+    obs.reset_all()
+
+
+def test_progress_and_eta():
+    t0 = 1754300000.0
+    evs = [
+        {"seq": 0, "t": t0, "type": "begin", "schema": events.SCHEMA,
+         "run_id": "r", "kind": "analyzeCases", "pid": 1},
+        {"seq": 1, "t": t0 + 1, "type": "case_start", "case": 0,
+         "n_cases": 4},
+        {"seq": 2, "t": t0 + 11, "type": "case_end", "case": 0,
+         "n_cases": 4, "ok": True, "s": 10.0},
+        {"seq": 3, "t": t0 + 11, "type": "case_start", "case": 1,
+         "n_cases": 4},
+        {"seq": 4, "t": t0 + 31, "type": "case_end", "case": 1,
+         "n_cases": 4, "ok": False, "s": 20.0},
+        {"seq": 5, "t": t0 + 31, "type": "quarantine", "case": 1,
+         "phase": "dynamics", "error": "NonFiniteResult"},
+        {"seq": 6, "t": t0 + 32, "type": "probe", "probe": "p",
+         "values": {}},
+    ]
+    p = events.progress(evs)
+    assert p["status"] == "running"            # no end record = in flight
+    assert p["n_cases"] == 4 and p["done"] == 2 and p["failed"] == 1
+    assert p["avg_case_s"] == pytest.approx(15.0)
+    assert p["eta_s"] == pytest.approx(30.0)   # 2 remaining x 15 s
+    assert p["probes"] == 1 and p["quarantined"] == 1
+    done = p | {}
+    evs.append({"seq": 7, "t": t0 + 40, "type": "end", "status": "failed"})
+    p2 = events.progress(evs)
+    assert p2["status"] == "failed" and p2["eta_s"] is None
+    assert done["status"] == "running"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a hard-killed run leaves the stub + a replayable stream
+# ---------------------------------------------------------------------------
+
+_KILL_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["RAFT_TPU_OBS_DIR"] = {obs_dir!r}
+from raft_tpu import obs
+
+m = obs.RunManifest.begin(kind="sweep_cases",
+                          config={{"ncases": 3}}, devices=False)
+print(m.run_id, flush=True)
+with obs.span("sweep_cases", ncases=3):
+    with obs.span("sweep_build", ncases=3):
+        pass
+    obs.events.emit("case_start", case=0, n_cases=3)
+    obs.events.emit("case_end", case=0, n_cases=3, ok=True, s=2.0)
+    obs.events.emit("case_start", case=1, n_cases=3)
+    os._exit(9)        # SIGKILL shape: no finally, no atexit, no finish
+"""
+
+
+def test_hard_killed_run_leaves_running_stub_and_replayable_events(
+        tmp_path):
+    obs_dir = str(tmp_path / "obs")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _KILL_SCRIPT.format(repo=REPO, obs_dir=obs_dir)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 9, proc.stderr
+    run_id = proc.stdout.strip().splitlines()[-1]
+    stem = os.path.join(obs_dir, f"sweep_cases_{run_id}")
+    # the crash-safety satellite: a killed run is DISCOVERABLE — the
+    # begin-time stub is a valid manifest frozen at status "running"
+    stub = json.load(open(stem + ".manifest.json"))
+    assert obs.validate_manifest(stub) == []
+    assert stub["status"] == "running" and stub["run_id"] == run_id
+    # the flight recorder's line-flushed JSONL survived the kill and
+    # replays per-case progress up to the kill point
+    evs = events.read(stem + ".events.jsonl")
+    assert events.validate(evs) == []
+    assert [e["type"] for e in evs if not e["type"].startswith("span")] \
+        == ["begin", "case_start", "case_end", "case_start"]
+    p = events.progress(evs)
+    assert p["status"] == "running"            # no end record: killed
+    assert p["done"] == 1 and p["in_flight"] == 1 and p["n_cases"] == 3
+    # the inner sweep_build span closed before the kill and replays;
+    # the outer sweep_cases span never closed — exactly the truth
+    names = [e["name"] for e in
+             events.to_chrome_trace(evs)["traceEvents"]]
+    assert names == ["sweep_build"]
+
+
+# ---------------------------------------------------------------------------
+# unit: probe channel on a tiny jitted function
+# ---------------------------------------------------------------------------
+
+def _probe_counts():
+    snap = obs.snapshot().get("raft_tpu_probe_events_total", {})
+    return {s["labels"]["probe"]: s["value"]
+            for s in snap.get("series", [])}
+
+
+def test_probe_modes_budget_and_suppress():
+    import jax
+    import jax.numpy as jnp
+
+    def build():
+        @jax.jit
+        def f(x):
+            def body(c):
+                x, i = c
+                x = x * 0.5
+                probes.probe("t_iter", it=i, residual=jnp.max(jnp.abs(x)))
+                return (x, i + 1)
+            x, i = jax.lax.while_loop(lambda c: c[1] < 3, body, (x, 0))
+            probes.probe("t_final", iters=i)
+            probes.probe("t_verbose", level="full", v=jnp.sum(x))
+            return x
+        return f
+
+    try:
+        # sampled (default): both sampled sites fire, "full" site doesn't
+        _config.set_probes_mode("sampled")
+        build()(jnp.ones(4))
+        jax.effects_barrier()
+        counts = _probe_counts()
+        assert counts == {"t_iter": 3.0, "t_final": 1.0}
+        snap = obs.snapshot()
+        vals = {(s["labels"]["probe"], s["labels"]["field"]): s["value"]
+                for s in snap["raft_tpu_probe_value"]["series"]}
+        assert vals[("t_final", "iters")] == 3.0
+
+        # full: the high-rate site joins in
+        obs.reset_all()
+        _config.set_probes_mode("full")
+        build()(jnp.ones(4))
+        jax.effects_barrier()
+        assert _probe_counts() == {"t_iter": 3.0, "t_final": 1.0,
+                                   "t_verbose": 1.0}
+
+        # off: trace-time no-op — the probe budget is exactly zero
+        obs.reset_all()
+        _config.set_probes_mode("off")
+        build()(jnp.ones(4))
+        jax.effects_barrier()
+        assert _probe_counts() == {}
+
+        # suppress: probes vanish from programs traced inside the block
+        # (the AOT-export seam), and the result stays exportable
+        obs.reset_all()
+        _config.set_probes_mode("sampled")
+        with probes.suppress("aot"):
+            g = build()
+            lowered = g.lower(jnp.ones(4))
+        from jax import export as jexport
+        jexport.export(g)(jnp.ones(4)).serialize()   # must not raise
+        g(jnp.ones(4))
+        jax.effects_barrier()
+        assert _probe_counts() == {}
+        assert lowered is not None
+    finally:
+        _config.set_probes_mode(None)
+
+
+def test_probe_events_reach_flight_recorder(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    obs.configure(str(tmp_path))
+    m = obs.RunManifest.begin(kind="unit", devices=False)
+    f = jax.jit(lambda x: (probes.probe("t_rec", v=jnp.max(x)), x + 1)[1])
+    f(jnp.ones(3))
+    jax.effects_barrier()
+    paths = obs.finish_run(m, status="ok")
+    evs = events.read(paths["events"])
+    pe = [e for e in evs if e["type"] == "probe"]
+    assert pe and pe[0]["probe"] == "t_rec"
+    assert pe[0]["values"]["v"] == 1.0
+
+
+def test_probe_array_summarization():
+    # host-side shaping: small arrays ride whole, large ones summarize
+    small = probes._summarize(np.arange(4.0))
+    assert small == [0.0, 1.0, 2.0, 3.0]
+    big = np.ones(100)
+    big[7] = np.nan
+    s = probes._summarize(big)
+    assert s["n"] == 100 and s["finite"] == 99 and s["max"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# model integration: the ISSUE acceptance criterion on a coarse cylinder
+# ---------------------------------------------------------------------------
+
+def _cyl_design(ncases=1):
+    from raft_tpu.io.designs import load_design
+
+    design = load_design("Vertical_cylinder")
+    design.setdefault("settings", {})
+    design["settings"].update({"min_freq": 0.05, "max_freq": 0.5})
+    row0 = list(design["cases"]["data"][0])
+    ih = design["cases"]["keys"].index("wave_height")
+    rows = []
+    for i in range(ncases):
+        row = list(row0)
+        row[ih] = 1.0 + 0.5 * i
+        rows.append(row)
+    design["cases"]["data"] = rows
+    return design
+
+
+@pytest.fixture(scope="module")
+def flight_runs(tmp_path_factory):
+    """One clean 1-case run and one fault-injected failing 2-case run
+    of the coarse cylinder, both with an obs dir configured and the
+    default (sampled) probe mode; obs facts captured per run."""
+    import jax
+
+    from raft_tpu.model import Model
+    from raft_tpu.testing import faults
+
+    os.environ["RAFT_TPU_JOURNAL"] = "0"
+    state = {}
+    try:
+        # ---- clean run -------------------------------------------------
+        obs.reset_all()
+        faults.clear()
+        clean_dir = str(tmp_path_factory.mktemp("obs_clean"))
+        obs.configure(clean_dir)
+        m = Model(_cyl_design(1))
+        m.analyzeCases()
+        jax.effects_barrier()
+        state["clean"] = {
+            "dir": clean_dir,
+            "manifest": m.last_manifest.to_dict(),
+            "events_path": m.last_manifest.extra["events"]["path"],
+            "snap": obs.snapshot(),
+            "transfers": obs.transfers.snapshot(),
+            "chrome": obs.chrome_trace(),
+        }
+
+        # ---- fault-injected failing run (recovery off: the typed
+        # failure propagates — the "killed mid-flight" soft shape) ----
+        obs.reset_all()
+        os.environ["RAFT_TPU_RECOVERY"] = "0"
+        faults.install("raise@dynamics:case=1")
+        fail_dir = str(tmp_path_factory.mktemp("obs_fail"))
+        obs.configure(fail_dir)
+        m2 = Model(_cyl_design(2))
+        err = None
+        try:
+            m2.analyzeCases()
+        except errors.DynamicsSingular as e:
+            err = e
+        jax.effects_barrier()
+        state["faulted"] = {
+            "dir": fail_dir,
+            "err": err,
+            "manifest": m2.last_manifest.to_dict(),
+            "events_path": m2.last_manifest.extra["events"]["path"],
+            "chrome": obs.chrome_trace(),
+        }
+        yield state
+    finally:
+        os.environ.pop("RAFT_TPU_RECOVERY", None)
+        os.environ.pop("RAFT_TPU_JOURNAL", None)
+        faults.clear()
+        obs.reset_all()
+
+
+def test_clean_run_budget_holds_with_probes_streaming(flight_runs):
+    """Acceptance: RAFT_TPU_PROBES=sampled streams fixed-point residual
+    and statics-Newton events while the pinned host-transfer budget
+    (statics=1, dynamics=4 pulls/case) holds EXACTLY."""
+    clean = flight_runs["clean"]
+    xfers = {ph: rec["events"]
+             for ph, rec in clean["transfers"]["phases"].items()}
+    assert xfers == {"statics": 1, "dynamics": 4}
+    counts = {s["labels"]["probe"]: s["value"]
+              for s in clean["snap"]["raft_tpu_probe_events_total"]
+              ["series"]}
+    assert counts.get("statics_newton", 0) >= 1
+    assert counts.get("drag_fixed_point", 0) >= 1
+    # the probe budget also lands in the manifest's metrics snapshot
+    mani_probe = clean["manifest"]["metrics"][
+        "raft_tpu_probe_events_total"]["series"]
+    assert sum(s["value"] for s in mani_probe) == sum(counts.values())
+
+
+def test_clean_run_events_replay_span_tree(flight_runs):
+    clean = flight_runs["clean"]
+    evs = events.read(clean["events_path"])
+    assert events.validate(evs) == []
+    p = events.progress(evs)
+    assert p["status"] == "ok" and p["done"] == 1 and p["n_cases"] == 1
+    assert p["probes"] >= 2
+    # replay == the in-process Chrome trace, event for event
+    replayed = events.to_chrome_trace(evs)["traceEvents"]
+    live = clean["chrome"]["traceEvents"]
+    assert [(e["name"], e["ts"], e["dur"]) for e in replayed] \
+        == [(e["name"], e["ts"], e["dur"]) for e in live]
+    # the run-scoped build-info series carries the process identity
+    (s,) = clean["snap"]["raft_tpu_build_info"]["series"]
+    assert s["labels"]["pid"] == str(os.getpid())
+    assert s["labels"]["run_id"] == clean["manifest"]["run_id"]
+
+
+def test_faulted_run_stream_reconstructs_progress(flight_runs):
+    faulted = flight_runs["faulted"]
+    assert faulted["err"] is not None and faulted["err"].injected
+    assert faulted["manifest"]["status"] == "failed"
+    evs = events.read(faulted["events_path"])
+    assert events.validate(evs) == []
+    cases = [(e["type"], e.get("case")) for e in evs
+             if e["type"].startswith("case_")]
+    assert cases == [("case_start", 0), ("case_end", 0),
+                     ("case_start", 1), ("case_end", 1)]
+    ends = [e for e in evs if e["type"] == "case_end"]
+    assert ends[0]["ok"] is True and ends[1]["ok"] is False
+    p = events.progress(evs)
+    assert p["status"] == "failed"
+    assert p["done"] == 2 and p["failed"] == 1
+    replayed = events.to_chrome_trace(evs)["traceEvents"]
+    live = faulted["chrome"]["traceEvents"]
+    assert [(e["name"], e["ts"]) for e in replayed] \
+        == [(e["name"], e["ts"]) for e in live]
+
+
+def test_finished_runs_land_in_trend_store(flight_runs):
+    from raft_tpu.obs import trendstore
+
+    clean = flight_runs["clean"]
+    store = trendstore.TrendStore(
+        os.path.join(clean["dir"], "trend.sqlite"))
+    (row,) = store.rows()
+    assert row["run_id"] == clean["manifest"]["run_id"]
+    assert row["status"] == "ok"
+    facts = row["facts"]
+    assert facts["cases_total"] == 1 and facts["cases_failed"] == 0
+    assert facts["transfers_per_case_statics"] == 1.0
+    assert facts["transfers_per_case_dynamics"] == 4.0
+    assert facts["probe_events"] >= 2
+    # the failing run landed in ITS dir's store with status failed
+    faulted = flight_runs["faulted"]
+    store2 = trendstore.TrendStore(
+        os.path.join(faulted["dir"], "trend.sqlite"))
+    (row2,) = store2.rows()
+    assert row2["status"] == "failed"

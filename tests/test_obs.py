@@ -333,7 +333,8 @@ def test_finish_run_writes_manifest_and_trace(tmp_path):
 def test_finish_run_without_dir_writes_nothing(tmp_path):
     m = obs.RunManifest.begin("unit", devices=False)
     paths = obs.finish_run(m, status="ok")
-    assert paths == {"manifest": None, "trace": None, "ledger": None}
+    assert paths == {"manifest": None, "trace": None, "ledger": None,
+                     "events": None, "trend": None}
     assert m.status == "ok"
 
 
@@ -381,13 +382,26 @@ def test_max_runs_retention_prunes_oldest(tmp_path):
 
 def test_build_info_gauge():
     labels = obs.record_build_info()
-    assert set(labels) == {"git_sha", "dirty", "version", "jax_version"}
+    assert set(labels) == {"git_sha", "dirty", "version", "jax_version",
+                           "pid", "hostname"}
     assert labels["dirty"] in ("true", "false", "unknown")
+    assert labels["pid"] == str(os.getpid())
     snap = obs.snapshot()
     (s,) = snap["raft_tpu_build_info"]["series"]
     assert s["value"] == 1.0
     assert s["labels"]["git_sha"] == labels["git_sha"]
     assert "raft_tpu_build_info{" in obs.to_prometheus()
+    # run-scoped identity: re-recording with a run_id REPLACES the
+    # series (exactly one build_info at any time) and the exposition
+    # header names the producer
+    labels2 = obs.record_build_info(run_id="runabc123")
+    assert labels2["run_id"] == "runabc123"
+    (s2,) = obs.snapshot()["raft_tpu_build_info"]["series"]
+    assert s2["labels"]["run_id"] == "runabc123"
+    page = obs.metrics.exposition(run_id="runabc123")
+    head = page.splitlines()[0]
+    assert head.startswith("# raft_tpu exposition pid=")
+    assert "run_id=runabc123" in head
 
 
 def test_collapse_probe_attempts():

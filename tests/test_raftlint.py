@@ -175,6 +175,38 @@ def test_rtl001_static_shape_casts_are_exempt(tmp_path):
     assert rep.findings == []
 
 
+# the probe-channel contract: host callbacks may appear ONLY in
+# obs/probes.py (its traffic is counted in raft_tpu_probe_events_total)
+HOST_CALLBACK = """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    @jax.jit
+    def solve(x):
+        jax.debug.callback(lambda v: None, jnp.max(x))
+        return x + 1
+
+    def stream(x):
+        return io_callback(lambda v: v, x, x)
+"""
+
+
+def test_rtl001_host_callback_fires_outside_probes(tmp_path):
+    rep = lint_src(tmp_path, HOST_CALLBACK, "RTL001",
+                   relname="raft_tpu/model.py")
+    assert len(rep.findings) == 2
+    assert all("obs.probes" in f.message for f in rep.findings)
+    assert any("debug" in f.message for f in rep.findings)
+    assert any("io_callback" in f.message for f in rep.findings)
+
+
+def test_rtl001_probe_module_is_sanctioned(tmp_path):
+    rep = lint_src(tmp_path, HOST_CALLBACK, "RTL001",
+                   relname="raft_tpu/obs/probes.py")
+    assert rep.findings == []
+
+
 # ---------------------------------------------------------------------------
 # RTL002 — recompile hazard
 # ---------------------------------------------------------------------------

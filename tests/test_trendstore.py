@@ -1,0 +1,331 @@
+"""Trend store (obs/trendstore.py) + the obsctl tail/serve/slo surface.
+
+Pure-stdlib tests: facts extraction from manifests, the SQLite store
+round trip (append/upsert/ingest), SLO rule evaluation (percentiles,
+ratios, windows, skip-vs-required), the committed golden-run fixture
+gate CI runs, the Prometheus page parser, and the `obsctl` subcommands
+— `slo` exit codes, `trend --db`, `tail`, and an in-process `serve`
+scrape of /healthz /metrics /runs /events.  No model solves, no jax.
+"""
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from raft_tpu.obs import events, trendstore  # noqa: E402
+from tools import obsctl  # noqa: E402
+
+GOLDEN_FIXTURE = os.path.join(REPO, "tests", "golden",
+                              "trend_fixture.jsonl")
+
+
+def _manifest_doc(run_id="r1", status="ok", n_cases=3, duration=90.0,
+                  **extra):
+    return {
+        "schema": "raft_tpu.run_manifest/v1", "run_id": run_id,
+        "kind": "analyzeCases", "status": status,
+        "started_at": "2026-08-02T10:00:00+00:00",
+        "finished_at": "2026-08-02T10:01:30+00:00",
+        "duration_s": duration,
+        "environment": {"git_sha": "abc", "hostname": "h", "pid": 42},
+        "config": {"nCases": n_cases}, "phases": [],
+        "metrics": {"raft_tpu_probe_events_total": {
+            "kind": "counter", "series": [
+                {"labels": {"probe": "statics_newton"}, "value": 3.0},
+                {"labels": {"probe": "drag_fixed_point"}, "value": 17.0},
+            ]}},
+        "probe_attempts": [],
+        "extra": {
+            "failed_cases": [{"case": 1}],
+            "resumed_cases": [0],
+            "recovery": {"attempts": [
+                {"outcome": "failed"}, {"outcome": "recovered"}]},
+            "host_transfers": {
+                "total": {"events": 15, "arrays": 40, "bytes": 1000},
+                "per_case": {"statics": 1.0, "dynamics": 4.0}},
+            "exec_cache": {"state": "hit"},
+            **extra,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# facts extraction + store round trip
+# ---------------------------------------------------------------------------
+
+def test_facts_from_manifest():
+    facts = trendstore.facts_from_manifest(_manifest_doc())
+    assert facts["cases_total"] == 3
+    assert facts["s_per_case"] == pytest.approx(30.0)
+    assert facts["cases_failed"] == 1 and facts["cases_resumed"] == 1
+    assert facts["recovery_attempts"] == 2
+    assert facts["recovery_recovered"] == 1
+    assert facts["transfer_events"] == 15
+    assert facts["transfers_per_case_statics"] == 1.0
+    assert facts["transfers_per_case_dynamics"] == 4.0
+    assert facts["exec_cache_warm"] == 1
+    assert facts["probe_events"] == 20.0
+    # missing structure -> missing facts, never an error
+    assert trendstore.facts_from_manifest({}) == {}
+
+
+def test_store_append_upsert_and_rows(tmp_path):
+    db = str(tmp_path / "trend.sqlite")
+    store = trendstore.TrendStore(db)
+    store.append(_manifest_doc("run_a", duration=60.0))
+    store.append(_manifest_doc("run_b", duration=90.0))
+    store.append(_manifest_doc("run_a", duration=61.0))   # upsert
+    assert store.count() == 2
+    rows = store.rows(kind="analyzeCases", status="ok")
+    assert {r["run_id"] for r in rows} == {"run_a", "run_b"}
+    a = next(r for r in rows if r["run_id"] == "run_a")
+    assert a["duration_s"] == 61.0
+    assert a["facts"]["s_per_case"] == pytest.approx(61.0 / 3)
+    assert a["hostname"] == "h" and a["pid"] == 42
+    assert store.rows(kind="bench") == []
+    assert store.rows(limit=1)[0]["run_id"] in ("run_a", "run_b")
+
+
+def test_store_ingest_manifest_and_jsonl(tmp_path):
+    db = str(tmp_path / "t.sqlite")
+    mani = tmp_path / "x.manifest.json"
+    mani.write_text(json.dumps(_manifest_doc("ing_a")))
+    store = trendstore.TrendStore(db)
+    n = store.ingest([str(mani), GOLDEN_FIXTURE])
+    assert n == 1 + 6
+    assert store.count() == 7
+    assert trendstore.load_rows(str(tmp_path / "missing.json")) == []
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluation
+# ---------------------------------------------------------------------------
+
+def _rows(values, kind="analyzeCases", status="ok", fact="s_per_case"):
+    return [{"run_id": f"r{i}", "kind": kind, "status": status,
+             "facts": {fact: v}} for i, v in enumerate(values)]
+
+
+def test_slo_percentile_window_and_ops():
+    rows = _rows([10.0, 20.0, 30.0, 40.0, 1000.0])
+    rule = {"name": "p50", "kind": "analyzeCases", "fact": "s_per_case",
+            "agg": "p50", "op": "<=", "threshold": 25.0}
+    rep = trendstore.evaluate_slo(rows, [rule])
+    assert not rep["ok"]                       # p50 over all 5 = 30
+    rep = trendstore.evaluate_slo(rows, [{**rule, "window": 4}])
+    assert rep["ok"]                           # newest 4 -> p50 = 20
+    rep = trendstore.evaluate_slo(rows, [
+        {"name": "mx", "fact": "s_per_case", "agg": "max", "op": "<",
+         "threshold": 1000.0}])
+    assert not rep["ok"]
+    # failed-status rows never enter an ok-status rule
+    rep = trendstore.evaluate_slo(
+        _rows([5.0]) + _rows([9999.0], status="failed"),
+        [{**rule, "window": 10}])
+    assert rep["ok"] and rep["results"][0]["n"] == 1
+
+
+def test_slo_ratio_skip_and_required():
+    rows = [{"run_id": "a", "kind": "analyzeCases", "status": "ok",
+             "facts": {"cases_failed": 1, "cases_total": 4}},
+            {"run_id": "b", "kind": "analyzeCases", "status": "ok",
+             "facts": {"cases_failed": 0, "cases_total": 4}}]
+    ratio = {"name": "fr", "kind": "analyzeCases", "fact": "cases_failed",
+             "denom": "cases_total", "agg": "ratio", "op": "<=",
+             "threshold": 0.2}
+    rep = trendstore.evaluate_slo(rows, [ratio])
+    assert rep["ok"]
+    assert rep["results"][0]["value"] == pytest.approx(0.125)
+    # no qualifying data: skipped-ok by default, a violation if required
+    rep = trendstore.evaluate_slo([], [ratio])
+    assert rep["ok"] and rep["results"][0]["skipped"]
+    rep = trendstore.evaluate_slo([], [{**ratio, "required": True}])
+    assert not rep["ok"]
+
+
+def test_golden_fixture_passes_default_rules():
+    """The committed golden-run trend fixture must clear the built-in
+    SLO gate — this is the same check CI's `obsctl slo` step runs."""
+    rows = trendstore.load_rows(GOLDEN_FIXTURE)
+    assert len(rows) == 6
+    rep = trendstore.evaluate_slo(rows)
+    assert rep["ok"], rep
+    # the deliberately-running row is excluded from every ok-gated rule
+    assert all(r["n"] <= 4 for r in rep["results"])
+
+
+def test_parse_prometheus_and_metric_rules():
+    text = (
+        "# raft_tpu exposition pid=1 hostname=h\n"
+        "# HELP raft_tpu_build_info x\n"
+        "# TYPE raft_tpu_build_info gauge\n"
+        'raft_tpu_build_info{git_sha="abc",pid="1"} 1\n'
+        'raft_tpu_live_cases_done 2\n'
+        'raft_tpu_trend_runs{kind="analyzeCases",status="ok"} 4\n'
+        'raft_tpu_trend_runs{kind="analyzeCases",status="failed"} 1\n')
+    series = trendstore.parse_prometheus(text)
+    assert series["raft_tpu_build_info"][0][0]["git_sha"] == "abc"
+    assert len(series["raft_tpu_trend_runs"]) == 2
+    rep = trendstore.evaluate_metric_rules(series, [
+        {"name": "alive", "metric": "raft_tpu_build_info", "op": ">=",
+         "threshold": 1, "required": True},
+        {"name": "ok_runs", "metric": "raft_tpu_trend_runs",
+         "labels": {"status": "ok"}, "op": ">=", "threshold": 2},
+    ])
+    assert rep["ok"]
+    rep = trendstore.evaluate_metric_rules(series, [
+        {"name": "failed", "metric": "raft_tpu_trend_runs",
+         "labels": {"status": "failed"}, "op": "<=", "threshold": 0}])
+    assert not rep["ok"]
+
+
+# ---------------------------------------------------------------------------
+# obsctl: slo / trend --db / serve
+# ---------------------------------------------------------------------------
+
+def test_obsctl_slo_fixture_gate_and_violation(tmp_path, capsys):
+    rc = obsctl.main(["slo", "--fixture", GOLDEN_FIXTURE])
+    out = capsys.readouterr().out
+    assert rc == 0 and "obsctl slo: OK" in out
+    # a tightened rule file flips the exit code
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps([
+        {"name": "impossible", "kind": "analyzeCases",
+         "fact": "s_per_case", "agg": "p50", "op": "<=",
+         "threshold": 0.001}]))
+    rc = obsctl.main(["slo", "--fixture", GOLDEN_FIXTURE,
+                      "--rules", str(rules)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "VIOLATION" in out
+    with pytest.raises(SystemExit) as exc:
+        obsctl.main(["slo"])                 # no store anywhere
+    assert exc.value.code == 2
+
+
+def test_obsctl_trend_db_renders_and_counts_running(tmp_path, capsys):
+    db = str(tmp_path / "trend.sqlite")
+    store = trendstore.TrendStore(db)
+    store.ingest([GOLDEN_FIXTURE])
+    rc = obsctl.main(["trend", "--db", db])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "trend/analyzeCases" in out
+    # the killed-run stub row is counted, not treated as a baseline
+    assert "1 run(s) still marked running" in out
+    rc = obsctl.main(["trend", "--db", db, "--json"])
+    rows = json.loads(capsys.readouterr().out)
+    assert rc == 0 and len(rows) == 6
+
+
+def test_obsctl_serve_endpoints(tmp_path):
+    import threading
+
+    # a store + an in-flight event file for the live half of /metrics
+    db = str(tmp_path / "trend.sqlite")
+    trendstore.TrendStore(db).ingest([GOLDEN_FIXTURE])
+    rec = events.FlightRecorder(
+        str(tmp_path / "analyzeCases_live01.events.jsonl"),
+        run_id="live01", kind="analyzeCases")
+    rec.emit("case_start", case=0, n_cases=3)
+    rec.emit("case_end", case=0, n_cases=3, ok=True, s=2.0)
+    # recorder left open: the run is "in flight" from the scraper's view
+
+    srv = obsctl.make_server(0, db=db, obs_dir=str(tmp_path))
+    host, port = srv.server_address[:2]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://{host}:{port}"
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health = json.loads(r.read().decode())
+        assert health["ok"] is True and health["trend_runs"] == 6
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            page = r.read().decode()
+        assert page.startswith("# raft_tpu exposition pid=")
+        assert "raft_tpu_build_info{" in page
+        series = trendstore.parse_prometheus(page)
+        trend_ok = [v for labels, v in series["raft_tpu_trend_runs"]
+                    if labels == {"kind": "analyzeCases", "status": "ok"}]
+        assert trend_ok == [4.0]
+        assert series["raft_tpu_live_cases_done"][0][1] == 1.0
+        assert series["raft_tpu_live_cases_total"][0][1] == 3.0
+        live = series["raft_tpu_live_run"][0][0]
+        assert live["run_id"] == "live01" and live["status"] == "running"
+        with urllib.request.urlopen(base + "/runs?limit=3",
+                                    timeout=10) as r:
+            runs = json.loads(r.read().decode())
+        assert len(runs) == 3 and all("facts" in row for row in runs)
+        with urllib.request.urlopen(base + "/events?n=10",
+                                    timeout=10) as r:
+            lines = r.read().decode().strip().splitlines()
+        assert json.loads(lines[-1])["type"] == "case_end"
+        with urllib.request.urlopen(base + "/nope", timeout=10) as r:
+            pass
+    except urllib.error.HTTPError as e:
+        assert e.code == 404                    # the /nope probe above
+    finally:
+        rec.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_obsctl_serve_smoke_flag(capsys):
+    rc = obsctl.main(["serve", "--port", "0", "--smoke"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "obsctl serve --smoke: OK" in out
+
+
+def test_obsctl_slo_url_gates_live_metrics(tmp_path):
+    """The acceptance wiring: `obsctl serve` exposes live /metrics that
+    `obsctl slo --url` can gate on."""
+    import threading
+
+    db = str(tmp_path / "trend.sqlite")
+    trendstore.TrendStore(db).ingest([GOLDEN_FIXTURE])
+    srv = obsctl.make_server(0, db=db, obs_dir=str(tmp_path))
+    host, port = srv.server_address[:2]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    rules = tmp_path / "live_rules.json"
+    rules.write_text(json.dumps([
+        {"name": "build_info_present", "metric": "raft_tpu_build_info",
+         "op": ">=", "threshold": 1, "required": True},
+        {"name": "ok_runs", "metric": "raft_tpu_trend_runs",
+         "labels": {"kind": "analyzeCases", "status": "ok"},
+         "op": ">=", "threshold": 4, "required": True},
+    ]))
+    try:
+        rc = obsctl.main(["slo", "--url", f"http://{host}:{port}/metrics",
+                          "--rules", str(rules)])
+        assert rc == 0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_finish_run_appends_trend_store(tmp_path):
+    from raft_tpu import obs
+
+    obs.configure(str(tmp_path))
+    m = obs.RunManifest.begin(kind="unitrun", config={"nCases": 2},
+                              devices=False)
+    paths = obs.finish_run(m, status="ok")
+    assert paths["trend"] == str(tmp_path / "trend.sqlite")
+    (row,) = trendstore.TrendStore(paths["trend"]).rows()
+    assert row["run_id"] == m.run_id and row["kind"] == "unitrun"
+    # RAFT_TPU_TREND=0 disables the append
+    os.environ["RAFT_TPU_TREND"] = "0"
+    try:
+        m2 = obs.RunManifest.begin(kind="unitrun", devices=False)
+        paths2 = obs.finish_run(m2, status="ok")
+        assert paths2["trend"] is None
+        assert trendstore.TrendStore(paths["trend"]).count() == 1
+    finally:
+        os.environ.pop("RAFT_TPU_TREND", None)
+    obs.reset_all()

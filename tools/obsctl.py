@@ -13,6 +13,17 @@ Subcommands::
     obsctl check --baseline L CUR   # CUR ledger against a golden/baseline
                                     # ledger with per-metric tolerances
     obsctl trend <dir | files...>   # text trend table over a run series
+    obsctl trend --db trend.sqlite  # ... or over the persistent trend
+                                    # store every finished run appends to
+    obsctl tail RUN.events.jsonl    # live/offline follow of a flight-
+                                    # recorder event file with per-case
+                                    # progress + ETA (--follow to stream)
+    obsctl serve --dir OBS_DIR      # stdlib HTTP endpoint: /metrics
+                                    # (Prometheus), /events, /runs,
+                                    # /healthz (--smoke: self-scrape)
+    obsctl slo [--db|--fixture|--url]  # declarative SLO gate over the
+                                    # trend store (or a live /metrics
+                                    # page); exit 1 on violation
     obsctl selfcheck                # round-trip a synthetic ledger through
                                     # diff/check/trend; exit 1 on failure
     obsctl lint [raftlint args...]  # static JAX/TPU discipline checks
@@ -20,11 +31,12 @@ Subcommands::
                                     # sibling of `check`; exit 1 on
                                     # findings, docs/static_analysis.md)
 
-Exit codes: 0 = no regression, 1 = regression (or selfcheck failure),
-2 = bad invocation / unreadable input.
+Exit codes: 0 = no regression, 1 = regression (or SLO violation /
+selfcheck failure), 2 = bad invocation / unreadable input.
 
-Pure stdlib + raft_tpu.obs.ledger — never initializes a JAX backend, so
-it is safe to run on a host whose TPU tunnel is wedged.
+Pure stdlib + the jax-free half of raft_tpu.obs (ledger, events,
+trendstore, metrics) — never initializes a JAX backend, so it is safe
+to run on a host whose TPU tunnel is wedged.
 """
 from __future__ import annotations
 
@@ -32,10 +44,13 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from raft_tpu.obs import events as E  # noqa: E402
 from raft_tpu.obs import ledger as L  # noqa: E402
+from raft_tpu.obs import trendstore as T  # noqa: E402
 
 
 def _fail(msg: str, code: int = 2):
@@ -201,21 +216,51 @@ _TREND_COLS = ("file", "kind", "status", "value", "vs_baseline", "digest",
                "when")
 
 
+def _store_trend_rows(db: str, limit: int = None) -> list[dict]:
+    """Trend-table rows from the persistent trend store (the
+    re-scan-a-directory model's replacement: one SQLite file every
+    finished run appended to)."""
+    store = T.TrendStore(db)
+    out = []
+    for r in reversed(store.rows(limit=limit)):      # oldest first
+        facts = r.get("facts") or {}
+        value = facts.get("s_per_case", r.get("duration_s"))
+        out.append({"file": (r.get("run_id") or "")[:12],
+                    "kind": f"trend/{r.get('kind')}",
+                    "status": r.get("status"), "value": value,
+                    "vs_baseline": facts.get("result_vs_baseline"),
+                    "digest": f"{len(facts)} facts",
+                    "when": (r.get("started_at") or "-")[:19]})
+    return out
+
+
 def cmd_trend(args) -> int:
-    paths = _expand_trend_paths(args.paths)
-    if not paths:
-        _fail("trend: no inputs (empty directory?)")
-    rows = []
-    for p in paths:
+    if getattr(args, "db", None):
         try:
-            with open(p) as f:
-                doc = json.load(f)
-        except (OSError, json.JSONDecodeError) as e:
-            rows.append({"file": os.path.basename(p), "kind": "unreadable",
-                         "status": type(e).__name__, "value": None,
-                         "vs_baseline": None, "digest": "-", "when": "-"})
-            continue
-        rows.append(_trend_row(p, doc))
+            rows = _store_trend_rows(args.db, limit=args.limit)
+        except Exception as e:  # sqlite errors are bad input, not a crash
+            _fail(f"trend: cannot read store {args.db}: {e}")
+        if not rows:
+            _fail(f"trend: store {args.db} has no runs")
+    else:
+        if not args.paths:
+            _fail("trend: no inputs (pass a directory, files, or --db)")
+        paths = _expand_trend_paths(args.paths)
+        if not paths:
+            _fail("trend: no inputs (empty directory?)")
+        rows = []
+        for p in paths:
+            try:
+                with open(p) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                rows.append({"file": os.path.basename(p),
+                             "kind": "unreadable",
+                             "status": type(e).__name__, "value": None,
+                             "vs_baseline": None, "digest": "-",
+                             "when": "-"})
+                continue
+            rows.append(_trend_row(p, doc))
     if args.json:
         print(json.dumps(rows, indent=1))
         return 0
@@ -226,7 +271,384 @@ def cmd_trend(args) -> int:
     print("  ".join("-" * w for w in widths))
     for row in cells:
         print("  ".join(v.ljust(w) for v, w in zip(row, widths)))
+    # crash-safety satellite: a killed run's manifest stub stays
+    # status="running" forever — count it instead of treating it as a
+    # baseline (bench self-compare and `slo` skip non-ok runs already)
+    running = sum(1 for r in rows
+                  if str(r.get("status", "")).startswith("running"))
+    if running:
+        print(f"  {running} run(s) still marked running (in flight or "
+              "killed) — not comparable baselines")
     return 0
+
+
+# ---------------------------------------------------------------------------
+# tail — follow a flight-recorder event file
+# ---------------------------------------------------------------------------
+
+def _fmt_event(e: dict) -> str | None:
+    """One rendered line per event (None = not rendered by default)."""
+    ts = time.strftime("%H:%M:%S", time.localtime(float(e.get("t", 0))))
+    t = e.get("type")
+    if t == "begin":
+        part = f" part {e['part']}" if e.get("part") else ""
+        return (f"{ts} begin {e.get('kind')} run {e.get('run_id')} "
+                f"pid {e.get('pid')} @{e.get('hostname')}{part}")
+    if t == "end":
+        return f"{ts} end status={e.get('status')}"
+    if t == "case_start":
+        return f"{ts} case {e.get('case')}/{e.get('n_cases')} started"
+    if t == "case_end":
+        tag = ("resumed" if e.get("resumed")
+               else "ok" if e.get("ok", True) else "FAILED")
+        s = e.get("s")
+        dur = f" ({s:.1f}s)" if isinstance(s, (int, float)) else ""
+        return f"{ts} case {e.get('case')} {tag}{dur}"
+    if t == "quarantine":
+        body = {k: v for k, v in e.items()
+                if k not in ("seq", "t", "type")}
+        return f"{ts} QUARANTINE {json.dumps(body, default=str)}"
+    if t == "recovery":
+        return (f"{ts} recovery[{e.get('phase')} case={e.get('case')}] "
+                f"{e.get('step_from')} -> {e.get('step_to')} "
+                f"({e.get('outcome')}) after {e.get('error')}")
+    if t == "exec_cache":
+        return f"{ts} exec_cache {e.get('event')}"
+    if t == "probe":
+        return (f"{ts} probe {e.get('probe')} "
+                f"{json.dumps(e.get('values', {}), default=str)}")
+    if t == "probe_attempt":
+        return (f"{ts} tpu-probe #{e.get('index')} "
+                f"{e.get('outcome')} ({e.get('message') or '-'})")
+    return None
+
+
+def _print_progress(p: dict):
+    bits = [f"run {p['run_id']} ({p['kind']})", f"status={p['status']}"]
+    if p["n_cases"] is not None:
+        bits.append(f"{p['done']}/{p['n_cases']} cases done")
+    if p["failed"]:
+        bits.append(f"{p['failed']} failed")
+    if p["resumed"]:
+        bits.append(f"{p['resumed']} resumed")
+    if p["avg_case_s"] is not None:
+        bits.append(f"avg {p['avg_case_s']:.1f} s/case")
+    if p["eta_s"] is not None:
+        bits.append(f"ETA {p['eta_s']:.0f}s")
+    if p["probes"]:
+        bits.append(f"{p['probes']} probe samples")
+    print("-- " + ", ".join(bits))
+
+
+def cmd_tail(args) -> int:
+    path = args.events
+    if not os.path.isfile(path):
+        _fail(f"tail: no such event file {path}")
+
+    def render(evs):
+        for e in evs:
+            if e.get("type", "").startswith("span_") and not args.spans:
+                continue
+            line = _fmt_event(e)
+            if line is None and args.spans:
+                line = (f"{time.strftime('%H:%M:%S', time.localtime(float(e.get('t', 0))))} "
+                        f"{e.get('type')} {e.get('name')}")
+            if line:
+                print(line, flush=True)
+
+    evs, offset = E.read_incremental(path, 0)
+    prog = E.progress(evs)
+    if args.json:
+        print(json.dumps(E.public_progress(prog), indent=1))
+        return 0
+    render(evs)
+    _print_progress(prog)
+    if not args.follow:
+        return 0
+    # follow mode: parse only appended lines (byte-offset incremental)
+    # and fold them into the running progress state — O(new) per poll
+    # — until the run's end record lands.  Rotation is detected by the
+    # file's inode changing (os.replace swaps it) with a size-shrink
+    # fallback for filesystems without stable inodes.
+    try:
+        ino = os.stat(path).st_ino
+    except OSError:
+        ino = None
+    try:
+        while prog["status"] == "running":
+            time.sleep(max(0.05, float(args.interval)))
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue                                # mid-rotation
+            if (ino is not None and st.st_ino != ino) \
+                    or st.st_size < offset:
+                offset = 0                              # rotated
+            ino = st.st_ino
+            new, offset = E.read_incremental(path, offset)
+            if new:
+                render(new)
+                prog = E.progress(new, state=prog)
+                _print_progress(prog)
+    except KeyboardInterrupt:                          # pragma: no cover
+        pass
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# serve — stdlib HTTP scrape endpoint over metrics / events / trend store
+# ---------------------------------------------------------------------------
+
+def _newest_events_file(obs_dir: str) -> str | None:
+    try:
+        cands = [os.path.join(obs_dir, f) for f in os.listdir(obs_dir)
+                 if f.endswith(".events.jsonl")]
+    except OSError:
+        return None
+    return max(cands, key=os.path.getmtime) if cands else None
+
+
+def _refresh_serve_metrics(db: str | None, obs_dir: str | None):
+    """Fold the trend store and the newest in-flight event file into
+    this process's registry so /metrics is a LIVE page: run history as
+    raft_tpu_trend_* gauges, the active run as raft_tpu_live_*."""
+    from raft_tpu.obs import metrics as M
+
+    if db and os.path.isfile(db):
+        rows = T.TrendStore(db).rows(limit=500)
+        g = M.gauge("raft_tpu_trend_runs",
+                    "runs in the trend store by kind and status")
+        g.clear()
+        counts: dict = {}
+        for r in rows:
+            key = (r.get("kind") or "-", r.get("status") or "-")
+            counts[key] = counts.get(key, 0) + 1
+        for (kind, status), n in counts.items():
+            g.set(float(n), kind=kind, status=status)
+        gp = M.gauge("raft_tpu_trend_s_per_case_p50",
+                     "p50 warm per-case seconds over the trend store's "
+                     "newest ok runs, by kind")
+        gp.clear()
+        by_kind: dict = {}
+        for r in rows:
+            v = (r.get("facts") or {}).get("s_per_case")
+            if r.get("status") == "ok" and isinstance(v, (int, float)):
+                by_kind.setdefault(r.get("kind") or "-", []).append(
+                    float(v))
+        for kind, vals in by_kind.items():
+            gp.set(T._percentile(vals[:20], 50), kind=kind)
+    ev = _newest_events_file(obs_dir) if obs_dir else None
+    if ev:
+        p = E.progress(E.read(ev))
+        live = M.gauge("raft_tpu_live_run",
+                       "info gauge (always 1) naming the newest run "
+                       "with a flight-recorder file in the obs dir")
+        live.clear()
+        live.set(1.0, run_id=str(p.get("run_id")),
+                 kind=str(p.get("kind")), status=str(p.get("status")))
+        for k, name in (("done", "raft_tpu_live_cases_done"),
+                        ("failed", "raft_tpu_live_cases_failed"),
+                        ("n_cases", "raft_tpu_live_cases_total"),
+                        ("probes", "raft_tpu_live_probe_events")):
+            g = M.gauge(name,
+                        "flight-recorder progress of the newest run "
+                        "(see raft_tpu_live_run for its identity)")
+            # cleared even when the newest run lacks the field — a
+            # caseless run (bench) must not inherit the previous
+            # run's case counts on the scrape page
+            g.clear()
+            if p.get(k) is not None:
+                g.set(float(p[k]))
+
+
+def make_server(port: int, host: str = "127.0.0.1", db: str = None,
+                obs_dir: str = None):
+    """Build (not start) the scrape server; returns the HTTPServer.
+    Routes: /healthz, /metrics (Prometheus text exposition with the
+    process-identity header), /runs (trend store JSON), /events (raw
+    JSONL tail of the newest — or ?file= named — event file)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs, urlparse
+
+    from raft_tpu.obs import metrics as M
+
+    M.record_build_info()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):                     # pragma: no cover
+            pass
+
+        def _send(self, code: int, body: str, ctype: str):
+            data = body.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):                              # noqa: N802
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            try:
+                if url.path == "/healthz":
+                    n_runs = None
+                    if db and os.path.isfile(db):
+                        n_runs = T.TrendStore(db).count()
+                    ev = _newest_events_file(obs_dir) if obs_dir else None
+                    self._send(200, json.dumps(
+                        {"ok": True, "pid": os.getpid(),
+                         "trend_db": db, "trend_runs": n_runs,
+                         "events_file": ev}), "application/json")
+                elif url.path == "/metrics":
+                    _refresh_serve_metrics(db, obs_dir)
+                    self._send(200, M.exposition(),
+                               "text/plain; version=0.0.4")
+                elif url.path == "/runs":
+                    if not (db and os.path.isfile(db)):
+                        self._send(404, json.dumps(
+                            {"error": "no trend store"}),
+                            "application/json")
+                        return
+                    limit = int(q.get("limit", ["50"])[0])
+                    self._send(200, json.dumps(
+                        T.TrendStore(db).rows(limit=limit), indent=1,
+                        default=str), "application/json")
+                elif url.path == "/events":
+                    # ?file= takes a BASENAME resolved inside the obs
+                    # dir only — a scrape endpoint must not be an
+                    # arbitrary-file-read service
+                    name = q.get("file", [None])[0]
+                    if name:
+                        if (os.path.basename(name) != name or not obs_dir
+                                or ".events.jsonl" not in name):
+                            self._send(400, "file must be a "
+                                       "*.events.jsonl basename in the "
+                                       "obs dir\n", "text/plain")
+                            return
+                        path = os.path.join(obs_dir, name)
+                    else:
+                        path = (_newest_events_file(obs_dir)
+                                if obs_dir else None)
+                    if not path or not os.path.isfile(path):
+                        self._send(404, "no event file\n", "text/plain")
+                        return
+                    n = int(q.get("n", ["200"])[0])
+                    with open(path, encoding="utf-8") as f:
+                        lines = f.readlines()[-n:]
+                    self._send(200, "".join(lines),
+                               "application/x-ndjson")
+                else:
+                    self._send(404, "not found\n", "text/plain")
+            # one bad request must not take down the scrape endpoint
+            except Exception as exc:  # raftlint: disable=RTL004
+                self._send(500, f"{type(exc).__name__}: {exc}\n",
+                           "text/plain")
+
+    return ThreadingHTTPServer((host, int(port)), Handler)
+
+
+def cmd_serve(args) -> int:
+    db = args.db or T.db_path() or (
+        os.path.join(args.dir, "trend.sqlite") if args.dir else None)
+    srv = make_server(args.port, host=args.host, db=db, obs_dir=args.dir)
+    host, port = srv.server_address[:2]
+    print(f"obsctl serve: http://{host}:{port}/  "
+          f"(metrics, events, runs, healthz; trend db: {db or '-'}, "
+          f"obs dir: {args.dir or '-'})", flush=True)
+    if args.smoke:
+        import threading
+        import urllib.request
+
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            base = f"http://{host}:{port}"
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=10) as r:
+                health = json.loads(r.read().decode())
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                metrics_page = r.read().decode()
+            ok = (health.get("ok") is True
+                  and "raft_tpu_build_info{" in metrics_page
+                  and metrics_page.startswith("# raft_tpu exposition"))
+            print(f"obsctl serve --smoke: "
+                  f"{'OK' if ok else 'FAILED'} (healthz ok={health.get('ok')}, "
+                  f"build_info={'present' if 'raft_tpu_build_info{' in metrics_page else 'MISSING'})")
+            return 0 if ok else 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:                          # pragma: no cover
+        pass
+    finally:
+        srv.server_close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# slo — declarative gates over the trend store / a live metrics page
+# ---------------------------------------------------------------------------
+
+def _print_slo(report: dict):
+    for r in report["results"]:
+        state = ("skip" if r.get("skipped")
+                 else "ok" if r["ok"] else "VIOLATION")
+        val = "-" if r["value"] is None else f"{r['value']:.6g}"
+        print(f"  {state:9s} {r['name']}: {val} {r.get('op')} "
+              f"{r.get('threshold')} (n={r.get('n')})")
+    print(f"obsctl slo: {'OK' if report['ok'] else 'VIOLATED'} "
+          f"({sum(1 for r in report['results'] if not r['ok'])} "
+          f"violation(s) over {len(report['results'])} rule(s))")
+
+
+def cmd_slo(args) -> int:
+    rules = None
+    if args.rules:
+        try:
+            with open(args.rules) as f:
+                rules = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            _fail(f"slo: cannot read rules {args.rules}: {e}")
+        if not isinstance(rules, list):
+            _fail("slo: rules file must be a JSON list of rule objects")
+    if args.url:
+        import urllib.request
+        try:
+            with urllib.request.urlopen(args.url, timeout=10) as r:
+                text = r.read().decode()
+        except OSError as e:
+            _fail(f"slo: cannot scrape {args.url}: {e}")
+        if rules is None:
+            _fail("slo: --url needs --rules with metric-based rules")
+        report = T.evaluate_metric_rules(T.parse_prometheus(text), rules)
+    else:
+        rows = []
+        if args.fixture:
+            for path in args.fixture:
+                loaded = T.load_rows(path)
+                if not loaded:
+                    _fail(f"slo: fixture {path} has no rows")
+                rows.extend(loaded)
+            # evaluate_slo's window/"last" semantics expect newest-first
+            # (what TrendStore.rows returns); fixtures are committed in
+            # append (oldest-first) order
+            rows.sort(key=lambda r: str(r.get("started_at") or ""),
+                      reverse=True)
+        else:
+            db = args.db or T.db_path()
+            if not db or not os.path.isfile(db):
+                _fail("slo: no trend store (pass --db, --fixture, or "
+                      "set RAFT_TPU_TREND_DB)")
+            rows = T.TrendStore(db).rows()
+        report = T.evaluate_slo(rows, rules)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        _print_slo(report)
+    return 0 if report["ok"] else 1
 
 
 # ---------------------------------------------------------------------------
@@ -413,12 +835,61 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("trend",
                        help="text trend table over manifests/ledgers/"
-                            "bench rounds")
-    p.add_argument("paths", nargs="+",
+                            "bench rounds, or the persistent trend store")
+    p.add_argument("paths", nargs="*",
                    help="obs output directory, or JSON files "
                         "(BENCH_r0*.json, *.manifest.json, *.ledger.json)")
+    p.add_argument("--db", help="read the persistent SQLite trend store "
+                                "instead of scanning files")
+    p.add_argument("--limit", type=int, default=None,
+                   help="newest N store rows (--db mode)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_trend)
+
+    p = sub.add_parser("tail",
+                       help="follow a flight-recorder event file with "
+                            "per-case progress and ETA")
+    p.add_argument("events", help="a <kind>_<run_id>.events.jsonl file")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="keep polling until the run's end record lands")
+    p.add_argument("--interval", type=float, default=0.5,
+                   help="poll interval in seconds (default 0.5)")
+    p.add_argument("--spans", action="store_true",
+                   help="also render span open/close events")
+    p.add_argument("--json", action="store_true",
+                   help="print the reconstructed progress dict as JSON")
+    p.set_defaults(fn=cmd_tail)
+
+    p = sub.add_parser("serve",
+                       help="HTTP scrape endpoint: /metrics /events "
+                            "/runs /healthz (stdlib http.server)")
+    p.add_argument("--port", type=int, default=9464,
+                   help="listen port (default 9464; 0 = ephemeral)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--dir", help="obs output directory (event files; "
+                                 "default trend db location)")
+    p.add_argument("--db", help="trend store path (default: "
+                                "RAFT_TPU_TREND_DB or <dir>/trend.sqlite)")
+    p.add_argument("--smoke", action="store_true",
+                   help="start, self-scrape /healthz + /metrics, assert "
+                        "raft_tpu_build_info present, exit (CI smoke)")
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("slo",
+                       help="evaluate declarative SLO rules over the "
+                            "trend store (or a live /metrics page); "
+                            "exit 1 on violation")
+    p.add_argument("--db", help="trend store path (default: "
+                                "RAFT_TPU_TREND_DB)")
+    p.add_argument("--fixture", action="append",
+                   help="JSONL trend-row fixture(s) instead of a store "
+                        "(the committed golden-run gate), repeatable")
+    p.add_argument("--url", help="scrape a live Prometheus page and "
+                                 "evaluate metric-based rules instead")
+    p.add_argument("--rules", help="JSON rules file (default: the "
+                                   "built-in DEFAULT_SLO_RULES)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser("selfcheck",
                        help="round-trip a synthetic ledger through "

@@ -306,16 +306,24 @@ def _param_names(fn) -> list:
 class RTL001:
     code = "RTL001"
     name = "host-transfer-escape"
-    summary = ("device->host pulls inside traced code, or raw "
-               "jax.device_get outside obs/transfers.py")
+    summary = ("device->host pulls inside traced code, raw "
+               "jax.device_get outside obs/transfers.py, or host "
+               "callbacks outside obs/probes.py")
 
     _BUILTIN_CASTS = {"float", "int", "bool", "complex"}
     _NP_PULLS = {"asarray", "array"}
+    #: the host-callback channel: sanctioned ONLY in obs/probes.py
+    #: (the counted probe budget), mirroring device_get/transfers.py
+    _CALLBACKS = {"jax.debug.callback", "jax.pure_callback",
+                  "jax.experimental.io_callback"}
 
     def check(self, mod, opts):
         if _prefix_match(mod.relpath, opts.get("sanctioned",
                                                ["raft_tpu/obs/transfers.py"])):
             return
+        probe_sanctioned = _prefix_match(
+            mod.relpath, opts.get("probe-sanctioned",
+                                  ["raft_tpu/obs/probes.py"]))
         aliases = _aliases(mod)
         idx = device_index(mod)
 
@@ -331,6 +339,22 @@ class RTL001:
                     "raw jax.device_get — route device->host pulls "
                     "through obs.transfers.device_get so they are "
                     "counted against the pinned per-case budget")
+                continue
+            # raw host callbacks ANYWHERE outside the sanctioned probe
+            # module: the probe channel counts its traffic in its own
+            # raft_tpu_probe_events_total budget and is the only legal
+            # way to stream values out of device code mid-execution
+            if not probe_sanctioned and (
+                    canon in self._CALLBACKS
+                    or (canon.startswith("jax.")
+                        and canon.endswith(".io_callback"))):
+                yield mod.finding(
+                    self.code, node,
+                    f"raw {canon.rsplit('.', 1)[-1]} — host callbacks "
+                    "are the probe channel's job: use obs.probes.probe "
+                    "(obs/probes.py is the only sanctioned "
+                    "io_callback/jax.debug.callback site, so probe "
+                    "traffic stays on its own counted budget)")
                 continue
             if not idx.is_device_scope(node):
                 continue

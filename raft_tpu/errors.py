@@ -123,6 +123,15 @@ class ModelConfigError(RaftError, ValueError):
     phase = "setup"
 
 
+class PartitionRuleError(RaftError, ValueError):
+    """The partition layer cannot place a pytree on the mesh as asked —
+    an unmatched leaf, a mesh/axes shape mismatch, or a mesh wanting
+    more devices than exist (not recoverable by the ladder: the sharding
+    request itself is wrong; see parallel/partition.py)."""
+
+    phase = "setup"
+
+
 class FaultInjected(RaftError, RuntimeError):
     """Raised by :mod:`raft_tpu.testing.faults` for ``raise@...`` specs
     at sites without a more specific mapped type."""

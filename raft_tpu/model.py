@@ -103,15 +103,32 @@ def _cond_core(Z_sys):
 
 
 #: lazily-built jitted instances (donation is decided by the active
-#: backend, which must not be queried at import time)
+#: backend, which must not be queried at import time); the dynamics
+#: solve is additionally keyed by the mesh topology — a mesh with a
+#: ``freq`` axis gets its own program with the statics->dynamics
+#: resharding constraints baked in (parallel/partition.py)
 _DYN_JITS: dict = {}
 
 
-def _dyn_solve_jit():
-    if "solve" not in _DYN_JITS:
+def _dyn_solve_jit(mesh=None):
+    from raft_tpu.parallel import partition
+    if not partition.has_freq_axis(mesh):
+        # only a freq axis changes this program — a batch-only mesh
+        # shares the single-device entry instead of recompiling it
+        mesh = None
+    # the compiled wrapper closes over the Mesh OBJECT, so the key must
+    # carry device identity, not just the axis topology — a same-shape
+    # mesh over different chips is a different program placement
+    key = ("solve", partition.mesh_key(mesh),
+           None if mesh is None
+           else tuple(d.id for d in mesh.devices.ravel()))
+    if key not in _DYN_JITS:
         donate = (2,) if jax.default_backend() != "cpu" else ()
-        _DYN_JITS["solve"] = jax.jit(_dyn_solve_core, donate_argnums=donate)
-    return _DYN_JITS["solve"]
+        core = _dyn_solve_core
+        if mesh is not None:
+            core = partition.sharded_dynamics_core(core, mesh)
+        _DYN_JITS[key] = jax.jit(core, donate_argnums=donate)
+    return _DYN_JITS[key]
 
 
 def _cond_jit():
@@ -190,6 +207,14 @@ class Model:
         plat = design.get("platform") or (design.get("platforms") or [{}])[0]
         self.outFolderQTF = plat.get("outFolderQTF")
         self._iCase = None
+        #: named device mesh for the batched dynamics solve (None =
+        #: single-device).  Defaults to the ambient ``RAFT_TPU_MESH``
+        #: topology (e.g. "freq=8") so existing entry points — the
+        #: golden gate, analyzeCases scripts — run through the
+        #: partitioned path with zero API changes; ``set_mesh``
+        #: overrides programmatically.
+        from raft_tpu.parallel import partition as _partition
+        self.mesh = _partition.ambient_mesh()
         #: RunManifest of the most recent analyzeCases invocation
         self.last_manifest = None
         #: result ledger (raft_tpu.ledger/v1) of the most recent
@@ -203,6 +228,15 @@ class Model:
         self.results = {}
         # per-fowt case state (filled by solveStatics/solveDynamics)
         self._state = [dict() for _ in self.fowtList]
+
+    def set_mesh(self, mesh):
+        """Run the heading-batched dynamics solve on ``mesh`` (a named
+        :class:`jax.sharding.Mesh`; a ``freq`` axis shards the
+        frequency-bin dimension of the impedance/excitation stacks —
+        see ``parallel/partition.py``).  ``None`` restores the
+        single-device program; already-compiled topologies stay cached.
+        """
+        self.mesh = mesh
 
     @staticmethod
     def _case_for_fowt(case, i):
@@ -839,11 +873,12 @@ class Model:
             # analyzeCases run, folded into the metrics registry and
             # thence the run manifest
             self._dyn_cost_recorded = True
-            obs.device.cost_analysis(_dyn_solve_jit(), Zinv, Z_sys, F_all,
+            obs.device.cost_analysis(_dyn_solve_jit(self.mesh), Zinv,
+                                     Z_sys, F_all,
                                      kernel="dynamics_system_solve")
         # ONE batched solve over every heading; the per-heading solve
         # residuals come back as nWaves scalars in the same pull
-        Xi_d, rel_d = _dyn_solve_jit()(Zinv, Z_sys, F_all)
+        Xi_d, rel_d = _dyn_solve_jit(self.mesh)(Zinv, Z_sys, F_all)
         rel = obs.transfers.device_get(rel_d, what="solve_residual",
                                        phase="dynamics")
         rel2 = None
@@ -872,7 +907,8 @@ class Model:
                                            fowt.w1_2nd, st["seastate"]["beta"][ih],
                                            st["seastate"]["S"][ih], self.w))
                     st["Fhydro_2nd"][ih] = f2h
-            Xi2_d, rel2_d = _dyn_solve_jit()(Zinv, Z_sys, assemble_F())
+            Xi2_d, rel2_d = _dyn_solve_jit(self.mesh)(Zinv, Z_sys,
+                                                      assemble_F())
             # heading 0's converged first-order solution is kept; the
             # secondary headings take the re-solved response
             Xi_d = jnp.concatenate([Xi_d[:1], Xi2_d[1:]], axis=0)
@@ -1376,11 +1412,13 @@ class Model:
         ``RAFT_TPU_RECOVERY=0`` to restore fail-fast behavior."""
         obs.install_jax_hooks()
         obs.device.jit_cache_delta(scope="analyzeCases")   # baseline
+        from raft_tpu.parallel import partition
         nCases = len(self.design["cases"]["data"])
         manifest = obs.RunManifest.begin(kind="analyzeCases", config={
             "nCases": nCases, "nFOWT": self.nFOWT, "nw": self.nw,
             "nDOF": self.nDOF, "nIter": self.nIter,
-            "depth": self.depth})
+            "depth": self.depth,
+            "mesh": partition.mesh_facts(self.mesh)})
         # run-scoped process identity: a scrape during this run carries
         # pid/hostname/run_id on the build-info series
         obs.record_build_info(run_id=manifest.run_id)

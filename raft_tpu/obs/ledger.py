@@ -207,10 +207,17 @@ def ledger_from_model(model, run_id: str = None) -> dict:
     """
     from raft_tpu.obs import manifest as _manifest
 
+    config = {"nCases": len(model.results.get("case_metrics", {})),
+              "nFOWT": model.nFOWT, "nw": model.nw, "nDOF": model.nDOF}
+    if getattr(model, "mesh", None) is not None:
+        # the full mesh topology rides in the ledger config so a
+        # partitioned run is distinguishable from a single-device one
+        # (the physics entries must still digest identically — the
+        # golden gate runs with RAFT_TPU_MESH set to prove it)
+        from raft_tpu.parallel import partition
+        config["mesh"] = partition.mesh_facts(model.mesh)
     led = new_ledger(
-        kind="analyzeCases", run_id=run_id,
-        config={"nCases": len(model.results.get("case_metrics", {})),
-                "nFOWT": model.nFOWT, "nw": model.nw, "nDOF": model.nDOF},
+        kind="analyzeCases", run_id=run_id, config=config,
         environment=_manifest.capture_environment(devices=False))
     records = getattr(model, "_case_records", {})
     for iCase in sorted(model.results.get("case_metrics", {})):

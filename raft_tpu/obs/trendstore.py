@@ -134,6 +134,16 @@ def facts_from_manifest(doc: dict) -> dict:
     cache_state = (extra.get("exec_cache") or {}).get("state")
     if cache_state:
         facts["exec_cache_warm"] = int(cache_state == "hit")
+    # mesh topology facts (parallel/partition.py): sweep manifests carry
+    # them in config["mesh"], partitioned analyzeCases runs too; the
+    # ordered-axes string lets `obsctl trend --db` show WHICH 2-D layout
+    # a run used, not just how many devices it spanned
+    mesh = config.get("mesh") or (extra.get("partition") or {}).get("mesh")
+    if isinstance(mesh, dict):
+        if _num(mesh.get("devices")) is not None:
+            facts["mesh_devices"] = mesh["devices"]
+        if mesh.get("topology"):
+            facts["mesh"] = str(mesh["topology"])
     res = extra.get("result") or {}
     for k in ("value", "vs_baseline", "analyze_cases_s_per_case"):
         if _num(res.get(k)) is not None:

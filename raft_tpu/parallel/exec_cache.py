@@ -5,9 +5,15 @@ batched program per (model, batch-shape, dtype, mesh) combination — tens
 of seconds of host work that is bitwise-identical across runs of the
 same model.  This module serializes the exported program (via
 ``jax.export``) keyed by a content digest of the model pytree (computed
-with the PR-2 ledger digest machinery) plus the shape/dtype/mesh/
-environment facts, so a warm-start process skips the ``sweep_lower`` and
-``sweep_compile`` phases entirely; the XLA compile that remains inside
+with the PR-2 ledger digest machinery) plus the shape/dtype/environment
+facts and — for sharded programs — the FULL ordered mesh topology
+(axis names + sizes + process span, ``partition.mesh_facts``) and the
+partition-rule fingerprint (``partition.rules_fingerprint``): a
+``(2,4)`` ``(cases,freq)`` program is never served for a ``(2,4)``
+``(variants,cases)`` request, and editing a partition rule invalidates
+every program it shaped.  A warm-start process skips the
+``sweep_lower`` and ``sweep_compile`` phases entirely; the XLA compile
+that remains inside
 the deserialized call is served by JAX's persistent compilation cache
 (enabled in ``_config.py``).
 

@@ -1,0 +1,462 @@
+"""Pod-scale partition layer: regex rules over the model pytree.
+
+The 1-D data-parallel mesh of PRs 1-7 shards exactly one batch axis and
+implicitly replicates everything else — every device holds the full QTF
+pair grids, BEM panel matrices and the ``(nWaves, 6N, nw)`` impedance
+stack, and one host feeds one chip-group.  This module is the deliberate
+placement layer that replaces that: the fmengine-style
+``match_partition_rules`` pattern (SNIPPETS.md [1]/[3]) maps every leaf
+of the FOWT model state and the sweep batch, by regex over its
+``/``-joined pytree path, to a :class:`~jax.sharding.PartitionSpec` on a
+named multi-axis :class:`~jax.sharding.Mesh` — ``(variants, cases)``,
+``(cases, freq)``, or any 1-D slice of those — and
+:func:`make_shard_and_gather_fns` turns the matched specs into concrete
+placement/replication functions.
+
+Axis vocabulary
+---------------
+``freq``
+    The frequency-bin axis.  Arrays whose trailing dimension is the
+    ``nw`` frequency grid (impedance/added-mass stacks, excitation
+    spectra, RAOs, wave-velocity precomputes) shard their LAST axis
+    over it.  Resolved by the :data:`FREQ` placeholder.
+everything else (``cases``, ``variants``, ``designs``, ...)
+    Batch axes.  The sweep batch dimension shards over the product of
+    every non-``freq`` mesh axis — a ``(variants, cases)`` mesh runs a
+    cases-only sweep over all its devices.  Resolved by the
+    :data:`BATCH` placeholder.
+
+Rules are authored with the :data:`BATCH`/:data:`FREQ` placeholders and
+resolved against a concrete mesh at shard/constrain time, so the same
+rule table serves a 1-D ``("cases",)`` mesh, a 2-D ``("cases","freq")``
+mesh, and an 8-process pod slice unchanged.
+
+Resharding happens at exactly one place: the statics->dynamics phase
+boundary (``solve_batched``'s per-case state ``st`` / the model-level
+``_dyn_solve_core`` inputs), where the layout legitimately changes from
+batch-everything to batch+frequency.  :func:`constrain` (the only
+sanctioned ``with_sharding_constraint`` site in the tree — raftlint
+RTL006) pins it there and nowhere else.
+
+Multi-process: :func:`ensure_distributed` initializes
+``jax.distributed`` from the standard coordinator environment
+(``RAFT_TPU_DIST=1`` or an explicit ``RAFT_TPU_COORDINATOR``), after
+which :func:`make_mesh` builds the mesh over the GLOBAL device set and
+:func:`host_local_put` assembles global arrays from per-process shards
+(``jax.make_array_from_process_local_data``) — the multi-process pjit
+pattern of SNIPPETS.md [2].  On a single process both degrade to the
+plain ``jax.device_put`` path, which is how the virtual-8-device
+dry-run (``__graft_entry__.dryrun_multichip_2d``) proves
+sharded==unsharded parity without a pod.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu import errors
+
+#: the one frequency-axis name (every other mesh axis is a batch axis)
+FREQ_AXIS = "freq"
+
+#: placeholder tokens used inside rule PartitionSpecs; resolved against
+#: the concrete mesh by :func:`resolve_spec`
+BATCH = "__batch__"
+FREQ = "__freq__"
+
+#: canonical mesh axis names (documentation + raftlint RTL006 config —
+#: the literals themselves must not leak outside this module)
+CANONICAL_AXES = ("variants", "cases", FREQ_AXIS, "designs")
+
+
+# ---------------------------------------------------------------------------
+# pytree path naming
+# ---------------------------------------------------------------------------
+
+def _key_str(k) -> str:
+    """One path component for any jax KeyEntry flavor."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def path_name(path) -> str:
+    """``/``-joined leaf path name (``drag_pre/u_P``, ``pose/members/0/R``)."""
+    return "/".join(_key_str(k) for k in path)
+
+
+def named_tree_map(fn, tree):
+    """``jax.tree.map`` handing ``fn(name, leaf)`` the ``/``-joined path
+    name of every leaf (the fmengine ``named_tree_map``)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: fn(path_name(path), leaf), tree)
+
+
+# ---------------------------------------------------------------------------
+# rule matching (fmengine-style)
+# ---------------------------------------------------------------------------
+
+#: shared state rules for the per-case/per-variant model state ``st`` at
+#: the statics->dynamics boundary (leading axis = the sweep batch):
+#: impedance-assembly stacks and excitation spectra additionally shard
+#: their trailing frequency axis; everything else is batch-sharded with
+#: all trailing dims replicated.
+STATE_RULES = (
+    (r"(^|/)(M_lin|B_BEM)$", P(BATCH, None, None, FREQ)),
+    (r"(^|/)F_lin$", P(BATCH, None, FREQ)),
+    (r"(^|/)u0$", P(BATCH, None, None, FREQ)),
+    (r"(^|/)drag_pre/(s_q|s_p1|s_p2)$", P(BATCH, None, FREQ)),
+    (r"(^|/)drag_pre/u_P$", P(BATCH, None, None, FREQ)),
+    (r".*", P(BATCH)),
+)
+
+#: sweep_cases inputs: (ncases,) scalars per case, batch-sharded
+CASE_INPUT_RULES = (
+    (r"^(Hs|Tp|beta)$", P(BATCH)),
+)
+
+#: sweep_variants inputs: every theta leaf carries a leading variant axis
+VARIANT_INPUT_RULES = (
+    (r".*", P(BATCH)),
+)
+
+#: per-case response state during the drag fixed point (batch, 6, nw)
+XI_SPEC = P(BATCH, None, FREQ)
+#: gather spec: batch-sharded, frequency axis replicated again (applied
+#: BEFORE any reduction over frequency so sharded==unsharded stays
+#: bitwise — the per-device summation order of e.g. ``get_rms`` is then
+#: identical to the single-device program)
+BATCH_ONLY = P(BATCH)
+
+#: model-level heading-batched dynamics solve (model.py:_dyn_solve_core):
+#: the factored inverse impedance and the system stack shard over
+#: frequency (their leading axis is nw), the excitation/response stacks
+#: over their trailing frequency axis; headings/DOF stay replicated.
+DYNAMICS_RULES = (
+    (r"^(Zinv|Z_sys)$", P(FREQ)),
+    (r"^(F_all|Xi)$", P(None, None, FREQ)),
+)
+
+
+def match_partition_rules(rules, tree):
+    """Pytree of (unresolved) PartitionSpecs for ``tree``: first regex in
+    ``rules`` that ``re.search``-matches the leaf's ``/``-joined path
+    name wins; 0-d / size-1 leaves are never partitioned.  A non-scalar
+    leaf no rule matches raises :class:`errors.PartitionRuleError` —
+    silent replication of a big array is exactly the failure mode this
+    layer exists to remove."""
+    def get_spec(name, leaf):
+        shape = np.shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise errors.PartitionRuleError(
+            f"no partition rule matches leaf '{name}' "
+            f"(shape {tuple(shape)}) — add a rule (or a catch-all) so "
+            "every leaf's placement is deliberate", leaf=name,
+            shape=tuple(int(s) for s in shape))
+    return named_tree_map(get_spec, tree)
+
+
+def batch_axes(mesh: Mesh) -> tuple:
+    """Every mesh axis that is not the frequency axis, in mesh order."""
+    return tuple(a for a in mesh.axis_names if a != FREQ_AXIS)
+
+
+def batch_size(mesh: Mesh | None) -> int:
+    """Product of the batch-axis sizes (1 with no mesh/batch axes) —
+    the divisor the sweep batch must be padded to."""
+    if mesh is None:
+        return 1
+    n = 1
+    for a in batch_axes(mesh):
+        n *= int(mesh.shape[a])
+    return n
+
+
+def resolve_spec(spec, mesh: Mesh):
+    """Concrete PartitionSpec for ``mesh``: :data:`BATCH` becomes the
+    tuple of batch axes, :data:`FREQ` the frequency axis when the mesh
+    has one; placeholders whose axes the mesh lacks resolve to ``None``
+    (replicated on that dim)."""
+    names = set(mesh.axis_names)
+    out = []
+    for entry in spec:
+        if entry == BATCH:
+            ax = batch_axes(mesh)
+            out.append(ax if len(ax) > 1 else (ax[0] if ax else None))
+        elif entry == FREQ:
+            out.append(FREQ_AXIS if FREQ_AXIS in names else None)
+        else:
+            out.append(entry)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def sharding(mesh: Mesh, spec) -> NamedSharding:
+    """NamedSharding for a (possibly placeholder) spec on ``mesh``."""
+    return NamedSharding(mesh, resolve_spec(spec, mesh))
+
+
+def make_shard_and_gather_fns(mesh: Mesh, specs):
+    """(shard_fns, gather_fns) pytrees matching ``specs``.
+
+    A shard fn places a host/global array onto the mesh with its
+    resolved sharding (multi-process aware via :func:`host_local_put`);
+    the matching gather fn reshards back to fully-replicated — both are
+    pure placement, the values are untouched."""
+    def _shard(spec):
+        sh = sharding(mesh, spec)
+        return lambda x: host_local_put(x, sh)
+
+    def _gather(spec):
+        sh = NamedSharding(mesh, P())
+        return lambda x: jax.device_put(x, sh)
+
+    shard_fns = jax.tree.map(_shard, specs,
+                             is_leaf=lambda s: isinstance(s, P))
+    gather_fns = jax.tree.map(_gather, specs,
+                              is_leaf=lambda s: isinstance(s, P))
+    return shard_fns, gather_fns
+
+
+def shard_tree(tree, mesh: Mesh, rules):
+    """Match ``rules`` over ``tree`` and place every leaf deliberately
+    (the one-call composition of :func:`match_partition_rules` +
+    :func:`make_shard_and_gather_fns` the sweep entry points use)."""
+    specs = match_partition_rules(rules, tree)
+    shard_fns, _ = make_shard_and_gather_fns(mesh, specs)
+    return jax.tree.map(lambda f, x: f(x), shard_fns, tree)
+
+
+# ---------------------------------------------------------------------------
+# the resharding boundary (the ONLY with_sharding_constraint site)
+# ---------------------------------------------------------------------------
+
+def constrain(tree, mesh: Mesh | None, rules_or_spec):
+    """Pin ``tree``'s layout inside a traced program (identity without a
+    mesh).  ``rules_or_spec`` is either a rule table matched over the
+    tree or a single placeholder PartitionSpec applied to every leaf.
+    This is the statics->dynamics resharding boundary — the one place
+    the layout legitimately changes — and the only sanctioned
+    ``with_sharding_constraint`` call site (raftlint RTL006)."""
+    if mesh is None:
+        return tree
+    if isinstance(rules_or_spec, P):
+        sh = sharding(mesh, rules_or_spec)
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, sh), tree)
+    specs = match_partition_rules(rules_or_spec, tree)
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, sharding(mesh, s)),
+        tree, specs)
+
+
+def has_freq_axis(mesh: Mesh | None) -> bool:
+    return mesh is not None and FREQ_AXIS in mesh.axis_names
+
+
+def sharded_dynamics_core(core, mesh: Mesh):
+    """Wrap the model-level heading-batched dynamics solve so its inputs
+    reshard onto the frequency axis at the statics->dynamics boundary
+    and its response gathers back to replicated before the host pull.
+    Numerics are untouched: the solve is independent per frequency bin,
+    so the sharded program is bitwise-identical per element (only the
+    telemetry residual's summation order may differ at ~1 ulp)."""
+    def wrapped(Zinv, Z_sys, F_all):
+        tree = {"Zinv": Zinv, "Z_sys": Z_sys, "F_all": F_all}
+        tree = constrain(tree, mesh, DYNAMICS_RULES)
+        Xi, rel = core(tree["Zinv"], tree["Z_sys"], tree["F_all"])
+        Xi = constrain(Xi, mesh, P())
+        return Xi, rel
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# padded batches (non-divisible sweeps)
+# ---------------------------------------------------------------------------
+
+def pad_batch(tree, n: int, multiple: int):
+    """Pad every leaf's leading batch axis from ``n`` to the next
+    multiple of ``multiple`` by repeating the last valid row — masked
+    lanes that are numerically benign (they converge exactly like the
+    case they copy, so the adaptive fixed point's trip decisions are
+    unchanged) and carry no NaN that could trip lane quarantine.
+    Returns ``(padded_tree, npad)``; callers strip ``[:n]`` from results
+    and metrics."""
+    npad = (-int(n)) % max(1, int(multiple))
+    if npad == 0:
+        return tree, 0
+    pad = jax.tree.map(
+        lambda x: jnp.concatenate(
+            [jnp.asarray(x), jnp.repeat(jnp.asarray(x)[-1:], npad,
+                                        axis=0)]), tree)
+    return pad, npad
+
+
+def unpad_batch(tree, n: int):
+    """Strip the padded lanes (`pad_batch`'s inverse) from every leaf."""
+    return jax.tree.map(lambda x: x[:int(n)], tree)
+
+
+# ---------------------------------------------------------------------------
+# meshes, topology facts, fingerprints
+# ---------------------------------------------------------------------------
+
+def make_mesh(shape=None, axes=None, devices=None) -> Mesh:
+    """Named mesh over ``devices`` (default: every global device).
+
+    ``shape``/``axes`` default to a 1-D ``("cases",)`` mesh over all
+    devices; a 2-D call looks like ``make_mesh((2, 4), ("cases",
+    "freq"))``.  On a multi-process run (:func:`ensure_distributed`)
+    ``jax.devices()`` is the global device set, so the same call builds
+    the pod-wide mesh on every process."""
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if axes is None:
+        axes = ("cases",)
+    if shape is None:
+        shape = (devices.size,)
+    if len(shape) != len(axes):
+        raise errors.PartitionRuleError(
+            f"mesh shape {tuple(shape)} and axes {tuple(axes)} disagree",
+            shape=tuple(shape), axes=tuple(axes))
+    n = int(np.prod(shape))
+    if n > devices.size:
+        raise errors.PartitionRuleError(
+            f"mesh shape {tuple(shape)} wants {n} devices, "
+            f"{devices.size} available", shape=tuple(shape),
+            devices=int(devices.size))
+    return Mesh(devices.ravel()[:n].reshape(shape), tuple(axes))
+
+
+def ambient_mesh() -> Mesh | None:
+    """Mesh described by ``RAFT_TPU_MESH`` (e.g. ``"cases=2,freq=4"``,
+    ``"freq=8"``), or None when unset — the zero-API-change way to run
+    ``analyzeCases``/the golden gate through the partitioned path."""
+    spec = os.environ.get("RAFT_TPU_MESH", "").strip()
+    if not spec:
+        return None
+    axes, shape = [], []
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if not name:
+            continue
+        axes.append(name)
+        shape.append(int(size) if size.strip() else len(jax.devices()))
+    if not axes:
+        return None
+    return make_mesh(tuple(shape), tuple(axes))
+
+
+def mesh_facts(mesh: Mesh | None) -> dict | None:
+    """JSON-able topology facts: ORDERED axis names + sizes (not just a
+    device count), device totals, and the process span — what cache
+    keys, manifests, the ledger config and the trend store record."""
+    if mesh is None:
+        return None
+    return {
+        "axes": [str(a) for a in mesh.axis_names],
+        "shape": [int(mesh.shape[a]) for a in mesh.axis_names],
+        "devices": int(mesh.devices.size),
+        "topology": "x".join(f"{a}={int(mesh.shape[a])}"
+                             for a in mesh.axis_names),
+        "processes": int(jax.process_count()),
+    }
+
+
+def mesh_key(mesh: Mesh | None):
+    """Hashable topology identity for jit-instance caches."""
+    if mesh is None:
+        return None
+    return tuple((str(a), int(mesh.shape[a])) for a in mesh.axis_names)
+
+
+def rules_fingerprint(*rule_tables) -> str:
+    """Stable digest of one or more rule tables (pattern + spec pairs) —
+    part of the executable-cache key, so editing a partition rule
+    invalidates every cached program it shaped."""
+    h = hashlib.sha256()
+    for rules in rule_tables:
+        if isinstance(rules, P):
+            rules = ((".*", rules),)
+        for pattern, spec in rules:
+            h.update(repr((pattern, tuple(spec))).encode())
+    return h.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# multi-process pjit
+# ---------------------------------------------------------------------------
+
+def process_facts() -> dict:
+    return {"process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count())}
+
+
+def ensure_distributed() -> dict:
+    """Initialize ``jax.distributed`` for a multi-process (pod-slice)
+    run when configured; returns the process facts either way.
+
+    Opt-in: ``RAFT_TPU_DIST=1`` (coordinator/num_processes/process_id
+    from the standard JAX env vars) or an explicit
+    ``RAFT_TPU_COORDINATOR=host:port`` plus ``RAFT_TPU_NUM_PROCESSES`` /
+    ``RAFT_TPU_PROCESS_ID``.  Must run before the first device query on
+    every process; a second call on an initialized runtime is a no-op.
+    Single-process (the virtual-device dry-run) never initializes."""
+    coord = os.environ.get("RAFT_TPU_COORDINATOR", "").strip()
+    want = os.environ.get("RAFT_TPU_DIST", "").strip() in ("1", "on",
+                                                           "true") or coord
+    if want and not _distributed_initialized():
+        kw = {}
+        if coord:
+            kw = {"coordinator_address": coord,
+                  "num_processes": int(
+                      os.environ["RAFT_TPU_NUM_PROCESSES"]),
+                  "process_id": int(os.environ["RAFT_TPU_PROCESS_ID"])}
+        try:
+            jax.distributed.initialize(**kw)
+        except RuntimeError as e:
+            # double-init is the documented benign case; anything else
+            # (bad coordinator, port clash) is a real launch failure
+            if "already" not in str(e).lower():
+                raise errors.KernelFailure(
+                    f"jax.distributed.initialize failed: {e}",
+                    coordinator=coord or "env") from e
+    return process_facts()
+
+
+def _distributed_initialized() -> bool:
+    state = getattr(jax.distributed, "global_state", None)
+    return bool(state is not None and
+                getattr(state, "client", None) is not None)
+
+
+def host_local_put(x, sharding: NamedSharding):
+    """Place ``x`` with ``sharding``.  Single process: plain
+    ``jax.device_put``.  Multi-process: every process holds the SAME
+    global array and contributes its addressable shards via
+    ``jax.make_array_from_process_local_data`` — the single-controller
+    programming model over a pod slice (each process may instead pass
+    its local shard stack when the batch is generated per-host; the
+    helper only requires that local data covers the local devices)."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    # global_shape must be passed explicitly: without it the helper
+    # infers the global shape as if each process held only its own
+    # slice, which would double-count the replicated batch
+    x = np.asarray(x)
+    return jax.make_array_from_process_local_data(
+        sharding, x, global_shape=x.shape)

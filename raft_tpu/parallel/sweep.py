@@ -13,7 +13,14 @@ the full drag-linearization fixed point (lax.while_loop) around one
 batched complex 6x6 solve over all frequencies.
 
 `sweep_cases(...)` vmaps it over a case batch and shards the batch axis
-over the devices of a 1-D mesh.
+over the devices of a named mesh.  Meshes may be multi-axis
+(`parallel/partition.py`): every non-``freq`` axis shards the case
+batch (a ``(variants, cases)`` mesh runs a cases-only sweep over all
+its devices) and a ``freq`` axis additionally shards the frequency-bin
+dimension of the per-case model state at the statics->dynamics phase
+boundary.  Placement is deliberate — regex partition rules over the
+pytree paths, not implicit replication — and non-divisible batches are
+padded with masked lanes that are stripped from results and metrics.
 """
 from __future__ import annotations
 
@@ -22,7 +29,7 @@ import contextlib
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from raft_tpu.models import mooring as mr
 from raft_tpu.models.fowt import (
@@ -117,10 +124,18 @@ def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2,
 
 def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
                      XiStart: float = 0.1, r6=None, fp_chunk: int = 2,
-                     relax: float = 0.8):
+                     relax: float = 0.8, mesh: Mesh = None):
     """Pure per-case response solver (no aero; wave loading) suitable for
     jit/vmap.  Returns fn(Hs, Tp, beta_rad) -> dict(Xi (6,nw) complex,
-    std (6,))."""
+    std (6,)).
+
+    ``mesh``: when the named mesh has a ``freq`` axis, the batched
+    solver reshards the per-case model state onto it at the
+    statics->dynamics boundary (partition.STATE_RULES) and gathers the
+    response back to frequency-replicated before any reduction over
+    frequency — so the sharded program's summation order, and therefore
+    its output, is bitwise-identical to the unsharded one."""
+    from raft_tpu.parallel import partition
     if fowt.potSecOrder > 0:
         import warnings
         warnings.warn(
@@ -202,9 +217,19 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         st = jax.vmap(setup)(Hs, Tp, beta)
         nc = Hs.shape[0]
         Xi0 = jnp.zeros((nc, 6, nw), dtype=complex) + XiStart
+        if partition.has_freq_axis(mesh):
+            # statics->dynamics phase boundary: the ONE place the
+            # layout changes — impedance/excitation stacks pick up the
+            # frequency axis here (rule-matched over the state pytree)
+            st = partition.constrain(st, mesh, partition.STATE_RULES)
+            Xi0 = partition.constrain(Xi0, mesh, partition.XI_SPEC)
         _, Xi, done, iters, chunks = unrolled_fixed_point(
             lambda XiLast: drag_step(st, XiLast), Xi0, nIter, tol,
             chunk=fp_chunk, relax=relax)
+        if partition.has_freq_axis(mesh):
+            # gather the frequency axis BEFORE the spectral reduction so
+            # per-device summation order matches the unsharded program
+            Xi = partition.constrain(Xi, mesh, partition.BATCH_ONLY)
         std = get_rms(Xi, axis=-1)
         # per-lane health streamed out of the batched program while it
         # runs — the finite/converged flags an operator tails to see a
@@ -216,6 +241,9 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
                     fp_chunks=chunks)
 
     solve.batched = solve_batched
+    # introspection hook: the per-case state pytree at the
+    # statics->dynamics boundary (partition-rule tests match over it)
+    solve.setup = setup
     return solve
 
 
@@ -328,6 +356,19 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
     ``converged`` flags).  With no mesh, runs as a plain vmap on the
     default device.
 
+    ``mesh`` may be multi-axis (``parallel/partition.py``): the case
+    batch shards over the product of every non-``freq`` axis — so both
+    a 1-D ``("cases",)`` mesh and a 2-D ``("variants", "cases")`` mesh
+    use all their devices for a case sweep — and a ``freq`` axis
+    additionally shards the frequency dimension of the model state at
+    the statics->dynamics boundary.  Input placement is deliberate
+    (partition rules -> shard fns, not implicit replication), a batch
+    not divisible by the mesh's batch size is padded with masked lanes
+    (stripped from results AND metrics), and the legacy ``axis_name``
+    argument is ignored when the mesh is named (the axes come from the
+    mesh itself).  On a multi-process run call
+    ``partition.ensure_distributed()`` before building the mesh.
+
     Observability: the run is wrapped in nested ``obs`` spans
     (``sweep_cases`` -> build/execute), the per-case iteration counts
     feed the ``raft_sweep_fixed_point_iterations`` histogram, and a
@@ -345,13 +386,15 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
     """
     from raft_tpu import obs
     from raft_tpu.ops import linalg as _linalg
-    from raft_tpu.parallel import exec_cache
+    from raft_tpu.parallel import exec_cache, partition
 
     ncases = int(jnp.asarray(Hs).shape[0])
+    mesh_info = partition.mesh_facts(mesh)
     manifest = obs.RunManifest.begin(kind="sweep_cases", config={
         "ncases": ncases, "nw": len(fowt.w),
         "sharded": mesh is not None,
         "mesh_devices": 0 if mesh is None else int(mesh.devices.size),
+        "mesh": mesh_info,
         **{k: v for k, v in kw.items() if isinstance(v, (int, float, str))}})
     obs.record_build_info(run_id=manifest.run_id)
     obs.device.jit_cache_delta(scope="sweep_cases")      # delta baseline
@@ -362,16 +405,23 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
         with obs.span("sweep_cases", ncases=ncases,
                       sharded=mesh is not None) as sp:
             with obs.span("sweep_build", ncases=ncases):
-                solver = make_case_solver(fowt, **kw)
+                solver = make_case_solver(fowt, mesh=mesh, **kw)
                 batched = jax.jit(solver.batched)
                 Hs = jnp.asarray(Hs, float)
                 Tp = jnp.asarray(Tp, float)
                 beta = jnp.asarray(beta, float)
+                npad = 0
                 if mesh is not None:
-                    sh = NamedSharding(mesh, P(axis_name))
-                    Hs = jax.device_put(Hs, sh)
-                    Tp = jax.device_put(Tp, sh)
-                    beta = jax.device_put(beta, sh)
+                    # pad the case axis to a batch-shard multiple with
+                    # masked lanes (stripped below), then place every
+                    # input deliberately via the matched partition rules
+                    (Hs, Tp, beta), npad = partition.pad_batch(
+                        (Hs, Tp, beta), ncases, partition.batch_size(mesh))
+                    placed = partition.shard_tree(
+                        {"Hs": Hs, "Tp": Tp, "beta": beta}, mesh,
+                        partition.CASE_INPUT_RULES)
+                    Hs, Tp, beta = (placed["Hs"], placed["Tp"],
+                                    placed["beta"])
             # persistent executable cache: a warm start skips
             # sweep_lower + sweep_compile entirely
             key = None
@@ -382,10 +432,22 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                     key = exec_cache.make_key(
                         fn="sweep_cases",
                         model=exec_cache.model_digest(fowt),
-                        nw=len(fowt.w), batch_shape=[ncases],
+                        nw=len(fowt.w),
+                        batch_shape=[int(jnp.shape(Hs)[0])],
                         dtype=str(Hs.dtype),
-                        mesh=(None if mesh is None
-                              else sorted(mesh.shape.items())),
+                        # full ORDERED topology (axis names + sizes +
+                        # process span) plus the partition-rule
+                        # fingerprint: a (2,4) (cases,freq) program is
+                        # never served for a (2,4) (variants,cases)
+                        # request, and editing a rule invalidates every
+                        # program it shaped
+                        mesh=mesh_info,
+                        partition_rules=(
+                            None if mesh is None
+                            else partition.rules_fingerprint(
+                                partition.CASE_INPUT_RULES,
+                                partition.STATE_RULES,
+                                partition.XI_SPEC)),
                         kw={k: v for k, v in kw.items()
                             if isinstance(v, (int, float, str, bool))},
                         # array-valued kwargs (r6) are baked into the
@@ -445,6 +507,14 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                                   "nw": len(fowt.w),
                                   "solver": _linalg.last_dispatch()})
                     cache_info["stored"] = stored is not None
+            if npad:
+                # strip the masked pad lanes BEFORE any summary pull,
+                # metric, quarantine decision or ledger digest — the
+                # padding is a placement detail, never a result
+                fp_c = out["fp_chunks"]
+                out = {k: v for k, v in out.items() if k != "fp_chunks"}
+                out = partition.unpad_batch(out, ncases)
+                out["fp_chunks"] = fp_c
             # fault-injection seam: nan@sweep[:lane=K] poisons lanes so
             # the quarantine detection below sees a corrupt-solve batch;
             # raise@sweep fails the batch as a typed KernelFailure
@@ -505,6 +575,14 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
             sp.set(converged=n_conv, iters_max=int(iters.max(initial=0)),
                    fp_chunks=fp_chunks,
                    exec_cache=cache_info["state"])
+            if mesh_info is not None:
+                sp.set(mesh=mesh_info["topology"])
+                obs.gauge(
+                    "raft_tpu_mesh_devices",
+                    "devices in the active sweep mesh, labeled by the "
+                    "ordered axis topology").set(
+                        mesh_info["devices"],
+                        topology=mesh_info["topology"])
             obs.histogram(
                 "raft_sweep_fixed_point_iterations",
                 "per-case drag fixed-point iterations in the batched sweep",
@@ -530,6 +608,12 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                 "recover (left NaN in the batch outputs)").set(float(
                     len((quarantine_info or {}).get("quarantined", []))))
         manifest.extra["exec_cache"] = cache_info
+        if mesh_info is not None:
+            manifest.extra["partition"] = {
+                "mesh": mesh_info, "npad": npad,
+                "rules": partition.rules_fingerprint(
+                    partition.CASE_INPUT_RULES, partition.STATE_RULES,
+                    partition.XI_SPEC)}
         if quarantine_info is not None:
             manifest.extra["quarantine"] = quarantine_info
         # on a warm start nothing traced in-process, so last_dispatch()

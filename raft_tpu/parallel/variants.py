@@ -30,7 +30,7 @@ from typing import Optional
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from raft_tpu import _config
 from raft_tpu.models import mooring as mr
@@ -200,8 +200,15 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
                         F_env=None, A_turb=None, B_turb=None,
                         ballast: bool = True, nIter: int = 10,
                         tol: float = 0.01, XiStart: float = 0.1,
-                        newton_iters: int = 20, fp_chunk: int = 2):
+                        newton_iters: int = 20, fp_chunk: int = 2,
+                        mesh: Optional[Mesh] = None):
     """Build the pure per-variant function θ -> outputs.
+
+    ``mesh``: a named mesh with a ``freq`` axis reshards the
+    per-variant model state onto it at the statics->dynamics boundary
+    (partition.STATE_RULES) and gathers the response back before the
+    spectral reduction — same bitwise-parity contract as
+    ``make_case_solver``.
 
     F_env: constant environmental force (aero mean thrust + current drag),
     computed once from the base design per load case (rotor geometry does
@@ -349,14 +356,23 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
         vmap/fori/while interacts pathologically with XLA:TPU layout
         assignment — measured ~300x slower than the same math written
         with explicit batch axes (see tests/test_variants.py)."""
+        from raft_tpu.parallel import partition
         from raft_tpu.parallel.sweep import unrolled_fixed_point
 
         st = jax.vmap(setup)(thetas)
         nv = st["Xeq"].shape[0]
         Xi0 = jnp.zeros((nv, 6, nw), dtype=complex) + XiStart
+        if partition.has_freq_axis(mesh):
+            # statics->dynamics boundary: reshard the impedance/
+            # excitation stacks onto the frequency axis (rule-matched)
+            st = partition.constrain(st, mesh, partition.STATE_RULES)
+            Xi0 = partition.constrain(Xi0, mesh, partition.XI_SPEC)
         _, Xi, _, _, chunks = unrolled_fixed_point(
             lambda XiLast: drag_step(st, XiLast), Xi0, nIter + 1, tol,
             chunk=fp_chunk)
+        if partition.has_freq_axis(mesh):
+            # gather before the spectral reduction (bitwise parity)
+            Xi = partition.constrain(Xi, mesh, partition.BATCH_ONLY)
         out = _finish(st, Xi)
         out["fp_chunks"] = chunks
         return out
@@ -377,27 +393,42 @@ def sweep_variants(base: FOWTModel, thetas: dict, mesh: Optional[Mesh] = None,
 
     When ``parallel.exec_cache`` is enabled, the AOT-compiled variant
     program is cached persistently (keyed by base-model + θ-shape
-    digest); a warm start skips ``variants_lower``/``variants_compile``.
+    digest, the full ordered mesh topology and the partition-rule
+    fingerprint); a warm start skips
+    ``variants_lower``/``variants_compile``.
+
+    ``mesh`` may be multi-axis: the variant batch shards over the
+    product of every non-``freq`` axis (a ``(variants, cases)`` mesh
+    uses all its devices) and a ``freq`` axis shards the frequency
+    dimension of the per-variant model state at the statics->dynamics
+    boundary.  Batches not divisible by the mesh's batch size are
+    padded with masked lanes, stripped from every returned array; the
+    legacy ``axis_name`` argument is ignored for named meshes.
     """
     from raft_tpu import obs
-    from raft_tpu.parallel import exec_cache
+    from raft_tpu.parallel import exec_cache, partition
 
-    solver = make_variant_solver(base, **kw)
+    solver = make_variant_solver(base, mesh=mesh, **kw)
     batched = jax.jit(solver.batched)
     thetas = {k: jnp.asarray(v) if not isinstance(v, list) else
               [jnp.asarray(x) for x in v] for k, v in thetas.items()}
     nv = len(jax.tree.leaves(thetas)[0])
+    mesh_info = partition.mesh_facts(mesh)
     with obs.span("sweep_variants", nv=nv, sharded=mesh is not None) as sp:
         if mesh is not None:
-            ndev = int(np.prod(list(mesh.shape.values())))
-            # pad the variant axis to a device multiple (repeat the last row)
-            npad = (-nv) % ndev
-            if npad:
-                thetas = jax.tree.map(
-                    lambda x: jnp.concatenate(
-                        [x, jnp.repeat(x[-1:], npad, axis=0)]), thetas)
-            sh = NamedSharding(mesh, P(axis_name))
-            thetas = jax.tree.map(lambda x: jax.device_put(x, sh), thetas)
+            sp.set(mesh=mesh_info["topology"])
+            # pad the variant axis to a batch-shard multiple with masked
+            # lanes (stripped below), then place every θ leaf
+            # deliberately via the matched partition rules
+            thetas, _npad = partition.pad_batch(
+                thetas, nv, partition.batch_size(mesh))
+            thetas = partition.shard_tree(thetas, mesh,
+                                          partition.VARIANT_INPUT_RULES)
+            obs.gauge(
+                "raft_tpu_mesh_devices",
+                "devices in the active sweep mesh, labeled by the "
+                "ordered axis topology").set(
+                    mesh_info["devices"], topology=mesh_info["topology"])
         key = None
         exe = None
         if exec_cache.enabled():
@@ -410,8 +441,15 @@ def sweep_variants(base: FOWTModel, thetas: dict, mesh: Optional[Mesh] = None,
                     theta_shapes={k: str([(jnp.shape(x), str(x.dtype))
                                           for x in jax.tree.leaves(v)])
                                   for k, v in sorted(thetas.items())},
-                    mesh=(None if mesh is None
-                          else sorted(mesh.shape.items())),
+                    # full ORDERED topology + partition-rule fingerprint
+                    # (same contract as sweep_cases: no cross-topology
+                    # cache hits, rule edits invalidate)
+                    mesh=mesh_info,
+                    partition_rules=(
+                        None if mesh is None
+                        else partition.rules_fingerprint(
+                            partition.VARIANT_INPUT_RULES,
+                            partition.STATE_RULES, partition.XI_SPEC)),
                     kw={k: v for k, v in kw.items()
                         if isinstance(v, (int, float, str, bool))},
                     # array-valued kwargs (F_env, A_turb, B_turb) are

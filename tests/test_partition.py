@@ -1,0 +1,384 @@
+"""Pod-scale partition layer (parallel/partition.py).
+
+conftest.py forces an 8-virtual-device CPU platform, so these tests
+exercise real 2-D ``jax.sharding.Mesh`` topologies — ``(cases, freq)``
+and ``(variants, cases)`` — without TPU hardware:
+
+* rule matching over the REAL per-case model-state pytree (every leaf
+  gets a spec; an unmatched leaf raises),
+* shard/gather round-trip identity,
+* 2-D vs 1-D vs unsharded sweep parity,
+* padded-batch parity with a prime-sized batch (masked lanes stripped
+  from results AND metrics),
+* mesh-topology cache-key distinctness and the per-topology warm
+  exec-cache hit,
+* the bitwise-parity contract of the sharded model-level dynamics core.
+
+Parity bars: integer solver decisions (fixed-point ``iters``,
+``converged``) must be EXACT; float outputs are allowed XLA's
+partition-induced reassociation jitter only (~1 ulp, bounded here at
+1e-12 absolute — orders of magnitude below the 1e-6 physics ledger
+tolerance).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu import errors, obs
+from raft_tpu.io.designs import load_design
+from raft_tpu.models.fowt import build_fowt
+from raft_tpu.parallel import exec_cache, partition
+from raft_tpu.parallel.sweep import make_case_solver, sweep_cases
+from raft_tpu.parallel.variants import sweep_variants
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+ATOL = 1e-12    # reassociation-only float parity bar (see module doc)
+
+
+@pytest.fixture(scope="module")
+def fowt():
+    design = load_design("Vertical_cylinder")
+    # 10 coarse bins: cheap compiles AND not divisible by the 4-way
+    # freq axis below, so the uneven-frequency-sharding path is the one
+    # under test
+    w = np.arange(0.05, 0.55, 0.05) * 2 * np.pi
+    return build_fowt(design, w, depth=float(design["site"]["water_depth"]))
+
+
+@pytest.fixture(scope="module")
+def cases():
+    rng = np.random.default_rng(11)
+    n = 8
+    return (4.0 + 2.0 * rng.random(n), 8.0 + 6.0 * rng.random(n),
+            np.deg2rad(rng.integers(0, 360, n).astype(float)))
+
+
+@pytest.fixture(scope="module")
+def plain(fowt, cases):
+    """Unsharded baseline batch (computed once per module)."""
+    Hs, Tp, beta = cases
+    return sweep_cases(fowt, Hs, Tp, beta, mesh=None, nIter=4)
+
+
+def _assert_sweep_parity(sharded, plain):
+    assert_allclose(np.asarray(sharded["std"]), np.asarray(plain["std"]),
+                    rtol=0, atol=ATOL)
+    assert_allclose(np.asarray(sharded["Xi"]), np.asarray(plain["Xi"]),
+                    rtol=0, atol=ATOL)
+    # solver DECISIONS must be bit-identical — resharding must never
+    # change a convergence trip
+    np.testing.assert_array_equal(np.asarray(sharded["iters"]),
+                                  np.asarray(plain["iters"]))
+    np.testing.assert_array_equal(np.asarray(sharded["converged"]),
+                                  np.asarray(plain["converged"]))
+
+
+# ---------------------------------------------------------------------------
+# rule matching over the real model pytree
+# ---------------------------------------------------------------------------
+
+def test_rules_cover_the_real_case_state_pytree(fowt, cases):
+    """Every leaf of the batched statics->dynamics state gets a spec,
+    and the frequency-carrying stacks get the freq axis."""
+    Hs, Tp, beta = cases
+    solver = make_case_solver(fowt, nIter=2)
+    st = jax.vmap(solver.setup)(jnp.asarray(Hs), jnp.asarray(Tp),
+                                jnp.asarray(beta))
+    specs = partition.match_partition_rules(partition.STATE_RULES, st)
+    spec_leaves = jax.tree.leaves(
+        specs, is_leaf=lambda s: isinstance(s, P))
+    assert len(spec_leaves) == len(jax.tree.leaves(st))
+    assert all(isinstance(s, P) for s in spec_leaves)
+    # the big frequency-axis stacks are deliberately freq-sharded
+    freq_specced = [name for (name, spec) in
+                    zip([partition.path_name(p) for p, _ in
+                         jax.tree_util.tree_flatten_with_path(st)[0]],
+                        spec_leaves) if partition.FREQ in tuple(spec)]
+    for expected in ("M_lin", "B_BEM", "F_lin", "u0", "drag_pre/s_q",
+                     "drag_pre/u_P"):
+        assert any(expected in n for n in freq_specced), expected
+
+
+def test_unmatched_leaf_raises():
+    with pytest.raises(errors.PartitionRuleError) as exc:
+        partition.match_partition_rules(partition.CASE_INPUT_RULES,
+                                        {"rogue": jnp.ones((4, 3))})
+    assert "rogue" in str(exc.value)
+
+
+def test_scalars_are_never_partitioned():
+    specs = partition.match_partition_rules(
+        (), {"a": jnp.float64(1.0), "b": jnp.ones((1, 1))})
+    assert specs["a"] == P() and specs["b"] == P()
+
+
+def test_resolve_spec_across_topologies():
+    tpl = P(partition.BATCH, None, partition.FREQ)
+    m_cf = partition.make_mesh((2, 4), ("cases", "freq"))
+    assert partition.resolve_spec(tpl, m_cf) == P("cases", None, "freq")
+    m_vc = partition.make_mesh((4, 2), ("variants", "cases"))
+    assert partition.resolve_spec(tpl, m_vc) == P(("variants", "cases"))
+    m_f = partition.make_mesh((8,), ("freq",))
+    assert partition.resolve_spec(tpl, m_f) == P(None, None, "freq")
+    assert partition.batch_size(m_cf) == 2
+    assert partition.batch_size(m_vc) == 8
+    assert partition.batch_size(None) == 1
+
+
+# ---------------------------------------------------------------------------
+# shard / gather round trip
+# ---------------------------------------------------------------------------
+
+def test_shard_and_gather_fns_round_trip():
+    mesh = partition.make_mesh((2, 4), ("cases", "freq"))
+    tree = {"M_lin": jnp.arange(8 * 6 * 6 * 12, dtype=float).reshape(
+                8, 6, 6, 12),
+            "C_lin": jnp.ones((8, 6, 6)),
+            "F_lin": jnp.zeros((8, 6, 12)) + 1j}
+    specs = partition.match_partition_rules(partition.STATE_RULES, tree)
+    shard_fns, gather_fns = partition.make_shard_and_gather_fns(mesh, specs)
+    placed = jax.tree.map(lambda f, x: f(x), shard_fns, tree)
+    # deliberate placement: the full mesh for the freq-sharded stack
+    assert len(placed["M_lin"].sharding.device_set) == 8
+    assert placed["M_lin"].sharding.spec == P("cases", None, None, "freq")
+    assert placed["C_lin"].sharding.spec == P("cases")
+    gathered = jax.tree.map(lambda f, x: f(x), gather_fns, placed)
+    for k in tree:
+        assert gathered[k].sharding.spec == P()       # fully replicated
+        np.testing.assert_array_equal(np.asarray(gathered[k]),
+                                      np.asarray(tree[k]))
+
+
+def test_pad_and_unpad_batch():
+    tree = {"a": jnp.arange(13.0), "b": jnp.ones((13, 3))}
+    padded, npad = partition.pad_batch(tree, 13, 8)
+    assert npad == 3
+    assert padded["a"].shape == (16,) and padded["b"].shape == (16, 3)
+    # masked lanes repeat the last valid row (numerically benign)
+    np.testing.assert_array_equal(np.asarray(padded["a"][13:]),
+                                  np.full(3, 12.0))
+    restored = partition.unpad_batch(padded, 13)
+    np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                  np.arange(13.0))
+    same, npad0 = partition.pad_batch(tree, 13, 1)
+    assert npad0 == 0 and same is tree
+
+
+# ---------------------------------------------------------------------------
+# sweep parity: 2-D vs 1-D vs unsharded on 8 virtual devices
+# ---------------------------------------------------------------------------
+
+def test_sweep_2d_cases_freq_matches_unsharded(fowt, cases, plain):
+    Hs, Tp, beta = cases
+    mesh = partition.make_mesh((2, 4), ("cases", "freq"))
+    out = sweep_cases(fowt, Hs, Tp, beta, mesh=mesh, nIter=4)
+    _assert_sweep_parity(out, plain)
+    assert len(out["std"].sharding.device_set) == 8
+
+
+def test_sweep_2d_variants_cases_mesh_runs_case_batch(fowt, cases, plain):
+    """A (variants, cases) mesh runs a cases-only sweep over ALL its
+    devices: the batch axis shards over the product of both axes."""
+    Hs, Tp, beta = cases
+    mesh = partition.make_mesh((4, 2), ("variants", "cases"))
+    out = sweep_cases(fowt, Hs, Tp, beta, mesh=mesh, nIter=4)
+    _assert_sweep_parity(out, plain)
+    assert len(out["std"].sharding.device_set) == 8
+
+
+def test_padded_prime_batch_parity_and_manifest(fowt, tmp_path,
+                                                monkeypatch):
+    """A prime-sized batch on a 2-D mesh: padded lanes must be invisible
+    in results, metrics, the manifest and the trend store."""
+    monkeypatch.setenv("RAFT_TPU_OBS_DIR", str(tmp_path))
+    obs.reset_all()
+    rng = np.random.default_rng(5)
+    n = 13
+    Hs = 4.0 + 2.0 * rng.random(n)
+    Tp = 8.0 + 6.0 * rng.random(n)
+    beta = np.zeros(n)
+    plain13 = sweep_cases(fowt, Hs, Tp, beta, mesh=None, nIter=3)
+    mesh = partition.make_mesh((2, 4), ("cases", "freq"))
+    out = sweep_cases(fowt, Hs, Tp, beta, mesh=mesh, nIter=3)
+    assert np.asarray(out["std"]).shape == (13, 6)
+    assert np.asarray(out["Xi"]).shape[0] == 13
+    assert np.asarray(out["iters"]).shape == (13,)
+    _assert_sweep_parity(out, plain13)
+    # metrics saw the TRUE batch size, not the padded one
+    snap = obs.snapshot()
+    batch = snap["raft_sweep_batch_cases"]["series"]
+    assert {s["value"] for s in batch} == {13.0}
+    meshg = snap["raft_tpu_mesh_devices"]["series"]
+    assert meshg[0]["labels"]["topology"] == "cases=2xfreq=4"
+    # the manifest records the full topology + the pad count
+    manifests = sorted(f for f in os.listdir(tmp_path)
+                       if f.endswith(".manifest.json"))
+    docs = [json.load(open(os.path.join(tmp_path, f))) for f in manifests]
+    doc = [d for d in docs if d["config"].get("mesh")][-1]
+    assert doc["config"]["mesh"]["axes"] == ["cases", "freq"]
+    assert doc["config"]["mesh"]["shape"] == [2, 4]
+    # padding goes to the BATCH-shard multiple (the cases axis is 2-way
+    # on this mesh; freq does not consume batch lanes): 13 -> 14
+    assert doc["extra"]["partition"]["npad"] == 1
+    assert doc["extra"]["partition"]["rules"]
+    # ... and the trend store + obsctl trend expose the topology column
+    from raft_tpu.obs import trendstore
+    facts = trendstore.facts_from_manifest(doc)
+    assert facts["mesh"] == "cases=2xfreq=4"
+    assert facts["mesh_devices"] == 8
+    from tools import obsctl
+    rows = obsctl._store_trend_rows(os.path.join(str(tmp_path),
+                                                 "trend.sqlite"))
+    assert any(r.get("mesh") == "cases=2xfreq=4" for r in rows)
+
+
+def test_variants_2d_mesh_parity(fowt):
+    nmem = len(fowt.members)
+    nv = 5                       # pads to 8 on the 2-D mesh
+    scales = np.linspace(0.9, 1.1, nv)
+    thetas = {"d_scale": np.ones((nv, nmem, 2)) * scales[:, None, None]}
+    kw = dict(ballast=False, nIter=3, newton_iters=4)
+    plain = sweep_variants(fowt, thetas, mesh=None, **kw)
+    mesh = partition.make_mesh((4, 2), ("variants", "cases"))
+    out = sweep_variants(fowt, thetas, mesh=mesh, **kw)
+    for k in ("std", "Xi", "mass", "Xeq", "GMT"):
+        assert np.asarray(out[k]).shape == np.asarray(plain[k]).shape
+        assert_allclose(np.asarray(out[k]), np.asarray(plain[k]),
+                        rtol=1e-12, atol=ATOL)
+
+
+# ---------------------------------------------------------------------------
+# model-level dynamics core: bitwise through the freq axis
+# ---------------------------------------------------------------------------
+
+def test_sharded_dynamics_core_is_bitwise(rng):
+    from raft_tpu.model import _dyn_solve_core, _dyn_solve_jit
+
+    nw, n6, nH = 10, 6, 3
+    Z = rng.random((nw, n6, n6)) + 1j * rng.random((nw, n6, n6))
+    Zinv = np.linalg.inv(Z)
+    F = rng.random((nH, n6, nw)) + 1j * rng.random((nH, n6, nw))
+    Xi0, rel0 = jax.jit(_dyn_solve_core)(Zinv, Z, F)
+    for shape, axes in (((8,), ("freq",)), ((2, 4), ("cases", "freq"))):
+        mesh = partition.make_mesh(shape, axes)
+        Xi1, rel1 = _dyn_solve_jit(mesh)(Zinv, Z, F)
+        # element-wise solve: sharding must not move a single bit
+        np.testing.assert_array_equal(np.asarray(Xi0), np.asarray(Xi1))
+        # the telemetry residual reduces over the sharded axis —
+        # reassociation jitter only
+        assert_allclose(np.asarray(rel1), np.asarray(rel0),
+                        rtol=0, atol=1e-14)
+    # distinct topologies get distinct compiled programs
+    assert _dyn_solve_jit(partition.make_mesh((8,), ("freq",))) is not \
+        _dyn_solve_jit(partition.make_mesh((2, 4), ("cases", "freq")))
+
+
+# ---------------------------------------------------------------------------
+# executable-cache topology identity
+# ---------------------------------------------------------------------------
+
+def test_cache_key_distinguishes_mesh_topologies():
+    m_cf = partition.make_mesh((2, 4), ("cases", "freq"))
+    m_vc = partition.make_mesh((2, 4), ("variants", "cases"))
+    m_fc = partition.make_mesh((4, 2), ("cases", "freq"))
+    keys = {exec_cache.make_key(fn="sweep_cases", model="sha256:aa",
+                                mesh=partition.mesh_facts(m), rules="r1")
+            for m in (m_cf, m_vc, m_fc)}
+    # same sorted shape, SAME device count — but three distinct programs
+    assert len(keys) == 3
+    # the rule fingerprint is part of the identity too
+    assert exec_cache.make_key(
+        fn="s", mesh=partition.mesh_facts(m_cf),
+        rules=partition.rules_fingerprint(partition.STATE_RULES)) != \
+        exec_cache.make_key(
+            fn="s", mesh=partition.mesh_facts(m_cf),
+            rules=partition.rules_fingerprint(partition.CASE_INPUT_RULES))
+
+
+def test_warm_cache_hit_per_topology(fowt, cases, tmp_path, monkeypatch):
+    """Each distinct mesh topology warms its own cache entry: a rerun on
+    the same topology skips lower+compile, a different topology on the
+    same devices is a miss."""
+    Hs, Tp, beta = cases
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    mesh = partition.make_mesh((2, 4), ("cases", "freq"))
+    out1 = sweep_cases(fowt, Hs, Tp, beta, mesh=mesh, nIter=3)
+    agg = obs.aggregate()
+    assert agg["sweep_lower"][1] == 1 and agg["sweep_compile"][1] == 1
+    assert exec_cache.stats()["misses"] == 1
+
+    obs.reset_all()
+    out2 = sweep_cases(fowt, Hs, Tp, beta, mesh=mesh, nIter=3)
+    agg = obs.aggregate()
+    assert "sweep_lower" not in agg and "sweep_compile" not in agg
+    assert exec_cache.stats()["hits"] == 1
+    np.testing.assert_array_equal(np.asarray(out1["Xi"]),
+                                  np.asarray(out2["Xi"]))
+
+    # same devices, same sorted shape — different topology: a MISS
+    obs.reset_all()
+    other = partition.make_mesh((4, 2), ("variants", "cases"))
+    sweep_cases(fowt, Hs, Tp, beta, mesh=other, nIter=3)
+    agg = obs.aggregate()
+    assert agg["sweep_lower"][1] == 1
+    assert exec_cache.stats()["misses"] == 2
+
+
+# ---------------------------------------------------------------------------
+# mesh construction / ambient topology / multi-process plumbing
+# ---------------------------------------------------------------------------
+
+def test_make_mesh_and_facts():
+    mesh = partition.make_mesh((2, 4), ("cases", "freq"))
+    facts = partition.mesh_facts(mesh)
+    assert facts["axes"] == ["cases", "freq"]
+    assert facts["shape"] == [2, 4]
+    assert facts["devices"] == 8
+    assert facts["topology"] == "cases=2xfreq=4"
+    assert facts["processes"] == 1
+    assert partition.mesh_facts(None) is None
+    assert partition.mesh_key(mesh) == (("cases", 2), ("freq", 4))
+    with pytest.raises(errors.PartitionRuleError):
+        partition.make_mesh((4, 4), ("cases", "freq"))   # 16 > 8 devices
+    with pytest.raises(errors.PartitionRuleError):
+        partition.make_mesh((2, 4), ("cases",))          # shape/axes clash
+
+
+def test_ambient_mesh_env(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_MESH", raising=False)
+    assert partition.ambient_mesh() is None
+    monkeypatch.setenv("RAFT_TPU_MESH", "cases=2,freq=4")
+    mesh = partition.ambient_mesh()
+    assert tuple(mesh.axis_names) == ("cases", "freq")
+    assert partition.mesh_facts(mesh)["topology"] == "cases=2xfreq=4"
+    monkeypatch.setenv("RAFT_TPU_MESH", "freq=8")
+    assert partition.mesh_facts(
+        partition.ambient_mesh())["topology"] == "freq=8"
+
+
+def test_ensure_distributed_single_process_is_a_noop(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_DIST", raising=False)
+    monkeypatch.delenv("RAFT_TPU_COORDINATOR", raising=False)
+    facts = partition.ensure_distributed()
+    assert facts == {"process_index": 0, "process_count": 1}
+
+
+def test_rules_fingerprint_stability():
+    f1 = partition.rules_fingerprint(partition.STATE_RULES)
+    assert f1 == partition.rules_fingerprint(partition.STATE_RULES)
+    assert f1 != partition.rules_fingerprint(partition.CASE_INPUT_RULES)
+    # editing a rule changes the fingerprint (cache invalidation)
+    edited = partition.STATE_RULES[:-1] + ((r".*", P(None)),)
+    assert f1 != partition.rules_fingerprint(edited)

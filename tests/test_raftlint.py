@@ -420,6 +420,61 @@ def test_rtl005_plot_py_exempt(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# RTL006 — sharding locality
+# ---------------------------------------------------------------------------
+
+STRAY_SHARDING = """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    def solve_batched(st, Xi, mesh):
+        # stray resharding outside the partition layer
+        Xi = jax.lax.with_sharding_constraint(
+            Xi, NamedSharding(mesh, P("cases", None, "freq")))
+        return Xi
+
+    def build(devices):
+        return Mesh(devices, axis_names=("variants", "cases"))
+"""
+
+
+def test_rtl006_fires_outside_partition_layer(tmp_path):
+    rep = lint_src(tmp_path, STRAY_SHARDING, "RTL006",
+                   relname="raft_tpu/parallel/sweep.py")
+    msgs = [f.message for f in rep.findings]
+    # the constraint call, the axis literals in NamedSharding/P, and
+    # the Mesh axis_names literal all fire
+    assert any("with_sharding_constraint" in m for m in msgs)
+    assert any("'cases'" in m and "PartitionSpec" in m for m in msgs)
+    assert any("Mesh" in m for m in msgs)
+    assert all(f.rule == "RTL006" for f in rep.findings)
+
+
+def test_rtl006_partition_layer_is_sanctioned(tmp_path):
+    rep = lint_src(tmp_path, STRAY_SHARDING, "RTL006",
+                   relname="raft_tpu/parallel/partition.py")
+    assert rep.findings == []
+
+
+def test_rtl006_plain_strings_and_other_calls_silent(tmp_path):
+    """Axis-name words in ordinary strings/calls are not sharding
+    constructors; axis-free sharding ctors carry no literal to flag."""
+    rep = lint_src(tmp_path, """
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def describe(log):
+            log.info("sweep over cases and freq bins")   # free text
+            record(kind="cases")                         # not a ctor
+            return P()                                   # no axis name
+
+        def build(devices, axes):
+            return Mesh(devices, axis_names=axes)        # no literal
+    """, "RTL006", relname="raft_tpu/parallel/sweep.py")
+    assert rep.findings == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions / baseline / config / CLI
 # ---------------------------------------------------------------------------
 

@@ -174,9 +174,12 @@ def _trend_row(path: str, doc: dict) -> dict:
             ok = sc.get("ok")
             status = f"{status}/" + ("n/a" if ok is None
                                      else "ok" if ok else "REGR")
+        mesh = (doc.get("config") or {}).get("mesh") or {}
         return {"file": name, "kind": f"manifest/{doc.get('kind')}",
                 "status": status, "value": res.get("value"),
                 "vs_baseline": res.get("vs_baseline"),
+                "mesh": mesh.get("topology") if isinstance(mesh, dict)
+                else None,
                 "digest": f"{doc.get('duration_s', 0) or 0:.1f}s",
                 "when": (doc.get("started_at") or "")[:19]}
     if "tail" in doc and ("cmd" in doc or "n" in doc):    # BENCH_r0*.json
@@ -212,8 +215,11 @@ def _expand_trend_paths(paths: list[str]) -> list[str]:
     return out
 
 
-_TREND_COLS = ("file", "kind", "status", "value", "vs_baseline", "digest",
-               "when")
+#: ``mesh`` renders the ordered axis topology of a partitioned run
+#: (e.g. ``cases=2xfreq=4``, from the trend-store mesh facts) — "-"
+#: for single-device runs and pre-partition documents
+_TREND_COLS = ("file", "kind", "status", "value", "vs_baseline", "mesh",
+               "digest", "when")
 
 
 def _store_trend_rows(db: str, limit: int = None) -> list[dict]:
@@ -229,6 +235,7 @@ def _store_trend_rows(db: str, limit: int = None) -> list[dict]:
                     "kind": f"trend/{r.get('kind')}",
                     "status": r.get("status"), "value": value,
                     "vs_baseline": facts.get("result_vs_baseline"),
+                    "mesh": facts.get("mesh"),
                     "digest": f"{len(facts)} facts",
                     "when": (r.get("started_at") or "-")[:19]})
     return out
@@ -264,7 +271,7 @@ def cmd_trend(args) -> int:
     if args.json:
         print(json.dumps(rows, indent=1))
         return 0
-    cells = [[_fmt(r[c]) for c in _TREND_COLS] for r in rows]
+    cells = [[_fmt(r.get(c)) for c in _TREND_COLS] for r in rows]
     widths = [max(len(c[i]) for c in cells + [list(_TREND_COLS)])
               for i in range(len(_TREND_COLS))]
     print("  ".join(h.ljust(w) for h, w in zip(_TREND_COLS, widths)))

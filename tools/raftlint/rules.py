@@ -9,6 +9,8 @@ RTL002    recompile hazards (traced branch, static args, jit     exec_cache warm
 RTL003    dtype discipline in device-code modules                precision ladder (ROADMAP 5)
 RTL004    exception discipline on solve paths                    errors.py taxonomy + recovery
 RTL005    bare ``print`` in library code                         obs logging/tracing layer
+RTL006    sharding locality: ``with_sharding_constraint`` /      parallel/partition.py rules
+          mesh-axis-name literals outside the partition layer
 ========  =====================================================  ==============================
 
 All rules are stdlib-``ast`` visitors over one parsed module at a time.
@@ -728,5 +730,72 @@ class RTL005:
                     "`# print-ok`)")
 
 
-ALL_RULES = [RTL001(), RTL002(), RTL003(), RTL004(), RTL005()]
+# ---------------------------------------------------------------------------
+# RTL006 — sharding locality
+# ---------------------------------------------------------------------------
+
+class RTL006:
+    """Static twin of the partition-layer contract (PR 8): resharding
+    happens at the statics->dynamics boundary inside
+    ``parallel/partition.py`` and NOWHERE else.  A stray
+    ``with_sharding_constraint`` is an undocumented layout change the
+    exec-cache key cannot see; a hardcoded mesh-axis-name string in a
+    ``PartitionSpec``/``NamedSharding``/``Mesh`` constructor bypasses
+    the rule tables (and their cache-key fingerprint) entirely."""
+
+    code = "RTL006"
+    name = "sharding-locality"
+    summary = ("with_sharding_constraint / mesh-axis-name literals in "
+               "sharding constructors outside parallel/partition.py")
+
+    _DEFAULT_AXIS_NAMES = ["cases", "freq", "variants", "designs"]
+    #: constructors whose string arguments name mesh axes
+    _CTORS = {"PartitionSpec", "NamedSharding", "Mesh", "AbstractMesh",
+              "make_mesh"}
+
+    def check(self, mod, opts):
+        sanctioned = opts.get("sanctioned",
+                              ["raft_tpu/parallel/partition.py"])
+        if _prefix_match(mod.relpath, sanctioned):
+            return
+        axis_names = set(opts.get("axis-names", self._DEFAULT_AXIS_NAMES))
+        aliases = _aliases(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = _canonical(_dotted(node.func), aliases)
+            tail = canon.rsplit(".", 1)[-1]
+            if tail == "with_sharding_constraint":
+                yield mod.finding(
+                    self.code, node,
+                    "with_sharding_constraint outside "
+                    "parallel/partition.py — resharding belongs at the "
+                    "statics->dynamics boundary behind "
+                    "partition.constrain, where the rule fingerprint "
+                    "keys the executable cache")
+            elif tail in self._CTORS:
+                hit = self._axis_literal(node, axis_names)
+                if hit is not None:
+                    yield mod.finding(
+                        self.code, node,
+                        f"mesh-axis-name literal '{hit}' in a {tail} "
+                        "constructor outside parallel/partition.py — "
+                        "use the partition rule tables / mesh helpers "
+                        "so placement stays deliberate and cache-keyed")
+
+    @staticmethod
+    def _axis_literal(call: ast.Call, axis_names) -> str | None:
+        """First mesh-axis-name string literal among the call's
+        argument expressions (tuples/lists included), or None."""
+        exprs = list(call.args) + [kw.value for kw in call.keywords]
+        for expr in exprs:
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str) \
+                        and sub.value in axis_names:
+                    return sub.value
+        return None
+
+
+ALL_RULES = [RTL001(), RTL002(), RTL003(), RTL004(), RTL005(), RTL006()]
 RULES_BY_CODE = {r.code: r for r in ALL_RULES}

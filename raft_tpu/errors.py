@@ -132,6 +132,35 @@ class PartitionRuleError(RaftError, ValueError):
     phase = "setup"
 
 
+class AdmissionRejected(RaftError, RuntimeError):
+    """The serving layer (:mod:`raft_tpu.serve`) refused a request at
+    admission — queue depth or deadline pressure beyond the configured
+    watermarks, or the service is in its ``reject`` degradation mode.
+
+    Carries ``retry_after_s`` (the load-shed hint: the caller's earliest
+    useful resubmission time, estimated from queue depth and the
+    observed batch cadence) as an attribute and in :meth:`context`.
+    Deliberately NOT recoverable by the in-process ladder: backpressure
+    only works if the rejection reaches the caller."""
+
+    phase = "admission"
+
+    def __init__(self, message: str = "", retry_after_s: float = 0.0,
+                 **context):
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(message, retry_after_s=self.retry_after_s,
+                         **context)
+
+
+class DeadlineExceeded(RaftError, TimeoutError):
+    """A request (or the batch carrying it) overran its deadline — the
+    serving watchdog's abandon signal and the typed failure a
+    quarantined-for-hanging request reports.  ``TimeoutError`` base
+    keeps pre-taxonomy timeout handling working."""
+
+    phase = "serve"
+
+
 class FaultInjected(RaftError, RuntimeError):
     """Raised by :mod:`raft_tpu.testing.faults` for ``raise@...`` specs
     at sites without a more specific mapped type."""

@@ -1395,6 +1395,40 @@ class Model:
                           heave_new)
         return delta_rho_fill
 
+    def make_service(self, config=None, coarse_stride: int = 2,
+                     **config_kw):
+        """An always-on sweep service over this model's (single) FOWT —
+        the serving-loop entry point of ROADMAP item 1.
+
+        Builds a :class:`raft_tpu.serve.SweepService` whose warm batch
+        runner closes over the device-resident FOWT state, handing the
+        service a frequency-decimated sibling (every
+        ``coarse_stride``-th bin) as the ``coarse`` degradation rung.
+        Keyword arguments construct the :class:`ServeConfig` when
+        ``config`` is not given.  The caller starts/stops it::
+
+            with model.make_service(batch_cases=8) as svc:
+                ticket = svc.submit(Hs, Tp, heading_rad)
+                result = ticket.result()
+
+        Farm models (``nFOWT > 1``) are not servable — the batched
+        case solver is single-FOWT (see parallel/sweep.py)."""
+        from raft_tpu.models.fowt import build_fowt
+        from raft_tpu.serve import ServeConfig, SweepService
+
+        if self.nFOWT != 1:
+            raise errors.ModelConfigError(
+                "make_service needs a single-FOWT model",
+                nFOWT=self.nFOWT)
+        degraded = None
+        if coarse_stride and int(coarse_stride) > 1:
+            w_coarse = np.asarray(self.w)[::int(coarse_stride)]
+            degraded = {"coarse": build_fowt(
+                self.design, w_coarse, depth=self.depth)}
+        return SweepService(self.fowtList[0],
+                            config or ServeConfig(**config_kw),
+                            degraded_fowts=degraded)
+
     def analyzeCases(self, display=0, RAO_plot=False, resume=False):
         """Statics + dynamics + output statistics per load case.  Records
         nested spans (statics/dynamics/QTF/outputs phases), solver-health

@@ -148,6 +148,19 @@ def facts_from_manifest(doc: dict) -> dict:
     for k in ("value", "vs_baseline", "analyze_cases_s_per_case"):
         if _num(res.get(k)) is not None:
             facts[f"result_{k}"] = res[k]
+    # serving-layer facts (raft_tpu/serve): one row per service
+    # lifetime, gated by the serve SLO rules below
+    serve = extra.get("serve") or {}
+    if isinstance(serve, dict):
+        for k in ("requests", "admitted", "rejected", "completed",
+                  "failed", "quarantined", "retries",
+                  "retried_recovered", "deadline_misses", "unhandled",
+                  "batches", "abandoned_batches", "n_mode_transitions",
+                  "p50_latency_s", "p99_latency_s"):
+            if _num(serve.get(k)) is not None:
+                facts[f"serve_{k}"] = serve[k]
+        if serve.get("mode"):
+            facts["serve_mode"] = str(serve["mode"])
     # probe-channel volume (its own budget, distinct from transfers):
     # the embedded metrics snapshot is process-cumulative, so subtract
     # the baseline RunManifest.begin recorded for THIS run
@@ -336,6 +349,21 @@ DEFAULT_SLO_RULES = [
     {"name": "transfers_per_case_dynamics", "kind": "analyzeCases",
      "fact": "transfers_per_case_dynamics", "agg": "max", "op": "<=",
      "threshold": 4.0, "window": 20},
+    # -- serving-layer gates (raft_tpu/serve; skipped when no serve
+    # runs exist).  Thresholds match the CI chaos soak's worst case
+    # with headroom; operators tighten per deployment via --rules.
+    {"name": "serve_admission_reject_ratio", "kind": "serve",
+     "fact": "serve_rejected", "denom": "serve_requests",
+     "agg": "ratio", "op": "<=", "threshold": 0.75, "window": 20},
+    {"name": "serve_retry_success_ratio", "kind": "serve",
+     "fact": "serve_retried_recovered", "denom": "serve_retries",
+     "agg": "ratio", "op": ">=", "threshold": 0.5, "window": 20},
+    {"name": "serve_deadline_miss_count", "kind": "serve",
+     "fact": "serve_deadline_misses", "agg": "max", "op": "<=",
+     "threshold": 16.0, "window": 20},
+    {"name": "serve_unhandled_errors", "kind": "serve",
+     "fact": "serve_unhandled", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
 ]
 
 _OPS = {

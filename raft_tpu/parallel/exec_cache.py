@@ -43,6 +43,22 @@ from raft_tpu.obs.ledger import digest_metrics
 _LOCK = threading.Lock()
 _STATS = {"hits": 0, "misses": 0, "stores": 0, "errors": 0, "corrupts": 0}
 
+#: in-process memo of deserialized executables (key -> exe) for callers
+#: that re-enter the same program many times per process — the serving
+#: loop's warm path (raft_tpu/serve) deserializes ONCE and then every
+#: batch is a pure ``exe.call``.  Opt-in per load (``memo=True``):
+#: sweep_cases keeps the plain read-validate-deserialize path so the
+#: corrupt-entry machinery stays exercised per call.  Bounded FIFO.
+_MEMO_LOCK = threading.Lock()
+_MEMO: dict[str, object] = {}
+_MEMO_MAX = 8
+
+
+def reset_memo():
+    """Drop every memoized executable (test isolation)."""
+    with _MEMO_LOCK:
+        _MEMO.clear()
+
 #: failure types a deserialized-executable call can legitimately raise
 #: (deserialization drift past the key, XLA runtime errors incl.
 #: jaxlib's XlaRuntimeError — a RuntimeError subclass — and truncated
@@ -180,7 +196,40 @@ def _purge(key: str):
             pass
 
 
-def load(key: str):
+_PRIMED = False
+
+
+def _prime_custom_calls():
+    """Force-register the CPU LAPACK custom-call targets before any
+    deserialized executable runs.
+
+    jaxlib registers its CPU solver custom calls lazily, on the first
+    in-process *lowering* of a linalg op.  A warm-start process that
+    only ever calls a deserialized export never lowers one, and the
+    program's ``lapack_*gesv``-style custom call hits an unregistered
+    target — a hard SIGSEGV at ``exe.call`` (observed with jax 0.4.37
+    on CPU: the identical call succeeds after any in-process
+    ``jit(jnp.linalg.solve)``).  One tiny real+complex solve per
+    process closes the hole for every cached program."""
+    global _PRIMED
+    if _PRIMED:
+        return
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        solve = jax.jit(jnp.linalg.solve)
+        for dt in (float, complex):
+            jax.block_until_ready(solve(jnp.eye(3, dtype=dt),
+                                        jnp.ones(3, dtype=dt)))
+    # priming is a best-effort safety net — a backend without these
+    # ops must not turn every cache load into a failure
+    except Exception:  # raftlint: disable=RTL004
+        pass
+    _PRIMED = True
+
+
+def load(key: str, memo: bool = False):
     """Deserialize the cached executable for ``key``; None on miss.
 
     Entries are validated BEFORE deserialization against the size and
@@ -189,13 +238,24 @@ def load(key: str):
     (one more miss next time, never a runtime error at ``exe.call``).
     Deserialization failures of a digest-valid entry (e.g. a jax
     version change that slipped past the key) still count as ``error``
-    and also purge the entry."""
+    and also purge the entry.
+
+    ``memo=True`` additionally consults/feeds the in-process executable
+    memo: a repeat load of the same key returns the already-deserialized
+    program without touching disk (counted as a ``hit``) — the serving
+    loop's warm path."""
     import hashlib
 
     from jax import export as jexport
 
     from raft_tpu.testing import faults
 
+    if memo:
+        with _MEMO_LOCK:
+            exe = _MEMO.get(key)
+        if exe is not None:
+            _count("hit")
+            return exe
     bin_path, _ = _paths(key)
     try:
         with open(bin_path, "rb") as f:
@@ -213,6 +273,7 @@ def load(key: str):
         _count("corrupt")
         _purge(key)
         return None
+    _prime_custom_calls()
     try:
         exe = jexport.deserialize(bytearray(data))
     # jax.export deserialization raises arbitrary types on drifted/
@@ -223,6 +284,11 @@ def load(key: str):
         _purge(key)
         return None
     _count("hit")
+    if memo:
+        with _MEMO_LOCK:
+            if len(_MEMO) >= _MEMO_MAX:
+                _MEMO.pop(next(iter(_MEMO)))
+            _MEMO[key] = exe
     return exe
 
 

@@ -336,8 +336,11 @@ class CaseJournal:
 
     def load_case(self, iCase: int) -> dict | None:
         """The journaled record of a completed case, or None (missing
-        or unreadable — an unreadable entry is deleted and treated as
-        a miss, like a corrupt executable-cache entry)."""
+        or unreadable — a torn/corrupt pickle, e.g. from a crash
+        mid-``store_case``, is deleted, logged, counted in
+        ``raft_tpu_journal_corrupt_total``, and treated as a miss, like
+        a corrupt executable-cache entry; it never raises into the
+        resume path)."""
         path = self._path(iCase)
         try:
             with open(path, "rb") as f:
@@ -346,12 +349,29 @@ class CaseJournal:
             return None
         except Exception:
             _LOG.warning("journal: corrupt entry %s — deleting", path)
+            self._count_corrupt()
             with contextlib.suppress(OSError):
                 os.remove(path)
             return None
         if not isinstance(doc, dict) or doc.get("iCase") != int(iCase):
+            if doc is not None:
+                # readable pickle, wrong shape: same corruption class
+                _LOG.warning("journal: malformed entry %s — ignoring",
+                             path)
+                self._count_corrupt()
             return None
         return doc
+
+    @staticmethod
+    def _count_corrupt():
+        try:
+            from raft_tpu import obs
+            obs.counter(
+                "raft_tpu_journal_corrupt_total",
+                "torn/corrupt per-case journal entries treated as "
+                "misses on load").inc(1.0)
+        except Exception:                             # pragma: no cover
+            pass
 
     def store_case(self, iCase: int, record: dict):
         """Atomically persist one completed case (never raises — a

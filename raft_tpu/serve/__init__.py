@@ -1,0 +1,26 @@
+"""raft_tpu.serve — the resilient always-on sweep service.
+
+Turns the batch-shaped sweep stack into a long-lived, request-driven
+loop: bounded-queue admission control with typed load shedding
+(:class:`raft_tpu.errors.AdmissionRejected` + Retry-After hints), a
+batching window over one warm compiled program
+(:func:`raft_tpu.parallel.sweep.make_batch_runner` — model state
+device-pinned between requests), per-request deadlines enforced by an
+out-of-band watchdog, per-error-class retry/backoff
+(:mod:`raft_tpu.serve.retry`), and an automatic service degradation
+ladder (``full -> no_qtf -> coarse -> reject``).  Results deliver
+asynchronously, keyed by their ledger content digest.
+
+Entry points: :class:`SweepService` (embedded),
+``tools/raftserve.py`` (CLI: HTTP endpoint + the deterministic chaos
+soak).  See docs/robustness.md "Serving".
+"""
+from raft_tpu.serve.config import MODES, ServeConfig  # noqa: F401
+from raft_tpu.serve.retry import (  # noqa: F401
+    DEFAULT_BUDGETS, TERMINAL, RetryPolicy,
+)
+from raft_tpu.serve.service import (  # noqa: F401
+    SweepResult, SweepService, Ticket,
+)
+from raft_tpu.serve.soak import DEFAULT_FAULTS, run_soak  # noqa: F401
+from raft_tpu.serve.watchdog import Watchdog  # noqa: F401

@@ -1,0 +1,117 @@
+"""Serving-layer configuration (:class:`ServeConfig`).
+
+One frozen-ish dataclass carries every knob of the always-on sweep
+service: admission watermarks, the batching window, deadlines, the
+watchdog, retry budgets, and the service degradation ladder.  Values
+are validated eagerly (a service that boots with a nonsensical
+watermark is a worse failure mode than a loud
+:class:`raft_tpu.errors.ModelConfigError` at construction).
+
+See docs/robustness.md "Serving" for the semantics of each group.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from raft_tpu import errors
+
+#: the service degradation ladder, best -> worst.  ``full`` runs the
+#: configured solver; ``no_qtf`` drops second-order (QTF/mean-drift)
+#: excitation from the solve; ``coarse`` additionally runs on a
+#: decimated frequency grid (both need a degraded model handed to the
+#: service — rungs without one are skipped); ``reject`` sheds every new
+#: request at admission until the backlog drains and the SLO recovers.
+MODES = ("full", "no_qtf", "coarse", "reject")
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Knobs of one :class:`raft_tpu.serve.SweepService`."""
+
+    # -- admission / queue -------------------------------------------
+    #: hard bound on queued (not yet in-flight) requests; admission
+    #: rejects above it with a Retry-After hint
+    queue_max: int = 64
+    #: reject a request at admission when its deadline cannot plausibly
+    #: be met: estimated queue wait > deadline_pressure * deadline
+    deadline_pressure: float = 1.0
+
+    # -- batching window ---------------------------------------------
+    #: fixed case-batch size of the warm compiled program (short
+    #: batches are padded, pad lanes stripped)
+    batch_cases: int = 8
+    #: coalescing window: after the first request of a batch arrives,
+    #: wait at most this long for more before solving
+    window_s: float = 0.05
+
+    # -- deadlines / watchdog ----------------------------------------
+    #: default per-request deadline (admission + in-queue expiry)
+    deadline_s: float = 120.0
+    #: watchdog deadline for one in-flight batch: a solve still running
+    #: after this is abandoned, its members re-admitted solo (repeat
+    #: offenders quarantined)
+    batch_deadline_s: float = 60.0
+    #: watchdog poll cadence
+    watchdog_tick_s: float = 0.05
+    #: abandoned-batch strikes after which a request is quarantined as a
+    #: typed DeadlineExceeded failure instead of re-admitted
+    hang_quarantine_after: int = 2
+
+    # -- retry / backoff (serve/retry.py) ----------------------------
+    retry_base_s: float = 0.05
+    retry_cap_s: float = 2.0
+    retry_jitter: float = 0.5
+    retry_seed: int = 0
+
+    # -- degradation ladder ------------------------------------------
+    #: per-batch latency SLO the mode controller folds (seconds)
+    latency_slo_s: float = 30.0
+    #: consecutive violating batches before stepping DOWN the ladder
+    degrade_after: int = 2
+    #: consecutive healthy batches before stepping back UP
+    upgrade_after: int = 4
+    #: minimum dwell in ``reject`` mode before probing back up
+    reject_hold_s: float = 1.0
+
+    # -- results ------------------------------------------------------
+    #: completed results kept for fetch-by-digest delivery
+    result_cache: int = 256
+
+    # -- solver kwargs forwarded to make_case_solver -----------------
+    nIter: int = 10
+    tol: float = 0.01
+    fp_chunk: int = 2
+
+    def __post_init__(self):
+        checks = [
+            ("queue_max", self.queue_max >= 1),
+            ("batch_cases", self.batch_cases >= 1),
+            ("window_s", self.window_s >= 0.0),
+            ("deadline_s", self.deadline_s > 0.0),
+            ("batch_deadline_s", self.batch_deadline_s > 0.0),
+            ("watchdog_tick_s", self.watchdog_tick_s > 0.0),
+            ("hang_quarantine_after", self.hang_quarantine_after >= 1),
+            ("deadline_pressure", self.deadline_pressure > 0.0),
+            ("retry_base_s", self.retry_base_s >= 0.0),
+            ("retry_cap_s", self.retry_cap_s >= self.retry_base_s),
+            ("retry_jitter", 0.0 <= self.retry_jitter <= 1.0),
+            ("degrade_after", self.degrade_after >= 1),
+            ("upgrade_after", self.upgrade_after >= 1),
+            ("reject_hold_s", self.reject_hold_s >= 0.0),
+            ("result_cache", self.result_cache >= 1),
+            ("nIter", self.nIter >= 1),
+        ]
+        bad = [name for name, ok in checks if not ok]
+        if bad:
+            raise errors.ModelConfigError(
+                "invalid ServeConfig", fields=",".join(bad))
+
+    def solver_kw(self) -> dict:
+        """kwargs forwarded to ``make_case_solver`` / the batch runner."""
+        return {"nIter": int(self.nIter), "tol": float(self.tol),
+                "fp_chunk": int(self.fp_chunk)}
+
+    def scalars(self) -> dict:
+        """Flat scalar snapshot for the service run manifest."""
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if isinstance(v, (bool, int, float, str))}

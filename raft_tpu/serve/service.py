@@ -1,0 +1,831 @@
+"""The always-on sweep service: admission, batching, deadlines, retry.
+
+:class:`SweepService` turns the batch-shaped sweep stack into a
+long-lived, request-driven loop whose headline property is *staying up
+and degrading gracefully* under sustained, partially-faulty traffic:
+
+- **Admission control / load shedding** — a bounded request queue;
+  above the ``queue_max`` watermark (or when the estimated queue wait
+  already blows the request's deadline, or while the service sits in
+  its ``reject`` degradation mode) ``submit`` raises a typed
+  :class:`raft_tpu.errors.AdmissionRejected` carrying a ``Retry-After``
+  hint derived from queue depth and the observed batch cadence.
+- **Batching window** — admitted requests coalesce for ``window_s``
+  into fixed-size batches solved by ONE warm compiled program
+  (:func:`raft_tpu.parallel.sweep.make_batch_runner`): model state is
+  device-resident across requests, the executable cache serves the
+  program on a warm start, and no per-batch tracing happens.
+- **Deadlines + watchdog** — a stuck solve cannot be cancelled inside
+  JAX, so the :class:`raft_tpu.serve.watchdog.Watchdog` abandons the
+  batch out-of-band: members are re-admitted *solo* (so a repeat
+  offender isolates itself), repeat offenders are quarantined as typed
+  :class:`~raft_tpu.errors.DeadlineExceeded` failures, and a fresh
+  worker replaces the stuck one — the process never dies.
+- **Retry/backoff** — typed solver failures walk the per-error-class
+  budgets of :class:`raft_tpu.serve.retry.RetryPolicy` with
+  deterministic jittered exponential backoff; transient faults never
+  surface to callers.
+- **Service degradation ladder** — sustained SLO violation steps the
+  service ``full -> no_qtf -> coarse -> reject`` (and back up when
+  healthy); every transition is a flight-recorder event, a metric, and
+  a manifest record.
+
+Results are delivered asynchronously: ``submit`` returns a
+:class:`Ticket`; each completed request carries the ledger-style
+content digest of its physics outputs (identical to the ``case<i>``
+entry digest a clean ``sweep_cases`` ledger would hold), and completed
+results are additionally fetchable by that digest.
+
+Everything here is host-side orchestration — the module never imports
+jax at module scope and all solve work happens through the injected
+``runner_factory`` (default: the warm batch runner over the service's
+FOWT model).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+import uuid
+
+import numpy as np
+
+from raft_tpu import errors
+from raft_tpu.serve.config import MODES, ServeConfig
+from raft_tpu.serve.retry import RetryPolicy
+from raft_tpu.serve.watchdog import Watchdog
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("serve")
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """One request's terminal outcome (ok or typed failure)."""
+
+    ok: bool
+    request_id: str
+    seq: int
+    mode: str
+    attempts: int
+    latency_s: float
+    digest: str | None = None
+    std: list | None = None
+    iters: int | None = None
+    converged: bool | None = None
+    quarantined: bool = False
+    error: dict | None = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Ticket:
+    """Async handle of one admitted request."""
+
+    def __init__(self, request_id: str, seq: int):
+        self.id = request_id
+        self.seq = seq
+        self._event = threading.Event()
+        self._result: SweepResult | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float = None) -> SweepResult:
+        """Block for the terminal result; raises a typed
+        :class:`~raft_tpu.errors.DeadlineExceeded` on wait timeout."""
+        if not self._event.wait(timeout):
+            raise errors.DeadlineExceeded("result wait timed out",
+                                          request=self.id)
+        return self._result
+
+    def _finish(self, result: SweepResult):
+        self._result = result
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("seq", "id", "Hs", "Tp", "beta", "deadline_ts",
+                 "submitted_ts", "attempts", "total_attempts", "strikes",
+                 "solo", "not_before", "ticket")
+
+    def __init__(self, seq, Hs, Tp, beta, deadline_ts, now):
+        self.seq = int(seq)
+        self.id = f"req{seq}-{uuid.uuid4().hex[:8]}"
+        self.Hs = float(Hs)
+        self.Tp = float(Tp)
+        self.beta = float(beta)
+        self.deadline_ts = float(deadline_ts)
+        self.submitted_ts = float(now)
+        self.attempts: dict[str, int] = {}
+        self.total_attempts = 0
+        self.strikes = 0
+        self.solo = False
+        self.not_before = 0.0
+        self.ticket = Ticket(self.id, self.seq)
+
+
+class SweepService:
+    """Long-lived request-driven sweep service (see module docstring).
+
+    ``fowt``: the model every request solves against (device-pinned for
+    the service lifetime).  ``degraded_fowts`` optionally maps ladder
+    rungs to degraded models (``{"coarse": fowt_on_decimated_grid}``);
+    the ``no_qtf`` rung is auto-derived when the model carries
+    second-order terms, and rungs with no model are skipped.
+    ``runner_factory(mode, fowt, ncases, **solver_kw)`` overrides the
+    batch engine (tests inject stubs; default is the warm
+    ``make_batch_runner``).
+    """
+
+    def __init__(self, fowt=None, config: ServeConfig = None, *,
+                 degraded_fowts: dict = None, runner_factory=None):
+        self.cfg = config or ServeConfig()
+        self.fowt = fowt
+        self.retry = RetryPolicy.from_config(self.cfg)
+        self._runner_factory = runner_factory
+        self._watchdog = Watchdog(self.cfg.watchdog_tick_s)
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._inflight: dict[int, dict] = {}
+        #: requests popped by _gather but not yet registered in
+        #: _inflight — without it, stop()'s idle check can declare the
+        #: service drained inside the pop->register window and a retry
+        #: requeued after that leaves its ticket unresolved forever
+        self._ngathered = 0
+        self._runners: dict[str, object] = {}
+        self._fowts = self._build_fowt_ladder(degraded_fowts or {})
+        self.ladder = tuple(m for m in MODES
+                            if m in self._fowts or m == "reject")
+        self._mode_idx = 0
+        self._mode_entered = time.monotonic()
+        self._bad_streak = 0
+        self._good_streak = 0
+        self._seq = 0
+        self._batch_seq = 0
+        self._gen = 0
+        self._worker: threading.Thread | None = None
+        self._state = "new"            # new | running | draining | stopped
+        self._ema_batch_s: float | None = None
+        # bounded: a long-lived service must not grow per-request state
+        # without limit; 10k samples is plenty for p50/p99 reporting
+        self._latencies: collections.deque[float] = collections.deque(
+            maxlen=10_000)
+        self._delivered: collections.OrderedDict[str, SweepResult] = \
+            collections.OrderedDict()
+        self._transitions: list[dict] = []
+        self._counts = {k: 0 for k in (
+            "admitted", "rejected", "completed", "failed", "quarantined",
+            "retries", "retried_recovered", "deadline_misses",
+            "unhandled", "batches", "abandoned_batches", "expired")}
+        self._manifest = None
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+
+    def _build_fowt_ladder(self, degraded: dict) -> dict:
+        out = {"full": self.fowt}
+        if "no_qtf" in degraded:
+            out["no_qtf"] = degraded["no_qtf"]
+        elif self.fowt is not None and \
+                getattr(self.fowt, "potSecOrder", 0):
+            try:
+                out["no_qtf"] = dataclasses.replace(
+                    self.fowt, potSecOrder=0)
+            except (TypeError, ValueError):
+                pass                    # rung unavailable: skipped
+        if "coarse" in degraded:
+            out["coarse"] = degraded["coarse"]
+        if self._runner_factory is not None:
+            # an injected engine serves every configured rung
+            for m in degraded:
+                out.setdefault(m, degraded[m])
+        return out
+
+    # ------------------------------------------------------------------
+    # observability plumbing
+    # ------------------------------------------------------------------
+
+    def _obs(self):
+        from raft_tpu import obs
+        return obs
+
+    def _emit(self, type_: str, **fields):
+        self._obs().events.emit(type_, **fields)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> "SweepService":
+        obs = self._obs()
+        with self._lock:
+            if self._state not in ("new", "stopped"):
+                return self
+            self._state = "running"
+        self._manifest = obs.RunManifest.begin(
+            kind="serve",
+            config={**self.cfg.scalars(),
+                    "ladder": "->".join(self.ladder),
+                    "nw": (len(self.fowt.w)
+                           if self.fowt is not None else 0)})
+        obs.record_build_info(run_id=self._manifest.run_id)
+        self._watchdog.start()
+        self._spawn_worker()
+        self._emit("service_start", run_id=self._manifest.run_id,
+                   ladder=list(self.ladder))
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _spawn_worker(self):
+        with self._lock:
+            self._gen += 1
+            gen = self._gen
+            t = threading.Thread(target=self._worker_loop, args=(gen,),
+                                 name=f"raft-serve-worker-{gen}",
+                                 daemon=True)
+            self._worker = t
+        t.start()
+
+    def stop(self, drain: bool = True, timeout: float = 120.0) -> dict:
+        """Stop the service (optionally draining the queue first),
+        finish the run manifest (-> trend store), and return the serve
+        summary."""
+        with self._cond:
+            if self._state == "stopped":
+                return self.summary()
+            self._state = "draining" if drain else "stopped"
+            self._cond.notify_all()
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = (not self._queue and not self._inflight
+                        and self._ngathered == 0)
+            if idle:
+                break
+            time.sleep(0.02)
+        with self._cond:
+            self._state = "stopped"
+            # flush anything left (non-drain stop or drain timeout)
+            leftovers = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for r in leftovers:
+            self._fail(r, errors.DeadlineExceeded(
+                "service stopped before the request ran", req=r.seq))
+        worker = self._worker
+        if worker is not None:
+            worker.join(2.0)
+        self._watchdog.stop()
+        summary = self.summary()
+        if self._manifest is not None:
+            obs = self._obs()
+            self._manifest.extra["serve"] = summary
+            self._manifest.extra["retry_matrix"] = self.retry.matrix()
+            obs.finish_run(self._manifest, status="ok")
+            self._manifest = None
+        return summary
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+
+    def _estimate_wait_locked(self) -> float:
+        depth = len(self._queue) + sum(
+            len(b["reqs"]) for b in self._inflight.values())
+        batches_ahead = -(-max(1, depth) // self.cfg.batch_cases)
+        per_batch = self._ema_batch_s if self._ema_batch_s is not None \
+            else 1.0
+        return batches_ahead * per_batch + self.cfg.window_s
+
+    def submit(self, Hs: float, Tp: float, heading_rad: float,
+               deadline_s: float = None) -> Ticket:
+        """Admit one case request; returns its :class:`Ticket`.
+
+        Raises :class:`~raft_tpu.errors.AdmissionRejected` (with a
+        ``retry_after_s`` hint) when the queue watermark, deadline
+        pressure, the ``reject`` degradation mode, or shutdown forbids
+        admission."""
+        obs = self._obs()
+        now = time.monotonic()
+        deadline_s = float(deadline_s if deadline_s is not None
+                           else self.cfg.deadline_s)
+        with self._cond:
+            retry_after = self._estimate_wait_locked()
+            reason = None
+            if self._state in ("draining", "stopped"):
+                reason = "stopped"
+            elif self.ladder[self._mode_idx] == "reject":
+                reason = "degraded"
+                retry_after = max(retry_after, self.cfg.reject_hold_s)
+            elif len(self._queue) >= self.cfg.queue_max:
+                reason = "queue_full"
+            elif retry_after > deadline_s * self.cfg.deadline_pressure:
+                reason = "deadline_pressure"
+            if reason is not None:
+                self._counts["rejected"] += 1
+                depth = len(self._queue)
+            else:
+                seq = self._seq
+                self._seq += 1
+                req = _Request(seq, Hs, Tp, heading_rad,
+                               now + deadline_s, now)
+                self._queue.append(req)
+                self._counts["admitted"] += 1
+                depth = len(self._queue)
+                self._cond.notify_all()
+        obs.gauge("raft_tpu_serve_queue_depth",
+                  "requests queued (not in flight) in the sweep "
+                  "service").set(float(depth))
+        if reason is not None:
+            obs.counter(
+                "raft_tpu_serve_admission_rejects_total",
+                "requests shed at admission, by reason").inc(
+                    1.0, reason=reason)
+            self._emit("admission_reject", reason=reason,
+                       retry_after_s=retry_after, queue_depth=depth)
+            raise errors.AdmissionRejected(
+                f"admission rejected ({reason})",
+                retry_after_s=retry_after, reason=reason,
+                queue_depth=depth)
+        obs.counter("raft_tpu_serve_requests_total",
+                    "request admissions/outcomes of the sweep service"
+                    ).inc(1.0, outcome="admitted")
+        return req.ticket
+
+    # ------------------------------------------------------------------
+    # worker: gather -> solve -> split
+    # ------------------------------------------------------------------
+
+    def _pop_ready_locked(self, now: float, solo_ok: bool = True):
+        for i, r in enumerate(self._queue):
+            if r.not_before <= now and (solo_ok or not r.solo):
+                del self._queue[i]
+                return r
+        return None
+
+    def _worker_loop(self, gen: int):
+        while True:
+            batch = self._gather(gen)
+            if batch is None:
+                return
+            try:
+                self._run_batch(batch, gen)
+            # the serve worker is the service's keep-alive seam
+            # (config-sanctioned for RTL004): any escape here would
+            # kill the loop, so unexpected failures are counted,
+            # logged, and turned into typed results
+            except Exception:
+                _LOG.exception("serve: unhandled batch failure")
+                with self._lock:
+                    self._counts["unhandled"] += 1
+                for r in batch:
+                    if not r.ticket.done():
+                        self._fail(r, errors.KernelFailure(
+                            "unhandled service error", unhandled=True))
+
+    def _gather(self, gen: int) -> list[_Request] | None:
+        """Block until a batch is ready (None = this worker retires)."""
+        first = None
+        with self._cond:
+            while True:
+                if self._gen != gen or self._state == "stopped":
+                    return None
+                now = time.monotonic()
+                first = self._pop_ready_locked(now)
+                if first is not None:
+                    self._ngathered += 1
+                    break
+                if self._state == "draining" and not self._queue \
+                        and not self._inflight:
+                    return None
+                # idle: a held reject mode probes back up once the
+                # backlog is gone and the hold elapsed
+                if not self._queue \
+                        and self.ladder[self._mode_idx] == "reject" \
+                        and now - self._mode_entered \
+                        >= self.cfg.reject_hold_s:
+                    self._step_mode_locked(-1, reason="reject_hold")
+                self._cond.wait(0.02)
+        if first.deadline_ts < time.monotonic():
+            self._ungather(1)
+            self._expire(first)
+            return []                   # empty batch: loop again
+        batch = [first]
+        if not first.solo and self.cfg.batch_cases > 1:
+            window_end = time.monotonic() + self.cfg.window_s
+            while len(batch) < self.cfg.batch_cases:
+                now = time.monotonic()
+                with self._cond:
+                    r = self._pop_ready_locked(now, solo_ok=False)
+                    if r is not None:
+                        self._ngathered += 1
+                    elif now >= window_end:
+                        break
+                    else:
+                        self._cond.wait(min(0.01, window_end - now))
+                        continue
+                if r.deadline_ts < time.monotonic():
+                    self._ungather(1)
+                    self._expire(r)
+                    continue
+                batch.append(r)
+        return batch
+
+    def _ungather(self, n: int):
+        with self._lock:
+            self._ngathered = max(0, self._ngathered - n)
+
+    def _ensure_runner(self, mode: str):
+        runner = self._runners.get(mode)
+        if runner is not None:
+            return runner
+        fowt = self._fowts.get(mode)
+        if self._runner_factory is not None:
+            runner = self._runner_factory(mode, fowt,
+                                          self.cfg.batch_cases,
+                                          **self.cfg.solver_kw())
+        else:
+            if fowt is None:
+                raise errors.ModelConfigError(
+                    "no model available for service mode", mode=mode)
+            from raft_tpu.parallel.sweep import make_batch_runner
+            runner = make_batch_runner(fowt, self.cfg.batch_cases,
+                                       **self.cfg.solver_kw())
+        self._runners[mode] = runner
+        return runner
+
+    def _solve_mode_locked(self) -> str:
+        mode = self.ladder[self._mode_idx]
+        if mode != "reject":
+            return mode
+        # reject mode still drains the backlog at the deepest solve rung
+        return self.ladder[max(0, self._mode_idx - 1)]
+
+    def _run_batch(self, batch: list[_Request], gen: int):
+        if not batch:
+            return
+        obs = self._obs()
+        from raft_tpu.testing import faults
+
+        cfg = self.cfg
+        t0 = time.monotonic()
+        with self._lock:
+            solve_mode = self._solve_mode_locked()
+            batch_id = self._batch_seq
+            self._batch_seq += 1
+            binfo = {"reqs": batch, "abandoned": False, "done": False}
+            self._inflight[batch_id] = binfo
+            # the gathered requests are now visible as in-flight state
+            self._ngathered = max(0, self._ngathered - len(batch))
+        wid = None
+        try:
+            runner = self._ensure_runner(solve_mode)
+            wid = self._watchdog.arm(
+                t0 + cfg.batch_deadline_s,
+                lambda: self._abandon_batch(batch_id))
+            # -- injection seam (pre-solve): a hang stalls THIS worker
+            # with the watchdog armed — exactly what a wedged device
+            # looks like from the host
+            for r in batch:
+                f = faults.fire_info("serve", req=r.seq)
+                if f is not None:
+                    if f["action"] == "hang":
+                        time.sleep(float(f.get("hang_s", 30.0)))
+                    elif f["action"] == "raise":
+                        raise errors.KernelFailure(
+                            "injected serve failure", injected=True,
+                            req=r.seq)
+            n = len(batch)
+            Hs = np.array([r.Hs for r in batch], float)
+            Tp = np.array([r.Tp for r in batch], float)
+            beta = np.array([r.beta for r in batch], float)
+            ncases = getattr(runner, "ncases", cfg.batch_cases)
+            if n < ncases:               # pad by repeating the last lane
+                pad = ncases - n
+                Hs = np.concatenate([Hs, np.repeat(Hs[-1:], pad)])
+                Tp = np.concatenate([Tp, np.repeat(Tp[-1:], pad)])
+                beta = np.concatenate([beta, np.repeat(beta[-1:], pad)])
+            with obs.span("serve_batch", n=n, mode=solve_mode,
+                          batch_id=batch_id):
+                out = runner(Hs, Tp, beta)
+            owned = self._watchdog.disarm(wid)
+            wid = None
+            if not owned:
+                # watchdog won the race: it (has or will) pop the batch
+                # and re-admit/quarantine the members — this (stale)
+                # worker discards its late results and retires
+                return
+            with self._lock:
+                binfo["done"] = True
+                self._inflight.pop(batch_id, None)
+            # ONE sanctioned counted pull per batch (PR 4 discipline)
+            std, iters, conv = obs.transfers.device_get(
+                (out["std"], out["iters"], out["converged"]),
+                what="serve_batch", phase="serve")
+            std = np.array(std, float)[:n]
+            iters = np.asarray(iters)[:n]
+            conv = np.asarray(conv)[:n]
+            # -- injection seam (post-solve, per lane): the dynamics /
+            # sweep-lane fault sites poison or fail single requests
+            for i, r in enumerate(batch):
+                action = (faults.fire("dynamics", case=r.seq)
+                          or faults.fire("sweep", lane=r.seq))
+                if action == "nan":
+                    std[i] = np.nan
+                elif action == "raise":
+                    self._retry_or_fail(r, errors.DynamicsSingular(
+                        "injected lane failure", injected=True,
+                        case=r.seq))
+                    std[i] = np.nan
+                    continue
+                if np.all(np.isfinite(std[i])):
+                    self._complete(r, std[i], int(iters[i]),
+                                   bool(conv[i]), solve_mode)
+                else:
+                    self._retry_or_fail(r, errors.NonFiniteResult(
+                        "non-finite response lane", case=r.seq))
+            batch_s = time.monotonic() - t0
+            with self._lock:
+                self._counts["batches"] += 1
+                self._ema_batch_s = (batch_s if self._ema_batch_s is None
+                                     else 0.8 * self._ema_batch_s
+                                     + 0.2 * batch_s)
+            obs.counter("raft_tpu_serve_batches_total",
+                        "batches solved by the sweep service, by mode"
+                        ).inc(1.0, mode=solve_mode)
+            self._fold_health(batch_s > cfg.latency_slo_s)
+        except errors.RaftError as e:
+            owned = True
+            if wid is not None:
+                owned = self._watchdog.disarm(wid)
+            if not owned:
+                # the watchdog already abandoned this batch and owns its
+                # requests (re-admitted solo / quarantined) — a second
+                # requeue here would double-solve them
+                return
+            with self._lock:
+                binfo["done"] = True
+                self._inflight.pop(batch_id, None)
+            for r in batch:
+                if not r.ticket.done():
+                    self._retry_or_fail(r, e)
+            self._fold_health(True)
+        except Exception:
+            # non-taxonomy escape (a bug): release the in-flight slot
+            # and the armed deadline BEFORE the keep-alive seam in
+            # _worker_loop turns it into typed results — otherwise the
+            # dead batch inflates _estimate_wait_locked forever and a
+            # later watchdog expiry re-queues already-finished tickets
+            owned = True
+            if wid is not None:
+                owned = self._watchdog.disarm(wid)
+            with self._lock:
+                binfo["done"] = True
+                if owned:
+                    self._inflight.pop(batch_id, None)
+            if not owned:
+                _LOG.exception("serve: stale worker error after "
+                               "watchdog abandon (discarded)")
+                return
+            raise
+
+    # ------------------------------------------------------------------
+    # watchdog abandon path
+    # ------------------------------------------------------------------
+
+    def _abandon_batch(self, batch_id: int):
+        obs = self._obs()
+        with self._lock:
+            binfo = self._inflight.pop(batch_id, None)
+            if binfo is None or binfo["done"]:
+                return
+            binfo["abandoned"] = True
+            reqs = list(binfo["reqs"])
+            self._counts["abandoned_batches"] += 1
+            self._counts["deadline_misses"] += len(reqs)
+        obs.counter("raft_tpu_serve_deadline_misses_total",
+                    "requests whose batch overran the watchdog deadline"
+                    ).inc(float(len(reqs)))
+        self._emit("watchdog_abandon", batch_id=batch_id,
+                   reqs=[r.seq for r in reqs])
+        _LOG.warning("serve: watchdog abandoned batch %d (%d requests); "
+                     "spawning replacement worker", batch_id, len(reqs))
+        # the stuck worker still owns a (possibly wedged) solve — a
+        # fresh worker takes over the queue, the old one retires when
+        # (if) its call returns and it sees the generation moved on
+        self._spawn_worker()
+        for r in reqs:
+            r.strikes += 1
+            if r.strikes >= self.cfg.hang_quarantine_after:
+                self._fail(r, errors.DeadlineExceeded(
+                    "batch abandoned by watchdog", req=r.seq,
+                    strikes=r.strikes), quarantined=True)
+            else:
+                r.solo = True            # isolate: offenders self-select
+                self._requeue(r, front=True)
+        self._fold_health(True)
+
+    # ------------------------------------------------------------------
+    # per-request outcomes
+    # ------------------------------------------------------------------
+
+    def _requeue(self, r: _Request, front: bool = False):
+        with self._cond:
+            if front:
+                self._queue.appendleft(r)
+            else:
+                self._queue.append(r)
+            self._cond.notify_all()
+
+    def _retry_or_fail(self, r: _Request, e: BaseException):
+        obs = self._obs()
+        key = self.retry.classify(e)
+        n = r.attempts.get(key, 0)
+        now = time.monotonic()
+        if self.retry.should_retry(e, n) and now < r.deadline_ts:
+            # keyed on the admission seq, not r.id (which embeds a
+            # uuid): two runs of the same soak schedule the same delays
+            backoff = self.retry.backoff_s(f"req{r.seq}",
+                                           r.total_attempts)
+            r.attempts[key] = n + 1
+            r.total_attempts += 1
+            r.not_before = now + backoff
+            with self._lock:
+                self._counts["retries"] += 1
+            obs.counter("raft_tpu_serve_retries_total",
+                        "request retries by error class").inc(
+                            1.0, error=key)
+            self._emit("retry", req=r.seq, error=key, attempt=n + 1,
+                       backoff_s=backoff)
+            self._requeue(r)
+        else:
+            self._fail(r, e)
+
+    def _expire(self, r: _Request):
+        with self._lock:
+            self._counts["deadline_misses"] += 1
+            self._counts["expired"] += 1
+        self._obs().counter(
+            "raft_tpu_serve_deadline_misses_total",
+            "requests whose batch overran the watchdog deadline").inc(1.0)
+        self._fail(r, errors.DeadlineExceeded(
+            "deadline expired in queue", req=r.seq))
+
+    def _result_base(self, r: _Request, mode: str) -> dict:
+        return {"request_id": r.id, "seq": r.seq, "mode": mode,
+                "attempts": r.total_attempts,
+                "latency_s": time.monotonic() - r.submitted_ts}
+
+    def _complete(self, r: _Request, std_row, iters: int,
+                  converged: bool, mode: str):
+        obs = self._obs()
+        from raft_tpu.obs.ledger import digest_metrics
+        digest = digest_metrics({"std": std_row, "iters": int(iters),
+                                 "converged": bool(converged)})
+        res = SweepResult(ok=True, digest=digest,
+                          std=[float(v) for v in std_row],
+                          iters=int(iters), converged=bool(converged),
+                          **self._result_base(r, mode))
+        with self._lock:
+            self._counts["completed"] += 1
+            if r.total_attempts:
+                self._counts["retried_recovered"] += 1
+            self._latencies.append(res.latency_s)
+            self._delivered[digest] = res
+            while len(self._delivered) > self.cfg.result_cache:
+                self._delivered.popitem(last=False)
+        obs.counter("raft_tpu_serve_requests_total",
+                    "request admissions/outcomes of the sweep service"
+                    ).inc(1.0, outcome="ok")
+        obs.histogram("raft_tpu_serve_request_latency_s",
+                      "submit-to-result latency of completed requests",
+                      buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                               30.0, 60.0, 120.0)).observe(res.latency_s)
+        self._emit("request_done", req=r.seq, digest=digest,
+                   latency_s=res.latency_s, attempts=r.total_attempts,
+                   mode=mode)
+        r.ticket._finish(res)
+
+    def _fail(self, r: _Request, e: BaseException,
+              quarantined: bool = False):
+        obs = self._obs()
+        ctx = (e.context() if isinstance(e, errors.RaftError)
+               else {"error": type(e).__name__, "message": str(e)})
+        res = SweepResult(ok=False, quarantined=quarantined, error=ctx,
+                          **self._result_base(
+                              r, self.ladder[self._mode_idx]))
+        with self._lock:
+            self._counts["failed"] += 1
+            if quarantined:
+                self._counts["quarantined"] += 1
+        outcome = "quarantined" if quarantined else "failed"
+        obs.counter("raft_tpu_serve_requests_total",
+                    "request admissions/outcomes of the sweep service"
+                    ).inc(1.0, outcome=outcome)
+        self._emit("quarantine" if quarantined else "request_failed",
+                   **{**ctx, "phase": "serve", "req": r.seq})
+        r.ticket._finish(res)
+
+    # ------------------------------------------------------------------
+    # degradation ladder
+    # ------------------------------------------------------------------
+
+    def _fold_health(self, violation: bool):
+        with self._lock:
+            if violation:
+                self._bad_streak += 1
+                self._good_streak = 0
+                if self._bad_streak >= self.cfg.degrade_after \
+                        and self._mode_idx < len(self.ladder) - 1:
+                    self._step_mode_locked(+1, reason="slo_violation")
+            else:
+                self._good_streak += 1
+                self._bad_streak = 0
+                if self._good_streak >= self.cfg.upgrade_after \
+                        and self._mode_idx > 0:
+                    self._step_mode_locked(-1, reason="healthy")
+
+    def _step_mode_locked(self, delta: int, reason: str):
+        obs = self._obs()
+        src = self.ladder[self._mode_idx]
+        self._mode_idx = min(len(self.ladder) - 1,
+                             max(0, self._mode_idx + delta))
+        dst = self.ladder[self._mode_idx]
+        if dst == src:
+            return
+        self._mode_entered = time.monotonic()
+        self._bad_streak = 0
+        self._good_streak = 0
+        rec = {"t": time.time(), "from": src, "to": dst,
+               "reason": reason}
+        self._transitions.append(rec)
+        obs.counter("raft_tpu_serve_mode_transitions_total",
+                    "service degradation-ladder transitions").inc(
+                        1.0, **{"from": src, "to": dst})
+        obs.gauge("raft_tpu_serve_mode",
+                  "active service mode as its ladder index "
+                  "(0 = full; see the mode label)").set(
+                      float(self._mode_idx), mode=dst)
+        self._emit("service_mode", **rec)
+        log = _LOG.warning if delta > 0 else _LOG.info
+        log("serve: mode %s -> %s (%s)", src, dst, reason)
+
+    # ------------------------------------------------------------------
+    # introspection / delivery
+    # ------------------------------------------------------------------
+
+    @property
+    def mode(self) -> str:
+        with self._lock:
+            return self.ladder[self._mode_idx]
+
+    def fetch(self, digest: str) -> SweepResult | None:
+        """Completed result by its ledger digest (async delivery)."""
+        with self._lock:
+            return self._delivered.get(digest)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {**self._counts, "queue_depth": len(self._queue),
+                    "mode": self.ladder[self._mode_idx],
+                    "state": self._state}
+
+    @staticmethod
+    def _percentile(values, q: float) -> float | None:
+        """Nearest-rank percentile — the obs.trendstore rule, so the
+        serve SLO gates and the service summary can never drift apart
+        (None on no data)."""
+        from raft_tpu.obs import trendstore
+        return trendstore._percentile(list(values), q) if values else None
+
+    def summary(self) -> dict:
+        """Flat serve facts (manifest ``extra["serve"]`` -> trend row)."""
+        with self._lock:
+            counts = dict(self._counts)
+            lat = list(self._latencies)
+            transitions = list(self._transitions)
+            mode = self.ladder[self._mode_idx]
+            runners = {m: getattr(r, "cache_state", "n/a")
+                       for m, r in self._runners.items()}
+            ema = self._ema_batch_s
+        return {
+            **counts,
+            "requests": counts["admitted"] + counts["rejected"],
+            "mode": mode,
+            "mode_transitions": transitions,
+            "n_mode_transitions": len(transitions),
+            "p50_latency_s": self._percentile(lat, 50),
+            "p99_latency_s": self._percentile(lat, 99),
+            "ema_batch_s": ema,
+            "exec_cache": runners,
+        }

@@ -1,0 +1,204 @@
+"""Deterministic chaos soak for the sweep service.
+
+The soak proves the service's headline property end-to-end: under a
+deterministic fault schedule (``RAFT_TPU_FAULTS``-style spec: NaN
+poisoning, a one-shot kernel raise, executable-cache corruption, an
+injected hang that trips the watchdog) plus an admission burst, the
+process survives, every retryable fault is retried within budget, the
+queue stays bounded, and **every completed request's ledger digest is
+identical to the clean run's** — quarantined requests surface as typed
+failures, never silent drops.
+
+The schedule is reproducible by construction: a seeded case table, a
+spec-driven fault harness (no randomness), deterministic retry jitter
+(seeded on request ids), and an admission burst submitted *before* the
+worker starts so the reject count is exact.  Degradation-ladder
+transitions are deliberately kept out of the parity phase
+(``degrade_after`` is set above the injected violation streak): a
+degraded rung changes the physics on purpose, which would break the
+digest gate — the ladder is exercised by the unit tier instead
+(tests/test_serve.py) and any transition that does happen is recorded
+in the report.
+
+Used by ``tools/raftserve.py soak`` (the CI chaos step) and
+``tests/test_serve.py``.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from raft_tpu import errors
+from raft_tpu.serve.config import ServeConfig
+from raft_tpu.serve.service import SweepService
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("serve.soak")
+
+#: the canonical chaos spec the soak (and the CI step) runs under:
+#: a persistently-poisoned lane (request seq 2), one transient kernel
+#: failure cleared by retry, cache corruption (delete-and-miss), and a
+#: hang on request seq 5 long enough to trip the soak's watchdog
+#: deadline twice (batch, then solo) -> quarantine
+DEFAULT_FAULTS = ("nan@dynamics:case=2,raise@kernel:once,"
+                  "corrupt@exec_cache,hang@serve:req=5:s=2.2")
+
+
+def default_config(**overrides) -> ServeConfig:
+    """The soak's service configuration: small batches, a tight-but-
+    safe watchdog deadline (the injected hang is 2.2 s), and a
+    degradation trigger above the injected violation streak so the
+    parity phase stays on the ``full`` rung."""
+    kw = dict(queue_max=8, batch_cases=4, window_s=0.05,
+              deadline_s=300.0, batch_deadline_s=1.0,
+              watchdog_tick_s=0.05, hang_quarantine_after=2,
+              latency_slo_s=30.0, degrade_after=3, upgrade_after=4,
+              nIter=6, tol=0.01, fp_chunk=2)
+    kw.update(overrides)
+    return ServeConfig(**kw)
+
+
+def case_table(n: int, seed: int = 2026):
+    """Deterministic (Hs, Tp, beta) request table."""
+    rng = np.random.default_rng(seed)
+    Hs = 2.0 + 2.0 * rng.random(n)
+    Tp = 7.0 + 4.0 * rng.random(n)
+    beta = np.deg2rad(rng.integers(0, 360, n).astype(float))
+    return Hs, Tp, beta
+
+
+def _collect(tickets: dict, timeout_s: float) -> dict:
+    out = {}
+    deadline = time.monotonic() + timeout_s
+    for seq, t in tickets.items():
+        out[seq] = t.result(max(0.5, deadline - time.monotonic()))
+    return out
+
+
+def _run_all(service: SweepService, rows, timeout_s: float,
+             pre_start: int = None) -> tuple[dict, int]:
+    """Submit every (seq-aligned) row, optionally the first
+    ``pre_start`` of them before the worker starts (the admission
+    burst); re-submits rejected rows once capacity returns.  Returns
+    ``({seq: SweepResult}, n_rejected)``."""
+    Hs, Tp, beta = rows
+    n = len(Hs)
+    tickets: dict[int, object] = {}
+    rejected = 0
+    pending = list(range(n))
+    burst = pending[:pre_start] if pre_start else []
+    retry_rows = []
+    for i in burst:
+        try:
+            tickets[i] = service.submit(Hs[i], Tp[i], beta[i])
+        except errors.AdmissionRejected as e:
+            rejected += 1
+            retry_rows.append((i, e.retry_after_s))
+    service.start()
+    rest = pending[len(burst):] if pre_start else pending
+    for i in [r for r, _ in retry_rows] + rest:
+        wait_until = time.monotonic() + timeout_s
+        while True:
+            try:
+                tickets[i] = service.submit(Hs[i], Tp[i], beta[i])
+                break
+            except errors.AdmissionRejected as e:
+                if time.monotonic() > wait_until:
+                    raise
+                # honor the load-shed hint (bounded): the well-behaved
+                # caller the Retry-After contract is designed for
+                time.sleep(min(1.0, max(0.05, e.retry_after_s)))
+    return _collect(tickets, timeout_s), rejected
+
+
+def run_soak(fowt, *, coarse_fowt=None, config: ServeConfig = None,
+             n_requests: int = 12, faults_spec: str = DEFAULT_FAULTS,
+             seed: int = 2026, timeout_s: float = 600.0) -> dict:
+    """Run the clean-reference pass then the chaos pass; returns the
+    structured soak report (see keys below).  ``report["ok"]`` is the
+    single pass/fail verdict: zero unhandled exceptions, every
+    completed chaos request digest-identical to the clean pass, and no
+    silent drops (every admitted request reached a terminal result —
+    guaranteed structurally because ``_collect`` waits on every
+    ticket)."""
+    from raft_tpu.parallel import exec_cache
+    from raft_tpu.testing import faults
+
+    cfg = config or default_config()
+    rows = case_table(n_requests, seed=seed)
+    degraded = {"coarse": coarse_fowt} if coarse_fowt is not None else None
+
+    # -- clean reference pass (also warms the executable cache) -------
+    # install("") OVERRIDES with an empty spec list; clear() would
+    # return control to the RAFT_TPU_FAULTS environment variable —
+    # which the CI chaos step sets for the whole invocation — and the
+    # "clean" pass would run under full chaos
+    faults.install("")
+    clean_cfg = ServeConfig(**{**cfg.__dict__, "queue_max": n_requests})
+    svc = SweepService(fowt, clean_cfg, degraded_fowts=degraded)
+    clean_results, _ = _run_all(svc, rows, timeout_s)
+    clean_summary = svc.stop()
+    clean_digests = {seq: r.digest for seq, r in clean_results.items()
+                     if r.ok}
+    if len(clean_digests) != n_requests:
+        raise errors.KernelFailure(
+            "soak clean pass failed", completed=len(clean_digests),
+            expected=n_requests)
+
+    # -- chaos pass ---------------------------------------------------
+    faults.install(faults_spec)
+    if exec_cache.enabled():
+        # drop the in-process executable memo so the chaos pass's cache
+        # load really reads disk — the corrupt@exec_cache seam fires
+        # and delete-and-miss recovery (not the memo) absorbs it
+        exec_cache.reset_memo()
+    t0 = time.monotonic()
+    try:
+        svc = SweepService(fowt, cfg, degraded_fowts=degraded)
+        chaos_results, rejected = _run_all(
+            svc, rows, timeout_s, pre_start=n_requests)
+        chaos_summary = svc.stop()
+    finally:
+        faults.clear()
+    wall_s = time.monotonic() - t0
+
+    # -- verdict ------------------------------------------------------
+    mismatches = []
+    completed = {}
+    failures = {}
+    for seq, r in sorted(chaos_results.items()):
+        if r.ok:
+            completed[seq] = r.digest
+            if clean_digests.get(seq) != r.digest:
+                mismatches.append(
+                    {"seq": seq, "clean": clean_digests.get(seq),
+                     "chaos": r.digest})
+        else:
+            failures[seq] = {"error": (r.error or {}).get("error"),
+                             "quarantined": r.quarantined,
+                             "attempts": r.attempts}
+    report = {
+        "n_requests": n_requests,
+        "faults": faults_spec,
+        "wall_s": wall_s,
+        "burst_rejected": rejected,
+        "clean": clean_summary,
+        "chaos": chaos_summary,
+        "completed": len(completed),
+        "failures": failures,
+        "digest_mismatches": mismatches,
+        "ok": (chaos_summary["unhandled"] == 0
+               and not mismatches
+               and len(completed) + len(failures)
+               == chaos_summary["admitted"]),
+    }
+    lvl = _LOG.info if report["ok"] else _LOG.error
+    lvl("chaos soak: %s — %d/%d completed digest-exact, %d typed "
+        "failure(s), %d burst reject(s), %d retries (%d recovered), "
+        "%d deadline miss(es), %.1fs",
+        "OK" if report["ok"] else "FAILED", len(completed), n_requests,
+        len(failures), rejected, chaos_summary["retries"],
+        chaos_summary["retried_recovered"],
+        chaos_summary["deadline_misses"], wall_s)
+    return report

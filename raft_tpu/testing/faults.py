@@ -15,14 +15,16 @@ dynamics  ``Model._fowt_linearize`` after the drag fixed point
 kernel    ``ops.linalg.impedance_solve`` dispatch (trace time)
 sweep     ``parallel.sweep.sweep_cases`` after the batched solve
 exec_cache  ``parallel.exec_cache.load`` on the deserialized bytes
+serve     ``serve.service`` request worker (per-request, pre/post solve)
 ========  ==========================================================
 
 Spec grammar (comma-separated specs)::
 
     RAFT_TPU_FAULTS="<action>@<site>[:qualifier]*[,...]"
 
-    action     nan | raise | corrupt
-    qualifier  case=N | lane=N | fowt=N | once | times=K
+    action     nan | raise | corrupt | hang
+    qualifier  case=N | lane=N | fowt=N | req=N | once | times=K
+               | s=SECONDS | ms=MILLIS  (hang duration)
 
 Examples: ``nan@dynamics:case=2`` poisons case 2's converged impedance
 with NaN (exercising the non-finite sanitizer and the ladder);
@@ -52,28 +54,41 @@ _FIRED: dict[tuple, int] = {}
 #: ambient matching context (case/fowt/lane) — host-single-threaded
 _CONTEXT: list[dict] = []
 
-_ACTIONS = ("nan", "raise", "corrupt")
-_SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache")
+_ACTIONS = ("nan", "raise", "corrupt", "hang")
+_SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache", "serve")
 
 #: exception class raised per site for ``raise@<site>`` specs.  Site/
 #: action support: statics, dynamics, kernel take ``nan`` and ``raise``;
 #: sweep takes ``nan`` (lane poisoning) and ``raise`` (fails the batch
 #: as a KernelFailure, handled at the seam itself); exec_cache takes
 #: ``corrupt`` only — its load path must never raise, so a
-#: ``raise@exec_cache`` spec is rejected at parse time.
+#: ``raise@exec_cache`` spec is rejected at parse time; serve (the
+#: request-worker seam in raft_tpu/serve/service.py) takes ``raise``
+#: and ``hang`` (``hang@serve:req=N:ms=400`` stalls the worker so the
+#: deadline watchdog fires — the seam reads the duration from the
+#: matched fault's ``hang_s``).
 _RAISES = {
     "statics": errors.StaticsDivergence,
     "dynamics": errors.DynamicsSingular,
     "kernel": errors.KernelFailure,
     "sweep": errors.KernelFailure,
+    "serve": errors.KernelFailure,
 }
 
 #: (action, site) combinations with no seam behavior — dropped at parse
 #: time so a spec can never silently no-op while consuming fire budget
 _UNSUPPORTED = {("raise", "exec_cache"), ("corrupt", "statics"),
                 ("corrupt", "dynamics"), ("corrupt", "kernel"),
-                ("corrupt", "sweep"), ("nan", "exec_cache"),
-                ("nan", "kernel")}
+                ("corrupt", "sweep"), ("corrupt", "serve"),
+                ("nan", "exec_cache"), ("nan", "kernel"),
+                ("nan", "serve"),
+                ("hang", "statics"), ("hang", "dynamics"),
+                ("hang", "kernel"), ("hang", "sweep"),
+                ("hang", "exec_cache")}
+
+#: default stall of a ``hang@serve`` spec without an ``s=``/``ms=``
+#: qualifier — long enough to trip any realistic watchdog deadline
+_DEFAULT_HANG_S = 30.0
 
 
 def _parse_one(spec: str) -> dict | None:
@@ -86,6 +101,8 @@ def _parse_one(spec: str) -> dict | None:
         return None
     fault = {"action": action, "site": site, "match": {}, "times": None,
              "spec": spec.strip()}
+    if action == "hang":
+        fault["hang_s"] = _DEFAULT_HANG_S
     for q in filter(None, (s.strip() for s in quals.split(":"))):
         if q == "once":
             fault["times"] = 1
@@ -94,6 +111,13 @@ def _parse_one(spec: str) -> dict | None:
                 fault["times"] = int(q[6:])
             except ValueError:
                 return None          # malformed spec: drop, never crash
+        elif q.startswith("s=") or q.startswith("ms="):
+            # hang-duration qualifiers are fault facts, not match keys
+            try:
+                val = float(q.split("=", 1)[1])
+            except ValueError:
+                return None
+            fault["hang_s"] = val / 1000.0 if q.startswith("ms=") else val
         elif "=" in q:
             k, v = q.split("=", 1)
             try:
@@ -165,10 +189,11 @@ def _ambient() -> dict:
     return out
 
 
-def fire(site: str, **ctx) -> str | None:
-    """Return the action of the first active fault matching ``site`` and
-    the (explicit + ambient) context, honoring ``once``/``times=``;
-    None when nothing matches.  The caller applies the action."""
+def fire_info(site: str, **ctx) -> dict | None:
+    """Return the first active fault dict matching ``site`` and the
+    (explicit + ambient) context, honoring ``once``/``times=``; None
+    when nothing matches.  The caller applies ``fault["action"]`` (and
+    reads per-action facts such as ``hang_s``)."""
     faults = _active()
     if not faults:
         return None
@@ -185,8 +210,14 @@ def fire(site: str, **ctx) -> str | None:
             if f["times"] is not None and n >= f["times"]:
                 continue
             _FIRED[key] = n + 1
-        return f["action"]
+        return dict(f)
     return None
+
+
+def fire(site: str, **ctx) -> str | None:
+    """Action-only form of :func:`fire_info` (the original seam API)."""
+    f = fire_info(site, **ctx)
+    return None if f is None else f["action"]
 
 
 def maybe_raise(site: str, **ctx):

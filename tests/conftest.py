@@ -57,6 +57,11 @@ def _obs_isolation(monkeypatch, tmp_path):
     yield
     faults.clear()
     obs.reset_all()
+    # the in-process executable memo is keyed by content digests, not
+    # by cache directory — two tests using different tmp cache dirs
+    # must not see each other's deserialized programs
+    from raft_tpu.parallel import exec_cache
+    exec_cache.reset_memo()
 
 
 @pytest.fixture(scope="session")

@@ -96,6 +96,48 @@ def test_store_load_roundtrip(tmp_path, monkeypatch):
     assert st["misses"] == 1 and st["stores"] == 1 and st["hits"] == 1
 
 
+def test_cross_process_warm_start_survives_and_matches(tmp_path,
+                                                       monkeypatch):
+    """Regression (found by the PR 9 serving chaos work): a process
+    that only ever CALLS a deserialized export never lowers a linalg
+    op in-process, so jaxlib's lazily-registered CPU LAPACK custom
+    calls are missing and ``exe.call`` used to SIGSEGV — the
+    warm-start process died instead of warm-starting.  `load` now
+    primes the registration; the child process below must exit 0 and
+    reproduce the parent's numbers bitwise."""
+    import subprocess
+    import sys
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    # a program whose guts are a complex linalg solve, like the
+    # impedance path the sweep/serve executables are built from
+    fn = jax.jit(lambda A, b: {"x": jnp.linalg.solve(A, b)})
+    A = jnp.eye(4, dtype=complex) * 2.0
+    b = jnp.arange(4.0).astype(complex)
+    key = exec_cache.make_key(fn="xproc")
+    assert exec_cache.store(fn, (A, b), key) is not None
+    want = np.asarray(fn(A, b)["x"])
+    child = subprocess.run(
+        [sys.executable, "-c", (
+            "import os, numpy as np, jax.numpy as jnp\n"
+            "from raft_tpu.parallel import exec_cache\n"
+            f"exe = exec_cache.load({key!r})\n"
+            "assert exe is not None, 'expected a warm hit'\n"
+            "out = exe.call(jnp.eye(4, dtype=complex) * 2.0,\n"
+            "               jnp.arange(4.0).astype(complex))\n"
+            "print(repr(np.asarray(out['x']).tolist()))\n")],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PALLAS_AXON_POOL_IPS": "",
+             "RAFT_TPU_EXEC_CACHE_DIR": str(tmp_path),
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))})
+    assert child.returncode == 0, (child.stdout, child.stderr)
+    got = np.asarray(eval(child.stdout.strip().splitlines()[-1]))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_corrupt_cache_entry_is_an_error_not_a_crash(tmp_path, monkeypatch):
     monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
     exec_cache.reset_stats()

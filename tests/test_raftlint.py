@@ -394,6 +394,65 @@ def test_rtl004_raise_scope_excludes_models(tmp_path):
     assert rep.findings == []
 
 
+#: the repo's configured RTL004 options (pyproject.toml) — the serve
+#: layer is a solve-path module whose two keep-alive seams (the request
+#: worker and the watchdog callback dispatch) are config-sanctioned for
+#: broad except
+_RTL004_SERVE_OPTS = {"rtl004": {
+    "solve-modules": ["raft_tpu/model.py", "raft_tpu/ops",
+                      "raft_tpu/parallel", "raft_tpu/io",
+                      "raft_tpu/recovery.py", "raft_tpu/serve"],
+    "except-sanctioned": ["raft_tpu/recovery.py",
+                          "raft_tpu/testing/faults.py", "raft_tpu/obs",
+                          "raft_tpu/serve/service.py",
+                          "raft_tpu/serve/watchdog.py"],
+}}
+
+_SERVE_SEAM_SRC = """
+    def worker_loop(batches):
+        for b in batches:
+            try:
+                b.run()
+            except Exception:      # keep-alive seam
+                b.fail_typed()
+
+    def submit(bad):
+        if bad:
+            raise ValueError("untyped admission failure")
+"""
+
+
+def test_rtl004_serve_seams_sanctioned_pair(tmp_path):
+    """The serve fixture fires OUTSIDE the two sanctioned seam files
+    (both the broad except and the untyped raise, since serve/ is a
+    solve-path module) and stays silent INSIDE them for the broad
+    except."""
+    rep = lint_src(tmp_path, _SERVE_SEAM_SRC, "RTL004",
+                   relname="raft_tpu/serve/handlers.py",
+                   options=_RTL004_SERVE_OPTS)
+    msgs = [f.message for f in rep.findings]
+    assert len(msgs) == 2
+    assert any("except" in m for m in msgs)
+    assert any("raise ValueError" in m for m in msgs)
+    # identical file at the sanctioned worker seam: the broad except is
+    # silent; the raise discipline still applies (sanctioning is for
+    # excepts only — typed raises are required everywhere in serve/)
+    rep2 = lint_src(tmp_path, _SERVE_SEAM_SRC, "RTL004",
+                    relname="raft_tpu/serve/service.py",
+                    options=_RTL004_SERVE_OPTS)
+    assert len(rep2.findings) == 1
+    assert "raise ValueError" in rep2.findings[0].message
+    rep3 = lint_src(tmp_path, """
+        def tick(cb):
+            try:
+                cb()
+            except Exception:
+                pass
+    """, "RTL004", relname="raft_tpu/serve/watchdog.py",
+                    options=_RTL004_SERVE_OPTS)
+    assert rep3.findings == []
+
+
 # ---------------------------------------------------------------------------
 # RTL005 — logging discipline
 # ---------------------------------------------------------------------------

@@ -283,6 +283,34 @@ def test_journal_roundtrip(tmp_path):
     assert j.completed() == []
 
 
+def test_journal_corrupt_entries_counted_not_raised(tmp_path):
+    """A torn pickle (crash mid-store) and a truncated one are misses:
+    logged, deleted, and counted in raft_tpu_journal_corrupt_total —
+    never an exception into the resume path."""
+    import pickle
+
+    j = recovery.CaseJournal("corrkey", base_dir=str(tmp_path))
+    j.store_case(0, {"case_metrics": {}, "mean_offset": np.zeros(6)})
+    j.store_case(1, {"case_metrics": {}, "mean_offset": np.zeros(6)})
+    # torn write: the first half of a valid pickle (EOFError on load)
+    whole = open(j._path(0), "rb").read()
+    with open(j._path(0), "wb") as f:
+        f.write(whole[: len(whole) // 2])
+    # readable pickle of the wrong shape (not the journaled dict)
+    with open(j._path(1), "wb") as f:
+        pickle.dump(["not", "a", "journal", "record"], f)
+    assert j.load_case(0) is None
+    assert j.load_case(1) is None
+    assert not os.path.exists(j._path(0))    # torn entry deleted
+    snap = obs.snapshot()
+    total = sum(s["value"] for s in
+                snap["raft_tpu_journal_corrupt_total"]["series"])
+    assert total == 2.0
+    # a clean store afterwards works (the miss is recoverable)
+    j.store_case(0, {"case_metrics": {}, "mean_offset": np.ones(6)})
+    assert j.load_case(0) is not None
+
+
 # ---------------------------------------------------------------------------
 # integration: quarantine / ladder / resume on the coarse cylinder
 # ---------------------------------------------------------------------------

@@ -137,6 +137,45 @@ def test_obsctl_trend_over_goldens(obsctl, capsys):
     assert "ledger/analyzeCases" in out
 
 
+def test_residual_metrics_get_tolerance_floor():
+    """Residual-class metrics (solver convergence diagnostics at
+    machine-epsilon magnitudes) compare with a relative tolerance FLOOR
+    instead of the exact ledger tolerance: the observed cross-host
+    statics_residual jitter (4.5638e-7 vs 4.5607e-7, a ~7e-4 relative
+    "drift" of pure noise) must NOT flag, while the same relative move
+    on a physics metric must."""
+    led = L.new_ledger("t", run_id="a")
+    L.add_entry(led, "case0/system", {"statics_residual": 4.5638e-7,
+                                      "mean_offset": 10.0})
+    L.finalize(led)
+    moved = L.new_ledger("t", run_id="b")
+    L.add_entry(moved, "case0/system", {"statics_residual": 4.5607e-7,
+                                        "mean_offset": 10.0})
+    L.finalize(moved)
+    assert L.diff(led, moved, tol_rel=1e-6)["ok"]
+
+    # the identical relative move on a non-residual metric still flags
+    drifted = L.new_ledger("t", run_id="c")
+    L.add_entry(drifted, "case0/system",
+                {"statics_residual": 4.5638e-7,
+                 "mean_offset": 10.0 * (1 + 6.8e-4)})
+    L.finalize(drifted)
+    rep = L.diff(led, drifted, tol_rel=1e-6)
+    assert not rep["ok"]
+    assert rep["regressions"][0]["metric"] == "mean_offset"
+
+    # an explicit per-metric override beats the floor (pin-it-exactly)
+    rep = L.diff(led, moved, tol_rel=1e-6,
+                 per_metric={"statics_residual": 1e-9})
+    assert not rep["ok"]
+    # a residual drift ABOVE the floor still flags
+    blown = L.new_ledger("t", run_id="d")
+    L.add_entry(blown, "case0/system", {"statics_residual": 4.6e-5,
+                                        "mean_offset": 10.0})
+    L.finalize(blown)
+    assert not L.diff(led, blown, tol_rel=1e-6)["ok"]
+
+
 def test_obsctl_selfcheck(obsctl, capsys):
     """CI guard: the synthetic round-trip through diff/check/trend."""
     rc = obsctl.main(["selfcheck"])

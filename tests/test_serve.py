@@ -1,0 +1,509 @@
+"""The resilient always-on sweep service (raft_tpu/serve).
+
+Unit tier (stub batch engines, no solves): admission control +
+Retry-After hints, the retry matrix and deterministic backoff, the
+watchdog abandon -> solo re-admit -> quarantine path, the service
+degradation ladder, and the serve run manifest / trend-store row.
+
+Integration tier (one coarse Vertical_cylinder model): the warm batch
+runner's parity with the plain batched solver and its executable-cache
+round trip, and the ISSUE acceptance scenario — the deterministic chaos
+soak (``serve.soak``): NaN poisoning, a one-shot kernel raise, cache
+corruption, an injected hang through the watchdog, and an admission
+burst, with zero unhandled errors and every completed request
+digest-identical to the clean pass.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import errors, obs
+from raft_tpu.serve import (DEFAULT_BUDGETS, TERMINAL, RetryPolicy,
+                            ServeConfig, SweepService, Watchdog)
+from raft_tpu.testing import faults
+
+
+def stub_factory(mode, fowt, ncases, **kw):
+    """Deterministic instant batch engine: std row = Hs replicated."""
+    def run(Hs, Tp, beta):
+        Hs = np.asarray(Hs)
+        return {"std": np.stack([np.full(6, float(h)) for h in Hs]),
+                "iters": np.full(len(Hs), 3),
+                "converged": np.ones(len(Hs), bool)}
+    run.ncases = ncases
+    run.cache_state = "stub"
+    return run
+
+
+def _cfg(**kw):
+    base = dict(queue_max=8, batch_cases=2, window_s=0.02,
+                batch_deadline_s=5.0, retry_base_s=0.01,
+                degrade_after=99)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# unit: config, retry policy, fault grammar, watchdog
+# ---------------------------------------------------------------------------
+
+def test_config_validation_is_typed():
+    with pytest.raises(errors.ModelConfigError) as exc:
+        ServeConfig(queue_max=0, window_s=-1.0)
+    assert "queue_max" in str(exc.value) and "window_s" in str(exc.value)
+
+
+def test_retry_policy_matrix():
+    p = RetryPolicy(seed=7)
+    assert p.classify(errors.KernelFailure("x")) == "KernelFailure"
+    # MRO walk: a taxonomy subclass inherits its parent's policy
+    class SubKernel(errors.KernelFailure):
+        pass
+    assert p.classify(SubKernel("x")) == "KernelFailure"
+    assert p.budget(errors.KernelFailure("x")) == \
+        DEFAULT_BUDGETS["KernelFailure"]
+    for name in TERMINAL:
+        assert p.budget(getattr(errors, name)("x")) == 0
+    # non-taxonomy errors are bugs, not transients
+    assert p.budget(RuntimeError("x")) == 0
+    assert p.should_retry(errors.NonFiniteResult("x"), 1)
+    assert not p.should_retry(errors.NonFiniteResult("x"), 2)
+
+
+def test_retry_backoff_deterministic_and_bounded():
+    p = RetryPolicy(base_s=0.05, cap_s=2.0, jitter=0.5, seed=3)
+    seq = [p.backoff_s("reqA", i) for i in range(8)]
+    assert seq == [p.backoff_s("reqA", i) for i in range(8)]  # repeatable
+    for i, d in enumerate(seq):
+        raw = min(2.0, 0.05 * 2 ** i)
+        assert raw * 0.5 <= d <= raw          # jitter in [1-j, 1]
+    assert p.backoff_s("reqA", 0) != p.backoff_s("reqB", 0)  # decorrelated
+    assert RetryPolicy(jitter=0.0).backoff_s("x", 3) == 0.05 * 8
+    m = p.matrix()
+    assert m["ModelConfigError"]["terminal"] is True
+    assert m["KernelFailure"]["budget"] == 3
+
+
+def test_faults_serve_grammar():
+    specs = faults.parse("hang@serve:req=5:ms=400,hang@serve:s=2,"
+                         "raise@serve:once,nan@serve,hang@dynamics")
+    assert [f["action"] for f in specs] == ["hang", "hang", "raise"]
+    assert specs[0]["hang_s"] == pytest.approx(0.4)
+    assert specs[0]["match"] == {"req": 5}
+    assert specs[1]["hang_s"] == pytest.approx(2.0)
+    faults.install("hang@serve:req=1:ms=50")
+    try:
+        assert faults.fire_info("serve", req=0) is None
+        f = faults.fire_info("serve", req=1)
+        assert f["action"] == "hang" and f["hang_s"] == pytest.approx(0.05)
+    finally:
+        faults.clear()
+
+
+def test_watchdog_arm_disarm_race_contract():
+    fired = []
+    wd = Watchdog(tick_s=0.01)
+    wd.start()
+    try:
+        wid = wd.arm(time.monotonic() + 10.0, lambda: fired.append("no"))
+        assert wd.disarm(wid) is True          # not expired: caller owns
+        wid = wd.arm(time.monotonic() + 0.03, lambda: fired.append("yes"))
+        deadline = time.monotonic() + 2.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fired == ["yes"]
+        assert wd.disarm(wid) is False         # expired: caller lost
+        assert wd.armed_count() == 0
+    finally:
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# unit: admission control
+# ---------------------------------------------------------------------------
+
+def test_admission_queue_full_rejects_with_retry_after():
+    svc = SweepService(runner_factory=stub_factory, config=_cfg(
+        queue_max=3))
+    for i in range(3):                        # fill pre-start: worker idle
+        svc.submit(1.0 + i, 8.0, 0.0)
+    with pytest.raises(errors.AdmissionRejected) as exc:
+        svc.submit(9.0, 8.0, 0.0)
+    e = exc.value
+    assert e.ctx["reason"] == "queue_full"
+    assert e.retry_after_s > 0.0
+    assert e.context()["retry_after_s"] == e.retry_after_s
+    snap = obs.snapshot()
+    series = snap["raft_tpu_serve_admission_rejects_total"]["series"]
+    assert any(s["labels"] == {"reason": "queue_full"} for s in series)
+    svc.start()
+    assert svc.stop()["completed"] == 3
+
+
+def test_admission_deadline_pressure_rejects():
+    svc = SweepService(runner_factory=stub_factory, config=_cfg())
+    with pytest.raises(errors.AdmissionRejected) as exc:
+        # the estimated queue wait (>= one batch cadence) cannot meet
+        # a 100 us deadline — shed instead of admitting a doomed request
+        svc.submit(1.0, 8.0, 0.0, deadline_s=1e-4)
+    assert exc.value.ctx["reason"] == "deadline_pressure"
+    svc.start()
+    assert svc.stop()["rejected"] == 1
+
+
+def test_admission_rejected_is_terminal_for_retry():
+    assert RetryPolicy().budget(
+        errors.AdmissionRejected("x", retry_after_s=1.0)) == 0
+
+
+# ---------------------------------------------------------------------------
+# unit: the happy path + async delivery
+# ---------------------------------------------------------------------------
+
+def test_stub_service_completes_and_delivers_by_digest():
+    svc = SweepService(runner_factory=stub_factory, config=_cfg())
+    svc.start()
+    tickets = [svc.submit(1.0 + i, 8.0, 0.0) for i in range(5)]
+    results = [t.result(10.0) for t in tickets]
+    assert all(r.ok for r in results)
+    assert [r.seq for r in results] == list(range(5))
+    # ledger-digest-keyed async delivery
+    for r in results:
+        assert r.digest.startswith("sha256:")
+        assert svc.fetch(r.digest).request_id == r.request_id
+    # the digest is EXACTLY the ledger entry digest of the same metrics
+    from raft_tpu.obs.ledger import digest_metrics
+    r = results[2]
+    assert r.digest == digest_metrics(
+        {"std": np.asarray(r.std), "iters": r.iters,
+         "converged": r.converged})
+    summary = svc.stop()
+    assert summary["completed"] == 5 and summary["failed"] == 0
+    assert summary["p50_latency_s"] is not None
+
+
+# ---------------------------------------------------------------------------
+# unit: watchdog abandon -> solo re-admit -> quarantine
+# ---------------------------------------------------------------------------
+
+def test_watchdog_abandons_hang_quarantines_offender_readmits_rest():
+    faults.install("hang@serve:req=1:ms=600")
+    try:
+        cfg = _cfg(batch_deadline_s=0.25, watchdog_tick_s=0.02,
+                   hang_quarantine_after=2)
+        svc = SweepService(runner_factory=stub_factory, config=cfg)
+        svc.start()
+        t0 = svc.submit(1.0, 8.0, 0.0)
+        t1 = svc.submit(2.0, 8.0, 0.0)        # seq 1 carries the hang
+        r0 = t0.result(20.0)
+        r1 = t1.result(20.0)
+    finally:
+        faults.clear()
+    # the survivor was re-admitted solo and completed normally
+    assert r0.ok and np.allclose(r0.std, 1.0)
+    # the offender hung again solo -> second strike -> typed quarantine
+    assert not r1.ok and r1.quarantined
+    assert r1.error["error"] == "DeadlineExceeded"
+    summary = svc.stop()
+    assert summary["abandoned_batches"] == 2       # batch, then solo
+    assert summary["deadline_misses"] == 3         # 2 members + 1 solo
+    assert summary["quarantined"] == 1
+    assert summary["unhandled"] == 0
+    snap = obs.snapshot()
+    assert snap["raft_tpu_serve_deadline_misses_total"][
+        "series"][0]["value"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# unit: retry/backoff over typed batch failures
+# ---------------------------------------------------------------------------
+
+def test_transient_batch_failure_retried_within_budget():
+    calls = {"n": 0}
+
+    def flaky(mode, fowt, ncases, **kw):
+        inner = stub_factory(mode, fowt, ncases, **kw)
+
+        def run(Hs, Tp, beta):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise errors.KernelFailure("transient", injected=True)
+            return inner(Hs, Tp, beta)
+        run.ncases = ncases
+        return run
+
+    svc = SweepService(runner_factory=flaky, config=_cfg())
+    svc.start()
+    tickets = [svc.submit(1.0 + i, 8.0, 0.0) for i in range(2)]
+    results = [t.result(10.0) for t in tickets]
+    summary = svc.stop()
+    assert all(r.ok and r.attempts == 1 for r in results)
+    assert summary["retries"] == 2
+    assert summary["retried_recovered"] == 2
+
+
+def test_terminal_failure_not_retried():
+    def broken(mode, fowt, ncases, **kw):
+        def run(Hs, Tp, beta):
+            raise errors.ModelConfigError("bad model", mode=mode)
+        run.ncases = ncases
+        return run
+
+    svc = SweepService(runner_factory=broken, config=_cfg())
+    svc.start()
+    r = svc.submit(1.0, 8.0, 0.0).result(10.0)
+    summary = svc.stop()
+    assert not r.ok and r.error["error"] == "ModelConfigError"
+    assert r.attempts == 0 and summary["retries"] == 0
+
+
+def test_persistent_lane_poison_exhausts_budget_as_typed_failure():
+    faults.install("nan@dynamics:case=1")
+    try:
+        svc = SweepService(runner_factory=stub_factory, config=_cfg())
+        svc.start()
+        t0 = svc.submit(1.0, 8.0, 0.0)
+        t1 = svc.submit(2.0, 8.0, 0.0)        # seq 1 poisoned every pass
+        r0 = t0.result(20.0)
+        r1 = t1.result(20.0)
+        summary = svc.stop()
+    finally:
+        faults.clear()
+    assert r0.ok
+    assert not r1.ok and r1.error["error"] == "NonFiniteResult"
+    assert r1.attempts == DEFAULT_BUDGETS["NonFiniteResult"]
+    assert summary["unhandled"] == 0
+
+
+def test_unhandled_bug_becomes_typed_result_service_survives():
+    calls = {"n": 0}
+
+    def buggy(mode, fowt, ncases, **kw):
+        inner = stub_factory(mode, fowt, ncases, **kw)
+
+        def run(Hs, Tp, beta):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ZeroDivisionError("bug, not a transient")
+            return inner(Hs, Tp, beta)
+        run.ncases = ncases
+        return run
+
+    svc = SweepService(runner_factory=buggy, config=_cfg())
+    svc.start()
+    r1 = svc.submit(1.0, 8.0, 0.0).result(10.0)
+    r2 = svc.submit(2.0, 8.0, 0.0).result(10.0)   # service still alive
+    summary = svc.stop()
+    assert not r1.ok and r1.error["error"] == "KernelFailure"
+    assert r2.ok
+    assert summary["unhandled"] == 1
+
+
+# ---------------------------------------------------------------------------
+# unit: degradation ladder
+# ---------------------------------------------------------------------------
+
+def test_ladder_degrades_on_sustained_violation_and_recovers():
+    delays = {"n": 0}
+
+    def paced(mode, fowt, ncases, **kw):
+        inner = stub_factory(mode, fowt, ncases, **kw)
+
+        def run(Hs, Tp, beta):
+            delays["n"] += 1
+            if delays["n"] <= 2:
+                time.sleep(0.08)              # the two violating batches
+            return inner(Hs, Tp, beta)
+        run.ncases = ncases
+        return run
+
+    cfg = _cfg(batch_cases=1, window_s=0.0, latency_slo_s=0.05,
+               degrade_after=2, upgrade_after=2)
+    svc = SweepService(runner_factory=paced, config=cfg,
+                       degraded_fowts={"no_qtf": object()})
+    assert svc.ladder == ("full", "no_qtf", "reject")
+    svc.start()
+    results = [svc.submit(1.0 + i, 8.0, 0.0).result(10.0)
+               for i in range(6)]
+    summary = svc.stop()
+    assert all(r.ok for r in results)
+    trans = [(t["from"], t["to"], t["reason"])
+             for t in summary["mode_transitions"]]
+    assert ("full", "no_qtf", "slo_violation") in trans
+    assert ("no_qtf", "full", "healthy") in trans
+    assert results[-1].mode == "full"         # recovered by the end
+    snap = obs.snapshot()
+    series = snap["raft_tpu_serve_mode_transitions_total"]["series"]
+    assert any(s["labels"] == {"from": "full", "to": "no_qtf"}
+               for s in series)
+
+
+def test_reject_mode_sheds_then_exits_after_hold():
+    def instant(mode, fowt, ncases, **kw):
+        return stub_factory(mode, fowt, ncases, **kw)
+
+    cfg = _cfg(batch_cases=1, window_s=0.0, latency_slo_s=0.0,
+               degrade_after=1, upgrade_after=99, reject_hold_s=0.2)
+    svc = SweepService(runner_factory=instant, config=cfg)
+    assert svc.ladder == ("full", "reject")   # no degraded models
+    svc.start()
+    first = svc.submit(1.0, 8.0, 0.0)
+    assert first.result(10.0).ok              # latency_slo 0 -> violation
+    deadline = time.monotonic() + 5.0
+    while svc.mode != "reject" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert svc.mode == "reject"
+    with pytest.raises(errors.AdmissionRejected) as exc:
+        svc.submit(2.0, 8.0, 0.0)
+    assert exc.value.ctx["reason"] == "degraded"
+    # the hold elapses with an empty queue -> the service probes back up
+    deadline = time.monotonic() + 5.0
+    while svc.mode == "reject" and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert svc.mode == "full"
+    svc.submit(3.0, 8.0, 0.0).result(10.0)
+    svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# unit: serve manifest -> trend store row -> SLO rules
+# ---------------------------------------------------------------------------
+
+def test_serve_manifest_and_trend_row(tmp_path, monkeypatch):
+    from raft_tpu.obs import trendstore as T
+
+    monkeypatch.setenv("RAFT_TPU_TREND_DB", str(tmp_path / "t.sqlite"))
+    obs.configure(str(tmp_path))
+    svc = SweepService(runner_factory=stub_factory, config=_cfg())
+    svc.start()
+    run_id = svc._manifest.run_id
+    svc.submit(1.0, 8.0, 0.0).result(10.0)
+    summary = svc.stop()
+    assert summary["completed"] == 1
+    # manifest written with the serve facts + retry matrix
+    path = tmp_path / f"serve_{run_id}.manifest.json"
+    assert path.is_file()
+    import json
+    doc = json.loads(path.read_text())
+    assert doc["status"] == "ok" and doc["kind"] == "serve"
+    assert doc["extra"]["serve"]["completed"] == 1
+    assert doc["extra"]["retry_matrix"]["ModelConfigError"]["terminal"]
+    # flight-recorder stream exists and carries the service lifecycle
+    from raft_tpu.obs import events as E
+    evs = E.read(str(tmp_path / f"serve_{run_id}.events.jsonl"))
+    types = {e["type"] for e in evs}
+    assert {"begin", "service_start", "request_done", "end"} <= types
+    # trend row + the serve SLO rules over it
+    store = T.TrendStore(str(tmp_path / "t.sqlite"))
+    rows = store.rows(kind="serve")
+    assert rows and rows[0]["facts"]["serve_completed"] == 1
+    report = T.evaluate_slo(rows)
+    assert report["ok"]
+    by_name = {r["name"]: r for r in report["results"]}
+    assert not by_name["serve_unhandled_errors"]["skipped"]
+    assert by_name["serve_retry_success_ratio"]["skipped"]  # no retries
+
+
+# ---------------------------------------------------------------------------
+# integration: warm batch runner + the chaos soak (coarse cylinder)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cyl_fowt():
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.models.fowt import build_fowt
+
+    design = load_design("Vertical_cylinder")
+    w = np.arange(0.05, 0.5, 0.05) * 2 * np.pi
+    return build_fowt(design, w,
+                      depth=float(design["site"]["water_depth"]))
+
+
+def test_model_make_service_builds_coarse_rung():
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.model import Model
+
+    design = load_design("Vertical_cylinder")
+    design.setdefault("settings", {})
+    design["settings"].update({"min_freq": 0.05, "max_freq": 0.5})
+    model = Model(design)
+    svc = model.make_service(batch_cases=2, queue_max=4)
+    assert svc.ladder == ("full", "coarse", "reject")
+    assert len(svc._fowts["coarse"].w) == (len(model.w) + 1) // 2
+    assert svc.cfg.batch_cases == 2
+    # not started: nothing to stop, but stop() must be a clean no-op
+    assert svc.stop()["completed"] == 0
+
+
+def test_batch_runner_matches_batched_solver(cyl_fowt, tmp_path,
+                                             monkeypatch):
+    import jax
+
+    from raft_tpu.parallel import exec_cache
+    from raft_tpu.parallel.sweep import make_batch_runner, make_case_solver
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_memo()
+    Hs = np.array([1.5, 2.5, 3.5])
+    Tp = np.array([8.0, 9.0, 10.0])
+    beta = np.array([0.0, 0.5, 1.0])
+    runner = make_batch_runner(cyl_fowt, 3, nIter=4)
+    assert runner.cache_state == "miss"
+    out = runner(Hs, Tp, beta)
+    ref = jax.jit(make_case_solver(cyl_fowt, nIter=4).batched)(
+        Hs, Tp, beta)
+    np.testing.assert_array_equal(np.asarray(out["std"]),
+                                  np.asarray(ref["std"]))
+    np.testing.assert_array_equal(np.asarray(out["iters"]),
+                                  np.asarray(ref["iters"]))
+    # second build: a warm start through the executable cache (served
+    # from the in-process memo without re-reading disk), same numbers
+    runner2 = make_batch_runner(cyl_fowt, 3, nIter=4)
+    assert runner2.cache_state == "hit"
+    out2 = runner2(Hs, Tp, beta)
+    np.testing.assert_array_equal(np.asarray(out2["std"]),
+                                  np.asarray(out["std"]))
+
+
+def test_chaos_soak_deterministic(cyl_fowt, tmp_path, monkeypatch):
+    """ISSUE acceptance: the deterministic chaos soak — injected NaNs,
+    a one-shot kernel raise, cache corruption, a hang through the
+    watchdog, and an admission burst; zero unhandled errors, bounded
+    queue, typed failures only, and every completed request
+    digest-identical to the clean pass."""
+    from raft_tpu.parallel import exec_cache
+    from raft_tpu.serve import soak
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR",
+                       str(tmp_path / "cache"))
+    exec_cache.reset_memo()
+    report = soak.run_soak(cyl_fowt, n_requests=12)
+    assert report["ok"], report
+    assert report["digest_mismatches"] == []
+    # the admission burst overflowed the queue_max=8 watermark exactly
+    assert report["burst_rejected"] == 4
+    chaos = report["chaos"]
+    assert chaos["unhandled"] == 0
+    assert chaos["admitted"] == 12
+    # seq 2: persistently poisoned -> retried to budget -> typed
+    # failure.  Its total attempts are 3: one KernelFailure retry (it
+    # rode the first batch, which hit the one-shot kernel raise) plus
+    # its full per-class NonFiniteResult budget — the budgets are
+    # per-error-class, so the kernel hiccup does not eat into them.
+    f2 = report["failures"][2]
+    assert f2["error"] == "NonFiniteResult" and not f2["quarantined"]
+    assert f2["attempts"] == 1 + DEFAULT_BUDGETS["NonFiniteResult"]
+    # seq 5: hung twice (batch, then solo) -> watchdog quarantine
+    f5 = report["failures"][5]
+    assert f5["error"] == "DeadlineExceeded" and f5["quarantined"]
+    assert set(report["failures"]) == {2, 5}
+    assert report["completed"] == 10
+    # the one-shot kernel failure was retried and recovered
+    assert chaos["retries"] >= 4 and chaos["retried_recovered"] >= 3
+    # hang path: 4 batch members + 1 solo re-run missed the deadline
+    assert chaos["deadline_misses"] == 5
+    assert chaos["abandoned_batches"] == 2
+    # the parity phase must not have served degraded physics
+    assert chaos["mode"] == "full" and chaos["n_mode_transitions"] == 0

@@ -75,6 +75,73 @@ def test_facts_from_manifest():
     assert trendstore.facts_from_manifest({}) == {}
 
 
+def _serve_manifest(run_id="srv_a", rejected=4, retries=6,
+                    recovered=3, misses=5, unhandled=0):
+    return {
+        "schema": "raft_tpu.run_manifest/v1", "run_id": run_id,
+        "kind": "serve", "status": "ok",
+        "started_at": "2026-08-04T00:00:00+00:00", "duration_s": 30.0,
+        "environment": {"hostname": "h", "pid": 42},
+        "config": {}, "metrics": {},
+        "extra": {"serve": {
+            "requests": 16, "admitted": 12, "rejected": rejected,
+            "completed": 10, "failed": 2, "quarantined": 1,
+            "retries": retries, "retried_recovered": recovered,
+            "deadline_misses": misses, "unhandled": unhandled,
+            "batches": 7, "abandoned_batches": 2,
+            "n_mode_transitions": 0, "mode": "full",
+            "p50_latency_s": 0.8, "p99_latency_s": 2.5}},
+    }
+
+
+def test_facts_from_serve_manifest():
+    facts = trendstore.facts_from_manifest(_serve_manifest())
+    assert facts["serve_requests"] == 16
+    assert facts["serve_rejected"] == 4
+    assert facts["serve_retries"] == 6
+    assert facts["serve_retried_recovered"] == 3
+    assert facts["serve_deadline_misses"] == 5
+    assert facts["serve_unhandled"] == 0
+    assert facts["serve_p99_latency_s"] == pytest.approx(2.5)
+    assert facts["serve_mode"] == "full"
+
+
+def test_serve_slo_rules_gate_soak_rows(tmp_path):
+    """The ISSUE's three serve gates (admission-reject ratio, retry-
+    success ratio, deadline-miss count) plus the unhandled-error gate
+    evaluate over serve trend rows and flag each failure mode."""
+    db = str(tmp_path / "t.sqlite")
+    store = trendstore.TrendStore(db)
+    store.append(_serve_manifest("srv_ok"))
+    report = trendstore.evaluate_slo(store.rows())
+    by = {r["name"]: r for r in report["results"]}
+    assert report["ok"]
+    assert by["serve_admission_reject_ratio"]["value"] == \
+        pytest.approx(4 / 16)
+    assert by["serve_retry_success_ratio"]["value"] == \
+        pytest.approx(0.5)
+    assert by["serve_deadline_miss_count"]["value"] == 5.0
+    assert not by["serve_unhandled_errors"]["skipped"]
+    # each gate flags its own failure mode
+    store.append(_serve_manifest("srv_shed", rejected=100))
+    store.append(_serve_manifest("srv_bug", unhandled=3))
+    store.append(_serve_manifest("srv_hang", misses=99))
+    store.append(_serve_manifest("srv_spin", retries=10, recovered=1))
+    report = trendstore.evaluate_slo(store.rows())
+    by = {r["name"]: r for r in report["results"]}
+    assert not report["ok"]
+    assert not by["serve_admission_reject_ratio"]["ok"]
+    assert not by["serve_retry_success_ratio"]["ok"]
+    assert not by["serve_deadline_miss_count"]["ok"]
+    assert not by["serve_unhandled_errors"]["ok"]
+    # analyzeCases-only stores skip the serve rules (fresh checkouts)
+    empty = trendstore.TrendStore(str(tmp_path / "e.sqlite"))
+    report = trendstore.evaluate_slo(empty.rows())
+    assert report["ok"]
+    assert all(r["skipped"] for r in report["results"]
+               if r["name"].startswith("serve_"))
+
+
 def test_store_append_upsert_and_rows(tmp_path):
     db = str(tmp_path / "trend.sqlite")
     store = trendstore.TrendStore(db)

@@ -327,6 +327,32 @@ def _fmt_event(e: dict) -> str | None:
     if t == "probe_attempt":
         return (f"{ts} tpu-probe #{e.get('index')} "
                 f"{e.get('outcome')} ({e.get('message') or '-'})")
+    # serving-layer events (raft_tpu/serve — docs/robustness.md)
+    if t == "service_start":
+        return f"{ts} service start ladder={'->'.join(e.get('ladder') or [])}"
+    if t == "service_mode":
+        return (f"{ts} MODE {e.get('from')} -> {e.get('to')} "
+                f"({e.get('reason')})")
+    if t == "admission_reject":
+        ra = e.get("retry_after_s")
+        hint = f", retry after {ra:.2f}s" if isinstance(
+            ra, (int, float)) else ""
+        return (f"{ts} admission REJECT ({e.get('reason')}, "
+                f"queue {e.get('queue_depth')}{hint})")
+    if t == "retry":
+        return (f"{ts} retry req {e.get('req')} after {e.get('error')} "
+                f"(attempt {e.get('attempt')}, "
+                f"backoff {e.get('backoff_s', 0):.3f}s)")
+    if t == "watchdog_abandon":
+        return (f"{ts} WATCHDOG abandoned batch {e.get('batch_id')} "
+                f"(reqs {e.get('reqs')})")
+    if t == "request_done":
+        return (f"{ts} req {e.get('req')} done "
+                f"({e.get('latency_s', 0):.2f}s, mode {e.get('mode')}, "
+                f"{str(e.get('digest'))[:19]})")
+    if t == "request_failed":
+        return (f"{ts} req {e.get('req')} FAILED "
+                f"({e.get('error')}: {e.get('message')})")
     return None
 
 

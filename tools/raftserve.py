@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""raftserve — the always-on sweep service's command line.
+
+Subcommands::
+
+    raftserve serve --design Vertical_cylinder --port 8765
+        Long-lived HTTP endpoint over raft_tpu.serve.SweepService:
+          POST /submit   {"hs":2.0,"tp":9.0,"heading_deg":0,
+                          "deadline_s":60, "wait":false}
+                         -> 202 {"request_id": ...} (or the full result
+                         with "wait": true); admission rejection maps
+                         to 429 + a Retry-After header.
+          GET  /result?id=...      -> result by request id (404 unknown,
+                                      202 still pending)
+          GET  /result?digest=...  -> completed result by ledger digest
+          GET  /stats | /healthz   -> service counters / liveness
+        Ctrl-C drains the queue and writes the serve run manifest.
+
+    raftserve soak [--requests 12] [--faults SPEC] [--json OUT]
+        Deterministic chaos soak (raft_tpu/serve/soak.py): clean
+        reference pass, then the same request schedule under fault
+        injection + an admission burst; exits nonzero unless every
+        completed request is digest-identical to the clean pass and
+        the service survived with zero unhandled errors.  The fault
+        spec defaults to serve.soak.DEFAULT_FAULTS, or comes from
+        --faults / the RAFT_TPU_FAULTS environment variable.
+
+Set RAFT_TPU_OBS_DIR to collect the serve manifests, flight-recorder
+event streams, and the trend-store rows the `obsctl slo` serve rules
+gate on.  On a host with a TPU tunnel problem set JAX_PLATFORMS=cpu.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_fowts(args):
+    """(fowt, coarse_fowt) on the requested frequency grid."""
+    import numpy as np
+
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.models.fowt import build_fowt
+
+    design = load_design(args.design)
+    w = np.arange(args.min_freq, args.max_freq,
+                  args.dfreq) * 2.0 * np.pi
+    depth = float(design["site"]["water_depth"])
+    fowt = build_fowt(design, w, depth=depth)
+    coarse = build_fowt(design, w[::2], depth=depth) \
+        if args.coarse else None
+    return fowt, coarse
+
+
+def cmd_soak(args) -> int:
+    from raft_tpu.serve import soak
+    from raft_tpu.serve.config import ServeConfig
+
+    spec = (args.faults or os.environ.get("RAFT_TPU_FAULTS", "").strip()
+            or soak.DEFAULT_FAULTS)
+    fowt, coarse = _build_fowts(args)
+    cfg = soak.default_config(batch_cases=args.batch)
+    if args.queue_max:
+        cfg = ServeConfig(**{**cfg.__dict__, "queue_max": args.queue_max})
+    report = soak.run_soak(fowt, coarse_fowt=coarse, config=cfg,
+                           n_requests=args.requests, faults_spec=spec,
+                           seed=args.seed, timeout_s=args.timeout)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+    chaos = report["chaos"]
+    print(f"raftserve soak: {'OK' if report['ok'] else 'FAILED'} — "
+          f"{report['completed']}/{report['n_requests']} digest-exact, "
+          f"{len(report['failures'])} typed failure(s), "
+          f"{report['burst_rejected']} burst reject(s), "
+          f"{chaos['retries']} retries "
+          f"({chaos['retried_recovered']} recovered), "
+          f"{chaos['deadline_misses']} deadline miss(es), "
+          f"mode={chaos['mode']}, {report['wall_s']:.1f}s")
+    return 0 if report["ok"] else 1
+
+
+def cmd_serve(args) -> int:
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from raft_tpu import errors
+    from raft_tpu.serve import ServeConfig, SweepService
+
+    fowt, coarse = _build_fowts(args)
+    cfg = ServeConfig(batch_cases=args.batch, queue_max=args.queue_max,
+                      deadline_s=args.deadline,
+                      batch_deadline_s=args.batch_deadline)
+    degraded = {"coarse": coarse} if coarse is not None else None
+    service = SweepService(fowt, cfg, degraded_fowts=degraded).start()
+    # bounded FIFO, like SweepService._delivered: an always-on process
+    # must not retain one ticket per request forever
+    import collections
+    tickets: collections.OrderedDict[str, object] = \
+        collections.OrderedDict()
+    tickets_max = 1024
+
+    def _track(t):
+        tickets[t.id] = t
+        while len(tickets) > tickets_max:
+            tickets.popitem(last=False)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):                     # pragma: no cover
+            pass
+
+        def _send(self, code: int, doc: dict, headers: dict = None):
+            data = json.dumps(doc, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):                              # noqa: N802
+            from urllib.parse import parse_qs, urlparse
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            if url.path == "/healthz":
+                self._send(200, {"ok": True, "pid": os.getpid(),
+                                 **service.stats()})
+            elif url.path == "/stats":
+                self._send(200, service.summary())
+            elif url.path == "/result":
+                digest = q.get("digest", [None])[0]
+                rid = q.get("id", [None])[0]
+                if digest:
+                    res = service.fetch(digest)
+                    if res is None:
+                        self._send(404, {"error": "unknown digest"})
+                    else:
+                        self._send(200, res.to_dict())
+                    return
+                t = tickets.get(rid)
+                if t is None:
+                    self._send(404, {"error": "unknown request id"})
+                elif not t.done():
+                    self._send(202, {"request_id": rid,
+                                     "status": "pending"})
+                else:
+                    self._send(200, t.result(0.0).to_dict())
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):                             # noqa: N802
+            import math
+            if self.path != "/submit":
+                self._send(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                hs = float(doc["hs"])
+                tp = float(doc["tp"])
+                beta = (math.radians(float(doc["heading_deg"]))
+                        if "heading_deg" in doc
+                        else float(doc.get("heading_rad", 0.0)))
+                deadline_s = doc.get("deadline_s")
+                if deadline_s is not None:
+                    deadline_s = float(deadline_s)
+                    if not (deadline_s > 0.0):
+                        raise ValueError("deadline_s must be > 0")
+            except (KeyError, TypeError, ValueError,
+                    json.JSONDecodeError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                t = service.submit(hs, tp, beta, deadline_s=deadline_s)
+            except errors.AdmissionRejected as e:
+                self._send(429, e.context(),
+                           headers={"Retry-After":
+                                    f"{max(1, round(e.retry_after_s))}"})
+                return
+            _track(t)
+            if doc.get("wait"):
+                try:
+                    res = t.result((deadline_s or cfg.deadline_s) + 5.0)
+                except errors.DeadlineExceeded as e:
+                    self._send(504, e.context())
+                    return
+                self._send(200, res.to_dict())
+            else:
+                self._send(202, {"request_id": t.id, "seq": t.seq})
+
+    srv = ThreadingHTTPServer((args.host, args.port), Handler)
+    host, port = srv.server_address[:2]
+    print(f"raftserve: http://{host}:{port}/  (submit, result, stats, "
+          f"healthz; design={args.design}, batch={cfg.batch_cases}, "
+          f"ladder={'->'.join(service.ladder)})", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:                          # pragma: no cover
+        pass
+    finally:
+        srv.server_close()
+        summary = service.stop()
+        print(json.dumps(summary, indent=1, default=str))
+    return 0
+
+
+def _add_model_args(p):
+    p.add_argument("--design", default="Vertical_cylinder",
+                   help="vendored design name (raft_tpu/designs)")
+    p.add_argument("--min-freq", type=float, default=0.05)
+    p.add_argument("--max-freq", type=float, default=0.5)
+    p.add_argument("--dfreq", type=float, default=0.05)
+    p.add_argument("--batch", type=int, default=4,
+                   help="fixed case-batch size of the warm program")
+    p.add_argument("--queue-max", type=int, default=None,
+                   help="admission queue watermark")
+    p.add_argument("--coarse", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="build the decimated-grid model for the "
+                        "'coarse' degradation rung")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="raftserve", description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("soak", help="deterministic chaos soak "
+                                    "(exit 1 on any verdict failure)")
+    _add_model_args(p)
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--faults", default=None,
+                   help="fault spec (default: RAFT_TPU_FAULTS or the "
+                        "built-in chaos spec)")
+    p.add_argument("--seed", type=int, default=2026)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--json", help="write the full report to this path")
+    p.set_defaults(fn=cmd_soak)
+
+    p = sub.add_parser("serve", help="HTTP endpoint over SweepService")
+    _add_model_args(p)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8765)
+    p.add_argument("--deadline", type=float, default=120.0,
+                   help="default per-request deadline (s)")
+    p.add_argument("--batch-deadline", type=float, default=60.0,
+                   help="watchdog deadline per in-flight batch (s)")
+    p.set_defaults(fn=cmd_serve)
+
+    args = ap.parse_args(argv)
+    if args.queue_max is None and args.cmd == "serve":
+        args.queue_max = 64
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

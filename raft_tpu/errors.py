@@ -102,6 +102,17 @@ class CacheCorruption(RaftError, RuntimeError):
     phase = "cache"
 
 
+class JournalCorrupt(CacheCorruption):
+    """A write-ahead/resume journal record failed to parse or verify
+    (torn tail, bit rot, schema drift).  Replay treats corruption as a
+    skip-and-count miss by default — this type surfaces only when a
+    caller opts into strict scanning (``serve.journal.replay(...,
+    strict=True)``), and inherits :class:`CacheCorruption` so existing
+    integrity handling keeps working."""
+
+    phase = "journal"
+
+
 class EigenFailure(RaftError, RuntimeError):
     """The eigen solve produced unusable system matrices or
     non-positive eigenvalues."""

@@ -45,14 +45,21 @@ record carrying an incremented ``part``.
 Like the rest of ``raft_tpu.obs``, this module never imports jax, and
 no recorder failure may ever take down the solve it is watching: every
 emit path degrades to a silent no-op on I/O trouble.
+
+The crash-safe file discipline itself (flush-per-line append, torn-tail
+skip on read, size rotation) lives in :mod:`raft_tpu.obs.journalio` —
+one tested codec shared with the serving layer's write-ahead request
+journal (:mod:`raft_tpu.serve.journal`); this module owns only the
+event *schema* on top of it.
 """
 from __future__ import annotations
 
-import json
 import os
 import socket
 import threading
 import time
+
+from raft_tpu.obs import journalio
 
 SCHEMA = "raft_tpu.events/v1"
 
@@ -120,62 +127,41 @@ class FlightRecorder:
         self.run_id = str(run_id)
         self.kind = str(kind)
         self.seq = 0
-        self.part = 0
         self._lock = threading.Lock()
-        self._fh = None
-        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
-                    exist_ok=True)
-        self._open_fresh()
+        # the shared crash-safe codec owns open/flush/rotate; this
+        # recorder owns the schema (seq numbering, begin/end records)
+        self._writer = journalio.JsonlWriter(
+            self.path, max_bytes=max_bytes(), keep=keep_rotations(),
+            header=self._begin_record)
 
     # -- file lifecycle ----------------------------------------------
 
-    def _open_fresh(self):
-        self._fh = open(self.path, "a", encoding="utf-8")
-        self._emit_locked("begin", schema=SCHEMA, run_id=self.run_id,
-                          kind=self.kind, pid=os.getpid(),
-                          hostname=socket.gethostname(), part=self.part)
+    @property
+    def part(self) -> int:
+        return self._writer.part if self._writer is not None else 0
 
-    def _rotate(self):
-        try:
-            self._fh.close()
-        except OSError:                          # pragma: no cover
-            pass
-        keep = keep_rotations()
-        if keep <= 0:
-            try:
-                os.remove(self.path)
-            except OSError:                      # pragma: no cover
-                pass
-        else:
-            for i in range(keep - 1, 0, -1):
-                src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
-                if os.path.exists(src):
-                    try:
-                        os.replace(src, dst)
-                    except OSError:              # pragma: no cover
-                        pass
-            try:
-                os.replace(self.path, self.path + ".1")
-            except OSError:                      # pragma: no cover
-                pass
-        self.part += 1
-        self._open_fresh()
+    def _begin_record(self, part: int) -> dict:
+        rec = {"seq": self.seq, "t": round(time.time(), 6),
+               "type": "begin", "schema": SCHEMA, "run_id": self.run_id,
+               "kind": self.kind, "pid": os.getpid(),
+               "hostname": socket.gethostname(), "part": int(part)}
+        self.seq += 1
+        return rec
 
     def close(self, status: str = "ok"):
         """Append the ``end`` record and close the file (idempotent)."""
         with self._lock:
-            if self._fh is None:
+            if self._writer is None or self._writer.closed:
                 return
-            self._emit_locked("end", status=str(status))
             try:
-                self._fh.close()
+                self._emit_locked("end", status=str(status))
             except OSError:                      # pragma: no cover
                 pass
-            self._fh = None
+            self._writer.close()
 
     @property
     def closed(self) -> bool:
-        return self._fh is None
+        return self._writer is None or self._writer.closed
 
     # -- emission ----------------------------------------------------
 
@@ -184,19 +170,21 @@ class FlightRecorder:
                "type": str(type_)}
         for k, v in fields.items():
             rec[k] = _jsonable(v)
-        self._fh.write(json.dumps(rec, separators=(",", ":"),
-                                  default=str) + "\n")
-        self._fh.flush()
+        # assign this record's seq BEFORE the write: a size rotation
+        # inside write() opens a fresh part whose begin header must
+        # number itself after this record
         self.seq += 1
+        self._writer.write(rec)
 
     def emit(self, type_: str, **fields):
         try:
             with self._lock:
-                if self._fh is None:
+                if self.closed:
                     return
+                # the knobs stay env-dynamic (tests shrink them mid-run)
+                self._writer.max_bytes = max_bytes()
+                self._writer.keep = keep_rotations()
                 self._emit_locked(type_, **fields)
-                if self._fh.tell() > max_bytes():
-                    self._rotate()
         # a full disk / closed stream must never take down the run the
         # recorder is documenting (obs contract)
         except Exception:  # pragma: no cover  # raftlint: disable=RTL004
@@ -272,23 +260,9 @@ def _tracing_sink(kind: str, event: dict):
 
 def read(path: str) -> list[dict]:
     """Parse one event file, tolerating the torn final line a hard kill
-    can leave (any unparseable line is skipped, never fatal)."""
-    out = []
-    try:
-        with open(path, encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    doc = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if isinstance(doc, dict):
-                    out.append(doc)
-    except OSError:
-        return []
-    return out
+    can leave (any unparseable line is skipped, never fatal) — the
+    shared :func:`raft_tpu.obs.journalio.read` codec."""
+    return journalio.read(path)
 
 
 def read_incremental(path: str, offset: int = 0) -> tuple[list[dict], int]:
@@ -299,26 +273,7 @@ def read_incremental(path: str, offset: int = 0) -> tuple[list[dict], int]:
     multi-MiB stream twice a second.  A ``new_offset`` smaller than the
     file is normal (torn tail); a file smaller than ``offset`` means
     the recorder rotated — re-enter at 0."""
-    try:
-        with open(path, "rb") as f:
-            f.seek(int(offset))
-            data = f.read()
-    except OSError:
-        return [], offset
-    end = data.rfind(b"\n")
-    if end < 0:
-        return [], offset
-    out = []
-    for raw in data[:end].split(b"\n"):
-        if not raw.strip():
-            continue
-        try:
-            doc = json.loads(raw.decode("utf-8"))
-        except (json.JSONDecodeError, UnicodeDecodeError):
-            continue
-        if isinstance(doc, dict):
-            out.append(doc)
-    return out, int(offset) + end + 1
+    return journalio.read_incremental(path, offset)
 
 
 def validate(events: list[dict]) -> list[str]:

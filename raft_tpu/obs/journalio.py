@@ -1,0 +1,237 @@
+"""Crash-safe JSONL codec shared by every append-only journal.
+
+Two subsystems keep forensic/durability records as line-flushed JSONL:
+the flight recorder (:mod:`raft_tpu.obs.events`) and the serving
+layer's write-ahead request journal (:mod:`raft_tpu.serve.journal`).
+Both need the same discipline, extracted here once:
+
+- **flush-per-line writes** — every record is serialized, written, and
+  flushed in one step, so the OS has the bytes even if the process is
+  SIGKILLed the next instant; a hard kill leaves at most one torn
+  final line;
+- **torn-tail-tolerant reads** — :func:`read` skips any unparseable
+  line (the torn tail of a killed writer, or mid-file bit rot) instead
+  of raising into a recovery path, and :func:`read_incremental` leaves
+  an incomplete final line unconsumed for the next poll;
+- **size rotation** — when a part exceeds ``max_bytes`` the file
+  rotates to ``<path>.1``, ``<path>.2``, ... keeping the newest
+  ``keep`` generations.
+
+Corrupt-entry accounting is shared too: every journal flavor counts
+skipped/unreadable entries in the single
+``raft_tpu_journal_corrupt_total{kind}`` counter (``kind="case"`` for
+the per-case resume pickles, ``kind="serve"`` for the write-ahead
+request journal, ``kind="events"`` when a reader opts in), so one
+dashboard row watches every durability surface.
+
+Like the rest of ``raft_tpu.obs`` this module never imports jax, and a
+writer failure must never take down the run it documents — callers
+decide whether to swallow (telemetry) or count-and-continue (WAL).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+def _default(v):
+    return str(v)
+
+
+def dumps(doc: dict) -> str:
+    """The one serialization every journal line uses (compact
+    separators, non-JSON-able values stringified)."""
+    return json.dumps(doc, separators=(",", ":"), default=_default)
+
+
+def count_corrupt(kind: str, n: int = 1):
+    """Count torn/corrupt journal entries in the shared
+    ``raft_tpu_journal_corrupt_total{kind}`` counter (never raises —
+    corruption accounting must not become a second failure)."""
+    if n <= 0:
+        return
+    try:
+        from raft_tpu import obs
+        obs.counter(
+            "raft_tpu_journal_corrupt_total",
+            "torn/corrupt journal entries treated as misses on read, "
+            "by journal kind").inc(float(n), kind=str(kind))
+    except Exception:                                 # pragma: no cover
+        pass
+
+
+class JsonlWriter:
+    """One append-only, line-flushed JSONL file with size rotation.
+
+    Not thread-safe on its own — callers that emit from several threads
+    hold their own lock around :meth:`write` (the flight recorder and
+    the serve journal both do).  ``header`` (optional) is called as
+    ``header(part)`` after every fresh open — including the first — and
+    its returned dict (if any) becomes the part's first record, so a
+    rotated generation is self-describing.
+    """
+
+    def __init__(self, path: str, *, max_bytes: int = None,
+                 keep: int = 2, header=None):
+        self.path = str(path)
+        self.max_bytes = max_bytes
+        self.keep = max(0, int(keep))
+        self.part = 0
+        self._header = header
+        self._fh = None
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        self._open_fresh()
+
+    # -- file lifecycle ----------------------------------------------
+
+    def _open_fresh(self):
+        self._fh = open(self.path, "a", encoding="utf-8")
+        if self._header is not None:
+            doc = self._header(self.part)
+            if doc:
+                self.write(dict(doc), rotate=False)
+
+    def write(self, doc: dict, rotate: bool = True):
+        """Serialize one record, write it, flush — then rotate if the
+        part outgrew ``max_bytes``.  Raises on I/O trouble; the caller
+        chooses its own degradation (the obs layer swallows, the WAL
+        counts and keeps serving)."""
+        self._fh.write(dumps(doc) + "\n")
+        self._fh.flush()
+        if rotate and self.max_bytes is not None \
+                and self._fh.tell() > self.max_bytes:
+            self.rotate()
+
+    def rotate(self):
+        """Close the current part and open a fresh one, shifting the
+        closed part to ``<path>.1`` (older generations shuffle up;
+        anything past ``keep`` is dropped)."""
+        try:
+            self._fh.close()
+        except OSError:                              # pragma: no cover
+            pass
+        if self.keep <= 0:
+            try:
+                os.remove(self.path)
+            except OSError:                          # pragma: no cover
+                pass
+        else:
+            for i in range(self.keep - 1, 0, -1):
+                src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
+                if os.path.exists(src):
+                    try:
+                        os.replace(src, dst)
+                    except OSError:                  # pragma: no cover
+                        pass
+            try:
+                os.replace(self.path, self.path + ".1")
+            except OSError:                          # pragma: no cover
+                pass
+        self.part += 1
+        self._open_fresh()
+
+    def tell(self) -> int:
+        return self._fh.tell()
+
+    def close(self):
+        """Close the stream (idempotent; no end-record ceremony — that
+        is the owning journal's schema, not the codec's)."""
+        if self._fh is None:
+            return
+        try:
+            self._fh.close()
+        except OSError:                              # pragma: no cover
+            pass
+        self._fh = None
+
+    @property
+    def closed(self) -> bool:
+        return self._fh is None
+
+    # -- fault seam (testing/faults.py: torn@journal) ----------------
+
+    def tear_tail(self, nbytes: int = 7):
+        """Truncate the file mid-record — what a crash between
+        ``write`` and ``flush`` of the NEXT record looks like.  Driven
+        only by the ``torn@journal`` fault action; readers must skip
+        the torn line."""
+        try:
+            self._fh.flush()
+            end = self._fh.tell()
+            self._fh.close()
+            with open(self.path, "ab") as f:
+                f.truncate(max(0, end - int(nbytes)))
+            self._fh = open(self.path, "a", encoding="utf-8")
+        except OSError:                              # pragma: no cover
+            pass
+
+
+_READ_LOCK = threading.Lock()  # corrupt counting only; reads are pure
+
+
+def read(path: str, kind: str = None) -> list[dict]:
+    """Parse one JSONL file, tolerating the torn final line a hard
+    kill can leave (any unparseable line is skipped, never fatal).
+    When ``kind`` is given, skipped lines are counted in
+    ``raft_tpu_journal_corrupt_total{kind}``."""
+    return read_counted(path, kind)[0]
+
+
+def read_counted(path: str, kind: str = None) -> tuple[list[dict], int]:
+    """:func:`read` plus the number of skipped (torn/corrupt) lines —
+    the replay paths that must *account* for corruption, not just
+    survive it."""
+    out = []
+    bad = 0
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                if isinstance(doc, dict):
+                    out.append(doc)
+                else:
+                    bad += 1
+    except OSError:
+        return [], 0
+    if kind is not None and bad:
+        with _READ_LOCK:
+            count_corrupt(kind, bad)
+    return out, bad
+
+
+def read_incremental(path: str, offset: int = 0) -> tuple[list[dict], int]:
+    """Parse only the COMPLETE lines at byte ``offset`` and beyond;
+    returns ``(records, new_offset)``.  A torn final line (mid-write or
+    mid-kill) is left unconsumed for the next call — the follow loop's
+    O(new) building block.  A ``new_offset`` smaller than the file is
+    normal (torn tail); a file smaller than ``offset`` means the writer
+    rotated — re-enter at 0."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(int(offset))
+            data = f.read()
+    except OSError:
+        return [], offset
+    end = data.rfind(b"\n")
+    if end < 0:
+        return [], offset
+    out = []
+    for raw in data[:end].split(b"\n"):
+        if not raw.strip():
+            continue
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        if isinstance(doc, dict):
+            out.append(doc)
+    return out, int(offset) + end + 1

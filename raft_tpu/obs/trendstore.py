@@ -156,7 +156,15 @@ def facts_from_manifest(doc: dict) -> dict:
                   "failed", "quarantined", "retries",
                   "retried_recovered", "deadline_misses", "unhandled",
                   "batches", "abandoned_batches", "n_mode_transitions",
-                  "p50_latency_s", "p99_latency_s"):
+                  "p50_latency_s", "p99_latency_s",
+                  # durability facts (serve/journal.py): present only
+                  # on journaled / recovered / drained service rows,
+                  # so the restart SLO rules skip ordinary runs
+                  "journal_errors", "replayed", "recovered_results",
+                  "deduped", "replayed_lost_count",
+                  "restart_warm_start", "handoff_pending",
+                  # tenancy facts (serve/tenancy.py)
+                  "tenant_evictions", "tenant_rewarms"):
             if _num(serve.get(k)) is not None:
                 facts[f"serve_{k}"] = serve[k]
         if serve.get("mode"):
@@ -364,6 +372,17 @@ DEFAULT_SLO_RULES = [
     {"name": "serve_unhandled_errors", "kind": "serve",
      "fact": "serve_unhandled", "agg": "max", "op": "<=",
      "threshold": 0.0, "window": 20},
+    # -- durability gates (serve/journal.py; skipped when no recovered
+    # serve run exists — the facts appear only after a replay).  A
+    # replayed request that never reached a terminal state is a silent
+    # drop; a recovered service that re-traced instead of warm-starting
+    # from the executable cache blew the restart-latency budget.
+    {"name": "serve_replayed_lost_count", "kind": "serve",
+     "fact": "serve_replayed_lost_count", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
+    {"name": "serve_restart_warm_start", "kind": "serve",
+     "fact": "serve_restart_warm_start", "agg": "min", "op": "==",
+     "threshold": 1.0, "window": 20},
 ]
 
 _OPS = {

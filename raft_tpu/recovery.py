@@ -364,14 +364,10 @@ class CaseJournal:
 
     @staticmethod
     def _count_corrupt():
-        try:
-            from raft_tpu import obs
-            obs.counter(
-                "raft_tpu_journal_corrupt_total",
-                "torn/corrupt per-case journal entries treated as "
-                "misses on load").inc(1.0)
-        except Exception:                             # pragma: no cover
-            pass
+        # shared durability accounting: one counter, labeled by journal
+        # kind (the serve WAL counts under kind="serve")
+        from raft_tpu.obs import journalio
+        journalio.count_corrupt("case")
 
     def store_case(self, iCase: int, record: dict):
         """Atomically persist one completed case (never raises — a

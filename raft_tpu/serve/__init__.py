@@ -11,11 +11,23 @@ out-of-band watchdog, per-error-class retry/backoff
 ladder (``full -> no_qtf -> coarse -> reject``).  Results deliver
 asynchronously, keyed by their ledger content digest.
 
+The durability layer makes the process replaceable: a write-ahead
+request journal (:mod:`raft_tpu.serve.journal`) records every
+admission/result before it is acknowledged, ``SweepService.recover``
+replays it after a crash, ``SweepService.drain`` hands off to a
+successor (handoff manifest + exec-cache warm start), and several
+models share the device as named tenants
+(:mod:`raft_tpu.serve.tenancy`) under an LRU warm-program budget.
+
 Entry points: :class:`SweepService` (embedded),
 ``tools/raftserve.py`` (CLI: HTTP endpoint + the deterministic chaos
-soak).  See docs/robustness.md "Serving".
+and kill-restart soaks).  See docs/robustness.md "Serving" and
+"Durability".
 """
 from raft_tpu.serve.config import MODES, ServeConfig  # noqa: F401
+from raft_tpu.serve.journal import (  # noqa: F401
+    RequestJournal, replay, request_digest,
+)
 from raft_tpu.serve.retry import (  # noqa: F401
     DEFAULT_BUDGETS, TERMINAL, RetryPolicy,
 )
@@ -23,4 +35,7 @@ from raft_tpu.serve.service import (  # noqa: F401
     SweepResult, SweepService, Ticket,
 )
 from raft_tpu.serve.soak import DEFAULT_FAULTS, run_soak  # noqa: F401
+from raft_tpu.serve.tenancy import (  # noqa: F401
+    DEFAULT_TENANT, Tenant, TenantRegistry,
+)
 from raft_tpu.serve.watchdog import Watchdog  # noqa: F401

@@ -77,6 +77,19 @@ class ServeConfig:
     #: completed results kept for fetch-by-digest delivery
     result_cache: int = 256
 
+    # -- durability (serve/journal.py) --------------------------------
+    #: directory of the write-ahead request journal; None (default)
+    #: serves from memory only — set it to make every admission,
+    #: completion, and failure crash-recoverable via
+    #: ``SweepService.recover()``
+    journal_dir: str | None = None
+
+    # -- tenancy (serve/tenancy.py) -----------------------------------
+    #: warm compiled batch programs kept live across all tenants;
+    #: least-recently-used runners are evicted (and re-warmed from the
+    #: executable cache on next use) beyond this budget
+    max_live_programs: int = 4
+
     # -- solver kwargs forwarded to make_case_solver -----------------
     nIter: int = 10
     tol: float = 0.01
@@ -99,6 +112,9 @@ class ServeConfig:
             ("upgrade_after", self.upgrade_after >= 1),
             ("reject_hold_s", self.reject_hold_s >= 0.0),
             ("result_cache", self.result_cache >= 1),
+            ("journal_dir", self.journal_dir is None
+             or bool(str(self.journal_dir).strip())),
+            ("max_live_programs", self.max_live_programs >= 1),
             ("nIter", self.nIter >= 1),
         ]
         bad = [name for name, ok in checks if not ok]

@@ -1,0 +1,332 @@
+"""Write-ahead request journal for the sweep service (durability).
+
+PR 9's :class:`~raft_tpu.serve.service.SweepService` survives faults
+*it can see*; this module makes it survive the fault it cannot — its
+own death.  Every externally-visible state change of a request is
+appended to a crash-safe JSONL journal (the
+:mod:`raft_tpu.obs.journalio` codec: flush-per-line, torn-tail-skip,
+size rotation) **before** the change is acknowledged to the caller:
+
+==========  ==========================================================
+record      written when
+==========  ==========================================================
+begin       journal (part) opens — schema + service run identity
+admit       a request passes admission, BEFORE its ticket is returned
+batch       a gathered batch is registered in-flight, before solving
+complete    a result is ready, BEFORE the ticket resolves; carries the
+            ledger ``case<i>`` result digest AND the payload needed to
+            re-deliver without re-solving
+fail        a typed terminal failure, BEFORE the ticket resolves
+tenant      a warm-runner eviction / re-warm (serve/tenancy.py)
+recover     a replay happened: the recovered/replayed/deduped counts
+handoff     a graceful drain: pending seqs + exec-cache keys the
+            successor warm-starts from
+==========  ==========================================================
+
+Records are keyed twice: by the **request digest** (``rdigest`` — the
+content address of the submitted ``(Hs, Tp, beta, tenant)``) and, once
+solved, by the deterministic ledger **result digest** the async
+delivery path already uses.  That makes replay idempotent: a re-run of
+an already-completed request is recognized by its request digest and
+becomes a *dedupe hit* (the journaled result is re-delivered), never a
+duplicate solve.
+
+:func:`replay` is the read half: scan a journal directory (rotated
+parts oldest-first), classify every admitted request as completed /
+failed / pending, skip-and-count torn lines
+(``raft_tpu_journal_corrupt_total{kind="serve"}``), and return the
+structured state :meth:`SweepService.recover` re-admits from.
+
+Journal writes must never take down the service they protect: an I/O
+failure is logged, counted (``raft_tpu_serve_journal_errors_total``)
+and serving continues — the operator sees the durability gap in the
+metrics instead of a dead endpoint.  The ``torn@journal`` fault action
+(:mod:`raft_tpu.testing.faults`) truncates the freshly-written record
+mid-line to drive the torn-tail replay path deterministically in CI.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from raft_tpu import errors
+from raft_tpu.obs import journalio
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("serve.journal")
+
+SCHEMA = "raft_tpu.serve.journal/v1"
+FILENAME = "serve.journal.jsonl"
+HANDOFF = "handoff.json"
+
+#: record types replay understands; anything else in the stream is
+#: schema drift and counts as corruption
+RECORD_TYPES = ("begin", "admit", "batch", "complete", "fail", "tenant",
+                "recover", "handoff")
+
+#: terminal record types — an admitted seq carrying one of these is no
+#: longer pending
+_TERMINAL = ("complete", "fail")
+
+
+def journal_path(journal_dir: str) -> str:
+    return os.path.join(journal_dir, FILENAME)
+
+
+def handoff_path(journal_dir: str) -> str:
+    return os.path.join(journal_dir, HANDOFF)
+
+
+def max_bytes() -> int:
+    try:
+        return int(os.environ.get("RAFT_TPU_SERVE_JOURNAL_MAX_BYTES",
+                                  str(64 << 20)))
+    except ValueError:                               # pragma: no cover
+        return 64 << 20
+
+
+def request_digest(Hs: float, Tp: float, beta: float,
+                   tenant: str = "default") -> str:
+    """Content address of one submission — the dedupe key.  Two
+    requests for the same physics under the same tenant share it; the
+    deadline deliberately does not participate (a resubmission with a
+    different deadline is still the same solve)."""
+    from raft_tpu.obs.ledger import digest_metrics
+    return digest_metrics({"Hs": float(Hs), "Tp": float(Tp),
+                           "beta": float(beta), "tenant": str(tenant)})
+
+
+class RequestJournal:
+    """The service's append-only WAL (one per journal directory).
+
+    Thread-safe; every ``record_*`` method serializes, writes, and
+    flushes one line before returning, so the caller may acknowledge
+    the state change the instant the call returns.  All methods are
+    crash-tolerant in the other direction too: a failed write degrades
+    to a counted, logged gap — never an exception into the serving
+    loop.
+    """
+
+    def __init__(self, journal_dir: str, run_id: str = None, *,
+                 snapshot_fn=None):
+        self.dir = str(journal_dir)
+        self.run_id = str(run_id or "")
+        self.path = journal_path(self.dir)
+        self._lock = threading.Lock()
+        self.errors = 0
+        #: checkpoint source: called (lock-free from the service side)
+        #: on every size rotation to re-append the ``admit`` records of
+        #: still-open requests into the fresh part — rotation may drop
+        #: old parts, and an open request's admit record must outlive
+        #: them or a crash after rotation silently loses it.  (The
+        #: dedupe index of COMPLETED results is deliberately bounded by
+        #: the retained parts instead — losing a dedupe hit costs one
+        #: redundant solve, never a request.)
+        self._snapshot = snapshot_fn
+        self._writer = journalio.JsonlWriter(
+            self.path, max_bytes=max_bytes(), keep=4,
+            header=self._begin_record)
+
+    def _begin_record(self, part: int) -> dict:
+        return {"t": round(time.time(), 6), "type": "begin",
+                "schema": SCHEMA, "run_id": self.run_id,
+                "pid": os.getpid(), "part": int(part)}
+
+    # -- the one write path ------------------------------------------
+
+    def _write(self, type_: str, **fields):
+        from raft_tpu.testing import faults
+
+        rec = {"t": round(time.time(), 6), "type": str(type_)}
+        rec.update(fields)
+        try:
+            with self._lock:
+                if self._writer.closed:
+                    return
+                part = self._writer.part
+                self._writer.write(rec)
+                if self._writer.part != part and self._snapshot:
+                    # rotated: checkpoint every still-open request's
+                    # admit record into the fresh part before old
+                    # parts age out
+                    for srec in self._snapshot():
+                        self._writer.write(dict(srec), rotate=False)
+                # deterministic torn-tail injection: what a crash
+                # between write and flush of this record looks like
+                if faults.fire("journal", record=type_) == "torn":
+                    self._writer.tear_tail()
+        # a journal write failure must not take down the service it
+        # protects: count the durability gap and keep serving
+        except Exception:  # raftlint: disable=RTL004
+            self.errors += 1
+            _LOG.warning("serve journal: write failed (%s record); "
+                         "durability gap", type_, exc_info=True)
+            try:
+                from raft_tpu import obs
+                obs.counter(
+                    "raft_tpu_serve_journal_errors_total",
+                    "serve WAL writes that failed (durability gaps)"
+                    ).inc(1.0)
+            except Exception:                        # pragma: no cover
+                pass
+
+    # -- record emitters (see module table) --------------------------
+
+    def record_admit(self, seq: int, request_id: str, rdigest: str,
+                     Hs: float, Tp: float, beta: float,
+                     deadline_s: float, tenant: str):
+        self._write("admit", seq=int(seq), id=str(request_id),
+                    rdigest=rdigest, Hs=float(Hs), Tp=float(Tp),
+                    beta=float(beta), deadline_s=float(deadline_s),
+                    tenant=str(tenant))
+
+    def record_batch(self, batch_id: int, seqs: list[int], mode: str,
+                     tenant: str):
+        self._write("batch", batch_id=int(batch_id),
+                    seqs=[int(s) for s in seqs], mode=str(mode),
+                    tenant=str(tenant))
+
+    def record_complete(self, seq: int, rdigest: str, digest: str,
+                        mode: str, attempts: int, std: list,
+                        iters: int, converged: bool):
+        self._write("complete", seq=int(seq), rdigest=rdigest,
+                    digest=digest, mode=str(mode), attempts=int(attempts),
+                    std=[float(v) for v in std], iters=int(iters),
+                    converged=bool(converged))
+
+    def record_fail(self, seq: int, rdigest: str, error: dict,
+                    quarantined: bool):
+        self._write("fail", seq=int(seq), rdigest=rdigest,
+                    error=dict(error or {}), quarantined=bool(quarantined))
+
+    def record_tenant(self, event: str, tenant: str, mode: str):
+        self._write("tenant", event=str(event), tenant=str(tenant),
+                    mode=str(mode))
+
+    def record_recover(self, counts: dict):
+        self._write("recover", **{k: int(v) for k, v in counts.items()})
+
+    def record_handoff(self, pending: list[int], exec_keys: dict,
+                       next_seq: int, successor: str = None):
+        self._write("handoff", pending=[int(s) for s in pending],
+                    exec_keys=dict(exec_keys), next_seq=int(next_seq),
+                    successor=successor)
+
+    def close(self):
+        with self._lock:
+            self._writer.close()
+
+
+def write_handoff_manifest(journal_dir: str, doc: dict) -> str:
+    """Atomically write the successor-facing handoff manifest
+    (``handoff.json``) next to the journal; returns its path."""
+    import json
+
+    path = handoff_path(journal_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def read_handoff_manifest(journal_dir: str) -> dict | None:
+    import json
+
+    try:
+        with open(handoff_path(journal_dir), encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def _journal_parts(journal_dir: str) -> list[str]:
+    """Journal files oldest-first (rotated ``.N`` parts then the live
+    file), so replay folds records in write order."""
+    main = journal_path(journal_dir)
+    parts = []
+    i = 1
+    while os.path.exists(f"{main}.{i}"):
+        parts.append(f"{main}.{i}")
+        i += 1
+    parts.reverse()
+    if os.path.exists(main):
+        parts.append(main)
+    return parts
+
+
+def replay(journal_dir: str, strict: bool = False) -> dict:
+    """Scan a journal directory into the structured replay state::
+
+        {"admitted":  {seq: admit record},
+         "completed": {seq: complete record},
+         "failed":    {seq: fail record},
+         "pending":   [admit records with no terminal record, seq-asc],
+         "deduped":   {seq: complete record of the SAME rdigest},
+         "by_rdigest": {rdigest: complete record},
+         "max_seq":   highest admitted seq (-1 when empty),
+         "corrupt":   torn/unparseable lines skipped (counted in
+                      raft_tpu_journal_corrupt_total{kind="serve"}),
+         "records":   parsed record count,
+         "handoff":   last handoff record or None}
+
+    A *pending* request whose ``rdigest`` matches an already-completed
+    one is a **dedupe hit**: it appears in ``deduped`` (mapped to the
+    completed record that already carries its result) instead of
+    ``pending`` — replay never solves the same physics twice.
+
+    Corruption is skip-and-count by default; ``strict=True`` raises a
+    typed :class:`raft_tpu.errors.JournalCorrupt` instead (integrity
+    audits, not the recovery path).
+    """
+    admitted: dict[int, dict] = {}
+    completed: dict[int, dict] = {}
+    failed: dict[int, dict] = {}
+    handoff = None
+    corrupt = 0
+    records = 0
+    for path in _journal_parts(journal_dir):
+        docs, bad = journalio.read_counted(path, kind="serve")
+        corrupt += bad
+        for doc in docs:
+            t = doc.get("type")
+            if t not in RECORD_TYPES:
+                corrupt += 1
+                journalio.count_corrupt("serve")
+                continue
+            records += 1
+            seq = doc.get("seq")
+            if t == "admit" and seq is not None:
+                admitted[int(seq)] = doc
+            elif t == "complete" and seq is not None:
+                completed[int(seq)] = doc
+            elif t == "fail" and seq is not None:
+                failed[int(seq)] = doc
+            elif t == "handoff":
+                handoff = doc
+    if strict and corrupt:
+        raise errors.JournalCorrupt(
+            "serve journal carries corrupt records",
+            journal_dir=str(journal_dir), corrupt=corrupt)
+    by_rdigest = {}
+    for rec in completed.values():
+        if rec.get("rdigest"):
+            by_rdigest[rec["rdigest"]] = rec
+    pending = []
+    deduped = {}
+    for seq in sorted(admitted):
+        if seq in completed or seq in failed:
+            continue
+        rec = admitted[seq]
+        prior = by_rdigest.get(rec.get("rdigest"))
+        if prior is not None:
+            deduped[seq] = prior
+        else:
+            pending.append(rec)
+    return {"admitted": admitted, "completed": completed,
+            "failed": failed, "pending": pending, "deduped": deduped,
+            "by_rdigest": by_rdigest,
+            "max_seq": max(admitted) if admitted else -1,
+            "corrupt": corrupt, "records": records, "handoff": handoff}

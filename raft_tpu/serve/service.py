@@ -29,6 +29,21 @@ and degrading gracefully* under sustained, partially-faulty traffic:
   service ``full -> no_qtf -> coarse -> reject`` (and back up when
   healthy); every transition is a flight-recorder event, a metric, and
   a manifest record.
+- **Durability** — with ``ServeConfig.journal_dir`` set, every
+  admission, batch assignment, typed failure, and result digest is
+  appended to a crash-safe write-ahead journal
+  (:mod:`raft_tpu.serve.journal`) *before* it is acknowledged;
+  :meth:`SweepService.recover` replays it after a crash (re-admitting
+  accepted-but-unfinished requests under their original seqs, marking
+  completed digests fetchable without re-solving, deduping duplicate
+  submissions by content digest) and :meth:`SweepService.drain` hands
+  a live service off to a successor with every in-flight request
+  either completed or journaled as pending — never dropped.
+- **Multi-tenant warm runners** — several models share the device
+  behind one service (:mod:`raft_tpu.serve.tenancy`): requests name a
+  tenant, batches never mix tenants, and each tenant/mode's warm
+  compiled program is held under an LRU live-program budget with
+  journaled, metered eviction/re-warm.
 
 Results are delivered asynchronously: ``submit`` returns a
 :class:`Ticket`; each completed request carries the ledger-style
@@ -45,6 +60,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import threading
 import time
 import uuid
@@ -52,8 +68,10 @@ import uuid
 import numpy as np
 
 from raft_tpu import errors
+from raft_tpu.serve import journal as wal
 from raft_tpu.serve.config import MODES, ServeConfig
 from raft_tpu.serve.retry import RetryPolicy
+from raft_tpu.serve.tenancy import DEFAULT_TENANT, Tenant, TenantRegistry
 from raft_tpu.serve.watchdog import Watchdog
 from raft_tpu.utils.profiling import get_logger
 
@@ -76,6 +94,12 @@ class SweepResult:
     converged: bool | None = None
     quarantined: bool = False
     error: dict | None = None
+    tenant: str = DEFAULT_TENANT
+    #: how this result reached the caller: "solved" (this process ran
+    #: it), "replayed" (journal recovery re-solved it), "recovered"
+    #: (journaled result re-delivered without a solve), or "deduped"
+    #: (duplicate submission matched a completed request digest)
+    source: str = "solved"
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -109,11 +133,13 @@ class Ticket:
 class _Request:
     __slots__ = ("seq", "id", "Hs", "Tp", "beta", "deadline_ts",
                  "submitted_ts", "attempts", "total_attempts", "strikes",
-                 "solo", "not_before", "ticket")
+                 "solo", "not_before", "ticket", "tenant", "rdigest",
+                 "replayed")
 
-    def __init__(self, seq, Hs, Tp, beta, deadline_ts, now):
+    def __init__(self, seq, Hs, Tp, beta, deadline_ts, now,
+                 tenant=DEFAULT_TENANT, request_id=None):
         self.seq = int(seq)
-        self.id = f"req{seq}-{uuid.uuid4().hex[:8]}"
+        self.id = request_id or f"req{seq}-{uuid.uuid4().hex[:8]}"
         self.Hs = float(Hs)
         self.Tp = float(Tp)
         self.beta = float(beta)
@@ -124,6 +150,9 @@ class _Request:
         self.strikes = 0
         self.solo = False
         self.not_before = 0.0
+        self.tenant = str(tenant)
+        self.rdigest = wal.request_digest(Hs, Tp, beta, self.tenant)
+        self.replayed = False
         self.ticket = Ticket(self.id, self.seq)
 
 
@@ -137,11 +166,17 @@ class SweepService:
     second-order terms, and rungs with no model are skipped.
     ``runner_factory(mode, fowt, ncases, **solver_kw)`` overrides the
     batch engine (tests inject stubs; default is the warm
-    ``make_batch_runner``).
+    ``make_batch_runner``).  ``tenants`` adds further served models
+    (:class:`raft_tpu.serve.tenancy.Tenant` records) next to the
+    implicit ``default`` tenant built from ``fowt``; with
+    ``config.journal_dir`` set the service keeps a write-ahead request
+    journal and becomes crash-recoverable (:meth:`recover`) and
+    hand-off-able (:meth:`drain`).
     """
 
     def __init__(self, fowt=None, config: ServeConfig = None, *,
-                 degraded_fowts: dict = None, runner_factory=None):
+                 degraded_fowts: dict = None, runner_factory=None,
+                 tenants: list[Tenant] = None):
         self.cfg = config or ServeConfig()
         self.fowt = fowt
         self.retry = RetryPolicy.from_config(self.cfg)
@@ -156,10 +191,40 @@ class SweepService:
         #: service drained inside the pop->register window and a retry
         #: requeued after that leaves its ticket unresolved forever
         self._ngathered = 0
-        self._runners: dict[str, object] = {}
-        self._fowts = self._build_fowt_ladder(degraded_fowts or {})
+        # -- durability: the WAL opens with the service object, so a
+        # recover()/submit() before start() is journaled too.  _open
+        # tracks every admitted-but-unfinished request under its own
+        # leaf lock (never held while taking any other lock) so the
+        # journal's rotation checkpoint can snapshot it without a
+        # lock-order cycle against the serving paths
+        self._open_lock = threading.Lock()
+        self._open: dict[int, _Request] = {}
+        self._journal = None
+        if self.cfg.journal_dir:
+            self._journal = wal.RequestJournal(
+                self.cfg.journal_dir, run_id=uuid.uuid4().hex[:12],
+                snapshot_fn=self._journal_snapshot)
+        # -- tenancy: every model (including the single-model PR 9
+        # shape) lives in the registry as a tenant
+        self._tenants = TenantRegistry(self.cfg.max_live_programs,
+                                       journal=self._journal)
+        self._fowts = self._build_fowt_ladder(fowt, degraded_fowts or {})
+        self._tenants.add(DEFAULT_TENANT, self._fowts)
+        for t in (tenants or []):
+            if t.name == DEFAULT_TENANT:
+                raise errors.ModelConfigError(
+                    "tenant name 'default' is reserved for the "
+                    "service-level model", tenant=t.name)
+            self._tenants.add(t.name,
+                              self._build_fowt_ladder(
+                                  t.fowt, t.degraded_fowts or {}),
+                              t.solver_kw)
         self.ladder = tuple(m for m in MODES
                             if m in self._fowts or m == "reject")
+        self._recover_info = None
+        self._handoff_info = None
+        self._replayed_pending: set[int] = set()
+        self._successor = None
         self._mode_idx = 0
         self._mode_entered = time.monotonic()
         self._bad_streak = 0
@@ -187,15 +252,13 @@ class SweepService:
     # construction helpers
     # ------------------------------------------------------------------
 
-    def _build_fowt_ladder(self, degraded: dict) -> dict:
-        out = {"full": self.fowt}
+    def _build_fowt_ladder(self, fowt, degraded: dict) -> dict:
+        out = {"full": fowt}
         if "no_qtf" in degraded:
             out["no_qtf"] = degraded["no_qtf"]
-        elif self.fowt is not None and \
-                getattr(self.fowt, "potSecOrder", 0):
+        elif fowt is not None and getattr(fowt, "potSecOrder", 0):
             try:
-                out["no_qtf"] = dataclasses.replace(
-                    self.fowt, potSecOrder=0)
+                out["no_qtf"] = dataclasses.replace(fowt, potSecOrder=0)
             except (TypeError, ValueError):
                 pass                    # rung unavailable: skipped
         if "coarse" in degraded:
@@ -231,6 +294,8 @@ class SweepService:
             kind="serve",
             config={**self.cfg.scalars(),
                     "ladder": "->".join(self.ladder),
+                    "tenants": ",".join(self._tenants.names()),
+                    "journaled": self._journal is not None,
                     "nw": (len(self.fowt.w)
                            if self.fowt is not None else 0)})
         obs.record_build_info(run_id=self._manifest.run_id)
@@ -293,7 +358,227 @@ class SweepService:
             self._manifest.extra["retry_matrix"] = self.retry.matrix()
             obs.finish_run(self._manifest, status="ok")
             self._manifest = None
+        if self._journal is not None:
+            self._journal.close()
         return summary
+
+    # ------------------------------------------------------------------
+    # durability: crash recovery + graceful handoff
+    # ------------------------------------------------------------------
+
+    def _journal_snapshot(self) -> list[dict]:
+        """Admit records of every still-open request — what the WAL
+        re-appends into a fresh part on size rotation, so an open
+        request's admission can never age out with a dropped part."""
+        with self._open_lock:
+            reqs = list(self._open.values())
+        now = time.monotonic()
+        return [{"t": round(time.time(), 6), "type": "admit",
+                 "seq": r.seq, "id": r.id, "rdigest": r.rdigest,
+                 "Hs": r.Hs, "Tp": r.Tp, "beta": r.beta,
+                 "deadline_s": max(0.0, r.deadline_ts - now),
+                 "tenant": r.tenant, "checkpoint": True}
+                for r in reqs]
+
+    def _track_open(self, r: _Request):
+        with self._open_lock:
+            self._open[r.seq] = r
+
+    def _untrack_open(self, seq: int):
+        with self._open_lock:
+            self._open.pop(seq, None)
+
+    def recover(self, journal_dir: str = None) -> dict:
+        """Replay a write-ahead journal into this (fresh) service.
+
+        Scans ``journal_dir`` (default: the configured
+        ``cfg.journal_dir``) and
+
+        - marks every journaled **completed** result fetchable by its
+          ledger digest without re-solving (``recovered``),
+        - re-admits every **accepted-but-unfinished** request under its
+          *original admission seq* — so the deterministic retry/backoff
+          keys (``req<seq>``) line up with the crashed process —
+          returning fresh tickets for them (``replayed``),
+        - resolves **duplicate submissions** whose request digest
+          matches an already-completed one from the journal instead of
+          re-solving (``deduped``), journaling the dedupe as a
+          ``complete`` record so the *next* replay is idempotent too,
+        - **skips** torn/corrupt lines, counted in
+          ``raft_tpu_journal_corrupt_total{kind="serve"}``.
+
+        Returns ``{"recovered", "replayed", "deduped", "corrupt",
+        "tickets": {seq: Ticket}}``; the accounting is also emitted to
+        the flight recorder (``journal_recovered``), the
+        ``raft_tpu_serve_journal_replayed_total{outcome}`` metric, the
+        service summary/manifest, and appended to the journal as a
+        ``recover`` record.  Call before or just after :meth:`start`,
+        on a service pointed at the dead process's journal directory.
+        """
+        obs = self._obs()
+        src = journal_dir or self.cfg.journal_dir
+        if not src:
+            raise errors.ModelConfigError(
+                "recover() needs a journal directory (config "
+                "journal_dir or the journal_dir argument)")
+        state = wal.replay(src)
+        now = time.monotonic()
+        tickets: dict[int, Ticket] = {}
+        recovered = replayed = deduped = 0
+        with self._cond:
+            for seq, rec in sorted(state["completed"].items()):
+                res = SweepResult(
+                    ok=True, request_id=str(rec.get("id") or f"req{seq}"),
+                    seq=int(seq), mode=str(rec.get("mode", "full")),
+                    attempts=int(rec.get("attempts", 0)), latency_s=0.0,
+                    digest=rec.get("digest"), std=rec.get("std"),
+                    iters=rec.get("iters"), converged=rec.get("converged"),
+                    tenant=str(state["admitted"].get(seq, {}).get(
+                        "tenant", DEFAULT_TENANT)), source="recovered")
+                if rec.get("digest"):
+                    self._delivered[rec["digest"]] = res
+                    recovered += 1
+            while len(self._delivered) > self.cfg.result_cache:
+                self._delivered.popitem(last=False)
+            for seq, prior in sorted(state["deduped"].items()):
+                # the duplicate's physics already solved: deliver the
+                # journaled payload under the duplicate's seq and make
+                # it terminal in the WAL
+                dup = state["admitted"][seq]
+                res = SweepResult(
+                    ok=True, request_id=str(dup.get("id") or f"req{seq}"),
+                    seq=int(seq), mode=str(prior.get("mode", "full")),
+                    attempts=0, latency_s=0.0, digest=prior.get("digest"),
+                    std=prior.get("std"), iters=prior.get("iters"),
+                    converged=prior.get("converged"),
+                    tenant=str(dup.get("tenant", DEFAULT_TENANT)),
+                    source="deduped")
+                if self._journal is not None:
+                    self._journal.record_complete(
+                        seq, dup.get("rdigest"), prior.get("digest"),
+                        res.mode, 0, res.std or [], res.iters or 0,
+                        bool(res.converged))
+                t = Ticket(res.request_id, int(seq))
+                t._finish(res)
+                tickets[int(seq)] = t
+                deduped += 1
+            for rec in state["pending"]:
+                seq = int(rec["seq"])
+                tenant = str(rec.get("tenant", DEFAULT_TENANT))
+                req = _Request(seq, rec.get("Hs", 0.0),
+                               rec.get("Tp", 1.0), rec.get("beta", 0.0),
+                               now + float(rec.get("deadline_s",
+                                                   self.cfg.deadline_s)),
+                               now, tenant=tenant,
+                               request_id=rec.get("id"))
+                req.replayed = True
+                tickets[seq] = req.ticket
+                if tenant not in self._tenants.names():
+                    # the successor was configured without this tenant:
+                    # a typed failure, never a silent drop
+                    self._counts["admitted"] += 1
+                    replayed += 1
+                    self._replayed_pending.add(seq)
+                    self._fail(req, errors.ModelConfigError(
+                        "replayed request names a tenant this service "
+                        "does not carry", tenant=tenant, seq=seq))
+                    continue
+                self._queue.append(req)
+                self._counts["admitted"] += 1
+                self._replayed_pending.add(seq)
+                self._track_open(req)
+                replayed += 1
+            # preserve the crashed process's seq space so new
+            # admissions and replayed backoff keys can never collide
+            self._seq = max(self._seq, state["max_seq"] + 1)
+            self._cond.notify_all()
+        info = {"recovered": recovered, "replayed": replayed,
+                "deduped": deduped, "corrupt": int(state["corrupt"])}
+        self._recover_info = {**info, "journal_dir": str(src),
+                              "records": int(state["records"])}
+        for outcome, n in info.items():
+            if n:
+                obs.counter(
+                    "raft_tpu_serve_journal_replayed_total",
+                    "journal replay outcomes of SweepService.recover"
+                    ).inc(float(n), outcome=outcome)
+        if self._journal is not None:
+            self._journal.record_recover(info)
+        self._emit("journal_recovered", **info)
+        _LOG.info("serve: journal recovery — %d result(s) restored, "
+                  "%d request(s) re-admitted, %d deduped, %d corrupt "
+                  "line(s) skipped", recovered, replayed, deduped,
+                  state["corrupt"])
+        return {**info, "tickets": tickets}
+
+    def drain(self, successor: str = None, timeout: float = 30.0) -> dict:
+        """Gracefully hand the service off: stop admitting (callers get
+        429/``AdmissionRejected`` with ``successor`` in the context and
+        Retry-After pointing at the handoff), flush in-flight batches
+        for up to ``timeout`` seconds, journal whatever could not
+        finish as handoff-pending (their live tickets resolve as typed
+        ``DeadlineExceeded`` failures with ``handoff=True`` — the WAL
+        keeps them *pending* so the successor re-solves them), and
+        write the ``handoff.json`` manifest naming the exec-cache keys
+        a successor warm-starts from.  Returns the handoff manifest."""
+        obs = self._obs()
+        with self._cond:
+            already = self._state in ("draining", "stopped")
+            self._successor = successor or self._successor
+            if not already:
+                self._state = "draining"
+                self._cond.notify_all()
+        self._emit("drain_begin", successor=successor)
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = (not self._queue and not self._inflight
+                        and self._ngathered == 0)
+            if idle:
+                break
+            time.sleep(0.02)
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            for b in self._inflight.values():
+                leftovers.extend(r for r in b["reqs"]
+                                 if not r.ticket.done())
+            self._cond.notify_all()
+        pending = sorted({r.seq for r in leftovers})
+        for r in leftovers:
+            if not r.ticket.done():
+                self._fail(r, errors.DeadlineExceeded(
+                    "request handed off to successor", req=r.seq,
+                    handoff=True, successor=successor), journal=False)
+        exec_keys = self._tenants.exec_keys()
+        doc = {"schema": "raft_tpu.serve.handoff/v1",
+               "t": time.time(),
+               "run_id": (self._manifest.run_id
+                          if self._manifest is not None else None),
+               "pending": pending,
+               "next_seq": self._seq,
+               "successor": successor,
+               "exec_keys": exec_keys,
+               "tenants": self._tenants.names(),
+               "config": self.cfg.scalars()}
+        if self._journal is not None:
+            self._journal.record_handoff(pending, exec_keys, self._seq,
+                                         successor)
+            wal.write_handoff_manifest(self.cfg.journal_dir, doc)
+        self._handoff_info = {"pending": len(pending),
+                              "successor": successor,
+                              "exec_keys": len(exec_keys)}
+        obs.counter("raft_tpu_serve_handoffs_total",
+                    "graceful drain/handoff completions").inc(1.0)
+        self._emit("handoff", pending=len(pending), successor=successor,
+                   exec_keys=list(exec_keys))
+        _LOG.info("serve: drained — %d request(s) handed off pending, "
+                  "%d warm exec-cache key(s) named for the successor",
+                  len(pending), len(exec_keys))
+        # teardown (worker join, watchdog stop, manifest -> trend
+        # store); the queue is already flushed so the bound is short
+        self.stop(drain=False, timeout=5.0)
+        return doc
 
     # ------------------------------------------------------------------
     # admission
@@ -308,19 +593,26 @@ class SweepService:
         return batches_ahead * per_batch + self.cfg.window_s
 
     def submit(self, Hs: float, Tp: float, heading_rad: float,
-               deadline_s: float = None) -> Ticket:
+               deadline_s: float = None,
+               tenant: str = DEFAULT_TENANT) -> Ticket:
         """Admit one case request; returns its :class:`Ticket`.
 
         Raises :class:`~raft_tpu.errors.AdmissionRejected` (with a
-        ``retry_after_s`` hint) when the queue watermark, deadline
+        ``retry_after_s`` hint, plus a ``successor`` pointer while
+        draining for a handoff) when the queue watermark, deadline
         pressure, the ``reject`` degradation mode, or shutdown forbids
-        admission."""
+        admission; an unknown ``tenant`` is a typed
+        :class:`~raft_tpu.errors.ModelConfigError`.  With a journal
+        configured the admission is written to the WAL *before* the
+        ticket is returned — an accepted request survives a crash."""
         obs = self._obs()
+        tenant = self._tenants.require(tenant)
         now = time.monotonic()
         deadline_s = float(deadline_s if deadline_s is not None
                            else self.cfg.deadline_s)
         with self._cond:
             retry_after = self._estimate_wait_locked()
+            successor = self._successor
             reason = None
             if self._state in ("draining", "stopped"):
                 reason = "stopped"
@@ -338,7 +630,7 @@ class SweepService:
                 seq = self._seq
                 self._seq += 1
                 req = _Request(seq, Hs, Tp, heading_rad,
-                               now + deadline_s, now)
+                               now + deadline_s, now, tenant=tenant)
                 self._queue.append(req)
                 self._counts["admitted"] += 1
                 depth = len(self._queue)
@@ -347,16 +639,29 @@ class SweepService:
                   "requests queued (not in flight) in the sweep "
                   "service").set(float(depth))
         if reason is not None:
+            self._tenants.count(tenant, "rejected")
             obs.counter(
                 "raft_tpu_serve_admission_rejects_total",
                 "requests shed at admission, by reason").inc(
                     1.0, reason=reason)
             self._emit("admission_reject", reason=reason,
                        retry_after_s=retry_after, queue_depth=depth)
+            ctx = {"reason": reason, "queue_depth": depth}
+            if reason == "stopped" and successor:
+                # the load-shed hint names who IS serving: a draining
+                # process points its callers at the successor
+                ctx["successor"] = successor
             raise errors.AdmissionRejected(
                 f"admission rejected ({reason})",
-                retry_after_s=retry_after, reason=reason,
-                queue_depth=depth)
+                retry_after_s=retry_after, **ctx)
+        # WAL before ack: the journal line hits disk before the caller
+        # holds a ticket, so an accepted request can never be lost
+        self._track_open(req)
+        if self._journal is not None:
+            self._journal.record_admit(
+                req.seq, req.id, req.rdigest, req.Hs, req.Tp, req.beta,
+                deadline_s, tenant)
+        self._tenants.count(tenant, "admitted")
         obs.counter("raft_tpu_serve_requests_total",
                     "request admissions/outcomes of the sweep service"
                     ).inc(1.0, outcome="admitted")
@@ -366,9 +671,11 @@ class SweepService:
     # worker: gather -> solve -> split
     # ------------------------------------------------------------------
 
-    def _pop_ready_locked(self, now: float, solo_ok: bool = True):
+    def _pop_ready_locked(self, now: float, solo_ok: bool = True,
+                          tenant: str = None):
         for i, r in enumerate(self._queue):
-            if r.not_before <= now and (solo_ok or not r.solo):
+            if r.not_before <= now and (solo_ok or not r.solo) \
+                    and (tenant is None or r.tenant == tenant):
                 del self._queue[i]
                 return r
         return None
@@ -426,7 +733,10 @@ class SweepService:
             while len(batch) < self.cfg.batch_cases:
                 now = time.monotonic()
                 with self._cond:
-                    r = self._pop_ready_locked(now, solo_ok=False)
+                    # batches never mix tenants: one warm program, one
+                    # model, one device execution
+                    r = self._pop_ready_locked(now, solo_ok=False,
+                                               tenant=first.tenant)
                     if r is not None:
                         self._ngathered += 1
                     elif now >= window_end:
@@ -445,24 +755,22 @@ class SweepService:
         with self._lock:
             self._ngathered = max(0, self._ngathered - n)
 
-    def _ensure_runner(self, mode: str):
-        runner = self._runners.get(mode)
-        if runner is not None:
-            return runner
-        fowt = self._fowts.get(mode)
-        if self._runner_factory is not None:
-            runner = self._runner_factory(mode, fowt,
-                                          self.cfg.batch_cases,
-                                          **self.cfg.solver_kw())
-        else:
+    def _ensure_runner(self, mode: str, tenant: str = DEFAULT_TENANT):
+        rmode = self._tenants.resolve_mode(tenant, mode)
+
+        def build(fowt, tenant_kw):
+            kw = {**self.cfg.solver_kw(), **tenant_kw}
+            if self._runner_factory is not None:
+                return self._runner_factory(rmode, fowt,
+                                            self.cfg.batch_cases, **kw)
             if fowt is None:
                 raise errors.ModelConfigError(
-                    "no model available for service mode", mode=mode)
+                    "no model available for service mode", mode=rmode,
+                    tenant=tenant)
             from raft_tpu.parallel.sweep import make_batch_runner
-            runner = make_batch_runner(fowt, self.cfg.batch_cases,
-                                       **self.cfg.solver_kw())
-        self._runners[mode] = runner
-        return runner
+            return make_batch_runner(fowt, self.cfg.batch_cases, **kw)
+
+        return self._tenants.runner(tenant, rmode, build)
 
     def _solve_mode_locked(self) -> str:
         mode = self.ladder[self._mode_idx]
@@ -478,6 +786,7 @@ class SweepService:
         from raft_tpu.testing import faults
 
         cfg = self.cfg
+        tenant = batch[0].tenant
         t0 = time.monotonic()
         with self._lock:
             solve_mode = self._solve_mode_locked()
@@ -487,19 +796,32 @@ class SweepService:
             self._inflight[batch_id] = binfo
             # the gathered requests are now visible as in-flight state
             self._ngathered = max(0, self._ngathered - len(batch))
+        if self._journal is not None:
+            self._journal.record_batch(batch_id,
+                                       [r.seq for r in batch],
+                                       solve_mode, tenant)
         wid = None
         try:
-            runner = self._ensure_runner(solve_mode)
+            runner = self._ensure_runner(solve_mode, tenant)
+            # the watchdog deadline covers the SOLVE: a cold runner
+            # build (trace/compile or exec-cache deserialize) above may
+            # legitimately take longer than batch_deadline_s and must
+            # not pre-expire the batch it is about to serve
             wid = self._watchdog.arm(
-                t0 + cfg.batch_deadline_s,
+                time.monotonic() + cfg.batch_deadline_s,
                 lambda: self._abandon_batch(batch_id))
             # -- injection seam (pre-solve): a hang stalls THIS worker
             # with the watchdog armed — exactly what a wedged device
-            # looks like from the host
+            # looks like from the host; a kill IS the crash mid-batch
+            # the write-ahead journal exists for
             for r in batch:
                 f = faults.fire_info("serve", req=r.seq)
                 if f is not None:
-                    if f["action"] == "hang":
+                    if f["action"] == "kill":
+                        _LOG.warning("serve: injected kill at req %d "
+                                     "(os._exit)", r.seq)
+                        os._exit(137)
+                    elif f["action"] == "hang":
                         time.sleep(float(f.get("hang_s", 30.0)))
                     elif f["action"] == "raise":
                         raise errors.KernelFailure(
@@ -538,6 +860,13 @@ class SweepService:
             # -- injection seam (post-solve, per lane): the dynamics /
             # sweep-lane fault sites poison or fail single requests
             for i, r in enumerate(batch):
+                if r.ticket.done():
+                    # already resolved out-of-band (a drain handed it
+                    # off while this solve ran): discard the late
+                    # result — the WAL keeps it pending for the
+                    # successor, and the delivered ticket must never
+                    # flip state
+                    continue
                 action = (faults.fire("dynamics", case=r.seq)
                           or faults.fire("sweep", lane=r.seq))
                 if action == "nan":
@@ -683,7 +1012,7 @@ class SweepService:
 
     def _result_base(self, r: _Request, mode: str) -> dict:
         return {"request_id": r.id, "seq": r.seq, "mode": mode,
-                "attempts": r.total_attempts,
+                "attempts": r.total_attempts, "tenant": r.tenant,
                 "latency_s": time.monotonic() - r.submitted_ts}
 
     def _complete(self, r: _Request, std_row, iters: int,
@@ -695,7 +1024,15 @@ class SweepService:
         res = SweepResult(ok=True, digest=digest,
                           std=[float(v) for v in std_row],
                           iters=int(iters), converged=bool(converged),
+                          source="replayed" if r.replayed else "solved",
                           **self._result_base(r, mode))
+        # WAL before ack: the result (digest + payload) is durable
+        # before the ticket resolves — a crash after this line loses
+        # nothing, a crash before it re-solves deterministically
+        if self._journal is not None:
+            self._journal.record_complete(
+                r.seq, r.rdigest, digest, mode, r.total_attempts,
+                res.std, res.iters, res.converged)
         with self._lock:
             self._counts["completed"] += 1
             if r.total_attempts:
@@ -704,6 +1041,9 @@ class SweepService:
             self._delivered[digest] = res
             while len(self._delivered) > self.cfg.result_cache:
                 self._delivered.popitem(last=False)
+            self._replayed_pending.discard(r.seq)
+        self._untrack_open(r.seq)
+        self._tenants.count(r.tenant, "completed")
         obs.counter("raft_tpu_serve_requests_total",
                     "request admissions/outcomes of the sweep service"
                     ).inc(1.0, outcome="ok")
@@ -717,17 +1057,28 @@ class SweepService:
         r.ticket._finish(res)
 
     def _fail(self, r: _Request, e: BaseException,
-              quarantined: bool = False):
+              quarantined: bool = False, journal: bool = True):
         obs = self._obs()
         ctx = (e.context() if isinstance(e, errors.RaftError)
                else {"error": type(e).__name__, "message": str(e)})
         res = SweepResult(ok=False, quarantined=quarantined, error=ctx,
                           **self._result_base(
                               r, self.ladder[self._mode_idx]))
+        # ``journal=False`` is the handoff path: the request must STAY
+        # pending in the WAL so the successor re-solves it
+        if journal and self._journal is not None:
+            self._journal.record_fail(r.seq, r.rdigest, ctx, quarantined)
         with self._lock:
             self._counts["failed"] += 1
             if quarantined:
                 self._counts["quarantined"] += 1
+            self._replayed_pending.discard(r.seq)
+        if journal:
+            # the handoff path (journal=False) keeps the request OPEN:
+            # it must stay in rotation checkpoints until the journal
+            # closes, exactly like it stays pending in the WAL
+            self._untrack_open(r.seq)
+        self._tenants.count(r.tenant, "failed")
         outcome = "quarantined" if quarantined else "failed"
         obs.counter("raft_tpu_serve_requests_total",
                     "request admissions/outcomes of the sweep service"
@@ -810,15 +1161,23 @@ class SweepService:
 
     def summary(self) -> dict:
         """Flat serve facts (manifest ``extra["serve"]`` -> trend row)."""
+        tenancy = self._tenants.facts()
         with self._lock:
             counts = dict(self._counts)
             lat = list(self._latencies)
             transitions = list(self._transitions)
             mode = self.ladder[self._mode_idx]
-            runners = {m: getattr(r, "cache_state", "n/a")
-                       for m, r in self._runners.items()}
             ema = self._ema_batch_s
-        return {
+            recover_info = (dict(self._recover_info)
+                            if self._recover_info else None)
+            handoff_info = (dict(self._handoff_info)
+                            if self._handoff_info else None)
+            replayed_open = len(self._replayed_pending)
+        runners = {}
+        for name, t in tenancy["tenants"].items():
+            for live in t.get("live", []):
+                runners[f"{name}/{live['mode']}"] = live["cache"]
+        out = {
             **counts,
             "requests": counts["admitted"] + counts["rejected"],
             "mode": mode,
@@ -828,4 +1187,28 @@ class SweepService:
             "p99_latency_s": self._percentile(lat, 99),
             "ema_batch_s": ema,
             "exec_cache": runners,
+            "tenancy": tenancy,
+            "tenant_evictions": tenancy["evictions"],
+            "tenant_rewarms": tenancy["rewarms"],
         }
+        if self._journal is not None:
+            out["journal"] = {"path": self._journal.path,
+                              "errors": self._journal.errors}
+            out["journal_errors"] = self._journal.errors
+        if handoff_info:
+            out["handoff"] = handoff_info
+            out["handoff_pending"] = handoff_info["pending"]
+        if recover_info:
+            # restart facts exist ONLY on recovered services, so the
+            # SLO rules gating them skip every ordinary serve row
+            out["recovery"] = recover_info
+            out["replayed"] = recover_info["replayed"]
+            out["recovered_results"] = recover_info["recovered"]
+            out["deduped"] = recover_info["deduped"]
+            # replayed requests that never reached a terminal state
+            # (handed-off ones resolved typed and stay pending in the
+            # WAL): MUST be zero — the no-silent-drop gate
+            out["replayed_lost_count"] = replayed_open
+            out["restart_warm_start"] = int(
+                any(c == "hit" for c in runners.values()))
+        return out

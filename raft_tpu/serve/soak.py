@@ -20,11 +20,22 @@ digest gate — the ladder is exercised by the unit tier instead
 (tests/test_serve.py) and any transition that does happen is recorded
 in the report.
 
-Used by ``tools/raftserve.py soak`` (the CI chaos step) and
-``tests/test_serve.py``.
+The **kill-restart** soak (:func:`run_kill_restart`) extends the proof
+to the durability layer: a subprocess service with a write-ahead
+journal is hard-killed (``kill@serve`` -> ``os._exit``) mid-batch, the
+harness restarts against the same journal directory via
+``SweepService.recover()``, and the verdict requires zero accepted
+requests lost, a warm start from the executable cache, and every
+completed request digest-identical to an uninterrupted clean run.
+
+Used by ``tools/raftserve.py soak [--kill-restart]`` (the CI chaos
+steps) and ``tests/test_serve.py`` / ``tests/test_serve_durability.py``.
 """
 from __future__ import annotations
 
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -201,4 +212,190 @@ def run_soak(fowt, *, coarse_fowt=None, config: ServeConfig = None,
         len(failures), rejected, chaos_summary["retries"],
         chaos_summary["retried_recovered"],
         chaos_summary["deadline_misses"], wall_s)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# kill-restart soak: the durability acceptance harness
+# ---------------------------------------------------------------------------
+
+def build_fowt(design: str, min_freq: float = 0.05,
+               max_freq: float = 0.5, dfreq: float = 0.05):
+    """The soak's model builder — shared by the parent harness, the
+    killed child, and the raftserve CLI so every phase solves the
+    identical physics."""
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.models.fowt import build_fowt as _build
+
+    d = load_design(design)
+    w = np.arange(min_freq, max_freq, dfreq) * 2.0 * np.pi
+    return _build(d, w, depth=float(d["site"]["water_depth"]))
+
+
+def kill_child_main(spec_json: str):
+    """Entry point of the to-be-killed phase (run in a subprocess by
+    :func:`run_kill_restart`): admit every request into a journaled
+    service, then start it with ``kill@serve`` armed — the process
+    hard-exits (``os._exit(137)``) mid-batch with accepted requests on
+    the books.  Reaching the end of this function means the kill never
+    fired; exit 3 tells the harness so."""
+    import json
+
+    from raft_tpu.testing import faults
+
+    spec = json.loads(spec_json)
+    fowt = build_fowt(spec["design"], spec["min_freq"],
+                      spec["max_freq"], spec["dfreq"])
+    faults.install(spec["kill_spec"])
+    cfg = default_config(batch_cases=spec["batch_cases"],
+                         queue_max=spec["n_requests"],
+                         journal_dir=spec["journal_dir"])
+    Hs, Tp, beta = case_table(spec["n_requests"], seed=spec["seed"])
+    svc = SweepService(fowt, cfg)
+    tickets = [svc.submit(Hs[i], Tp[i], beta[i])
+               for i in range(spec["n_requests"])]
+    svc.start()
+    for t in tickets:
+        t.result(float(spec.get("timeout_s", 300.0)))
+    svc.stop()
+    sys.exit(3)                          # kill fault never fired
+
+
+def run_kill_restart(design: str = "Vertical_cylinder", *,
+                     journal_dir: str, min_freq: float = 0.05,
+                     max_freq: float = 0.5, dfreq: float = 0.05,
+                     n_requests: int = 10, kill_at: int = 6,
+                     batch_cases: int = 4, seed: int = 2026,
+                     timeout_s: float = 600.0) -> dict:
+    """The ISSUE-acceptance durability soak, three phases:
+
+    1. **clean** (in-process, no faults, no journal): the reference
+       digests of all ``n_requests`` requests — also warms the
+       executable cache the later phases deserialize from.
+    2. **kill** (subprocess): a journaled service admits every request,
+       then ``kill@serve:req=<kill_at>`` hard-exits it mid-batch
+       (``os._exit(137)`` — the SIGKILL-equivalent no handler sees).
+    3. **recover** (in-process): a successor on the *same journal
+       directory* replays the WAL — completed results restored without
+       re-solving, unfinished requests re-admitted under their original
+       seqs — then drains gracefully, writing the handoff manifest.
+
+    The verdict (``report["ok"]``) requires: the child actually died by
+    the injected kill; **zero accepted requests lost** (every admitted
+    seq reaches a terminal ``complete`` record in the final journal);
+    every completed digest **identical** to the uninterrupted clean
+    run; zero unhandled errors; and no replayed request left open
+    (``replayed_lost_count == 0``)."""
+    import json
+
+    from raft_tpu.serve import journal as wal
+    from raft_tpu.testing import faults
+
+    t0 = time.monotonic()
+    # the child runs with its own cwd — a relative journal dir MUST
+    # resolve to the same place in every phase
+    journal_dir = os.path.abspath(journal_dir)
+    fowt = build_fowt(design, min_freq, max_freq, dfreq)
+    rows = case_table(n_requests, seed=seed)
+
+    # -- phase 1: clean reference digests (warms the exec cache) ------
+    faults.install("")
+    clean_cfg = default_config(batch_cases=batch_cases,
+                               queue_max=n_requests)
+    svc = SweepService(fowt, clean_cfg)
+    clean_results, _ = _run_all(svc, rows, timeout_s)
+    svc.stop()
+    clean_digests = {seq: r.digest for seq, r in clean_results.items()
+                     if r.ok}
+    if len(clean_digests) != n_requests:
+        raise errors.KernelFailure(
+            "kill-restart soak clean pass failed",
+            completed=len(clean_digests), expected=n_requests)
+
+    # -- phase 2: the killed child ------------------------------------
+    spec = {"design": design, "min_freq": min_freq,
+            "max_freq": max_freq, "dfreq": dfreq,
+            "n_requests": n_requests, "batch_cases": batch_cases,
+            "seed": seed, "journal_dir": str(journal_dir),
+            "kill_spec": f"kill@serve:req={int(kill_at)}",
+            "timeout_s": timeout_s}
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = {**os.environ, "RAFT_TPU_FAULTS": ""}
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    child = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from raft_tpu.serve import soak; "
+         "soak.kill_child_main(sys.argv[1])", json.dumps(spec)],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    killed = child.returncode == 137
+    if not killed:
+        _LOG.error("kill-restart soak: child exited %d, not the "
+                   "injected kill\nstderr tail:\n%s", child.returncode,
+                   "\n".join(child.stderr.splitlines()[-15:]))
+
+    mid = wal.replay(journal_dir)
+    pre_kill_completed = len(mid["completed"])
+
+    # -- phase 3: the successor recovers the same journal dir ---------
+    faults.install("")
+    try:
+        cfg = default_config(batch_cases=batch_cases,
+                             queue_max=n_requests,
+                             journal_dir=str(journal_dir))
+        svc = SweepService(fowt, cfg)
+        info = svc.recover()
+        svc.start()
+        replay_results = {}
+        deadline = time.monotonic() + timeout_s
+        for seq, t in sorted(info["tickets"].items()):
+            replay_results[seq] = t.result(
+                max(0.5, deadline - time.monotonic()))
+        handoff = svc.drain()
+        summary = svc.summary()
+    finally:
+        faults.clear()
+
+    # -- verdict ------------------------------------------------------
+    final = wal.replay(journal_dir)
+    mismatches = []
+    for seq in range(n_requests):
+        rec = final["completed"].get(seq)
+        got = rec.get("digest") if rec else None
+        if got != clean_digests.get(seq):
+            mismatches.append({"seq": seq, "clean": clean_digests.get(seq),
+                               "final": got})
+    lost = sorted(set(range(n_requests)) - set(final["completed"])
+                  - set(final["failed"]))
+    warm = int(summary.get("restart_warm_start", 0))
+    report = {
+        "n_requests": n_requests,
+        "kill_spec": spec["kill_spec"],
+        "killed": killed,
+        "child_rc": child.returncode,
+        "pre_kill_completed": pre_kill_completed,
+        "recover": {k: info[k] for k in
+                    ("recovered", "replayed", "deduped", "corrupt")},
+        "replayed_ok": sum(1 for r in replay_results.values() if r.ok),
+        "lost": lost,
+        "digest_mismatches": mismatches,
+        "restart_warm_start": warm,
+        "replayed_lost_count": summary.get("replayed_lost_count"),
+        "handoff": handoff,
+        "summary": summary,
+        "wall_s": time.monotonic() - t0,
+        "ok": (killed and not lost and not mismatches
+               and summary.get("unhandled", 0) == 0
+               and summary.get("replayed_lost_count") == 0
+               and final["failed"] == {}),
+    }
+    lvl = _LOG.info if report["ok"] else _LOG.error
+    lvl("kill-restart soak: %s — child rc=%d, %d completed pre-kill, "
+        "%d recovered / %d replayed / %d deduped, %d lost, %d digest "
+        "mismatch(es), warm_start=%d, %.1fs",
+        "OK" if report["ok"] else "FAILED", child.returncode,
+        pre_kill_completed, info["recovered"], info["replayed"],
+        info["deduped"], len(lost), len(mismatches), warm,
+        report["wall_s"])
     return report

@@ -16,13 +16,14 @@ kernel    ``ops.linalg.impedance_solve`` dispatch (trace time)
 sweep     ``parallel.sweep.sweep_cases`` after the batched solve
 exec_cache  ``parallel.exec_cache.load`` on the deserialized bytes
 serve     ``serve.service`` request worker (per-request, pre/post solve)
+journal   ``serve.journal`` write-ahead journal writes
 ========  ==========================================================
 
 Spec grammar (comma-separated specs)::
 
     RAFT_TPU_FAULTS="<action>@<site>[:qualifier]*[,...]"
 
-    action     nan | raise | corrupt | hang
+    action     nan | raise | corrupt | hang | kill | torn
     qualifier  case=N | lane=N | fowt=N | req=N | once | times=K
                | s=SECONDS | ms=MILLIS  (hang duration)
 
@@ -54,8 +55,9 @@ _FIRED: dict[tuple, int] = {}
 #: ambient matching context (case/fowt/lane) — host-single-threaded
 _CONTEXT: list[dict] = []
 
-_ACTIONS = ("nan", "raise", "corrupt", "hang")
-_SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache", "serve")
+_ACTIONS = ("nan", "raise", "corrupt", "hang", "kill", "torn")
+_SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache",
+          "serve", "journal")
 
 #: exception class raised per site for ``raise@<site>`` specs.  Site/
 #: action support: statics, dynamics, kernel take ``nan`` and ``raise``;
@@ -63,10 +65,14 @@ _SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache", "serve")
 #: as a KernelFailure, handled at the seam itself); exec_cache takes
 #: ``corrupt`` only — its load path must never raise, so a
 #: ``raise@exec_cache`` spec is rejected at parse time; serve (the
-#: request-worker seam in raft_tpu/serve/service.py) takes ``raise``
-#: and ``hang`` (``hang@serve:req=N:ms=400`` stalls the worker so the
+#: request-worker seam in raft_tpu/serve/service.py) takes ``raise``,
+#: ``hang`` (``hang@serve:req=N:ms=400`` stalls the worker so the
 #: deadline watchdog fires — the seam reads the duration from the
-#: matched fault's ``hang_s``).
+#: matched fault's ``hang_s``) and ``kill`` (``kill@serve:req=N``
+#: hard-exits the process mid-batch via ``os._exit`` — the crash the
+#: serve write-ahead journal recovers from); journal (the WAL write
+#: seam in raft_tpu/serve/journal.py) takes ``torn`` only (truncate
+#: the freshly-written record mid-line — the torn tail readers skip).
 _RAISES = {
     "statics": errors.StaticsDivergence,
     "dynamics": errors.DynamicsSingular,
@@ -76,7 +82,11 @@ _RAISES = {
 }
 
 #: (action, site) combinations with no seam behavior — dropped at parse
-#: time so a spec can never silently no-op while consuming fire budget
+#: time so a spec can never silently no-op while consuming fire budget.
+#: ``kill`` (hard ``os._exit`` mid-batch — the crash the write-ahead
+#: journal must survive) is a serve-only action, like ``hang``; ``torn``
+#: (truncate the last journal record mid-write) is journal-only, and
+#: the journal site takes nothing else.
 _UNSUPPORTED = {("raise", "exec_cache"), ("corrupt", "statics"),
                 ("corrupt", "dynamics"), ("corrupt", "kernel"),
                 ("corrupt", "sweep"), ("corrupt", "serve"),
@@ -85,6 +95,9 @@ _UNSUPPORTED = {("raise", "exec_cache"), ("corrupt", "statics"),
                 ("hang", "statics"), ("hang", "dynamics"),
                 ("hang", "kernel"), ("hang", "sweep"),
                 ("hang", "exec_cache")}
+_UNSUPPORTED |= {("kill", s) for s in _SITES if s != "serve"}
+_UNSUPPORTED |= {("torn", s) for s in _SITES if s != "journal"}
+_UNSUPPORTED |= {(a, "journal") for a in _ACTIONS if a != "torn"}
 
 #: default stall of a ``hang@serve`` spec without an ``s=``/``ms=``
 #: qualifier — long enough to trip any realistic watchdog deadline

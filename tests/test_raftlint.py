@@ -405,7 +405,8 @@ _RTL004_SERVE_OPTS = {"rtl004": {
     "except-sanctioned": ["raft_tpu/recovery.py",
                           "raft_tpu/testing/faults.py", "raft_tpu/obs",
                           "raft_tpu/serve/service.py",
-                          "raft_tpu/serve/watchdog.py"],
+                          "raft_tpu/serve/watchdog.py",
+                          "raft_tpu/serve/journal.py"],
 }}
 
 _SERVE_SEAM_SRC = """
@@ -451,6 +452,44 @@ def test_rtl004_serve_seams_sanctioned_pair(tmp_path):
     """, "RTL004", relname="raft_tpu/serve/watchdog.py",
                     options=_RTL004_SERVE_OPTS)
     assert rep3.findings == []
+
+
+_DURABILITY_SRC = """
+    from raft_tpu import errors
+
+    def scan(journal_dir, strict):
+        if strict:
+            raise errors.JournalCorrupt("torn records")     # typed: ok
+        raise RuntimeError("untyped corruption")            # finding
+
+    def write(rec, sink, count):
+        try:
+            sink.write(rec)
+        except Exception:           # WAL keep-alive seam
+            count()
+"""
+
+
+def test_rtl004_durability_modules_fixture_pair(tmp_path):
+    """serve/journal.py and serve/tenancy.py are solve-path modules:
+    the untyped raise fires in BOTH (journal corruption must be the
+    typed JournalCorrupt, tenancy misconfig ModelConfigError); the
+    WAL write seam's broad except is config-sanctioned in journal.py
+    only — in tenancy (or any other serve file) it fires."""
+    rep = lint_src(tmp_path, _DURABILITY_SRC, "RTL004",
+                   relname="raft_tpu/serve/tenancy.py",
+                   options=_RTL004_SERVE_OPTS)
+    msgs = [f.message for f in rep.findings]
+    assert len(msgs) == 2
+    assert any("raise RuntimeError" in m for m in msgs)
+    assert any("except" in m for m in msgs)
+    # identical file at the sanctioned journal seam: the broad except
+    # is silent, the raise discipline still fires
+    rep2 = lint_src(tmp_path, _DURABILITY_SRC, "RTL004",
+                    relname="raft_tpu/serve/journal.py",
+                    options=_RTL004_SERVE_OPTS)
+    assert len(rep2.findings) == 1
+    assert "raise RuntimeError" in rep2.findings[0].message
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,572 @@
+"""Durable serving (raft_tpu/serve journal + tenancy + handoff).
+
+Unit tier (stub batch engines, no solves): the shared crash-safe JSONL
+codec (obs/journalio), the kill/torn fault grammar, write-ahead journal
+record schema + replay classification, the ISSUE replay-idempotency
+matrix (completed digest / duplicate submission / accepted-unfinished /
+torn tail), WAL-before-ack ordering, seq preservation across recovery,
+graceful drain/handoff, and the multi-tenant warm-runner registry with
+LRU eviction.
+
+Integration tier (one coarse Vertical_cylinder model, subprocess): the
+ISSUE kill-restart acceptance — a journaled child service hard-killed
+mid-batch by ``kill@serve``, restarted via ``SweepService.recover()``
+on the same journal dir, with zero accepted requests lost, digests
+identical to an uninterrupted clean run, and a span-asserted warm start
+from the executable cache.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import errors, obs
+from raft_tpu.obs import journalio
+from raft_tpu.serve import ServeConfig, SweepService, Tenant
+from raft_tpu.serve import journal as wal
+from raft_tpu.serve.tenancy import TenantRegistry
+from raft_tpu.testing import faults
+
+
+def stub_factory(mode, fowt, ncases, **kw):
+    """Deterministic instant batch engine: std row = Hs replicated
+    (+ the tenant fowt's marker offset when one is handed in)."""
+    offset = float(getattr(fowt, "marker", 0.0) or 0.0)
+
+    def run(Hs, Tp, beta):
+        Hs = np.asarray(Hs)
+        return {"std": np.stack([np.full(6, float(h) + offset)
+                                 for h in Hs]),
+                "iters": np.full(len(Hs), 3),
+                "converged": np.ones(len(Hs), bool)}
+    run.ncases = ncases
+    run.cache_state = "stub"
+    return run
+
+
+def _cfg(tmp_path=None, **kw):
+    base = dict(queue_max=8, batch_cases=2, window_s=0.02,
+                batch_deadline_s=5.0, retry_base_s=0.01,
+                degrade_after=99)
+    if tmp_path is not None:
+        base["journal_dir"] = str(tmp_path)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# unit: the shared crash-safe JSONL codec
+# ---------------------------------------------------------------------------
+
+def test_journalio_flush_per_line_and_torn_tail(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    w = journalio.JsonlWriter(path, header=lambda part: {"type": "begin",
+                                                         "part": part})
+    w.write({"type": "rec", "n": 1})
+    w.write({"type": "rec", "n": 2})
+    # flush-per-line: the bytes are on disk NOW, before close
+    docs = journalio.read(path)
+    assert [d["type"] for d in docs] == ["begin", "rec", "rec"]
+    # a torn tail (crash mid-write) is skipped and COUNTED by kind
+    w.write({"type": "rec", "n": 3})
+    w.tear_tail()
+    w.close()
+    docs, bad = journalio.read_counted(path, kind="unittest")
+    assert [d.get("n") for d in docs] == [None, 1, 2]
+    assert bad == 1
+    snap = obs.snapshot()
+    series = snap["raft_tpu_journal_corrupt_total"]["series"]
+    assert any(s["labels"] == {"kind": "unittest"} and s["value"] == 1.0
+               for s in series)
+
+
+def test_journalio_size_rotation_with_part_headers(tmp_path):
+    path = str(tmp_path / "r.jsonl")
+    w = journalio.JsonlWriter(path, max_bytes=120, keep=2,
+                              header=lambda p: {"type": "begin",
+                                                "part": p})
+    for i in range(12):
+        w.write({"type": "rec", "n": i, "pad": "x" * 20})
+    w.close()
+    assert os.path.exists(path + ".1")
+    docs = journalio.read(path)
+    assert docs[0]["type"] == "begin" and docs[0]["part"] == w.part
+    # the case-journal metric migration: CaseJournal counts under
+    # kind="case" through the same shared counter
+    from raft_tpu import recovery
+    j = recovery.CaseJournal("k", base_dir=str(tmp_path))
+    j.store_case(0, {"x": 1})
+    with open(j._path(0), "wb") as f:
+        f.write(b"torn")
+    assert j.load_case(0) is None
+    series = obs.snapshot()["raft_tpu_journal_corrupt_total"]["series"]
+    assert any(s["labels"] == {"kind": "case"} for s in series)
+
+
+# ---------------------------------------------------------------------------
+# unit: kill/torn fault grammar
+# ---------------------------------------------------------------------------
+
+def test_faults_kill_and_torn_grammar():
+    specs = faults.parse(
+        "kill@serve:req=7,torn@journal:once,"           # supported
+        "kill@dynamics,torn@serve,nan@journal,"         # rejected
+        "hang@journal,corrupt@journal,kill@journal")    # rejected
+    assert [(f["action"], f["site"]) for f in specs] == \
+        [("kill", "serve"), ("torn", "journal")]
+    assert specs[0]["match"] == {"req": 7}
+    assert specs[1]["times"] == 1
+    faults.install("kill@serve:req=2,torn@journal:record=admit")
+    try:
+        assert faults.fire("serve", req=1) is None
+        assert faults.fire("serve", req=2) == "kill"
+        assert faults.fire("journal", record="complete") is None
+        assert faults.fire("journal", record="admit") == "torn"
+    finally:
+        faults.clear()
+
+
+def test_torn_journal_fault_tears_the_wal(tmp_path):
+    faults.install("torn@journal:record=complete:once")
+    try:
+        j = wal.RequestJournal(str(tmp_path), run_id="t")
+        j.record_admit(0, "req0", "sha256:r0", 1.0, 8.0, 0.0, 60.0,
+                       "default")
+        j.record_complete(0, "sha256:r0", "sha256:d0", "full", 0,
+                          [1.0] * 6, 3, True)
+        j.close()
+    finally:
+        faults.clear()
+    state = wal.replay(str(tmp_path))
+    # the complete record was torn mid-write: skipped, counted, and the
+    # request correctly classifies as still pending
+    assert state["corrupt"] == 1
+    assert [r["seq"] for r in state["pending"]] == [0]
+    assert state["completed"] == {}
+
+
+# ---------------------------------------------------------------------------
+# unit: WAL record schema + replay classification
+# ---------------------------------------------------------------------------
+
+def test_request_journal_records_and_replay(tmp_path):
+    j = wal.RequestJournal(str(tmp_path), run_id="r1")
+    rd = [wal.request_digest(1.0 + i, 8.0, 0.0) for i in range(4)]
+    for i in range(4):
+        j.record_admit(i, f"req{i}", rd[i], 1.0 + i, 8.0, 0.0, 60.0,
+                       "default")
+    j.record_batch(0, [0, 1], "full", "default")
+    j.record_complete(0, rd[0], "sha256:d0", "full", 0, [1.0] * 6, 3,
+                      True)
+    j.record_fail(1, rd[1], {"error": "NonFiniteResult"}, False)
+    j.record_tenant("evict", "default", "full")
+    j.record_handoff([2, 3], {"default/full": "k"}, 4, "succ")
+    j.close()
+    state = wal.replay(str(tmp_path))
+    assert set(state["admitted"]) == {0, 1, 2, 3}
+    assert list(state["completed"]) == [0]
+    assert list(state["failed"]) == [1]
+    assert [r["seq"] for r in state["pending"]] == [2, 3]
+    assert state["max_seq"] == 3 and state["corrupt"] == 0
+    assert state["handoff"]["pending"] == [2, 3]
+    assert state["by_rdigest"][rd[0]]["digest"] == "sha256:d0"
+
+
+def test_replay_strict_raises_typed_journal_corrupt(tmp_path):
+    j = wal.RequestJournal(str(tmp_path), run_id="r2")
+    j.record_admit(0, "req0", "sha256:x", 1.0, 8.0, 0.0, 60.0,
+                   "default")
+    j.close()
+    with open(wal.journal_path(str(tmp_path)), "ab") as f:
+        f.write(b'{"type":"admit","seq":1')          # torn tail
+    assert wal.replay(str(tmp_path))["corrupt"] == 1
+    with pytest.raises(errors.JournalCorrupt) as exc:
+        wal.replay(str(tmp_path), strict=True)
+    assert isinstance(exc.value, errors.CacheCorruption)
+    assert exc.value.ctx["corrupt"] == 1
+
+
+def test_rotation_checkpoints_open_admits(tmp_path, monkeypatch):
+    """Size rotation must never age out an open request's admit
+    record: each fresh part re-appends a checkpoint of still-open
+    admissions, so replay finds them however much traffic rotated the
+    older parts away."""
+    monkeypatch.setenv("RAFT_TPU_SERVE_JOURNAL_MAX_BYTES", "500")
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path))
+    t = svc.submit(2.0, 9.0, 0.0)     # stays open: service not started
+    j = svc._journal
+    part0 = j._writer.part
+    for _ in range(40):               # enough traffic to rotate twice+
+        j.record_tenant("evict", "default", "full")
+    assert j._writer.part > part0 + 1
+    # the live part no longer holds the ORIGINAL admit line, yet replay
+    # still classifies the request as pending via the checkpoint copy
+    state = wal.replay(str(tmp_path))
+    assert [r["seq"] for r in state["pending"]] == [t.seq]
+    assert state["admitted"][t.seq]["checkpoint"] is True
+    assert state["admitted"][t.seq]["rdigest"] == \
+        wal.request_digest(2.0, 9.0, 0.0)
+    svc.start()
+    assert t.result(10.0).ok
+    svc.stop()
+    # terminal: the complete record lands in the live part, and the
+    # request no longer rides rotation checkpoints
+    assert svc._journal_snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# unit: WAL-before-ack + recovery semantics
+# ---------------------------------------------------------------------------
+
+def test_wal_written_before_ticket_ack(tmp_path):
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path))
+    # NOT started: the admit record must hit the WAL at submit time,
+    # before the ticket is returned, not when the batch runs
+    t = svc.submit(2.5, 9.0, 0.0)
+    docs = journalio.read(wal.journal_path(str(tmp_path)))
+    admits = [d for d in docs if d["type"] == "admit"]
+    assert len(admits) == 1 and admits[0]["seq"] == t.seq
+    assert admits[0]["rdigest"] == wal.request_digest(2.5, 9.0, 0.0)
+    svc.start()
+    res = t.result(10.0)
+    svc.stop()
+    docs = journalio.read(wal.journal_path(str(tmp_path)))
+    comp = [d for d in docs if d["type"] == "complete"]
+    batch = [d for d in docs if d["type"] == "batch"]
+    assert len(comp) == 1 and comp[0]["digest"] == res.digest
+    assert comp[0]["std"] == res.std
+    assert batch and batch[0]["seqs"] == [t.seq]
+
+
+def test_replay_idempotency_matrix(tmp_path):
+    """ISSUE satellite: a journal containing a completed digest, a
+    duplicate submission, an accepted-unfinished request, and a torn
+    tail line — ``recover()`` re-solves exactly the unfinished one,
+    dedupes the duplicate, skips the torn line, and the resulting
+    digests match a continuous run bit-for-bit."""
+    solves = {"batches": 0, "seqs": []}
+
+    def counting_factory(mode, fowt, ncases, **kw):
+        inner = stub_factory(mode, fowt, ncases, **kw)
+
+        def run(Hs, Tp, beta):
+            solves["batches"] += 1
+            solves["seqs"].append(list(np.asarray(Hs)))
+            return inner(Hs, Tp, beta)
+        run.ncases = ncases
+        return run
+
+    # the continuous reference: one service solves all three distinct
+    # requests in one life
+    ref = SweepService(runner_factory=stub_factory,
+                       config=_cfg(batch_cases=1))
+    ref.start()
+    ref_digests = {}
+    for seq, hs in enumerate([1.0, 1.0, 5.0]):   # seq1 duplicates seq0
+        ref_digests[seq] = ref.submit(hs, 8.0, 0.0).result(10.0).digest
+    ref.stop()
+
+    # the crashed life's journal: seq0 completed, seq1 duplicate of it
+    # (admitted, unfinished), seq2 unfinished, then a torn tail
+    d0 = ref_digests[0]
+    rd0 = wal.request_digest(1.0, 8.0, 0.0)
+    j = wal.RequestJournal(str(tmp_path), run_id="dead")
+    j.record_admit(0, "req0", rd0, 1.0, 8.0, 0.0, 60.0, "default")
+    j.record_complete(0, rd0, d0, "full", 0, [1.0] * 6, 3, True)
+    j.record_admit(1, "req1", rd0, 1.0, 8.0, 0.0, 60.0, "default")
+    j.record_admit(2, "req2", wal.request_digest(5.0, 8.0, 0.0),
+                   5.0, 8.0, 0.0, 60.0, "default")
+    j.close()
+    with open(wal.journal_path(str(tmp_path)), "ab") as f:
+        f.write(b'{"type":"admit","seq":3,"Hs":9.9')   # torn tail
+
+    svc = SweepService(runner_factory=counting_factory,
+                       config=_cfg(tmp_path, batch_cases=1))
+    info = svc.recover()
+    assert info["recovered"] == 1 and info["replayed"] == 1
+    assert info["deduped"] == 1 and info["corrupt"] == 1
+    # the completed digest is fetchable WITHOUT re-solving
+    assert svc.fetch(d0).seq == 0
+    assert svc.fetch(d0).source == "recovered"
+    # the duplicate resolved instantly from the journal
+    dup = info["tickets"][1].result(1.0)
+    assert dup.ok and dup.digest == d0 and dup.source == "deduped"
+    svc.start()
+    r2 = info["tickets"][2].result(10.0)
+    svc.stop()
+    # exactly ONE solve ran: the accepted-unfinished request
+    assert solves["batches"] == 1 and solves["seqs"] == [[5.0]]
+    assert r2.source == "replayed"
+    # digest parity with the continuous run, bit for bit
+    assert {0: svc.fetch(d0).digest, 1: dup.digest, 2: r2.digest} == \
+        ref_digests
+    # idempotent twice over: a second replay of the journal now sees
+    # every seq terminal (the dedupe was journaled as complete)
+    state = wal.replay(str(tmp_path))
+    assert state["pending"] == [] and set(state["completed"]) == {0, 1, 2}
+
+
+def test_recover_preserves_seqs_and_continues_seq_space(tmp_path):
+    j = wal.RequestJournal(str(tmp_path), run_id="dead")
+    j.record_admit(5, "req5-orig", wal.request_digest(2.0, 8.0, 0.0),
+                   2.0, 8.0, 0.0, 60.0, "default")
+    j.close()
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path))
+    info = svc.recover()
+    svc.start()
+    # the replayed request keeps its original admission seq (the
+    # deterministic retry/backoff key) AND its original request id
+    r5 = info["tickets"][5].result(10.0)
+    assert r5.seq == 5 and r5.request_id == "req5-orig"
+    # new admissions continue the crashed process's seq space
+    t = svc.submit(3.0, 8.0, 0.0)
+    assert t.seq == 6
+    assert t.result(10.0).ok
+    summary = svc.stop()
+    assert summary["replayed"] == 1
+    assert summary["replayed_lost_count"] == 0
+    snap = obs.snapshot()
+    series = snap["raft_tpu_serve_journal_replayed_total"]["series"]
+    assert any(s["labels"] == {"outcome": "replayed"} for s in series)
+
+
+def test_recover_unknown_tenant_fails_typed_never_drops(tmp_path):
+    j = wal.RequestJournal(str(tmp_path), run_id="dead")
+    j.record_admit(0, "req0", "sha256:x", 1.0, 8.0, 0.0, 60.0,
+                   "retired-model")
+    j.close()
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path))
+    info = svc.recover()
+    r = info["tickets"][0].result(1.0)
+    assert not r.ok and r.error["error"] == "ModelConfigError"
+    assert svc.stop()["replayed_lost_count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# unit: graceful drain / handoff
+# ---------------------------------------------------------------------------
+
+def test_drain_flushes_work_and_writes_handoff_manifest(tmp_path):
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path))
+    svc.start()
+    tickets = [svc.submit(1.0 + i, 8.0, 0.0) for i in range(3)]
+    doc = svc.drain(successor="http://replacement:8765")
+    # in-flight work completed (nothing pending), manifest written
+    assert all(t.result(0.1).ok for t in tickets)
+    assert doc["pending"] == [] and doc["next_seq"] == 3
+    assert doc["successor"] == "http://replacement:8765"
+    hpath = wal.handoff_path(str(tmp_path))
+    assert os.path.isfile(hpath)
+    assert json.load(open(hpath))["schema"] == "raft_tpu.serve.handoff/v1"
+    # post-drain admission: 429-style typed reject pointing at the
+    # successor
+    with pytest.raises(errors.AdmissionRejected) as exc:
+        svc.submit(9.0, 8.0, 0.0)
+    assert exc.value.ctx["reason"] == "stopped"
+    assert exc.value.ctx["successor"] == "http://replacement:8765"
+
+
+def test_drain_journals_unflushable_work_as_pending(tmp_path):
+    def slow_factory(mode, fowt, ncases, **kw):
+        inner = stub_factory(mode, fowt, ncases, **kw)
+
+        def run(Hs, Tp, beta):
+            time.sleep(1.0)
+            return inner(Hs, Tp, beta)
+        run.ncases = ncases
+        return run
+
+    svc = SweepService(runner_factory=slow_factory,
+                       config=_cfg(tmp_path, batch_cases=1,
+                                   queue_max=8))
+    svc.start()
+    tickets = [svc.submit(1.0 + i, 8.0, 0.0) for i in range(3)]
+    doc = svc.drain(timeout=0.2)          # cannot flush 3s of work
+    assert doc["pending"], "slow work should have been handed off"
+    # the local tickets resolve typed (handoff), never hang silently
+    done = [t.result(0.1) for t in tickets if t.done()]
+    assert all(r.ok or r.error["error"] == "DeadlineExceeded"
+               for r in done)
+    # ... and the WAL never drops anything: every admitted seq is
+    # either terminal (the in-flight batch may legitimately finish —
+    # and journal — during teardown) or still pending for the
+    # successor; the handoff snapshot is conservative (a superset of
+    # what remains pending after teardown)
+    state = wal.replay(str(tmp_path))
+    wal_pending = {r["seq"] for r in state["pending"]}
+    assert wal_pending | set(state["completed"]) == {0, 1, 2}
+    assert wal_pending <= set(doc["pending"])
+    assert wal_pending, "the queued requests never ran: must stay pending"
+    assert state["handoff"]["pending"] == doc["pending"]
+
+
+# ---------------------------------------------------------------------------
+# unit: multi-tenant warm runners
+# ---------------------------------------------------------------------------
+
+class _Marker:
+    def __init__(self, marker):
+        self.marker = marker
+        self.w = np.arange(3)
+
+
+def test_tenant_registry_typed_misconfig():
+    with pytest.raises(errors.ModelConfigError):
+        TenantRegistry(max_live_programs=0)
+    reg = TenantRegistry(max_live_programs=1)
+    reg.add("a", {"full": object()})
+    with pytest.raises(errors.ModelConfigError):
+        reg.add("a", {"full": object()})              # duplicate
+    with pytest.raises(errors.ModelConfigError) as exc:
+        reg.require("nope")
+    assert exc.value.ctx["tenant"] == "nope"
+    with pytest.raises(errors.ModelConfigError):
+        SweepService(runner_factory=stub_factory, config=_cfg(),
+                     tenants=[Tenant("default")])     # reserved name
+
+
+def test_multi_tenant_requests_solve_on_their_own_models():
+    svc = SweepService(_Marker(0.0), config=_cfg(),
+                       runner_factory=stub_factory,
+                       tenants=[Tenant("modelB", _Marker(100.0))])
+    svc.start()
+    ta = svc.submit(1.0, 8.0, 0.0)
+    tb = svc.submit(1.0, 8.0, 0.0, tenant="modelB")
+    with pytest.raises(errors.ModelConfigError):
+        svc.submit(1.0, 8.0, 0.0, tenant="modelC")
+    ra, rb = ta.result(10.0), tb.result(10.0)
+    summary = svc.stop()
+    # same physics request, different tenant model — and the batches
+    # never mixed (the marker offset proves which program served it)
+    assert np.allclose(ra.std, 1.0) and ra.tenant == "default"
+    assert np.allclose(rb.std, 101.0) and rb.tenant == "modelB"
+    assert rb.digest != ra.digest
+    tenants = summary["tenancy"]["tenants"]
+    assert tenants["default"]["completed"] == 1
+    assert tenants["modelB"]["completed"] == 1
+    snap = obs.snapshot()
+    series = snap["raft_tpu_serve_tenant_requests_total"]["series"]
+    assert any(s["labels"] == {"tenant": "modelB", "outcome": "completed"}
+               for s in series)
+
+
+def test_tenant_lru_eviction_and_rewarm_under_budget(tmp_path):
+    svc = SweepService(_Marker(0.0),
+                       config=_cfg(tmp_path, max_live_programs=1,
+                                   batch_cases=1),
+                       runner_factory=stub_factory,
+                       tenants=[Tenant("modelB", _Marker(100.0))])
+    svc.start()
+    # A, B (evicts A), A again (evicts B, REWARMS A)
+    assert svc.submit(1.0, 8.0, 0.0).result(10.0).ok
+    assert svc.submit(1.0, 8.0, 0.0, tenant="modelB").result(10.0).ok
+    assert svc.submit(2.0, 8.0, 0.0).result(10.0).ok
+    summary = svc.stop()
+    fac = summary["tenancy"]
+    assert fac["live_programs"] == 1
+    assert fac["evictions"] == 2 and fac["rewarms"] == 1
+    assert summary["tenant_evictions"] == 2
+    snap = obs.snapshot()
+    ev = snap["raft_tpu_serve_tenant_evictions_total"]["series"]
+    assert any(s["labels"] == {"tenant": "default", "mode": "full"}
+               for s in ev)
+    # evictions/re-warms are journaled
+    docs = journalio.read(wal.journal_path(str(tmp_path)))
+    tevents = [(d["event"], d["tenant"]) for d in docs
+               if d["type"] == "tenant"]
+    assert ("evict", "default") in tevents
+    assert ("rewarm", "default") in tevents
+
+
+# ---------------------------------------------------------------------------
+# unit: recovered-service manifest -> trend row -> restart SLO rules
+# ---------------------------------------------------------------------------
+
+def test_recovered_serve_manifest_trend_row_and_slo(tmp_path,
+                                                    monkeypatch):
+    from raft_tpu.obs import trendstore as T
+
+    jdir = tmp_path / "journal"
+    j = wal.RequestJournal(str(jdir), run_id="dead")
+    j.record_admit(0, "req0", wal.request_digest(2.0, 8.0, 0.0),
+                   2.0, 8.0, 0.0, 60.0, "default")
+    j.close()
+    monkeypatch.setenv("RAFT_TPU_TREND_DB", str(tmp_path / "t.sqlite"))
+    obs.configure(str(tmp_path / "obs"))
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(jdir))
+    info = svc.recover()
+    svc.start()
+    run_id = svc._manifest.run_id
+    assert info["tickets"][0].result(10.0).ok
+    summary = svc.stop()
+    assert summary["replayed"] == 1
+    assert summary["replayed_lost_count"] == 0
+    doc = json.loads((tmp_path / "obs" /
+                      f"serve_{run_id}.manifest.json").read_text())
+    assert doc["extra"]["serve"]["recovery"]["replayed"] == 1
+    store = T.TrendStore(str(tmp_path / "t.sqlite"))
+    rows = store.rows(kind="serve")
+    facts = rows[0]["facts"]
+    assert facts["serve_replayed"] == 1
+    assert facts["serve_replayed_lost_count"] == 0
+    # stub runners never come from the exec cache -> warm-start fact 0;
+    # the rule correctly fires on a recovered service that re-traced
+    assert facts["serve_restart_warm_start"] == 0
+    report = T.evaluate_slo(rows)
+    by_name = {r["name"]: r for r in report["results"]}
+    assert not by_name["serve_replayed_lost_count"]["skipped"]
+    assert by_name["serve_replayed_lost_count"]["ok"]
+    assert not by_name["serve_restart_warm_start"]["skipped"]
+    assert not by_name["serve_restart_warm_start"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# integration: the ISSUE kill-restart acceptance (subprocess, coarse
+# cylinder model, exec-cache warm start)
+# ---------------------------------------------------------------------------
+
+def test_kill_restart_acceptance(tmp_path, monkeypatch):
+    """A journaled child service is hard-killed (``kill@serve`` ->
+    ``os._exit(137)``) mid-batch; the successor recovers the same
+    journal dir: zero accepted requests lost, every completed request
+    digest-identical to an uninterrupted clean run, warm start from
+    the executable cache, graceful drain writing the handoff
+    manifest."""
+    from raft_tpu.parallel import exec_cache
+    from raft_tpu.serve import soak
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR",
+                       str(tmp_path / "cache"))
+    exec_cache.reset_memo()
+    jdir = tmp_path / "journal"
+    report = soak.run_kill_restart(journal_dir=str(jdir),
+                                   n_requests=10, kill_at=6)
+    assert report["ok"], {k: report[k] for k in
+                          ("killed", "child_rc", "lost",
+                           "digest_mismatches", "recover")}
+    # the injected kill really fired, mid-batch, with work on the books
+    assert report["child_rc"] == 137
+    assert 0 < report["pre_kill_completed"] < report["n_requests"]
+    # completed-before-kill results were restored WITHOUT re-solving,
+    # the unfinished remainder was replayed, nothing was lost
+    rec = report["recover"]
+    assert rec["recovered"] == report["pre_kill_completed"]
+    assert rec["recovered"] + rec["replayed"] == report["n_requests"]
+    assert report["lost"] == [] and report["digest_mismatches"] == []
+    assert report["replayed_lost_count"] == 0
+    # the successor deserialized the SAME warm program (no recompile)
+    assert report["restart_warm_start"] == 1
+    assert report["summary"]["unhandled"] == 0
+    # the drain handed off cleanly: nothing pending, exec-cache keys
+    # named for the NEXT successor
+    assert report["handoff"]["pending"] == []
+    assert report["handoff"]["exec_keys"]
+    assert os.path.isfile(wal.handoff_path(str(jdir)))

@@ -3,18 +3,28 @@
 
 Subcommands::
 
-    raftserve serve --design Vertical_cylinder --port 8765
+    raftserve serve --design Vertical_cylinder --port 8765 \
+                    [--journal-dir DIR] [--successor URL]
         Long-lived HTTP endpoint over raft_tpu.serve.SweepService:
           POST /submit   {"hs":2.0,"tp":9.0,"heading_deg":0,
                           "deadline_s":60, "wait":false}
                          -> 202 {"request_id": ...} (or the full result
                          with "wait": true); admission rejection maps
                          to 429 + a Retry-After header.
+          POST /drain    graceful restart handoff: stop admitting,
+                         flush or journal in-flight work, write the
+                         handoff manifest, shut down (SIGTERM does the
+                         same).
           GET  /result?id=...      -> result by request id (404 unknown,
                                       202 still pending)
           GET  /result?digest=...  -> completed result by ledger digest
           GET  /stats | /healthz   -> service counters / liveness
         Ctrl-C drains the queue and writes the serve run manifest.
+        With --journal-dir, every admission/result is write-ahead
+        journaled before it is acknowledged, and a journal left by a
+        predecessor (killed or drained) is recovered on boot: completed
+        results re-delivered without re-solving, unfinished requests
+        re-admitted, the program warm-started from the exec cache.
 
     raftserve soak [--requests 12] [--faults SPEC] [--json OUT]
         Deterministic chaos soak (raft_tpu/serve/soak.py): clean
@@ -24,6 +34,14 @@ Subcommands::
         the service survived with zero unhandled errors.  The fault
         spec defaults to serve.soak.DEFAULT_FAULTS, or comes from
         --faults / the RAFT_TPU_FAULTS environment variable.
+
+    raftserve soak --kill-restart --journal-dir DIR [--kill-at N]
+        Durability soak: a journaled child service is hard-killed
+        mid-batch (kill@serve -> os._exit), then recovered against the
+        same journal dir; exits nonzero unless the child died by the
+        injected kill, zero accepted requests were lost, and every
+        completed request is digest-identical to an uninterrupted
+        clean run.
 
 Set RAFT_TPU_OBS_DIR to collect the serve manifests, flight-recorder
 event streams, and the trend-store rows the `obsctl slo` serve rules
@@ -40,25 +58,48 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _build_fowts(args):
-    """(fowt, coarse_fowt) on the requested frequency grid."""
-    import numpy as np
+    """(fowt, coarse_fowt) on the requested frequency grid — one
+    recipe (serve.soak.build_fowt) for the CLI, the soak harness, and
+    its killed subprocess, so every phase solves identical physics."""
+    from raft_tpu.serve.soak import build_fowt
 
-    from raft_tpu.io.designs import load_design
-    from raft_tpu.models.fowt import build_fowt
-
-    design = load_design(args.design)
-    w = np.arange(args.min_freq, args.max_freq,
-                  args.dfreq) * 2.0 * np.pi
-    depth = float(design["site"]["water_depth"])
-    fowt = build_fowt(design, w, depth=depth)
-    coarse = build_fowt(design, w[::2], depth=depth) \
-        if args.coarse else None
+    fowt = build_fowt(args.design, args.min_freq, args.max_freq,
+                      args.dfreq)
+    coarse = build_fowt(args.design, args.min_freq, args.max_freq,
+                        args.dfreq * 2.0) if args.coarse else None
     return fowt, coarse
 
 
 def cmd_soak(args) -> int:
     from raft_tpu.serve import soak
     from raft_tpu.serve.config import ServeConfig
+
+    if args.kill_restart:
+        if not args.journal_dir:
+            print("raftserve soak --kill-restart needs --journal-dir",
+                  file=sys.stderr)
+            return 2
+        report = soak.run_kill_restart(
+            args.design, journal_dir=args.journal_dir,
+            min_freq=args.min_freq, max_freq=args.max_freq,
+            dfreq=args.dfreq, n_requests=args.requests,
+            kill_at=args.kill_at, batch_cases=args.batch,
+            seed=args.seed, timeout_s=args.timeout)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=1, default=str)
+        rec = report["recover"]
+        print(f"raftserve kill-restart soak: "
+              f"{'OK' if report['ok'] else 'FAILED'} — child "
+              f"rc={report['child_rc']}, "
+              f"{report['pre_kill_completed']} completed pre-kill, "
+              f"{rec['recovered']} recovered / {rec['replayed']} "
+              f"replayed / {rec['deduped']} deduped, "
+              f"{len(report['lost'])} lost, "
+              f"{len(report['digest_mismatches'])} digest mismatch(es), "
+              f"warm_start={report['restart_warm_start']}, "
+              f"{report['wall_s']:.1f}s")
+        return 0 if report["ok"] else 1
 
     spec = (args.faults or os.environ.get("RAFT_TPU_FAULTS", "").strip()
             or soak.DEFAULT_FAULTS)
@@ -85,17 +126,21 @@ def cmd_soak(args) -> int:
 
 
 def cmd_serve(args) -> int:
+    import signal
+    import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from raft_tpu import errors
     from raft_tpu.serve import ServeConfig, SweepService
+    from raft_tpu.serve import journal as wal
 
     fowt, coarse = _build_fowts(args)
     cfg = ServeConfig(batch_cases=args.batch, queue_max=args.queue_max,
                       deadline_s=args.deadline,
-                      batch_deadline_s=args.batch_deadline)
+                      batch_deadline_s=args.batch_deadline,
+                      journal_dir=args.journal_dir)
     degraded = {"coarse": coarse} if coarse is not None else None
-    service = SweepService(fowt, cfg, degraded_fowts=degraded).start()
+    service = SweepService(fowt, cfg, degraded_fowts=degraded)
     # bounded FIFO, like SweepService._delivered: an always-on process
     # must not retain one ticket per request forever
     import collections
@@ -107,6 +152,22 @@ def cmd_serve(args) -> int:
         tickets[t.id] = t
         while len(tickets) > tickets_max:
             tickets.popitem(last=False)
+
+    # crash recovery: a journal left by a predecessor (killed or
+    # drained) replays BEFORE the worker starts — completed results
+    # become fetchable, unfinished requests re-enter the queue under
+    # their original seqs, and their tickets are trackable by id
+    if args.journal_dir and \
+            os.path.exists(wal.journal_path(args.journal_dir)):
+        info = service.recover()
+        for t in info["tickets"].values():
+            _track(t)
+        print(f"raftserve: journal recovery — "
+              f"{info['recovered']} result(s) restored, "
+              f"{info['replayed']} request(s) replayed, "
+              f"{info['deduped']} deduped, "
+              f"{info['corrupt']} corrupt line(s) skipped", flush=True)
+    service.start()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):                     # pragma: no cover
@@ -154,6 +215,14 @@ def cmd_serve(args) -> int:
 
         def do_POST(self):                             # noqa: N802
             import math
+            if self.path == "/drain":
+                # graceful handoff: flush/journal everything, write the
+                # handoff manifest, answer with it, then shut down
+                doc = service.drain(successor=args.successor)
+                self._send(200, doc)
+                threading.Thread(target=srv.shutdown,
+                                 daemon=True).start()
+                return
             if self.path != "/submit":
                 self._send(404, {"error": "not found"})
                 return
@@ -194,9 +263,22 @@ def cmd_serve(args) -> int:
 
     srv = ThreadingHTTPServer((args.host, args.port), Handler)
     host, port = srv.server_address[:2]
-    print(f"raftserve: http://{host}:{port}/  (submit, result, stats, "
-          f"healthz; design={args.design}, batch={cfg.batch_cases}, "
-          f"ladder={'->'.join(service.ladder)})", flush=True)
+
+    def _on_sigterm(signum, frame):                    # pragma: no cover
+        # SIGTERM = orchestrated restart: drain (handoff manifest, WAL
+        # pending records) on a side thread — a signal handler must not
+        # block — then stop accepting connections
+        def _drain():
+            service.drain(successor=args.successor)
+            srv.shutdown()
+        threading.Thread(target=_drain, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    print(f"raftserve: http://{host}:{port}/  (submit, result, drain, "
+          f"stats, healthz; design={args.design}, "
+          f"batch={cfg.batch_cases}, "
+          f"ladder={'->'.join(service.ladder)}, "
+          f"journal={args.journal_dir or 'off'})", flush=True)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:                          # pragma: no cover
@@ -239,6 +321,15 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=2026)
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--json", help="write the full report to this path")
+    p.add_argument("--kill-restart", action="store_true",
+                   help="durability soak: SIGKILL a journaled child "
+                        "service mid-batch, recover on the same "
+                        "--journal-dir, gate zero-loss digest parity")
+    p.add_argument("--journal-dir", default=None,
+                   help="write-ahead journal directory (required with "
+                        "--kill-restart)")
+    p.add_argument("--kill-at", type=int, default=6,
+                   help="request seq the kill@serve fault fires at")
     p.set_defaults(fn=cmd_soak)
 
     p = sub.add_parser("serve", help="HTTP endpoint over SweepService")
@@ -249,6 +340,13 @@ def main(argv=None) -> int:
                    help="default per-request deadline (s)")
     p.add_argument("--batch-deadline", type=float, default=60.0,
                    help="watchdog deadline per in-flight batch (s)")
+    p.add_argument("--journal-dir", default=None,
+                   help="write-ahead request journal directory; a "
+                        "journal left by a predecessor is recovered "
+                        "on boot (replay + warm start)")
+    p.add_argument("--successor", default=None,
+                   help="where a drain points rejected callers "
+                        "(Retry-After context)")
     p.set_defaults(fn=cmd_serve)
 
     args = ap.parse_args(argv)

@@ -770,5 +770,121 @@ def _skew(v):
     ], axis=1)
 
 
+# ---------------------------------------------------------------------------
+# `bench.py serve` — sustained serving throughput (open-loop arrivals)
+# ---------------------------------------------------------------------------
+
+def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
+                n_requests=None, rps=None, batch_cases=4, seed=2026,
+                timeout_s=600.0):
+    """Drive a :class:`raft_tpu.serve.SweepService` with a seeded
+    OPEN-LOOP arrival process (exponential inter-arrivals at ``rps``
+    requests/s, submitted on schedule whether or not earlier requests
+    finished — the arrival law of independent callers, not a closed
+    benchmark loop) and report sustained-serving facts:
+
+    - ``cases_per_min`` — completed requests per wall minute;
+    - ``admission_p50_s`` / ``admission_p99_s`` — latency of the
+      ``submit()`` admission edge itself (the WAL-write + queue-check
+      path a caller blocks on, NOT the solve);
+    - ``batch_fill_ratio`` — completed / (batches x batch size): how
+      well the coalescing window packs the warm program under this
+      arrival rate (1.0 = every batch full).
+
+    The facts land in a ``bench_serve`` run manifest
+    (``extra["serve_bench"]``) -> trend-store row, so `obsctl trend
+    --db` tracks serving throughput across rounds exactly like the
+    solver metrics.  ``runner_factory`` injects a stub engine (tests);
+    the default builds the real warm batch runner over ``design``.
+    Knobs: ``RAFT_BENCH_SERVE_N`` (requests), ``RAFT_BENCH_SERVE_RPS``
+    (arrival rate)."""
+    from raft_tpu import errors, obs
+    from raft_tpu.serve import SweepService, soak
+
+    n = int(n_requests if n_requests is not None
+            else os.environ.get("RAFT_BENCH_SERVE_N", 48))
+    rps = float(rps if rps is not None
+                else os.environ.get("RAFT_BENCH_SERVE_RPS", 6.0))
+    fowt = None
+    if runner_factory is None:
+        fowt = soak.build_fowt(design)
+    cfg = soak.default_config(batch_cases=batch_cases, queue_max=n,
+                              deadline_s=timeout_s,
+                              batch_deadline_s=120.0)
+    manifest = obs.RunManifest.begin(kind="bench_serve", config={
+        "design": design, "n_requests": n, "arrival_rps": rps,
+        "batch_cases": batch_cases, "seed": seed,
+        "stub": runner_factory is not None})
+    status = "failed"
+    svc = None
+    try:
+        svc = SweepService(fowt, cfg, runner_factory=runner_factory)
+        svc.start()
+        rng = np.random.default_rng(seed)
+        Hs, Tp, beta = soak.case_table(n, seed=seed)
+        gaps = rng.exponential(1.0 / rps, n)
+        t0 = time.monotonic()
+        arrivals = t0 + np.cumsum(gaps)
+        tickets = {}
+        admit_s = []
+        shed = 0
+        for i in range(n):
+            wait = arrivals[i] - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            ta = time.perf_counter()
+            try:
+                tickets[i] = svc.submit(Hs[i], Tp[i], beta[i])
+            except errors.AdmissionRejected:
+                shed += 1        # open loop: shed arrivals do not retry
+            finally:
+                admit_s.append(time.perf_counter() - ta)
+        results = {}
+        deadline = time.monotonic() + timeout_s
+        for i, t in tickets.items():
+            results[i] = t.result(max(0.5, deadline - time.monotonic()))
+        open_loop_s = time.monotonic() - t0
+        summary = svc.stop()
+        completed = sum(1 for r in results.values() if r.ok)
+        batches = max(1, summary["batches"])
+        facts = {
+            "cases_per_min": round(completed / open_loop_s * 60.0, 2),
+            "admission_p50_s": SweepService._percentile(admit_s, 50),
+            "admission_p99_s": SweepService._percentile(admit_s, 99),
+            "batch_fill_ratio": round(
+                completed / (batches * cfg.batch_cases), 4),
+            "arrival_rps": rps,
+            "open_loop_s": round(open_loop_s, 3),
+            "completed": completed,
+            "shed": shed,
+            "failed": sum(1 for r in results.values() if not r.ok),
+        }
+        manifest.extra["serve_bench"] = facts
+        manifest.extra["serve"] = summary
+        status = "ok" if completed and not facts["failed"] else "failed"
+        report = {"metric": "sustained serving throughput "
+                            f"(open-loop {rps} req/s over {n} "
+                            f"requests, batch={cfg.batch_cases})",
+                  **facts, "ok": status == "ok"}
+    finally:
+        # the service must stop on the error path too (its own serve
+        # manifest finishes, the WAL/mirror closes) — a ticket timeout
+        # must not strand the worker threads behind a traceback
+        if svc is not None:
+            svc.stop(drain=False, timeout=5.0)
+        paths = obs.finish_run(manifest, status=status)
+    report["manifest"] = paths["manifest"]
+    return report
+
+
+def _serve_bench_main() -> int:
+    report = serve_bench()
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
 if __name__ == "__main__":
+    import sys as _sys
+    if len(_sys.argv) > 1 and _sys.argv[1] == "serve":
+        raise SystemExit(_serve_bench_main())
     main()

@@ -163,6 +163,18 @@ class AdmissionRejected(RaftError, RuntimeError):
                          **context)
 
 
+class ReplicaLagExceeded(RaftError, RuntimeError):
+    """The write-ahead-journal mirror (:mod:`raft_tpu.serve.replica`)
+    fell further behind the primary than the configured record budget —
+    a *degradation signal*, not a crash: the service keeps serving (and
+    the mirror keeps catching up), but a failover while this condition
+    holds could lose the lagging tail.  Surfaces as a typed raise only
+    from :meth:`WalMirror.check` (strict callers: health gates, tests);
+    the serving loop folds it into the degradation ladder instead."""
+
+    phase = "replication"
+
+
 class DeadlineExceeded(RaftError, TimeoutError):
     """A request (or the batch carrying it) overran its deadline — the
     serving watchdog's abandon signal and the typed failure a

@@ -70,15 +70,29 @@ class JsonlWriter:
     ``header(part)`` after every fresh open — including the first — and
     its returned dict (if any) becomes the part's first record, so a
     rotated generation is self-describing.
+
+    Replication hooks (optional, both guarded — a hook failure must
+    never take down the journal it observes):
+
+    - ``post_flush(writer)`` runs after every record's write+flush,
+      while the caller's lock (if any) is still held — the seam the
+      serve WAL mirror uses to ship the fresh bytes (or queue a
+      catch-up) to peer stores *before* the write is acknowledged;
+    - ``post_rotate(writer, sealed_part)`` runs after a size rotation
+      sealed a part (now at ``<path>.1``), with the sealed generation's
+      part index — the seam that ships whole sealed parts.
     """
 
     def __init__(self, path: str, *, max_bytes: int = None,
-                 keep: int = 2, header=None):
+                 keep: int = 2, header=None, post_flush=None,
+                 post_rotate=None):
         self.path = str(path)
         self.max_bytes = max_bytes
         self.keep = max(0, int(keep))
         self.part = 0
         self._header = header
+        self.post_flush = post_flush
+        self.post_rotate = post_rotate
         self._fh = None
         os.makedirs(os.path.dirname(os.path.abspath(self.path)),
                     exist_ok=True)
@@ -100,6 +114,13 @@ class JsonlWriter:
         counts and keeps serving)."""
         self._fh.write(dumps(doc) + "\n")
         self._fh.flush()
+        if self.post_flush is not None:
+            # notification only — the mirror counts its own errors; a
+            # broken hook must never become a failed WAL write
+            try:
+                self.post_flush(self)
+            except Exception:                        # pragma: no cover
+                pass
         if rotate and self.max_bytes is not None \
                 and self._fh.tell() > self.max_bytes:
             self.rotate()
@@ -130,6 +151,15 @@ class JsonlWriter:
             except OSError:                          # pragma: no cover
                 pass
         self.part += 1
+        # the rotate hook fires BEFORE the fresh part opens (and writes
+        # its header): a mirror must shuffle its peer generations while
+        # the sealed bytes still name the live path, or the header ship
+        # would overwrite the peer's un-sealed copy
+        if self.post_rotate is not None:
+            try:
+                self.post_rotate(self, self.part - 1)
+            except Exception:                        # pragma: no cover
+                pass
         self._open_fresh()
 
     def tell(self) -> int:

@@ -164,11 +164,25 @@ def facts_from_manifest(doc: dict) -> dict:
                   "deduped", "replayed_lost_count",
                   "restart_warm_start", "handoff_pending",
                   # tenancy facts (serve/tenancy.py)
-                  "tenant_evictions", "tenant_rewarms"):
+                  "tenant_evictions", "tenant_rewarms",
+                  # replication facts (serve/replica.py): lag/errors on
+                  # every mirrored service row; failover facts only on
+                  # a life that recovered from a FOREIGN mirror — the
+                  # cross-host SLO rules skip ordinary rows
+                  "replication_lag_records", "replication_errors",
+                  "failover", "failover_lost_count"):
             if _num(serve.get(k)) is not None:
                 facts[f"serve_{k}"] = serve[k]
         if serve.get("mode"):
             facts["serve_mode"] = str(serve["mode"])
+    # serving-throughput bench facts (bench.py serve): one row per
+    # sustained-throughput run, trended by `obsctl trend --db`
+    sbench = extra.get("serve_bench") or {}
+    if isinstance(sbench, dict):
+        for k in ("cases_per_min", "admission_p99_s", "admission_p50_s",
+                  "batch_fill_ratio", "arrival_rps", "open_loop_s"):
+            if _num(sbench.get(k)) is not None:
+                facts[f"serve_{k}"] = sbench[k]
     # probe-channel volume (its own budget, distinct from transfers):
     # the embedded metrics snapshot is process-cumulative, so subtract
     # the baseline RunManifest.begin recorded for THIS run
@@ -383,6 +397,17 @@ DEFAULT_SLO_RULES = [
     {"name": "serve_restart_warm_start", "kind": "serve",
      "fact": "serve_restart_warm_start", "agg": "min", "op": "==",
      "threshold": 1.0, "window": 20},
+    # -- replication gates (serve/replica.py; skipped when no mirrored
+    # / failed-over serve row exists).  A failover that left a request
+    # open lost an accepted request across the host boundary; a mirror
+    # more than 64 records behind at summary time has outgrown the
+    # synchronous-mirroring contract the zero-loss failover rests on.
+    {"name": "serve_failover_lost_count", "kind": "serve",
+     "fact": "serve_failover_lost_count", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
+    {"name": "serve_replication_lag_records", "kind": "serve",
+     "fact": "serve_replication_lag_records", "agg": "max", "op": "<=",
+     "threshold": 64.0, "window": 20},
 ]
 
 _OPS = {

@@ -254,7 +254,7 @@ def _lane_finite(Xi):
 
 
 def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
-                      **kw):
+                      mesh: Mesh = None, **kw):
     """One warm, reusable batched case-solve for the serving loop
     (:mod:`raft_tpu.serve`).
 
@@ -274,17 +274,40 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
     batches); the callable carries ``.ncases``, ``.cache_state``
     (``hit``/``miss``/``disabled``) and ``.build_s`` for the service's
     manifest.  Solver kwargs (``nIter``, ``tol``, ``fp_chunk``, ...)
-    pass through to :func:`make_case_solver`."""
+    pass through to :func:`make_case_solver`.
+
+    ``mesh`` (optional, multi-axis welcome — ``parallel/partition.py``)
+    shards every batch of the program's lifetime: the fixed case count
+    rounds UP to the mesh's batch-shard multiple (``run.ncases`` tells
+    the service what to pad to), inputs are placed per the partition
+    rules on every call, and the exec-cache key carries the full
+    ordered topology + rule fingerprint — so warm multi-tenant serving
+    composes with sharding exactly like ``sweep_cases`` does."""
     import time as _time
 
     from raft_tpu import obs
-    from raft_tpu.parallel import exec_cache
+    from raft_tpu.parallel import exec_cache, partition
 
     t0 = _time.perf_counter()
-    solver = make_case_solver(fowt, **kw)
+    ncases = int(ncases)
+    if mesh is not None:
+        # the warm program's batch shape is fixed: bake the pad-to-
+        # shard-multiple in once and let the service pad (repeat-last-
+        # lane, stripped from results) up to it
+        ncases += (-ncases) % partition.batch_size(mesh)
+    solver = make_case_solver(fowt, mesh=mesh, **kw)
     batched = jax.jit(solver.batched)
     dtype = _config.real_dtype()
-    args = tuple(jnp.zeros((int(ncases),), dtype) for _ in range(3))
+
+    def _place(Hs, Tp, beta):
+        if mesh is None:
+            return Hs, Tp, beta
+        placed = partition.shard_tree(
+            {"Hs": Hs, "Tp": Tp, "beta": beta}, mesh,
+            partition.CASE_INPUT_RULES)
+        return placed["Hs"], placed["Tp"], placed["beta"]
+
+    args = _place(*(jnp.zeros((ncases,), dtype) for _ in range(3)))
     exe = None
     key = None
     cache_state = "disabled"
@@ -296,7 +319,15 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
             batch_shape=[int(ncases)],
             dtype=str(dtype.__name__ if hasattr(dtype, "__name__")
                       else dtype),
-            mesh=None,
+            # full ORDERED topology + rule fingerprint, exactly like
+            # sweep_cases: a (2,4) (cases,freq) program is never served
+            # for a (2,4) (variants,cases) service mesh
+            mesh=partition.mesh_facts(mesh),
+            partition_rules=(None if mesh is None
+                             else partition.rules_fingerprint(
+                                 partition.CASE_INPUT_RULES,
+                                 partition.STATE_RULES,
+                                 partition.XI_SPEC)),
             kw={k: v for k, v in kw.items()
                 if isinstance(v, (int, float, str, bool))},
             kw_arrays=exec_cache.model_digest(
@@ -320,9 +351,9 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
                                        "nw": len(fowt.w)})
 
     def run(Hs, Tp, beta):
-        Hs = jnp.asarray(Hs, dtype)
-        Tp = jnp.asarray(Tp, dtype)
-        beta = jnp.asarray(beta, dtype)
+        Hs, Tp, beta = _place(jnp.asarray(Hs, dtype),
+                              jnp.asarray(Tp, dtype),
+                              jnp.asarray(beta, dtype))
         out = (exe.call(Hs, Tp, beta) if exe is not None
                else compiled(Hs, Tp, beta))
         jax.block_until_ready(out["std"])
@@ -339,6 +370,7 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
     run.ncases = int(ncases)
     run.cache_state = cache_state
     run.key = key
+    run.mesh = mesh
     run.build_s = _time.perf_counter() - t0
     return run
 

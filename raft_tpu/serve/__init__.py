@@ -19,18 +19,30 @@ successor (handoff manifest + exec-cache warm start), and several
 models share the device as named tenants
 (:mod:`raft_tpu.serve.tenancy`) under an LRU warm-program budget.
 
-Entry points: :class:`SweepService` (embedded),
-``tools/raftserve.py`` (CLI: HTTP endpoint + the deterministic chaos
-and kill-restart soaks).  See docs/robustness.md "Serving" and
-"Durability".
+The replication layer makes the *host* replaceable: the WAL mirrors
+to peer stores (:mod:`raft_tpu.serve.replica` — synchronous shipping,
+bounded catch-up, typed ``ReplicaLagExceeded`` degradation), a
+successor on another host recovers from a mirror alone, and a thin
+health-checked router (:mod:`raft_tpu.serve.router`) fronts N
+replicas with per-tenant token-bucket quotas, shared-secret auth,
+tenant-affinity routing, and request-digest re-resolution after a
+replica dies.
+
+Entry points: :class:`SweepService` / :class:`ReplicaRouter`
+(embedded), ``tools/raftserve.py`` (CLI: HTTP endpoint + router + the
+deterministic chaos / kill-restart / failover soaks).  See
+docs/robustness.md "Serving", "Durability", and "Replication &
+failover".
 """
 from raft_tpu.serve.config import MODES, ServeConfig  # noqa: F401
 from raft_tpu.serve.journal import (  # noqa: F401
     RequestJournal, replay, request_digest,
 )
+from raft_tpu.serve.replica import WalMirror  # noqa: F401
 from raft_tpu.serve.retry import (  # noqa: F401
     DEFAULT_BUDGETS, TERMINAL, RetryPolicy,
 )
+from raft_tpu.serve.router import ReplicaRouter  # noqa: F401
 from raft_tpu.serve.service import (  # noqa: F401
     SweepResult, SweepService, Ticket,
 )

@@ -12,6 +12,7 @@ See docs/robustness.md "Serving" for the semantics of each group.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 from raft_tpu import errors
 
@@ -84,6 +85,27 @@ class ServeConfig:
     #: ``SweepService.recover()``
     journal_dir: str | None = None
 
+    # -- replication (serve/replica.py) -------------------------------
+    #: peer directories the write-ahead journal is mirrored to (local
+    #: paths now, object-store mounts later); requires ``journal_dir``.
+    #: A successor on a DIFFERENT host recovers from a mirror alone
+    #: (``SweepService.recover(mirror_dir)``) with the same zero-loss
+    #: replay guarantees
+    mirror_dirs: tuple = ()
+    #: mirror records behind which the typed ``ReplicaLagExceeded``
+    #: degradation signal trips (folded into the service ladder)
+    replica_max_lag_records: int = 1024
+    #: True (default): ship each WAL record to every reachable peer
+    #: inline, before the write is acknowledged (zero-loss failover);
+    #: False: mirror asynchronously via the bounded catch-up queue
+    mirror_sync: bool = True
+
+    # -- sharding (parallel/partition.py) ------------------------------
+    #: named mesh the warm batch programs solve on (None = single
+    #: device); exec-cache keys carry the full ordered topology so warm
+    #: tenancy composes with sharding
+    mesh: object = None
+
     # -- tenancy (serve/tenancy.py) -----------------------------------
     #: warm compiled batch programs kept live across all tenants;
     #: least-recently-used runners are evicted (and re-warmed from the
@@ -114,6 +136,13 @@ class ServeConfig:
             ("result_cache", self.result_cache >= 1),
             ("journal_dir", self.journal_dir is None
              or bool(str(self.journal_dir).strip())),
+            ("mirror_dirs", not self.mirror_dirs
+             or (self.journal_dir is not None
+                 and all(str(d).strip() for d in self.mirror_dirs)
+                 and not any(os.path.abspath(str(d))
+                             == os.path.abspath(str(self.journal_dir))
+                             for d in self.mirror_dirs))),
+            ("replica_max_lag_records", self.replica_max_lag_records >= 1),
             ("max_live_programs", self.max_live_programs >= 1),
             ("nIter", self.nIter >= 1),
         ]
@@ -128,6 +157,19 @@ class ServeConfig:
                 "fp_chunk": int(self.fp_chunk)}
 
     def scalars(self) -> dict:
-        """Flat scalar snapshot for the service run manifest."""
-        return {k: v for k, v in dataclasses.asdict(self).items()
-                if isinstance(v, (bool, int, float, str))}
+        """Flat scalar snapshot for the service run manifest (field
+        iteration, not ``asdict`` — the ``mesh`` field holds a device
+        mesh that must not be deep-copied)."""
+        out = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, (bool, int, float, str)):
+                out[f.name] = v
+        if self.mirror_dirs:
+            out["mirror_peers"] = len(self.mirror_dirs)
+        if self.mesh is not None:
+            from raft_tpu.parallel import partition
+            facts = partition.mesh_facts(self.mesh)
+            if facts:
+                out["mesh"] = facts["topology"]
+        return out

@@ -43,6 +43,12 @@ and serving continues — the operator sees the durability gap in the
 metrics instead of a dead endpoint.  The ``torn@journal`` fault action
 (:mod:`raft_tpu.testing.faults`) truncates the freshly-written record
 mid-line to drive the torn-tail replay path deterministically in CI.
+
+With ``mirror_dirs`` the journal additionally streams every record and
+every sealed part to peer stores (:mod:`raft_tpu.serve.replica`), so
+:func:`replay`/:meth:`SweepService.recover` work against a *mirror*
+directory on a different host with the same zero-loss guarantees — the
+cross-host failover the ``raftserve soak --failover`` harness proves.
 """
 from __future__ import annotations
 
@@ -109,7 +115,8 @@ class RequestJournal:
     """
 
     def __init__(self, journal_dir: str, run_id: str = None, *,
-                 snapshot_fn=None):
+                 snapshot_fn=None, mirror_dirs=None,
+                 mirror_max_lag: int = 1024, mirror_sync: bool = True):
         self.dir = str(journal_dir)
         self.run_id = str(run_id or "")
         self.path = journal_path(self.dir)
@@ -124,9 +131,26 @@ class RequestJournal:
         #: the retained parts instead — losing a dedupe hit costs one
         #: redundant solve, never a request.)
         self._snapshot = snapshot_fn
+        #: WAL mirroring (serve/replica.py): every flushed record and
+        #: every sealed part streams to the peer directories through
+        #: the writer hooks, BEFORE the journaled change is acked when
+        #: mirror_sync (the default) — a mirror replays like the
+        #: primary on any other host
+        self.mirror = None
+        if mirror_dirs:
+            from raft_tpu.serve.replica import WalMirror
+            self.mirror = WalMirror(
+                self.path, [str(d) for d in mirror_dirs],
+                max_lag_records=mirror_max_lag, keep=4,
+                sync=mirror_sync)
         self._writer = journalio.JsonlWriter(
             self.path, max_bytes=max_bytes(), keep=4,
-            header=self._begin_record)
+            header=self._begin_record,
+            post_flush=(self.mirror.notify_flush
+                        if self.mirror is not None else None),
+            post_rotate=(
+                (lambda w, part: self.mirror.notify_rotate(w, part))
+                if self.mirror is not None else None))
 
     def _begin_record(self, part: int) -> dict:
         return {"t": round(time.time(), 6), "type": "begin",
@@ -216,6 +240,10 @@ class RequestJournal:
     def close(self):
         with self._lock:
             self._writer.close()
+        if self.mirror is not None:
+            # graceful stop: one final reconciliation leaves every peer
+            # bit-identical to the primary before the worker retires
+            self.mirror.close()
 
 
 def write_handoff_manifest(journal_dir: str, doc: dict) -> str:

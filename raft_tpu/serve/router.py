@@ -1,0 +1,562 @@
+"""Replica router: one front door over N sweep-service replicas.
+
+Layer 2 of the replicated-serving arc: a thin, stdlib-only HTTP front
+(:class:`ReplicaRouter` + :func:`make_server`) that callers hit instead
+of any single ``raftserve`` process.  It owns exactly three concerns —
+everything else is proxied verbatim to a backend:
+
+- **Admission** — a shared-secret auth header (``X-Raft-Auth``) and
+  per-tenant token-bucket quotas.  Every rejection is the same typed
+  :class:`raft_tpu.errors.AdmissionRejected` the service itself sheds
+  with, reason-coded and mapped onto HTTP: ``unauthorized`` -> 401,
+  ``quota_exceeded`` -> 429 + Retry-After (time until the bucket
+  refills a token), ``no_healthy_replica`` -> 503 + Retry-After (the
+  next health sweep).  One over-quota tenant cannot starve another —
+  buckets are per tenant, and the router never queues.
+- **Routing** — tenant-affinity first: a tenant sticks to the replica
+  that already holds its warm compiled program (the tenancy layer's
+  exec-cache economics), failing over to any healthy replica when the
+  pinned one dies mid-request (connection errors re-route within the
+  same submit, counted as failovers).
+- **Health + re-resolution** — a background loop polls every backend's
+  ``/healthz``; fetches for a request whose owning replica died are
+  *re-resolved by request digest* (``rdigest`` — the content address
+  of the submitted physics) against the surviving replicas: a
+  successor that recovered the dead replica's WAL mirror serves the
+  result under the same digest even though it never issued the
+  original ticket (``SweepService.fetch_rdigest``).
+
+The router holds no solver state and journals nothing: replicas own
+durability (their mirrored WALs), the router owns reachability.  Its
+health/proxy loops are keep-alive seams — a replica failing in any way
+must never take the router down with it.
+
+CLI: ``tools/raftserve.py route --backend URL --backend URL ...``.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from raft_tpu import errors
+from raft_tpu.serve import journal as wal
+from raft_tpu.serve.tenancy import DEFAULT_TENANT
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("serve.router")
+
+#: the shared-secret admission header
+AUTH_HEADER = "X-Raft-Auth"
+
+#: AdmissionRejected reason -> HTTP status
+REASON_HTTP = {"unauthorized": 401, "quota_exceeded": 429,
+               "no_healthy_replica": 503}
+
+
+class TokenBucket:
+    """Per-tenant admission quota: ``rate`` tokens/second, ``burst``
+    capacity.  Not thread-safe on its own (the router holds its lock)."""
+
+    def __init__(self, rate: float, burst: float = None):
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None
+                           else max(1.0, self.rate))
+        self.tokens = self.burst
+        self._t = time.monotonic()
+
+    def take(self, now: float = None) -> tuple[bool, float]:
+        """Consume one token; returns ``(admitted, retry_after_s)`` —
+        the retry hint is the exact refill time of the missing token."""
+        now = time.monotonic() if now is None else now
+        self.tokens = min(self.burst,
+                          self.tokens
+                          + max(0.0, now - self._t) * self.rate)
+        self._t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True, 0.0
+        if self.rate <= 0.0:
+            return False, 3600.0         # zero-rate tenant: hard-shed
+        return False, (1.0 - self.tokens) / self.rate
+
+
+def parse_quota(spec: str) -> tuple[float, float]:
+    """``"rate"`` or ``"rate:burst"`` -> (rate, burst)."""
+    rate, _, burst = str(spec).partition(":")
+    r = float(rate)
+    return r, float(burst) if burst.strip() else max(1.0, r)
+
+
+class _Backend:
+    __slots__ = ("url", "healthy", "checked_at", "fails", "stats")
+
+    def __init__(self, url: str):
+        self.url = str(url).rstrip("/")
+        self.healthy = False
+        self.checked_at = 0.0
+        self.fails = 0
+        self.stats = {}
+
+
+class ReplicaRouter:
+    """Health-checked, quota-guarded front over N ``raftserve``
+    replicas (see module docstring)."""
+
+    def __init__(self, backends, *, secret: str = None, quotas=None,
+                 default_quota=None, health_interval_s: float = 1.0,
+                 timeout_s: float = 30.0, track_max: int = 4096):
+        if not backends:
+            raise errors.ModelConfigError(
+                "the replica router needs at least one backend")
+        self.backends = [_Backend(u) for u in backends]
+        if len({b.url for b in self.backends}) != len(self.backends):
+            raise errors.ModelConfigError(
+                "duplicate router backend URLs",
+                backends=",".join(b.url for b in self.backends))
+        self.secret = secret
+        self.health_interval_s = float(health_interval_s)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.RLock()
+        #: explicitly-configured quotas: permanent
+        self._buckets: dict[str, TokenBucket] = {
+            str(t): TokenBucket(*q) for t, q in (quotas or {}).items()}
+        #: default-quota buckets materialize per tenant NAME a caller
+        #: sends — bounded LRU (like _requests), or an attacker cycling
+        #: tenant strings grows the router without limit
+        self._dyn_buckets: collections.OrderedDict[str, TokenBucket] = \
+            collections.OrderedDict()
+        self._default_quota = default_quota
+        #: tenant -> backend url of the replica holding its warm
+        #: program (affinity-first routing); bounded LRU like above
+        self._affinity: collections.OrderedDict[str, str] = \
+            collections.OrderedDict()
+        #: request id -> {backend, rdigest, tenant} for fetch routing
+        #: and post-mortem re-resolution; bounded FIFO
+        self._requests: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
+        self._track_max = int(track_max)
+        self._rr = 0
+        self._counts = {k: 0 for k in (
+            "routed", "failovers", "reresolved", "unauthorized",
+            "quota_exceeded", "no_healthy_replica", "proxy_errors")}
+        self._state = "new"
+        self._thread = None
+
+    # ------------------------------------------------------------------
+    # lifecycle / health
+    # ------------------------------------------------------------------
+
+    def start(self) -> "ReplicaRouter":
+        with self._lock:
+            if self._state == "running":
+                return self
+            self._state = "running"
+        self.check_now()
+        self._thread = threading.Thread(target=self._health_loop,
+                                        name="raft-router-health",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._state = "stopped"
+        if self._thread is not None:
+            self._thread.join(2.0)
+
+    def _health_loop(self):
+        while True:
+            with self._lock:
+                if self._state != "running":
+                    return
+            time.sleep(self.health_interval_s)
+            # keep-alive seam: whatever a replica (or its network path)
+            # does, the health loop must outlive it — a probe failure
+            # is that backend's unhealth, never the router's death
+            try:
+                self.check_now()
+            except Exception:
+                _LOG.exception("router: health sweep failed (retrying)")
+
+    def check_now(self):
+        """One synchronous health sweep over every backend."""
+        for b in self.backends:
+            was = b.healthy
+            try:
+                doc = self._get_json(b, "/healthz",
+                                     timeout=min(2.0,
+                                                 self.timeout_s))
+                b.healthy = bool(doc.get("ok"))
+                b.stats = {k: doc[k] for k in ("mode", "state",
+                                               "queue_depth")
+                           if k in doc}
+                b.fails = 0
+            # keep-alive seam: any probe trouble means "unhealthy",
+            # never an escaped exception
+            except Exception:
+                b.healthy = False
+                b.fails += 1
+            b.checked_at = time.time()
+            if was != b.healthy:
+                (_LOG.info if b.healthy else _LOG.warning)(
+                    "router: backend %s is %s", b.url,
+                    "healthy" if b.healthy else "UNHEALTHY")
+                self._emit("router_health", backend=b.url,
+                           healthy=b.healthy)
+        self._gauge_health()
+
+    def _gauge_health(self):
+        try:
+            from raft_tpu import obs
+            obs.gauge("raft_tpu_serve_router_healthy_replicas",
+                      "backends the router currently considers healthy"
+                      ).set(float(sum(1 for b in self.backends
+                                      if b.healthy)))
+        # telemetry guard: router metrics must never take down routing
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+
+    def _emit(self, type_: str, **fields):
+        try:
+            from raft_tpu import obs
+            obs.events.emit(type_, **fields)
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+
+    def _count(self, outcome: str):
+        with self._lock:
+            if outcome in self._counts:
+                self._counts[outcome] += 1
+        try:
+            from raft_tpu import obs
+            obs.counter("raft_tpu_serve_router_requests_total",
+                        "router admissions/outcomes, by outcome"
+                        ).inc(1.0, outcome=outcome)
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+
+    # ------------------------------------------------------------------
+    # admission (typed; the HTTP layer maps reasons onto status codes)
+    # ------------------------------------------------------------------
+
+    def admit(self, tenant: str, token: str = None):
+        """Shared-secret + per-tenant quota admission; raises the typed
+        :class:`~raft_tpu.errors.AdmissionRejected` (reasons
+        ``unauthorized`` / ``quota_exceeded`` / ``no_healthy_replica``)
+        or returns None when the request may be routed."""
+        import hmac
+        if self.secret is not None and not hmac.compare_digest(
+                str(token or ""), self.secret):
+            self._count("unauthorized")
+            self._emit("router_reject", reason="unauthorized",
+                       tenant=tenant)
+            raise errors.AdmissionRejected(
+                "router admission rejected (unauthorized)",
+                reason="unauthorized", tenant=str(tenant))
+        with self._lock:
+            bucket = self._buckets.get(str(tenant))
+            if bucket is None and self._default_quota is not None:
+                bucket = self._dyn_buckets.get(str(tenant))
+                if bucket is None:
+                    bucket = TokenBucket(*self._default_quota)
+                    self._dyn_buckets[str(tenant)] = bucket
+                else:
+                    self._dyn_buckets.move_to_end(str(tenant))
+                while len(self._dyn_buckets) > self._track_max:
+                    self._dyn_buckets.popitem(last=False)
+            if bucket is not None:
+                ok, after = bucket.take()
+                if not ok:
+                    self._count("quota_exceeded")
+                    self._emit("router_reject", reason="quota_exceeded",
+                               tenant=tenant, retry_after_s=after)
+                    raise errors.AdmissionRejected(
+                        "router admission rejected (quota_exceeded)",
+                        retry_after_s=after, reason="quota_exceeded",
+                        tenant=str(tenant))
+        if not any(b.healthy for b in self.backends):
+            self._count("no_healthy_replica")
+            self._emit("router_reject", reason="no_healthy_replica",
+                       tenant=tenant)
+            raise errors.AdmissionRejected(
+                "router admission rejected (no_healthy_replica)",
+                retry_after_s=self.health_interval_s,
+                reason="no_healthy_replica", tenant=str(tenant))
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+
+    def _healthy(self) -> list[_Backend]:
+        return [b for b in self.backends if b.healthy]
+
+    def _pick(self, tenant: str) -> list[_Backend]:
+        """Candidate backends, affinity-first: the replica already
+        holding this tenant's warm program leads, the remaining healthy
+        replicas follow round-robin as failover targets."""
+        with self._lock:
+            healthy = self._healthy()
+            pinned = self._affinity.get(str(tenant))
+            order = []
+            lead = next((b for b in healthy if b.url == pinned), None)
+            if lead is not None:
+                order.append(lead)
+            rest = [b for b in healthy if b is not lead]
+            if rest:
+                self._rr = (self._rr + 1) % len(rest)
+                order.extend(rest[self._rr:] + rest[:self._rr])
+            return order
+
+    def submit(self, doc: dict, token: str = None) -> tuple[int, dict,
+                                                            dict]:
+        """Admit + route one submission; returns ``(status, body,
+        headers)``.  Raises :class:`~raft_tpu.errors.AdmissionRejected`
+        (the HTTP layer maps it) when admission or every failover
+        candidate refuses."""
+        tenant = str(doc.get("tenant") or DEFAULT_TENANT)
+        self.admit(tenant, token)
+        import math
+        try:
+            beta = (math.radians(float(doc["heading_deg"]))
+                    if "heading_deg" in doc
+                    else float(doc.get("heading_rad", 0.0)))
+            rdigest = wal.request_digest(float(doc["hs"]),
+                                         float(doc["tp"]), beta, tenant)
+        except (KeyError, TypeError, ValueError):
+            rdigest = None               # the backend 400s it for us
+        candidates = self._pick(tenant)
+        for b in candidates:
+            try:
+                code, body, headers = self._post_json(
+                    b, "/submit", doc, timeout=self.timeout_s)
+            except (urllib.error.URLError, OSError, TimeoutError):
+                # the pinned/next replica died mid-request: mark it,
+                # fail over to the next healthy candidate
+                b.healthy = False
+                b.fails += 1
+                self._gauge_health()
+                self._count("proxy_errors")
+                self._count("failovers")
+                self._emit("router_failover", backend=b.url,
+                           tenant=tenant)
+                _LOG.warning("router: backend %s failed a submit — "
+                             "failing over", b.url)
+                continue
+            with self._lock:
+                self._affinity[tenant] = b.url
+                self._affinity.move_to_end(tenant)
+                while len(self._affinity) > self._track_max:
+                    self._affinity.popitem(last=False)
+                rid = body.get("request_id")
+                if rid:
+                    self._requests[rid] = {"backend": b.url,
+                                           "rdigest": rdigest,
+                                           "tenant": tenant}
+                    while len(self._requests) > self._track_max:
+                        self._requests.popitem(last=False)
+            self._count("routed")
+            body = {**body, "replica": b.url}
+            return code, body, headers
+        self._count("no_healthy_replica")
+        raise errors.AdmissionRejected(
+            "router admission rejected (no_healthy_replica)",
+            retry_after_s=self.health_interval_s,
+            reason="no_healthy_replica", tenant=tenant)
+
+    def result(self, rid: str = None, digest: str = None,
+               rdigest: str = None) -> tuple[int, dict]:
+        """Fetch a result: by request id against the owning replica
+        (re-resolving by request digest against the survivors when it
+        died), or by result/request digest against any healthy
+        replica."""
+        if rid:
+            with self._lock:
+                rec = self._requests.get(rid)
+            owner = None
+            if rec is not None:
+                owner = next((b for b in self.backends
+                              if b.url == rec["backend"] and b.healthy),
+                             None)
+            if owner is not None:
+                try:
+                    code, body, _ = self._get_json_full(
+                        owner, "/result?id=" + urllib.parse.quote(rid),
+                        timeout=self.timeout_s)
+                    if code != 404:
+                        return code, {**body, "replica": owner.url}
+                except (urllib.error.URLError, OSError, TimeoutError):
+                    owner.healthy = False
+                    self._gauge_health()
+                    self._count("proxy_errors")
+            # the owner is gone (or forgot the ticket): re-resolve by
+            # the request's CONTENT against the survivors — a successor
+            # that replayed the dead replica's mirror answers
+            rdigest = rdigest or (rec or {}).get("rdigest")
+            if not rdigest:
+                return 404, {"error": "unknown request id"}
+            code, body = self._fan_get(
+                "/result?rdigest=" + urllib.parse.quote(rdigest))
+            if code == 200:
+                self._count("reresolved")
+                self._emit("router_reresolve", id=rid, rdigest=rdigest)
+            return code, body
+        if digest:
+            return self._fan_get(
+                "/result?digest=" + urllib.parse.quote(digest))
+        if rdigest:
+            return self._fan_get(
+                "/result?rdigest=" + urllib.parse.quote(rdigest))
+        return 400, {"error": "need id=, digest= or rdigest="}
+
+    def _fan_get(self, path: str) -> tuple[int, dict]:
+        """Ask every healthy replica in turn; first 200 wins."""
+        last = (404, {"error": "not found on any healthy replica"})
+        for b in self._healthy():
+            try:
+                code, body, _ = self._get_json_full(
+                    b, path, timeout=self.timeout_s)
+            except (urllib.error.URLError, OSError, TimeoutError):
+                b.healthy = False
+                self._gauge_health()
+                self._count("proxy_errors")
+                continue
+            if code == 200:
+                return 200, {**body, "replica": b.url}
+        return last
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {**self._counts,
+                    "backends": {b.url: {"healthy": b.healthy,
+                                         "fails": b.fails, **b.stats}
+                                 for b in self.backends},
+                    "healthy": sum(1 for b in self.backends
+                                   if b.healthy),
+                    "affinity": dict(self._affinity),
+                    "tracked_requests": len(self._requests),
+                    "quotas": {t: {"rate": bk.rate, "burst": bk.burst}
+                               for t, bk in self._buckets.items()},
+                    "dynamic_quota_tenants": len(self._dyn_buckets),
+                    "secured": self.secret is not None}
+
+    # ------------------------------------------------------------------
+    # tiny HTTP client helpers (stdlib only)
+    # ------------------------------------------------------------------
+
+    def _get_json(self, b: _Backend, path: str, timeout: float) -> dict:
+        code, body, _ = self._get_json_full(b, path, timeout)
+        return body
+
+    @staticmethod
+    def _get_json_full(b: _Backend, path: str,
+                       timeout: float) -> tuple[int, dict, dict]:
+        req = urllib.request.Request(b.url + path, method="GET")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return (resp.status,
+                        json.loads(resp.read() or b"{}"),
+                        dict(resp.headers))
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+    @staticmethod
+    def _post_json(b: _Backend, path: str, doc: dict,
+                   timeout: float) -> tuple[int, dict, dict]:
+        data = json.dumps(doc, default=str).encode()
+        req = urllib.request.Request(
+            b.url + path, data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return (resp.status,
+                        json.loads(resp.read() or b"{}"),
+                        dict(resp.headers))
+        except urllib.error.HTTPError as e:
+            # a backend 429/400 is an ANSWER (Retry-After and all), not
+            # a dead replica — pass it through verbatim
+            return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def make_server(router: ReplicaRouter, host: str = "127.0.0.1",
+                port: int = 0):
+    """The router's stdlib HTTP server (returns it unstarted; callers
+    run ``serve_forever``).  Endpoints: ``POST /submit`` (auth +
+    quota + route), ``GET /result?id=|digest=|rdigest=``, ``GET
+    /stats``, ``GET /healthz``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):                     # pragma: no cover
+            pass
+
+        def _send(self, code: int, doc: dict, headers: dict = None):
+            data = json.dumps(doc, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):                              # noqa: N802
+            url = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(url.query)
+            if url.path == "/healthz":
+                healthy = any(b.healthy for b in router.backends)
+                self._send(200 if healthy else 503,
+                           {"ok": healthy, "role": "router",
+                            **router.stats()})
+            elif url.path == "/stats":
+                self._send(200, router.stats())
+            elif url.path == "/result":
+                code, body = router.result(
+                    rid=q.get("id", [None])[0],
+                    digest=q.get("digest", [None])[0],
+                    rdigest=q.get("rdigest", [None])[0])
+                self._send(code, body)
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):                             # noqa: N802
+            if self.path != "/submit":
+                self._send(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                doc = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": f"bad request: {e}"})
+                return
+            if not isinstance(doc, dict):
+                # a JSON array/scalar body must 400, not kill the
+                # handler thread inside router.submit
+                self._send(400, {"error": "bad request: body must be "
+                                          "a JSON object"})
+                return
+            try:
+                code, body, headers = router.submit(
+                    doc, token=self.headers.get(AUTH_HEADER))
+            except errors.AdmissionRejected as e:
+                reason = e.ctx.get("reason")
+                code = REASON_HTTP.get(reason, 429)
+                hdrs = {}
+                if code != 401:
+                    hdrs["Retry-After"] = \
+                        f"{max(1, round(e.retry_after_s))}"
+                self._send(code, e.context(), headers=hdrs)
+                return
+            fwd = {k: v for k, v in headers.items()
+                   if k.lower() == "retry-after"}
+            self._send(code, body, headers=fwd)
+
+    return ThreadingHTTPServer((host, port), Handler)

@@ -44,6 +44,12 @@ and degrading gracefully* under sustained, partially-faulty traffic:
   tenant, batches never mix tenants, and each tenant/mode's warm
   compiled program is held under an LRU live-program budget with
   journaled, metered eviction/re-warm.
+- **Replication** — with ``ServeConfig.mirror_dirs`` the WAL streams
+  to peer stores (:mod:`raft_tpu.serve.replica`): a successor on a
+  different host recovers from a mirror alone, duplicate delivery
+  across replicas dedupes by request digest
+  (:meth:`fetch_rdigest`), and mirror lag beyond budget is a typed
+  degradation signal folded into the service ladder.
 
 Results are delivered asynchronously: ``submit`` returns a
 :class:`Ticket`; each completed request carries the ledger-style
@@ -203,7 +209,10 @@ class SweepService:
         if self.cfg.journal_dir:
             self._journal = wal.RequestJournal(
                 self.cfg.journal_dir, run_id=uuid.uuid4().hex[:12],
-                snapshot_fn=self._journal_snapshot)
+                snapshot_fn=self._journal_snapshot,
+                mirror_dirs=self.cfg.mirror_dirs,
+                mirror_max_lag=self.cfg.replica_max_lag_records,
+                mirror_sync=self.cfg.mirror_sync)
         # -- tenancy: every model (including the single-model PR 9
         # shape) lives in the registry as a tenant
         self._tenants = TenantRegistry(self.cfg.max_live_programs,
@@ -240,6 +249,13 @@ class SweepService:
         self._latencies: collections.deque[float] = collections.deque(
             maxlen=10_000)
         self._delivered: collections.OrderedDict[str, SweepResult] = \
+            collections.OrderedDict()
+        #: request digest -> result digest of delivered results — the
+        #: cross-replica re-resolution index: a router (or a duplicate
+        #: submission that landed on another replica) fetches by the
+        #: CONTENT of the request when the replica that held its ticket
+        #: died; bounded alongside _delivered
+        self._rdigest_index: collections.OrderedDict[str, str] = \
             collections.OrderedDict()
         self._transitions: list[dict] = []
         self._counts = {k: 0 for k in (
@@ -391,15 +407,31 @@ class SweepService:
     def recover(self, journal_dir: str = None) -> dict:
         """Replay a write-ahead journal into this (fresh) service.
 
-        Scans ``journal_dir`` (default: the configured
-        ``cfg.journal_dir``) and
+        ``journal_dir`` (default: the configured ``cfg.journal_dir``)
+        may equally be a **mirror** directory left by the WAL
+        replication layer (:mod:`raft_tpu.serve.replica`) on a
+        different host — a mirror replays exactly like a primary, its
+        possibly-missing torn live-part tail skip-and-counted like any
+        other torn line.  ``recover`` may be called more than once on
+        the same service (own journal, then a dead peer's mirror): a
+        later replay's pending request whose request digest matches a
+        result an earlier replay already delivered resolves as a
+        **dedupe hit** — duplicate delivery across replicas never
+        re-solves.
+
+        Scans the directory and
 
         - marks every journaled **completed** result fetchable by its
           ledger digest without re-solving (``recovered``),
         - re-admits every **accepted-but-unfinished** request under its
           *original admission seq* — so the deterministic retry/backoff
           keys (``req<seq>``) line up with the crashed process —
-          returning fresh tickets for them (``replayed``),
+          returning fresh tickets for them (``replayed``); a seq this
+          life already uses (a SECOND fold whose seq space overlaps
+          the first's) is remapped onto fresh seq space, and admits
+          inherited from a foreign directory are re-journaled into our
+          own WAL (the returned ``tickets`` stay keyed by the source
+          journal's seqs either way),
         - resolves **duplicate submissions** whose request digest
           matches an already-completed one from the journal instead of
           re-solving (``deduped``), journaling the dedupe as a
@@ -421,11 +453,35 @@ class SweepService:
             raise errors.ModelConfigError(
                 "recover() needs a journal directory (config "
                 "journal_dir or the journal_dir argument)")
+        is_mirror = bool(
+            self.cfg.journal_dir
+            and os.path.abspath(str(src))
+            != os.path.abspath(str(self.cfg.journal_dir)))
         state = wal.replay(src)
         now = time.monotonic()
         tickets: dict[int, Ticket] = {}
         recovered = replayed = deduped = 0
         with self._cond:
+            # seqs below this life's high-water mark are already taken
+            # (live traffic or an earlier fold): a second folded
+            # journal's colliding seq is REMAPPED onto fresh seq space,
+            # or its _open/_replayed_pending tracking would alias the
+            # earlier request's and a rotation checkpoint could drop a
+            # still-pending admit (zero-loss broken).  Fresh seqs are
+            # allocated past BOTH this life's counter and the fold's
+            # own max_seq — a remap must never land on a seq the same
+            # fold still carries.  Tickets stay keyed by the SOURCE
+            # journal's seq — the caller's frame.
+            base_seq = self._seq
+            next_fresh = max(self._seq, state["max_seq"] + 1)
+
+            def claim_seq(orig: int) -> int:
+                nonlocal next_fresh
+                if orig >= base_seq:
+                    return orig
+                fresh, next_fresh = next_fresh, next_fresh + 1
+                return fresh
+
             for seq, rec in sorted(state["completed"].items()):
                 res = SweepResult(
                     ok=True, request_id=str(rec.get("id") or f"req{seq}"),
@@ -437,17 +493,23 @@ class SweepService:
                         "tenant", DEFAULT_TENANT)), source="recovered")
                 if rec.get("digest"):
                     self._delivered[rec["digest"]] = res
+                    if rec.get("rdigest"):
+                        self._rdigest_index[rec["rdigest"]] = \
+                            rec["digest"]
                     recovered += 1
             while len(self._delivered) > self.cfg.result_cache:
                 self._delivered.popitem(last=False)
-            for seq, prior in sorted(state["deduped"].items()):
+            while len(self._rdigest_index) > self.cfg.result_cache:
+                self._rdigest_index.popitem(last=False)
+            for orig, prior in sorted(state["deduped"].items()):
                 # the duplicate's physics already solved: deliver the
                 # journaled payload under the duplicate's seq and make
                 # it terminal in the WAL
-                dup = state["admitted"][seq]
+                dup = state["admitted"][orig]
+                seq = claim_seq(int(orig))
                 res = SweepResult(
                     ok=True, request_id=str(dup.get("id") or f"req{seq}"),
-                    seq=int(seq), mode=str(prior.get("mode", "full")),
+                    seq=seq, mode=str(prior.get("mode", "full")),
                     attempts=0, latency_s=0.0, digest=prior.get("digest"),
                     std=prior.get("std"), iters=prior.get("iters"),
                     converged=prior.get("converged"),
@@ -458,21 +520,56 @@ class SweepService:
                         seq, dup.get("rdigest"), prior.get("digest"),
                         res.mode, 0, res.std or [], res.iters or 0,
                         bool(res.converged))
-                t = Ticket(res.request_id, int(seq))
+                t = Ticket(res.request_id, seq)
                 t._finish(res)
-                tickets[int(seq)] = t
+                tickets[int(orig)] = t
                 deduped += 1
             for rec in state["pending"]:
-                seq = int(rec["seq"])
+                orig = int(rec["seq"])
+                seq = claim_seq(orig)
                 tenant = str(rec.get("tenant", DEFAULT_TENANT))
+                deadline_s = float(rec.get("deadline_s",
+                                           self.cfg.deadline_s))
+                # cross-replica dedupe: a request this service already
+                # delivered (an earlier recover — own journal or another
+                # replica's mirror — or live traffic) re-resolves from
+                # the delivered payload instead of re-solving
+                prior_digest = self._rdigest_index.get(rec.get("rdigest"))
+                prior_res = (self._delivered.get(prior_digest)
+                             if prior_digest else None)
+                if prior_res is not None:
+                    res = dataclasses.replace(
+                        prior_res,
+                        request_id=str(rec.get("id") or f"req{seq}"),
+                        seq=seq, tenant=tenant, attempts=0,
+                        latency_s=0.0, source="deduped")
+                    if self._journal is not None:
+                        self._journal.record_complete(
+                            seq, rec.get("rdigest"), res.digest,
+                            res.mode, 0, res.std or [], res.iters or 0,
+                            bool(res.converged))
+                    t = Ticket(res.request_id, seq)
+                    t._finish(res)
+                    tickets[orig] = t
+                    deduped += 1
+                    continue
                 req = _Request(seq, rec.get("Hs", 0.0),
                                rec.get("Tp", 1.0), rec.get("beta", 0.0),
-                               now + float(rec.get("deadline_s",
-                                                   self.cfg.deadline_s)),
+                               now + deadline_s,
                                now, tenant=tenant,
                                request_id=rec.get("id"))
                 req.replayed = True
-                tickets[seq] = req.ticket
+                tickets[orig] = req.ticket
+                # a foreign fold (a dead peer's mirror) replays admits
+                # OUR journal never saw: re-journal them, or a crash of
+                # THIS process before solving them would lose them from
+                # our own mirror chain — WAL-before-ack applies to
+                # inherited work too
+                if self._journal is not None and (is_mirror
+                                                  or seq != orig):
+                    self._journal.record_admit(
+                        seq, req.id, req.rdigest, req.Hs, req.Tp,
+                        req.beta, deadline_s, tenant)
                 if tenant not in self._tenants.names():
                     # the successor was configured without this tenant:
                     # a typed failure, never a silent drop
@@ -490,12 +587,19 @@ class SweepService:
                 replayed += 1
             # preserve the crashed process's seq space so new
             # admissions and replayed backoff keys can never collide
-            self._seq = max(self._seq, state["max_seq"] + 1)
+            self._seq = max(self._seq, state["max_seq"] + 1, next_fresh)
             self._cond.notify_all()
         info = {"recovered": recovered, "replayed": replayed,
                 "deduped": deduped, "corrupt": int(state["corrupt"])}
-        self._recover_info = {**info, "journal_dir": str(src),
-                              "records": int(state["records"])}
+        # accumulate across calls (own journal, then a peer's mirror);
+        # the mirror flag is sticky — ANY fold of a foreign directory
+        # makes this life a failover, which the failover SLO facts gate
+        prev = self._recover_info or {}
+        self._recover_info = {
+            **{k: prev.get(k, 0) + v for k, v in info.items()},
+            "journal_dir": str(src),
+            "records": prev.get("records", 0) + int(state["records"]),
+            "mirror": bool(prev.get("mirror")) or is_mirror}
         for outcome, n in info.items():
             if n:
                 obs.counter(
@@ -504,12 +608,13 @@ class SweepService:
                     ).inc(float(n), outcome=outcome)
         if self._journal is not None:
             self._journal.record_recover(info)
-        self._emit("journal_recovered", **info)
-        _LOG.info("serve: journal recovery — %d result(s) restored, "
+        self._emit("journal_recovered", mirror=is_mirror, **info)
+        _LOG.info("serve: journal recovery%s — %d result(s) restored, "
                   "%d request(s) re-admitted, %d deduped, %d corrupt "
-                  "line(s) skipped", recovered, replayed, deduped,
-                  state["corrupt"])
-        return {**info, "tickets": tickets}
+                  "line(s) skipped",
+                  " (from mirror)" if is_mirror else "", recovered,
+                  replayed, deduped, state["corrupt"])
+        return {**info, "mirror": is_mirror, "tickets": tickets}
 
     def drain(self, successor: str = None, timeout: float = 30.0) -> dict:
         """Gracefully hand the service off: stop admitting (callers get
@@ -768,7 +873,8 @@ class SweepService:
                     "no model available for service mode", mode=rmode,
                     tenant=tenant)
             from raft_tpu.parallel.sweep import make_batch_runner
-            return make_batch_runner(fowt, self.cfg.batch_cases, **kw)
+            return make_batch_runner(fowt, self.cfg.batch_cases,
+                                     mesh=self.cfg.mesh, **kw)
 
         return self._tenants.runner(tenant, rmode, build)
 
@@ -892,7 +998,11 @@ class SweepService:
             obs.counter("raft_tpu_serve_batches_total",
                         "batches solved by the sweep service, by mode"
                         ).inc(1.0, mode=solve_mode)
-            self._fold_health(batch_s > cfg.latency_slo_s)
+            # a WAL mirror behind its lag budget is an SLO violation
+            # too: a failover right now could lose the lagging tail, so
+            # the ladder sheds load until replication catches up
+            self._fold_health(batch_s > cfg.latency_slo_s
+                              or self._replica_degraded())
         except errors.RaftError as e:
             owned = True
             if wid is not None:
@@ -1039,8 +1149,11 @@ class SweepService:
                 self._counts["retried_recovered"] += 1
             self._latencies.append(res.latency_s)
             self._delivered[digest] = res
+            self._rdigest_index[r.rdigest] = digest
             while len(self._delivered) > self.cfg.result_cache:
                 self._delivered.popitem(last=False)
+            while len(self._rdigest_index) > self.cfg.result_cache:
+                self._rdigest_index.popitem(last=False)
             self._replayed_pending.discard(r.seq)
         self._untrack_open(r.seq)
         self._tenants.count(r.tenant, "completed")
@@ -1145,11 +1258,29 @@ class SweepService:
         with self._lock:
             return self._delivered.get(digest)
 
+    def fetch_rdigest(self, rdigest: str) -> SweepResult | None:
+        """Completed result by its REQUEST digest (the content address
+        of the submitted physics) — how a router re-resolves an
+        in-flight fetch against a successor after the replica that held
+        the original ticket died: the successor knows the request from
+        the replayed WAL even though it never issued the ticket."""
+        with self._lock:
+            digest = self._rdigest_index.get(rdigest)
+            return self._delivered.get(digest) if digest else None
+
+    def _replica_degraded(self) -> bool:
+        mirror = self._journal.mirror if self._journal is not None \
+            else None
+        return mirror is not None and mirror.lag_exceeded
+
     def stats(self) -> dict:
         with self._lock:
-            return {**self._counts, "queue_depth": len(self._queue),
-                    "mode": self.ladder[self._mode_idx],
-                    "state": self._state}
+            out = {**self._counts, "queue_depth": len(self._queue),
+                   "mode": self.ladder[self._mode_idx],
+                   "state": self._state}
+        if self._journal is not None and self._journal.mirror is not None:
+            out["replica_lag_exceeded"] = self._replica_degraded()
+        return out
 
     @staticmethod
     def _percentile(values, q: float) -> float | None:
@@ -1195,6 +1326,14 @@ class SweepService:
             out["journal"] = {"path": self._journal.path,
                               "errors": self._journal.errors}
             out["journal_errors"] = self._journal.errors
+            if self._journal.mirror is not None:
+                # replication facts (serve/replica.py): peer census,
+                # worst-peer lag, ship errors — the SLO rule
+                # serve_replication_lag_records gates the lag column
+                rep = self._journal.mirror.status()
+                out["replication"] = rep
+                out["replication_lag_records"] = rep["lag_records"]
+                out["replication_errors"] = rep["errors"]
         if handoff_info:
             out["handoff"] = handoff_info
             out["handoff_pending"] = handoff_info["pending"]
@@ -1211,4 +1350,11 @@ class SweepService:
             out["replayed_lost_count"] = replayed_open
             out["restart_warm_start"] = int(
                 any(c == "hit" for c in runners.values()))
+            if recover_info.get("mirror"):
+                # this life is a FAILOVER (it folded a foreign mirror
+                # directory): the zero-loss gate gets its own fact so
+                # the serve_failover_lost_count SLO rule skips ordinary
+                # same-host restarts
+                out["failover"] = 1
+                out["failover_lost_count"] = replayed_open
         return out

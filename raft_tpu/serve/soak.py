@@ -28,8 +28,17 @@ harness restarts against the same journal directory via
 requests lost, a warm start from the executable cache, and every
 completed request digest-identical to an uninterrupted clean run.
 
-Used by ``tools/raftserve.py soak [--kill-restart]`` (the CI chaos
-steps) and ``tests/test_serve.py`` / ``tests/test_serve_durability.py``.
+The **failover** soak (:func:`run_failover`) extends it across hosts:
+the killed child's WAL is *mirrored* to a peer store
+(:mod:`raft_tpu.serve.replica`), and the successor boots in a fresh
+directory tree — a different "host" that has never seen the primary's
+disk — recovering from **only the mirror**.  The verdict requires the
+same zero-loss, bit-for-bit digest guarantees through the replication
+layer alone.
+
+Used by ``tools/raftserve.py soak [--kill-restart|--failover]`` (the
+CI chaos steps) and ``tests/test_serve.py`` /
+``tests/test_serve_durability.py`` / ``tests/test_serve_replication.py``.
 """
 from __future__ import annotations
 
@@ -249,7 +258,9 @@ def kill_child_main(spec_json: str):
     faults.install(spec["kill_spec"])
     cfg = default_config(batch_cases=spec["batch_cases"],
                          queue_max=spec["n_requests"],
-                         journal_dir=spec["journal_dir"])
+                         journal_dir=spec["journal_dir"],
+                         mirror_dirs=tuple(spec.get("mirror_dirs")
+                                           or ()))
     Hs, Tp, beta = case_table(spec["n_requests"], seed=spec["seed"])
     svc = SweepService(fowt, cfg)
     tickets = [svc.submit(Hs[i], Tp[i], beta[i])
@@ -398,4 +409,175 @@ def run_kill_restart(design: str = "Vertical_cylinder", *,
         pre_kill_completed, info["recovered"], info["replayed"],
         info["deduped"], len(lost), len(mismatches), warm,
         report["wall_s"])
+    return report
+
+
+# ---------------------------------------------------------------------------
+# cross-host failover soak: the replication acceptance harness
+# ---------------------------------------------------------------------------
+
+def run_failover(design: str = "Vertical_cylinder", *,
+                 journal_dir: str, min_freq: float = 0.05,
+                 max_freq: float = 0.5, dfreq: float = 0.05,
+                 n_requests: int = 10, kill_at: int = 6,
+                 batch_cases: int = 4, seed: int = 2026,
+                 timeout_s: float = 600.0) -> dict:
+    """The ISSUE-acceptance replication soak — :func:`run_kill_restart`
+    taken across hosts, four directory roles under ``journal_dir``:
+
+    - ``primary/`` — host A's write-ahead journal (dies with host A);
+    - ``mirror/``  — the peer store host A's WAL streams to
+      (:mod:`raft_tpu.serve.replica`, synchronous mirroring);
+    - ``successor/`` — host B's *fresh* directory tree: its own journal
+      (and its own mirror — a failed-over service must itself be ready
+      for the NEXT failover) starts empty, and host A's ``primary/`` is
+      never read.
+
+    Phases: (1) clean in-process reference digests (warms the
+    executable cache); (2) subprocess child A admits every request into
+    the mirrored WAL and is hard-killed mid-batch
+    (``kill@serve:req=<kill_at>`` -> ``os._exit(137)``); (3) successor
+    B recovers from **only the mirror** (``recover(mirror_dir)`` on a
+    service journaling into its own fresh tree), re-solves the
+    unfinished remainder, and drains.
+
+    The verdict (``report["ok"]``) requires: the child died by the
+    injected kill; **zero accepted requests lost across the host
+    boundary** (every admitted seq in the mirror reaches a terminal
+    ``complete`` record in the mirror or the successor's journal);
+    every completed digest **bit-for-bit identical** to the
+    uninterrupted clean run; the successor's summary carries
+    ``failover=1`` with ``failover_lost_count == 0`` and a warm
+    exec-cache start."""
+    import json
+
+    from raft_tpu.serve import journal as wal
+    from raft_tpu.testing import faults
+
+    t0 = time.monotonic()
+    base = os.path.abspath(journal_dir)
+    primary_dir = os.path.join(base, "primary")
+    mirror_dir = os.path.join(base, "mirror")
+    successor_dir = os.path.join(base, "successor")
+    fowt = build_fowt(design, min_freq, max_freq, dfreq)
+    rows = case_table(n_requests, seed=seed)
+
+    # -- phase 1: clean reference digests (warms the exec cache) ------
+    faults.install("")
+    clean_cfg = default_config(batch_cases=batch_cases,
+                               queue_max=n_requests)
+    svc = SweepService(fowt, clean_cfg)
+    clean_results, _ = _run_all(svc, rows, timeout_s)
+    svc.stop()
+    clean_digests = {seq: r.digest for seq, r in clean_results.items()
+                     if r.ok}
+    if len(clean_digests) != n_requests:
+        raise errors.KernelFailure(
+            "failover soak clean pass failed",
+            completed=len(clean_digests), expected=n_requests)
+
+    # -- phase 2: child A, mirrored WAL, killed mid-batch -------------
+    spec = {"design": design, "min_freq": min_freq,
+            "max_freq": max_freq, "dfreq": dfreq,
+            "n_requests": n_requests, "batch_cases": batch_cases,
+            "seed": seed, "journal_dir": primary_dir,
+            "mirror_dirs": [mirror_dir],
+            "kill_spec": f"kill@serve:req={int(kill_at)}",
+            "timeout_s": timeout_s}
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = {**os.environ, "RAFT_TPU_FAULTS": ""}
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    child = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from raft_tpu.serve import soak; "
+         "soak.kill_child_main(sys.argv[1])", json.dumps(spec)],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    killed = child.returncode == 137
+    if not killed:
+        _LOG.error("failover soak: child exited %d, not the injected "
+                   "kill\nstderr tail:\n%s", child.returncode,
+                   "\n".join(child.stderr.splitlines()[-15:]))
+
+    mid = wal.replay(mirror_dir)
+    pre_kill_completed = len(mid["completed"])
+    mirror_admitted = set(mid["admitted"])
+
+    # -- phase 3: successor B, fresh tree, recovers from ONLY the
+    # mirror ------------------------------------------------------------
+    faults.install("")
+    try:
+        cfg = default_config(
+            batch_cases=batch_cases, queue_max=n_requests,
+            journal_dir=os.path.join(successor_dir, "journal"),
+            mirror_dirs=(os.path.join(successor_dir, "mirror"),))
+        svc = SweepService(fowt, cfg)
+        info = svc.recover(mirror_dir)
+        svc.start()
+        replay_results = {}
+        deadline = time.monotonic() + timeout_s
+        for seq, t in sorted(info["tickets"].items()):
+            replay_results[seq] = t.result(
+                max(0.5, deadline - time.monotonic()))
+        handoff = svc.drain()
+        summary = svc.summary()
+    finally:
+        faults.clear()
+
+    # -- verdict: fold the mirror and the successor's own journal -----
+    final_mirror = wal.replay(mirror_dir)
+    final_succ = wal.replay(cfg.journal_dir)
+    completed = {seq: rec.get("digest")
+                 for seq, rec in final_mirror["completed"].items()}
+    for seq, rec in final_succ["completed"].items():
+        completed.setdefault(seq, rec.get("digest"))
+    failed = set(final_mirror["failed"]) | set(final_succ["failed"])
+    mismatches = []
+    for seq in range(n_requests):
+        if completed.get(seq) != clean_digests.get(seq):
+            mismatches.append({"seq": seq,
+                               "clean": clean_digests.get(seq),
+                               "final": completed.get(seq)})
+    lost = sorted(set(range(n_requests)) - set(completed) - failed)
+    warm = int(summary.get("restart_warm_start", 0))
+    report = {
+        "n_requests": n_requests,
+        "kill_spec": spec["kill_spec"],
+        "killed": killed,
+        "child_rc": child.returncode,
+        "mirror_admitted": len(mirror_admitted),
+        "pre_kill_completed": pre_kill_completed,
+        "recover": {k: info[k] for k in
+                    ("recovered", "replayed", "deduped", "corrupt")},
+        "recovered_from_mirror_only": True,
+        "replayed_ok": sum(1 for r in replay_results.values() if r.ok),
+        "lost": lost,
+        "digest_mismatches": mismatches,
+        "restart_warm_start": warm,
+        "failover": summary.get("failover"),
+        "failover_lost_count": summary.get("failover_lost_count"),
+        "replication": (wal.replay(primary_dir)["records"],
+                        final_mirror["records"]),
+        "handoff": handoff,
+        "summary": summary,
+        "wall_s": time.monotonic() - t0,
+        "ok": (killed
+               and len(mirror_admitted) == n_requests
+               and not lost and not mismatches
+               and summary.get("unhandled", 0) == 0
+               and summary.get("failover") == 1
+               and summary.get("failover_lost_count") == 0
+               and summary.get("replication_lag_records") == 0
+               and not failed),
+    }
+    lvl = _LOG.info if report["ok"] else _LOG.error
+    lvl("failover soak: %s — child rc=%d, %d/%d admits on the mirror, "
+        "%d completed pre-kill, %d recovered / %d replayed / %d "
+        "deduped from the MIRROR alone, %d lost, %d digest "
+        "mismatch(es), warm_start=%d, %.1fs",
+        "OK" if report["ok"] else "FAILED", child.returncode,
+        len(mirror_admitted), n_requests, pre_kill_completed,
+        info["recovered"], info["replayed"], info["deduped"],
+        len(lost), len(mismatches), warm, report["wall_s"])
     return report

@@ -17,15 +17,16 @@ sweep     ``parallel.sweep.sweep_cases`` after the batched solve
 exec_cache  ``parallel.exec_cache.load`` on the deserialized bytes
 serve     ``serve.service`` request worker (per-request, pre/post solve)
 journal   ``serve.journal`` write-ahead journal writes
+replica   ``serve.replica`` WAL mirroring to peer stores
 ========  ==========================================================
 
 Spec grammar (comma-separated specs)::
 
     RAFT_TPU_FAULTS="<action>@<site>[:qualifier]*[,...]"
 
-    action     nan | raise | corrupt | hang | kill | torn
-    qualifier  case=N | lane=N | fowt=N | req=N | once | times=K
-               | s=SECONDS | ms=MILLIS  (hang duration)
+    action     nan | raise | corrupt | hang | kill | torn | drop | lag
+    qualifier  case=N | lane=N | fowt=N | req=N | part=N | once | times=K
+               | s=SECONDS | ms=MILLIS  (hang/lag duration)
 
 Examples: ``nan@dynamics:case=2`` poisons case 2's converged impedance
 with NaN (exercising the non-finite sanitizer and the ladder);
@@ -55,9 +56,10 @@ _FIRED: dict[tuple, int] = {}
 #: ambient matching context (case/fowt/lane) — host-single-threaded
 _CONTEXT: list[dict] = []
 
-_ACTIONS = ("nan", "raise", "corrupt", "hang", "kill", "torn")
+_ACTIONS = ("nan", "raise", "corrupt", "hang", "kill", "torn", "drop",
+            "lag")
 _SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache",
-          "serve", "journal")
+          "serve", "journal", "replica")
 
 #: exception class raised per site for ``raise@<site>`` specs.  Site/
 #: action support: statics, dynamics, kernel take ``nan`` and ``raise``;
@@ -72,7 +74,13 @@ _SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache",
 #: hard-exits the process mid-batch via ``os._exit`` — the crash the
 #: serve write-ahead journal recovers from); journal (the WAL write
 #: seam in raft_tpu/serve/journal.py) takes ``torn`` only (truncate
-#: the freshly-written record mid-line — the torn tail readers skip).
+#: the freshly-written record mid-line — the torn tail readers skip);
+#: replica (the WAL-mirroring seam in raft_tpu/serve/replica.py) takes
+#: ``drop`` (``drop@replica:part=N`` swallows the one-shot ship of a
+#: freshly-sealed journal part — the catch-up resync must recover it)
+#: and ``lag`` (``lag@replica:s=S`` defers mirroring by S seconds so
+#: per-peer lag grows and the typed ``ReplicaLagExceeded`` degradation
+#: signal trips) and nothing else.
 _RAISES = {
     "statics": errors.StaticsDivergence,
     "dynamics": errors.DynamicsSingular,
@@ -98,10 +106,20 @@ _UNSUPPORTED = {("raise", "exec_cache"), ("corrupt", "statics"),
 _UNSUPPORTED |= {("kill", s) for s in _SITES if s != "serve"}
 _UNSUPPORTED |= {("torn", s) for s in _SITES if s != "journal"}
 _UNSUPPORTED |= {(a, "journal") for a in _ACTIONS if a != "torn"}
+# drop/lag are replica-only, and the replica site takes nothing else
+_UNSUPPORTED |= {("drop", s) for s in _SITES if s != "replica"}
+_UNSUPPORTED |= {("lag", s) for s in _SITES if s != "replica"}
+_UNSUPPORTED |= {(a, "replica") for a in _ACTIONS
+                 if a not in ("drop", "lag")}
 
 #: default stall of a ``hang@serve`` spec without an ``s=``/``ms=``
 #: qualifier — long enough to trip any realistic watchdog deadline
 _DEFAULT_HANG_S = 30.0
+
+#: default mirroring deferral of a ``lag@replica`` spec without an
+#: ``s=``/``ms=`` qualifier — long enough that a steady request stream
+#: outruns any realistic per-peer lag budget
+_DEFAULT_LAG_S = 2.0
 
 
 def _parse_one(spec: str) -> dict | None:
@@ -116,6 +134,8 @@ def _parse_one(spec: str) -> dict | None:
              "spec": spec.strip()}
     if action == "hang":
         fault["hang_s"] = _DEFAULT_HANG_S
+    elif action == "lag":
+        fault["lag_s"] = _DEFAULT_LAG_S
     for q in filter(None, (s.strip() for s in quals.split(":"))):
         if q == "once":
             fault["times"] = 1
@@ -125,12 +145,14 @@ def _parse_one(spec: str) -> dict | None:
             except ValueError:
                 return None          # malformed spec: drop, never crash
         elif q.startswith("s=") or q.startswith("ms="):
-            # hang-duration qualifiers are fault facts, not match keys
+            # duration qualifiers (hang stall / replica-mirroring lag)
+            # are fault facts, not match keys
             try:
                 val = float(q.split("=", 1)[1])
             except ValueError:
                 return None
-            fault["hang_s"] = val / 1000.0 if q.startswith("ms=") else val
+            dur = val / 1000.0 if q.startswith("ms=") else val
+            fault["lag_s" if action == "lag" else "hang_s"] = dur
         elif "=" in q:
             k, v = q.split("=", 1)
             try:
@@ -202,11 +224,14 @@ def _ambient() -> dict:
     return out
 
 
-def fire_info(site: str, **ctx) -> dict | None:
+def fire_info(site: str, action: str = None, **ctx) -> dict | None:
     """Return the first active fault dict matching ``site`` and the
     (explicit + ambient) context, honoring ``once``/``times=``; None
     when nothing matches.  The caller applies ``fault["action"]`` (and
-    reads per-action facts such as ``hang_s``)."""
+    reads per-action facts such as ``hang_s``).  ``action`` restricts
+    matching to specs of that action — a seam that only implements one
+    action (the replica hooks: flush=lag, rotate=drop) must not burn
+    another spec's ``once``/``times=`` budget on a non-match."""
     faults = _active()
     if not faults:
         return None
@@ -214,6 +239,8 @@ def fire_info(site: str, **ctx) -> dict | None:
     facts.update({k: v for k, v in ctx.items() if v is not None})
     for f in faults:
         if f["site"] != site:
+            continue
+        if action is not None and f["action"] != action:
             continue
         if any(facts.get(k) != v for k, v in f["match"].items()):
             continue

@@ -406,7 +406,9 @@ _RTL004_SERVE_OPTS = {"rtl004": {
                           "raft_tpu/testing/faults.py", "raft_tpu/obs",
                           "raft_tpu/serve/service.py",
                           "raft_tpu/serve/watchdog.py",
-                          "raft_tpu/serve/journal.py"],
+                          "raft_tpu/serve/journal.py",
+                          "raft_tpu/serve/replica.py",
+                          "raft_tpu/serve/router.py"],
 }}
 
 _SERVE_SEAM_SRC = """
@@ -490,6 +492,45 @@ def test_rtl004_durability_modules_fixture_pair(tmp_path):
                     options=_RTL004_SERVE_OPTS)
     assert len(rep2.findings) == 1
     assert "raise RuntimeError" in rep2.findings[0].message
+
+
+_REPLICATION_SRC = """
+    from raft_tpu import errors
+
+    def health_sweep(backends):
+        for b in backends:
+            try:
+                b.probe()
+            except Exception:       # keep-alive seam
+                b.healthy = False
+
+    def ship(rec, peer):
+        if peer.gone:
+            raise RuntimeError("untyped replication failure")
+"""
+
+
+def test_rtl004_replication_modules_fixture_pair(tmp_path):
+    """serve/replica.py and serve/router.py are solve-path modules with
+    config-sanctioned keep-alive seams: the broad except (a peer store
+    / backend failing must never take the mirror or router down) is
+    silent INSIDE them and fires in any other serve file; the untyped
+    raise fires everywhere (replication trouble must be the typed
+    ReplicaLagExceeded / AdmissionRejected)."""
+    for seam in ("raft_tpu/serve/replica.py",
+                 "raft_tpu/serve/router.py"):
+        rep = lint_src(tmp_path, _REPLICATION_SRC, "RTL004",
+                       relname=seam, options=_RTL004_SERVE_OPTS)
+        assert len(rep.findings) == 1, seam
+        assert "raise RuntimeError" in rep.findings[0].message
+    # identical file anywhere else in serve/: BOTH fire
+    rep2 = lint_src(tmp_path, _REPLICATION_SRC, "RTL004",
+                    relname="raft_tpu/serve/mirroring.py",
+                    options=_RTL004_SERVE_OPTS)
+    msgs = [f.message for f in rep2.findings]
+    assert len(msgs) == 2
+    assert any("except" in m for m in msgs)
+    assert any("raise RuntimeError" in m for m in msgs)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,834 @@
+"""Replicated serving (raft_tpu/serve replica + router + failover).
+
+Unit tier (stub batch engines, no solves): the journalio replication
+hooks, the drop/lag fault grammar, WAL mirroring parity (mirror ==
+primary replay, rotation parity, torn mirror tail skip-and-counted),
+catch-up resync after a dropped part, the typed ``ReplicaLagExceeded``
+degradation signal (and its fold into the service ladder), recovery
+from a mirror alone in a fresh directory tree, duplicate delivery
+across replicas deduped by request digest, the replica router
+(token-bucket quotas, shared-secret auth, tenant-affinity routing,
+failover, re-resolution by rdigest), the replication/failover
+trend-store facts + SLO rules, and the ``bench.py serve``
+sustained-throughput facts.
+
+Integration tier (one coarse Vertical_cylinder model): a meshed
+service reproduces the unmeshed digests on virtual devices, and the
+ISSUE failover acceptance — child A's mirrored WAL SIGKILLed
+mid-batch, successor B recovering from ONLY the mirror in a fresh
+tree with zero accepted requests lost and bit-for-bit digest parity.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from raft_tpu import errors, obs
+from raft_tpu.obs import journalio
+from raft_tpu.serve import ReplicaRouter, ServeConfig, SweepService
+from raft_tpu.serve import journal as wal
+from raft_tpu.serve.replica import WalMirror
+from raft_tpu.serve.router import TokenBucket, make_server, parse_quota
+from raft_tpu.testing import faults
+
+
+def stub_factory(mode, fowt, ncases, **kw):
+    """Deterministic instant batch engine (std row = Hs replicated)."""
+    def run(Hs, Tp, beta):
+        Hs = np.asarray(Hs)
+        return {"std": np.stack([np.full(6, float(h)) for h in Hs]),
+                "iters": np.full(len(Hs), 3),
+                "converged": np.ones(len(Hs), bool)}
+    run.ncases = ncases
+    run.cache_state = "stub"
+    return run
+
+
+def _cfg(journal_dir=None, mirror_dirs=None, **kw):
+    base = dict(queue_max=16, batch_cases=2, window_s=0.02,
+                batch_deadline_s=5.0, retry_base_s=0.01,
+                degrade_after=99)
+    if journal_dir is not None:
+        base["journal_dir"] = str(journal_dir)
+    if mirror_dirs is not None:
+        base["mirror_dirs"] = tuple(str(d) for d in mirror_dirs)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# unit: journalio replication hooks
+# ---------------------------------------------------------------------------
+
+def test_journalio_post_flush_and_post_rotate_hooks(tmp_path):
+    calls = {"flush": 0, "rotate": []}
+    w = journalio.JsonlWriter(
+        str(tmp_path / "j.jsonl"), max_bytes=80, keep=2,
+        post_flush=lambda writer: calls.__setitem__(
+            "flush", calls["flush"] + 1),
+        post_rotate=lambda writer, sealed: calls["rotate"].append(sealed))
+    for i in range(6):
+        w.write({"type": "rec", "n": i, "pad": "x" * 30})
+    w.close()
+    # every write+flush notified; each sealed generation notified with
+    # its part index, in order
+    assert calls["flush"] >= 6
+    assert calls["rotate"] == list(range(len(calls["rotate"])))
+    assert len(calls["rotate"]) >= 2
+
+    # a broken hook must never break the write itself
+    w2 = journalio.JsonlWriter(
+        str(tmp_path / "k.jsonl"),
+        post_flush=lambda writer: (_ for _ in ()).throw(OSError("peer")))
+    w2.write({"type": "rec"})
+    w2.close()
+    assert [d["type"] for d in journalio.read(str(tmp_path / "k.jsonl"))] \
+        == ["rec"]
+
+
+# ---------------------------------------------------------------------------
+# unit: drop/lag fault grammar
+# ---------------------------------------------------------------------------
+
+def test_faults_drop_lag_grammar():
+    specs = faults.parse(
+        "drop@replica:part=2,lag@replica:s=1.5,lag@replica:ms=250,"  # ok
+        "drop@serve,lag@journal,nan@replica,raise@replica,"          # no
+        "hang@replica,kill@replica,torn@replica,corrupt@replica")    # no
+    assert [(f["action"], f["site"]) for f in specs] == \
+        [("drop", "replica"), ("lag", "replica"), ("lag", "replica")]
+    assert specs[0]["match"] == {"part": 2}
+    assert specs[1]["lag_s"] == 1.5
+    assert specs[2]["lag_s"] == 0.25
+    # a bare lag spec carries the default deferral
+    assert faults.parse("lag@replica")[0]["lag_s"] == 2.0
+    faults.install("drop@replica:part=1:once")
+    try:
+        assert faults.fire("replica", part=0) is None
+        assert faults.fire("replica", part=1) == "drop"
+        assert faults.fire("replica", part=1) is None     # once
+    finally:
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# unit: WAL mirroring parity
+# ---------------------------------------------------------------------------
+
+def test_mirror_matches_primary_replay(tmp_path):
+    primary, mirror = str(tmp_path / "p"), str(tmp_path / "m")
+    j = wal.RequestJournal(primary, run_id="r", mirror_dirs=[mirror])
+    rd = [wal.request_digest(1.0 + i, 8.0, 0.0) for i in range(4)]
+    for i in range(4):
+        j.record_admit(i, f"req{i}", rd[i], 1.0 + i, 8.0, 0.0, 60.0,
+                       "default")
+    j.record_batch(0, [0, 1], "full", "default")
+    j.record_complete(0, rd[0], "sha256:d0", "full", 0, [1.0] * 6, 3,
+                      True)
+    j.record_fail(1, rd[1], {"error": "NonFiniteResult"}, False)
+    # synchronous mirroring: the peer is current BEFORE close
+    assert j.mirror.status()["lag_records"] == 0
+    j.close()
+    sp, sm = wal.replay(primary), wal.replay(mirror)
+    # the mirror replays EXACTLY like the primary
+    assert sp["admitted"].keys() == sm["admitted"].keys()
+    assert sp["completed"].keys() == sm["completed"].keys()
+    assert sp["failed"].keys() == sm["failed"].keys()
+    assert [r["seq"] for r in sp["pending"]] == \
+        [r["seq"] for r in sm["pending"]] == [2, 3]
+    assert sp["records"] == sm["records"]
+    assert sm["by_rdigest"][rd[0]]["digest"] == "sha256:d0"
+
+
+def test_mirror_rotation_parity_and_two_peers(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_SERVE_JOURNAL_MAX_BYTES", "600")
+    primary = str(tmp_path / "p")
+    peers = [str(tmp_path / "m1"), str(tmp_path / "m2")]
+    j = wal.RequestJournal(primary, run_id="r", mirror_dirs=peers)
+    for i in range(40):
+        j.record_admit(i, f"r{i}", f"s{i}", 1.0, 8.0, 0.0, 60.0,
+                       "default")
+    assert j._writer.part >= 2          # really rotated
+    j.close()
+    sp = wal.replay(primary)
+    for peer in peers:
+        sm = wal.replay(peer)
+        assert sm["admitted"].keys() == sp["admitted"].keys()
+        assert sm["records"] == sp["records"]
+    st = j.mirror.status()
+    assert st["lag_records"] == 0 and st["errors"] == 0
+    assert set(st["peers"]) == set(peers)
+
+
+def test_drop_fault_catchup_resync(tmp_path, monkeypatch):
+    """ISSUE satellite: ``drop@replica:part=N`` swallows one sealed
+    part's ship; the peer visibly lags (metric + lag accounting) until
+    a reconciliation pass re-ships it by size comparison."""
+    monkeypatch.setenv("RAFT_TPU_SERVE_JOURNAL_MAX_BYTES", "600")
+    primary, mirror = str(tmp_path / "p"), str(tmp_path / "m")
+    faults.install("drop@replica:part=0")
+    try:
+        j = wal.RequestJournal(primary, run_id="r",
+                               mirror_dirs=[mirror])
+        while j._writer.part == 0:      # exactly one rotation
+            j.record_tenant("evict", "default", "full")
+        # one post-rotation write so the lag gauge refolds with the
+        # swallowed sealed part on the books
+        j.record_tenant("evict", "default", "full")
+        lags = j.mirror.lag_records()
+        assert max(lags.values()) > 0
+        assert not os.path.exists(
+            os.path.join(mirror, wal.FILENAME + ".1"))
+        snap = obs.snapshot()
+        g = snap["raft_tpu_serve_wal_replication_lag_records"]["series"]
+        assert any(s["labels"] == {"peer": mirror} and s["value"] > 0
+                   for s in g)
+        # catch-up resync converges by size reconciliation
+        j.mirror.sync_now()
+        assert max(j.mirror.lag_records().values()) == 0
+        assert os.path.exists(
+            os.path.join(mirror, wal.FILENAME + ".1"))
+        j.close()
+    finally:
+        faults.clear()
+    assert wal.replay(mirror)["records"] == wal.replay(primary)["records"]
+
+
+def test_lag_fault_trips_typed_replica_lag_exceeded(tmp_path):
+    """ISSUE satellite: ``lag@replica:s=S`` defers mirroring; lag past
+    the budget raises the typed degradation signal from ``check()``,
+    and a graceful close catches the peer up and clears it."""
+    primary, mirror = str(tmp_path / "p"), str(tmp_path / "m")
+    faults.install("lag@replica:s=30")
+    try:
+        j = wal.RequestJournal(primary, run_id="r",
+                               mirror_dirs=[mirror], mirror_max_lag=3)
+        for i in range(6):
+            j.record_admit(i, f"r{i}", f"s{i}", 1.0, 8.0, 0.0, 60.0,
+                           "default")
+        assert j.mirror.lag_exceeded
+        with pytest.raises(errors.ReplicaLagExceeded) as exc:
+            j.mirror.check()
+        assert exc.value.ctx["max_lag_records"] == 3
+        assert exc.value.ctx["lag"] >= 4
+    finally:
+        faults.clear()
+    j.close()                            # final sync, fault cleared
+    assert not j.mirror.lag_exceeded
+    assert j.mirror.status()["lag_records"] == 0
+    assert wal.replay(mirror)["records"] == wal.replay(primary)["records"]
+
+
+def test_mirror_config_validation():
+    with pytest.raises(errors.ModelConfigError):
+        ServeConfig(mirror_dirs=("peer",))          # mirrors need a WAL
+    with pytest.raises(errors.ModelConfigError):
+        ServeConfig(journal_dir="j", mirror_dirs=("j",))  # self-mirror
+    with pytest.raises(errors.ModelConfigError):
+        ServeConfig(journal_dir="j", mirror_dirs=("m",),
+                    replica_max_lag_records=0)
+
+
+# ---------------------------------------------------------------------------
+# unit: service-level replication + failover semantics
+# ---------------------------------------------------------------------------
+
+def test_service_mirrors_wal_and_reports_replication_facts(tmp_path):
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path / "primary",
+                                   [tmp_path / "mirror"]))
+    svc.start()
+    t = svc.submit(2.0, 9.0, 0.0)
+    assert t.result(10.0).ok
+    # fetch by REQUEST digest (the router's re-resolution path)
+    res = svc.fetch_rdigest(wal.request_digest(2.0, 9.0, 0.0))
+    assert res is not None and res.seq == t.seq
+    assert svc.fetch_rdigest("sha256:nope") is None
+    summary = svc.stop()
+    assert summary["replication_lag_records"] == 0
+    assert summary["replication_errors"] == 0
+    assert summary["replication"]["peers"]
+    # mirrored-but-never-recovered lives carry NO failover facts: the
+    # cross-host SLO rules must skip ordinary rows
+    assert "failover" not in summary and "failover_lost_count" not in summary
+
+
+def test_recover_from_mirror_only_in_fresh_tree(tmp_path):
+    """The tentpole recover semantics: host A's mirrored WAL replays on
+    host B from ONLY the mirror — fresh journal tree, the primary never
+    read, a torn mirror live-part tail skip-and-counted — with failover
+    facts on the successor's summary."""
+    # host A: a live mirrored service completes seq0; seq1's batch
+    # wedges mid-solve (the gate) and A's WAL writer is torn away —
+    # the admit reached primary AND mirror before the ticket returned
+    # (WAL-before-ack), the complete never will: exactly the
+    # killed-mid-batch window
+    gate = threading.Event()
+
+    def gated_factory(mode, fowt, ncases, **kw):
+        inner = stub_factory(mode, fowt, ncases, **kw)
+
+        def run(Hs, Tp, beta):
+            if float(np.asarray(Hs)[0]) == 5.0:
+                gate.wait(20.0)          # the doomed batch hangs here
+            return inner(Hs, Tp, beta)
+        run.ncases = ncases
+        return run
+
+    a = SweepService(runner_factory=gated_factory,
+                     config=_cfg(tmp_path / "A" / "journal",
+                                 [tmp_path / "shared-mirror"],
+                                 batch_cases=1,
+                                 batch_deadline_s=60.0))
+    done = a.submit(2.0, 9.0, 0.0)
+    pend = a.submit(5.0, 9.0, 0.0)
+    a.start()
+    d0 = done.result(10.0).digest
+    time.sleep(0.2)                      # the doomed batch reaches the
+    a._journal._writer.close()           # gate; then "host A dies"
+    # the mirror additionally carries a torn live-part tail (the dying
+    # write a crash can leave) that the PRIMARY never got
+    mirror_live = os.path.join(str(tmp_path / "shared-mirror"),
+                               wal.FILENAME)
+    with open(mirror_live, "ab") as f:
+        f.write(b'{"type":"admit","seq":9')       # torn mirror tail
+    # host B: FRESH tree, recovers from the mirror alone
+    b = SweepService(runner_factory=stub_factory,
+                     config=_cfg(tmp_path / "B" / "journal",
+                                 [tmp_path / "B" / "mirror"]))
+    info = b.recover(str(tmp_path / "shared-mirror"))
+    assert info["mirror"] is True
+    assert info["recovered"] == 1 and info["replayed"] == 1
+    assert info["corrupt"] == 1          # the torn mirror tail, counted
+    assert b.fetch(d0).source == "recovered"
+    b.start()
+    r = info["tickets"][pend.seq].result(10.0)
+    assert r.ok and r.source == "replayed" and r.seq == pend.seq
+    summary = b.stop()
+    assert summary["failover"] == 1
+    assert summary["failover_lost_count"] == 0
+    assert summary["replayed_lost_count"] == 0
+    # B's own journal now carries the replayed complete — the NEXT
+    # failover (from B's mirror) would re-deliver without re-solving
+    sb = wal.replay(str(tmp_path / "B" / "journal"))
+    assert pend.seq in sb["completed"]
+    # and B's own mirror is current (a failed-over service is itself
+    # failover-ready)
+    sbm = wal.replay(str(tmp_path / "B" / "mirror"))
+    assert pend.seq in sbm["completed"]
+    gate.set()                           # release A's wedged worker
+    a.stop(timeout=5.0)
+
+
+def test_duplicate_delivery_across_replicas_dedupes_by_rdigest(tmp_path):
+    """ISSUE satellite: the same physics admitted on TWO replicas (a
+    router retry straddling a failover) resolves once — the second
+    replay recognizes the request digest and re-delivers the payload
+    instead of re-solving."""
+    solves = {"n": 0}
+
+    def counting_factory(mode, fowt, ncases, **kw):
+        inner = stub_factory(mode, fowt, ncases, **kw)
+
+        def run(Hs, Tp, beta):
+            solves["n"] += 1
+            return inner(Hs, Tp, beta)
+        run.ncases = ncases
+        return run
+
+    rd = wal.request_digest(2.0, 9.0, 0.0)
+    # replica A completed the request (its WAL says so)
+    ja = wal.RequestJournal(str(tmp_path / "walA"), run_id="A")
+    ja.record_admit(0, "reqA", rd, 2.0, 9.0, 0.0, 60.0, "default")
+    ja.record_complete(0, rd, "sha256:dA", "full", 0, [2.0] * 6, 3,
+                       True)
+    ja.close()
+    # replica B admitted the SAME physics but died before solving
+    jb = wal.RequestJournal(str(tmp_path / "walB"), run_id="B")
+    jb.record_admit(3, "reqB", rd, 2.0, 9.0, 0.0, 60.0, "default")
+    jb.close()
+    svc = SweepService(runner_factory=counting_factory,
+                       config=_cfg(tmp_path / "journal"))
+    svc.recover(str(tmp_path / "walA"))
+    info = svc.recover(str(tmp_path / "walB"))
+    res = info["tickets"][3].result(1.0)
+    assert res.ok and res.source == "deduped"
+    assert res.digest == "sha256:dA" and res.request_id == "reqB"
+    assert info["deduped"] == 1 and solves["n"] == 0
+    summary = svc.stop()
+    assert summary["recovery"]["recovered"] == 1
+    assert summary["recovery"]["deduped"] == 1
+    # the dedupe was journaled terminal: B's seq replays complete here
+    assert 3 in wal.replay(str(tmp_path / "journal"))["completed"]
+
+
+def test_second_fold_remaps_colliding_seqs_never_aliases(tmp_path):
+    """Two dead replicas' journals both carry a pending seq 3 with
+    DIFFERENT physics: folding both must remap the second onto fresh
+    seq space (no _open/_replayed_pending aliasing), re-journal the
+    inherited admits into OUR WAL, and solve BOTH requests — the
+    zero-loss guarantee across overlapping seq spaces."""
+    ja = wal.RequestJournal(str(tmp_path / "walA"), run_id="A")
+    ja.record_admit(3, "reqA3", wal.request_digest(2.0, 9.0, 0.0),
+                    2.0, 9.0, 0.0, 60.0, "default")
+    ja.close()
+    # journal B overlaps A's seq space (pending 3) AND carries a
+    # pending seq (10) ABOVE this life's post-fold-A counter — a remap
+    # of B's seq 3 must not land on B's own still-unprocessed seq 10
+    jb = wal.RequestJournal(str(tmp_path / "walB"), run_id="B")
+    jb.record_admit(3, "reqB3", wal.request_digest(7.0, 9.0, 0.0),
+                    7.0, 9.0, 0.0, 60.0, "default")
+    jb.record_admit(10, "reqB10", wal.request_digest(8.0, 9.0, 0.0),
+                    8.0, 9.0, 0.0, 60.0, "default")
+    jb.close()
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path / "journal", batch_cases=1))
+    infoA = svc.recover(str(tmp_path / "walA"))
+    infoB = svc.recover(str(tmp_path / "walB"))
+    # both callers address their ticket by THEIR journal's seq
+    ta, tb = infoA["tickets"][3], infoB["tickets"][3]
+    tb10 = infoB["tickets"][10]
+    assert ta is not tb
+    # ... and the service tracks three distinct open requests
+    assert len(svc._journal_snapshot()) == 3
+    svc.start()
+    ra, rb, rb10 = ta.result(10.0), tb.result(10.0), tb10.result(10.0)
+    summary = svc.stop()
+    assert ra.ok and rb.ok and rb10.ok
+    assert len({ra.digest, rb.digest, rb10.digest}) == 3
+    assert ra.seq == 3                        # first fold keeps seqs
+    assert rb10.seq == 10                     # non-colliding seq kept
+    assert rb.seq > 10                        # remapped PAST the
+    assert ra.request_id == "reqA3"           # fold's own max_seq
+    assert rb.request_id == "reqB3" and rb10.request_id == "reqB10"
+    assert summary["replayed"] == 3
+    assert summary["replayed_lost_count"] == 0
+    # the inherited admits were re-journaled: OUR journal replays all
+    # three terminal on its own
+    state = wal.replay(str(tmp_path / "journal"))
+    assert state["pending"] == []
+    assert {ra.seq, rb.seq, rb10.seq} <= set(state["completed"])
+
+
+def test_replica_lag_folds_into_service_degradation_ladder(tmp_path):
+    """A mirror behind budget is an SLO violation the ladder acts on:
+    consecutive lagging batches step the service into ``reject`` and
+    admission sheds with the typed degraded reason."""
+    faults.install("lag@replica:s=30")
+    try:
+        svc = SweepService(
+            runner_factory=stub_factory,
+            config=_cfg(tmp_path / "p", [tmp_path / "m"],
+                        batch_cases=1, degrade_after=2,
+                        replica_max_lag_records=1, reject_hold_s=60.0))
+        svc.start()
+        deadline = time.monotonic() + 10.0
+        seq = 0
+        while svc.mode != "reject" and time.monotonic() < deadline:
+            try:
+                svc.submit(1.0 + seq, 8.0, 0.0).result(5.0)
+            except errors.AdmissionRejected:
+                break
+            seq += 1
+        assert svc.stats()["replica_lag_exceeded"] is True
+        assert svc.mode == "reject"
+        with pytest.raises(errors.AdmissionRejected) as exc:
+            svc.submit(9.0, 8.0, 0.0)
+        assert exc.value.ctx["reason"] == "degraded"
+    finally:
+        faults.clear()
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# unit: the replica router
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_and_quota_parsing():
+    assert parse_quota("2.5") == (2.5, 2.5)
+    assert parse_quota("10:40") == (10.0, 40.0)
+    b = TokenBucket(1.0, 2.0)
+    now = time.monotonic() + 100.0
+    ok1, _ = b.take(now)
+    ok2, _ = b.take(now)
+    ok3, after = b.take(now)
+    assert (ok1, ok2, ok3) == (True, True, False)
+    assert after == pytest.approx(1.0)   # exactly one refill away
+    ok4, _ = b.take(now + 1.0)
+    assert ok4
+    # zero-rate tenant: hard shed with a bounded hint
+    blocked = TokenBucket(0.0, 1.0)
+    assert blocked.take(now)[0] is True
+    ok, after = blocked.take(now)
+    assert not ok and after == 3600.0
+
+
+def test_router_typed_admission_reasons():
+    router = ReplicaRouter(["http://127.0.0.1:9"], secret="s",
+                           quotas={"t": (0.0, 1.0)})
+    # unauthorized beats everything
+    with pytest.raises(errors.AdmissionRejected) as exc:
+        router.admit("t", token="wrong")
+    assert exc.value.ctx["reason"] == "unauthorized"
+    router.backends[0].healthy = True
+    # burst of 1 admits once, then quota_exceeded with a retry hint
+    router.admit("t", token="s")
+    with pytest.raises(errors.AdmissionRejected) as exc:
+        router.admit("t", token="s")
+    assert exc.value.ctx["reason"] == "quota_exceeded"
+    assert exc.value.retry_after_s == 3600.0
+    # no backend healthy (quota passes first — reasons are ordered
+    # auth -> quota -> reachability)
+    router.backends[0].healthy = False
+    with pytest.raises(errors.AdmissionRejected) as exc:
+        router.admit("other", token="s")
+    assert exc.value.ctx["reason"] == "no_healthy_replica"
+    with pytest.raises(errors.ModelConfigError):
+        ReplicaRouter([])
+    with pytest.raises(errors.ModelConfigError):
+        ReplicaRouter(["http://a", "http://a"])
+
+
+class _StubReplica:
+    """Minimal raftserve-shaped backend for router tests."""
+
+    def __init__(self, name):
+        self.name = name
+        self.results = {}
+        self.by_rdigest = {}
+        self.nsub = 0
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, doc):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                if u.path == "/healthz":
+                    self._send(200, {"ok": True, "queue_depth": 0})
+                elif u.path == "/result":
+                    rid = q.get("id", [None])[0]
+                    rd = q.get("rdigest", [None])[0]
+                    if rid and rid in outer.results:
+                        self._send(200, outer.results[rid])
+                    elif rd and rd in outer.by_rdigest:
+                        self._send(200, outer.by_rdigest[rd])
+                    else:
+                        self._send(404, {"error": "unknown"})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                import math
+                n = int(self.headers.get("Content-Length") or 0)
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                outer.nsub += 1
+                rid = f"{outer.name}-req{outer.nsub}"
+                beta = math.radians(float(doc.get("heading_deg", 0.0)))
+                rd = wal.request_digest(
+                    float(doc["hs"]), float(doc["tp"]), beta,
+                    doc.get("tenant", "default"))
+                res = {"ok": True, "request_id": rid,
+                       "served_by": outer.name}
+                outer.results[rid] = res
+                outer.by_rdigest[rd] = res
+                self._send(202, {"request_id": rid, "seq": outer.nsub})
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+
+    def shutdown(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def _post(url, doc, token=None):
+    headers = {"X-Raft-Auth": token} if token else {}
+    req = urllib.request.Request(url + "/submit",
+                                 data=json.dumps(doc).encode(),
+                                 method="POST", headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_router_quota_auth_affinity_failover_http():
+    """The ISSUE router acceptance: 401 on bad auth, 429 +
+    Retry-After for the over-quota tenant while the healthy tenant's
+    traffic is unaffected, tenant-affinity routing, failover to the
+    survivor when a replica dies, re-resolution by rdigest, and 503
+    when nothing is healthy."""
+    a, b = _StubReplica("A"), _StubReplica("B")
+    router = ReplicaRouter([a.url, b.url], secret="s3",
+                           quotas={"small": (0.0, 1.0)},
+                           default_quota=(100.0, 100.0),
+                           health_interval_s=30.0).start()
+    srv = make_server(router, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        code, body, _ = _post(url, {"hs": 2, "tp": 9}, token="bad")
+        assert code == 401 and body["reason"] == "unauthorized"
+        # tenant "small": burst 1 -> first in, second 429 + Retry-After
+        c1, _, _ = _post(url, {"hs": 2, "tp": 9, "tenant": "small"},
+                         token="s3")
+        c2, b2, h2 = _post(url, {"hs": 2, "tp": 9, "tenant": "small"},
+                           token="s3")
+        assert c1 == 202 and c2 == 429
+        assert b2["reason"] == "quota_exceeded"
+        assert int(h2["Retry-After"]) >= 1
+        # ... while the default tenant sails through (isolation)
+        c3, b3, _ = _post(url, {"hs": 2.5, "tp": 9}, token="s3")
+        assert c3 == 202
+        pinned = b3["replica"]
+        # affinity: the tenant sticks to its warm replica
+        c4, b4, _ = _post(url, {"hs": 3.0, "tp": 9}, token="s3")
+        assert c4 == 202 and b4["replica"] == pinned
+        # fetch by id routes to the owner
+        with urllib.request.urlopen(
+                url + "/result?id=" + b3["request_id"], timeout=5) as r:
+            got = json.loads(r.read())
+        assert got["ok"] and got["replica"] == pinned
+        # the owning replica dies; the survivor (which replayed the
+        # mirror) knows the physics by rdigest
+        dead = a if pinned == a.url else b
+        surv = b if dead is a else a
+        surv.by_rdigest.update(dead.by_rdigest)
+        dead.shutdown()
+        router.check_now()
+        code, got2 = router.result(rid=b3["request_id"])
+        assert code == 200 and got2["replica"] == surv.url
+        assert router.stats()["reresolved"] == 1
+        # submits fail over to the survivor
+        c5, b5, _ = _post(url, {"hs": 4.0, "tp": 9}, token="s3")
+        assert c5 == 202 and b5["replica"] == surv.url
+        # nothing healthy -> 503 no_healthy_replica + Retry-After
+        surv.shutdown()
+        router.check_now()
+        c6, b6, h6 = _post(url, {"hs": 4.0, "tp": 9}, token="s3")
+        assert c6 == 503 and b6["reason"] == "no_healthy_replica"
+        assert "Retry-After" in h6
+        snap = obs.snapshot()
+        series = snap["raft_tpu_serve_router_requests_total"]["series"]
+        outcomes = {s["labels"]["outcome"] for s in series}
+        assert {"routed", "unauthorized", "quota_exceeded",
+                "no_healthy_replica"} <= outcomes
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        router.stop()
+
+
+def test_router_submit_failover_midrequest():
+    """A replica that accepts the TCP connection but dies mid-request
+    is failed over within the same submit (counted)."""
+    b = _StubReplica("B")
+    router = ReplicaRouter(["http://127.0.0.1:9", b.url],
+                           health_interval_s=30.0)
+    # both "healthy" as far as the router knows: the dead one is
+    # discovered by the submit itself (affinity pins the tenant to the
+    # replica that just died — the exact mid-request failover window)
+    for bk in router.backends:
+        bk.healthy = True
+    router._affinity["default"] = "http://127.0.0.1:9"
+    code, body, _ = router.submit({"hs": 2.0, "tp": 9.0})
+    assert code == 202 and body["replica"] == b.url
+    st = router.stats()
+    assert st["failovers"] == 1 and st["routed"] == 1
+    assert not router.backends[0].healthy
+    b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# unit: trend-store facts + the replication/failover SLO rules
+# ---------------------------------------------------------------------------
+
+def test_replication_facts_trend_row_and_slo_rules(tmp_path,
+                                                   monkeypatch):
+    from raft_tpu.obs import trendstore as T
+
+    # a dead replica's mirror with one completed + one pending request
+    rd = wal.request_digest(2.0, 9.0, 0.0)
+    j = wal.RequestJournal(str(tmp_path / "mirror"), run_id="dead")
+    j.record_admit(0, "req0", rd, 2.0, 9.0, 0.0, 60.0, "default")
+    j.record_complete(0, rd, "sha256:d0", "full", 0, [2.0] * 6, 3, True)
+    j.record_admit(1, "req1", wal.request_digest(3.0, 9.0, 0.0),
+                   3.0, 9.0, 0.0, 60.0, "default")
+    j.close()
+    monkeypatch.setenv("RAFT_TPU_TREND_DB", str(tmp_path / "t.sqlite"))
+    obs.configure(str(tmp_path / "obs"))
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path / "succ" / "journal",
+                                   [tmp_path / "succ" / "mirror"]))
+    info = svc.recover(str(tmp_path / "mirror"))
+    svc.start()
+    assert info["tickets"][1].result(10.0).ok
+    summary = svc.stop()
+    assert summary["failover"] == 1
+    assert summary["failover_lost_count"] == 0
+    assert summary["replication_lag_records"] == 0
+    store = T.TrendStore(str(tmp_path / "t.sqlite"))
+    rows = store.rows(kind="serve")
+    facts = rows[0]["facts"]
+    assert facts["serve_failover"] == 1
+    assert facts["serve_failover_lost_count"] == 0
+    assert facts["serve_replication_lag_records"] == 0
+    assert facts["serve_replication_errors"] == 0
+    report = T.evaluate_slo(rows)
+    by_name = {r["name"]: r for r in report["results"]}
+    assert not by_name["serve_failover_lost_count"]["skipped"]
+    assert by_name["serve_failover_lost_count"]["ok"]
+    assert not by_name["serve_replication_lag_records"]["skipped"]
+    assert by_name["serve_replication_lag_records"]["ok"]
+    # a lost request across the boundary MUST fail the gate
+    bad = [dict(rows[0]) for _ in range(1)]
+    bad[0] = {**rows[0],
+              "facts": {**facts, "serve_failover_lost_count": 2}}
+    rep2 = T.evaluate_slo(bad)
+    assert not rep2["ok"]
+
+
+def test_bench_serve_open_loop_facts(tmp_path, monkeypatch):
+    # bench.py setdefaults RAFT_TPU_X64=0 at import for the TPU path;
+    # pin it under monkeypatch so the setdefault cannot leak f32 into
+    # the subprocess-spawning tests that follow
+    monkeypatch.setenv("RAFT_TPU_X64",
+                       os.environ.get("RAFT_TPU_X64", "1"))
+    import bench
+
+    monkeypatch.setenv("RAFT_TPU_TREND_DB", str(tmp_path / "t.sqlite"))
+    obs.configure(str(tmp_path / "obs"))
+    rep = bench.serve_bench(runner_factory=stub_factory,
+                            n_requests=16, rps=50.0)
+    assert rep["ok"] and rep["completed"] == 16 and rep["shed"] == 0
+    assert 0.0 < rep["batch_fill_ratio"] <= 1.0
+    assert rep["admission_p99_s"] >= rep["admission_p50_s"] >= 0.0
+    assert rep["cases_per_min"] > 0
+    from raft_tpu.obs import trendstore as T
+    rows = T.TrendStore(str(tmp_path / "t.sqlite")).rows(
+        kind="bench_serve")
+    facts = rows[0]["facts"]
+    assert facts["serve_cases_per_min"] == rep["cases_per_min"]
+    assert facts["serve_batch_fill_ratio"] == rep["batch_fill_ratio"]
+    assert facts["serve_admission_p99_s"] == rep["admission_p99_s"]
+
+
+# ---------------------------------------------------------------------------
+# integration: meshed service digest parity (virtual devices)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cyl_fowt():
+    from raft_tpu.serve.soak import build_fowt
+    return build_fowt("Vertical_cylinder")
+
+
+def test_meshed_service_reproduces_unmeshed_digests(cyl_fowt, tmp_path,
+                                                    monkeypatch):
+    """ISSUE satellite: ``ServeConfig(mesh=...)`` solves a tenant's
+    batching window on a sharded mesh and reproduces the unmeshed
+    results on virtual devices — iteration counts and convergence
+    flags EXACT, responses at the PR 8 partition-parity tolerance
+    (XLA SPMD may reassociate reductions by one ulp, exactly as the
+    committed MULTICHIP gate records), and meshed digests bit-for-bit
+    STABLE across a warm exec-cache restart (the key carries the full
+    mesh facts, so warm tenancy composes with sharding)."""
+    from raft_tpu.parallel import exec_cache, partition
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path / "c"))
+    exec_cache.reset_memo()
+    rows = [(1.5, 8.0, 0.0), (2.5, 9.0, 0.5), (3.5, 10.0, 1.0),
+            (2.0, 8.5, 0.2)]
+
+    def run(cfg):
+        svc = SweepService(cyl_fowt, cfg)
+        tickets = [svc.submit(h, t, b) for h, t, b in rows]
+        svc.start()
+        out = [t.result(300.0) for t in tickets]
+        summary = svc.stop()
+        assert all(r.ok for r in out)
+        return out, summary
+
+    base = dict(queue_max=8, batch_cases=2, window_s=0.02,
+                batch_deadline_s=120.0, nIter=4, degrade_after=99)
+    plain, _ = run(ServeConfig(**base))
+    mesh = partition.make_mesh((2,), ("cases",))
+    exec_cache.reset_memo()
+    meshed, _ = run(ServeConfig(**base, mesh=mesh))
+    for p, m in zip(plain, meshed):
+        assert (m.iters, m.converged) == (p.iters, p.converged)
+        np.testing.assert_allclose(m.std, p.std, rtol=1e-9, atol=1e-15)
+    # warm restart of the MESHED program (exec-cache round trip):
+    # digests reproduce bit-for-bit — the determinism the replicated
+    # WAL's digest gates rest on
+    exec_cache.reset_memo()
+    meshed2, summary = run(ServeConfig(**base, mesh=mesh))
+    assert [r.digest for r in meshed2] == [r.digest for r in meshed]
+    assert summary["exec_cache"]["default/full"] == "hit"
+    # the mesh topology rides the manifest config scalars
+    assert ServeConfig(**base, mesh=mesh).scalars()["mesh"] == "cases=2"
+
+
+# ---------------------------------------------------------------------------
+# integration: the ISSUE failover acceptance (subprocess, coarse
+# cylinder, mirror-only recovery on a fresh "host")
+# ---------------------------------------------------------------------------
+
+def test_failover_soak_acceptance(tmp_path, monkeypatch):
+    """Child A admits into a mirrored WAL and is SIGKILLed mid-batch;
+    successor B boots from ONLY the mirror in a fresh directory tree
+    (a different "host"): zero accepted requests lost, every digest
+    bit-for-bit equal to an uninterrupted run, warm exec-cache start,
+    failover facts clean."""
+    from raft_tpu.parallel import exec_cache
+    from raft_tpu.serve import soak
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR",
+                       str(tmp_path / "cache"))
+    exec_cache.reset_memo()
+    root = tmp_path / "failover"
+    report = soak.run_failover(journal_dir=str(root), n_requests=10,
+                               kill_at=6)
+    assert report["ok"], {k: report[k] for k in
+                          ("killed", "child_rc", "lost",
+                           "digest_mismatches", "recover", "failover",
+                           "failover_lost_count")}
+    assert report["child_rc"] == 137
+    # every accepted request reached the mirror BEFORE the kill
+    assert report["mirror_admitted"] == report["n_requests"]
+    assert 0 < report["pre_kill_completed"] < report["n_requests"]
+    rec = report["recover"]
+    assert rec["recovered"] == report["pre_kill_completed"]
+    assert rec["recovered"] + rec["replayed"] == report["n_requests"]
+    assert report["lost"] == [] and report["digest_mismatches"] == []
+    assert report["failover"] == 1
+    assert report["failover_lost_count"] == 0
+    assert report["restart_warm_start"] == 1
+    assert report["summary"]["unhandled"] == 0
+    # the successor never read the primary: its recovery source was the
+    # mirror, and its own journal+mirror now carry the full story
+    succ_journal = os.path.join(str(root), "successor", "journal")
+    succ = wal.replay(succ_journal)
+    assert set(succ["completed"]) | \
+        set(wal.replay(os.path.join(str(root), "mirror"))["completed"]) \
+        == set(range(report["n_requests"]))

@@ -43,6 +43,30 @@ Subcommands::
         completed request is digest-identical to an uninterrupted
         clean run.
 
+    raftserve soak --failover --journal-dir DIR [--kill-at N]
+        Replication soak: the killed child's WAL mirrors to a peer
+        store (DIR/mirror); the successor boots in a FRESH directory
+        tree (DIR/successor — a different "host" that never reads
+        DIR/primary) and recovers from only the mirror; exits nonzero
+        unless zero accepted requests were lost across the host
+        boundary and every digest is bit-for-bit identical to an
+        uninterrupted clean run.
+
+    raftserve route --backend URL [--backend URL ...] [--port N]
+                    [--secret-file F] [--quota TENANT=RATE[:BURST]]
+                    [--default-quota RATE[:BURST]]
+        Replica router (raft_tpu/serve/router.py): one front door over
+        N raftserve replicas — /healthz-swept backends, shared-secret
+        auth (X-Raft-Auth), per-tenant token-bucket quotas (429 +
+        Retry-After; one tenant's burst never starves another),
+        tenant-affinity routing (warm programs stay warm) with
+        failover, and fetches re-resolved by request digest against
+        the survivors when the owning replica dies.
+
+With --journal-dir (and --mirror-dir peers), every admission/result
+is write-ahead journaled (and mirrored) before it is acknowledged;
+--recover-from replays a dead peer's mirror at boot (the cross-host
+failover: fresh journal tree, the dead host's disk never read).
 Set RAFT_TPU_OBS_DIR to collect the serve manifests, flight-recorder
 event streams, and the trend-store rows the `obsctl slo` serve rules
 gate on.  On a host with a TPU tunnel problem set JAX_PLATFORMS=cpu.
@@ -73,6 +97,35 @@ def _build_fowts(args):
 def cmd_soak(args) -> int:
     from raft_tpu.serve import soak
     from raft_tpu.serve.config import ServeConfig
+
+    if args.failover:
+        if not args.journal_dir:
+            print("raftserve soak --failover needs --journal-dir",
+                  file=sys.stderr)
+            return 2
+        report = soak.run_failover(
+            args.design, journal_dir=args.journal_dir,
+            min_freq=args.min_freq, max_freq=args.max_freq,
+            dfreq=args.dfreq, n_requests=args.requests,
+            kill_at=args.kill_at, batch_cases=args.batch,
+            seed=args.seed, timeout_s=args.timeout)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=1, default=str)
+        rec = report["recover"]
+        print(f"raftserve failover soak: "
+              f"{'OK' if report['ok'] else 'FAILED'} — child "
+              f"rc={report['child_rc']}, "
+              f"{report['mirror_admitted']}/{report['n_requests']} "
+              f"admits on the mirror, "
+              f"{report['pre_kill_completed']} completed pre-kill, "
+              f"{rec['recovered']} recovered / {rec['replayed']} "
+              f"replayed / {rec['deduped']} deduped from the mirror "
+              f"alone, {len(report['lost'])} lost, "
+              f"{len(report['digest_mismatches'])} digest mismatch(es), "
+              f"warm_start={report['restart_warm_start']}, "
+              f"{report['wall_s']:.1f}s")
+        return 0 if report["ok"] else 1
 
     if args.kill_restart:
         if not args.journal_dir:
@@ -138,7 +191,8 @@ def cmd_serve(args) -> int:
     cfg = ServeConfig(batch_cases=args.batch, queue_max=args.queue_max,
                       deadline_s=args.deadline,
                       batch_deadline_s=args.batch_deadline,
-                      journal_dir=args.journal_dir)
+                      journal_dir=args.journal_dir,
+                      mirror_dirs=tuple(args.mirror_dir or ()))
     degraded = {"coarse": coarse} if coarse is not None else None
     service = SweepService(fowt, cfg, degraded_fowts=degraded)
     # bounded FIFO, like SweepService._delivered: an always-on process
@@ -156,13 +210,26 @@ def cmd_serve(args) -> int:
     # crash recovery: a journal left by a predecessor (killed or
     # drained) replays BEFORE the worker starts — completed results
     # become fetchable, unfinished requests re-enter the queue under
-    # their original seqs, and their tickets are trackable by id
+    # their original seqs, and their tickets are trackable by id.
+    # --recover-from points at a FOREIGN directory (a dead peer's WAL
+    # mirror): this process journals into its own --journal-dir and
+    # replays the mirror — the cross-host failover boot
+    # OWN journal first, then the foreign mirror: the own journal's
+    # pending requests keep their original seqs (deterministic backoff
+    # keys), and its completed results are in the dedupe index before
+    # the mirror's duplicates replay
+    sources = []
     if args.journal_dir and \
             os.path.exists(wal.journal_path(args.journal_dir)):
-        info = service.recover()
+        sources.append(args.journal_dir)
+    if args.recover_from:
+        sources.append(args.recover_from)
+    for src in sources:
+        info = service.recover(src)
         for t in info["tickets"].values():
             _track(t)
-        print(f"raftserve: journal recovery — "
+        print(f"raftserve: journal recovery from {src}"
+              f"{' (mirror/failover)' if info['mirror'] else ''} — "
               f"{info['recovered']} result(s) restored, "
               f"{info['replayed']} request(s) replayed, "
               f"{info['deduped']} deduped, "
@@ -194,9 +261,14 @@ def cmd_serve(args) -> int:
                 self._send(200, service.summary())
             elif url.path == "/result":
                 digest = q.get("digest", [None])[0]
+                rdigest = q.get("rdigest", [None])[0]
                 rid = q.get("id", [None])[0]
-                if digest:
-                    res = service.fetch(digest)
+                if digest or rdigest:
+                    # rdigest= fetches by the REQUEST's content address
+                    # — how a router re-resolves a dead replica's
+                    # in-flight fetch against this (successor) process
+                    res = (service.fetch(digest) if digest
+                           else service.fetch_rdigest(rdigest))
                     if res is None:
                         self._send(404, {"error": "unknown digest"})
                     else:
@@ -234,6 +306,7 @@ def cmd_serve(args) -> int:
                 beta = (math.radians(float(doc["heading_deg"]))
                         if "heading_deg" in doc
                         else float(doc.get("heading_rad", 0.0)))
+                tenant = str(doc.get("tenant", "default"))
                 deadline_s = doc.get("deadline_s")
                 if deadline_s is not None:
                     deadline_s = float(deadline_s)
@@ -244,11 +317,20 @@ def cmd_serve(args) -> int:
                 self._send(400, {"error": f"bad request: {e}"})
                 return
             try:
-                t = service.submit(hs, tp, beta, deadline_s=deadline_s)
+                # the tenant RIDES the submission: the journaled
+                # rdigest is tenant-salted, and the router's
+                # re-resolution/dedupe contracts depend on backend and
+                # router computing the SAME digest
+                t = service.submit(hs, tp, beta, deadline_s=deadline_s,
+                                   tenant=tenant)
             except errors.AdmissionRejected as e:
                 self._send(429, e.context(),
                            headers={"Retry-After":
                                     f"{max(1, round(e.retry_after_s))}"})
+                return
+            except errors.ModelConfigError as e:
+                # unknown tenant: this replica does not carry the model
+                self._send(400, e.context())
                 return
             _track(t)
             if doc.get("wait"):
@@ -290,6 +372,61 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_route(args) -> int:
+    import threading
+
+    from raft_tpu.serve.router import (ReplicaRouter, make_server,
+                                       parse_quota)
+
+    secret = None
+    if args.secret_file:
+        with open(args.secret_file, encoding="utf-8") as f:
+            secret = f.read().strip()
+        if not secret:
+            print("raftserve route: --secret-file is empty",
+                  file=sys.stderr)
+            return 2
+    quotas = {}
+    for spec in (args.quota or []):
+        tenant, _, q = spec.partition("=")
+        if not tenant or not q:
+            print(f"raftserve route: bad --quota {spec!r} "
+                  "(want TENANT=RATE[:BURST])", file=sys.stderr)
+            return 2
+        quotas[tenant.strip()] = parse_quota(q)
+    default_quota = (parse_quota(args.default_quota)
+                     if args.default_quota else None)
+    router = ReplicaRouter(
+        args.backend, secret=secret, quotas=quotas,
+        default_quota=default_quota,
+        health_interval_s=args.health_interval,
+        timeout_s=args.timeout).start()
+    srv = make_server(router, args.host, args.port)
+    host, port = srv.server_address[:2]
+    healthy = sum(1 for b in router.backends if b.healthy)
+    qdesc = ",".join(sorted(quotas)) \
+        or ("default" if default_quota else "off")
+    print(f"raftserve route: http://{host}:{port}/  (submit, result, "
+          f"stats, healthz; {len(router.backends)} replica(s), "
+          f"{healthy} healthy; quotas={qdesc}; "
+          f"auth={'on' if secret else 'off'})", flush=True)
+
+    def _shutdown(signum=None, frame=None):            # pragma: no cover
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    import signal
+    signal.signal(signal.SIGTERM, _shutdown)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:                          # pragma: no cover
+        pass
+    finally:
+        srv.server_close()
+        router.stop()
+        print(json.dumps(router.stats(), indent=1, default=str))
+    return 0
+
+
 def _add_model_args(p):
     p.add_argument("--design", default="Vertical_cylinder",
                    help="vendored design name (raft_tpu/designs)")
@@ -325,9 +462,14 @@ def main(argv=None) -> int:
                    help="durability soak: SIGKILL a journaled child "
                         "service mid-batch, recover on the same "
                         "--journal-dir, gate zero-loss digest parity")
+    p.add_argument("--failover", action="store_true",
+                   help="replication soak: SIGKILL a child whose WAL "
+                        "mirrors to a peer store, recover a successor "
+                        "in a FRESH directory tree from only the "
+                        "mirror, gate cross-host zero-loss parity")
     p.add_argument("--journal-dir", default=None,
-                   help="write-ahead journal directory (required with "
-                        "--kill-restart)")
+                   help="journal root directory (required with "
+                        "--kill-restart / --failover)")
     p.add_argument("--kill-at", type=int, default=6,
                    help="request seq the kill@serve fault fires at")
     p.set_defaults(fn=cmd_soak)
@@ -344,13 +486,48 @@ def main(argv=None) -> int:
                    help="write-ahead request journal directory; a "
                         "journal left by a predecessor is recovered "
                         "on boot (replay + warm start)")
+    p.add_argument("--mirror-dir", action="append", default=None,
+                   help="peer directory the WAL mirrors to (repeat "
+                        "for several peers); a successor on another "
+                        "host recovers from a mirror alone")
+    p.add_argument("--recover-from", default=None,
+                   help="replay a FOREIGN journal/mirror directory at "
+                        "boot (a dead peer's WAL mirror) while "
+                        "journaling into --journal-dir — the "
+                        "cross-host failover boot")
     p.add_argument("--successor", default=None,
                    help="where a drain points rejected callers "
                         "(Retry-After context)")
     p.set_defaults(fn=cmd_serve)
 
+    p = sub.add_parser("route", help="replica router over N raftserve "
+                                     "backends (health checks, "
+                                     "per-tenant quotas, auth, "
+                                     "failover)")
+    p.add_argument("--backend", action="append", required=True,
+                   help="backend raftserve URL (repeat per replica)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8700)
+    p.add_argument("--secret-file", default=None,
+                   help="file holding the shared admission secret "
+                        "(callers send it as X-Raft-Auth); omit for "
+                        "an open router")
+    p.add_argument("--quota", action="append", default=None,
+                   metavar="TENANT=RATE[:BURST]",
+                   help="per-tenant token-bucket quota (requests/s "
+                        "[+ burst]); repeatable")
+    p.add_argument("--default-quota", default=None,
+                   metavar="RATE[:BURST]",
+                   help="quota for tenants without an explicit one "
+                        "(omit for unlimited)")
+    p.add_argument("--health-interval", type=float, default=1.0,
+                   help="seconds between backend /healthz sweeps")
+    p.add_argument("--timeout", type=float, default=30.0,
+                   help="per-proxied-request timeout (s)")
+    p.set_defaults(fn=cmd_route)
+
     args = ap.parse_args(argv)
-    if args.queue_max is None and args.cmd == "serve":
+    if args.cmd == "serve" and args.queue_max is None:
         args.queue_max = 64
     return args.fn(args)
 

@@ -776,7 +776,7 @@ def _skew(v):
 
 def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
                 n_requests=None, rps=None, batch_cases=4, seed=2026,
-                timeout_s=600.0):
+                dup_ratio=None, store_dir=None, timeout_s=600.0):
     """Drive a :class:`raft_tpu.serve.SweepService` with a seeded
     OPEN-LOOP arrival process (exponential inter-arrivals at ``rps``
     requests/s, submitted on schedule whether or not earlier requests
@@ -797,7 +797,16 @@ def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
     solver metrics.  ``runner_factory`` injects a stub engine (tests);
     the default builds the real warm batch runner over ``design``.
     Knobs: ``RAFT_BENCH_SERVE_N`` (requests), ``RAFT_BENCH_SERVE_RPS``
-    (arrival rate)."""
+    (arrival rate), ``RAFT_BENCH_SERVE_DUP_RATIO`` (fraction of
+    arrivals repeating an earlier request — the realistic near-
+    duplicate traffic shape; > 0 enables the content-addressed result
+    tier on a scratch ``store_dir`` and additionally reports
+    ``store_hit_ratio``, ``read_p50_ms``/``p99``,
+    ``warm_start_iter_savings``, and the ground-truth
+    ``store_corrupt_served_count`` — every duplicate's payload digest
+    compared against the first delivery of the same request)."""
+    import tempfile
+
     from raft_tpu import errors, obs
     from raft_tpu.serve import SweepService, soak
 
@@ -805,15 +814,24 @@ def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
             else os.environ.get("RAFT_BENCH_SERVE_N", 48))
     rps = float(rps if rps is not None
                 else os.environ.get("RAFT_BENCH_SERVE_RPS", 6.0))
+    dup_ratio = float(dup_ratio if dup_ratio is not None
+                      else os.environ.get("RAFT_BENCH_SERVE_DUP_RATIO",
+                                          0.0))
+    scratch_store = None
+    if dup_ratio > 0.0 and store_dir is None:
+        store_dir = scratch_store = tempfile.mkdtemp(
+            prefix="raft-bench-store-")
     fowt = None
     if runner_factory is None:
         fowt = soak.build_fowt(design)
     cfg = soak.default_config(batch_cases=batch_cases, queue_max=n,
                               deadline_s=timeout_s,
-                              batch_deadline_s=120.0)
+                              batch_deadline_s=120.0,
+                              store_dir=store_dir)
     manifest = obs.RunManifest.begin(kind="bench_serve", config={
         "design": design, "n_requests": n, "arrival_rps": rps,
         "batch_cases": batch_cases, "seed": seed,
+        "dup_ratio": dup_ratio, "store": store_dir is not None,
         "stub": runner_factory is not None})
     status = "failed"
     svc = None
@@ -822,6 +840,16 @@ def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
         svc.start()
         rng = np.random.default_rng(seed)
         Hs, Tp, beta = soak.case_table(n, seed=seed)
+        if dup_ratio > 0.0:
+            # dup-heavy arrival shape: each arrival repeats an earlier
+            # request's exact physics with probability dup_ratio —
+            # identical requests recur constantly across tenants in the
+            # paper's workload, and they are what the result tier turns
+            # into memory-speed reads / coalesced flights
+            for i in range(1, n):
+                if rng.random() < dup_ratio:
+                    j = int(rng.integers(0, i))
+                    Hs[i], Tp[i], beta[i] = Hs[j], Tp[j], beta[j]
         gaps = rng.exponential(1.0 / rps, n)
         t0 = time.monotonic()
         arrivals = t0 + np.cumsum(gaps)
@@ -859,6 +887,32 @@ def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
             "shed": shed,
             "failed": sum(1 for r in results.values() if not r.ok),
         }
+        if store_dir is not None:
+            # result-tier facts + the ground-truth integrity gate: a
+            # duplicate arrival's payload digest must equal the FIRST
+            # delivery of the identical request — any disagreement
+            # means a corrupt (or warm-start-poisoned) byte was served
+            first_digest: dict[tuple, str] = {}
+            corrupt_served = 0
+            for i in sorted(results):
+                r = results[i]
+                if not r.ok:
+                    continue
+                key = (float(Hs[i]), float(Tp[i]), float(beta[i]))
+                prior = first_digest.setdefault(key, r.digest)
+                if prior != r.digest:
+                    corrupt_served += 1
+            facts.update({
+                "dup_ratio": dup_ratio,
+                "store_hit_ratio": summary.get("store_hit_ratio"),
+                "read_p50_ms": summary.get("read_p50_ms"),
+                "read_p99_ms": summary.get("read_p99_ms"),
+                "warm_start_iter_savings": summary.get(
+                    "warm_start_iter_savings"),
+                "store_corrupt_served_count": corrupt_served,
+                "warm_start_digest_mismatch": summary.get(
+                    "warm_start_digest_mismatch", 0),
+            })
         manifest.extra["serve_bench"] = facts
         manifest.extra["serve"] = summary
         status = "ok" if completed and not facts["failed"] else "failed"
@@ -872,6 +926,9 @@ def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
         # must not strand the worker threads behind a traceback
         if svc is not None:
             svc.stop(drain=False, timeout=5.0)
+        if scratch_store is not None:
+            import shutil
+            shutil.rmtree(scratch_store, ignore_errors=True)
         paths = obs.finish_run(manifest, status=status)
     report["manifest"] = paths["manifest"]
     return report

@@ -113,6 +113,31 @@ class JournalCorrupt(CacheCorruption):
     phase = "journal"
 
 
+class ResultStoreCorrupt(CacheCorruption):
+    """A persistent result-store entry (:mod:`raft_tpu.serve.resultstore`)
+    failed an integrity check — size/sha256 sidecar mismatch, a torn or
+    unparseable payload, a key/payload digest disagreement (a *stale*
+    entry answering for the wrong request), or a payload whose recorded
+    result digest no longer matches its own metrics.  The store recovers
+    by delete-and-miss (the request re-solves; the corruption is counted
+    in ``raft_tpu_serve_result_store_corrupt_total``); this type
+    surfaces only when a caller opts into strict reads."""
+
+    phase = "cache"
+
+
+class WarmStartRejected(RaftError, RuntimeError):
+    """A neighbor-seeded (warm-started) solve tripped the divergence
+    guard — the seeded iteration failed to converge, went non-finite,
+    or regressed past the cold-start bound — and the service fell back
+    to a cold start, quarantining the offending neighbor seed.  This is
+    a *degradation signal* recorded per occurrence (event + counter +
+    summary fact), never a caller-visible failure: the fallback result
+    is always delivered, bit-identical to a cold start."""
+
+    phase = "serve"
+
+
 class EigenFailure(RaftError, RuntimeError):
     """The eigen solve produced unusable system matrices or
     non-positive eigenvalues."""

@@ -170,7 +170,17 @@ def facts_from_manifest(doc: dict) -> dict:
                   # a life that recovered from a FOREIGN mirror — the
                   # cross-host SLO rules skip ordinary rows
                   "replication_lag_records", "replication_errors",
-                  "failover", "failover_lost_count"):
+                  "failover", "failover_lost_count",
+                  # result-tier facts (serve/resultstore.py): present
+                  # only on store-enabled service rows — the
+                  # corrupt-served / warm-mismatch zero-tolerance SLO
+                  # rules skip every store-less run
+                  "store_hits", "store_hit_ratio", "read_p50_ms",
+                  "read_p99_ms", "coalesced", "store_corrupt",
+                  "store_entries", "store_quarantined",
+                  "warm_start_seeded", "warm_start_rejected",
+                  "warm_start_iter_savings",
+                  "warm_start_digest_mismatch"):
             if _num(serve.get(k)) is not None:
                 facts[f"serve_{k}"] = serve[k]
         if serve.get("mode"):
@@ -180,9 +190,25 @@ def facts_from_manifest(doc: dict) -> dict:
     sbench = extra.get("serve_bench") or {}
     if isinstance(sbench, dict):
         for k in ("cases_per_min", "admission_p99_s", "admission_p50_s",
-                  "batch_fill_ratio", "arrival_rps", "open_loop_s"):
+                  "batch_fill_ratio", "arrival_rps", "open_loop_s",
+                  # dup-heavy arrival facts (RAFT_BENCH_SERVE_DUP_RATIO)
+                  "dup_ratio", "store_hit_ratio", "read_p50_ms",
+                  "read_p99_ms", "warm_start_iter_savings",
+                  "store_corrupt_served_count",
+                  "warm_start_digest_mismatch"):
             if _num(sbench.get(k)) is not None:
                 facts[f"serve_{k}"] = sbench[k]
+    # duplicate-storm soak facts (serve/soak.py run_storm): ground-truth
+    # integrity counts measured against the clean reference digests
+    storm = extra.get("serve_storm") or {}
+    if isinstance(storm, dict):
+        for k in ("solves", "coalesced", "store_hit_ratio",
+                  "read_p50_ms", "read_p99_ms", "store_corrupt_detected",
+                  "store_corrupt_served_count", "warm_start_seeded",
+                  "warm_start_rejected", "warm_start_iter_savings",
+                  "warm_start_digest_mismatch"):
+            if _num(storm.get(k)) is not None:
+                facts[f"serve_{k}"] = storm[k]
     # probe-channel volume (its own budget, distinct from transfers):
     # the embedded metrics snapshot is process-cumulative, so subtract
     # the baseline RunManifest.begin recorded for THIS run
@@ -408,6 +434,19 @@ DEFAULT_SLO_RULES = [
     {"name": "serve_replication_lag_records", "kind": "serve",
      "fact": "serve_replication_lag_records", "agg": "max", "op": "<=",
      "threshold": 64.0, "window": 20},
+    # -- result-tier gates (serve/resultstore.py; skipped when no
+    # store-enabled row exists).  Both are zero-tolerance tripwires,
+    # gated across EVERY kind that measures them (service audit counts,
+    # the dup-heavy serve bench's ground-truth duplicate comparison,
+    # and the duplicate-storm soak's clean-reference comparison): a
+    # corrupt store byte delivered as a result, or a neighbor
+    # warm-start that silently changed physics, is never acceptable.
+    {"name": "serve_store_corrupt_served_count",
+     "fact": "serve_store_corrupt_served_count", "agg": "max",
+     "op": "<=", "threshold": 0.0, "window": 20},
+    {"name": "serve_warm_start_digest_mismatch",
+     "fact": "serve_warm_start_digest_mismatch", "agg": "max",
+     "op": "<=", "threshold": 0.0, "window": 20},
 ]
 
 _OPS = {

@@ -28,17 +28,26 @@ replicas with per-tenant token-bucket quotas, shared-secret auth,
 tenant-affinity routing, and request-digest re-resolution after a
 replica dies.
 
+The result tier makes repeats free: a persistent content-addressed
+store (:mod:`raft_tpu.serve.resultstore`) consulted at admission —
+exact-digest hits return at memory speed across restarts and replicas,
+concurrent duplicates single-flight onto one solve, and cache misses
+warm-start the drag fixed point from the nearest cold-solved neighbor
+under a divergence guard + audit that can never silently change
+physics.
+
 Entry points: :class:`SweepService` / :class:`ReplicaRouter`
 (embedded), ``tools/raftserve.py`` (CLI: HTTP endpoint + router + the
-deterministic chaos / kill-restart / failover soaks).  See
-docs/robustness.md "Serving", "Durability", and "Replication &
-failover".
+deterministic chaos / kill-restart / failover / duplicate-storm
+soaks).  See docs/robustness.md "Serving", "Durability", "Replication
+& failover", and "Result tier".
 """
 from raft_tpu.serve.config import MODES, ServeConfig  # noqa: F401
 from raft_tpu.serve.journal import (  # noqa: F401
     RequestJournal, replay, request_digest,
 )
 from raft_tpu.serve.replica import WalMirror  # noqa: F401
+from raft_tpu.serve.resultstore import ResultStore  # noqa: F401
 from raft_tpu.serve.retry import (  # noqa: F401
     DEFAULT_BUDGETS, TERMINAL, RetryPolicy,
 )

@@ -85,6 +85,33 @@ class ServeConfig:
     #: ``SweepService.recover()``
     journal_dir: str | None = None
 
+    # -- result tier (serve/resultstore.py) ---------------------------
+    #: directory of the persistent content-addressed result store; None
+    #: (default) disables the whole read-through tier.  With it set:
+    #: an exact request-digest hit at admission returns at memory speed
+    #: without entering the batch window (across restarts, and across
+    #: replicas sharing or mirroring the directory), concurrent
+    #: duplicate submissions single-flight onto one solve, and
+    #: ``fetch_rdigest`` falls through to the store after the
+    #: in-memory LRU evicts
+    store_dir: str | None = None
+    #: seed the drag fixed point of cache MISSES from the nearest
+    #: cold-solved neighbor in (Hs, Tp, beta), guarded by the
+    #: divergence watchdog + the warm audit (requires ``store_dir``;
+    #: not yet composed with ``mesh`` sharding)
+    warm_start: bool = False
+    #: neighbor-seeding radius — Euclidean distance over (Hs [m],
+    #: Tp [s], beta [rad]); a seed farther than this is worse than a
+    #: cold start
+    warm_radius: float = 1.0
+    #: every Nth warm batch is AUDITED: solved both seeded and cold,
+    #: the cold results delivered (bit-identical to an unseeded
+    #: service by construction) and the two compared — a divergence
+    #: past the solver tolerance is a counted
+    #: ``warm_start_digest_mismatch`` and quarantines the seed.  1 =
+    #: audit every batch (the parity-proof mode the storm soak runs)
+    warm_audit_every: int = 8
+
     # -- replication (serve/replica.py) -------------------------------
     #: peer directories the write-ahead journal is mirrored to (local
     #: paths now, object-store mounts later); requires ``journal_dir``.
@@ -143,6 +170,12 @@ class ServeConfig:
                              == os.path.abspath(str(self.journal_dir))
                              for d in self.mirror_dirs))),
             ("replica_max_lag_records", self.replica_max_lag_records >= 1),
+            ("store_dir", self.store_dir is None
+             or bool(str(self.store_dir).strip())),
+            ("warm_start", not self.warm_start
+             or (self.store_dir is not None and self.mesh is None)),
+            ("warm_radius", self.warm_radius > 0.0),
+            ("warm_audit_every", self.warm_audit_every >= 1),
             ("max_live_programs", self.max_live_programs >= 1),
             ("nIter", self.nIter >= 1),
         ]

@@ -270,6 +270,18 @@ def read_handoff_manifest(journal_dir: str) -> dict | None:
     return doc if isinstance(doc, dict) else None
 
 
+def find_rdigest(journal_dir: str, rdigest: str) -> dict | None:
+    """The journaled ``complete`` record for one request digest, or
+    None — the last-resort read path of
+    :meth:`SweepService.fetch_rdigest` once both the in-memory LRU and
+    the result store have missed.  A full directory scan (rotated
+    parts included), so callers should try the cheaper tiers first."""
+    try:
+        return replay(journal_dir)["by_rdigest"].get(str(rdigest))
+    except OSError:
+        return None
+
+
 def _journal_parts(journal_dir: str) -> list[str]:
     """Journal files oldest-first (rotated ``.N`` parts then the live
     file), so replay folds records in write order."""

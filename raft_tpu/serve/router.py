@@ -24,7 +24,12 @@ everything else is proxied verbatim to a backend:
   of the submitted physics) against the surviving replicas: a
   successor that recovered the dead replica's WAL mirror serves the
   result under the same digest even though it never issued the
-  original ticket (``SweepService.fetch_rdigest``).
+  original ticket (``SweepService.fetch_rdigest``).  With
+  ``store_dir`` pointed at the replicas' shared/mirrored result store
+  (:mod:`raft_tpu.serve.resultstore`), digest fetches consult that
+  LOCAL store before any proxying — a dead replica's results stay
+  readable with zero healthy backends, integrity-checked like every
+  store read.
 
 The router holds no solver state and journals nothing: replicas own
 durability (their mirrored WALs), the router owns reachability.  Its
@@ -109,10 +114,20 @@ class ReplicaRouter:
 
     def __init__(self, backends, *, secret: str = None, quotas=None,
                  default_quota=None, health_interval_s: float = 1.0,
-                 timeout_s: float = 30.0, track_max: int = 4096):
+                 timeout_s: float = 30.0, track_max: int = 4096,
+                 store_dir: str = None):
         if not backends:
             raise errors.ModelConfigError(
                 "the replica router needs at least one backend")
+        #: local result-store consult (serve/resultstore.py): with the
+        #: replicas' shared/mirrored store mounted here, digest fetches
+        #: are answered from disk BEFORE any proxying — a dead
+        #: replica's results stay readable even with zero healthy
+        #: backends, and a hit costs no backend round-trip
+        self.store = None
+        if store_dir:
+            from raft_tpu.serve.resultstore import ResultStore
+            self.store = ResultStore(store_dir)
         self.backends = [_Backend(u) for u in backends]
         if len({b.url for b in self.backends}) != len(self.backends):
             raise errors.ModelConfigError(
@@ -143,7 +158,8 @@ class ReplicaRouter:
         self._rr = 0
         self._counts = {k: 0 for k in (
             "routed", "failovers", "reresolved", "unauthorized",
-            "quota_exceeded", "no_healthy_replica", "proxy_errors")}
+            "quota_exceeded", "no_healthy_replica", "proxy_errors",
+            "store_hits")}
         self._state = "new"
         self._thread = None
 
@@ -368,12 +384,35 @@ class ReplicaRouter:
             retry_after_s=self.health_interval_s,
             reason="no_healthy_replica", tenant=tenant)
 
+    def _store_lookup(self, digest: str = None,
+                      rdigest: str = None) -> dict | None:
+        """Local result-store consult — the read path that needs no
+        replica at all.  Integrity-checked like every store read; a
+        corrupt entry is a (counted) miss that falls through to the
+        backends."""
+        if self.store is None:
+            return None
+        doc = (self.store.get(rdigest) if rdigest
+               else self.store.get_by_digest(digest) if digest
+               else None)
+        if doc is None:
+            return None
+        self._count("store_hits")
+        return {"ok": True, "source": "stored",
+                "request_id": doc.get("id"), "seq": doc.get("seq"),
+                "digest": doc.get("digest"), "rdigest": doc.get("rdigest"),
+                "std": doc.get("std"), "iters": doc.get("iters"),
+                "converged": doc.get("converged"),
+                "tenant": doc.get("tenant"), "mode": doc.get("mode"),
+                "replica": "store"}
+
     def result(self, rid: str = None, digest: str = None,
                rdigest: str = None) -> tuple[int, dict]:
         """Fetch a result: by request id against the owning replica
         (re-resolving by request digest against the survivors when it
-        died), or by result/request digest against any healthy
-        replica."""
+        died), or by result/request digest — the router's LOCAL result
+        store first (a shared/mirrored store answers for dead replicas
+        without any round-trip), then any healthy replica."""
         if rid:
             with self._lock:
                 rec = self._requests.get(rid)
@@ -399,12 +438,22 @@ class ReplicaRouter:
             rdigest = rdigest or (rec or {}).get("rdigest")
             if not rdigest:
                 return 404, {"error": "unknown request id"}
+            hit = self._store_lookup(rdigest=rdigest)
+            if hit is not None:
+                self._count("reresolved")
+                self._emit("router_reresolve", id=rid, rdigest=rdigest,
+                           source="store")
+                return 200, hit
             code, body = self._fan_get(
                 "/result?rdigest=" + urllib.parse.quote(rdigest))
             if code == 200:
                 self._count("reresolved")
                 self._emit("router_reresolve", id=rid, rdigest=rdigest)
             return code, body
+        if digest or rdigest:
+            hit = self._store_lookup(digest=digest, rdigest=rdigest)
+            if hit is not None:
+                return 200, hit
         if digest:
             return self._fan_get(
                 "/result?digest=" + urllib.parse.quote(digest))
@@ -446,7 +495,9 @@ class ReplicaRouter:
                     "quotas": {t: {"rate": bk.rate, "burst": bk.burst}
                                for t, bk in self._buckets.items()},
                     "dynamic_quota_tenants": len(self._dyn_buckets),
-                    "secured": self.secret is not None}
+                    "secured": self.secret is not None,
+                    "store": (self.store.stats()
+                              if self.store is not None else None)}
 
     # ------------------------------------------------------------------
     # tiny HTTP client helpers (stdlib only)

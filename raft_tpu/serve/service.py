@@ -140,10 +140,10 @@ class _Request:
     __slots__ = ("seq", "id", "Hs", "Tp", "beta", "deadline_ts",
                  "submitted_ts", "attempts", "total_attempts", "strikes",
                  "solo", "not_before", "ticket", "tenant", "rdigest",
-                 "replayed")
+                 "replayed", "followers")
 
     def __init__(self, seq, Hs, Tp, beta, deadline_ts, now,
-                 tenant=DEFAULT_TENANT, request_id=None):
+                 tenant=DEFAULT_TENANT, request_id=None, rdigest=None):
         self.seq = int(seq)
         self.id = request_id or f"req{seq}-{uuid.uuid4().hex[:8]}"
         self.Hs = float(Hs)
@@ -157,8 +157,16 @@ class _Request:
         self.solo = False
         self.not_before = 0.0
         self.tenant = str(tenant)
-        self.rdigest = wal.request_digest(Hs, Tp, beta, self.tenant)
+        # callers that already hashed the admission (the store-enabled
+        # submit edge — the exact path the serve bench measures) pass
+        # the digest through instead of hashing twice
+        self.rdigest = rdigest or wal.request_digest(Hs, Tp, beta,
+                                                     self.tenant)
         self.replayed = False
+        #: single-flight followers: duplicate submissions attached to
+        #: this (primary) request — they never enter the queue, and the
+        #: primary's terminal outcome fans out to them
+        self.followers: list["_Request"] = []
         self.ticket = Ticket(self.id, self.seq)
 
 
@@ -261,7 +269,26 @@ class SweepService:
         self._counts = {k: 0 for k in (
             "admitted", "rejected", "completed", "failed", "quarantined",
             "retries", "retried_recovered", "deadline_misses",
-            "unhandled", "batches", "abandoned_batches", "expired")}
+            "unhandled", "batches", "abandoned_batches", "expired",
+            "store_hits", "coalesced", "warm_seeded", "warm_rejected",
+            "warm_mismatch")}
+        # -- result tier (serve/resultstore.py): the persistent
+        # content-addressed read-through store, single-flight request
+        # coalescing, and neighbor warm starts all key off store_dir
+        self._store = None
+        if self.cfg.store_dir:
+            from raft_tpu.serve.resultstore import ResultStore
+            self._store = ResultStore(self.cfg.store_dir,
+                                      keep_xi=self.cfg.warm_start)
+        #: rdigest -> the PRIMARY in-flight request duplicates attach to
+        self._flight: dict[str, _Request] = {}
+        #: read-tier latencies (ms) for the p50/p99 summary facts
+        self._read_ms: collections.deque[float] = collections.deque(
+            maxlen=10_000)
+        #: observed cold-start iteration baseline (EMA over unseeded
+        #: lanes) — what non-audited warm batches report savings against
+        self._cold_iters_ema: float | None = None
+        self._warm_iter_savings = 0.0
         self._manifest = None
 
     # ------------------------------------------------------------------
@@ -497,6 +524,23 @@ class SweepService:
                         self._rdigest_index[rec["rdigest"]] = \
                             rec["digest"]
                     recovered += 1
+                    # migrate the recovered result into the persistent
+                    # read tier: the NEXT life (and every replica on
+                    # this store) serves it at memory speed even after
+                    # the journal rotates it away
+                    adm = state["admitted"].get(seq, {})
+                    if self._store is not None and rec.get("rdigest") \
+                            and "Hs" in adm and res.mode == "full":
+                        self._store.put({
+                            "rdigest": rec["rdigest"],
+                            "digest": rec["digest"],
+                            "std": rec.get("std") or [],
+                            "iters": int(rec.get("iters") or 0),
+                            "converged": bool(rec.get("converged")),
+                            "tenant": res.tenant, "Hs": adm["Hs"],
+                            "Tp": adm.get("Tp"), "beta": adm.get("beta"),
+                            "mode": res.mode, "id": res.request_id,
+                            "seq": int(seq)})
             while len(self._delivered) > self.cfg.result_cache:
                 self._delivered.popitem(last=False)
             while len(self._rdigest_index) > self.cfg.result_cache:
@@ -580,6 +624,22 @@ class SweepService:
                         "replayed request names a tenant this service "
                         "does not carry", tenant=tenant, seq=seq))
                     continue
+                if self._store is not None:
+                    # single-flight holds through replay too: a second
+                    # pending admit carrying the same request digest
+                    # attaches to the first as a follower — a storm
+                    # interrupted by a crash still performs exactly one
+                    # solve per distinct digest after recovery
+                    prim = self._flight.get(req.rdigest)
+                    if prim is not None and not prim.ticket.done():
+                        prim.followers.append(req)
+                        self._counts["admitted"] += 1
+                        self._counts["coalesced"] += 1
+                        self._replayed_pending.add(seq)
+                        self._track_open(req)
+                        replayed += 1
+                        continue
+                    self._flight[req.rdigest] = req
                 self._queue.append(req)
                 self._counts["admitted"] += 1
                 self._replayed_pending.add(seq)
@@ -709,37 +769,104 @@ class SweepService:
         admission; an unknown ``tenant`` is a typed
         :class:`~raft_tpu.errors.ModelConfigError`.  With a journal
         configured the admission is written to the WAL *before* the
-        ticket is returned — an accepted request survives a crash."""
+        ticket is returned — an accepted request survives a crash.
+
+        With the result tier configured (``cfg.store_dir``) admission
+        consults the content-addressed read path first: an exact
+        request-digest hit returns an already-resolved ticket at memory
+        speed — it never enters the batch window, the queue accounting,
+        or the WAL (the caller holds the payload synchronously, so
+        there is nothing a crash could lose) — and a duplicate of a
+        request already in flight attaches to that single solve as a
+        *follower* instead of occupying a queue slot (a storm of N
+        duplicates over D distinct digests performs exactly D
+        solves)."""
         obs = self._obs()
         tenant = self._tenants.require(tenant)
         now = time.monotonic()
         deadline_s = float(deadline_s if deadline_s is not None
                            else self.cfg.deadline_s)
+        if self._store is not None:
+            rdigest = wal.request_digest(Hs, Tp, heading_rad, tenant)
+            hit = self._lookup_cached(rdigest)
+            if hit is not None:
+                t = Ticket(hit.request_id, hit.seq)
+                t._finish(hit)
+                return t
+        follower = None
         with self._cond:
             retry_after = self._estimate_wait_locked()
             successor = self._successor
             reason = None
             if self._state in ("draining", "stopped"):
                 reason = "stopped"
-            elif self.ladder[self._mode_idx] == "reject":
-                reason = "degraded"
-                retry_after = max(retry_after, self.cfg.reject_hold_s)
-            elif len(self._queue) >= self.cfg.queue_max:
-                reason = "queue_full"
-            elif retry_after > deadline_s * self.cfg.deadline_pressure:
-                reason = "deadline_pressure"
+            elif self._store is not None:
+                # single-flight: whatever the queue pressure, a
+                # duplicate of an in-flight digest rides that solve —
+                # it costs no queue slot and no solver work, so even
+                # the reject rung admits it
+                prim = self._flight.get(rdigest)
+                if prim is not None and not prim.ticket.done():
+                    seq = self._seq
+                    self._seq += 1
+                    follower = _Request(seq, Hs, Tp, heading_rad,
+                                        now + deadline_s, now,
+                                        tenant=tenant, rdigest=rdigest)
+                    # track BEFORE the attach is visible: the primary's
+                    # fan-out may deliver (and untrack) the follower
+                    # the instant it appears in prim.followers — a
+                    # track after that window would pin the delivered
+                    # seq in _open for the life of the process
+                    self._track_open(follower)
+                    prim.followers.append(follower)
+                    self._counts["admitted"] += 1
+                    self._counts["coalesced"] += 1
+            if follower is None and reason is None:
+                if self.ladder[self._mode_idx] == "reject":
+                    reason = "degraded"
+                    retry_after = max(retry_after,
+                                      self.cfg.reject_hold_s)
+                elif len(self._queue) >= self.cfg.queue_max:
+                    reason = "queue_full"
+                elif retry_after > deadline_s * self.cfg.deadline_pressure:
+                    reason = "deadline_pressure"
             if reason is not None:
                 self._counts["rejected"] += 1
                 depth = len(self._queue)
-            else:
+            elif follower is None:
                 seq = self._seq
                 self._seq += 1
                 req = _Request(seq, Hs, Tp, heading_rad,
-                               now + deadline_s, now, tenant=tenant)
+                               now + deadline_s, now, tenant=tenant,
+                               rdigest=(rdigest
+                                        if self._store is not None
+                                        else None))
                 self._queue.append(req)
+                if self._store is not None:
+                    self._flight[req.rdigest] = req
                 self._counts["admitted"] += 1
                 depth = len(self._queue)
                 self._cond.notify_all()
+        if follower is not None:
+            # WAL-before-ack applies to followers too: the attached
+            # duplicate is journaled as its own admission, and its
+            # delivery (or failure) will be journaled terminal — replay
+            # after a crash re-resolves it by digest, never re-solves
+            if self._journal is not None:
+                self._journal.record_admit(
+                    follower.seq, follower.id, follower.rdigest,
+                    follower.Hs, follower.Tp, follower.beta, deadline_s,
+                    tenant)
+            self._tenants.count(tenant, "admitted")
+            obs.counter("raft_tpu_serve_coalesced_total",
+                        "duplicate submissions single-flighted onto an "
+                        "in-flight solve").inc(1.0)
+            obs.counter("raft_tpu_serve_requests_total",
+                        "request admissions/outcomes of the sweep "
+                        "service").inc(1.0, outcome="admitted")
+            self._emit("coalesced", req=follower.seq,
+                       rdigest=follower.rdigest)
+            return follower.ticket
         obs.gauge("raft_tpu_serve_queue_depth",
                   "requests queued (not in flight) in the sweep "
                   "service").set(float(depth))
@@ -874,7 +1001,9 @@ class SweepService:
                     tenant=tenant)
             from raft_tpu.parallel.sweep import make_batch_runner
             return make_batch_runner(fowt, self.cfg.batch_cases,
-                                     mesh=self.cfg.mesh, **kw)
+                                     mesh=self.cfg.mesh,
+                                     warm_start=self.cfg.warm_start,
+                                     **kw)
 
         return self._tenants.runner(tenant, rmode, build)
 
@@ -912,9 +1041,18 @@ class SweepService:
             # the watchdog deadline covers the SOLVE: a cold runner
             # build (trace/compile or exec-cache deserialize) above may
             # legitimately take longer than batch_deadline_s and must
-            # not pre-expire the batch it is about to serve
+            # not pre-expire the batch it is about to serve.  A
+            # warm-start batch may legitimately run TWO solves (every
+            # warm_audit_every-th batch is audited, and a guard
+            # fallback re-solves cold) — the window must cover both,
+            # or every healthy audited batch would be abandoned and
+            # accrue hang strikes toward quarantine
+            window = cfg.batch_deadline_s
+            if (self._store is not None and cfg.warm_start
+                    and getattr(runner, "warm_start", False)):
+                window *= 2.0
             wid = self._watchdog.arm(
-                time.monotonic() + cfg.batch_deadline_s,
+                time.monotonic() + window,
                 lambda: self._abandon_batch(batch_id))
             # -- injection seam (pre-solve): a hang stalls THIS worker
             # with the watchdog armed — exactly what a wedged device
@@ -943,9 +1081,13 @@ class SweepService:
                 Hs = np.concatenate([Hs, np.repeat(Hs[-1:], pad)])
                 Tp = np.concatenate([Tp, np.repeat(Tp[-1:], pad)])
                 beta = np.concatenate([beta, np.repeat(beta[-1:], pad)])
+            # the watchdog stays armed through the whole solve phase —
+            # warm attempt, guard fallback, and audit reference alike
             with obs.span("serve_batch", n=n, mode=solve_mode,
                           batch_id=batch_id):
-                out = runner(Hs, Tp, beta)
+                std, iters, conv, xi = self._solve_lanes(
+                    runner, batch, batch_id, Hs, Tp, beta, n, ncases,
+                    solve_mode)
             owned = self._watchdog.disarm(wid)
             wid = None
             if not owned:
@@ -956,13 +1098,6 @@ class SweepService:
             with self._lock:
                 binfo["done"] = True
                 self._inflight.pop(batch_id, None)
-            # ONE sanctioned counted pull per batch (PR 4 discipline)
-            std, iters, conv = obs.transfers.device_get(
-                (out["std"], out["iters"], out["converged"]),
-                what="serve_batch", phase="serve")
-            std = np.array(std, float)[:n]
-            iters = np.asarray(iters)[:n]
-            conv = np.asarray(conv)[:n]
             # -- injection seam (post-solve, per lane): the dynamics /
             # sweep-lane fault sites poison or fail single requests
             for i, r in enumerate(batch):
@@ -985,7 +1120,9 @@ class SweepService:
                     continue
                 if np.all(np.isfinite(std[i])):
                     self._complete(r, std[i], int(iters[i]),
-                                   bool(conv[i]), solve_mode)
+                                   bool(conv[i]), solve_mode,
+                                   xi_row=(xi[i] if xi is not None
+                                           else None))
                 else:
                     self._retry_or_fail(r, errors.NonFiniteResult(
                         "non-finite response lane", case=r.seq))
@@ -1037,6 +1174,165 @@ class SweepService:
                                "watchdog abandon (discarded)")
                 return
             raise
+
+    # ------------------------------------------------------------------
+    # the solve phase: neighbor warm starts + divergence guard + audit
+    # ------------------------------------------------------------------
+
+    def _pull(self, out, n: int, with_xi: bool):
+        """The sanctioned counted host pull of one batch's outputs
+        (PR 4 discipline: one pull per solve; an audited warm batch
+        performs two solves and therefore two pulls)."""
+        obs = self._obs()
+        if with_xi:
+            std, iters, conv, xi = obs.transfers.device_get(
+                (out["std"], out["iters"], out["converged"], out["Xi"]),
+                what="serve_batch", phase="serve")
+            xi = np.asarray(xi)[:n]
+        else:
+            std, iters, conv = obs.transfers.device_get(
+                (out["std"], out["iters"], out["converged"]),
+                what="serve_batch", phase="serve")
+            xi = None
+        return (np.array(std, float)[:n], np.asarray(iters)[:n],
+                np.asarray(conv)[:n], xi)
+
+    def _gather_seeds(self, batch, ncases: int, nw: int,
+                      xistart: float):
+        """Per-lane drag-fixed-point seeds from the nearest cold-solved
+        store neighbors: ``(seeds, {lane: neighbor rdigest})`` —
+        unseeded lanes carry the cold ``XiStart`` fill, so the seeded
+        program with no neighbors is numerically the cold program."""
+        seeds = np.full((ncases, 6, nw), complex(xistart), complex)
+        lanes: dict[int, str] = {}
+        for i, r in enumerate(batch):
+            found = self._store.nearest(r.Hs, r.Tp, r.beta, r.tenant,
+                                        radius=self.cfg.warm_radius)
+            if found is None:
+                continue
+            rd, _dist = found
+            seed = self._store.get_xi(rd)
+            if seed is None or seed.shape != (6, nw):
+                continue
+            seeds[i] = seed
+            lanes[i] = rd
+        return (seeds if lanes else None), lanes
+
+    def _warm_event(self, outcome: str, lane: int, neighbor: str,
+                    detail: str):
+        """Count + record one divergence-guard rejection (or audit
+        mismatch) as the typed :class:`~raft_tpu.errors.WarmStartRejected`
+        signal — the fallback result is delivered regardless."""
+        obs = self._obs()
+        e = errors.WarmStartRejected(
+            "warm-started solve rejected by the divergence guard",
+            lane=lane, neighbor=neighbor, outcome=outcome,
+            detail=detail)
+        obs.counter("raft_tpu_serve_warm_starts_total",
+                    "warm-start seeding outcomes of the serving loop"
+                    ).inc(1.0, outcome=outcome)
+        self._emit("warm_start_rejected", **e.context())
+        _LOG.warning("serve: %s", e)
+
+    def _solve_lanes(self, runner, batch, batch_id: int, Hs, Tp, beta,
+                     n: int, ncases: int, solve_mode: str):
+        """Solve one gathered batch, warm-starting misses when the
+        result tier is configured for it.  Returns the delivered
+        ``(std, iters, conv, xi)`` host arrays (``xi`` only for
+        cold-solved lanes — seeds always trace to unseeded solves).
+
+        Guard ladder (``docs/robustness.md``): (1) a seeded lane that
+        failed to converge or went non-finite is a
+        ``WarmStartRejected`` — its neighbor seed is quarantined and
+        the whole batch re-solves cold (no digest deviation possible);
+        (2) every ``warm_audit_every``-th seeded batch is *audited*:
+        solved both ways, the cold results delivered, and any seeded
+        lane whose warm response deviates past the solver tolerance is
+        a counted ``warm_start_digest_mismatch`` + quarantine — the
+        tripwire that a poisoned seed changed physics; (3) accepted
+        non-audited warm lanes deliver the seeded solution (converged
+        under the same tolerance a cold start faces) and report
+        iteration savings against the cold baseline EMA."""
+        obs = self._obs()
+        cfg = self.cfg
+        warm_on = (self._store is not None and cfg.warm_start
+                   and getattr(runner, "warm_start", False))
+        if not warm_on:
+            return self._pull(runner(Hs, Tp, beta), n, with_xi=False)
+        nw = int(getattr(runner, "nw", 0))
+        seeds, seed_lanes = self._gather_seeds(
+            batch, ncases, nw, getattr(runner, "xistart", 0.1))
+        if not seed_lanes:
+            # no neighbors yet: a cold solve that BOOTSTRAPS the seed
+            # pool (xi rows ride the one pull and land in the store)
+            pulled = self._pull(runner(Hs, Tp, beta), n, with_xi=True)
+            self._fold_cold_iters(pulled[1])
+            return pulled
+        audit = (batch_id % cfg.warm_audit_every) == 0
+        with self._lock:
+            self._counts["warm_seeded"] += len(seed_lanes)
+        obs.counter("raft_tpu_serve_warm_starts_total",
+                    "warm-start seeding outcomes of the serving loop"
+                    ).inc(float(len(seed_lanes)), outcome="seeded")
+        std_w, iters_w, conv_w, _ = self._pull(
+            runner(Hs, Tp, beta, seeds), n, with_xi=False)
+        bad = [i for i in seed_lanes
+               if i < n and not (bool(conv_w[i])
+                                 and np.all(np.isfinite(std_w[i])))]
+        if not (audit or bad):
+            # accepted: the seeded solution converged under the cold
+            # tolerance; savings measured against the cold-iters EMA.
+            # No xi capture — warm results never become seeds.
+            ema = self._cold_iters_ema
+            if ema is not None:
+                saving = sum(max(0.0, ema - float(iters_w[i]))
+                             for i in seed_lanes if i < n)
+                with self._lock:
+                    self._warm_iter_savings += saving
+            return std_w, iters_w, conv_w, None
+        # guard fallback / audit reference: one cold solve, delivered
+        std, iters, conv, xi = self._pull(runner(Hs, Tp, beta), n,
+                                          with_xi=True)
+        for i in bad:
+            self._store.quarantine(seed_lanes[i])
+            with self._lock:
+                self._counts["warm_rejected"] += 1
+            self._warm_event(
+                "rejected", i, seed_lanes[i],
+                "seeded lane non-converged/non-finite; cold fallback")
+        if audit:
+            tol = float(cfg.tol)
+            for i, rd in sorted(seed_lanes.items()):
+                if i in bad or i >= n:
+                    continue
+                rel = np.abs(std_w[i] - std[i]) / (np.abs(std[i]) + tol)
+                if np.any(rel > tol):
+                    # the warm solve CLAIMED convergence but landed on
+                    # different physics — the poisoned-seed signature
+                    self._store.quarantine(rd)
+                    with self._lock:
+                        self._counts["warm_mismatch"] += 1
+                    self._warm_event(
+                        "mismatch", i, rd,
+                        f"audit deviation {float(np.max(rel)):.3e} > "
+                        f"{tol:g}")
+                else:
+                    with self._lock:
+                        self._warm_iter_savings += max(
+                            0.0, float(iters[i]) - float(iters_w[i]))
+        # cold delivery refreshes the cold-iteration baseline
+        self._fold_cold_iters(iters, exclude=())
+        return std, iters, conv, xi
+
+    def _fold_cold_iters(self, iters, exclude=()):
+        with self._lock:
+            for i, v in enumerate(iters):
+                if i in exclude:
+                    continue
+                v = float(v)
+                self._cold_iters_ema = (
+                    v if self._cold_iters_ema is None
+                    else 0.8 * self._cold_iters_ema + 0.2 * v)
 
     # ------------------------------------------------------------------
     # watchdog abandon path
@@ -1126,7 +1422,7 @@ class SweepService:
                 "latency_s": time.monotonic() - r.submitted_ts}
 
     def _complete(self, r: _Request, std_row, iters: int,
-                  converged: bool, mode: str):
+                  converged: bool, mode: str, xi_row=None):
         obs = self._obs()
         from raft_tpu.obs.ledger import digest_metrics
         digest = digest_metrics({"std": std_row, "iters": int(iters),
@@ -1143,6 +1439,22 @@ class SweepService:
             self._journal.record_complete(
                 r.seq, r.rdigest, digest, mode, r.total_attempts,
                 res.std, res.iters, res.converged)
+        # result tier: persist the payload under the request's content
+        # address (fsync'd + sidecar'd; a put failure is a counted gap,
+        # never a lost delivery — memory and the WAL still have it).
+        # ``xi_row`` carries the warm-start seed only for COLD-solved
+        # lanes, so every seed in the store traces to an unseeded solve.
+        # FULL-rung results only: a no_qtf/coarse solve is a legitimate
+        # delivery to ITS caller under degradation pressure, but it must
+        # never become the canonical cached answer every future repeat
+        # (on every replica, forever) short-circuits to
+        if self._store is not None and mode == "full":
+            self._store.put({"rdigest": r.rdigest, "digest": digest,
+                             "std": res.std, "iters": res.iters,
+                             "converged": res.converged,
+                             "tenant": r.tenant, "Hs": r.Hs, "Tp": r.Tp,
+                             "beta": r.beta, "mode": mode, "id": r.id,
+                             "seq": r.seq}, xi=xi_row)
         with self._lock:
             self._counts["completed"] += 1
             if r.total_attempts:
@@ -1168,6 +1480,52 @@ class SweepService:
                    latency_s=res.latency_s, attempts=r.total_attempts,
                    mode=mode)
         r.ticket._finish(res)
+        self._fanout_complete(r, res)
+
+    def _fanout_complete(self, r: _Request, res: SweepResult):
+        """Deliver a primary's result to its single-flight followers:
+        each gets the identical payload under its own identity,
+        journaled terminal (replay stays idempotent), unless its OWN
+        deadline lapsed while the shared solve ran — per-follower
+        deadlines hold even inside a coalesced flight."""
+        obs = self._obs()
+        with self._lock:
+            if self._flight.get(r.rdigest) is r:
+                del self._flight[r.rdigest]
+            followers, r.followers = r.followers, []
+        now = time.monotonic()
+        for f in followers:
+            if f.ticket.done():
+                continue
+            if f.deadline_ts < now:
+                self._fail(f, errors.DeadlineExceeded(
+                    "coalesced solve finished past this follower's "
+                    "deadline", req=f.seq, coalesced=True))
+                continue
+            fres = dataclasses.replace(
+                res, request_id=f.id, seq=f.seq,
+                latency_s=now - f.submitted_ts, attempts=0,
+                source="coalesced")
+            if self._journal is not None:
+                self._journal.record_complete(
+                    f.seq, f.rdigest, res.digest, res.mode, 0, res.std,
+                    res.iters, res.converged)
+            with self._lock:
+                self._counts["completed"] += 1
+                self._latencies.append(fres.latency_s)
+                # a recovery-coalesced follower is a REPLAYED request:
+                # its delivery must clear the no-silent-drop gate
+                # exactly like a primary's does
+                self._replayed_pending.discard(f.seq)
+            self._untrack_open(f.seq)
+            self._tenants.count(f.tenant, "completed")
+            obs.counter("raft_tpu_serve_requests_total",
+                        "request admissions/outcomes of the sweep "
+                        "service").inc(1.0, outcome="ok")
+            self._emit("request_done", req=f.seq, digest=res.digest,
+                       latency_s=fres.latency_s, attempts=0,
+                       mode=res.mode, coalesced=True)
+            f.ticket._finish(fres)
 
     def _fail(self, r: _Request, e: BaseException,
               quarantined: bool = False, journal: bool = True):
@@ -1199,6 +1557,18 @@ class SweepService:
         self._emit("quarantine" if quarantined else "request_failed",
                    **{**ctx, "phase": "serve", "req": r.seq})
         r.ticket._finish(res)
+        # single-flight: a primary's terminal failure fans out to its
+        # followers with the same typed error (the handoff path's
+        # ``journal=False`` rides along — followers stay pending in the
+        # WAL for the successor exactly like their primary)
+        with self._lock:
+            if self._flight.get(r.rdigest) is r:
+                del self._flight[r.rdigest]
+            followers, r.followers = r.followers, []
+        for f in followers:
+            if not f.ticket.done():
+                self._fail(f, e, quarantined=quarantined,
+                           journal=journal)
 
     # ------------------------------------------------------------------
     # degradation ladder
@@ -1254,19 +1624,104 @@ class SweepService:
             return self.ladder[self._mode_idx]
 
     def fetch(self, digest: str) -> SweepResult | None:
-        """Completed result by its ledger digest (async delivery)."""
+        """Completed result by its ledger digest (async delivery);
+        falls through to the result store after the in-memory LRU
+        evicts."""
         with self._lock:
-            return self._delivered.get(digest)
+            res = self._delivered.get(digest)
+        if res is None and self._store is not None:
+            doc = self._store.get_by_digest(digest)
+            if doc is not None:
+                res = self._result_from_store(doc)
+        return res
+
+    @staticmethod
+    def _result_from_store(doc: dict) -> SweepResult:
+        return SweepResult(
+            ok=True, request_id=str(doc.get("id") or "stored"),
+            seq=int(doc.get("seq", -1)), mode=str(doc.get("mode",
+                                                          "full")),
+            attempts=0, latency_s=0.0, digest=doc.get("digest"),
+            std=[float(v) for v in doc["std"]], iters=int(doc["iters"]),
+            converged=bool(doc["converged"]),
+            tenant=str(doc.get("tenant", DEFAULT_TENANT)),
+            source="stored")
+
+    def _lookup_cached(self, rdigest: str) -> SweepResult | None:
+        """The read-through tier: in-memory LRU first, then the
+        persistent store — a hit is counted, its latency sampled, and
+        the result returned already terminal (memory speed: no queue,
+        no batch window, no WAL)."""
+        obs = self._obs()
+        t0 = time.perf_counter()
+        with self._lock:
+            digest = self._rdigest_index.get(rdigest)
+            res = self._delivered.get(digest) if digest else None
+        # full-rung answers only: a result solved under ladder
+        # degradation (a replay-dedupe index entry, or a store written
+        # by an older/foreign service) must not short-circuit future
+        # full-mode admissions with degraded physics
+        if res is not None and res.mode != "full":
+            res = None
+        source = "memory"
+        if res is None:
+            doc = self._store.get(rdigest)
+            if doc is None or doc.get("mode", "full") != "full":
+                return None
+            res = self._result_from_store(doc)
+            source = "store"
+        elapsed = time.perf_counter() - t0
+        res = dataclasses.replace(res, latency_s=elapsed,
+                                  source="cached")
+        with self._lock:
+            self._counts["store_hits"] += 1
+            self._read_ms.append(elapsed * 1e3)
+        self._tenants.count(res.tenant, "completed")
+        obs.counter(
+            "raft_tpu_serve_result_store_reads_total",
+            "read-through-tier hits at admission, by serving tier"
+            ).inc(1.0, source=source)
+        obs.histogram(
+            "raft_tpu_serve_store_read_s",
+            "read-through-tier hit latency (admission to payload)",
+            buckets=(1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5)
+            ).observe(elapsed)
+        return res
 
     def fetch_rdigest(self, rdigest: str) -> SweepResult | None:
         """Completed result by its REQUEST digest (the content address
         of the submitted physics) — how a router re-resolves an
         in-flight fetch against a successor after the replica that held
         the original ticket died: the successor knows the request from
-        the replayed WAL even though it never issued the ticket."""
+        the replayed WAL even though it never issued the ticket.
+
+        Read ladder: the in-memory index first; after the bounded LRU
+        has evicted, the persistent result store; last, the write-ahead
+        journal itself (a full scan — the journal keeps terminal
+        records the LRU has long forgotten)."""
         with self._lock:
             digest = self._rdigest_index.get(rdigest)
-            return self._delivered.get(digest) if digest else None
+            res = self._delivered.get(digest) if digest else None
+        if res is not None:
+            return res
+        if self._store is not None:
+            doc = self._store.get(rdigest)
+            if doc is not None:
+                return self._result_from_store(doc)
+        if self.cfg.journal_dir:
+            rec = wal.find_rdigest(self.cfg.journal_dir, rdigest)
+            if rec is not None and rec.get("digest"):
+                return SweepResult(
+                    ok=True,
+                    request_id=str(rec.get("id")
+                                   or f"req{rec.get('seq', -1)}"),
+                    seq=int(rec.get("seq", -1)),
+                    mode=str(rec.get("mode", "full")),
+                    attempts=int(rec.get("attempts", 0)), latency_s=0.0,
+                    digest=rec.get("digest"), std=rec.get("std"),
+                    iters=rec.get("iters"),
+                    converged=rec.get("converged"), source="recovered")
+        return None
 
     def _replica_degraded(self) -> bool:
         mirror = self._journal.mirror if self._journal is not None \
@@ -1304,6 +1759,8 @@ class SweepService:
             handoff_info = (dict(self._handoff_info)
                             if self._handoff_info else None)
             replayed_open = len(self._replayed_pending)
+            read_ms = list(self._read_ms)
+            warm_savings = self._warm_iter_savings
         runners = {}
         for name, t in tenancy["tenants"].items():
             for live in t.get("live", []):
@@ -1322,6 +1779,26 @@ class SweepService:
             "tenant_evictions": tenancy["evictions"],
             "tenant_rewarms": tenancy["rewarms"],
         }
+        if self._store is not None:
+            # result-tier facts (serve/resultstore.py): hit ratio over
+            # every request that COULD have hit (hits + admissions),
+            # read-path latency percentiles, single-flight coalescing,
+            # and the warm-start guard/audit counters the
+            # serve_warm_start_digest_mismatch SLO rule gates
+            st = self._store.stats()
+            out["store"] = st
+            out["requests"] += counts["store_hits"]
+            out["store_hit_ratio"] = counts["store_hits"] / max(
+                1, counts["store_hits"] + counts["admitted"])
+            out["read_p50_ms"] = self._percentile(read_ms, 50)
+            out["read_p99_ms"] = self._percentile(read_ms, 99)
+            out["store_corrupt"] = st["corrupt"]
+            out["store_entries"] = st["entries"]
+            out["store_quarantined"] = st["quarantined"]
+            out["warm_start_seeded"] = counts["warm_seeded"]
+            out["warm_start_rejected"] = counts["warm_rejected"]
+            out["warm_start_digest_mismatch"] = counts["warm_mismatch"]
+            out["warm_start_iter_savings"] = round(warm_savings, 3)
         if self._journal is not None:
             out["journal"] = {"path": self._journal.path,
                               "errors": self._journal.errors}

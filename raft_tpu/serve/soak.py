@@ -413,6 +413,253 @@ def run_kill_restart(design: str = "Vertical_cylinder", *,
 
 
 # ---------------------------------------------------------------------------
+# duplicate-storm soak: the result-tier acceptance harness
+# ---------------------------------------------------------------------------
+
+def run_storm(design: str = "Vertical_cylinder", *, store_dir: str,
+              journal_dir: str = None, min_freq: float = 0.05,
+              max_freq: float = 0.5, dfreq: float = 0.05,
+              n_requests: int = 24, n_distinct: int = 4,
+              batch_cases: int = 4, seed: int = 2026,
+              faults_spec: str = "corrupt@resultstore",
+              timeout_s: float = 600.0) -> dict:
+    """The ISSUE-acceptance result-tier soak, five waves over one
+    persistent content-addressed store:
+
+    1. **clean** (store-less, in-process): reference digests for the
+       ``n_distinct`` distinct cases AND their warm-start offset
+       variants — also warms the executable cache.
+    2. **storm**: a fresh warm-start-capable service on the (empty)
+       store; all ``n_requests`` duplicate-heavy requests are admitted
+       *before* the worker starts — the solver runs **exactly once**,
+       over exactly the distinct lanes (single-flight), every duplicate
+       delivered bit-identical; the cold solutions seed the store.
+    3. **reads**: a *different* service instance (a "replica" sharing
+       the store; its own journal) re-submits every distinct case —
+       every ticket resolves at admission (zero solves), bit-for-bit,
+       and ``fetch_rdigest`` resolves from the store.
+    4. **corruption**: under ``corrupt@resultstore`` every store read
+       fails its integrity check — each entry is deleted, counted, and
+       **re-solved**; every delivered digest still equals the clean
+       run's (zero corrupt bytes served).
+    5. **warm**: the offset cases (inside ``warm_radius`` of wave 2's
+       entries) solve seeded from their neighbors under
+       ``warm_audit_every=1`` — every batch audited, cold results
+       delivered (digest parity bit-for-bit by construction), warm
+       iteration savings measured, zero audit mismatches.
+
+    The verdict additionally replays the wave-2 journal (when
+    ``journal_dir`` is given): every admitted seq — followers included
+    — must be terminal, so a replay after a crash mid-storm re-solves
+    nothing it already delivered."""
+    from raft_tpu import obs
+    from raft_tpu.serve import journal as wal
+    from raft_tpu.testing import faults
+
+    t0 = time.monotonic()
+    D = int(n_distinct)
+    if D > int(batch_cases):
+        # the storm's headline proof is "D distinct digests -> ONE
+        # runner invocation"; spreading the distinct set over several
+        # batches (where later batches may also warm-seed + audit)
+        # would make that count ambiguous — reject loudly instead of
+        # gating a meaningless number
+        raise errors.ModelConfigError(
+            "run_storm needs n_distinct <= batch_cases (the distinct "
+            "set must fit one batch for the exactly-one-runner-call "
+            "verdict)", n_distinct=D, batch_cases=int(batch_cases))
+    fowt = build_fowt(design, min_freq, max_freq, dfreq)
+    Hs, Tp, beta = case_table(D, seed=seed)
+    # warm-offset variants: nearby in (Hs, Tp), same headings — inside
+    # the default warm radius of their wave-2 neighbors
+    Hs_off, Tp_off = Hs + 0.15, Tp + 0.1
+    manifest = obs.RunManifest.begin(kind="serve_storm", config={
+        "design": design, "n_requests": int(n_requests),
+        "n_distinct": D, "batch_cases": int(batch_cases),
+        "faults": faults_spec, "seed": int(seed)})
+    status = "failed"
+
+    def storm_config(**kw):
+        base = dict(batch_cases=batch_cases, queue_max=max(8, D),
+                    store_dir=store_dir, warm_start=True,
+                    warm_audit_every=1, deadline_s=timeout_s)
+        base.update(kw)
+        return default_config(**base)
+
+    try:
+        # -- wave 1: clean reference (no store) -----------------------
+        faults.install("")
+        svc = SweepService(fowt, default_config(
+            batch_cases=batch_cases, queue_max=2 * D,
+            deadline_s=timeout_s))
+        clean_results, _ = _run_all(
+            svc, (np.concatenate([Hs, Hs_off]),
+                  np.concatenate([Tp, Tp_off]),
+                  np.concatenate([beta, beta])), timeout_s)
+        svc.stop()
+        if not all(r.ok for r in clean_results.values()):
+            raise errors.KernelFailure("storm soak clean pass failed")
+        clean = {i: clean_results[i].digest for i in range(D)}
+        clean_off = {i: clean_results[D + i].digest for i in range(D)}
+
+        # -- wave 2: the duplicate storm (single-flight) --------------
+        lanes_solved = []
+
+        def counting_factory(mode, f, ncases, **kw):
+            from raft_tpu.parallel.sweep import make_batch_runner
+            run = make_batch_runner(f, ncases, warm_start=True, **kw)
+
+            def counted(Hs_, Tp_, beta_, Xi0=None):
+                lanes_solved.append(np.asarray(Hs_).tolist())
+                return run(Hs_, Tp_, beta_, Xi0)
+            for attr in ("ncases", "cache_state", "warm_start", "nw",
+                         "xistart", "build_s", "key", "mesh"):
+                setattr(counted, attr, getattr(run, attr))
+            return counted
+
+        svc = SweepService(fowt, storm_config(
+            queue_max=max(8, D), journal_dir=journal_dir),
+            runner_factory=counting_factory)
+        tickets = {}
+        for i in range(int(n_requests)):
+            j = i % D
+            tickets[i] = svc.submit(Hs[j], Tp[j], beta[j])
+        svc.start()
+        storm_results = _collect(tickets, timeout_s)
+        storm_summary = svc.stop()
+        solved = sum(1 for r in storm_results.values()
+                     if r.ok and r.source == "solved")
+        coalesced = sum(1 for r in storm_results.values()
+                        if r.ok and r.source == "coalesced")
+        storm_mismatch = [
+            i for i, r in storm_results.items()
+            if not r.ok or r.digest != clean[i % D]]
+        # exactly ONE runner invocation, carrying the D distinct lanes
+        storm_runner_calls = len(lanes_solved)
+
+        # journaled delivery: every admitted seq (followers included)
+        # is terminal — a replay after a crash re-solves nothing
+        journal_pending = None
+        if journal_dir:
+            st = wal.replay(journal_dir)
+            journal_pending = len(st["pending"]) + len(st["deduped"])
+
+        # -- wave 3: cross-replica / cross-restart reads --------------
+        svc = SweepService(fowt, storm_config(), runner_factory=None)
+        read_tickets = {i: svc.submit(Hs[i], Tp[i], beta[i])
+                        for i in range(D)}
+        reads_resolved_at_admission = all(
+            t.done() for t in read_tickets.values())
+        read_results = {i: t.result(1.0)
+                        for i, t in read_tickets.items()}
+        # LRU-eviction fall-through: a fresh service's index is empty,
+        # so fetch_rdigest must resolve from the store
+        fetch_ok = all(
+            svc.fetch_rdigest(wal.request_digest(
+                Hs[i], Tp[i], beta[i], "default")) is not None
+            for i in range(D))
+        svc.start()
+        read_summary = svc.stop()
+        read_mismatch = [i for i, r in read_results.items()
+                         if not r.ok or r.digest != clean[i]
+                         or r.std != storm_results[i].std]
+
+        # -- wave 4: corruption storm ---------------------------------
+        faults.install(faults_spec)
+        svc = SweepService(fowt, storm_config())
+        cor_tickets = {i: svc.submit(Hs[i], Tp[i], beta[i])
+                       for i in range(D)}
+        svc.start()
+        cor_results = _collect(cor_tickets, timeout_s)
+        faults.install("")
+        cor_summary = svc.stop()
+        cor_mismatch = [i for i, r in cor_results.items()
+                        if not r.ok or r.digest != clean[i]]
+        # ground truth: a corrupt byte SERVED would be a digest that
+        # differs from the clean run while claiming success
+        corrupt_served = len(cor_mismatch)
+        corrupt_detected = cor_summary.get("store_corrupt", 0)
+
+        # -- wave 5: neighbor warm starts (audited) -------------------
+        svc = SweepService(fowt, storm_config())
+        warm_tickets = {i: svc.submit(Hs_off[i], Tp_off[i], beta[i])
+                        for i in range(D)}
+        svc.start()
+        warm_results = _collect(warm_tickets, timeout_s)
+        warm_summary = svc.stop()
+        warm_mismatch_vs_clean = [
+            i for i, r in warm_results.items()
+            if not r.ok or r.digest != clean_off[i]]
+        wall_s = time.monotonic() - t0
+
+        facts = {
+            "n_requests": int(n_requests), "n_distinct": D,
+            "solves": solved, "coalesced": coalesced,
+            "runner_calls_storm": storm_runner_calls,
+            "store_hit_ratio": read_summary.get("store_hit_ratio"),
+            "read_p50_ms": read_summary.get("read_p50_ms"),
+            "read_p99_ms": read_summary.get("read_p99_ms"),
+            "store_corrupt_detected": corrupt_detected,
+            "store_corrupt_served_count": corrupt_served,
+            "warm_start_seeded": warm_summary.get("warm_start_seeded"),
+            "warm_start_rejected": warm_summary.get(
+                "warm_start_rejected"),
+            "warm_start_iter_savings": warm_summary.get(
+                "warm_start_iter_savings"),
+            "warm_start_digest_mismatch":
+                warm_summary.get("warm_start_digest_mismatch", 0)
+                + len(warm_mismatch_vs_clean),
+        }
+        manifest.extra["serve_storm"] = facts
+        report = {
+            **facts,
+            "faults": faults_spec,
+            "journal_pending_after_storm": journal_pending,
+            "digest_mismatches": {"storm": storm_mismatch,
+                                  "reads": read_mismatch,
+                                  "corrupt": cor_mismatch,
+                                  "warm": warm_mismatch_vs_clean},
+            "reads_resolved_at_admission": reads_resolved_at_admission,
+            "fetch_rdigest_ok": fetch_ok,
+            "summaries": {"storm": storm_summary, "reads": read_summary,
+                          "corrupt": cor_summary, "warm": warm_summary},
+            "wall_s": wall_s,
+            "ok": (solved == D
+                   and coalesced == int(n_requests) - D
+                   and storm_runner_calls == 1
+                   and not storm_mismatch and not read_mismatch
+                   and not cor_mismatch and not warm_mismatch_vs_clean
+                   and reads_resolved_at_admission and fetch_ok
+                   and read_summary.get("store_hits", 0) == D
+                   and corrupt_detected >= D and corrupt_served == 0
+                   and (warm_summary.get("warm_start_iter_savings")
+                        or 0) > 0
+                   and warm_summary.get("warm_start_digest_mismatch",
+                                        0) == 0
+                   and (journal_pending in (None, 0))
+                   and all(s.get("unhandled", 0) == 0
+                           for s in (storm_summary, read_summary,
+                                     cor_summary, warm_summary))),
+        }
+        status = "ok" if report["ok"] else "failed"
+    finally:
+        faults.clear()
+        obs.finish_run(manifest, status=status)
+    lvl = _LOG.info if report["ok"] else _LOG.error
+    lvl("duplicate-storm soak: %s — %d requests / %d distinct: %d "
+        "solve(s) in %d runner call(s), %d coalesced; reads: %d store "
+        "hit(s) (p50 %.3f ms); corruption: %d detected, %d served; "
+        "warm: savings=%.1f iters, %d mismatch(es); %.1fs",
+        "OK" if report["ok"] else "FAILED", n_requests, D, solved,
+        storm_runner_calls, coalesced,
+        read_summary.get("store_hits", 0),
+        read_summary.get("read_p50_ms") or -1.0, corrupt_detected,
+        corrupt_served, facts["warm_start_iter_savings"] or 0.0,
+        facts["warm_start_digest_mismatch"], wall_s)
+    return report
+
+
+# ---------------------------------------------------------------------------
 # cross-host failover soak: the replication acceptance harness
 # ---------------------------------------------------------------------------
 

@@ -18,6 +18,7 @@ exec_cache  ``parallel.exec_cache.load`` on the deserialized bytes
 serve     ``serve.service`` request worker (per-request, pre/post solve)
 journal   ``serve.journal`` write-ahead journal writes
 replica   ``serve.replica`` WAL mirroring to peer stores
+resultstore  ``serve.resultstore`` content-addressed result reads
 ========  ==========================================================
 
 Spec grammar (comma-separated specs)::
@@ -25,8 +26,10 @@ Spec grammar (comma-separated specs)::
     RAFT_TPU_FAULTS="<action>@<site>[:qualifier]*[,...]"
 
     action     nan | raise | corrupt | hang | kill | torn | drop | lag
-    qualifier  case=N | lane=N | fowt=N | req=N | part=N | once | times=K
-               | s=SECONDS | ms=MILLIS  (hang/lag duration)
+               | stale
+    qualifier  case=N | lane=N | fowt=N | req=N | part=N | entry=HEX
+               | once | times=K | s=SECONDS | ms=MILLIS  (hang/lag
+               duration)
 
 Examples: ``nan@dynamics:case=2`` poisons case 2's converged impedance
 with NaN (exercising the non-finite sanitizer and the ladder);
@@ -57,9 +60,9 @@ _FIRED: dict[tuple, int] = {}
 _CONTEXT: list[dict] = []
 
 _ACTIONS = ("nan", "raise", "corrupt", "hang", "kill", "torn", "drop",
-            "lag")
+            "lag", "stale")
 _SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache",
-          "serve", "journal", "replica")
+          "serve", "journal", "replica", "resultstore")
 
 #: exception class raised per site for ``raise@<site>`` specs.  Site/
 #: action support: statics, dynamics, kernel take ``nan`` and ``raise``;
@@ -80,7 +83,15 @@ _SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache",
 #: freshly-sealed journal part — the catch-up resync must recover it)
 #: and ``lag`` (``lag@replica:s=S`` defers mirroring by S seconds so
 #: per-peer lag grows and the typed ``ReplicaLagExceeded`` degradation
-#: signal trips) and nothing else.
+#: signal trips) and nothing else; resultstore (the content-addressed
+#: read seam in raft_tpu/serve/resultstore.py) takes ``corrupt``
+#: (``corrupt@resultstore[:entry=HEX]`` damages the raw entry bytes
+#: before the size/sha256 sidecar check — the delete-and-miss path) and
+#: ``stale`` (``stale@resultstore[:entry=HEX]`` perturbs the PARSED
+#: payload after the byte-level checks pass, a digest-mismatched entry
+#: that only the semantic result-digest check can reject) and nothing
+#: else; ``entry=`` matches the bare hex stem of the request digest
+#: (digest strings carry a ``:`` which the qualifier grammar reserves).
 _RAISES = {
     "statics": errors.StaticsDivergence,
     "dynamics": errors.DynamicsSingular,
@@ -111,6 +122,11 @@ _UNSUPPORTED |= {("drop", s) for s in _SITES if s != "replica"}
 _UNSUPPORTED |= {("lag", s) for s in _SITES if s != "replica"}
 _UNSUPPORTED |= {(a, "replica") for a in _ACTIONS
                  if a not in ("drop", "lag")}
+# stale is resultstore-only, and the resultstore site takes only the
+# two integrity attacks its read path implements (corrupt + stale)
+_UNSUPPORTED |= {("stale", s) for s in _SITES if s != "resultstore"}
+_UNSUPPORTED |= {(a, "resultstore") for a in _ACTIONS
+                 if a not in ("corrupt", "stale")}
 
 #: default stall of a ``hang@serve`` spec without an ``s=``/``ms=``
 #: qualifier — long enough to trip any realistic watchdog deadline

@@ -408,7 +408,8 @@ _RTL004_SERVE_OPTS = {"rtl004": {
                           "raft_tpu/serve/watchdog.py",
                           "raft_tpu/serve/journal.py",
                           "raft_tpu/serve/replica.py",
-                          "raft_tpu/serve/router.py"],
+                          "raft_tpu/serve/router.py",
+                          "raft_tpu/serve/resultstore.py"],
 }}
 
 _SERVE_SEAM_SRC = """
@@ -526,6 +527,45 @@ def test_rtl004_replication_modules_fixture_pair(tmp_path):
     # identical file anywhere else in serve/: BOTH fire
     rep2 = lint_src(tmp_path, _REPLICATION_SRC, "RTL004",
                     relname="raft_tpu/serve/mirroring.py",
+                    options=_RTL004_SERVE_OPTS)
+    msgs = [f.message for f in rep2.findings]
+    assert len(msgs) == 2
+    assert any("except" in m for m in msgs)
+    assert any("raise RuntimeError" in m for m in msgs)
+
+
+_RESULTSTORE_SRC = """
+    from raft_tpu import errors
+
+    def put_entry(path, data):
+        try:
+            with open(path, "wb") as f:
+                f.write(data)
+        except Exception:        # counted put gap, never a dead service
+            return False
+        return True
+
+    def verify(doc):
+        if doc is None:
+            raise RuntimeError("untyped store corruption")
+"""
+
+
+def test_rtl004_resultstore_fixture_pair(tmp_path):
+    """serve/resultstore.py is a solve-path module with a
+    config-sanctioned keep-alive seam: a store put/read failing must be
+    a counted gap or a delete-and-miss, never a dead service — so its
+    broad except is silent INSIDE resultstore.py and fires in any
+    other serve file; the untyped raise fires everywhere (store
+    corruption must be the typed ResultStoreCorrupt)."""
+    rep = lint_src(tmp_path, _RESULTSTORE_SRC, "RTL004",
+                   relname="raft_tpu/serve/resultstore.py",
+                   options=_RTL004_SERVE_OPTS)
+    assert len(rep.findings) == 1
+    assert "raise RuntimeError" in rep.findings[0].message
+    # identical file anywhere else in serve/: BOTH fire
+    rep2 = lint_src(tmp_path, _RESULTSTORE_SRC, "RTL004",
+                    relname="raft_tpu/serve/readtier.py",
                     options=_RTL004_SERVE_OPTS)
     msgs = [f.message for f in rep2.findings]
     assert len(msgs) == 2

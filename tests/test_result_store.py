@@ -1,0 +1,696 @@
+"""The content-addressed result tier (raft_tpu/serve/resultstore.py).
+
+Unit tier (stub batch engines, no solves): store roundtrip + the
+integrity ladder (torn put, byte corruption, stale-payload rejection,
+delete-and-miss accounting), the fault grammar, neighbor search +
+quarantine, read-through hits at admission (memory speed, batch window
+bypassed, across restarts and replicas, bit-for-bit), single-flight
+coalescing (exactly D solves under a concurrent duplicate storm,
+per-follower deadlines, failure fan-out, replay coalescing), the
+``fetch_rdigest`` LRU-eviction fall-through (store, then journal), the
+router's local store consult, and the trend-store facts / SLO rules.
+
+Integration tier (one coarse Vertical_cylinder model): neighbor
+warm-start parity — audited warm batches deliver cold-identical
+digests with strictly fewer seeded iterations on a smooth grid, and a
+deliberately poisoned neighbor seed trips the typed
+``WarmStartRejected`` fallback with no digest deviation — plus the
+ISSUE-acceptance duplicate-storm soak (``serve.soak.run_storm``).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import errors, obs
+from raft_tpu.obs.ledger import digest_metrics
+from raft_tpu.serve import ServeConfig, SweepService
+from raft_tpu.serve import journal as wal
+from raft_tpu.serve.resultstore import ResultStore
+from raft_tpu.testing import faults
+
+
+def _payload(Hs=2.0, Tp=8.0, beta=0.0, tenant="default", iters=3,
+             converged=True, seed=1.0):
+    std = [float(seed) * (i + 1) for i in range(6)]
+    rdigest = wal.request_digest(Hs, Tp, beta, tenant)
+    digest = digest_metrics({"std": std, "iters": int(iters),
+                             "converged": bool(converged)})
+    return {"rdigest": rdigest, "digest": digest, "std": std,
+            "iters": int(iters), "converged": bool(converged),
+            "tenant": tenant, "Hs": float(Hs), "Tp": float(Tp),
+            "beta": float(beta)}
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(queue_max=16, batch_cases=4, window_s=0.02,
+                batch_deadline_s=10.0, retry_base_s=0.01,
+                degrade_after=99, store_dir=str(tmp_path / "store"))
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def stub_factory(mode, fowt, ncases, **kw):
+    """Instant deterministic engine: std row = Hs replicated."""
+    def run(Hs, Tp, beta):
+        Hs = np.asarray(Hs)
+        return {"std": np.stack([np.full(6, float(h)) for h in Hs]),
+                "iters": np.full(len(Hs), 3),
+                "converged": np.ones(len(Hs), bool)}
+    run.ncases = ncases
+    run.cache_state = "stub"
+    return run
+
+
+def counting_stub_factory(calls):
+    def factory(mode, fowt, ncases, **kw):
+        base = stub_factory(mode, fowt, ncases, **kw)
+
+        def run(Hs, Tp, beta):
+            calls.append(np.asarray(Hs).tolist())
+            return base(Hs, Tp, beta)
+        run.ncases = ncases
+        run.cache_state = "stub"
+        return run
+    return factory
+
+
+# ---------------------------------------------------------------------------
+# unit: the store itself
+# ---------------------------------------------------------------------------
+
+def test_store_roundtrip_sidecar_and_seed(tmp_path):
+    s = ResultStore(str(tmp_path), keep_xi=True)
+    p = _payload()
+    xi = (np.arange(12.0) + 2j).reshape(6, 2)
+    assert s.put(p, xi=xi)
+    stem = p["rdigest"].rsplit(":", 1)[-1]
+    side_path = tmp_path / f"{stem}.sum"
+    assert (tmp_path / f"{stem}.json").exists()
+    assert side_path.exists() and (tmp_path / f"{stem}.xi").exists()
+    side = json.loads(side_path.read_text())
+    assert side["sha256"] and side["size"] > 0 and side["xi_sha256"]
+    doc = s.get(p["rdigest"])
+    assert doc["std"] == p["std"] and doc["digest"] == p["digest"]
+    assert np.array_equal(s.get_xi(p["rdigest"]), xi)
+    assert s.get_by_digest(p["digest"])["rdigest"] == p["rdigest"]
+    # a fresh handle rebuilds the neighbor index from sidecars alone
+    s2 = ResultStore(str(tmp_path), keep_xi=True)
+    assert len(s2) == 1
+    assert s2.nearest(2.1, 8.0, 0.0, "default", radius=1.0)[0] \
+        == p["rdigest"]
+    st = s.stats()
+    assert st["puts"] == 1 and st["corrupt"] == 0 and st["seeds"] == 1
+
+
+def test_store_torn_put_reads_as_counted_miss(tmp_path):
+    s = ResultStore(str(tmp_path))
+    p = _payload()
+    assert s.put(p)
+    stem = p["rdigest"].rsplit(":", 1)[-1]
+    (tmp_path / f"{stem}.sum").unlink()      # the crash-before-sidecar
+    # within TORN_GRACE_S the payload may be a concurrent put mid-
+    # commit: a plain miss that must NOT delete the entry
+    assert s.get(p["rdigest"]) is None
+    assert (tmp_path / f"{stem}.json").exists()
+    assert s.stats()["corrupt"] == 0 and s.stats()["misses"] == 1
+    # past the grace window it is a genuine torn put: delete-and-miss
+    old = time.time() - 2 * ResultStore.TORN_GRACE_S
+    os.utime(tmp_path / f"{stem}.json", (old, old))
+    assert s.get(p["rdigest"]) is None
+    assert not (tmp_path / f"{stem}.json").exists()
+    assert s.stats()["corrupt"] == 1
+    # a genuinely absent key is a plain miss, not corruption
+    assert s.get(_payload(Hs=9.0)["rdigest"]) is None
+    assert s.stats()["misses"] == 2 and s.stats()["corrupt"] == 1
+
+
+def test_store_corrupt_bytes_delete_and_miss_and_strict(tmp_path):
+    s = ResultStore(str(tmp_path))
+    p = _payload()
+    s.put(p)
+    stem = p["rdigest"].rsplit(":", 1)[-1]
+    path = tmp_path / f"{stem}.json"
+    raw = bytearray(path.read_bytes())
+    raw[0] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    assert s.get(p["rdigest"]) is None       # delete-and-miss
+    assert not path.exists()
+    assert s.stats()["corrupt"] == 1
+    snap = obs.snapshot()
+    series = snap["raft_tpu_serve_result_store_corrupt_total"]["series"]
+    assert sum(x["value"] for x in series) >= 1
+    # strict mode surfaces the typed subclass instead
+    s.put(p)
+    path.write_bytes(b"garbage")
+    with pytest.raises(errors.ResultStoreCorrupt) as exc:
+        s.get(p["rdigest"], strict=True)
+    assert isinstance(exc.value, errors.CacheCorruption)
+
+
+def test_store_fault_corrupt_and_stale_rejected(tmp_path):
+    """corrupt@resultstore drives the byte-level reject; stale@ serves
+    a byte-consistent but digest-mismatched payload that ONLY the
+    semantic check can catch — both end delete-and-miss."""
+    s = ResultStore(str(tmp_path))
+    p, q = _payload(), _payload(Hs=3.0, seed=2.0)
+    s.put(p)
+    s.put(q)
+    stem_p = p["rdigest"].rsplit(":", 1)[-1]
+    faults.install(f"corrupt@resultstore:entry={stem_p}")
+    try:
+        assert s.get(q["rdigest"]) is not None   # other entries fine
+        assert s.get(p["rdigest"]) is None
+        assert s.stats()["corrupt"] == 1
+    finally:
+        faults.clear()
+    faults.install("stale@resultstore")
+    try:
+        assert s.get(q["rdigest"]) is None
+        assert s.stats()["corrupt"] == 2
+    finally:
+        faults.clear()
+    # both attacked entries are gone; the store itself still serves
+    assert len(s) == 0
+
+
+def test_faults_resultstore_grammar():
+    specs = faults.parse(
+        "corrupt@resultstore:entry=abc,stale@resultstore:once,"
+        "corrupt@resultstore")
+    assert [f["action"] for f in specs] == ["corrupt", "stale",
+                                           "corrupt"]
+    assert specs[0]["match"] == {"entry": "abc"}
+    # unsupported combos rejected at parse time (never a silent no-op)
+    assert faults.parse("stale@serve,stale@journal,nan@resultstore,"
+                        "torn@resultstore,hang@resultstore,"
+                        "kill@resultstore,drop@resultstore") == []
+
+
+def test_nearest_respects_radius_tenant_and_quarantine(tmp_path):
+    s = ResultStore(str(tmp_path), keep_xi=True)
+    near = _payload(Hs=2.0, Tp=8.0)
+    far = _payload(Hs=5.0, Tp=11.0)
+    other = _payload(Hs=2.05, Tp=8.0, tenant="acme")
+    xi = np.ones((6, 2), complex)
+    for p in (near, far, other):
+        s.put(p, xi=xi)
+    got = s.nearest(2.1, 8.1, 0.0, "default", radius=1.0)
+    assert got[0] == near["rdigest"] and got[1] < 0.2
+    assert s.nearest(9.0, 3.0, 0.0, "default", radius=1.0) is None
+    assert s.nearest(2.1, 8.0, 0.0, "acme", radius=1.0)[0] \
+        == other["rdigest"]
+    s.quarantine(near["rdigest"])
+    assert s.nearest(2.1, 8.1, 0.0, "default", radius=1.0) is None
+    assert s.stats()["quarantined"] == 1
+    # a seed-less entry never seeds
+    s2 = ResultStore(str(tmp_path / "noxi"), keep_xi=False)
+    s2.put(_payload())
+    assert s2.nearest(2.0, 8.0, 0.0, "default", radius=1.0) is None
+
+
+def test_warm_watchdog_window_covers_audit_double_solve(tmp_path,
+                                                        monkeypatch):
+    """An audited (or guard-fallback) warm batch legitimately runs TWO
+    solves — the watchdog window must cover both, or every audit would
+    be abandoned and accrue hang strikes toward quarantine."""
+    from raft_tpu.serve.watchdog import Watchdog
+
+    windows = []
+    real_arm = Watchdog.arm
+
+    def arm(self, deadline_ts, on_expire):
+        windows.append(deadline_ts - time.monotonic())
+        return real_arm(self, deadline_ts, on_expire)
+
+    monkeypatch.setattr(Watchdog, "arm", arm)
+
+    def warm_stub(mode, fowt, ncases, **kw):
+        def run(Hs, Tp, beta, Xi0=None):
+            Hs = np.asarray(Hs)
+            return {"std": np.stack([np.full(6, float(h)) for h in Hs]),
+                    "iters": np.full(len(Hs), 3),
+                    "converged": np.ones(len(Hs), bool),
+                    "Xi": np.zeros((len(Hs), 6, 2), complex)}
+        run.ncases = ncases
+        run.cache_state = "stub"
+        run.warm_start = True
+        run.nw = 2
+        run.xistart = 0.1
+        return run
+
+    cfg = _cfg(tmp_path, warm_start=True, warm_audit_every=1,
+               batch_deadline_s=8.0)
+    svc = SweepService(None, cfg, runner_factory=warm_stub)
+    svc.start()
+    try:
+        assert svc.submit(2.0, 8.0, 0.0).result(10.0).ok
+    finally:
+        svc.stop(drain=False, timeout=5.0)
+    assert windows and windows[-1] > 1.5 * cfg.batch_deadline_s
+
+
+def test_quarantine_is_durable_across_handles(tmp_path):
+    """A quarantined seed must stay out of nearest() after a restart
+    and for sibling replicas sharing the directory — the .xi file is
+    unlinked, not just flagged in this process's memory."""
+    s = ResultStore(str(tmp_path), keep_xi=True)
+    p = _payload(Hs=2.0, Tp=8.0)
+    s.put(p, xi=np.ones((6, 2), complex))
+    # a sibling replica over the same directory sees the seed...
+    sib = ResultStore(str(tmp_path), keep_xi=True)
+    assert sib.nearest(2.1, 8.0, 0.0, "default", radius=1.0)[0] \
+        == p["rdigest"]
+    s.quarantine(p["rdigest"])
+    stem = p["rdigest"].rsplit(":", 1)[-1]
+    assert not (tmp_path / f"{stem}.xi").exists()
+    # ...but never after the quarantine: neither the already-running
+    # sibling (index refresh) nor a fresh post-restart handle
+    assert sib.nearest(2.1, 8.0, 0.0, "default", radius=1.0) is None
+    fresh = ResultStore(str(tmp_path), keep_xi=True)
+    assert fresh.nearest(2.1, 8.0, 0.0, "default", radius=1.0) is None
+    # the payload itself stays readable — only seeding is revoked
+    assert fresh.get(p["rdigest"])["digest"] == p["digest"]
+
+
+def test_index_refreshes_across_processes(tmp_path):
+    """get_by_digest()/nearest() must see entries written by a sibling
+    process after this handle's first index load (the router consults
+    its local store for a dead replica's results)."""
+    reader = ResultStore(str(tmp_path), keep_xi=True)
+    assert len(reader) == 0                  # index loaded while empty
+    writer = ResultStore(str(tmp_path), keep_xi=True)
+    p = _payload(Hs=3.0, Tp=9.0)
+    writer.put(p, xi=np.ones((6, 2), complex))
+    assert reader.get_by_digest(p["digest"])["rdigest"] == p["rdigest"]
+    assert reader.nearest(3.1, 9.0, 0.0, "default", radius=1.0)[0] \
+        == p["rdigest"]
+    assert len(reader) == 1
+
+
+# ---------------------------------------------------------------------------
+# unit: read-through admission + single-flight coalescing
+# ---------------------------------------------------------------------------
+
+def test_store_hit_bypasses_batch_window_and_restarts(tmp_path):
+    calls = []
+    cfg = _cfg(tmp_path)
+    svc = SweepService(runner_factory=counting_stub_factory(calls),
+                       config=cfg)
+    svc.start()
+    r0 = svc.submit(2.0, 8.0, 0.0).result(10.0)
+    assert r0.ok and r0.source == "solved"
+    svc.stop()
+    # a NEW service on the same store, worker never started: the exact
+    # repeat resolves AT ADMISSION — no queue, no batch window, no WAL
+    svc2 = SweepService(runner_factory=counting_stub_factory(calls),
+                        config=cfg)
+    t = svc2.submit(2.0, 8.0, 0.0)
+    assert t.done()
+    r1 = t.result(0.0)
+    assert r1.source == "cached"
+    assert r1.digest == r0.digest and r1.std == r0.std   # bit-for-bit
+    svc2.start()
+    s = svc2.stop()
+    assert s["store_hits"] == 1 and s["admitted"] == 0
+    assert s["store_hit_ratio"] == 1.0
+    assert s["read_p50_ms"] is not None
+    assert len(calls) == 1                    # one solve, ever
+    snap = obs.snapshot()
+    series = snap["raft_tpu_serve_result_store_reads_total"]["series"]
+    assert any(x["labels"].get("source") == "store" for x in series)
+
+
+def test_single_flight_concurrent_storm_exactly_d_solves(tmp_path):
+    calls = []
+    gate = threading.Event()
+
+    def factory(mode, fowt, ncases, **kw):
+        def run(Hs, Tp, beta):
+            gate.wait(10.0)
+            Hs = np.asarray(Hs)
+            calls.append(Hs.tolist())
+            return {"std": np.stack([np.full(6, float(h)) for h in Hs]),
+                    "iters": np.full(len(Hs), 3),
+                    "converged": np.ones(len(Hs), bool)}
+        run.ncases = ncases
+        run.cache_state = "stub"
+        return run
+
+    svc = SweepService(runner_factory=factory, config=_cfg(tmp_path))
+    n, d = 24, 3
+    tickets = [None] * n
+    barrier = threading.Barrier(8)
+
+    def storm(k):
+        barrier.wait(5.0)
+        for i in range(k, n, 8):
+            tickets[i] = svc.submit(1.0 + (i % d), 8.0, 0.0)
+    threads = [threading.Thread(target=storm, args=(k,))
+               for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    svc.start()
+    gate.set()
+    results = [t.result(20.0) for t in tickets]
+    assert all(r.ok for r in results)
+    # duplicates bit-identical to their primary
+    by_hs = {}
+    for r in results:
+        by_hs.setdefault(r.std[0], set()).add(r.digest)
+    assert all(len(v) == 1 for v in by_hs.values())
+    s = svc.stop()
+    distinct_solved = {h for lanes in calls for h in lanes}
+    assert len(distinct_solved) == d          # exactly D distinct solves
+    assert s["coalesced"] == n - d
+    assert s["completed"] == n
+
+
+def test_single_flight_follower_deadline_and_failure_fanout(tmp_path):
+    gate = threading.Event()
+
+    def slow_factory(mode, fowt, ncases, **kw):
+        def run(Hs, Tp, beta):
+            gate.wait(10.0)
+            Hs = np.asarray(Hs)
+            return {"std": np.stack([np.full(6, float(h)) for h in Hs]),
+                    "iters": np.full(len(Hs), 3),
+                    "converged": np.ones(len(Hs), bool)}
+        run.ncases = ncases
+        run.cache_state = "stub"
+        return run
+
+    svc = SweepService(runner_factory=slow_factory,
+                       config=_cfg(tmp_path, batch_cases=1))
+    svc.start()
+    prim = svc.submit(2.0, 8.0, 0.0)
+    time.sleep(0.1)                          # the solve is in flight
+    fol_ok = svc.submit(2.0, 8.0, 0.0)
+    fol_dead = svc.submit(2.0, 8.0, 0.0, deadline_s=0.05)
+    time.sleep(0.2)                          # follower deadline lapses
+    gate.set()
+    assert prim.result(10.0).ok
+    r_ok = fol_ok.result(10.0)
+    assert r_ok.ok and r_ok.source == "coalesced"
+    r_dead = fol_dead.result(10.0)
+    assert not r_dead.ok
+    assert r_dead.error["error"] == "DeadlineExceeded"
+    s = svc.stop()
+    assert s["coalesced"] == 2
+
+    # failure fan-out: the primary's typed terminal failure reaches
+    # every follower (budget-exhausted NonFiniteResult here)
+    def nan_factory(mode, fowt, ncases, **kw):
+        def run(Hs, Tp, beta):
+            Hs = np.asarray(Hs)
+            return {"std": np.full((len(Hs), 6), np.nan),
+                    "iters": np.full(len(Hs), 3),
+                    "converged": np.zeros(len(Hs), bool)}
+        run.ncases = ncases
+        run.cache_state = "stub"
+        return run
+
+    svc = SweepService(runner_factory=nan_factory,
+                       config=_cfg(tmp_path / "b", retry_base_s=0.0))
+    p = svc.submit(2.0, 8.0, 0.0)
+    f = svc.submit(2.0, 8.0, 0.0)
+    svc.start()
+    rp, rf = p.result(20.0), f.result(20.0)
+    assert not rp.ok and not rf.ok
+    assert rf.error["error"] == rp.error["error"] == "NonFiniteResult"
+    svc.stop()
+
+
+def test_recover_coalesces_duplicate_pending(tmp_path):
+    """A crash mid-storm leaves N pending admits over D digests; the
+    successor's replay re-admits exactly D primaries with the
+    duplicates attached as followers — one solve each, idempotent."""
+    cfg = _cfg(tmp_path, journal_dir=str(tmp_path / "journal"))
+    crashed = SweepService(runner_factory=stub_factory, config=cfg)
+    for _ in range(3):
+        crashed.submit(2.0, 8.0, 0.0)
+    crashed.submit(4.0, 9.0, 0.0)
+    # no start(), no stop(): the WAL holds 4 admits, zero terminals
+    calls = []
+    svc = SweepService(runner_factory=counting_stub_factory(calls),
+                       config=cfg)
+    info = svc.recover()
+    assert info["replayed"] == 4
+    svc.start()
+    results = {seq: t.result(20.0) for seq, t in info["tickets"].items()}
+    summary = svc.stop()
+    assert all(r.ok for r in results.values())
+    assert len({r.digest for r in results.values()}) == 2
+    distinct_solved = {h for lanes in calls for h in lanes}
+    assert len(distinct_solved) == 2          # D solves, not N
+    # delivered followers must clear the no-silent-drop gate: a
+    # recovery-coalesced duplicate counted as "lost" would trip the
+    # serve_replayed_lost_count<=0 SLO rule despite zero loss
+    assert summary["replayed_lost_count"] == 0
+    # the next replay sees everything terminal
+    assert wal.replay(cfg.journal_dir)["pending"] == []
+
+
+def test_fetch_rdigest_falls_through_store_then_journal(tmp_path):
+    """REGRESSION (ISSUE 12 satellite): fetch_rdigest silently missed
+    once the bounded LRU evicted a digest the journal still held
+    terminal — it must fall through to the store, then the journal."""
+    cfg = _cfg(tmp_path, result_cache=2,
+               journal_dir=str(tmp_path / "journal"))
+    svc = SweepService(runner_factory=stub_factory, config=cfg)
+    svc.start()
+    rows = [(1.0 + i, 8.0, 0.0) for i in range(4)]
+    digests = [svc.submit(*row).result(10.0).digest for row in rows]
+    rd0 = wal.request_digest(*rows[0], "default")
+    with svc._lock:
+        assert rd0 not in svc._rdigest_index   # LRU evicted it
+    got = svc.fetch_rdigest(rd0)
+    assert got is not None and got.digest == digests[0]
+    assert got.source == "stored"
+    svc.stop()
+    # journal-only service (no store): the same eviction resolves from
+    # the WAL's complete records instead
+    cfg2 = ServeConfig(queue_max=16, batch_cases=4, window_s=0.02,
+                       result_cache=2, degrade_after=99,
+                       journal_dir=str(tmp_path / "j2"))
+    svc2 = SweepService(runner_factory=stub_factory, config=cfg2)
+    svc2.start()
+    d2 = [svc2.submit(*row).result(10.0).digest for row in rows]
+    got2 = svc2.fetch_rdigest(rd0)
+    assert got2 is not None and got2.digest == d2[0]
+    assert got2.source == "recovered"
+    svc2.stop()
+
+
+def test_router_consults_local_store_before_proxying(tmp_path):
+    from raft_tpu.serve.router import ReplicaRouter
+
+    store = ResultStore(str(tmp_path))
+    p = _payload()
+    store.put(p)
+    # one unreachable backend, never health-checked healthy: without
+    # the local store every fetch would 404/503
+    router = ReplicaRouter(["http://127.0.0.1:9"],
+                           store_dir=str(tmp_path))
+    code, body = router.result(rdigest=p["rdigest"])
+    assert code == 200 and body["replica"] == "store"
+    assert body["std"] == p["std"] and body["digest"] == p["digest"]
+    code, body = router.result(digest=p["digest"])
+    assert code == 200 and body["rdigest"] == p["rdigest"]
+    code, _ = router.result(rdigest=_payload(Hs=9.9)["rdigest"])
+    assert code == 404
+    st = router.stats()
+    assert st["store_hits"] == 2 and st["store"]["entries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# unit: facts -> trend row -> SLO rules; bench dup shape
+# ---------------------------------------------------------------------------
+
+def test_store_facts_reach_trend_row_and_slo_rules(tmp_path):
+    from raft_tpu.obs import trendstore
+
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path))
+    svc.start()
+    svc.submit(2.0, 8.0, 0.0).result(10.0)
+    assert svc.submit(2.0, 8.0, 0.0).done()   # one hit
+    summary = svc.stop()
+    doc = {"schema": "raft_tpu.run_manifest/v1", "run_id": "t1",
+           "kind": "serve", "status": "ok",
+           "extra": {"serve": summary}}
+    facts = trendstore.facts_from_manifest(doc)
+    assert facts["serve_store_hits"] == 1
+    assert facts["serve_store_hit_ratio"] == 0.5
+    assert "serve_read_p50_ms" in facts
+    assert facts["serve_warm_start_digest_mismatch"] == 0
+    names = [r["name"] for r in trendstore.DEFAULT_SLO_RULES]
+    assert "serve_store_corrupt_served_count" in names
+    assert "serve_warm_start_digest_mismatch" in names
+    rows = [{"kind": "serve", "status": "ok", "facts": facts}]
+    assert trendstore.evaluate_slo(rows)["ok"]
+    bad = [{"kind": "serve_storm", "status": "ok",
+            "facts": {"serve_store_corrupt_served_count": 1,
+                      "serve_warm_start_digest_mismatch": 2}}]
+    rep = trendstore.evaluate_slo(bad)
+    assert not rep["ok"]
+    failing = {r["name"] for r in rep["results"] if not r["ok"]}
+    assert failing == {"serve_store_corrupt_served_count",
+                       "serve_warm_start_digest_mismatch"}
+
+
+def test_serve_bench_dup_ratio_publishes_tier_facts(tmp_path,
+                                                   monkeypatch):
+    import bench
+
+    monkeypatch.setenv("RAFT_TPU_OBS_DIR", str(tmp_path / "obs"))
+    report = bench.serve_bench(
+        runner_factory=stub_factory, n_requests=24, rps=400.0,
+        dup_ratio=0.5, store_dir=str(tmp_path / "store"),
+        timeout_s=60.0)
+    assert report["ok"]
+    assert report["dup_ratio"] == 0.5
+    assert report["store_hit_ratio"] is not None
+    assert report["store_corrupt_served_count"] == 0
+    assert report["warm_start_digest_mismatch"] == 0
+    # the manifest row carries the tier facts for the SLO gates
+    from raft_tpu.obs import trendstore
+    with open(report["manifest"]) as f:
+        doc = json.load(f)
+    facts = trendstore.facts_from_manifest(doc)
+    assert facts["serve_dup_ratio"] == 0.5
+    assert facts["serve_store_corrupt_served_count"] == 0
+    # store_dir=None: the scratch store is created per run and removed
+    # in the finally block — repeated bench runs must not leak /tmp dirs
+    import tempfile
+    made = []
+    real_mkdtemp = tempfile.mkdtemp
+    monkeypatch.setattr(
+        tempfile, "mkdtemp",
+        lambda **kw: made.append(real_mkdtemp(**kw)) or made[-1])
+    report = bench.serve_bench(
+        runner_factory=stub_factory, n_requests=8, rps=400.0,
+        dup_ratio=0.5, store_dir=None, timeout_s=60.0)
+    assert report["ok"]
+    assert len(made) == 1 and not os.path.exists(made[0])
+
+
+# ---------------------------------------------------------------------------
+# integration: warm starts on the real model + the storm acceptance
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fowt():
+    from raft_tpu.serve.soak import build_fowt
+    return build_fowt("Vertical_cylinder")
+
+
+def _real_cfg(store_dir, **kw):
+    from raft_tpu.serve.soak import default_config
+    base = dict(batch_cases=2, queue_max=8, deadline_s=300.0,
+                batch_deadline_s=120.0, nIter=8, tol=0.01)
+    base.update(kw)
+    cfg = default_config(**base)
+    if store_dir is not None:
+        cfg = ServeConfig(**{**cfg.__dict__, "store_dir": str(store_dir)},
+                          )
+    return cfg
+
+
+def _solve_all(svc, rows, timeout=300.0):
+    tickets = [svc.submit(*row) for row in rows]
+    svc.start()
+    return [t.result(timeout) for t in tickets]
+
+
+def test_warm_start_parity_savings_and_poisoned_quarantine(tmp_path,
+                                                           fowt):
+    base_rows = [(2.0, 8.0, 0.0), (2.4, 8.4, 0.0)]
+    off_rows = [(2.15, 8.1, 0.0), (2.55, 8.5, 0.0)]
+    # cold reference digests for the offset cases (store-less service)
+    svc = SweepService(fowt, _real_cfg(None))
+    cold = _solve_all(svc, off_rows)
+    svc.stop()
+    assert all(r.ok for r in cold)
+    cold_digests = [r.digest for r in cold]
+
+    # seed pool: a warm-capable service cold-solves the base rows
+    store_dir = tmp_path / "store"
+    warm_kw = dict(warm_start=True, warm_audit_every=1, warm_radius=1.0)
+    cfgw = ServeConfig(**{**_real_cfg(store_dir).__dict__, **warm_kw})
+    svc = SweepService(fowt, cfgw)
+    seeded = _solve_all(svc, base_rows)
+    s1 = svc.stop()
+    assert all(r.ok for r in seeded)
+    assert ResultStore(str(store_dir)).stats()["seeds"] == 2
+
+    # audited warm batch over the offset rows: digests BIT-FOR-BIT
+    # equal to cold, seeded lanes counted, iteration savings positive
+    svc = SweepService(fowt, cfgw)
+    warm = _solve_all(svc, off_rows)
+    s2 = svc.stop()
+    assert [r.digest for r in warm] == cold_digests
+    assert [r.std for r in warm] == [r.std for r in cold]
+    assert s2["warm_start_seeded"] >= 2
+    assert s2["warm_start_digest_mismatch"] == 0
+    assert s2["warm_start_iter_savings"] > 0
+    assert s2["warm_start_rejected"] == 0
+
+    # poisoned neighbor: a FRESH store holding exactly one seed —
+    # overwritten with NaNs — so the offset case must warm-start from
+    # the poison.  The divergence guard rejects it, quarantines the
+    # seed, falls back cold, and delivers an unchanged digest.
+    pdir = tmp_path / "poison"
+    cfgp = ServeConfig(**{**cfgw.__dict__, "store_dir": str(pdir),
+                          "warm_audit_every": 1000})
+    svc = SweepService(fowt, cfgp)
+    base = _solve_all(svc, [base_rows[0]])
+    svc.stop()
+    assert base[0].ok
+    store = ResultStore(str(pdir), keep_xi=True)
+    near = store.nearest(*off_rows[0], "default", radius=1.0)[0]
+    doc = store.get(near)
+    nwv = len(fowt.w)
+    assert store.put(doc, xi=np.full((6, nwv), np.nan, complex))
+    # non-audited path (audit_every high): the guard alone must catch it
+    svc = SweepService(fowt, cfgp)
+    poisoned = _solve_all(svc, [off_rows[0]])
+    s3 = svc.stop()
+    assert poisoned[0].ok
+    assert poisoned[0].digest == cold_digests[0]   # no digest deviation
+    assert s3["warm_start_rejected"] >= 1
+    assert s3["store_quarantined"] >= 1
+    snap = obs.snapshot()
+    series = snap["raft_tpu_serve_warm_starts_total"]["series"]
+    assert any(x["labels"].get("outcome") == "rejected"
+               and x["value"] >= 1 for x in series)
+
+
+def test_duplicate_storm_soak_acceptance(tmp_path, fowt):
+    """ISSUE acceptance: N duplicate requests over D distinct digests
+    solve exactly D times in one runner call; reads hit bit-for-bit
+    across a restart and from a replica; corrupt@resultstore never
+    serves a corrupt byte; audited warm starts save iterations at zero
+    digest deviation; the storm journal replays with nothing pending."""
+    from raft_tpu.serve import soak
+
+    report = soak.run_storm(
+        store_dir=str(tmp_path / "store"),
+        journal_dir=str(tmp_path / "journal"),
+        n_requests=12, n_distinct=4, batch_cases=4)
+    assert report["ok"], json.dumps(
+        {k: v for k, v in report.items() if k != "summaries"},
+        indent=1, default=str)
+    assert report["solves"] == 4
+    assert report["coalesced"] == 8
+    assert report["runner_calls_storm"] == 1
+    assert report["store_corrupt_detected"] >= 4
+    assert report["store_corrupt_served_count"] == 0
+    assert report["warm_start_iter_savings"] > 0
+    assert report["warm_start_digest_mismatch"] == 0
+    assert report["journal_pending_after_storm"] == 0

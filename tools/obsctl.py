@@ -353,6 +353,18 @@ def _fmt_event(e: dict) -> str | None:
     if t == "request_failed":
         return (f"{ts} req {e.get('req')} FAILED "
                 f"({e.get('error')}: {e.get('message')})")
+    # result-tier events (serve/resultstore.py — "Result tier")
+    if t == "coalesced":
+        return (f"{ts} req {e.get('req')} coalesced onto in-flight "
+                f"{str(e.get('rdigest'))[:19]}")
+    if t == "store_corrupt":
+        return f"{ts} STORE corrupt entry ({e.get('reason')}) — re-solve"
+    if t == "store_seed_quarantined":
+        return (f"{ts} STORE seed quarantined "
+                f"{str(e.get('rdigest'))[:19]}")
+    if t == "warm_start_rejected":
+        return (f"{ts} WARM-START rejected lane {e.get('lane')} "
+                f"({e.get('outcome')}: {e.get('detail')})")
     return None
 
 

@@ -52,6 +52,15 @@ Subcommands::
         boundary and every digest is bit-for-bit identical to an
         uninterrupted clean run.
 
+    raftserve soak --storm --store-dir DIR [--journal-dir DIR]
+        Result-tier soak: duplicate-heavy traffic over a persistent
+        content-addressed store, a cross-replica read wave, a
+        corrupt@resultstore integrity wave, and an audited neighbor
+        warm-start wave; exits nonzero unless N duplicate requests
+        over D distinct digests perform exactly D solves, zero
+        corrupt bytes are ever served, and every digest (warm starts
+        included) is bit-for-bit identical to the clean run.
+
     raftserve route --backend URL [--backend URL ...] [--port N]
                     [--secret-file F] [--quota TENANT=RATE[:BURST]]
                     [--default-quota RATE[:BURST]]
@@ -67,6 +76,13 @@ With --journal-dir (and --mirror-dir peers), every admission/result
 is write-ahead journaled (and mirrored) before it is acknowledged;
 --recover-from replays a dead peer's mirror at boot (the cross-host
 failover: fresh journal tree, the dead host's disk never read).
+With --store-dir the service adds the persistent content-addressed
+result tier: exact-digest repeats return at memory speed (across
+restarts and replicas sharing the directory), duplicate in-flight
+submissions coalesce onto one solve, and --warm-start seeds misses
+from the nearest cached neighbor under a divergence guard; `route
+--store-dir` answers digest fetches from the same store before
+proxying.
 Set RAFT_TPU_OBS_DIR to collect the serve manifests, flight-recorder
 event streams, and the trend-store rows the `obsctl slo` serve rules
 gate on.  On a host with a TPU tunnel problem set JAX_PLATFORMS=cpu.
@@ -97,6 +113,35 @@ def _build_fowts(args):
 def cmd_soak(args) -> int:
     from raft_tpu.serve import soak
     from raft_tpu.serve.config import ServeConfig
+
+    if args.storm:
+        if not args.store_dir:
+            print("raftserve soak --storm needs --store-dir",
+                  file=sys.stderr)
+            return 2
+        report = soak.run_storm(
+            args.design, store_dir=args.store_dir,
+            journal_dir=args.journal_dir, min_freq=args.min_freq,
+            max_freq=args.max_freq, dfreq=args.dfreq,
+            n_requests=args.requests, n_distinct=args.distinct,
+            batch_cases=args.batch, seed=args.seed,
+            timeout_s=args.timeout)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=1, default=str)
+        print(f"raftserve duplicate-storm soak: "
+              f"{'OK' if report['ok'] else 'FAILED'} — "
+              f"{report['n_requests']} requests / "
+              f"{report['n_distinct']} distinct: {report['solves']} "
+              f"solve(s) in {report['runner_calls_storm']} runner "
+              f"call(s), {report['coalesced']} coalesced; "
+              f"{report['store_corrupt_detected']} corruption(s) "
+              f"detected, {report['store_corrupt_served_count']} "
+              f"served; warm savings="
+              f"{report['warm_start_iter_savings']} iters, "
+              f"{report['warm_start_digest_mismatch']} mismatch(es); "
+              f"{report['wall_s']:.1f}s")
+        return 0 if report["ok"] else 1
 
     if args.failover:
         if not args.journal_dir:
@@ -192,7 +237,9 @@ def cmd_serve(args) -> int:
                       deadline_s=args.deadline,
                       batch_deadline_s=args.batch_deadline,
                       journal_dir=args.journal_dir,
-                      mirror_dirs=tuple(args.mirror_dir or ()))
+                      mirror_dirs=tuple(args.mirror_dir or ()),
+                      store_dir=args.store_dir,
+                      warm_start=bool(args.warm_start))
     degraded = {"coarse": coarse} if coarse is not None else None
     service = SweepService(fowt, cfg, degraded_fowts=degraded)
     # bounded FIFO, like SweepService._delivered: an always-on process
@@ -400,7 +447,7 @@ def cmd_route(args) -> int:
         args.backend, secret=secret, quotas=quotas,
         default_quota=default_quota,
         health_interval_s=args.health_interval,
-        timeout_s=args.timeout).start()
+        timeout_s=args.timeout, store_dir=args.store_dir).start()
     srv = make_server(router, args.host, args.port)
     host, port = srv.server_address[:2]
     healthy = sum(1 for b in router.backends if b.healthy)
@@ -467,9 +514,21 @@ def main(argv=None) -> int:
                         "mirrors to a peer store, recover a successor "
                         "in a FRESH directory tree from only the "
                         "mirror, gate cross-host zero-loss parity")
+    p.add_argument("--storm", action="store_true",
+                   help="duplicate-storm soak (result tier): dup-heavy "
+                        "traffic over a persistent content-addressed "
+                        "store under corrupt@resultstore — gate "
+                        "exactly-D solves, zero corrupt bytes served, "
+                        "warm-start digest parity")
     p.add_argument("--journal-dir", default=None,
                    help="journal root directory (required with "
                         "--kill-restart / --failover)")
+    p.add_argument("--store-dir", default=None,
+                   help="result-store directory (required with "
+                        "--storm)")
+    p.add_argument("--distinct", type=int, default=4,
+                   help="distinct request digests in the storm "
+                        "(--storm)")
     p.add_argument("--kill-at", type=int, default=6,
                    help="request seq the kill@serve fault fires at")
     p.set_defaults(fn=cmd_soak)
@@ -498,6 +557,15 @@ def main(argv=None) -> int:
     p.add_argument("--successor", default=None,
                    help="where a drain points rejected callers "
                         "(Retry-After context)")
+    p.add_argument("--store-dir", default=None,
+                   help="persistent content-addressed result store: "
+                        "exact-digest repeats return at memory speed "
+                        "across restarts/replicas, duplicates "
+                        "single-flight onto one solve")
+    p.add_argument("--warm-start", action="store_true",
+                   help="seed cache-miss solves from the nearest "
+                        "cold-solved store neighbor (guarded + "
+                        "audited; needs --store-dir)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("route", help="replica router over N raftserve "
@@ -524,6 +592,10 @@ def main(argv=None) -> int:
                    help="seconds between backend /healthz sweeps")
     p.add_argument("--timeout", type=float, default=30.0,
                    help="per-proxied-request timeout (s)")
+    p.add_argument("--store-dir", default=None,
+                   help="the replicas' shared/mirrored result store: "
+                        "digest fetches consult it locally before any "
+                        "proxying (dead replicas stay readable)")
     p.set_defaults(fn=cmd_route)
 
     args = ap.parse_args(argv)

@@ -83,8 +83,12 @@ def _aero_constants(design, base):
 
 def _run_cpu_subprocess(body_lines, out_path, x64):
     """Run a snippet in a fresh CPU-only jax process (the axon tunnel is
-    single-claim and lacks some eager ops; x64 must be configured before
-    jax initializes) and return the .npz it writes."""
+    single-claim and lacks some eager ops) and return the .npz it
+    writes.  Sole remaining caller: ``_aero_constants_subprocess`` (a
+    TPU-has-no-f64 CONSTANT builder, not an accuracy reference) — the
+    f64 accuracy-reference subprocesses died with the mixed-precision
+    ladder (RAFT_TPU_PRECISION=mixed is the accuracy contract; see
+    ``_accuracy_gate`` / ``_analyze_cases_metric``)."""
     import subprocess
     import sys
 
@@ -354,9 +358,10 @@ def _acc_ok(acc):
 
 
 def _gate_only():
-    """CPU-mode accuracy gate (f32 pipeline vs f64 subprocess truth) on
-    the fixed 16-variant batch; the fallback correctness record when the
-    TPU is unavailable.  Prints one JSON line."""
+    """CPU-mode accuracy gate (f32 pipeline vs the in-process
+    mixed-ladder f64-refined truth) on the fixed 16-variant batch; the
+    fallback correctness record when the TPU is unavailable.  Prints
+    one JSON line."""
     _, _, thetas, batched, _, _ = _solver_setup(16)
     acc = _accuracy_gate(thetas, batched)
     ok = _acc_ok(acc)
@@ -537,75 +542,100 @@ def _qtf_metric():
         return f"qtf metric failed: {type(e).__name__}: {e}"
 
 
+def _f64_scope():
+    """Context pieces for the in-process f64-contract sections: a
+    scoped x64 enable plus a CPU device pin when the bench itself runs
+    on an accelerator backend (TPU has no native f64 — the refinement
+    accumulator needs a device that does).  This replaces the f64 CPU
+    *subprocess* the accuracy references used to fork."""
+    import contextlib
+
+    import jax
+    from jax.experimental import enable_x64
+
+    try:
+        dev = (jax.default_device(jax.local_devices(backend="cpu")[0])
+               if jax.default_backend() != "cpu"
+               else contextlib.nullcontext())
+    except Exception:                                 # pragma: no cover
+        dev = contextlib.nullcontext()
+    return enable_x64(), dev
+
+
 def _analyze_cases_metric():
     """Wall time per case through the flagship device-resident
     ``Model.analyzeCases`` path (coarse OC3 golden config, one case,
     cold start) — the ``analyze_cases_s_per_case`` fact ``obsctl trend``
-    tracks across rounds.  Runs in an f64 CPU subprocess: the case
-    pipeline's accuracy contract is f64, and the in-process bench may
-    be f32/TPU.  Returns a dict for the bench JSON, or an error
-    string."""
-    import tempfile
+    tracks across rounds.  Runs IN-PROCESS under a scoped x64 enable
+    (the case pipeline's accuracy contract rides the precision ladder;
+    the f64 CPU subprocess this used to fork is gone).  Returns a dict
+    for the bench JSON, or an error string."""
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.model import Model
+    from raft_tpu.ops import linalg as _linalg
 
-    with tempfile.TemporaryDirectory() as td:
-        out = os.path.join(td, "ac.npz")
-        try:
-            d = _run_cpu_subprocess([
-                "import time",
-                "from raft_tpu.io.designs import load_design",
-                "from raft_tpu.model import Model",
-                "design = load_design('OC3spar')",
-                "design.setdefault('settings', {})",
-                "design['settings'].update(min_freq=0.02, max_freq=0.2)",
-                "design['cases']['data'] = design['cases']['data'][:1]",
-                "m = Model(design)",
-                "t0 = time.perf_counter()",
-                "m.analyzeCases()",
-                "dt = time.perf_counter() - t0",
-                "x = (m.last_manifest.extra or {}).get("
-                "'host_transfers', {}).get('total', {})",
-                f"np.savez({out!r}, dt=dt, "
-                "events=x.get('events', -1), bytes=x.get('bytes', -1))",
-            ], out, x64=True)
-        except RuntimeError as e:
-            return f"analyze_cases metric failed: {e}"
-        return {"s_per_case": round(float(d["dt"]), 3), "n_cases": 1,
-                "design": "OC3spar",
-                "host_transfer_events": int(d["events"]),
-                "host_transfer_bytes": int(d["bytes"])}
+    x64_ctx, dev_ctx = _f64_scope()
+    try:
+        with x64_ctx, dev_ctx:
+            design = load_design("OC3spar")
+            design.setdefault("settings", {})
+            design["settings"].update(min_freq=0.02, max_freq=0.2)
+            design["cases"]["data"] = design["cases"]["data"][:1]
+            m = Model(design)
+            t0 = time.perf_counter()
+            m.analyzeCases()
+            dt = time.perf_counter() - t0
+            x = (m.last_manifest.extra or {}).get(
+                "host_transfers", {}).get("total", {})
+    except Exception as e:                            # pragma: no cover
+        return f"analyze_cases metric failed: {type(e).__name__}: {e}"
+    return {"s_per_case": round(float(dt), 3), "n_cases": 1,
+            "design": "OC3spar",
+            "host_transfer_events": int(x.get("events", -1)),
+            "host_transfer_bytes": int(x.get("bytes", -1)),
+            "solver": _linalg.last_dispatch()}
 
 
 def _accuracy_gate(thetas, batched):
-    """On-hardware f32 accuracy vs an f64 CPU re-solve of the SAME fixed
-    16-variant batch (BASELINE's accuracy target is meaningless without a
-    measured on-hardware number).  The f64 reference runs in a
-    subprocess because x64 must be configured before jax initializes."""
-    import tempfile
+    """On-hardware f32 accuracy vs the mixed-precision-ladder re-solve
+    of the SAME fixed 16-variant batch (BASELINE's accuracy target is
+    meaningless without a measured on-hardware number).
+
+    The reference is the SAME pipeline re-built in-process at f64 under
+    ``RAFT_TPU_PRECISION=mixed`` — low-width factorization with
+    in-kernel f64 residual refinement and per-lane promotion
+    (ops/pallas/gj_solve.py) — i.e. the on-device ladder IS the
+    accuracy contract.  The f64 CPU subprocess this used to fork is
+    gone."""
+    import jax
+
+    from raft_tpu import _config
+    from raft_tpu.ops import linalg as _linalg
 
     sub = {k: np.asarray(v)[:16] for k, v in thetas.items()}
     out32 = batched(sub)
     std32 = np.asarray(out32["std"], dtype=np.float64)
-    with tempfile.TemporaryDirectory() as td:
-        tin = os.path.join(td, "thetas.npz")
-        tout = os.path.join(td, "std64.npz")
-        np.savez(tin, **sub)
-        try:
-            d = _run_cpu_subprocess([
-                "design = bench._design()",
-                "base = bench._base_fowt(design)",
-                "F_env, A_turb, B_turb = bench._aero_constants(design, base)",
-                "from raft_tpu.parallel.variants import make_variant_solver",
-                "solver = make_variant_solver(base, Hs=6.0, Tp=12.0,"
-                " ballast=True, F_env=F_env, A_turb=A_turb, B_turb=B_turb,"
-                " nIter=bench.NITER, tol=-1.0, newton_iters=10)",
-                f"d = dict(np.load({tin!r}))",
-                "out = jax.jit(solver.batched)(d)",
-                f"np.savez({tout!r}, std=np.asarray(out['std'],"
-                " dtype=np.float64))",
-            ], tout, x64=True)
-        except RuntimeError as e:
-            return f"f64-reference failed: {e}"
-        std64 = d["std"]
+    x64_ctx, dev_ctx = _f64_scope()
+    _config.set_precision_mode("mixed")
+    try:
+        with x64_ctx, dev_ctx:
+            from raft_tpu.parallel.variants import make_variant_solver
+
+            design = _design()
+            base = _base_fowt(design)
+            F_env, A_turb, B_turb = _aero_constants(design, base)
+            solver = make_variant_solver(
+                base, Hs=6.0, Tp=12.0, ballast=True, F_env=F_env,
+                A_turb=A_turb, B_turb=B_turb, nIter=NITER, tol=-1.0,
+                newton_iters=10)
+            out = jax.jit(solver.batched)(
+                {k: np.asarray(v, np.float64) for k, v in sub.items()})
+            std64 = np.asarray(out["std"], dtype=np.float64)
+            ref_solver = _linalg.last_dispatch()
+    except Exception as e:                            # pragma: no cover
+        return f"mixed-ladder reference failed: {type(e).__name__}: {e}"
+    finally:
+        _config.set_precision_mode(None)
     # unit-safe masking: translations (m) and rotations (rad) are scaled
     # within their own unit group, each channel against its own batch
     # peak — a channel whose peak is itself fp noise (exact-zero response
@@ -625,6 +655,8 @@ def _accuracy_gate(thetas, batched):
         "max": float(dev[mask].max()),
         "median": float(np.median(dev[mask])),
         "surge_max": float(dev[:, 0].max()),
+        "reference": "mixed_ladder",
+        "reference_solver": ref_solver,
     }
 
 

@@ -18,6 +18,16 @@ regime); override with RAFT_BENCH_KERNELS_B="1024,4096".  On CPU the
 default shrinks to 1024/4096 (interpret-mode Pallas at 262144 systems is
 a correctness exercise, not a timing).
 
+The mixed-precision ladder rows (``pallas_f64`` / ``pallas_mixed`` /
+``pallas_f32``) time the SAME kernel at the three RAFT_TPU_PRECISION
+rungs on f64 inputs and report the per-solve speedup of mixed over f64
+plus the promoted-lane ratio (``solve_promoted_lane_ratio`` — the
+trend-store fact the DEFAULT_SLO_RULES bound so a mixed ladder that
+silently mass-promotes to all-f64 gates CI).  On CPU the Pallas rows
+run under interpret mode: those rows are parity records, labeled
+``timing_meaningful: false`` — the compiled-path speedup claim only
+comes from accelerator rounds.
+
 Prints ONE json line (same shape as bench.py: metric/value/unit/ok) and
 writes a run manifest (kind ``bench_kernels``) so ``tools/obsctl.py
 trend`` charts kernel history next to the sweep manifests.
@@ -137,6 +147,78 @@ def main():
                     "batched small-system solve throughput by kernel "
                     "and batch size").set(row["systems_per_s"],
                                           kernel=name, batch=str(B))
+
+        # ---- mixed-precision ladder rows: the same Pallas kernel at
+        # the three RAFT_TPU_PRECISION rungs on f64 inputs (scoped x64
+        # enable — the f32-default bench still measures the ladder at
+        # its real contract).  On CPU these run under interpret mode:
+        # parity records, not timings (timing_meaningful=false).
+        from jax.experimental import enable_x64
+
+        from raft_tpu import _config as _cfg
+        from raft_tpu.ops import precision as _prec
+
+        timing_ok = backend != "cpu"
+        ladder: dict = {}
+        with enable_x64():
+            Bl = sizes[-1]
+            A, b = _systems(rng, Bl)
+            truth = np.linalg.solve(A, b)
+            Aj = jnp.asarray(A, jnp.float64)
+            bj = jnp.asarray(b, jnp.float64)
+            tol = _cfg.precision_tol()
+            # the mixed row factorizes at the CONFIGURED width so the
+            # manifest's precision_width fact matches what actually ran
+            fdt = _prec.factor_dtype(_cfg.precision_width())
+            fns = {
+                "pallas_f64": jax.jit(lambda a, r: gj_solve(a, r)),
+                "pallas_mixed": jax.jit(lambda a, r: gj_solve(
+                    a, r, refine=2, precision="mixed", factor_dtype=fdt,
+                    promote_tol=tol, return_stats=True)),
+                "pallas_f32": jax.jit(lambda a, r: gj_solve(
+                    a.astype(jnp.float32), r.astype(jnp.float32))),
+            }
+            for name, fn in fns.items():
+                with obs.span("bench_kernel", kernel=name, batch=Bl):
+                    dt, out = _time(fn, Aj, bj)
+                stats = None
+                if isinstance(out, tuple):
+                    out, stats = out
+                out = np.asarray(out, np.float64)
+                err = np.max(np.abs(out - truth)
+                             / np.maximum(np.abs(truth), 1e-12))
+                row = {"kernel": name, "batch": Bl,
+                       "systems_per_s": round(Bl / dt, 1),
+                       "wall_s": round(dt, 6),
+                       "rel_dev_vs_f64_lapack": float(err),
+                       "timing_meaningful": timing_ok}
+                if stats is not None:
+                    row["promoted_lane_ratio"] = round(
+                        float(np.asarray(stats["promoted"])) / Bl, 6)
+                    row["promote_tol"] = tol
+                    # the ladder's whole point: f64-level accuracy out
+                    # of a low-width factorization
+                    acc_ok = acc_ok and bool(err <= 1e-8)
+                ladder[name] = row
+                rows.append(row)
+                obs.gauge(
+                    "raft_kernel_systems_per_s",
+                    "batched small-system solve throughput by kernel "
+                    "and batch size").set(row["systems_per_s"],
+                                          kernel=name, batch=str(Bl))
+        promoted_ratio = ladder["pallas_mixed"].get("promoted_lane_ratio")
+        mixed_speedup = round(ladder["pallas_f64"]["wall_s"]
+                              / max(ladder["pallas_mixed"]["wall_s"],
+                                    1e-12), 3)
+        solver_facts = {
+            "promoted_lane_ratio": promoted_ratio,
+            "mixed_speedup_vs_f64": mixed_speedup,
+            "precision_width": _cfg.precision_width(),
+            "promote_tol": ladder["pallas_mixed"].get("promote_tol"),
+            "timing_meaningful": timing_ok,
+        }
+        manifest.extra["solver"] = solver_facts
+
         best = max((r["systems_per_s"] for r in rows
                     if r["kernel"] == "pallas"), default=0.0)
         ok = acc_ok
@@ -151,6 +233,9 @@ def main():
             "unit": "systems/s",
             "rows": rows,
             "pallas_parity_max_rel_dev": worst_parity,
+            "solver": solver_facts,
+            "mixed_speedup_vs_f64": mixed_speedup,
+            "solve_promoted_lane_ratio": promoted_ratio,
             "ok": ok,
         }
         status = "ok" if ok else "failed"

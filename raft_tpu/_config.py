@@ -81,6 +81,121 @@ def set_pallas_mode(mode: str | None):
 
 
 # ---------------------------------------------------------------------------
+# fused QTF pair-grid kernel (models/qtf.py, ops/pallas/qtf_pair.py)
+# ---------------------------------------------------------------------------
+
+#: RAFT_TPU_QTF_KERNEL values: "1" routes the dense (i1, i2) QTF pair
+#: grid through the fused Pallas kernel (interpret mode — the CI parity
+#: path, exactly like RAFT_TPU_PALLAS=1 for the solve kernel); "0"
+#: forbids it; "auto" (default) keeps the doubly-vmapped XLA path until
+#: the kernel's real/imag-split Mosaic port proves on hardware (the
+#: body is complex-typed; see ops/pallas/qtf_pair.py).
+_QTF_KERNEL_MODES = ("0", "1", "auto")
+_qtf_kernel_override: str | None = None
+
+
+def qtf_kernel_mode() -> str:
+    """Active QTF-kernel dispatch mode ("0" | "1" | "auto")."""
+    if _qtf_kernel_override is not None:
+        return _qtf_kernel_override
+    mode = os.environ.get("RAFT_TPU_QTF_KERNEL", "auto").strip().lower()
+    return mode if mode in _QTF_KERNEL_MODES else "auto"
+
+
+def set_qtf_kernel_mode(mode: str | None):
+    """Override the QTF-kernel dispatch mode in-process (None clears)."""
+    global _qtf_kernel_override
+    if mode is not None and str(mode) not in _QTF_KERNEL_MODES:
+        raise ValueError(
+            f"qtf kernel mode {mode!r} not in {_QTF_KERNEL_MODES}")
+    _qtf_kernel_override = None if mode is None else str(mode)
+
+
+# ---------------------------------------------------------------------------
+# mixed-precision solve ladder (ops/linalg.py, ops/pallas/gj_solve.py)
+# ---------------------------------------------------------------------------
+
+#: RAFT_TPU_PRECISION values: "f64" (default) solves at the ambient
+#: pipeline width (f64 under the default x64 pipeline — today's exact
+#: behavior); "mixed" factorizes at the low RAFT_TPU_PRECISION_WIDTH
+#: (f32 default, bf16 opt-in) while the refinement residual
+#: r = rhs - A x and the correction accumulate at the full input width
+#: INSIDE the kernel, and lanes whose final relative residual exceeds
+#: RAFT_TPU_PRECISION_TOL are re-solved at the full width in a second
+#: pass over only the promoted lanes; "f32" forces the whole solve to
+#: f32 (the pure-throughput rung — the pre-ladder accuracy tradeoff,
+#: now explicit).  Read lazily at solve-dispatch (trace) time; the mode
+#: is part of the exec-cache key (a mixed program is never served for
+#: an f64 request).
+_PRECISION_MODES = ("f64", "mixed", "f32")
+_precision_override: str | None = None
+
+
+def precision_mode() -> str:
+    """Active solve-precision mode ("f64" | "mixed" | "f32");
+    programmatic override beats the ``RAFT_TPU_PRECISION`` environment
+    variable, unknown values fall back to "f64"."""
+    if _precision_override is not None:
+        return _precision_override
+    mode = os.environ.get("RAFT_TPU_PRECISION", "f64").strip().lower()
+    return mode if mode in _PRECISION_MODES else "f64"
+
+
+def set_precision_mode(mode: str | None):
+    """Override the solve-precision mode in-process (None clears)."""
+    global _precision_override
+    if mode is not None and str(mode) not in _PRECISION_MODES:
+        raise ValueError(
+            f"precision mode {mode!r} not in {_PRECISION_MODES}")
+    _precision_override = None if mode is None else str(mode)
+
+
+#: RAFT_TPU_PRECISION_WIDTH values: the factorization width the mixed
+#: ladder drops to ("f32" default; "bf16" for pipelines already at f32
+#: — bf16 shares f32's exponent range, so the equilibration floor is
+#: unchanged).
+_PRECISION_WIDTHS = ("f32", "bf16")
+_precision_width_override: str | None = None
+
+
+def precision_width() -> str:
+    """Active mixed-ladder factorization width ("f32" | "bf16")."""
+    if _precision_width_override is not None:
+        return _precision_width_override
+    w = os.environ.get("RAFT_TPU_PRECISION_WIDTH", "f32").strip().lower()
+    return w if w in _PRECISION_WIDTHS else "f32"
+
+
+def set_precision_width(width: str | None):
+    """Override the mixed-ladder factorization width (None clears)."""
+    global _precision_width_override
+    if width is not None and str(width) not in _PRECISION_WIDTHS:
+        raise ValueError(
+            f"precision width {width!r} not in {_PRECISION_WIDTHS}")
+    _precision_width_override = None if width is None else str(width)
+
+
+#: default per-lane promotion tolerance: the max relative refinement
+#: residual a mixed-solved lane may keep before it is re-solved at the
+#: full width.  1e-9 sits three decades under the 1e-6 golden-ledger
+#: contract and three above the f64 refinement noise floor (~1e-13 on
+#: the equilibrated impedance blocks), so promotion fires on genuinely
+#: ill-conditioned lanes, not on refinement jitter.
+_PRECISION_TOL_DEFAULT = 1e-9
+
+
+def precision_tol() -> float:
+    """Per-lane promotion tolerance for the mixed ladder
+    (``RAFT_TPU_PRECISION_TOL``, default 1e-9); non-numeric values fall
+    back to the default."""
+    raw = os.environ.get("RAFT_TPU_PRECISION_TOL", "")
+    try:
+        return float(raw) if raw.strip() else _PRECISION_TOL_DEFAULT
+    except ValueError:
+        return _PRECISION_TOL_DEFAULT
+
+
+# ---------------------------------------------------------------------------
 # solver-health telemetry placement (model.py dynamics/statics hot path)
 # ---------------------------------------------------------------------------
 

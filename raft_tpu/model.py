@@ -784,7 +784,8 @@ class Model:
         if N == 1:
             Z_sys = jnp.moveaxis(jnp.asarray(self._state[0]["Z"]), -1, 0)
         else:
-            Z_sys = jnp.zeros((nw, 6 * N, 6 * N), dtype=complex)
+            Z_sys = jnp.zeros((nw, 6 * N, 6 * N),
+                              dtype=_config.complex_dtype())
             for i in range(N):
                 s = slice(6 * i, 6 * i + 6)
                 Z_sys = Z_sys.at[:, s, s].set(
@@ -854,8 +855,10 @@ class Model:
                 return (jnp.asarray(st["F_BEM"])[:nWaves]
                         + jnp.asarray(st["excitation"]["F_hydro_iner"])[:nWaves]
                         + st["F_drag"]
-                        + jnp.asarray(st["Fhydro_2nd"])).astype(complex)
-            F_all = jnp.zeros((nWaves, 6 * N, nw), dtype=complex)
+                        + jnp.asarray(st["Fhydro_2nd"])).astype(
+                    _config.complex_dtype())
+            F_all = jnp.zeros((nWaves, 6 * N, nw),
+                              dtype=_config.complex_dtype())
             for i in range(N):
                 st = self._state[i]
                 s = slice(6 * i, 6 * i + 6)
@@ -924,7 +927,8 @@ class Model:
         # ----- final write-back: the ONE response pull per case -----
         Xi_np = obs.transfers.device_get(Xi_d, what="response",
                                          phase="dynamics")
-        Xi_sys = np.zeros((nWaves + 1, 6 * N, nw), dtype=complex)
+        Xi_sys = np.zeros((nWaves + 1, 6 * N, nw),
+                          dtype=complex)  # raftlint: disable=RTL003 host-side result mirror stays complex128
         Xi_sys[:nWaves] = np.asarray(Xi_np)
 
         for i, fowt in enumerate(self.fowtList):
@@ -1034,7 +1038,8 @@ class Model:
                 B_tot = B_lin + B_drag[:, :, None]
                 Zn = (-w[None, None, :] ** 2 * M_lin
                       + 1j * w[None, None, :] * B_tot
-                      + C_lin[:, :, None]).astype(complex)
+                      + C_lin[:, :, None]).astype(
+                          _config.complex_dtype())
                 # batched complex 6x6 solve over all frequencies at once
                 # (real block embedding keeps this TPU-compatible); the
                 # converged Zn itself is still carried out of the loop —
@@ -1057,10 +1062,11 @@ class Model:
                 return (ii < nIter) & (~done)
 
             if Xi_init is None:
-                Xi0c = jnp.zeros((6, nw), dtype=complex) + self.XiStart
+                Xi0c = jnp.zeros(
+                    (6, nw), dtype=_config.complex_dtype()) + self.XiStart
             else:
                 Xi0c = jnp.asarray(Xi_init)
-            Z0 = jnp.zeros((6, 6, nw), dtype=complex)
+            Z0 = jnp.zeros((6, 6, nw), dtype=_config.complex_dtype())
             Bmat0 = jnp.zeros((fowt.nodes.n, 3, 3),
                               dtype=_config.real_dtype())
             if jax.default_backend() != "cpu":
@@ -1123,7 +1129,7 @@ class Model:
                 for a in (state["r6"], [beta0], RAO,
                           stat["M_struc"], fowt.w1_2nd):
                     h.update(np.ascontiguousarray(
-                        np.asarray(a, dtype=complex)).tobytes())
+                        np.asarray(a, dtype=complex)).tobytes())  # raftlint: disable=RTL003 digest canonicalization is width-pinned by contract
                 # fold the DIRECT QTF inputs into the key too — the RAO is
                 # not a perfect proxy for every QTF-affecting quantity (a
                 # geometry edit could leave the first-order response
@@ -1486,6 +1492,11 @@ class Model:
                 for ph, rec in xfers["phases"].items()}
             manifest.extra["host_transfers"] = xfers
             manifest.extra["failed_cases"] = list(self.failed_cases)
+            # solve-backend + precision-ladder facts of the most recent
+            # dispatch (trace time): which kernel solved the impedance
+            # systems and at what widths (RAFT_TPU_PRECISION)
+            from raft_tpu.ops import linalg as _linalg
+            manifest.extra["solver"] = _linalg.last_dispatch()
             if self._recovery_attempts:
                 manifest.extra["recovery"] = {
                     "attempts": [a.to_dict()
@@ -1838,7 +1849,8 @@ class Model:
 
         # nacelle acceleration + tower base bending (reference :1900-1971)
         nrot = fowt.nrotors
-        XiHub = np.zeros((Xi.shape[0], nrot, self.nw), dtype=complex)
+        XiHub = np.zeros((Xi.shape[0], nrot, self.nw),
+                         dtype=complex)  # raftlint: disable=RTL003 host-side result mirror stays complex128
         for key in ("AxRNA", "Mbase"):
             results[f"{key}_avg"] = np.zeros(nrot)
             results[f"{key}_std"] = np.zeros(nrot)
@@ -1928,7 +1940,8 @@ class Model:
                 kp_tau = rot.kp_tau * (kp_beta == 0)
                 ki_tau = rot.ki_tau * (ki_beta == 0)
                 nh = Xi.shape[0]
-                phi_w = np.zeros((nh, self.nw), dtype=complex)
+                phi_w = np.zeros((nh, self.nw),
+                                 dtype=complex)  # raftlint: disable=RTL003 host-side result mirror stays complex128
                 for ih in range(nh - 1):
                     phi_w[ih] = C * XiHub[ih, ir, :]
                 phi_w[-1] = C * (XiHub[-1, ir, :] - V_w / (1j * self.w))

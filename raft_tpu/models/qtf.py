@@ -38,11 +38,23 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import _config
 from raft_tpu.ops.waves import (
     wave_kinematics, kinematics_from_motion, wave_vel_gradient,
     wave_pres1st_gradient, wave_pot_2nd_order, wave_number,
 )
 from raft_tpu.ops.transforms import skew
+
+
+def _use_qtf_kernel() -> bool:
+    """Whether the dense pair grid routes through the fused Pallas
+    kernel (ops/pallas/qtf_pair.py), per RAFT_TPU_QTF_KERNEL: "1"
+    forces it (interpret mode — the CI parity path, the same pattern
+    RAFT_TPU_PALLAS=1 uses for the solve kernel), "0"/"auto" keep the
+    doubly-vmapped XLA path (the kernel's complex-typed body awaits its
+    real/imag-split Mosaic port before "auto" can prefer it on
+    hardware)."""
+    return _config.qtf_kernel_mode() == "1"
 
 
 @dataclass
@@ -81,7 +93,8 @@ def read_qtf_12d(path: str, rho: float = 1025.0, g: float = 9.81,
     if not (len(w1) == len(w2) and np.allclose(w1, w2)):
         raise ValueError("both frequency columns must contain the same values")
 
-    qtf = np.zeros([len(w1), len(w2), len(heads), 6], dtype=complex)
+    qtf = np.zeros([len(w1), len(w2), len(heads), 6],
+                   dtype=complex)  # raftlint: disable=RTL003 host-side .12d I/O stays numpy complex128
     for row, (ww1, ww2) in zip(data, w12):
         i1 = int(np.argmin(np.abs(w1 - ww1)))
         i2 = int(np.argmin(np.abs(w2 - ww2)))
@@ -179,7 +192,7 @@ def kim_yue_correction(fowt, pose, beta, Nm: int = 10):
                if getattr(m, "MCF", False)
                and float(m.rA0[2]) * float(m.rB0[2]) < 0]
     if not members:
-        return jnp.zeros((nw2, nw2, 6), dtype=complex)
+        return jnp.zeros((nw2, nw2, 6), dtype=_config.complex_dtype())
 
     k1 = jnp.asarray(k2g)[:, None]     # (nw2,1) broadcast over pairs
     k2 = jnp.asarray(k2g)[None, :]
@@ -229,7 +242,7 @@ def kim_yue_correction(fowt, pose, beta, Nm: int = 10):
             _hp_cache[key] = hankel1p_all(jnp.asarray(k2g) * R, Nm + 1)
         return _hp_cache[key]
 
-    F = jnp.zeros((nw2, nw2, 6), dtype=complex)
+    F = jnp.zeros((nw2, nw2, 6), dtype=_config.complex_dtype())
     for im, m in members:
         mpose = pose["members"][im]
         rA = np.asarray(mpose["rA"])
@@ -341,7 +354,7 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None,
 
     # ---- resample RAOs to the 2nd-order grid (reference :1415-1417) ----
     if Xi0 is None:
-        Xi = jnp.zeros((6, nw2), dtype=complex)
+        Xi = jnp.zeros((6, nw2), dtype=_config.complex_dtype())
     else:
         wm = jnp.asarray(fowt.w)
         Xi = jax.vmap(lambda row: jnp.interp(w2, wm, row.real, left=0.0, right=0.0)
@@ -350,7 +363,7 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None,
 
     # ---- first-order inertial loads for Pinkster IV (reference :1437-1440)
     if M_struc is None:
-        M_struc = jnp.zeros((6, 6))
+        M_struc = jnp.zeros((6, 6), dtype=_config.real_dtype())
     M_struc = jnp.asarray(M_struc)
     F1st = jnp.concatenate([
         M_struc[0, 0] * (-w2**2 * Xi[0:3, :]),
@@ -378,7 +391,7 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None,
     a_i = jnp.asarray(nd.a_i)
     submerged = (z < 0.0)                        # strict, reference :1522-1523
 
-    ones = jnp.ones(nw2, dtype=complex)
+    ones = jnp.ones(nw2, dtype=_config.complex_dtype())
     u_n, _, _ = wave_kinematics(ones, beta, w2, k2, h, r, rho=rho, g=g)  # (N,3,nw2)
     dr_n, nodeV, _ = kinematics_from_motion(offsets, Xi, w2)             # (N,3,nw2)
     grad_u = wave_vel_gradient(w2, k2, beta, h, r[:, None, :])           # (N,nw2,3,3)
@@ -429,16 +442,16 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None,
         eta_r = eta - drw[2, :]
         pm1, pm2 = p1[last], p2[last]
         # g projected along p1/p2 per frequency (reference :1506-1509)
-        g_e1 = -g * (jnp.cross(Xi[3:, :], pm1[:, None].astype(complex),
+        g_e1 = -g * (jnp.cross(Xi[3:, :], pm1[:, None].astype(_config.complex_dtype()),
                                axisa=0, axisb=0, axisc=0)[2][None, :] * pm1[:, None]
-                     + jnp.cross(Xi[3:, :], pm2[:, None].astype(complex),
+                     + jnp.cross(Xi[3:, :], pm2[:, None].astype(_config.complex_dtype()),
                                  axisa=0, axisb=0, axisc=0)[2][None, :] * pm2[:, None])
         wl_members.append(dict(
             r_int=jnp.asarray(r_int), a=a_wl_area, last=last,
             udw=udw, aw=aw, eta_r=eta_r, g_e1=g_e1))
 
     # ---- pair kernel over the dense (i1,i2) grid ----
-    idx = jnp.arange(nw2)
+    idx = jnp.arange(nw2, dtype=jnp.int32)
 
     def pair(i1, i2):
         w1, wv2 = w2[i1], w2[i2]
@@ -463,35 +476,35 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None,
         # 2nd-order potential (reference :1541-1544)
         acc_2p, p_2nd = wave_pot_2nd_order(w1, wv2, kk1, kk2, beta, beta, h, r,
                                            g=g, rho=rho)
-        f_2ndPot = (rho * v_i)[:, None] * jnp.einsum("nij,nj->ni", Minert.astype(complex), acc_2p)
+        f_2ndPot = (rho * v_i)[:, None] * jnp.einsum("nij,nj->ni", Minert.astype(_config.complex_dtype()), acc_2p)
 
         # convective acceleration (reference :1546-1548)
         conv_acc = 0.25 * (jnp.einsum("nij,nj->ni", gu1, jnp.conj(u2))
                            + jnp.einsum("nij,nj->ni", jnp.conj(gu2), u1))
-        f_conv = (rho * v_i)[:, None] * jnp.einsum("nij,nj->ni", Minert.astype(complex), conv_acc)
+        f_conv = (rho * v_i)[:, None] * jnp.einsum("nij,nj->ni", Minert.astype(_config.complex_dtype()), conv_acc)
 
         # Rainey axial divergence (reference :1550-1551, helpers.py:228-251)
-        dwdz1 = jnp.einsum("nij,nj,ni->n", gu1, q.astype(complex), q.astype(complex))
-        dwdz2 = jnp.einsum("nij,nj,ni->n", gu2, q.astype(complex), q.astype(complex))
+        dwdz1 = jnp.einsum("nij,nj,ni->n", gu1, q.astype(_config.complex_dtype()), q.astype(_config.complex_dtype()))
+        dwdz2 = jnp.einsum("nij,nj,ni->n", gu2, q.astype(_config.complex_dtype()), q.astype(_config.complex_dtype()))
         def transverse(vec):
-            return vec - jnp.einsum("nc,nc->n", vec, q.astype(complex))[:, None] * q
+            return vec - jnp.einsum("nc,nc->n", vec, q.astype(_config.complex_dtype()))[:, None] * q
         u1t, u2t = transverse(u1), transverse(u2)
         nv1t, nv2t = transverse(nv1), transverse(nv2)
         axdv = 0.25 * (dwdz1[:, None] * jnp.conj(u2t - nv2t)
                        + jnp.conj(dwdz2)[:, None] * (u1t - nv1t))
         axdv = transverse(axdv)
-        f_axdv = (rho * v_i)[:, None] * jnp.einsum("nij,nj->ni", CaMat.astype(complex), axdv)
+        f_axdv = (rho * v_i)[:, None] * jnp.einsum("nij,nj->ni", CaMat.astype(_config.complex_dtype()), axdv)
 
         # body motion in the 1st-order field (reference :1553-1555)
         acc_nabla = 0.25 * (jnp.einsum("nij,nj->ni", gdu1, jnp.conj(dr2))
                             + jnp.einsum("nij,nj->ni", jnp.conj(gdu2), dr1))
-        f_nabla = (rho * v_i)[:, None] * jnp.einsum("nij,nj->ni", Minert.astype(complex), acc_nabla)
+        f_nabla = (rho * v_i)[:, None] * jnp.einsum("nij,nj->ni", Minert.astype(_config.complex_dtype()), acc_nabla)
 
         # Rainey body-rotation terms (reference :1557-1576)
         OM1 = -skew(1j * w1 * Xi1[3:])
         OM2 = -skew(1j * wv2 * Xi2[3:])
         f_rslb = -0.25 * 2.0 * jnp.einsum(
-            "nij,nj->ni", CaMat.astype(complex),
+            "nij,nj->ni", CaMat.astype(_config.complex_dtype()),
             (OM1 @ jnp.conj(nax2[:, None] * q).T).T
             + (jnp.conj(OM2) @ (nax1[:, None] * q).T).T)
         f_rslb = (rho * v_i)[:, None] * f_rslb
@@ -501,53 +514,53 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None,
         V1 = gu1 + OM1[None, :, :]
         V2 = gu2 + OM2[None, :, :]
         aux = 0.25 * (jnp.einsum("nij,nj->ni", V1,
-                                 jnp.conj(jnp.einsum("nij,nj->ni", CaMat.astype(complex), u2a)))
+                                 jnp.conj(jnp.einsum("nij,nj->ni", CaMat.astype(_config.complex_dtype()), u2a)))
                       + jnp.einsum("nij,nj->ni", jnp.conj(V2),
-                                   jnp.einsum("nij,nj->ni", CaMat.astype(complex), u1a)))
-        aux = aux - jnp.einsum("nij,nj->ni", qMat.astype(complex), aux)
+                                   jnp.einsum("nij,nj->ni", CaMat.astype(_config.complex_dtype()), u1a)))
+        aux = aux - jnp.einsum("nij,nj->ni", qMat.astype(_config.complex_dtype()), aux)
         f_rslb = f_rslb + (rho * v_i)[:, None] * aux
 
-        u1at = u1a - jnp.einsum("nij,nj->ni", qMat.astype(complex), u1a)
-        u2at = u2a - jnp.einsum("nij,nj->ni", qMat.astype(complex), u2a)
-        aux2 = 0.25 * (jnp.einsum("nij,nj->ni", CaMat.astype(complex),
+        u1at = u1a - jnp.einsum("nij,nj->ni", qMat.astype(_config.complex_dtype()), u1a)
+        u2at = u2a - jnp.einsum("nij,nj->ni", qMat.astype(_config.complex_dtype()), u2a)
+        aux2 = 0.25 * (jnp.einsum("nij,nj->ni", CaMat.astype(_config.complex_dtype()),
                                   jnp.einsum("nij,nj->ni", V1, jnp.conj(u2at)))
-                       + jnp.einsum("nij,nj->ni", CaMat.astype(complex),
+                       + jnp.einsum("nij,nj->ni", CaMat.astype(_config.complex_dtype()),
                                     jnp.einsum("nij,nj->ni", jnp.conj(V2), u1at)))
         f_rslb = f_rslb - (rho * v_i)[:, None] * aux2
 
         # axial/end effects (reference :1578-1601)
         f_2ndPot = f_2ndPot + a_i[:, None] * p_2nd[:, None] * q
         f_2ndPot = f_2ndPot + (rho * v_end * Ca_End)[:, None] * jnp.einsum(
-            "nij,nj->ni", qMat.astype(complex), acc_2p)
+            "nij,nj->ni", qMat.astype(_config.complex_dtype()), acc_2p)
         f_conv = f_conv + (rho * v_end * Ca_End)[:, None] * jnp.einsum(
-            "nij,nj->ni", qMat.astype(complex), conv_acc)
+            "nij,nj->ni", qMat.astype(_config.complex_dtype()), conv_acc)
         f_nabla = f_nabla + (rho * v_end * Ca_End)[:, None] * jnp.einsum(
-            "nij,nj->ni", qMat.astype(complex), acc_nabla)
+            "nij,nj->ni", qMat.astype(_config.complex_dtype()), acc_nabla)
         p_nabla = 0.25 * (jnp.einsum("nc,nc->n", gp1, jnp.conj(dr2))
                           + jnp.einsum("nc,nc->n", jnp.conj(gp2), dr1))
         f_nabla = f_nabla + (a_i * p_nabla)[:, None] * q
         p_drop = -2.0 * 0.25 * 0.5 * rho * jnp.einsum(
             "nc,nc->n",
-            jnp.einsum("nij,nj->ni", ptMat.astype(complex), u1 - nv1),
-            jnp.conj(jnp.einsum("nij,nj->ni", CaMat.astype(complex), u2 - nv2)))
+            jnp.einsum("nij,nj->ni", ptMat.astype(_config.complex_dtype()), u1 - nv1),
+            jnp.conj(jnp.einsum("nij,nj->ni", CaMat.astype(_config.complex_dtype()), u2 - nv2)))
         f_conv = f_conv + (a_i[:, None] * p_drop[:, None]) * q
 
         # wrench about the PRP, masked to submerged nodes
         f_side = (f_2ndPot + f_conv + f_axdv + f_nabla + f_rslb) \
             * submerged[:, None].astype(float)
-        mom = jnp.cross(offsets.astype(complex), f_side)
+        mom = jnp.cross(offsets.astype(_config.complex_dtype()), f_side)
         F_side = jnp.concatenate([jnp.sum(f_side, axis=0), jnp.sum(mom, axis=0)])
 
         # waterline relative-elevation term per crossing member
         # (reference :1603-1631; all fields precomputed outside the kernel)
-        F_eta = jnp.zeros(6, dtype=complex)
+        F_eta = jnp.zeros(6, dtype=_config.complex_dtype())
         for wm in wl_members:
             last = wm["last"]
             aA = wm["a"]
             # reference quirk: Ca at the waterline is the LAST node's value
             # (loop-leaked variable, raft_fowt.py:1527-1529 used at :1613)
-            Minert_wl = Minert[last].astype(complex)
-            CaMat_wl = CaMat[last].astype(complex)
+            Minert_wl = Minert[last].astype(_config.complex_dtype())
+            CaMat_wl = CaMat[last].astype(_config.complex_dtype())
             udw, aw, eta_r, g_e1 = wm["udw"], wm["aw"], wm["eta_r"], wm["g_e1"]
             f_eta = 0.25 * (udw[:, i1] * jnp.conj(eta_r[i2])
                             + jnp.conj(udw[:, i2]) * eta_r[i1])
@@ -557,7 +570,7 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None,
             f_eta = f_eta - rho * aA * (CaMat_wl @ a_eta)
             f_eta = f_eta - 0.25 * rho * aA * (g_e1[:, i1] * jnp.conj(eta_r[i2])
                                                + jnp.conj(g_e1[:, i2]) * eta_r[i1])
-            off = (wm["r_int"] - rPRP).astype(complex)
+            off = (wm["r_int"] - rPRP).astype(_config.complex_dtype())
             F_eta = F_eta + jnp.concatenate([f_eta, jnp.cross(off, f_eta)])
 
         return F_rotN + F_side + F_eta
@@ -566,7 +579,42 @@ def calc_qtf_slender_body(fowt, pose, beta, Xi0=None, M_struc=None,
         return jax.vmap(jax.vmap(pair, in_axes=(None, 0)),
                         in_axes=(0, None))(jnp.asarray(rows), idx)
 
-    Q = jax.vmap(jax.vmap(pair, in_axes=(None, 0)), in_axes=(0, None))(idx, idx)
+    if _use_qtf_kernel():
+        # fused Pallas pair-grid kernel: same precomputed fields, the
+        # (i1, i2) product tiled with w2 on the lane axis and the whole
+        # per-pair force assembly VMEM-resident (ops/pallas/qtf_pair.py)
+        from raft_tpu.ops.pallas.qtf_pair import qtf_pair_grid
+
+        wl = None
+        if wl_members:
+            rdt = _config.real_dtype()
+            wl = dict(
+                c=jnp.stack([jnp.stack([m["udw"], m["aw"], m["g_e1"]])
+                             for m in wl_members]),
+                eta=jnp.stack([m["eta_r"] for m in wl_members]),
+                mats=jnp.stack([jnp.stack([Minert[m["last"]],
+                                           CaMat[m["last"]]])
+                                for m in wl_members]),
+                geo=jnp.stack([jnp.concatenate([
+                    jnp.asarray([m["a"]], rdt),
+                    jnp.asarray(m["r_int"] - rPRP, rdt)])
+                    for m in wl_members]),
+            )
+        fields = dict(
+            w2=w2, k2=k2, Xi=Xi, F1st=F1st,
+            u=u_n, dr=dr_n, nv=nodeV, nax=nodeV_ax,
+            gu=jnp.moveaxis(grad_u, 1, -1),      # (N,3,3,nw2) lane-last
+            gp=jnp.moveaxis(grad_p, 1, -1),      # (N,3,nw2) lane-last
+            q=q, offsets=offsets, pos=r,
+            Minert=Minert, CaMat=CaMat, ptMat=ptMat, qMat=qMat,
+            nodescal=jnp.stack(
+                [v_i, v_end * Ca_End, a_i,
+                 submerged.astype(_config.real_dtype())], axis=1),
+            wl=wl)
+        Q = qtf_pair_grid(fields, beta, h, rho, g)
+    else:
+        Q = jax.vmap(jax.vmap(pair, in_axes=(None, 0)),
+                     in_axes=(0, None))(idx, idx)
 
     # Kim & Yue analytical 2nd-order diffraction correction for MCF
     # members (reference: raft_fowt.py:1636 -> raft_member.py:1090-1205)
@@ -652,7 +700,7 @@ def hydro_force_2nd(qtf, heads_rad, w2, beta, S0, w, interp_mode="qtf"):
         Qc = jax.vmap(i1d, in_axes=0)(Qd)          # interp along axis 1
         return jax.vmap(i1d, in_axes=1, out_axes=1)(Qc)  # then axis 0
 
-    jj = jnp.arange(nw)
+    jj = jnp.arange(nw, dtype=jnp.int32)
     i2idx = jj[None, :] + jj[:, None]              # [imu, j] -> j + imu
     valid = (i2idx < nw)
     i2c = jnp.clip(i2idx, 0, nw - 1)
@@ -674,7 +722,7 @@ def hydro_force_2nd(qtf, heads_rad, w2, beta, S0, w, interp_mode="qtf"):
         # :1760-1784)
         nw2n = len(np.asarray(w2))
         S2 = (jnp.interp(w2, w, S0, left=0.0, right=0.0))
-        j2 = jnp.arange(nw2n)
+        j2 = jnp.arange(nw2n, dtype=jnp.int32)
         i2idx2 = j2[None, :] + j2[:, None]
         valid2 = (i2idx2 < nw2n)
         i2c2 = jnp.clip(i2idx2, 0, nw2n - 1)
@@ -697,5 +745,6 @@ def hydro_force_2nd(qtf, heads_rad, w2, beta, S0, w, interp_mode="qtf"):
 
     # shift by one frequency: difference frequencies start at 0, the model
     # grid starts at dw (reference :1806-1810)
-    f = jnp.concatenate([f[:, 1:], jnp.zeros((6, 1))], axis=1)
+    f = jnp.concatenate([f[:, 1:],
+                         jnp.zeros((6, 1), dtype=f.dtype)], axis=1)
     return fmean, f

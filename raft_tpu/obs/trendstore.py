@@ -148,6 +148,21 @@ def facts_from_manifest(doc: dict) -> dict:
     for k in ("value", "vs_baseline", "analyze_cases_s_per_case"):
         if _num(res.get(k)) is not None:
             facts[f"result_{k}"] = res[k]
+    # mixed-precision ladder facts (bench_kernels.py / ops/linalg.py):
+    # the promoted-lane ratio is the SLO tripwire against a mixed
+    # ladder silently degenerating to all-f64 promotion; the speedup
+    # fact only lands on compiled-path rounds (interpret rows omit it)
+    solver = extra.get("solver") or {}
+    if isinstance(solver, dict):
+        if _num(solver.get("promoted_lane_ratio")) is not None:
+            facts["solve_promoted_lane_ratio"] = \
+                solver["promoted_lane_ratio"]
+        if (_num(solver.get("mixed_speedup_vs_f64")) is not None
+                and solver.get("timing_meaningful")):
+            facts["solve_mixed_speedup_vs_f64"] = \
+                solver["mixed_speedup_vs_f64"]
+        if solver.get("precision"):
+            facts["solve_precision"] = str(solver["precision"])
     # serving-layer facts (raft_tpu/serve): one row per service
     # lifetime, gated by the serve SLO rules below
     serve = extra.get("serve") or {}
@@ -447,6 +462,15 @@ DEFAULT_SLO_RULES = [
     {"name": "serve_warm_start_digest_mismatch",
      "fact": "serve_warm_start_digest_mismatch", "agg": "max",
      "op": "<=", "threshold": 0.0, "window": 20},
+    # -- mixed-precision ladder gate (bench_kernels.py; skipped when no
+    # mixed-ladder bench row exists).  A promoted-lane ratio near 1.0
+    # means the mixed ladder silently degenerated to an all-f64
+    # re-solve — paying the low-width factorization AND the full-width
+    # pass on every lane; the bench's well-conditioned hot-path systems
+    # should promote (far) under a quarter of their lanes.
+    {"name": "solve_promoted_lane_ratio", "kind": "bench_kernels",
+     "fact": "solve_promoted_lane_ratio", "agg": "max", "op": "<=",
+     "threshold": 0.25, "window": 20},
 ]
 
 _OPS = {

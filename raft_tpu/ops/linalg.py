@@ -36,6 +36,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from raft_tpu import _config
+from raft_tpu.ops import precision as _prec
+from raft_tpu.ops.precision import equilibration_eps
+
 
 def gauss_jordan_solve(A, b, refine: int = 1):
     """Solve A x = b for real A (..., n, n), b (..., n, k) by unrolled
@@ -56,9 +60,10 @@ def gauss_jordan_solve(A, b, refine: int = 1):
     B = int(np.prod(batch)) if batch else 1
     Af = A.reshape(B, n, n)
     bf = b.reshape(B, n, k)
-    # row equilibration: D A x = D b with D = 1/max|row|
+    # row equilibration: D A x = D b with D = 1/max|row| (shared
+    # dtype-aware underflow floor — the ladder's single source)
     scale = 1.0 / jnp.maximum(jnp.max(jnp.abs(Af), axis=-1, keepdims=True),
-                              1e-300 if Af.dtype == jnp.float64 else 1e-30)
+                              equilibration_eps(Af.dtype))
     Af = Af * scale
     bf = bf * scale
     x = _gj_core(Af, bf, n, k)
@@ -90,6 +95,95 @@ def _gj_core(Af, bf, n, k):
         M = M - colk[:, None, :] * rowk_n[None, :, :]
         M = M.at[kk, :, :].set(rowk_n)
     return jnp.moveaxis(M[:, n:, :], -1, 0)        # (B, n, k)
+
+
+# ---------------------------------------------------------------------------
+# mixed-precision ladder (batch-first twin of the in-kernel ladder in
+# ops/pallas/gj_solve.py — used by the jnp-GJ and LU backends so every
+# RAFT_TPU_PALLAS mode honors RAFT_TPU_PRECISION)
+# ---------------------------------------------------------------------------
+
+def _mixed_ladder(A, b, core_low, core_hi, refine, factor_dtype, tol):
+    """Equilibrate at the input width, factorize/solve at
+    ``factor_dtype`` via ``core_low(Af, rhs_f) -> x_f``, accumulate the
+    refinement residual and correction at the input width, then
+    promote: lanes whose final max relative residual exceeds ``tol``
+    are re-solved at the full width via ``core_hi`` in a second pass in
+    which non-promoted lanes are masked to identity systems — and the
+    pass is skipped entirely (``lax.cond``) when nothing promoted.
+
+    A (B, n, n), b (B, n, k) batch-first; returns
+    (x, {"promoted", "lanes", "resid_max"})."""
+    B, n, _ = A.shape
+    eps = equilibration_eps(A.dtype)
+    scale = 1.0 / jnp.maximum(jnp.max(jnp.abs(A), axis=-1, keepdims=True),
+                              eps)
+    As = A * scale
+    bs = b * scale
+    Af = As.astype(factor_dtype)
+    x = core_low(Af, bs.astype(factor_dtype)).astype(A.dtype)
+    for _ in range(refine):
+        r = bs - jnp.einsum("bij,bjk->bik", As, x)
+        x = x + core_low(Af, r.astype(factor_dtype)).astype(A.dtype)
+    r = bs - jnp.einsum("bij,bjk->bik", As, x)
+    rn = (jnp.max(jnp.abs(r), axis=(-2, -1))
+          / (jnp.max(jnp.abs(bs), axis=(-2, -1)) + eps))     # (B,)
+    mask, promoted = _prec.promotion_mask(rn, tol)
+
+    def _resolve(xm):
+        m = mask[:, None, None]
+        eye = jnp.broadcast_to(jnp.eye(n, dtype=As.dtype), As.shape)
+        xh = core_hi(jnp.where(m, As, eye),
+                     jnp.where(m, bs, jnp.zeros((), bs.dtype)))
+        return jnp.where(m, xh, xm)
+
+    x = jax.lax.cond(promoted > 0, _resolve, lambda xm: xm, x)
+    return x, {"promoted": promoted, "lanes": B,
+               "resid_max": jnp.max(rn)}
+
+
+def _precision_plan(dtype) -> dict:
+    """Resolve the ambient ``RAFT_TPU_PRECISION`` request against the
+    (real-embedded) solve dtype at trace time.
+
+    Returns the dispatch facts plus the actionable pieces:
+    ``mode`` (requested), ``solve_width``/``factor_width`` (resolved
+    names), ``factor`` (jnp dtype or None — None means single-width),
+    ``cast`` (dtype to force the whole solve to, or None), ``tol``
+    (promotion tolerance, mixed only).  A mixed request whose factor
+    width is not strictly below the input width degenerates to the
+    native solve — recorded, never silent."""
+    from raft_tpu import _config
+
+    mode = _config.precision_mode()
+    dt = jnp.dtype(dtype)
+    plan = {"mode": mode, "solve_width": _prec.width_name(dt),
+            "factor": None, "factor_width": None, "cast": None,
+            "tol": None}
+    if mode == "mixed":
+        fd = _prec.factor_dtype(_config.precision_width())
+        if _prec.narrows(fd, dt):
+            plan.update(factor=fd, factor_width=_prec.width_name(fd),
+                        tol=_config.precision_tol())
+        else:
+            plan["degenerate"] = True
+    elif mode == "f32" and dt != jnp.dtype(jnp.float32):
+        plan.update(cast=jnp.dtype(jnp.float32), solve_width="f32")
+    return plan
+
+
+def _probe_promoted(stats):
+    """Stream the mixed ladder's runtime promotion counts through the
+    sanctioned on-device probe channel (metric:
+    ``raft_tpu_probe_value{probe="solve_promoted_lanes"}`` + flight
+    recorder); trace-time no-op under RAFT_TPU_PROBES=off."""
+    try:
+        from raft_tpu.obs import probes
+        probes.probe("solve_promoted_lanes", promoted=stats["promoted"],
+                     lanes=stats["lanes"], resid_max=stats["resid_max"])
+    # telemetry emission must never fail a solve (obs layer contract)
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+        pass
 
 
 #: above this many systems of size <= _GJ_MAX_N, prefer Gauss-Jordan on TPU
@@ -129,20 +223,94 @@ _LAST_DISPATCH: dict = {}
 
 def last_dispatch() -> dict:
     """Most recent solve-backend dispatch decision (made at trace time):
-    ``{"backend", "n", "batch_elems", "fused"}``.  Empty before any
+    ``{"backend", "n", "batch_elems", "fused", "precision",
+    "solve_width", "factor_width", "promote_tol"}``.  Empty before any
     solve has been traced in this process."""
     return dict(_LAST_DISPATCH)
 
 
-def _record_dispatch(backend: str, n, batch_elems, fused: bool = False):
+def _record_dispatch(backend: str, n, batch_elems, fused: bool = False,
+                     plan: dict = None):
+    # cleared, not merged: a later single-width dispatch must not keep
+    # wearing an earlier mixed dispatch's precision facts
+    _LAST_DISPATCH.clear()
     _LAST_DISPATCH.update(backend=backend, n=int(n),
                           batch_elems=int(batch_elems), fused=bool(fused))
+    if plan is not None:
+        _LAST_DISPATCH.update(
+            precision=plan["mode"], solve_width=plan["solve_width"],
+            factor_width=plan["factor_width"], promote_tol=plan["tol"])
+        if plan.get("degenerate"):
+            _LAST_DISPATCH["precision_degenerate"] = True
     try:
         from raft_tpu import obs
         obs.record_solve_dispatch(backend, n, batch_elems, fused=fused)
     # telemetry emission must never fail a solve (obs layer contract)
     except Exception:  # pragma: no cover  # raftlint: disable=RTL004
         pass
+
+
+def _solve_real_embedded(M, rhs, n2, batch_elems):
+    """Dispatch the real-embedded solve M x = rhs per the active
+    RAFT_TPU_PALLAS x RAFT_TPU_PRECISION modes; returns x at the input
+    width (precision "f32" casts down for the solve and back up)."""
+    in_dtype = M.dtype
+    plan = _precision_plan(in_dtype)
+    if plan["cast"] is not None:
+        M = M.astype(plan["cast"])
+        rhs = rhs.astype(plan["cast"])
+    mixed = plan["factor"] is not None
+    k = rhs.shape[-1]
+    batch = M.shape[:-2]
+    if _use_pallas(n2, batch_elems):
+        from raft_tpu.ops.pallas.gj_solve import gj_solve
+        _record_dispatch("pallas_gj", n2, batch_elems, plan=plan)
+        if mixed:
+            x, stats = gj_solve(M, rhs, refine=2, precision="mixed",
+                                factor_dtype=plan["factor"],
+                                promote_tol=plan["tol"],
+                                return_stats=True)
+            _probe_promoted(stats)
+        else:
+            x = gj_solve(M, rhs)
+    elif _use_gauss_jordan(n2, batch_elems):
+        _record_dispatch("jnp_gj", n2, batch_elems, plan=plan)
+        if mixed:
+            B = int(np.prod(batch)) if batch else 1
+            Mf = M.reshape(B, n2, n2)
+            rf = rhs.reshape(B, n2, k)
+
+            def _hi(a, r):
+                xh = _gj_core(a, r, n2, k)
+                rr = r - jnp.einsum("bij,bjk->bik", a, xh)
+                return xh + _gj_core(a, rr, n2, k)
+
+            x, stats = _mixed_ladder(
+                Mf, rf, lambda a, r: _gj_core(a, r, n2, k), _hi,
+                refine=2, factor_dtype=plan["factor"], tol=plan["tol"])
+            _probe_promoted(stats)
+            x = x.reshape(*batch, n2, k)
+        else:
+            x = gauss_jordan_solve(M, rhs)
+    else:
+        _record_dispatch("lu", n2, batch_elems, plan=plan)
+        if mixed:
+            B = int(np.prod(batch)) if batch else 1
+            # LAPACK LU has no bf16 kernel — the bf16 low rung on this
+            # backend runs the jnp Gauss-Jordan core instead (the high
+            # rung and the promotion pass stay on LU)
+            low = (jnp.linalg.solve
+                   if jnp.dtype(plan["factor"]) != jnp.dtype(jnp.bfloat16)
+                   else (lambda a, r: _gj_core(a, r, n2, k)))
+            x, stats = _mixed_ladder(
+                M.reshape(B, n2, n2), rhs.reshape(B, n2, k),
+                low, jnp.linalg.solve,
+                refine=2, factor_dtype=plan["factor"], tol=plan["tol"])
+            _probe_promoted(stats)
+            x = x.reshape(*batch, n2, k)
+        else:
+            x = jnp.linalg.solve(M, rhs)
+    return x.astype(in_dtype)
 
 
 def solve_complex(A, b):
@@ -161,16 +329,7 @@ def solve_complex(A, b):
     ], axis=-2)
     rhs = jnp.concatenate([jnp.real(b), jnp.imag(b)], axis=-2)
     batch_elems = int(np.prod(A.shape[:-2])) if A.ndim > 2 else 1
-    if _use_pallas(2 * n, batch_elems):
-        from raft_tpu.ops.pallas.gj_solve import gj_solve
-        _record_dispatch("pallas_gj", 2 * n, batch_elems)
-        x = gj_solve(M, rhs)
-    elif _use_gauss_jordan(2 * n, batch_elems):
-        _record_dispatch("jnp_gj", 2 * n, batch_elems)
-        x = gauss_jordan_solve(M, rhs)
-    else:
-        _record_dispatch("lu", 2 * n, batch_elems)
-        x = jnp.linalg.solve(M, rhs)
+    x = _solve_real_embedded(M, rhs, 2 * n, batch_elems)
     out = x[..., :n, :] + 1j * x[..., n:, :]
     return out[..., 0] if vec else out
 
@@ -206,9 +365,29 @@ def impedance_solve(w, M, B, C, F):
     batch_elems = (int(np.prod(batch)) if batch else 1) * nw
     if _use_pallas(2 * n, batch_elems):
         from raft_tpu.ops.pallas.gj_solve import impedance_gj_solve
-        _record_dispatch("pallas_fused", 2 * n, batch_elems, fused=True)
+        in_dtype = M.dtype
+        out_ctype = jnp.result_type(in_dtype, jnp.complex64)
+        plan = _precision_plan(in_dtype)
+        _record_dispatch("pallas_fused", 2 * n, batch_elems, fused=True,
+                         plan=plan)
+        if plan["cast"] is not None:
+            c32 = jnp.result_type(plan["cast"], jnp.complex64)
+            X = impedance_gj_solve(w.astype(plan["cast"]),
+                                   M.astype(plan["cast"]),
+                                   B.astype(plan["cast"]),
+                                   C.astype(plan["cast"]),
+                                   F.astype(c32))
+            return X.astype(out_ctype)
+        if plan["factor"] is not None:
+            X, stats = impedance_gj_solve(
+                w, M, B, C, F, refine=2, precision="mixed",
+                factor_dtype=plan["factor"], promote_tol=plan["tol"],
+                return_stats=True)
+            _probe_promoted(stats)
+            return X
         return impedance_gj_solve(w, M, B, C, F)
-    Z = (-w ** 2 * M + 1j * w * B + C[..., None]).astype(complex)
+    Z = (-w ** 2 * M + 1j * w * B
+         + C[..., None]).astype(_config.complex_dtype())
     Xin = solve_complex(jnp.moveaxis(Z, -1, -3), jnp.moveaxis(F, -1, -2))
     return jnp.moveaxis(Xin, -2, -1)
 

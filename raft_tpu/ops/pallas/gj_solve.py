@@ -29,6 +29,18 @@ CI proves kernel parity without TPU hardware (``RAFT_TPU_PALLAS=1``).
 Numerical behavior matches ``ops.linalg.gauss_jordan_solve``: row
 equilibration (1/max|row|), partial pivoting, ``refine`` steps of
 residual re-solve.
+
+Mixed-precision ladder (``precision="mixed"``): the elimination runs at
+a configurable low width (f32 default, bf16 opt-in) while the
+refinement residual ``r = rhs - A x`` and the correction accumulate at
+the full input width INSIDE the kernel — classical Carson & Higham
+iterative refinement, fused into the same VMEM-resident invocation.
+The kernel additionally emits each lane's final relative residual;
+lanes above the promotion tolerance are re-solved at the full width in
+a second pass where every non-promoted lane is masked to an identity
+system, and the whole pass is skipped (``lax.cond``) when no lane
+promoted — the common case.  Promoted-lane counts ride back to the
+dispatch layer via the returned stats.
 """
 from __future__ import annotations
 
@@ -38,6 +50,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from raft_tpu.ops.precision import equilibration_eps, promotion_mask
 
 #: default lane-batch tile: 2 full 128-lane registers per op
 DEFAULT_TILE_B = 256
@@ -99,29 +113,55 @@ def _matmul_bl(A, x):
     return jnp.sum(A[:, :, None, :] * x[None, :, :, :], axis=1)
 
 
-def _gj_batchlast(A, rhs, n, k, refine):
-    """Equilibrate + eliminate + refine, all on VMEM-resident values."""
-    eps = 1e-300 if A.dtype == jnp.float64 else 1e-30
+def _gj_batchlast(A, rhs, n, k, refine, factor_dtype=None, resid=False):
+    """Equilibrate + eliminate + refine, all on VMEM-resident values.
+
+    ``factor_dtype``: when given (and lower than the input width), the
+    elimination runs at that width while the residual ``rhs - A x`` and
+    the correction accumulate at the input width — the in-kernel mixed
+    ladder.  ``resid=True`` additionally returns each lane's final max
+    relative residual (tB,), the promotion signal."""
+    eps = equilibration_eps(A.dtype)
     scale = 1.0 / jnp.maximum(jnp.max(jnp.abs(A), axis=1, keepdims=True),
                               eps)
     A = A * scale
     rhs = rhs * scale
-    x = _gj_elim(A, rhs, n, k)
-    for _ in range(refine):
-        r = rhs - _matmul_bl(A, x)
-        x = x + _gj_elim(A, r, n, k)
-    return x
+    if factor_dtype is None or jnp.dtype(factor_dtype) == A.dtype:
+        x = _gj_elim(A, rhs, n, k)
+        for _ in range(refine):
+            r = rhs - _matmul_bl(A, x)
+            x = x + _gj_elim(A, r, n, k)
+    else:
+        # low-width elimination on the FULL-width-equilibrated block;
+        # residual + correction stay at the input width
+        Af = A.astype(factor_dtype)
+        x = _gj_elim(Af, rhs.astype(factor_dtype), n, k).astype(A.dtype)
+        for _ in range(refine):
+            r = rhs - _matmul_bl(A, x)
+            x = x + _gj_elim(Af, r.astype(factor_dtype),
+                             n, k).astype(A.dtype)
+    if not resid:
+        return x, None
+    r = rhs - _matmul_bl(A, x)
+    den = jnp.max(jnp.abs(rhs), axis=(0, 1)) + eps     # (tB,)
+    return x, jnp.max(jnp.abs(r), axis=(0, 1)) / den
 
 
 def _gj_kernel(a_ref, b_ref, out_ref, *, n, k, refine):
-    out_ref[:] = _gj_batchlast(a_ref[:], b_ref[:], n, k, refine)
+    out_ref[:] = _gj_batchlast(a_ref[:], b_ref[:], n, k, refine)[0]
 
 
-def _impedance_kernel(w_ref, m_ref, b_ref, c_ref, fre_ref, fim_ref,
-                      out_ref, *, n, k, refine):
-    """Fused load stage: assemble the real block embedding of
-    Z = -w^2 M + i w B + C from its real factors, then solve — Z never
-    leaves VMEM."""
+def _gj_mixed_kernel(a_ref, b_ref, out_ref, res_ref, *, n, k, refine,
+                     factor_dtype):
+    x, rn = _gj_batchlast(a_ref[:], b_ref[:], n, k, refine,
+                          factor_dtype=factor_dtype, resid=True)
+    out_ref[:] = x
+    res_ref[:] = rn[None, :]
+
+
+def _assemble_embedding(w_ref, m_ref, b_ref, c_ref, fre_ref, fim_ref, n):
+    """VMEM load stage: the real 2n x 2n block embedding of
+    Z = -w^2 M + i w B + C and its stacked real rhs."""
     w = w_ref[0, :]                                    # (tB,)
     reZ = c_ref[:] - (w * w)[None, None, :] * m_ref[:]
     imZ = w[None, None, :] * b_ref[:]
@@ -130,7 +170,31 @@ def _impedance_kernel(w_ref, m_ref, b_ref, c_ref, fre_ref, fim_ref,
         jnp.concatenate([imZ, reZ], axis=1),
     ], axis=0)                                         # (2n, 2n, tB)
     rhs = jnp.concatenate([fre_ref[:], fim_ref[:]], axis=0)  # (2n, k, tB)
-    out_ref[:] = _gj_batchlast(A, rhs, 2 * n, k, refine)
+    return A, rhs
+
+
+def _impedance_kernel(w_ref, m_ref, b_ref, c_ref, fre_ref, fim_ref,
+                      out_ref, *, n, k, refine):
+    """Fused load stage: assemble the real block embedding of
+    Z = -w^2 M + i w B + C from its real factors, then solve — Z never
+    leaves VMEM."""
+    A, rhs = _assemble_embedding(w_ref, m_ref, b_ref, c_ref,
+                                 fre_ref, fim_ref, n)
+    out_ref[:] = _gj_batchlast(A, rhs, 2 * n, k, refine)[0]
+
+
+def _impedance_mixed_kernel(w_ref, m_ref, b_ref, c_ref, fre_ref, fim_ref,
+                            out_ref, res_ref, *, n, k, refine,
+                            factor_dtype):
+    """The fused assembly with the in-kernel mixed ladder: Z is
+    assembled at the full width, eliminated at ``factor_dtype``, and
+    refined at the full width — per-lane residuals ride out with X."""
+    A, rhs = _assemble_embedding(w_ref, m_ref, b_ref, c_ref,
+                                 fre_ref, fim_ref, n)
+    x, rn = _gj_batchlast(A, rhs, 2 * n, k, refine,
+                          factor_dtype=factor_dtype, resid=True)
+    out_ref[:] = x
+    res_ref[:] = rn[None, :]
 
 
 # ---------------------------------------------------------------------------
@@ -145,14 +209,81 @@ def _pad_lanes(x, pad, fill):
     return jnp.concatenate([x, tail], axis=-1)
 
 
-def gj_solve(A, b, refine: int = 1, tile_b: int = None, interpret=None):
+def _call_gj(Af, bf, n, k, refine, tB, Bp, interpret):
+    """One plain (single-width) kernel launch over the padded lane-last
+    blocks; returns x (n, k, Bp)."""
+    kern = functools.partial(_gj_kernel, n=n, k=k, refine=int(refine))
+    return pl.pallas_call(
+        kern,
+        grid=(Bp // tB,),
+        in_specs=[pl.BlockSpec((n, n, tB), lambda i: (0, 0, i)),
+                  pl.BlockSpec((n, k, tB), lambda i: (0, 0, i))],
+        out_specs=pl.BlockSpec((n, k, tB), lambda i: (0, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((n, k, Bp), Af.dtype),
+        interpret=interpret,
+    )(Af, bf)
+
+
+def _call_gj_mixed(Af, bf, n, k, refine, tB, Bp, interpret, factor_dtype):
+    """One mixed-ladder kernel launch; returns (x (n, k, Bp),
+    per-lane relative residual (Bp,))."""
+    kern = functools.partial(_gj_mixed_kernel, n=n, k=k,
+                             refine=int(refine),
+                             factor_dtype=jnp.dtype(factor_dtype))
+    x, rn = pl.pallas_call(
+        kern,
+        grid=(Bp // tB,),
+        in_specs=[pl.BlockSpec((n, n, tB), lambda i: (0, 0, i)),
+                  pl.BlockSpec((n, k, tB), lambda i: (0, 0, i))],
+        out_specs=[pl.BlockSpec((n, k, tB), lambda i: (0, 0, i)),
+                   pl.BlockSpec((1, tB), lambda i: (0, i))],
+        out_shape=[jax.ShapeDtypeStruct((n, k, Bp), Af.dtype),
+                   jax.ShapeDtypeStruct((1, Bp), Af.dtype)],
+        interpret=interpret,
+    )(Af, bf)
+    return x, rn[0]
+
+
+def _promote_lanes_gj(Af, bf, x, rn, n, k, refine, tB, Bp, interpret,
+                      promote_tol):
+    """Per-lane adaptive promotion: lanes whose mixed-ladder residual
+    exceeds the tolerance are re-solved at the full input width in a
+    second pass in which every NON-promoted lane is masked to an
+    identity system (lane-parallel tiles cannot be thinned, so the win
+    is skipping the pass entirely — ``lax.cond`` — when nothing
+    promoted, the common case).  Returns (x, promoted_count)."""
+    mask, promoted = promotion_mask(rn, promote_tol)   # (Bp,), scalar
+
+    def _resolve(xm):
+        m = mask[None, None, :]
+        eye = jnp.broadcast_to(jnp.eye(n, dtype=Af.dtype)[:, :, None],
+                               (n, n, Bp))
+        A2 = jnp.where(m, Af, eye)
+        b2 = jnp.where(m, bf, jnp.zeros((), bf.dtype))
+        xh = _call_gj(A2, b2, n, k, refine, tB, Bp, interpret)
+        return jnp.where(m, xh, xm)
+
+    x = jax.lax.cond(promoted > 0, _resolve, lambda xm: xm, x)
+    return x, promoted
+
+
+def gj_solve(A, b, refine: int = 1, tile_b: int = None, interpret=None,
+             precision: str = None, factor_dtype=None, promote_tol=None,
+             return_stats: bool = False):
     """Pallas batched Gauss-Jordan solve of real A (..., n, n) x = b
     (..., n, k); semantics match ``ops.linalg.gauss_jordan_solve`` (row
     equilibration, partial pivoting, ``refine`` refinement passes).
 
     The flattened batch is tiled over the grid; each (n, n+k, tile_b)
     augmented block stays VMEM-resident through all pivot steps.
-    ``interpret=None`` auto-selects interpret mode on CPU."""
+    ``interpret=None`` auto-selects interpret mode on CPU.
+
+    ``precision="mixed"`` runs the in-kernel mixed ladder: elimination
+    at ``factor_dtype`` (f32 default), full-width residual/correction,
+    and per-lane promotion past ``promote_tol`` (see module docstring).
+    ``return_stats=True`` additionally returns
+    ``{"promoted", "lanes", "resid_max"}`` (promoted/resid_max are
+    traced scalars — jit-safe)."""
     A = jnp.asarray(A)
     b = jnp.asarray(b)
     n = A.shape[-1]
@@ -170,21 +301,37 @@ def gj_solve(A, b, refine: int = 1, tile_b: int = None, interpret=None):
             [Af, jnp.broadcast_to(jnp.eye(n, dtype=Af.dtype)[:, :, None],
                                   (n, n, pad))], axis=-1)
         bf = _pad_lanes(bf, pad, 0.0)
-    kern = functools.partial(_gj_kernel, n=n, k=k, refine=int(refine))
-    x = pl.pallas_call(
-        kern,
-        grid=(Bp // tB,),
-        in_specs=[pl.BlockSpec((n, n, tB), lambda i: (0, 0, i)),
-                  pl.BlockSpec((n, k, tB), lambda i: (0, 0, i))],
-        out_specs=pl.BlockSpec((n, k, tB), lambda i: (0, 0, i)),
-        out_shape=jax.ShapeDtypeStruct((n, k, Bp), Af.dtype),
-        interpret=_default_interpret(interpret),
-    )(Af, bf)
-    return jnp.moveaxis(x[..., :B], -1, 0).reshape(*batch, n, k)
+    interp = _default_interpret(interpret)
+    if precision in (None, "native"):
+        x = _call_gj(Af, bf, n, k, refine, tB, Bp, interp)
+        out = jnp.moveaxis(x[..., :B], -1, 0).reshape(*batch, n, k)
+        if not return_stats:
+            return out
+        return out, {"promoted": jnp.zeros((), jnp.int32), "lanes": B,
+                     "resid_max": jnp.zeros((), Af.dtype)}
+    if precision != "mixed":
+        from raft_tpu import errors
+        raise errors.ModelConfigError(
+            f"unknown gj_solve precision {precision!r}")
+    fd = jnp.dtype(factor_dtype) if factor_dtype is not None \
+        else jnp.dtype(jnp.float32)
+    tol = float(promote_tol) if promote_tol is not None else 1e-9
+    x, rn = _call_gj_mixed(Af, bf, n, k, refine, tB, Bp, interp, fd)
+    # pad lanes are identity systems with a zero rhs -> residual 0,
+    # never promoted
+    x, promoted = _promote_lanes_gj(Af, bf, x, rn, n, k, refine, tB, Bp,
+                                    interp, tol)
+    out = jnp.moveaxis(x[..., :B], -1, 0).reshape(*batch, n, k)
+    if not return_stats:
+        return out
+    return out, {"promoted": promoted, "lanes": B,
+                 "resid_max": jnp.max(rn[:B])}
 
 
 def impedance_gj_solve(w, M, B, C, F, refine: int = 1, tile_b: int = None,
-                       interpret=None):
+                       interpret=None, precision: str = None,
+                       factor_dtype=None, promote_tol=None,
+                       return_stats: bool = False):
     """Solve [-w^2 M + i w B + C] X = F without materializing Z.
 
     w (nw,) real; M, B (..., n, n, nw) real; C (..., n, n) real;
@@ -193,7 +340,13 @@ def impedance_gj_solve(w, M, B, C, F, refine: int = 1, tile_b: int = None,
     The (case, frequency) product is flattened to one lane batch; the
     kernel assembles the real 2n x 2n block embedding of Z in its VMEM
     load stage and runs the equilibrated, partially-pivoted Gauss-Jordan
-    elimination with ``refine`` refinement passes in-place."""
+    elimination with ``refine`` refinement passes in-place.
+
+    ``precision="mixed"`` runs the in-kernel mixed ladder (see
+    :func:`gj_solve`); the promotion second pass re-assembles only the
+    promoted lanes' systems (non-promoted lanes degrade to the same
+    identity system the lane padding uses: M=B=w=F=0, C=I) and is
+    skipped entirely when no lane promoted."""
     M = jnp.asarray(M)
     B = jnp.asarray(B)
     C = jnp.asarray(C)
@@ -237,20 +390,77 @@ def impedance_gj_solve(w, M, B, C, F, refine: int = 1, tile_b: int = None,
         Fre = _pad_lanes(Fre, pad, 0.0)
         Fim = _pad_lanes(Fim, pad, 0.0)
 
-    kern = functools.partial(_impedance_kernel, n=n, k=1,
-                             refine=int(refine))
+    interp = _default_interpret(interpret)
     spec_nn = pl.BlockSpec((n, n, tB), lambda i: (0, 0, i))
     spec_nk = pl.BlockSpec((n, 1, tB), lambda i: (0, 0, i))
-    x = pl.pallas_call(
-        kern,
-        grid=(Bp // tB,),
-        in_specs=[pl.BlockSpec((1, tB), lambda i: (0, i)),
-                  spec_nn, spec_nn, spec_nn, spec_nk, spec_nk],
-        out_specs=pl.BlockSpec((2 * n, 1, tB), lambda i: (0, 0, i)),
-        out_shape=jax.ShapeDtypeStruct((2 * n, 1, Bp), Mf.dtype),
-        interpret=_default_interpret(interpret),
-    )(wf, Mf, Bf, Cf, Fre, Fim)
+    spec_w = pl.BlockSpec((1, tB), lambda i: (0, i))
+    spec_x = pl.BlockSpec((2 * n, 1, tB), lambda i: (0, 0, i))
+
+    def _call_plain(wf_, Mf_, Bf_, Cf_, Fre_, Fim_):
+        kern = functools.partial(_impedance_kernel, n=n, k=1,
+                                 refine=int(refine))
+        return pl.pallas_call(
+            kern,
+            grid=(Bp // tB,),
+            in_specs=[spec_w, spec_nn, spec_nn, spec_nn,
+                      spec_nk, spec_nk],
+            out_specs=spec_x,
+            out_shape=jax.ShapeDtypeStruct((2 * n, 1, Bp), Mf.dtype),
+            interpret=interp,
+        )(wf_, Mf_, Bf_, Cf_, Fre_, Fim_)
+
+    stats = None
+    if precision in (None, "native"):
+        x = _call_plain(wf, Mf, Bf, Cf, Fre, Fim)
+        if return_stats:
+            stats = {"promoted": jnp.zeros((), jnp.int32), "lanes": Bt,
+                     "resid_max": jnp.zeros((), Mf.dtype)}
+    elif precision == "mixed":
+        fd = jnp.dtype(factor_dtype) if factor_dtype is not None \
+            else jnp.dtype(jnp.float32)
+        tol = float(promote_tol) if promote_tol is not None else 1e-9
+        kern = functools.partial(_impedance_mixed_kernel, n=n, k=1,
+                                 refine=int(refine), factor_dtype=fd)
+        x, rn = pl.pallas_call(
+            kern,
+            grid=(Bp // tB,),
+            in_specs=[spec_w, spec_nn, spec_nn, spec_nn,
+                      spec_nk, spec_nk],
+            out_specs=[spec_x, pl.BlockSpec((1, tB), lambda i: (0, i))],
+            out_shape=[jax.ShapeDtypeStruct((2 * n, 1, Bp), Mf.dtype),
+                       jax.ShapeDtypeStruct((1, Bp), Mf.dtype)],
+            interpret=interp,
+        )(wf, Mf, Bf, Cf, Fre, Fim)
+        rn = rn[0]                                     # (Bp,)
+        mask, promoted = promotion_mask(rn, tol)
+
+        def _resolve(xm):
+            # non-promoted lanes degrade to the identity padding system
+            # (M=B=w=F=0, C=I); only the promoted lanes carry physics
+            # through the full-width pass
+            mnn = mask[None, None, :]
+            mnk = mask[None, None, :]
+            zero = jnp.zeros((), Mf.dtype)
+            eye = jnp.broadcast_to(
+                jnp.eye(n, dtype=Cf.dtype)[:, :, None], (n, n, Bp))
+            xh = _call_plain(jnp.where(mask[None, :], wf, zero),
+                             jnp.where(mnn, Mf, zero),
+                             jnp.where(mnn, Bf, zero),
+                             jnp.where(mnn, Cf, eye),
+                             jnp.where(mnk, Fre, zero),
+                             jnp.where(mnk, Fim, zero))
+            return jnp.where(mask[None, None, :], xh, xm)
+
+        x = jax.lax.cond(promoted > 0, _resolve, lambda xm: xm, x)
+        if return_stats:
+            stats = {"promoted": promoted, "lanes": Bt,
+                     "resid_max": jnp.max(rn[:Bt])}
+    else:
+        from raft_tpu import errors
+        raise errors.ModelConfigError(
+            f"unknown impedance_gj_solve precision {precision!r}")
     x = x[..., :Bt]                                    # (2n, 1, B)
     X = (x[:n, 0, :] + 1j * x[n:, 0, :])               # (n, B) complex
     X = jnp.moveaxis(X, -1, 0).reshape(batch + (nw, n))
-    return jnp.moveaxis(X, -1, -2)                     # (..., n, nw)
+    X = jnp.moveaxis(X, -1, -2)                        # (..., n, nw)
+    return (X, stats) if return_stats else X

@@ -1,0 +1,391 @@
+"""Fused QTF pair-grid contraction as a Pallas kernel.
+
+``models/qtf.py:calc_qtf_slender_body`` evaluates the slender-body QTF
+on the dense (w1, w2) pair grid as a doubly-vmapped ``pair()`` closure:
+every Pinkster/Rainey term materializes its (N, 3, nw2, nw2)-shaped
+einsum intermediates to HBM between XLA fusions.  The kernel here tiles
+the pair grid instead — grid dimension 0 walks the w1 rows, the w2 axis
+rides the TPU lane dimension — and evaluates the ENTIRE per-pair force
+assembly (second-order potential, convective/axial-divergence/nabla
+accelerations, Rainey body-rotation terms, waterline relative-elevation
+terms, Pinkster IV) on VMEM-resident blocks, writing only the (6,)
+wrench per pair.  Every frequency field is loaded twice through two
+BlockSpecs: a width-1 block at the row index (the "1" side) and a
+lane-tile block at the column index (the "2" side).
+
+Precision discipline: all arithmetic happens at the input widths (the
+complex fields arrive at ``_config.complex_dtype()``); the kernel
+changes memory locality, never numerics — parity vs the vmapped path
+is pinned at 1e-6 in tests/test_qtf_kernel.py.
+
+Backend status: the kernel body uses complex arithmetic, which Mosaic
+(compiled Pallas-TPU) does not lower yet — the kernel therefore always
+runs in interpret mode (the same CI-parity vehicle ``gj_solve`` uses on
+CPU), and the ``RAFT_TPU_QTF_KERNEL`` knob keeps the vmapped path the
+"auto" default until the real/imag-split Mosaic port lands.  The
+blocking layout above is the hardware-shaped part: the real-split port
+changes element types, not the tiling.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from raft_tpu import _config
+from raft_tpu.ops.waves import wave_pot_2nd_order
+
+#: lane tile over the w2 (column) axis — one full 128-lane register
+TILE_P = 128
+
+
+# ---------------------------------------------------------------------------
+# lane-last algebra helpers (trailing axis = w2 lane tile)
+# ---------------------------------------------------------------------------
+
+def _cross0(a, b):
+    """Cross product along axis 0 of (3, ...) stacks, broadcasting the
+    trailing axes (the lane dimension)."""
+    return jnp.stack([
+        a[1] * b[2] - a[2] * b[1],
+        a[2] * b[0] - a[0] * b[2],
+        a[0] * b[1] - a[1] * b[0],
+    ], axis=0)
+
+
+def _cross1(a, b):
+    """Cross product along axis 1 of (N, 3, ...) node stacks."""
+    return jnp.stack([
+        a[:, 1] * b[:, 2] - a[:, 2] * b[:, 1],
+        a[:, 2] * b[:, 0] - a[:, 0] * b[:, 2],
+        a[:, 0] * b[:, 1] - a[:, 1] * b[:, 0],
+    ], axis=1)
+
+
+def _mv(Mt, v):
+    """(N,3,3) static matrix times (N,3,L) lane field -> (N,3,L)."""
+    return jnp.sum(Mt[:, :, :, None] * v[:, None, :, :], axis=2)
+
+
+def _mv4(G, v):
+    """(N,3,3,L1) lane matrix field times (N,3,L2) -> (N,3,L) with
+    L1/L2 broadcasting (the 1-side block is width 1)."""
+    return jnp.sum(G * v[:, None, :, :], axis=2)
+
+
+def _omv(OM, v):
+    """(3,3,L1) per-pair rotation matrix times (N,3,L2) -> (N,3,L)."""
+    return jnp.sum(OM[None, :, :, :] * v[:, None, :, :], axis=2)
+
+
+def _skew_l(v):
+    """(3, L) lane vector -> (3, 3, L) skew matrices."""
+    z = jnp.zeros_like(v[0])
+    return jnp.stack([
+        jnp.stack([z, -v[2], v[1]], axis=0),
+        jnp.stack([v[2], z, -v[0]], axis=0),
+        jnp.stack([-v[1], v[0], z], axis=0),
+    ], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# kernel body
+# ---------------------------------------------------------------------------
+
+def _qtf_pair_kernel(*refs, nm, beta, h, rho, g):
+    (w1_ref, wv2_ref, k1_ref, k2_ref,
+     xi1_ref, xi2_ref, f11_ref, f12_ref,
+     u1_ref, u2_ref, dr1_ref, dr2_ref,
+     nv1_ref, nv2_ref, nax1_ref, nax2_ref,
+     gu1_ref, gu2_ref, gp1_ref, gp2_ref,
+     q_ref, off_ref, pos_ref,
+     minert_ref, camat_ref, ptmat_ref, qmat_ref, nsc_ref) = refs[:28]
+    if nm:
+        wlc1_ref, wlc2_ref, eta1_ref, eta2_ref, \
+            wlm_ref, wlg_ref = refs[28:34]
+    qre_ref, qim_ref = refs[-2:]
+
+    cdt = xi1_ref.dtype
+
+    w1 = w1_ref[0, :]                                  # (1,)
+    wv2 = wv2_ref[0, :]                                # (t,)
+    kk1 = k1_ref[0, :]
+    kk2 = k2_ref[0, :]
+    Xi1 = xi1_ref[:]                                   # (6, 1)
+    Xi2 = xi2_ref[:]                                   # (6, t)
+    F11 = f11_ref[:]                                   # (6, 1) F1st @ i1
+    F12 = f12_ref[:]                                   # (6, t) F1st @ i2
+    u1 = u1_ref[:]                                     # (N, 3, 1)
+    u2 = u2_ref[:]                                     # (N, 3, t)
+    dr1, dr2 = dr1_ref[:], dr2_ref[:]
+    nv1, nv2 = nv1_ref[:], nv2_ref[:]
+    nax1 = nax1_ref[:]                                 # (N, 1)
+    nax2 = nax2_ref[:]                                 # (N, t)
+    gu1 = gu1_ref[:]                                   # (N, 3, 3, 1)
+    gu2 = gu2_ref[:]                                   # (N, 3, 3, t)
+    gp1 = gp1_ref[:]                                   # (N, 3, 1)
+    gp2 = gp2_ref[:]
+    q = q_ref[:]                                       # (N, 3) real
+    offsets = off_ref[:]                               # (N, 3) real
+    pos = pos_ref[:]                                   # (N, 3) real
+    Minert = minert_ref[:]                             # (N, 3, 3) real
+    CaMat = camat_ref[:]
+    ptMat = ptmat_ref[:]
+    qMat = qmat_ref[:]
+    v_i = nsc_ref[:, 0]                                # (N,)
+    v_end_ca = nsc_ref[:, 1]
+    a_i = nsc_ref[:, 2]
+    submerged = nsc_ref[:, 3]
+
+    qc = q.astype(cdt)                                 # (N, 3)
+    gdu1 = 1j * w1[None, None, None, :] * gu1
+    gdu2 = 1j * wv2[None, None, None, :] * gu2
+
+    # ---- Pinkster IV (reference :1449-1456) ----
+    F_rotN = jnp.concatenate([
+        0.25 * (_cross0(Xi1[3:], jnp.conj(F12[0:3]))
+                + _cross0(jnp.conj(Xi2[3:]), F11[0:3])),
+        0.25 * (_cross0(Xi1[3:], jnp.conj(F12[3:]))
+                + _cross0(jnp.conj(Xi2[3:]), F11[3:])),
+    ])                                                 # (6, t)
+
+    # ---- 2nd-order potential (reference :1541-1544) ----
+    # positions broadcast as (N, 1, 3) against the (t,) lane scalars
+    acc_2p, p_2nd = wave_pot_2nd_order(
+        w1, wv2, kk1, kk2, beta, beta, h, pos[:, None, :], g=g, rho=rho)
+    acc_2p = jnp.moveaxis(acc_2p, -1, 1)               # (N, 3, t)
+    f_2ndPot = (rho * v_i)[:, None, None] * _mv(Minert, acc_2p)
+
+    # ---- convective acceleration (reference :1546-1548) ----
+    conv_acc = 0.25 * (_mv4(gu1, jnp.conj(u2)) + _mv4(jnp.conj(gu2), u1))
+    f_conv = (rho * v_i)[:, None, None] * _mv(Minert, conv_acc)
+
+    # ---- Rainey axial divergence (reference :1550-1551) ----
+    qq = q[:, :, None, None] * q[:, None, :, None]     # (N,3,3,1)
+    dwdz1 = jnp.sum(gu1 * qq, axis=(1, 2))             # (N, 1)
+    dwdz2 = jnp.sum(gu2 * qq, axis=(1, 2))             # (N, t)
+
+    def transverse(vec):
+        vq = jnp.sum(vec * qc[:, :, None], axis=1)     # (N, L)
+        return vec - vq[:, None, :] * qc[:, :, None]
+
+    u1t, u2t = transverse(u1), transverse(u2)
+    nv1t, nv2t = transverse(nv1), transverse(nv2)
+    axdv = 0.25 * (dwdz1[:, None, :] * jnp.conj(u2t - nv2t)
+                   + jnp.conj(dwdz2)[:, None, :] * (u1t - nv1t))
+    axdv = transverse(axdv)
+    f_axdv = (rho * v_i)[:, None, None] * _mv(CaMat, axdv)
+
+    # ---- body motion in the 1st-order field (reference :1553-1555) ----
+    acc_nabla = 0.25 * (_mv4(gdu1, jnp.conj(dr2))
+                        + _mv4(jnp.conj(gdu2), dr1))
+    f_nabla = (rho * v_i)[:, None, None] * _mv(Minert, acc_nabla)
+
+    # ---- Rainey body-rotation terms (reference :1557-1576) ----
+    # transforms.skew is the reference's H-matrix (H(r) x = cross(x, r)
+    # = MINUS the standard skew), so the vmapped path's -skew(v) is
+    # +_skew_l(v) here
+    OM1 = _skew_l(1j * w1[None, :] * Xi1[3:])          # (3, 3, 1)
+    OM2 = _skew_l(1j * wv2[None, :] * Xi2[3:])         # (3, 3, t)
+    vec1 = nax1[:, None, :] * qc[:, :, None]           # (N, 3, 1)
+    vec2 = nax2[:, None, :] * qc[:, :, None]           # (N, 3, t)
+    f_rslb = -0.25 * 2.0 * _mv(
+        CaMat, _omv(OM1, jnp.conj(vec2)) + _omv(jnp.conj(OM2), vec1))
+    f_rslb = (rho * v_i)[:, None, None] * f_rslb
+
+    u1a = u1 - nv1
+    u2a = u2 - nv2
+    V1 = gu1 + OM1[None, :, :, :]
+    V2 = gu2 + OM2[None, :, :, :]
+    aux = 0.25 * (_mv4(V1, jnp.conj(_mv(CaMat, u2a)))
+                  + _mv4(jnp.conj(V2), _mv(CaMat, u1a)))
+    aux = aux - _mv(qMat, aux)
+    f_rslb = f_rslb + (rho * v_i)[:, None, None] * aux
+
+    u1at = u1a - _mv(qMat, u1a)
+    u2at = u2a - _mv(qMat, u2a)
+    aux2 = 0.25 * (_mv(CaMat, _mv4(V1, jnp.conj(u2at)))
+                   + _mv(CaMat, _mv4(jnp.conj(V2), u1at)))
+    f_rslb = f_rslb - (rho * v_i)[:, None, None] * aux2
+
+    # ---- axial/end effects (reference :1578-1601) ----
+    f_2ndPot = f_2ndPot + (a_i[:, None, None] * p_2nd[:, None, :]
+                           * qc[:, :, None])
+    f_2ndPot = f_2ndPot + (rho * v_end_ca)[:, None, None] * _mv(qMat,
+                                                                acc_2p)
+    f_conv = f_conv + (rho * v_end_ca)[:, None, None] * _mv(qMat,
+                                                            conv_acc)
+    f_nabla = f_nabla + (rho * v_end_ca)[:, None, None] * _mv(qMat,
+                                                              acc_nabla)
+    p_nabla = 0.25 * (jnp.sum(gp1 * jnp.conj(dr2), axis=1)
+                      + jnp.sum(jnp.conj(gp2) * dr1, axis=1))  # (N, t)
+    f_nabla = f_nabla + (a_i[:, None, None] * p_nabla[:, None, :]
+                         * qc[:, :, None])
+    p_drop = -2.0 * 0.25 * 0.5 * rho * jnp.sum(
+        _mv(ptMat, u1a) * jnp.conj(_mv(CaMat, u2a)), axis=1)   # (N, t)
+    f_conv = f_conv + (a_i[:, None, None] * p_drop[:, None, :]
+                       * qc[:, :, None])
+
+    # ---- wrench about the PRP, masked to submerged nodes ----
+    f_side = ((f_2ndPot + f_conv + f_axdv + f_nabla + f_rslb)
+              * submerged[:, None, None])
+    mom = _cross1(offsets.astype(cdt)[:, :, None], f_side)
+    F_side = jnp.concatenate([jnp.sum(f_side, axis=0),
+                              jnp.sum(mom, axis=0)])           # (6, t)
+
+    # ---- waterline relative-elevation terms per crossing member ----
+    F_eta = jnp.zeros_like(F_side)
+    if nm:
+        wlc1 = wlc1_ref[:]                             # (nm, 3, 3, 1)
+        wlc2 = wlc2_ref[:]                             # (nm, 3, 3, t)
+        eta1 = eta1_ref[:]                             # (nm, 1)
+        eta2 = eta2_ref[:]                             # (nm, t)
+        wlm = wlm_ref[:]                               # (nm, 2, 3, 3)
+        wlg = wlg_ref[:]                               # (nm, 4)
+        for im in range(nm):
+            udw1, aw1, ge1 = wlc1[im, 0], wlc1[im, 1], wlc1[im, 2]
+            udw2, aw2, ge2 = wlc2[im, 0], wlc2[im, 1], wlc2[im, 2]
+            er1, er2 = eta1[im], eta2[im]              # (1,), (t,)
+            aA = wlg[im, 0]
+            off = wlg[im, 1:4].astype(cdt)             # (3,)
+            Minert_wl = wlm[im, 0]
+            CaMat_wl = wlm[im, 1]
+            f_eta = 0.25 * (udw1 * jnp.conj(er2)[None, :]
+                            + jnp.conj(udw2) * er1[None, :])
+            f_eta = rho * aA * jnp.sum(
+                Minert_wl[:, :, None].astype(cdt)
+                * f_eta[None, :, :], axis=1)
+            a_eta = 0.25 * (aw1 * jnp.conj(er2)[None, :]
+                            + jnp.conj(aw2) * er1[None, :])
+            f_eta = f_eta - rho * aA * jnp.sum(
+                CaMat_wl[:, :, None].astype(cdt)
+                * a_eta[None, :, :], axis=1)
+            f_eta = f_eta - 0.25 * rho * aA * (
+                ge1 * jnp.conj(er2)[None, :]
+                + jnp.conj(ge2) * er1[None, :])
+            F_eta = F_eta + jnp.concatenate(
+                [f_eta, _cross0(off[:, None], f_eta)])
+
+    Q = F_rotN + F_side + F_eta                        # (6, t)
+    qre_ref[:] = jnp.real(Q)[None, :, :]
+    qim_ref[:] = jnp.imag(Q)[None, :, :]
+
+
+# ---------------------------------------------------------------------------
+# public wrapper
+# ---------------------------------------------------------------------------
+
+def qtf_pair_grid(fields: dict, beta, h, rho, g, interpret=None):
+    """Evaluate the raw slender-body QTF pair grid (no Kim & Yue
+    correction, no Hermitian completion — the caller applies both,
+    exactly like the ``rows=`` sharded path) as one Pallas program.
+
+    ``fields`` carries the precomputed frequency/node arrays assembled
+    by ``calc_qtf_slender_body`` (see ``_kernel_fields`` there).
+    Returns (nw2, nw2, 6) complex.
+
+    ``interpret`` defaults to True on every backend: the body is
+    complex-typed (see module docstring) — the knob exists so the
+    future Mosaic port can flip the default per backend without an API
+    change."""
+    w2 = jnp.asarray(fields["w2"])
+    nw2 = int(w2.shape[0])
+    t = TILE_P
+    Bp = -(-nw2 // t) * t
+    padf = Bp - nw2
+    cdt = _config.complex_dtype()
+    rdt = _config.real_dtype()
+
+    def padded(x, fill=0.0):
+        """Pad the trailing (frequency) axis to the lane multiple."""
+        x = jnp.asarray(x)
+        if padf == 0:
+            return x
+        tail = jnp.broadcast_to(jnp.asarray(fill, x.dtype),
+                                x.shape[:-1] + (padf,))
+        return jnp.concatenate([x, tail], axis=-1)
+
+    # frequency scalars ride as (1, Bp) rows; dead lanes carry 1.0 so
+    # no division in the kernel sees a structural zero (their output
+    # is sliced off)
+    wrow = padded(w2.astype(rdt)[None, :], 1.0)
+    krow = padded(jnp.asarray(fields["k2"], rdt)[None, :], 1.0)
+    Xi = padded(jnp.asarray(fields["Xi"], cdt))
+    F1st = padded(jnp.asarray(fields["F1st"], cdt))
+    u_n = padded(jnp.asarray(fields["u"], cdt))
+    dr_n = padded(jnp.asarray(fields["dr"], cdt))
+    nodeV = padded(jnp.asarray(fields["nv"], cdt))
+    nax = padded(jnp.asarray(fields["nax"], cdt))
+    gu = padded(jnp.asarray(fields["gu"], cdt))
+    gp = padded(jnp.asarray(fields["gp"], cdt))
+    q = jnp.asarray(fields["q"], rdt)
+    offsets = jnp.asarray(fields["offsets"], rdt)
+    pos = jnp.asarray(fields["pos"], rdt)
+    Minert = jnp.asarray(fields["Minert"], rdt)
+    CaMat = jnp.asarray(fields["CaMat"], rdt)
+    ptMat = jnp.asarray(fields["ptMat"], rdt)
+    qMat = jnp.asarray(fields["qMat"], rdt)
+    nsc = jnp.asarray(fields["nodescal"], rdt)
+    N = int(q.shape[0])
+
+    wl = fields.get("wl")
+    nm = 0 if wl is None else int(np.asarray(wl["geo"]).shape[0])
+
+    def s1(*block):
+        """1-side spec: width-1 frequency block at the row index."""
+        nd = len(block)
+        return pl.BlockSpec(tuple(block) + (1,),
+                            lambda i, j, nd=nd: (0,) * nd + (i,))
+
+    def s2(*block):
+        """2-side spec: lane-tile frequency block at the column tile."""
+        nd = len(block)
+        return pl.BlockSpec(tuple(block) + (t,),
+                            lambda i, j, nd=nd: (0,) * nd + (j,))
+
+    def sfull(*shape):
+        nd = len(shape)
+        return pl.BlockSpec(tuple(shape),
+                            lambda i, j, nd=nd: (0,) * nd)
+
+    inputs = [wrow, wrow, krow, krow,
+              Xi, Xi, F1st, F1st,
+              u_n, u_n, dr_n, dr_n,
+              nodeV, nodeV, nax, nax,
+              gu, gu, gp, gp,
+              q, offsets, pos,
+              Minert, CaMat, ptMat, qMat, nsc]
+    in_specs = [s1(1), s2(1), s1(1), s2(1),
+                s1(6), s2(6), s1(6), s2(6),
+                s1(N, 3), s2(N, 3), s1(N, 3), s2(N, 3),
+                s1(N, 3), s2(N, 3), s1(N), s2(N),
+                s1(N, 3, 3), s2(N, 3, 3), s1(N, 3), s2(N, 3),
+                sfull(N, 3), sfull(N, 3), sfull(N, 3),
+                sfull(N, 3, 3), sfull(N, 3, 3), sfull(N, 3, 3),
+                sfull(N, 3, 3), sfull(N, 4)]
+    if nm:
+        wlc = padded(jnp.asarray(wl["c"], cdt))
+        eta = padded(jnp.asarray(wl["eta"], cdt))
+        inputs += [wlc, wlc, eta, eta,
+                   jnp.asarray(wl["mats"], rdt),
+                   jnp.asarray(wl["geo"], rdt)]
+        in_specs += [s1(nm, 3, 3), s2(nm, 3, 3), s1(nm), s2(nm),
+                     sfull(nm, 2, 3, 3), sfull(nm, 4)]
+
+    kern = functools.partial(_qtf_pair_kernel, nm=nm, beta=float(beta),
+                             h=float(h), rho=float(rho), g=float(g))
+    out_spec = pl.BlockSpec((1, 6, t), lambda i, j: (i, 0, j))
+    qre, qim = pl.pallas_call(
+        kern,
+        grid=(nw2, Bp // t),
+        in_specs=in_specs,
+        out_specs=[out_spec, out_spec],
+        out_shape=[jax.ShapeDtypeStruct((nw2, 6, Bp), rdt),
+                   jax.ShapeDtypeStruct((nw2, 6, Bp), rdt)],
+        interpret=True if interpret is None else bool(interpret),
+    )(*inputs)
+    Q = (qre + 1j * qim)[:, :, :nw2]                   # (nw2, 6, nw2)
+    return jnp.moveaxis(Q, 1, 2).astype(cdt)           # (nw2, nw2, 6)

@@ -1,0 +1,89 @@
+"""Shared helpers for the mixed-precision solve ladder.
+
+One module owns the numeric facts both Gauss-Jordan implementations
+(the jnp graph in ``ops/linalg.py`` and the Pallas kernel in
+``ops/pallas/gj_solve.py``) must agree on:
+
+- the **equilibration underflow floor**: the row scale ``1/max|row|``
+  must never divide by zero (an all-zero row is singular anyway and
+  partial pivoting reports it as NaN downstream), and the floor has to
+  live BELOW any physical row magnitude while staying representable in
+  the width the scale is computed in.  Before the ladder this constant
+  was duplicated (and dtype-switched by hand) at both call sites;
+  :func:`equilibration_eps` is now the single source.
+
+- the **factorization widths** the ladder can drop to
+  (``RAFT_TPU_PRECISION_WIDTH``): f32 is the TPU-native fast path;
+  bf16 shares f32's 8-bit exponent (so the same underflow floor
+  applies) and is the aggressive rung for pipelines already running
+  at f32.
+
+- the **promotion predicate** (:func:`promotion_mask`): which lanes
+  the full-width second pass must re-solve.  All three ladder sites
+  (the plain and fused Pallas kernels, the batch-first jnp twin) share
+  it, so the NaN-safety contract cannot silently diverge between them.
+
+No jax transforms — importable from kernel modules without dragging in
+the dispatch layer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: factorization widths the ladder supports, by RAFT_TPU_PRECISION_WIDTH
+#: value.  Key insert order is narrow->wide-ish irrelevant; lookup only.
+FACTOR_WIDTHS = {
+    "f32": jnp.float32,
+    "bf16": jnp.bfloat16,
+}
+
+
+def equilibration_eps(dtype) -> float:
+    """Underflow floor for the row-equilibration scale ``1/max|row|``.
+
+    float64 has ~1e-308 of normal range: 1e-300 leaves the scale finite
+    for any physical row while flooring a numerically-zero one.
+    float32 and bfloat16 share the same 8-bit exponent field (min
+    normal ~1.2e-38): 1e-30 is the equivalent floor with margin for the
+    subsequent multiply."""
+    if jnp.dtype(dtype) == jnp.float64:
+        return 1e-300
+    return 1e-30
+
+
+def factor_dtype(width: str):
+    """Resolve a ``RAFT_TPU_PRECISION_WIDTH`` name to the jnp dtype the
+    ladder factorizes in; unknown names fall back to f32 (the
+    conservative rung — never silently *wider* than asked)."""
+    return FACTOR_WIDTHS.get(str(width).strip().lower(), jnp.float32)
+
+
+def narrows(factor, solve_dtype) -> bool:
+    """True when ``factor`` is a strictly lower width than the solve
+    dtype — i.e. the mixed ladder has an actual low rung to drop to.
+    (f32 inputs with a requested f32 factor width degenerate to the
+    native solve; the dispatch records that fact.)"""
+    return jnp.dtype(factor).itemsize < jnp.dtype(solve_dtype).itemsize
+
+
+def promotion_mask(rn, tol):
+    """Per-lane promotion predicate of the mixed ladder: ``(mask,
+    promoted_count)`` for a vector of final relative residuals.
+
+    Negated CONVERGED, not ``rn > tol``: a lane whose low-width
+    elimination overflowed carries a NaN residual, and ``nan > tol``
+    is False — the broken lane must promote, not slip through."""
+    mask = ~(rn <= tol)
+    return mask, jnp.sum(mask.astype(jnp.int32))
+
+
+def width_name(dtype) -> str:
+    """Short ladder name of a real dtype ("f64" / "f32" / "bf16")."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.float64:
+        return "f64"
+    if dt == jnp.dtype(jnp.bfloat16):
+        return "bf16"
+    if dt == jnp.float32:
+        return "f32"
+    return str(dt)

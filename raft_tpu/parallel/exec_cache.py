@@ -164,6 +164,12 @@ def _env_facts() -> dict:
             # executable traced under one RAFT_TPU_PALLAS mode must not
             # be served under another
             "pallas": _config.pallas_mode(),
+            # the precision ladder is likewise baked in at trace time: a
+            # mixed-ladder program must never be served for an f64
+            # request (nor across factor widths / promotion tolerances)
+            "precision": _config.precision_mode(),
+            "precision_width": _config.precision_width(),
+            "precision_tol": _config.precision_tol(),
             "raft": getattr(raft_tpu, "__version__", "unknown"),
             "git": sha}
 

@@ -162,7 +162,7 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
                   else jnp.zeros((6, 6), dtype=_config.real_dtype()))
 
         S = jonswap(w, Hs, Tp)
-        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(_config.complex_dtype())
         seastate = dict(beta=jnp.asarray(beta)[None], zeta=zeta[None])
         exc = fowt_hydro_excitation(fowt, pose, seastate, hc)
         F_BEM = fowt_bem_excitation(fowt, seastate)[0]
@@ -205,7 +205,7 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
             _, _, ii, done = carry
             return (ii < nIter) & (~done)
 
-        Xi0 = jnp.zeros((6, nw), dtype=complex) + XiStart
+        Xi0 = jnp.zeros((6, nw), dtype=_config.complex_dtype()) + XiStart
         _, Xi, _, _ = jax.lax.while_loop(cond, body, (Xi0, Xi0, 0, False))
         std = jax.vmap(lambda row: get_rms(row))(Xi)
         return dict(Xi=Xi, std=std)
@@ -224,9 +224,10 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         st = jax.vmap(setup)(Hs, Tp, beta)
         nc = Hs.shape[0]
         if Xi0 is None:
-            Xi0 = jnp.zeros((nc, 6, nw), dtype=complex) + XiStart
+            Xi0 = jnp.zeros((nc, 6, nw),
+                            dtype=_config.complex_dtype()) + XiStart
         else:
-            Xi0 = jnp.asarray(Xi0, dtype=complex)
+            Xi0 = jnp.asarray(Xi0, dtype=_config.complex_dtype())
         if partition.has_freq_axis(mesh):
             # statics->dynamics phase boundary: the ONE place the
             # layout changes — impedance/excitation stacks pick up the
@@ -324,7 +325,8 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
     dtype = _config.real_dtype()
 
     def _cold_seed():
-        return jnp.full((ncases, 6, nw), xistart, dtype=complex)
+        return jnp.full((ncases, 6, nw), xistart,
+                        dtype=_config.complex_dtype())
 
     def _place(Hs, Tp, beta):
         if mesh is None:
@@ -386,7 +388,7 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
                               jnp.asarray(beta, dtype))
         if warm_start:
             seed = (_cold_seed() if Xi0 is None
-                    else jnp.asarray(Xi0, dtype=complex))
+                    else jnp.asarray(Xi0, dtype=_config.complex_dtype()))
             call_args = (Hs, Tp, beta, seed)
         else:
             call_args = (Hs, Tp, beta)

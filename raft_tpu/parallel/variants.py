@@ -290,7 +290,7 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
         pose_eq = fowt_pose(fowt, Xeq)
 
         S = jonswap(w, Hs, Tp)
-        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(_config.complex_dtype())
         seastate = dict(beta=jnp.asarray(beta)[None], zeta=zeta[None])
         exc = fowt_hydro_excitation(fowt, pose_eq, seastate, hc)
         u0 = exc["u"][0]
@@ -344,7 +344,7 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
             _, _, ii, done = carry
             return (ii < nIter + 1) & (~done)
 
-        Xi0 = jnp.zeros((6, nw), dtype=complex) + XiStart
+        Xi0 = jnp.zeros((6, nw), dtype=_config.complex_dtype()) + XiStart
         _, Xi, _, _ = jax.lax.while_loop(cond, body, (Xi0, Xi0, 0, False))
         return _finish(st, Xi)
 
@@ -361,7 +361,8 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
 
         st = jax.vmap(setup)(thetas)
         nv = st["Xeq"].shape[0]
-        Xi0 = jnp.zeros((nv, 6, nw), dtype=complex) + XiStart
+        Xi0 = jnp.zeros((nv, 6, nw),
+                        dtype=_config.complex_dtype()) + XiStart
         if partition.has_freq_axis(mesh):
             # statics->dynamics boundary: reshard the impedance/
             # excitation stacks onto the frequency axis (rule-matched)

@@ -1,0 +1,436 @@
+"""Mixed-precision solve ladder: config knobs, in-kernel numerics,
+per-lane promotion, dispatch-fact recording, and exec-cache identity.
+
+The ladder contract (docs/performance.md "Layer 6"): under
+``RAFT_TPU_PRECISION=mixed`` the factorization runs at a low width
+(f32 default, bf16 opt-in) while the refinement residual and correction
+accumulate at the full input width inside the kernel; lanes whose final
+relative residual exceeds the promotion tolerance are re-solved at the
+full width in a second pass.  Accuracy is therefore f64-level no matter
+how the low rung behaves — the promotion mask, not hope, carries the
+guarantee — and every solve records which rung it ran on
+(``linalg.last_dispatch()``) so manifests and the exec-cache key can
+tell a mixed program from an f64 one.
+"""
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import _config
+from raft_tpu.ops import linalg as L
+from raft_tpu.ops import precision as prec
+from raft_tpu.ops.pallas.gj_solve import gj_solve, impedance_gj_solve
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+@pytest.fixture(autouse=True)
+def _clear_overrides():
+    """Precision/pallas overrides are process-global; never leak them."""
+    yield
+    _config.set_precision_mode(None)
+    _config.set_precision_width(None)
+    _config.set_pallas_mode(None)
+
+
+def _rel(a, b):
+    return np.max(np.abs(a - b) / np.maximum(np.abs(b), 1e-12))
+
+
+def _dev(a, b):
+    """Max deviation normalized by the reference's own peak — the
+    ledger-style measure, immune to near-zero elements."""
+    return np.max(np.abs(np.asarray(a) - np.asarray(b))) \
+        / np.max(np.abs(np.asarray(b)))
+
+
+def _ill_conditioned(rng, A, lanes, cond=1e9):
+    """Rewrite the first ``lanes`` systems to a prescribed condition
+    number via their SVD — the f32 rung cannot refine these below the
+    default tolerance, so they MUST promote."""
+    n = A.shape[-1]
+    for i in range(lanes):
+        U, _, Vt = np.linalg.svd(A[i])
+        A[i] = (U * np.geomspace(1.0, 1.0 / cond, n)) @ Vt
+    return A
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+# ---------------------------------------------------------------------------
+
+def test_precision_mode_env(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_PRECISION", raising=False)
+    assert _config.precision_mode() == "f64"          # default
+    monkeypatch.setenv("RAFT_TPU_PRECISION", "mixed")
+    assert _config.precision_mode() == "mixed"
+    monkeypatch.setenv("RAFT_TPU_PRECISION", "bogus")
+    assert _config.precision_mode() == "f64"          # unknown -> default
+    _config.set_precision_mode("f32")                 # override beats env
+    assert _config.precision_mode() == "f32"
+    with pytest.raises(ValueError):
+        _config.set_precision_mode("f16")
+
+
+def test_precision_width_and_tol_env(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_PRECISION_WIDTH", raising=False)
+    monkeypatch.delenv("RAFT_TPU_PRECISION_TOL", raising=False)
+    assert _config.precision_width() == "f32"
+    monkeypatch.setenv("RAFT_TPU_PRECISION_WIDTH", "bf16")
+    assert _config.precision_width() == "bf16"
+    monkeypatch.setenv("RAFT_TPU_PRECISION_WIDTH", "f8")
+    assert _config.precision_width() == "f32"         # unknown -> f32
+    with pytest.raises(ValueError):
+        _config.set_precision_width("f8")
+    assert _config.precision_tol() == 1e-9            # default
+    monkeypatch.setenv("RAFT_TPU_PRECISION_TOL", "1e-6")
+    assert _config.precision_tol() == 1e-6
+    monkeypatch.setenv("RAFT_TPU_PRECISION_TOL", "not-a-number")
+    assert _config.precision_tol() == 1e-9
+
+
+def test_shared_precision_helpers():
+    """One underflow-floor source for both GJ implementations
+    (dedupe satellite): dtype-aware, bf16 shares f32's exponent."""
+    assert prec.equilibration_eps(jnp.float64) == 1e-300
+    assert prec.equilibration_eps(jnp.float32) == 1e-30
+    assert prec.equilibration_eps(jnp.bfloat16) == 1e-30
+    assert prec.factor_dtype("f32") == jnp.float32
+    assert prec.factor_dtype("bf16") == jnp.bfloat16
+    assert prec.factor_dtype("nonsense") == jnp.float32
+    assert prec.narrows(jnp.float32, jnp.float64)
+    assert not prec.narrows(jnp.float32, jnp.float32)
+    assert prec.narrows(jnp.bfloat16, jnp.float32)
+    assert prec.width_name(jnp.float64) == "f64"
+    assert prec.width_name(jnp.float32) == "f32"
+    assert prec.width_name(jnp.bfloat16) == "bf16"
+
+
+# ---------------------------------------------------------------------------
+# in-kernel ladder numerics (interpret mode on this CPU backend)
+# ---------------------------------------------------------------------------
+
+def test_mixed_kernel_reaches_f64_accuracy(rng):
+    """f32 factorization + in-kernel f64 refinement must land at
+    f64-level accuracy on well-conditioned systems — and beat a pure
+    f32 solve by orders of magnitude."""
+    n, B = 12, 256
+    A = rng.standard_normal((B, n, n)) + 5.0 * np.eye(n)
+    b = rng.standard_normal((B, n, 2))
+    truth = np.linalg.solve(A, b)
+    xm, st = gj_solve(jnp.asarray(A), jnp.asarray(b), refine=2,
+                      precision="mixed", promote_tol=1e-9,
+                      return_stats=True)
+    err_mixed = _rel(np.asarray(xm), truth)
+    x32 = gj_solve(jnp.asarray(A, jnp.float32), jnp.asarray(b, jnp.float32))
+    err_f32 = _rel(np.asarray(x32, np.float64), truth)
+    assert err_mixed < 1e-10
+    assert err_mixed < err_f32 / 100.0
+    assert int(np.asarray(st["promoted"])) == 0       # nothing promoted
+    assert st["lanes"] == B
+    assert float(np.asarray(st["resid_max"])) < 1e-9
+
+
+def test_mixed_kernel_promotes_ill_lanes(rng):
+    """Lanes the f32 rung cannot refine below tolerance are re-solved
+    at f64 — the count is exact and the OUTPUT of promoted lanes
+    matches the full-f64 solve, while untouched lanes keep their
+    mixed-ladder values."""
+    n, B, ill = 8, 64, 9
+    A = rng.standard_normal((B, n, n)) + 5.0 * np.eye(n)
+    A = _ill_conditioned(rng, A, ill)
+    b = rng.standard_normal((B, n, 1))
+    x, st = gj_solve(jnp.asarray(A), jnp.asarray(b), refine=2,
+                     precision="mixed", promote_tol=1e-9,
+                     return_stats=True)
+    assert int(np.asarray(st["promoted"])) == ill
+    assert float(np.asarray(st["resid_max"])) > 1e-9  # the signal fired
+    xf64 = np.asarray(gj_solve(jnp.asarray(A), jnp.asarray(b), refine=2))
+    # promoted lanes ran the identical full-width path
+    assert_allclose(np.asarray(x)[:ill], xf64[:ill], rtol=1e-12, atol=0)
+    # and the whole batch satisfies the original systems
+    r = np.abs(np.einsum("bij,bjk->bik", A, np.asarray(x)) - b)
+    assert np.max(r) / np.max(np.abs(b)) < 1e-6
+
+
+def test_mixed_kernel_bf16_rung_still_accurate(rng):
+    """The aggressive bf16 rung: whatever the 8-bit mantissa does to
+    convergence, promotion guarantees the contract — output error stays
+    ledger-grade."""
+    n, B = 8, 128
+    A = rng.standard_normal((B, n, n)) + 6.0 * np.eye(n)
+    b = rng.standard_normal((B, n, 1))
+    x, st = gj_solve(jnp.asarray(A), jnp.asarray(b), refine=2,
+                     precision="mixed", factor_dtype=jnp.bfloat16,
+                     promote_tol=1e-9, return_stats=True)
+    assert _dev(np.asarray(x), np.linalg.solve(A, b)) < 1e-7
+    assert st["lanes"] == B
+
+
+def test_mixed_fused_impedance_parity(rng):
+    """The fused impedance kernel's mixed ladder against its own f64
+    path — same assembly, same physics, low-width elimination."""
+    nc, n, nw = 4, 6, 9
+    w = np.linspace(0.2, 1.5, nw)
+    M = rng.standard_normal((nc, n, n, nw)) + 5.0 * np.eye(n)[None, :, :, None]
+    B = 0.1 * rng.standard_normal((nc, n, n, nw))
+    C = rng.standard_normal((nc, n, n)) + 10.0 * np.eye(n)
+    F = rng.standard_normal((nc, n, nw)) + 1j * rng.standard_normal((nc, n, nw))
+    Xref = np.asarray(impedance_gj_solve(w, M, B, C, F))
+    Xm, st = impedance_gj_solve(w, M, B, C, F, refine=2, precision="mixed",
+                                promote_tol=1e-9, return_stats=True)
+    assert _rel(np.asarray(Xm), Xref) < 1e-10
+    assert int(np.asarray(st["promoted"])) == 0
+    assert st["lanes"] == nc * nw
+
+
+def test_unknown_precision_raises_typed():
+    from raft_tpu import errors
+
+    A = jnp.eye(4)[None]
+    b = jnp.ones((1, 4, 1))
+    with pytest.raises(errors.ModelConfigError):
+        gj_solve(A, b, precision="f16")
+    with pytest.raises(errors.ModelConfigError):
+        impedance_gj_solve(jnp.ones(1), jnp.zeros((4, 4, 1)),
+                           jnp.zeros((4, 4, 1)), jnp.eye(4),
+                           jnp.ones((4, 1)) + 0j, precision="f16")
+
+
+def test_gj_solve_under_jit_with_stats(rng):
+    """The stats are traced scalars — the mixed path must be jittable
+    end to end (the dynamics hot path calls it inside jit)."""
+    n, B = 8, 130                                     # off-tile padding
+    A = rng.standard_normal((B, n, n)) + 5.0 * np.eye(n)
+    b = rng.standard_normal((B, n, 1))
+
+    fn = jax.jit(lambda a, r: gj_solve(a, r, refine=2, precision="mixed",
+                                       promote_tol=1e-9,
+                                       return_stats=True))
+    x, st = fn(jnp.asarray(A), jnp.asarray(b))
+    assert _rel(np.asarray(x), np.linalg.solve(A, b)) < 1e-10
+    assert int(np.asarray(st["promoted"])) == 0
+
+
+# ---------------------------------------------------------------------------
+# dispatch recording: the RAFT_TPU_PALLAS x RAFT_TPU_PRECISION matrix
+# ---------------------------------------------------------------------------
+
+def _impedance_case(rng, nc=3, n=6, nw=7):
+    w = np.linspace(0.3, 1.2, nw)
+    M = rng.standard_normal((nc, n, n, nw)) + 5.0 * np.eye(n)[None, :, :, None]
+    B = 0.1 * rng.standard_normal((nc, n, n, nw))
+    C = rng.standard_normal((nc, n, n)) + 10.0 * np.eye(n)
+    F = rng.standard_normal((nc, n, nw)) + 1j * rng.standard_normal((nc, n, nw))
+    return w, M, B, C, F
+
+
+@pytest.mark.parametrize("pallas", ["0", "1"])
+@pytest.mark.parametrize("mode", ["f64", "mixed", "f32"])
+def test_dispatch_matrix_records_precision_facts(rng, pallas, mode):
+    """Every (RAFT_TPU_PALLAS, RAFT_TPU_PRECISION) combination must
+    solve correctly AND record the precision facts manifests and the
+    exec-cache key rely on."""
+    w, M, B, C, F = _impedance_case(rng)
+    Xref = np.asarray(L.impedance_solve(w, M, B, C, F))  # ambient f64
+    _config.set_pallas_mode(pallas)
+    _config.set_precision_mode(mode)
+    X = np.asarray(L.impedance_solve(w, M, B, C, F))
+    d = L.last_dispatch()
+    assert d["precision"] == mode
+    if pallas == "1":
+        assert d["backend"] == "pallas_fused" and d["fused"]
+    else:
+        assert d["backend"] in ("lu", "jnp_gj")
+    if mode == "mixed":
+        assert d["solve_width"] == "f64"
+        assert d["factor_width"] == "f32"
+        assert d["promote_tol"] == _config.precision_tol()
+        assert _dev(X, Xref) < 1e-9                   # under the ledger bar
+    elif mode == "f32":
+        assert d["solve_width"] == "f32"
+        assert d["factor_width"] is None
+        assert _dev(X, Xref) < 1e-4                   # the explicit rung
+    else:
+        assert d["solve_width"] == "f64"
+        assert d["factor_width"] is None
+        assert_allclose(X, Xref, rtol=1e-12)
+    assert X.dtype == Xref.dtype                      # width restored
+
+
+def test_mixed_degenerates_on_f32_inputs_recorded(rng):
+    """A mixed request whose factor width is not strictly below the
+    input width degenerates to the native solve — recorded, never
+    silent."""
+    n, B = 6, 20
+    A = (rng.standard_normal((B, n, n)) + 4.0 * np.eye(n)
+         + 1j * 0.1 * rng.standard_normal((B, n, n))).astype(np.complex64)
+    b = (rng.standard_normal((B, n)) + 0j).astype(np.complex64)
+    _config.set_precision_mode("mixed")
+    x = np.asarray(L.solve_complex(jnp.asarray(A), jnp.asarray(b)))
+    d = L.last_dispatch()
+    assert d["precision"] == "mixed"
+    assert d["factor_width"] is None                  # no lower rung
+    assert d.get("precision_degenerate") is True
+    assert x.dtype == np.complex64
+    assert _dev(np.einsum("bij,bj->bi", A, x), b) < 1e-4
+
+
+def test_dispatch_record_cleared_between_modes(rng):
+    """A later single-width dispatch must not keep wearing an earlier
+    mixed dispatch's precision facts (cleared, not merged)."""
+    w, M, B, C, F = _impedance_case(rng)
+    _config.set_precision_mode("mixed")
+    L.impedance_solve(w, M, B, C, F)
+    assert L.last_dispatch()["factor_width"] == "f32"
+    _config.set_precision_mode(None)
+    L.impedance_solve(w, M, B, C, F)
+    d = L.last_dispatch()
+    assert d["precision"] == "f64"
+    assert d["factor_width"] is None
+    assert "precision_degenerate" not in d
+
+
+def test_mixed_ladder_on_jnp_gj_backend(rng, monkeypatch):
+    """RAFT_TPU_PRECISION is honored on every RAFT_TPU_PALLAS rung —
+    here the jnp Gauss-Jordan backend (batch-first _mixed_ladder)."""
+    monkeypatch.setattr(L, "_use_pallas", lambda n, b: False)
+    monkeypatch.setattr(L, "_use_gauss_jordan", lambda n, b: True)
+    _config.set_precision_mode("mixed")
+    n, B = 6, 32
+    A = (rng.standard_normal((B, n, n)) + 4.0 * np.eye(n)
+         + 1j * 0.1 * rng.standard_normal((B, n, n)))
+    b = rng.standard_normal((B, n)) + 1j * rng.standard_normal((B, n))
+    x = np.asarray(L.solve_complex(jnp.asarray(A), jnp.asarray(b)))
+    assert L.last_dispatch()["backend"] == "jnp_gj"
+    assert L.last_dispatch()["factor_width"] == "f32"
+    assert _rel(np.einsum("bij,bj->bi", A, x), b) < 1e-10
+
+
+def test_mixed_ladder_on_lu_backend_promotes(rng):
+    """The LU rung's _mixed_ladder with genuinely ill-conditioned lanes:
+    promotion re-solves them at the full width."""
+    _config.set_pallas_mode("0")
+    _config.set_precision_mode("mixed")
+    n, B, ill = 8, 24, 5
+    Ar = rng.standard_normal((B, n, n)) + 5.0 * np.eye(n)
+    Ar = _ill_conditioned(rng, Ar, ill, cond=1e8)
+    A = Ar + 0j
+    b = rng.standard_normal((B, n)) + 0j
+    x = np.asarray(L.solve_complex(jnp.asarray(A), jnp.asarray(b)))
+    assert L.last_dispatch()["backend"] == "lu"
+    xref = np.linalg.solve(A, b[..., None])[..., 0]
+    assert _dev(x, xref) < 1e-6
+
+
+def test_mixed_ladder_on_lu_backend_bf16_width(rng):
+    """LAPACK LU has no bf16 kernel: the LU cell's bf16 low rung must
+    route through the jnp Gauss-Jordan core instead of crashing at
+    trace time — and promotion still guarantees the contract."""
+    _config.set_pallas_mode("0")
+    _config.set_precision_mode("mixed")
+    _config.set_precision_width("bf16")
+    n, B = 6, 16
+    A = (rng.standard_normal((B, n, n)) + 6.0 * np.eye(n)) + 0j
+    b = rng.standard_normal((B, n)) + 0j
+    x = np.asarray(L.solve_complex(jnp.asarray(A), jnp.asarray(b)))
+    d = L.last_dispatch()
+    assert d["backend"] == "lu"
+    assert d["factor_width"] == "bf16"
+    xref = np.linalg.solve(A, b[..., None])[..., 0]
+    assert _dev(x, xref) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# exec-cache identity: a mixed program is never served for an f64 request
+# ---------------------------------------------------------------------------
+
+def test_exec_cache_key_distinct_per_precision_mode():
+    from raft_tpu.parallel import exec_cache
+
+    def key():
+        return exec_cache.make_key(fn="sweep_cases", model="sha256:aa",
+                                   nw=10)
+
+    base = key()
+    assert base == key()                              # stable
+    _config.set_precision_mode("mixed")
+    k_mixed = key()
+    _config.set_precision_width("bf16")
+    k_bf16 = key()
+    _config.set_precision_width(None)
+    _config.set_precision_mode("f32")
+    k_f32 = key()
+    _config.set_precision_mode(None)
+    assert len({base, k_mixed, k_bf16, k_f32}) == 4
+
+
+def test_exec_cache_key_distinct_per_promote_tol(monkeypatch):
+    from raft_tpu.parallel import exec_cache
+
+    _config.set_precision_mode("mixed")
+    k1 = exec_cache.make_key(fn="sweep_cases", model="sha256:aa", nw=10)
+    monkeypatch.setenv("RAFT_TPU_PRECISION_TOL", "1e-7")
+    k2 = exec_cache.make_key(fn="sweep_cases", model="sha256:aa", nw=10)
+    assert k1 != k2
+
+
+@pytest.fixture(scope="module")
+def fowt():
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.models.fowt import build_fowt
+
+    design = load_design("OC3spar")
+    w = np.arange(0.05, 0.25, 0.05) * 2 * np.pi     # 4 coarse bins
+    return build_fowt(design, w,
+                      depth=float(design["site"]["water_depth"]))
+
+
+def test_sweep_warm_hit_per_precision_mode(fowt, tmp_path, monkeypatch):
+    """Acceptance: per-mode cache identity end to end.  An f64 sweep and
+    a mixed sweep each cold-compile their OWN executable (the mixed
+    request must not be served the f64 program, nor vice versa), and
+    each re-run is a span-asserted warm hit that skips lower+compile."""
+    from raft_tpu import obs
+    from raft_tpu.parallel import exec_cache
+    from raft_tpu.parallel.sweep import sweep_cases
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    Hs = np.array([3.0, 6.0])
+    Tp = np.array([8.0, 10.0])
+    beta = np.zeros(2)
+
+    out_f64 = sweep_cases(fowt, Hs, Tp, beta, nIter=2)
+    assert exec_cache.stats()["misses"] == 1          # f64 cold
+
+    _config.set_precision_mode("mixed")
+    obs.reset_all()
+    out_mixed = sweep_cases(fowt, Hs, Tp, beta, nIter=2)
+    st = exec_cache.stats()
+    assert st["misses"] == 2 and st["hits"] == 0      # mixed is NOT f64
+    agg = obs.aggregate()
+    assert agg["sweep_lower"][1] == 1                 # really compiled
+
+    obs.reset_all()
+    sweep_cases(fowt, Hs, Tp, beta, nIter=2)          # mixed warm
+    agg = obs.aggregate()
+    assert "sweep_lower" not in agg and "sweep_compile" not in agg
+    assert exec_cache.stats()["hits"] == 1
+
+    _config.set_precision_mode(None)
+    obs.reset_all()
+    sweep_cases(fowt, Hs, Tp, beta, nIter=2)          # f64 warm
+    agg = obs.aggregate()
+    assert "sweep_lower" not in agg and "sweep_compile" not in agg
+    assert exec_cache.stats()["hits"] == 2
+
+    # physics: the mixed ladder holds the ledger bar on the real sweep
+    assert _dev(np.asarray(out_mixed["std"]),
+                np.asarray(out_f64["std"])) < 1e-6

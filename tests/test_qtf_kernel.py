@@ -1,0 +1,125 @@
+"""Fused QTF pair-grid Pallas kernel: parity vs the vmapped engine.
+
+``ops/pallas/qtf_pair.py`` re-tiles the dense (w1, w2) pair grid of
+``calc_qtf_slender_body`` — w2 on the TPU lane axis, every per-pair
+Pinkster/Rainey term VMEM-resident — and must change memory locality,
+never numerics.  These tests run the kernel in interpret mode (its only
+mode until the real/imag-split Mosaic port; see the module docstring)
+against the doubly-vmapped XLA path on the same model and pin the
+deviation at 1e-6, exactly like the gj_solve parity suite.
+"""
+import numpy as np
+import pytest
+
+from raft_tpu import _config
+from raft_tpu.models.fowt import build_fowt, fowt_pose, fowt_statics
+from raft_tpu.models import qtf as qt
+
+PARITY = 1e-6
+
+
+@pytest.fixture(autouse=True)
+def _clear_override():
+    yield
+    _config.set_qtf_kernel_mode(None)
+
+
+def _design(rB_z=10.0):
+    """Single-spar potSecOrder design; ``rB_z`` above water gives one
+    waterline-crossing member, below water gives none (the nm=0 kernel
+    branch)."""
+    return {
+        "site": {"water_depth": 200.0, "rho_water": 1025.0, "g": 9.81},
+        "platform": {
+            "potModMaster": 1,
+            "potSecOrder": 1,
+            "min_freq2nd": 0.04, "max_freq2nd": 0.12, "df_freq2nd": 0.02,
+            "members": [{
+                "name": "spar", "type": 2,
+                "rA": [0, 0, -20], "rB": [0, 0, rB_z],
+                "shape": "circ", "gamma": 0.0, "potMod": False,
+                "stations": [0, 0.5, 1], "d": [10.0, 8.0, 8.0],
+                "t": 0.05, "Cd": 0.6, "Ca": 0.97,
+                "CdEnd": 0.6, "CaEnd": 0.6, "rho_shell": 7850.0,
+                "dlsMax": 5.0,
+            }],
+        },
+    }
+
+
+def _qtf_both_paths(design, beta=0.0, with_motion=True):
+    """The full calc_qtf_slender_body through the vmapped path and the
+    fused kernel on identical inputs."""
+    w = np.arange(0.02, 0.25, 0.02) * 2 * np.pi
+    fowt = build_fowt(design, w, depth=200.0)
+    pose = fowt_pose(fowt, np.zeros(6))
+    kw = {}
+    if with_motion:
+        stat = fowt_statics(fowt, pose)
+        rng = np.random.default_rng(3)
+        Xi0 = (rng.normal(size=(6, len(w)))
+               + 1j * rng.normal(size=(6, len(w))))
+        Xi0[3:] *= 0.01
+        kw = dict(Xi0=Xi0, M_struc=np.asarray(stat["M_struc"]))
+    ref = np.asarray(qt.calc_qtf_slender_body(fowt, pose, beta, **kw))
+    _config.set_qtf_kernel_mode("1")
+    try:
+        got = np.asarray(qt.calc_qtf_slender_body(fowt, pose, beta, **kw))
+    finally:
+        _config.set_qtf_kernel_mode(None)
+    return ref, got
+
+
+def _dev(got, ref):
+    return np.max(np.abs(got - ref)) / np.max(np.abs(ref))
+
+
+def test_kernel_parity_waterline_member():
+    """Surface-piercing spar with first-order motion: every term group
+    active, including the waterline relative-elevation loop."""
+    ref, got = _qtf_both_paths(_design(rB_z=10.0))
+    assert got.shape == ref.shape == (5, 5, 6)
+    assert _dev(got, ref) < PARITY
+
+
+def test_kernel_parity_no_waterline_member():
+    """Fully submerged member (nm=0): the kernel variant without the
+    waterline input block."""
+    ref, got = _qtf_both_paths(_design(rB_z=-5.0))
+    assert _dev(got, ref) < PARITY
+
+
+def test_kernel_parity_no_motion():
+    """Xi0=None (diffraction-only QTF): the zero-motion degenerate the
+    model uses before the first RAO is available."""
+    ref, got = _qtf_both_paths(_design(rB_z=10.0), with_motion=False)
+    assert _dev(got, ref) < PARITY
+
+
+def test_kernel_parity_off_zero_heading():
+    """beta != 0 exercises the heading-dependent wave kinematics the
+    kernel receives precomputed."""
+    ref, got = _qtf_both_paths(_design(rB_z=10.0), beta=0.35)
+    assert _dev(got, ref) < PARITY
+
+
+def test_kernel_output_hermitian():
+    """The kernel feeds the same Hermitian completion as the vmapped
+    path — the completed QTF must stay Hermitian per DOF."""
+    _, got = _qtf_both_paths(_design(rB_z=10.0))
+    for i in range(6):
+        np.testing.assert_allclose(got[:, :, i], np.conj(got[:, :, i]).T,
+                                   rtol=1e-12, atol=1e-10)
+
+
+def test_qtf_kernel_mode_env(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_QTF_KERNEL", raising=False)
+    assert _config.qtf_kernel_mode() == "auto"
+    monkeypatch.setenv("RAFT_TPU_QTF_KERNEL", "1")
+    assert _config.qtf_kernel_mode() == "1"
+    monkeypatch.setenv("RAFT_TPU_QTF_KERNEL", "bogus")
+    assert _config.qtf_kernel_mode() == "auto"
+    _config.set_qtf_kernel_mode("0")                  # override beats env
+    assert _config.qtf_kernel_mode() == "0"
+    with pytest.raises(ValueError):
+        _config.set_qtf_kernel_mode("2")

@@ -581,7 +581,8 @@ class RTL003:
     def check(self, mod, opts):
         device_modules = opts.get("device-modules",
                                   ["raft_tpu/ops", "raft_tpu/parallel",
-                                   "raft_tpu/model.py"])
+                                   "raft_tpu/model.py",
+                                   "raft_tpu/models/qtf.py"])
         if not _prefix_match(mod.relpath, device_modules):
             return
         aliases = _aliases(mod)
@@ -600,6 +601,36 @@ class RTL003:
                             "(e.g. _config.real_dtype()/complex_dtype(),"
                             " jnp.int32) so the precision ladder stays "
                             "auditable")
+                # bare builtin `complex` as a dtype: `.astype(complex)`
+                # and `dtype=complex` silently canonicalize per the
+                # ambient x64 flag — on the device hot path the complex
+                # width must come from _config.complex_dtype() so the
+                # precision ladder governs it in one place
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype"
+                        and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id == "complex"):
+                    yield mod.finding(
+                        self.code, node,
+                        "bare `.astype(complex)` in a device-code "
+                        "module — pin to "
+                        "`.astype(_config.complex_dtype())` so the "
+                        "precision ladder governs the complex width")
+                for kw in node.keywords:
+                    if (kw.arg == "dtype"
+                            and isinstance(kw.value, ast.Name)
+                            and kw.value.id == "complex"):
+                        # anchor on the literal itself so multi-line
+                        # calls pin/suppress on the line that reads
+                        # `dtype=complex`
+                        yield mod.finding(
+                            self.code, kw.value,
+                            "bare `dtype=complex` in a device-code "
+                            "module — pin to "
+                            "`dtype=_config.complex_dtype()` so the "
+                            "precision ladder governs the complex "
+                            "width")
             elif isinstance(node, ast.Attribute):
                 canon = _canonical(_dotted(node), aliases)
                 if canon.startswith("numpy.") and \

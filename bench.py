@@ -972,8 +972,180 @@ def _serve_bench_main() -> int:
     return 0 if report["ok"] else 1
 
 
+def optimize_bench(*, design=None, bounds=None, objective=None,
+                   grid=None, nlanes=None, steps=None, method="adam",
+                   lr=None, min_freq=None, max_freq=None, dfreq=None,
+                   nIter=None, tol=1e-4, seed=2026):
+    """Benchmark + golden-gate the differentiable co-design loop
+    (``parallel/optimize.py``) against the dense forward sweep.
+
+    Two runs over the SAME design box:
+
+    1. **Dense forward sweep** — a ``grid^P`` θ batch through
+       ``sweep_variants`` (the repo's headline forward machinery), its
+       per-variant objective evaluated host-side, its argmin the
+       reference optimum.
+    2. **Batched descent** — ``nlanes`` simultaneous implicit-diff
+       projected descents (``optimize_designs``) over the same bounds.
+
+    The GATE: the descent's best objective must land within tolerance
+    of (or beat) the dense argmin, and the best design must sit within
+    one grid spacing of the dense argmin per dimension — gradients that
+    lie produce a wrong optimum, so this is an end-to-end gradient
+    correctness gate, not just a throughput number.
+
+    Facts (``bench_optimize`` manifest -> trend store): descents/min,
+    adjoint-solve s/step, speedup-vs-dense-sweep (wall ratio to the
+    same argmin), and ``grad_nonfinite_ratio`` (SLO rule: must be 0).
+    Knobs: ``RAFT_BENCH_OPT_{DESIGN,GRID,LANES,STEPS,NITER}``.
+
+    Runs under the scoped x64 enable (``_f64_scope``): this is an
+    accuracy gate like the golden ledgers, and the f32 throughput mode
+    the bench pins for TPU timing loses the adjoint chain's headroom
+    (catenary/statics reverse passes square ~1e9 stiffness terms)."""
+    import contextlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu import obs
+    from raft_tpu.parallel import optimize as optmod
+    from raft_tpu.parallel.variants import sweep_variants
+    from raft_tpu.serve.soak import build_fowt
+
+    x64, dev = _f64_scope()
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(x64)
+        stack.enter_context(dev)
+        return _optimize_bench_body(
+            design, bounds, objective, grid, nlanes, steps, method, lr,
+            min_freq, max_freq, dfreq, nIter, tol, seed, jax, jnp, obs,
+            optmod, sweep_variants, build_fowt)
+
+
+def _optimize_bench_body(design, bounds, objective, grid, nlanes, steps,
+                         method, lr, min_freq, max_freq, dfreq, nIter,
+                         tol, seed, jax, jnp, obs, optmod,
+                         sweep_variants, build_fowt):
+
+    # one precedence rule for EVERY knob (the serve bench's): an
+    # explicit argument wins, the RAFT_BENCH_OPT_* env var is the
+    # default, the literal is the fallback
+    def _knob(value, env, fallback, cast):
+        return cast(value if value is not None
+                    else os.environ.get(env, fallback))
+
+    design = _knob(design, "RAFT_BENCH_OPT_DESIGN", "OC3spar", str)
+    min_freq = _knob(min_freq, "RAFT_BENCH_OPT_MIN_FREQ", 0.1, float)
+    max_freq = _knob(max_freq, "RAFT_BENCH_OPT_MAX_FREQ", 0.9, float)
+    dfreq = _knob(dfreq, "RAFT_BENCH_OPT_DFREQ", 0.2, float)
+    grid = _knob(grid, "RAFT_BENCH_OPT_GRID", 5, int)
+    nlanes = _knob(nlanes, "RAFT_BENCH_OPT_LANES", 4, int)
+    steps = _knob(steps, "RAFT_BENCH_OPT_STEPS", 10, int)
+    nIter = _knob(nIter, "RAFT_BENCH_OPT_NITER", 8, int)
+    adjoint_iters = _knob(None, "RAFT_BENCH_OPT_ADJ", nIter, int)
+    lr = _knob(lr, "RAFT_BENCH_OPT_LR", 0.05, float)
+    if bounds is None:
+        bounds = {"ballast": (0.95, 1.05), "moor_L": (0.98, 1.02)}
+    objective = dict(objective or {"metric": "std", "Hs": 6.0,
+                                   "Tp": 10.0})
+    base = build_fowt(design, min_freq, max_freq, dfreq)
+    space = optmod.DesignSpace(base, bounds)
+    fn, spec = optmod.make_objective(objective)
+    w = jnp.asarray(base.w)
+    manifest = obs.RunManifest.begin(kind="bench_optimize", config={
+        "design": design, "grid": grid, "nlanes": nlanes,
+        "steps": steps, "method": method, "nw": len(base.w),
+        "objective": spec["metric"],
+        "names": ",".join(space.names)})
+    status = "failed"
+    try:
+        # ----- dense forward sweep over the grid -----
+        lo = np.asarray(space.lower)
+        hi = np.asarray(space.upper)
+        axes = [np.linspace(lo[i], hi[i], grid)
+                for i in range(space.ndim)]
+        gx = np.stack(np.meshgrid(*axes, indexing="ij"),
+                      axis=-1).reshape(-1, space.ndim)
+        thetas = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *[space.to_theta(jnp.asarray(x)) for x in gx])
+        with obs.span("bench_opt_dense", nv=len(gx)):
+            t0 = time.perf_counter()
+            out = sweep_variants(base, thetas,
+                                 ballast=("ballast" not in space.names),
+                                 Hs=float(spec["Hs"]),
+                                 Tp=float(spec["Tp"]),
+                                 beta=float(spec["beta"]),
+                                 nIter=nIter, tol=tol)
+            dense_f = np.asarray(jax.vmap(lambda o: fn(o, w))(
+                {k: out[k] for k in ("Xi", "std", "Xeq", "offset")}))
+            dense_s = time.perf_counter() - t0
+        ibest = int(np.nanargmin(dense_f))
+        x_dense = gx[ibest]
+        f_dense = float(dense_f[ibest])
+        # ----- batched implicit-diff descent over the same box -----
+        with obs.span("bench_opt_descend", nlanes=nlanes):
+            t0 = time.perf_counter()
+            res = optmod.optimize_designs(
+                base, space, objective, nlanes=nlanes, steps=steps,
+                method=method, lr=lr, seed=seed, nIter=nIter, tol=tol,
+                adjoint_iters=adjoint_iters)
+            descent_s = time.perf_counter() - t0
+        spacing = (hi - lo) / max(1, grid - 1)
+        design_gap = np.abs(np.asarray(res["x_best"]) - x_dense)
+        # objective tolerance: the fixed points converge to ``tol`` —
+        # a few tol of relative slack separates gradient lies from
+        # solver-tolerance noise
+        obj_tol = max(5.0 * tol * max(abs(f_dense), 1e-12), 1e-10)
+        argmin_match = bool(
+            (res["f_best"] <= f_dense + obj_tol)
+            and np.all(design_gap <= spacing + 1e-12))
+        nonfinite_ratio = float(np.mean(res["nonfinite"]))
+        facts = {
+            "descents_per_min": round(nlanes / descent_s * 60.0, 3),
+            "adjoint_s_per_step": round(descent_s / steps, 4),
+            "speedup_vs_dense_sweep": round(dense_s / descent_s, 4),
+            "dense_points": int(len(gx)),
+            "dense_s": round(dense_s, 3),
+            "descent_s": round(descent_s, 3),
+            "f_best": float(res["f_best"]),
+            "f_dense_min": f_dense,
+            "objective_gap": float(res["f_best"] - f_dense),
+            "design_gap_max_spacing": float(
+                np.max(design_gap / np.maximum(spacing, 1e-12))),
+            "grad_nonfinite_ratio": nonfinite_ratio,
+            "converged_lanes": int(np.sum(res["converged"])),
+            "argmin_match": int(argmin_match),
+            "exec_cache": res["provenance"]["exec_cache"],
+        }
+        manifest.extra["bench_optimize"] = facts
+        manifest.extra["solver"] = res["provenance"]["solver"]
+        status = ("ok" if argmin_match and nonfinite_ratio == 0.0
+                  else "failed")
+        report = {"metric": "differentiable co-design gate "
+                            f"({design}: {grid}^{space.ndim} dense grid "
+                            f"vs {nlanes}x{steps} descent)",
+                  **facts,
+                  "x_best": [float(v) for v in res["x_best"]],
+                  "x_dense": [float(v) for v in x_dense],
+                  "ok": status == "ok"}
+    finally:
+        paths = obs.finish_run(manifest, status=status)
+    report["manifest"] = paths["manifest"]
+    return report
+
+
+def _optimize_bench_main() -> int:
+    report = optimize_bench()
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
 if __name__ == "__main__":
     import sys as _sys
     if len(_sys.argv) > 1 and _sys.argv[1] == "serve":
         raise SystemExit(_serve_bench_main())
+    if len(_sys.argv) > 1 and _sys.argv[1] == "optimize":
+        raise SystemExit(_optimize_bench_main())
     main()

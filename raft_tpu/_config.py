@@ -259,6 +259,15 @@ def set_statics_mode(mode: str | None):
     _statics_override = None if mode is None else str(mode)
 
 
+def statics_warm() -> bool:
+    """Ambient default for statics Newton warm-start seeding in
+    ``Model.analyzeCases`` (``RAFT_TPU_STATICS_WARM=1``).  Opt-in:
+    seeding changes iteration counts (and the accepted pose at
+    solver-tolerance level), so the golden-ledger gates run unseeded."""
+    return os.environ.get("RAFT_TPU_STATICS_WARM", "0").strip().lower() \
+        in ("1", "on", "true")
+
+
 # ---------------------------------------------------------------------------
 # on-device probe channel (obs/probes.py — live in-flight telemetry)
 # ---------------------------------------------------------------------------

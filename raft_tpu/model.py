@@ -535,24 +535,62 @@ class Model:
         Ucur = jnp.asarray(np.stack([
             st.get("moor_current") if st.get("moor_current") is not None
             else np.zeros(3) for st in self._state]))
-        if _config.statics_mode() == "host":
-            X, xf_arg, n_iters, residual = self._statics_newton_host(
-                X, xf_arg, F0s, K_hss, Ucur, db, tol)
-        else:
+
+        def run_newton(Xstart, xf0):
+            if _config.statics_mode() == "host":
+                return self._statics_newton_host(
+                    np.asarray(Xstart, float).copy(), xf0, F0s, K_hss,
+                    Ucur, db, tol)
             # device-resident lax.while_loop Newton: exactly ONE host
             # sync per statics solve, through the sanctioned counted
-            # exit point
+            # exit point.  X0/xf0 buffers are donated on accelerator
+            # backends — copy them so a guarded cold RE-solve (warm
+            # start rejected) never re-passes a donated buffer.
             newton = self._statics_newton_fn()
-            Xd, xfd, itd, resd = newton(jnp.asarray(X), xf_arg, F0s,
+            # jnp.array (copy=True by default) = an on-device copy, no
+            # host round-trip — the one-sync-per-solve budget holds
+            Xd, xfd, itd, resd = newton(jnp.array(Xstart),
+                                        jnp.array(xf0), F0s,
                                         K_hss, Ucur, jnp.asarray(db),
                                         jnp.asarray(tol))
-            X, xf_np, n_iters, residual = obs.transfers.device_get(
+            Xh, xf_np, n_it, res = obs.transfers.device_get(
                 (Xd, xfd, itd, resd), what="statics_newton",
                 phase="statics")
-            X = np.asarray(X, float)
-            xf_arg = jnp.asarray(xf_np)
-            n_iters = int(n_iters)
-            residual = float(residual)
+            return (np.asarray(Xh, float), jnp.asarray(xf_np),
+                    int(n_it), float(res))
+
+        # ----- statics Newton warm start (opt-in): seed from the
+        # previous case's converged pose instead of the reference
+        # position.  Guarded exactly like the serve tier's neighbor
+        # seeds: a seeded solve that fails to converge (or goes
+        # non-finite) triggers a counted cold re-solve from the
+        # reference start — seeding can cost one extra solve, never a
+        # wrong equilibrium.
+        seed = getattr(self, "_statics_seed", None)
+        seeded = (bool(getattr(self, "_statics_warm", False))
+                  and self._iCase is not None and seed is not None
+                  and np.shape(seed) == np.shape(X)
+                  and bool(np.all(np.isfinite(seed))))
+        xf0 = xf_arg
+        X, xf_arg, n_iters, residual = run_newton(
+            np.asarray(seed, float) if seeded else X, xf0)
+        if seeded:
+            ok = (bool(np.all(np.isfinite(X))) and np.isfinite(residual)
+                  and n_iters < self._NEWTON_MAX_ITERS)
+            outcome = "seeded" if ok else "rejected"
+            if not ok:
+                obs.events.emit("statics_warm_rejected",
+                                case=self._iCase, iters=n_iters)
+                X, xf_arg, n_iters, residual = run_newton(refs.copy(),
+                                                          xf0)
+            counts = getattr(self, "_statics_warm_counts", None)
+            if counts is not None:
+                counts[outcome] = counts.get(outcome, 0) + 1
+            obs.counter(
+                "raft_tpu_statics_warm_total",
+                "statics Newton warm-start outcomes in analyzeCases "
+                "(seeded = previous-case pose accepted; rejected = "
+                "guarded cold re-solve)").inc(outcome=outcome)
         # fault-injection seam + divergence screen: a Newton that walked
         # the pose into NaN/Inf (or an injected statics fault) surfaces
         # as a typed StaticsDivergence the degradation ladder can act on
@@ -563,6 +601,11 @@ class Model:
                 "statics Newton produced a non-finite pose",
                 case=self._iCase, iters=n_iters, residual=residual,
                 backend=_config.statics_mode())
+        if getattr(self, "_statics_warm", False) \
+                and n_iters < self._NEWTON_MAX_ITERS:
+            # converged pose becomes the next case's seed (DLC-shaped
+            # case tables walk the operating point smoothly)
+            self._statics_seed = np.asarray(X, float).copy()
         case_lbl = self._case_label()
         sp.set(newton_iters=n_iters, residual_norm=residual)
         obs.histogram(
@@ -1435,7 +1478,8 @@ class Model:
                             config or ServeConfig(**config_kw),
                             degraded_fowts=degraded)
 
-    def analyzeCases(self, display=0, RAO_plot=False, resume=False):
+    def analyzeCases(self, display=0, RAO_plot=False, resume=False,
+                     warm_statics=None):
         """Statics + dynamics + output statistics per load case.  Records
         nested spans (statics/dynamics/QTF/outputs phases), solver-health
         metrics, and a :class:`raft_tpu.obs.RunManifest` — kept on
@@ -1449,7 +1493,15 @@ class Model:
         remaining cases still run.  Completed cases are journaled (keyed
         by the model content digest) so ``resume=True`` after a crash or
         preemption re-runs only the missing/failed cases.  Set
-        ``RAFT_TPU_RECOVERY=0`` to restore fail-fast behavior."""
+        ``RAFT_TPU_RECOVERY=0`` to restore fail-fast behavior.
+
+        ``warm_statics`` (default: the ``RAFT_TPU_STATICS_WARM`` env
+        knob, off) seeds each case's statics Newton from the previous
+        case's converged pose — fewer iterations on DLC-shaped case
+        tables — with the serve-tier guard: a seeded solve that does
+        not converge triggers a counted cold re-solve.  Opt-in because
+        seeding shifts iteration counts (and poses at solver-tolerance
+        level), which the golden ledgers pin exactly."""
         obs.install_jax_hooks()
         obs.device.jit_cache_delta(scope="analyzeCases")   # baseline
         from raft_tpu.parallel import partition
@@ -1469,6 +1521,13 @@ class Model:
         self.failed_cases = []
         self._recovery_attempts = []
         self._resumed_cases = []
+        #: statics warm-start state (satellite of ROADMAP item 5): the
+        #: previous case's converged pose seeds the next case's Newton
+        self._statics_warm = bool(_config.statics_warm()
+                                  if warm_statics is None
+                                  else warm_statics)
+        self._statics_seed = None
+        self._statics_warm_counts = {}
         transfers0 = obs.transfers.snapshot()
         status = "failed"
         try:
@@ -1503,6 +1562,13 @@ class Model:
                                  for a in self._recovery_attempts]}
             if self._resumed_cases:
                 manifest.extra["resumed_cases"] = list(self._resumed_cases)
+            if self._statics_warm:
+                manifest.extra["statics_warm"] = {
+                    "seeded": self._statics_warm_counts.get("seeded", 0),
+                    "rejected": self._statics_warm_counts.get(
+                        "rejected", 0)}
+            self._statics_warm = False
+            self._statics_seed = None
             if status == "ok":
                 obs.device.collect(manifest, scope="analyzeCases")
                 ledger = obs.ledger_from_model(

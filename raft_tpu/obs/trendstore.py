@@ -213,6 +213,27 @@ def facts_from_manifest(doc: dict) -> dict:
                   "warm_start_digest_mismatch"):
             if _num(sbench.get(k)) is not None:
                 facts[f"serve_{k}"] = sbench[k]
+    # differentiable co-design facts (parallel/optimize.py +
+    # bench.py optimize): descent throughput, the gradient-health
+    # ratio (SLO rule: non-finite adjoints must be 0), and the
+    # dense-sweep-vs-descent gate facts
+    for section in ("optimize", "bench_optimize"):
+        opt = extra.get(section) or {}
+        if isinstance(opt, dict):
+            for k in ("nlanes", "steps", "converged",
+                      "grad_nonfinite", "grad_nonfinite_ratio",
+                      "f_best", "iters_max", "wall_s",
+                      "descents_per_min", "adjoint_s_per_step",
+                      "speedup_vs_dense_sweep", "dense_points",
+                      "objective_gap", "design_gap_max_spacing",
+                      "argmin_match", "converged_lanes"):
+                if _num(opt.get(k)) is not None:
+                    facts[f"optimize_{k}"] = opt[k]
+            if opt.get("method"):
+                facts["optimize_method"] = str(opt["method"])
+            if opt.get("exec_cache"):
+                facts["optimize_exec_cache_warm"] = int(
+                    opt["exec_cache"] == "hit")
     # duplicate-storm soak facts (serve/soak.py run_storm): ground-truth
     # integrity counts measured against the clean reference digests
     storm = extra.get("serve_storm") or {}
@@ -471,6 +492,14 @@ DEFAULT_SLO_RULES = [
     {"name": "solve_promoted_lane_ratio", "kind": "bench_kernels",
      "fact": "solve_promoted_lane_ratio", "agg": "max", "op": "<=",
      "threshold": 0.25, "window": 20},
+    # -- differentiable co-design gradient-health gate (parallel/
+    # optimize.py; fact present only on optimize/bench_optimize rows —
+    # ordinary runs skip).  A single lane whose adjoint goes non-finite
+    # is frozen + counted, never fatal; ANY non-zero ratio on a healthy
+    # benchmark model means the implicit-diff plumbing regressed.
+    {"name": "optimize_grad_nonfinite_ratio",
+     "fact": "optimize_grad_nonfinite_ratio", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
 ]
 
 _OPS = {

@@ -220,6 +220,22 @@ def _use_pallas(n, batch_elems):
 #: fact the run manifests and bench JSON report
 _LAST_DISPATCH: dict = {}
 
+#: trace-time flag: the dispatch being recorded is an ADJOINT solve
+#: (the backward pass of the implicit-diff custom_vjp below) — folded
+#: into ``last_dispatch()`` so manifests/tests can assert that gradient
+#: plumbing reuses the forward dispatch ladder instead of growing a
+#: second linear-solve implementation.  THREAD-LOCAL: the serve stack
+#: traces forward batches (sweep worker) and backward descents
+#: (optimize worker) concurrently, and a process-global flag would
+#: cross-stamp their dispatch facts.
+import threading as _threading
+
+_ADJOINT_TLS = _threading.local()
+
+
+def _adjoint_active() -> bool:
+    return bool(getattr(_ADJOINT_TLS, "active", False))
+
 
 def last_dispatch() -> dict:
     """Most recent solve-backend dispatch decision (made at trace time):
@@ -236,6 +252,8 @@ def _record_dispatch(backend: str, n, batch_elems, fused: bool = False,
     _LAST_DISPATCH.clear()
     _LAST_DISPATCH.update(backend=backend, n=int(n),
                           batch_elems=int(batch_elems), fused=bool(fused))
+    if _adjoint_active():
+        _LAST_DISPATCH["adjoint"] = True
     if plan is not None:
         _LAST_DISPATCH.update(
             precision=plan["mode"], solve_width=plan["solve_width"],
@@ -334,20 +352,11 @@ def solve_complex(A, b):
     return out[..., 0] if vec else out
 
 
-def impedance_solve(w, M, B, C, F):
-    """Solve the frequency-domain impedance system
-
-        [-w^2 M + i w B + C] X(w) = F(w)
-
-    over the trailing frequency axis: w (nw,) real, M/B (..., n, n, nw)
-    real, C (..., n, n) real, F (..., n, nw) complex -> X (..., n, nw)
-    complex.
-
-    Dispatch: the fused Pallas kernel when enabled for the shape (the
-    assembly happens in the kernel's VMEM load stage — Z is never
-    written to HBM), otherwise the pre-existing assemble-then-
-    ``solve_complex`` path, kept bitwise identical to the inline
-    assembly the sweep/variant/model callers used to carry."""
+def _impedance_solve_impl(w, M, B, C, F):
+    """Dispatch body of :func:`impedance_solve` (see its docstring).
+    Split out so the implicit-diff backward pass below can run the
+    ADJOINT solve through the identical Pallas/jnp/LU + precision
+    ladder without re-entering the custom_vjp wrapper."""
     # fault-injection seam (trace time): raise@kernel makes this
     # dispatch fail as a typed KernelFailure so the degradation ladder
     # (Pallas -> jnp -> host) is testable on CPU without breaking a
@@ -390,6 +399,123 @@ def impedance_solve(w, M, B, C, F):
          + C[..., None]).astype(_config.complex_dtype())
     Xin = solve_complex(jnp.moveaxis(Z, -1, -3), jnp.moveaxis(F, -1, -2))
     return jnp.moveaxis(Xin, -2, -1)
+
+
+# ---------------------------------------------------------------------------
+# implicit differentiation: the impedance solve as a custom_vjp whose
+# backward pass is ONE adjoint solve through the same dispatch ladder
+# ---------------------------------------------------------------------------
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def _adjoint_scope():
+    """Trace-time marker (per thread): dispatches recorded inside are
+    adjoint solves (``last_dispatch()["adjoint"] == True``)."""
+    prev = _adjoint_active()
+    _ADJOINT_TLS.active = True
+    try:
+        yield
+    finally:
+        _ADJOINT_TLS.active = prev
+
+
+def _probe_gate():
+    """Probe-suppression context for the custom_vjp fwd/bwd rules —
+    jax.custom_vjp cannot carry host-callback effects in its fwd/bwd
+    jaxprs, so the differentiated path traces callback-free (the
+    primal, non-differentiated path keeps its live probes)."""
+    try:
+        from raft_tpu.obs import probes
+        return probes.suppress("implicit-diff fwd/bwd rule")
+    # obs layer must never fail a solve; tracing proceeds un-gated
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+        return _contextlib.nullcontext()
+
+
+def _unbroadcast(x, shape):
+    """Sum-reduce a cotangent down to the primal's (broadcast-origin)
+    shape — the standard transpose of implicit numpy broadcasting."""
+    x = jnp.asarray(x)
+    if tuple(x.shape) == tuple(shape):
+        return x
+    extra = x.ndim - len(shape)
+    if extra > 0:
+        x = jnp.sum(x, axis=tuple(range(extra)))
+    axes = tuple(i for i, (a, b) in enumerate(zip(x.shape, shape))
+                 if a != b)
+    if axes:
+        x = jnp.sum(x, axis=axes, keepdims=True)
+    return x.reshape(shape)
+
+
+@jax.custom_vjp
+def impedance_solve(w, M, B, C, F):
+    """Solve the frequency-domain impedance system
+
+        [-w^2 M + i w B + C] X(w) = F(w)
+
+    over the trailing frequency axis: w (nw,) real, M/B (..., n, n, nw)
+    real, C (..., n, n) real, F (..., n, nw) complex -> X (..., n, nw)
+    complex.
+
+    Dispatch: the fused Pallas kernel when enabled for the shape (the
+    assembly happens in the kernel's VMEM load stage — Z is never
+    written to HBM), otherwise the pre-existing assemble-then-
+    ``solve_complex`` path, kept bitwise identical to the inline
+    assembly the sweep/variant/model callers used to carry.
+
+    Differentiable by construction (``custom_vjp``): the backward pass
+    is the implicit-function adjoint — ONE solve with the transposed
+    impedance ``Z^T λ = X̄`` dispatched through this very function, so
+    the Pallas/jnp/LU rungs AND the mixed-precision ladder apply to
+    adjoint solves identically, and ``last_dispatch()`` records
+    ``adjoint=True`` for them.  The cotangent algebra (plain-transpose,
+    conjugation-free, real parts onto the real inputs) matches JAX's
+    native linear-solve VJP to machine precision — pinned by
+    ``tests/test_optimize.py``."""
+    return _impedance_solve_impl(w, M, B, C, F)
+
+
+def _impedance_solve_fwd(w, M, B, C, F):
+    with _probe_gate():
+        X = _impedance_solve_impl(w, M, B, C, F)
+    return X, (jnp.asarray(w), jnp.asarray(M), jnp.asarray(B),
+               jnp.asarray(C), X)
+
+
+def _impedance_solve_bwd(res, Xbar):
+    w, M, B, C, X = res
+    # adjoint system: Z^T λ = X̄ with Z^T = -w² M^T + i w B^T + C^T —
+    # i.e. the SAME impedance solve on the transposed blocks, riding
+    # the full dispatch ladder (and recorded as an adjoint dispatch)
+    with _adjoint_scope(), _probe_gate():
+        lam = _impedance_solve_impl(
+            w, jnp.swapaxes(M, -3, -2), jnp.swapaxes(B, -3, -2),
+            jnp.swapaxes(C, -2, -1), Xbar)
+    # Z̄[..., i, j, w] = -λ_i X_j (plain outer product per frequency);
+    # real inputs take the real part of their holomorphic chain
+    Zbar = -lam[..., :, None, :] * X[..., None, :, :]
+    Mbar = _unbroadcast(jnp.real(-w ** 2 * Zbar), jnp.shape(M)
+                        ).astype(M.dtype)
+    Bbar = _unbroadcast(jnp.real(1j * w * Zbar), jnp.shape(B)
+                        ).astype(B.dtype)
+    Cbar = _unbroadcast(jnp.real(jnp.sum(Zbar, axis=-1)), jnp.shape(C)
+                        ).astype(C.dtype)
+    Fbar = lam
+    # frequency-grid cotangent: ∂Z/∂w = -2wM + iB per bin, contracted
+    # against Z̄ over every non-frequency axis (frequency-sensitivity
+    # studies get the true gradient, not a silent zero)
+    wbar = _unbroadcast(
+        jnp.real(jnp.sum(
+            Zbar * (-2.0 * w * M + 1j * B),
+            axis=tuple(range(Zbar.ndim - 1)))), jnp.shape(w)
+        ).astype(w.dtype)
+    return (wbar, Mbar, Bbar, Cbar, Fbar)
+
+
+impedance_solve.defvjp(_impedance_solve_fwd, _impedance_solve_bwd)
 
 
 def inv_complex(A):

@@ -1,0 +1,857 @@
+"""Differentiable co-design: implicit-diff solvers + batched descents.
+
+The whole stack is JAX but gradients used to stop at the solvers: the
+statics Newton is a ``lax.scan``/``lax.while_loop`` and the drag
+linearization is a fixed point — so the only way to search a
+hull/ballast/mooring design space was the dense forward sweep
+(``parallel/variants.py``, BENCH_r03 ~3.96M variants/h/chip).  This
+module closes the gap with the standard implicit-function-theorem
+construction (the jaxopt-style ``custom_vjp`` pattern):
+
+``newton_implicit``
+    The statics equilibrium ``F(X*, θ) = 0`` differentiates through ONE
+    adjoint solve with the SAME (regularized) tangent stiffness the
+    forward Newton factorized — not through the unrolled iteration.
+
+``fixed_point_implicit``
+    The drag-linearization fixed point ``Xi* = T(Xi*, θ)``
+    differentiates through the adjoint fixed point
+    ``λ = X̄ + (∂T/∂Xi)ᵀ λ``; every application of ``(∂T/∂Xi)ᵀ``
+    contains one adjoint impedance solve ``Zᵀ λ = v`` that dispatches
+    through :func:`raft_tpu.ops.linalg.impedance_solve`'s own
+    ``custom_vjp`` — the Pallas/jnp/LU rungs and the mixed-precision
+    ladder apply to adjoint solves identically, and
+    ``linalg.last_dispatch()`` records ``adjoint=True``.
+
+On top sit ``DesignSpace`` (named design variables with box bounds →
+variant θ pytrees), ``make_objective`` (RAO std / mean offset / DEL
+proxy), and :func:`optimize_designs` — hundreds of independent
+projected descents (optax Adam or a bounded L-BFGS) in ONE compiled
+program, with per-lane convergence masks riding the same padded-batch
+machinery as ``partition.pad_batch`` and the whole descent AOT-cached
+via ``exec_cache`` under an ``fn="optimize"`` key that carries the
+objective and bound fingerprints.
+
+Gradient health is guarded by the errors taxonomy: a lane whose
+adjoint produces a non-finite gradient is frozen and counted (it never
+stalls the batch), and an all-lanes-poisoned descent raises a typed
+:class:`raft_tpu.errors.NonFiniteResult` with ``phase="adjoint"``.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import _config, errors
+
+# ---------------------------------------------------------------------------
+# implicit-diff solver wrappers (closure_convert hoists traced closures)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _newton_core(f, iters, X0, *aux):
+    from raft_tpu.parallel.variants import statics_newton
+
+    return statics_newton(lambda X: f(X, *aux), X0, iters=iters)
+
+
+def _newton_fwd(f, iters, X0, *aux):
+    Xeq = _newton_core(f, iters, X0, *aux)
+    return Xeq, (Xeq, aux)
+
+
+def _newton_bwd(f, iters, res, Xbar):
+    Xeq, aux = res
+    # the SAME regularized tangent stiffness the forward Newton
+    # factorized (K = -∂F/∂X + εI, variants.statics_newton), evaluated
+    # at the accepted equilibrium — one adjoint solve, not an unroll
+    J = jax.jacfwd(lambda X: f(X, *aux))(Xeq)
+    K = -J + 1e-6 * jnp.eye(Xeq.shape[-1], dtype=Xeq.dtype)
+    lam = jnp.linalg.solve(jnp.swapaxes(K, -2, -1), Xbar)
+    _, vjp_aux = jax.vjp(lambda *a: f(Xeq, *a), *aux)
+    return (jnp.zeros_like(Xeq), *vjp_aux(lam))
+
+
+_newton_core.defvjp(_newton_fwd, _newton_bwd)
+
+
+def newton_implicit(net_force, X0, iters: int = 20):
+    """Statics equilibrium ``net_force(X*) = 0`` with implicit
+    differentiation: forward = ``variants.statics_newton`` (unchanged
+    math), backward = one adjoint solve ``Kᵀ λ = X̄`` with the same
+    regularized tangent stiffness, then the pullback of ``net_force``
+    w.r.t. its (closure-converted) θ-dependent operands.
+
+    ``net_force`` may close over traced values — ``jax.closure_convert``
+    hoists them into explicit implicit-diff operands."""
+    X0 = jnp.asarray(X0, _config.real_dtype())
+    f, aux = jax.closure_convert(net_force, X0)
+    return _newton_core(f, int(iters), X0, *aux)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _fp_core(f, nIter, tol, relax, adj_iters, Xi0, *aux):
+    from raft_tpu.recovery import relax_weights
+
+    keep, rlx = relax_weights(relax)
+    XiLast, done = Xi0, jnp.zeros((), bool)
+    for _ in range(nIter):
+        Xin = f(XiLast, *aux)
+        rel = jnp.abs(Xin - XiLast) / (jnp.abs(Xin) + tol)
+        conv = jnp.all(rel < tol)
+        XiLast = jnp.where(done | conv, XiLast,
+                           keep * XiLast + rlx * Xin)
+        done = done | conv
+    # return the RELAXED iterate, not the raw last step output: both
+    # converge to the same fixed point (within tol), but the raw output
+    # lands on EXACT zeros for symmetric DOFs — the one point where the
+    # drag linearization's |Xi| chain is non-smooth and the adjoint
+    # pullback would evaluate to NaN.  The relaxed iterate decays
+    # geometrically toward those zeros without reaching them, so the
+    # backward pass evaluates on smooth ground.
+    return XiLast
+
+
+def _fp_fwd(f, nIter, tol, relax, adj_iters, Xi0, *aux):
+    Xi = _fp_core(f, nIter, tol, relax, adj_iters, Xi0, *aux)
+    return Xi, (Xi, aux)
+
+
+def _fp_bwd(f, nIter, tol, relax, adj_iters, res, Xbar):
+    from raft_tpu.recovery import relax_weights
+
+    Xi, aux = res
+    keep, rlx = relax_weights(relax)
+    # adjoint fixed point λ = X̄ + (∂T/∂Xi)ᵀ λ, iterated with the same
+    # under-relaxation weights as the forward (same contraction), with
+    # the same convergence freeze.  Each pullback application solves
+    # Zᵀ λ = v through impedance_solve's own custom_vjp — the adjoint
+    # rides the full dispatch ladder.
+    _, pullback = jax.vjp(lambda x: f(x, *aux), Xi)
+    lam, done = Xbar, jnp.zeros((), bool)
+    for _ in range(adj_iters):
+        nxt = Xbar + pullback(lam)[0]
+        rel = jnp.abs(nxt - lam) / (jnp.abs(nxt) + tol)
+        conv = jnp.all(rel < tol)
+        lam = jnp.where(done | conv, lam, keep * lam + rlx * nxt)
+        done = done | conv
+    _, vjp_aux = jax.vjp(lambda *a: f(Xi, *a), *aux)
+    return (jnp.zeros_like(Xi), *vjp_aux(lam))
+
+
+_fp_core.defvjp(_fp_fwd, _fp_bwd)
+
+
+def fixed_point_implicit(step, Xi0, nIter: int = 10, tol: float = 0.01,
+                         relax: float = 0.8, adjoint_iters: int = None):
+    """Drag-linearization fixed point ``Xi* = step(Xi*)`` with implicit
+    differentiation (the IFT construction: backward = the adjoint fixed
+    point, never the unrolled forward iteration).
+
+    ``step`` may close over traced values (per-variant model state) —
+    closure-converted into explicit operands whose cotangents flow back
+    to θ.  ``adjoint_iters`` bounds the backward iteration (default
+    ``2 * nIter``; same relaxation weights, same freeze-on-converged
+    semantics as the forward pass)."""
+    Xi0 = jnp.asarray(Xi0, _config.complex_dtype())
+    f, aux = jax.closure_convert(step, Xi0)
+    adj = int(adjoint_iters) if adjoint_iters else 2 * int(nIter)
+    return _fp_core(f, int(nIter), float(tol), float(relax), adj,
+                    Xi0, *aux)
+
+
+# ---------------------------------------------------------------------------
+# design spaces: named scalar variables -> variant θ pytrees
+# ---------------------------------------------------------------------------
+
+def _theta_ballast(base, x):
+    return {"rho_fill": [jnp.atleast_1d(jnp.asarray(
+        m.rho_fill, _config.real_dtype())) * x for m in base.members]}
+
+
+def _theta_d_scale(base, x):
+    return {"d_scale": jnp.ones((len(base.members), 2),
+                                dtype=_config.real_dtype()) * x}
+
+
+def _theta_moor_L(base, x):
+    return {"moor_L": jnp.asarray(base.mooring.L,
+                                  _config.real_dtype()) * x}
+
+
+def _theta_moor_EA(base, x):
+    return {"moor_EA": jnp.asarray(base.mooring.EA,
+                                   _config.real_dtype()) * x}
+
+
+def _theta_moor_anchor(base, x):
+    rA = jnp.asarray(base.mooring.rAnchor, _config.real_dtype())
+    scale = jnp.stack([x, x, jnp.ones_like(x)]) if jnp.ndim(x) \
+        else jnp.array([x, x, 1.0])
+    return {"moor_rAnchor": rA * scale}
+
+
+#: named design variables: each maps a SCALE factor (1.0 = the base
+#: design) into variant-θ contributions.  ``ballast`` scales every
+#: member's fill density (the variant solver must run ``ballast=False``
+#: so the closed-form trim does not cancel the variable), ``d_scale``
+#: scales all member diameters/side lengths (hull diameter/thickness),
+#: ``moor_L`` scales unstretched line length (pretension: shorter line
+#: = higher pretension), ``moor_EA`` scales axial stiffness, and
+#: ``moor_anchor`` scales the anchor-radius footprint.
+DESIGN_PARAMS = {
+    "ballast": _theta_ballast,
+    "d_scale": _theta_d_scale,
+    "moor_L": _theta_moor_L,
+    "moor_EA": _theta_moor_EA,
+    "moor_anchor": _theta_moor_anchor,
+}
+
+
+class DesignSpace:
+    """Box-bounded design space over :data:`DESIGN_PARAMS` variables.
+
+    ``bounds`` maps variable name -> ``(lo, hi)`` scale factors.  The
+    ordered names define the layout of the flat design vector ``x``
+    (shape ``(P,)``) every optimizer lane walks."""
+
+    def __init__(self, base, bounds: dict):
+        if not bounds:
+            raise errors.ModelConfigError("empty design space",
+                                          bounds=str(bounds))
+        self.base = base
+        self.names = sorted(bounds)
+        for name in self.names:
+            if name not in DESIGN_PARAMS:
+                raise errors.ModelConfigError(
+                    f"unknown design variable '{name}' "
+                    f"(known: {sorted(DESIGN_PARAMS)})", param=name)
+            if name.startswith("moor") and base.mooring is None:
+                raise errors.ModelConfigError(
+                    f"design variable '{name}' needs a moored design",
+                    param=name)
+        lo = np.array([float(bounds[n][0]) for n in self.names])
+        hi = np.array([float(bounds[n][1]) for n in self.names])
+        if not np.all(lo < hi) or not np.all(np.isfinite(lo)) \
+                or not np.all(np.isfinite(hi)):
+            raise errors.ModelConfigError(
+                "design bounds must be finite with lo < hi",
+                bounds=json.dumps({n: list(map(float, bounds[n]))
+                                   for n in self.names}))
+        self.lower = jnp.asarray(lo, _config.real_dtype())
+        self.upper = jnp.asarray(hi, _config.real_dtype())
+
+    @property
+    def ndim(self) -> int:
+        return len(self.names)
+
+    def to_theta(self, x) -> dict:
+        """Variant θ for ONE flat design vector ``x`` (P,)."""
+        theta = {}
+        for i, name in enumerate(self.names):
+            theta.update(DESIGN_PARAMS[name](self.base, x[i]))
+        return theta
+
+    def clip(self, x):
+        return jnp.clip(x, self.lower, self.upper)
+
+    def sample(self, nlanes: int, seed: int = 0) -> np.ndarray:
+        """(nlanes, P) uniform starts inside the box (host RNG)."""
+        rng = np.random.default_rng(seed)
+        lo = np.asarray(self.lower)
+        hi = np.asarray(self.upper)
+        return lo + (hi - lo) * rng.uniform(size=(int(nlanes), self.ndim))
+
+    def fingerprint(self) -> dict:
+        """JSON-able identity (exec-cache key / request digests)."""
+        return {"names": list(self.names),
+                "lower": [float(v) for v in np.asarray(self.lower)],
+                "upper": [float(v) for v in np.asarray(self.upper)]}
+
+
+# ---------------------------------------------------------------------------
+# objectives
+# ---------------------------------------------------------------------------
+
+#: objective spec defaults (JSON-able — the serve tenant ships these)
+DEFAULT_OBJECTIVE = {"metric": "std", "dof": None, "weights": None,
+                     "Hs": 6.0, "Tp": 12.0, "beta": 0.0, "sn_m": 4.0}
+
+OBJECTIVE_METRICS = ("std", "offset", "del")
+
+
+def normalize_objective(spec) -> dict:
+    """Validated, canonicalized objective spec (typed on bad input)."""
+    if spec is None:
+        spec = {}
+    if isinstance(spec, str):
+        spec = {"metric": spec}
+    if not isinstance(spec, dict):
+        raise errors.ModelConfigError("objective spec must be a dict "
+                                      "or metric name", spec=str(spec))
+    out = dict(DEFAULT_OBJECTIVE)
+    unknown = set(spec) - set(out)
+    if unknown:
+        raise errors.ModelConfigError(
+            f"unknown objective keys {sorted(unknown)}",
+            keys=",".join(sorted(unknown)))
+    out.update(spec)
+    if out["metric"] not in OBJECTIVE_METRICS:
+        raise errors.ModelConfigError(
+            f"unknown objective metric '{out['metric']}' "
+            f"(known: {OBJECTIVE_METRICS})", metric=str(out["metric"]))
+    # every scalar is coerced + validated here — the serve tenant's
+    # typed-reject contract means junk must never get past admission
+    # (and canonicalization means 1 vs 1.0 never fork a digest)
+    if out["dof"] is not None:
+        try:
+            out["dof"] = int(out["dof"])
+        except (TypeError, ValueError) as e:
+            raise errors.ModelConfigError(
+                "objective dof must be an integer",
+                dof=str(out["dof"])) from e
+        if not 0 <= out["dof"] < 6:
+            raise errors.ModelConfigError("objective dof must be 0..5",
+                                          dof=out["dof"])
+    for key, lo in (("Hs", 0.0), ("Tp", 0.0), ("beta", None),
+                    ("sn_m", 0.0)):
+        try:
+            out[key] = float(out[key])
+        except (TypeError, ValueError) as e:
+            raise errors.ModelConfigError(
+                f"objective '{key}' must be a number",
+                key=key) from e
+        if not np.isfinite(out[key]) or (lo is not None
+                                         and out[key] <= lo):
+            raise errors.ModelConfigError(
+                f"objective '{key}' must be finite"
+                + ("" if lo is None else f" and > {lo:g}"), key=key)
+    if out["weights"] is not None:
+        try:
+            wts = [float(v) for v in out["weights"]]
+        except (TypeError, ValueError) as e:
+            raise errors.ModelConfigError(
+                "objective weights must be a list of numbers") from e
+        if len(wts) != 6 or not all(np.isfinite(v) for v in wts):
+            raise errors.ModelConfigError(
+                "objective weights must be 6 finite numbers",
+                n=len(wts))
+        out["weights"] = wts
+    return out
+
+
+def _dof_weights(spec) -> jnp.ndarray:
+    if spec.get("weights") is not None:
+        wts = jnp.asarray(spec["weights"], _config.real_dtype())
+    elif spec.get("dof") is not None:
+        wts = jnp.zeros(6, _config.real_dtype()).at[int(spec["dof"])].set(1.0)
+    else:
+        wts = jnp.ones(6, _config.real_dtype())
+    return wts
+
+
+def _abs2(z):
+    """|z|² with polynomial gradients — ``jnp.abs(z)**2`` chains through
+    ``d|z|`` which is NaN at exactly-zero entries (a symmetric design's
+    sway/roll/yaw responses are EXACT zeros), poisoning every adjoint."""
+    return jnp.real(z) ** 2 + jnp.imag(z) ** 2
+
+
+def _safe_sqrt(s):
+    """``sqrt`` whose gradient is 0 (not NaN) at s == 0, NaN-propagating
+    for genuinely poisoned inputs (``s * 0`` keeps NaN), and primal-
+    identical to ``jnp.sqrt`` elsewhere."""
+    pos = s > 0.0
+    return jnp.where(pos, jnp.sqrt(jnp.where(pos, s, 1.0)), s * 0.0)
+
+
+def safe_rms(xi, axis=None):
+    """Gradient-safe twin of :func:`raft_tpu.ops.spectra.get_rms`:
+    the same ``sqrt(0.5 Σ|xi|²)`` up to one ulp (|z|² accumulates as
+    ``re²+im²``, skipping ``abs``'s internal rounding), exact at zero,
+    with finite gradients at identically-zero responses.  The
+    objective layer below uses this so a zero DOF row contributes a
+    zero gradient instead of NaN."""
+    return _safe_sqrt(0.5 * jnp.sum(_abs2(xi), axis=axis))
+
+
+def del_proxy(Xi, w, sn_m: float = 4.0):
+    """Narrow-band spectral damage-equivalent-load proxy per DOF:
+    ``σ · ν^(1/m)`` with ``ν = sqrt(m2/m0)/2π`` the mean zero-upcrossing
+    rate of the response (``m_k = Σ w^k |Xi|²/2`` spectral moments) and
+    ``m`` the S-N slope — the standard frequency-domain fatigue proxy
+    (exact for a narrow-band Gaussian response up to the material
+    constant).  Zero-response DOFs contribute exactly 0 with a zero
+    gradient (the where-trick both ways, so no NaN leaks either
+    direction through the fractional powers)."""
+    p2 = 0.5 * _abs2(Xi)
+    m0 = jnp.sum(p2, axis=-1)
+    m2 = jnp.sum(w ** 2 * p2, axis=-1)
+    pos = m0 > 0.0
+    m0s = jnp.where(pos, m0, 1.0)
+    m2s = jnp.where(pos, m2, 1.0)
+    nu = jnp.sqrt(m2s / m0s) / (2.0 * jnp.pi)
+    return jnp.where(pos, jnp.sqrt(m0s) * nu ** (1.0 / sn_m), m0 * 0.0)
+
+
+def make_objective(spec=None):
+    """``fn(out, w) -> scalar`` over a per-variant solver output dict.
+
+    ``spec["metric"]``: ``"std"`` (DOF-weighted response std),
+    ``"offset"`` (mean horizontal offset), ``"del"`` (DOF-weighted
+    narrow-band DEL proxy).  Returns ``(fn, canonical_spec)``."""
+    spec = normalize_objective(spec)
+    wts = _dof_weights(spec)
+
+    def fn(out, w):
+        if spec["metric"] == "offset":
+            # out["offset"] is hypot(x, y) whose gradient is NaN at the
+            # exact origin (an unloaded symmetric design) — recompute
+            # with the safe sqrt, primal-identical
+            return _safe_sqrt(out["Xeq"][0] ** 2 + out["Xeq"][1] ** 2)
+        if spec["metric"] == "del":
+            return jnp.sum(wts * del_proxy(out["Xi"], w,
+                                           float(spec["sn_m"])))
+        return jnp.sum(wts * safe_rms(out["Xi"], axis=-1))
+
+    return fn, spec
+
+
+# ---------------------------------------------------------------------------
+# serve-tenant request specs
+# ---------------------------------------------------------------------------
+
+#: knobs an ``optimize`` serve request may carry (all JSON scalars plus
+#: the bounds/objective dicts); everything else is a typed reject
+OPTIMIZE_REQUEST_DEFAULTS = {
+    "bounds": None, "objective": None, "nlanes": 32, "steps": 30,
+    "method": "adam", "lr": 0.02, "gtol": 1e-4, "seed": 0,
+    "nIter": 10, "tol": 0.01,
+}
+
+
+def normalize_request(spec, lanes_max: int = None,
+                      steps_max: int = None) -> dict:
+    """Validated canonical form of an ``optimize`` serve-request spec.
+
+    The canonical dict (sorted keys, defaults filled) is what the
+    request digest, the WAL admit record, and the exec-cache key all
+    see — two requests asking for the same optimization share one
+    content address.  Bad input is a typed
+    :class:`errors.ModelConfigError`; ``lanes_max``/``steps_max`` are
+    the service's resource guards."""
+    if not isinstance(spec, dict):
+        raise errors.ModelConfigError(
+            "optimize request spec must be a JSON object",
+            spec=str(type(spec).__name__))
+    unknown = set(spec) - set(OPTIMIZE_REQUEST_DEFAULTS)
+    if unknown:
+        raise errors.ModelConfigError(
+            f"unknown optimize request keys {sorted(unknown)}",
+            keys=",".join(sorted(unknown)))
+    out = dict(OPTIMIZE_REQUEST_DEFAULTS)
+    out.update(spec)
+    bounds = out["bounds"]
+    if not isinstance(bounds, dict) or not bounds:
+        raise errors.ModelConfigError(
+            "optimize request needs non-empty 'bounds' "
+            "{design_var: [lo, hi]}", bounds=str(bounds))
+    canon_bounds = {}
+    for name, pair in bounds.items():
+        if name not in DESIGN_PARAMS:
+            raise errors.ModelConfigError(
+                f"unknown design variable '{name}' "
+                f"(known: {sorted(DESIGN_PARAMS)})", param=str(name))
+        try:
+            lo, hi = float(pair[0]), float(pair[1])
+        except (TypeError, ValueError, IndexError) as e:
+            raise errors.ModelConfigError(
+                f"bounds for '{name}' must be [lo, hi]",
+                param=str(name)) from e
+        if not (np.isfinite(lo) and np.isfinite(hi) and lo < hi):
+            raise errors.ModelConfigError(
+                f"bounds for '{name}' must be finite with lo < hi",
+                param=str(name), lo=lo, hi=hi)
+        canon_bounds[str(name)] = [lo, hi]
+    out["bounds"] = {k: canon_bounds[k] for k in sorted(canon_bounds)}
+    out["objective"] = normalize_objective(out["objective"])
+    if str(out["method"]) not in ("adam", "lbfgs"):
+        raise errors.ModelConfigError(
+            f"unknown optimize method '{out['method']}' (adam|lbfgs)",
+            method=str(out["method"]))
+    # nIter is hard-capped unconditionally: the implicit fixed point
+    # Python-unrolls nIter forward passes and 2*nIter adjoint passes
+    # at trace time, so it is THE compile-size knob — an uncapped
+    # value is the compile bomb the admission guard exists to reject
+    for key, lo, hi in (("nlanes", 1, None), ("steps", 1, None),
+                        ("nIter", 1, 200), ("seed", 0, None)):
+        try:
+            out[key] = int(out[key])
+        except (TypeError, ValueError) as e:
+            raise errors.ModelConfigError(
+                f"optimize request '{key}' must be an integer",
+                key=key) from e
+        if out[key] < lo or (hi is not None and out[key] > hi):
+            raise errors.ModelConfigError(
+                f"optimize request '{key}' must be in "
+                f"[{lo}, {hi if hi is not None else 'inf'}]", key=key)
+    for key in ("lr", "gtol", "tol"):
+        try:
+            out[key] = float(out[key])
+        except (TypeError, ValueError) as e:
+            raise errors.ModelConfigError(
+                f"optimize request '{key}' must be a number",
+                key=key) from e
+        if not (np.isfinite(out[key]) and out[key] > 0):
+            raise errors.ModelConfigError(
+                f"optimize request '{key}' must be finite and > 0",
+                key=key)
+    if lanes_max is not None and out["nlanes"] > int(lanes_max):
+        raise errors.ModelConfigError(
+            f"optimize request nlanes {out['nlanes']} exceeds the "
+            f"service bound {lanes_max}", nlanes=out["nlanes"],
+            bound=int(lanes_max))
+    if steps_max is not None and out["steps"] > int(steps_max):
+        raise errors.ModelConfigError(
+            f"optimize request steps {out['steps']} exceeds the "
+            f"service bound {steps_max}", steps=out["steps"],
+            bound=int(steps_max))
+    return {k: out[k] for k in sorted(out)}
+
+
+# ---------------------------------------------------------------------------
+# the batched descent
+# ---------------------------------------------------------------------------
+
+def make_design_objective(base, space: DesignSpace, objective=None,
+                          nIter: int = 10, tol: float = 0.01,
+                          newton_iters: int = 20, **solver_kw):
+    """``obj(x) -> scalar`` for one flat design vector, through the
+    implicit-diff pipeline (value AND gradient exact+cheap), plus the
+    canonical objective spec.  ``value_and_grad``-able and vmap-able."""
+    from raft_tpu.parallel.variants import make_variant_solver
+
+    fn, spec = make_objective(objective)
+    ballast = "ballast" not in space.names
+    solver = make_variant_solver(
+        base, Hs=float(spec["Hs"]), Tp=float(spec["Tp"]),
+        beta=float(spec["beta"]), ballast=ballast, nIter=int(nIter),
+        tol=float(tol), newton_iters=int(newton_iters),
+        implicit_diff=True, **solver_kw)
+    w = jnp.asarray(base.w)
+
+    def obj(x):
+        out = solver.implicit(space.to_theta(x))
+        return fn(out, w)
+
+    obj.spec = spec
+    obj.solver = solver
+    return obj
+
+
+def grad_guarded(obj):
+    """``value_and_grad(obj)`` whose non-finite adjoint output raises a
+    typed :class:`errors.NonFiniteResult` with ``phase="adjoint"`` at
+    the (host-side) call boundary."""
+    vg = jax.value_and_grad(obj)
+
+    def wrapped(x):
+        v, g = vg(x)
+        if not (np.isfinite(np.asarray(v))
+                and np.all(np.isfinite(np.asarray(g)))):
+            err = errors.NonFiniteResult(
+                "non-finite objective/adjoint gradient",
+                value=float(np.asarray(v)))
+            err.phase = "adjoint"
+            raise err
+        return v, g
+
+    return wrapped
+
+
+def _make_optimizer(method: str, lr: float, lbfgs_memory: int = 8,
+                    linesearch_steps: int = 8):
+    import optax
+
+    if method == "adam":
+        return optax.adam(lr), False
+    if method == "lbfgs":
+        # bounded L-BFGS: fixed memory, zoom linesearch capped at a
+        # static step budget (every lane runs the same bounded program)
+        return optax.lbfgs(
+            memory_size=int(lbfgs_memory),
+            linesearch=optax.scale_by_zoom_linesearch(
+                max_linesearch_steps=int(linesearch_steps))), True
+    raise errors.ModelConfigError(
+        f"unknown optimize method '{method}' (adam|lbfgs)", method=method)
+
+
+def _finite_lane(v, g):
+    return jnp.isfinite(v) & jnp.all(jnp.isfinite(g))
+
+
+def make_descent(base, space: DesignSpace, objective=None,
+                 method: str = "adam", steps: int = 40, lr: float = 0.02,
+                 gtol: float = 1e-4, xtol: float = 0.0, **obj_kw):
+    """One compiled program ``descend(X0 (L,P)) -> result pytree``
+    running L independent projected descents with per-lane convergence
+    masks.  Lanes whose adjoint goes non-finite are FROZEN at their last
+    finite iterate and counted — one poisoned lane never stalls the
+    batch."""
+    obj = make_design_objective(base, space, objective, **obj_kw)
+    opt, needs_value = _make_optimizer(method, lr)
+    vg = jax.value_and_grad(obj)
+    rdt = _config.real_dtype()
+    steps = int(steps)                 # static scan length, host-side
+
+    def lane_update(x, state, v, g):
+        if needs_value:
+            upd, state = opt.update(g, state, x, value=v, grad=g,
+                                    value_fn=obj)
+        else:
+            upd, state = opt.update(g, state, x)
+        import optax
+        return space.clip(optax.apply_updates(x, upd)), state
+
+    def _freeze(mask, old, new):
+        return jax.tree.map(
+            lambda a, b: jnp.where(
+                mask.reshape(mask.shape + (1,) * (jnp.ndim(a) - 1)), a, b),
+            old, new)
+
+    def descend(X0):
+        X0 = jnp.asarray(X0, rdt)
+        L = X0.shape[0]
+        state0 = jax.vmap(opt.init)(X0)
+        carry0 = (X0, state0, jnp.zeros(L, bool), jnp.zeros(L, bool),
+                  jnp.zeros(L, jnp.int32))
+
+        def body(carry, _):
+            x, state, done, bad, iters = carry
+            v, g = jax.vmap(vg)(x)
+            finite = jax.vmap(_finite_lane)(v, g)
+            bad_now = bad | (~finite & ~done)
+            g_safe = jnp.nan_to_num(g, nan=0.0, posinf=0.0, neginf=0.0)
+            v_safe = jnp.nan_to_num(v, nan=0.0, posinf=0.0, neginf=0.0)
+            x_new, state_new = jax.vmap(lane_update)(x, state, v_safe,
+                                                     g_safe)
+            frozen = done | bad_now
+            x_new = jnp.where(frozen[:, None], x, x_new)
+            state_new = _freeze(frozen, state, state_new)
+            gnorm = jnp.max(jnp.abs(g_safe), axis=-1)
+            moved = jnp.max(jnp.abs(x_new - x), axis=-1)
+            conv = finite & ((gnorm <= gtol) | ((moved <= xtol)
+                                                & (xtol > 0.0)))
+            iters = iters + jnp.where(frozen, 0, 1)
+            done = done | conv
+            return ((x_new, state_new, done, bad_now, iters),
+                    (v, gnorm))
+
+        (x, _, done, bad, iters), (obj_trace, gnorm_trace) = \
+            jax.lax.scan(body, carry0, None, length=steps)
+        v_fin, g_fin = jax.vmap(vg)(x)
+        return {"x": x, "objective": v_fin,
+                "grad_norm": jnp.max(jnp.abs(
+                    jnp.nan_to_num(g_fin, nan=jnp.inf)), axis=-1),
+                "converged": done & ~bad, "nonfinite": bad,
+                "iters": iters, "obj_trace": obj_trace,
+                "gnorm_trace": gnorm_trace}
+
+    descend.objective_spec = obj.spec
+    descend.space = space
+    return descend
+
+
+def optimize_designs(base, space: DesignSpace, objective=None,
+                     x0=None, nlanes: int = 64, method: str = "adam",
+                     steps: int = 40, lr: float = 0.02,
+                     gtol: float = 1e-4, xtol: float = 0.0,
+                     mesh=None, seed: int = 0, strict: bool = True,
+                     **obj_kw) -> dict:
+    """Run ``nlanes`` simultaneous projected gradient descents over
+    ``space`` in ONE compiled (AOT-cached) program.
+
+    Returns a dict with per-lane results (``x``, ``objective``,
+    ``grad_norm``, ``converged``, ``nonfinite``, ``iters``,
+    ``obj_trace``), the best lane (``x_best``/``f_best``/``design`` —
+    named scale factors), descent provenance, and the exec-cache
+    outcome.  A run manifest (kind ``optimize``) records the facts the
+    trend store extracts.
+
+    ``mesh`` (optional, batch axes only) shards the lane axis like a
+    variant sweep; lanes pad to the mesh batch multiple via
+    ``partition.pad_batch`` and strip on return.  ``strict=True``
+    raises a typed :class:`errors.NonFiniteResult` (``phase="adjoint"``)
+    when EVERY lane's adjoint went non-finite."""
+    import time as _time
+
+    from raft_tpu import obs
+    from raft_tpu.ops import linalg as _linalg
+    from raft_tpu.parallel import exec_cache, partition
+
+    descend = make_descent(base, space, objective, method=method,
+                           steps=steps, lr=lr, gtol=gtol, xtol=xtol,
+                           **obj_kw)
+    spec = descend.objective_spec
+    if x0 is None:
+        x0 = space.sample(nlanes, seed=seed)
+    x0 = jnp.asarray(x0, _config.real_dtype())
+    nlanes = int(x0.shape[0])
+    npad = 0
+    if mesh is not None:
+        (x0,), npad = partition.pad_batch((x0,), nlanes,
+                                          partition.batch_size(mesh))
+        x0 = partition.shard_tree({"x0": x0}, mesh,
+                                  partition.VARIANT_INPUT_RULES)["x0"]
+    mesh_info = partition.mesh_facts(mesh)
+    manifest = obs.RunManifest.begin(kind="optimize", config={
+        "nlanes": nlanes, "ndim": space.ndim, "steps": int(steps),
+        "method": method, "objective": spec["metric"],
+        "mesh": mesh_info, "names": ",".join(space.names)})
+    obs.record_build_info(run_id=manifest.run_id)
+    status = "failed"
+    try:
+        with obs.span("optimize_designs", nlanes=nlanes,
+                      method=method) as sp:
+            jitted = jax.jit(descend)
+            key = None
+            exe = None
+            cache_info = {"state": "disabled"}
+            if exec_cache.enabled():
+                key = exec_cache.make_key(
+                    fn="optimize",
+                    model=exec_cache.model_digest(base),
+                    space=space.fingerprint(),
+                    objective=spec,
+                    method=method, steps=int(steps), lr=float(lr),
+                    gtol=float(gtol), xtol=float(xtol),
+                    batch_shape=[int(x0.shape[0]), space.ndim],
+                    dtype=str(x0.dtype),
+                    mesh=mesh_info,
+                    kw={k: v for k, v in obj_kw.items()
+                        if isinstance(v, (int, float, str, bool))})
+                exe = exec_cache.load(key)
+                cache_info = {"state": "hit" if exe is not None
+                              else "miss", "key": key}
+            sp.set(exec_cache=cache_info["state"])
+            t0 = _time.perf_counter()
+            out = None
+            if exe is not None:
+                try:
+                    with obs.span("optimize_execute", cached=True):
+                        out = exe.call(x0)
+                        jax.block_until_ready(out["x"])
+                except exec_cache.CALL_ERRORS as e:
+                    from raft_tpu.utils.profiling import get_logger
+                    get_logger("optimize").warning(
+                        "cached optimize executable %s failed "
+                        "(%s: %s) — recompiling", key,
+                        type(e).__name__, e)
+                    exec_cache._count("error")
+                    cache_info = {"state": "error", "key": key}
+                    out = None
+            if out is None:
+                probe_gate = (obs.probes.suppress("aot-exported program")
+                              if key is not None
+                              else contextlib.nullcontext())
+                with obs.span("optimize_lower"), probe_gate:
+                    lowered = jitted.lower(x0)
+                with obs.span("optimize_compile"):
+                    compiled = lowered.compile()
+                with obs.span("optimize_execute"):
+                    out = compiled(x0)
+                    jax.block_until_ready(out["x"])
+                if key is not None:
+                    with obs.span("optimize_cache_store"), \
+                            obs.probes.suppress("aot-exported program"):
+                        exec_cache.store(jitted, (x0,), key,
+                                         meta={"fn": "optimize",
+                                               "nlanes": nlanes})
+            wall_s = _time.perf_counter() - t0
+            out = dict(out)
+            if npad:
+                trace = {k: out.pop(k) for k in ("obj_trace",
+                                                 "gnorm_trace")}
+                out = partition.unpad_batch(out, nlanes)
+                out.update({k: v[:, :nlanes] for k, v in trace.items()})
+            # one host pull for the descent summary
+            res = obs.transfers.device_get(
+                (out["x"], out["objective"], out["grad_norm"],
+                 out["converged"], out["nonfinite"], out["iters"],
+                 out["obj_trace"]),
+                what="optimize_summary", phase="optimize")
+            x, fval, gnorm, conv, bad, iters, obj_trace = \
+                [np.asarray(a) for a in res]
+            n_bad = int(bad.sum())
+            if n_bad:
+                obs.counter(
+                    "raft_tpu_optimize_grad_nonfinite_total",
+                    "descent lanes whose adjoint gradient went "
+                    "non-finite (frozen, never stalling the batch)",
+                    ).inc(n_bad)
+            if strict and n_bad == nlanes:
+                err = errors.NonFiniteResult(
+                    "every descent lane produced a non-finite adjoint "
+                    "gradient", lanes=nlanes)
+                err.phase = "adjoint"
+                raise err
+            ok = ~bad & np.isfinite(fval)
+            if not ok.any():
+                raise errors.NonFiniteResult(
+                    "no descent lane finished with a finite objective",
+                    lanes=nlanes)
+            best = int(np.flatnonzero(ok)[np.argmin(fval[ok])])
+            result = {
+                "x": x, "objective": fval, "grad_norm": gnorm,
+                "converged": conv, "nonfinite": bad, "iters": iters,
+                "obj_trace": obj_trace,
+                "x_best": x[best], "f_best": float(fval[best]),
+                "lane_best": best,
+                "design": {n: float(x[best][i])
+                           for i, n in enumerate(space.names)},
+                "provenance": {
+                    "method": method, "steps": int(steps),
+                    "lr": float(lr), "gtol": float(gtol),
+                    "nlanes": nlanes, "ndim": space.ndim,
+                    "objective": spec,
+                    "space": space.fingerprint(),
+                    "iterations": int(iters.max(initial=0)),
+                    "grad_norm_best": float(gnorm[best]),
+                    "grad_nonfinite": n_bad,
+                    "converged": int(conv.sum()),
+                    "wall_s": wall_s,
+                    "solver": _linalg.last_dispatch(),
+                    "exec_cache": cache_info["state"]},
+            }
+            sp.set(best=result["f_best"], converged=int(conv.sum()),
+                   nonfinite=n_bad)
+            obs.gauge(
+                "raft_tpu_optimize_lanes",
+                "descent lanes of the most recent batched design "
+                "optimization").set(nlanes, method=method)
+            obs.gauge(
+                "raft_tpu_optimize_converged_lanes",
+                "lanes whose projected descent met the gradient "
+                "tolerance").set(int(conv.sum()), method=method)
+            manifest.extra["exec_cache"] = cache_info
+            manifest.extra["optimize"] = {
+                "nlanes": nlanes, "steps": int(steps),
+                "method": method,
+                "converged": int(conv.sum()),
+                "grad_nonfinite": n_bad,
+                "grad_nonfinite_ratio": n_bad / max(1, nlanes),
+                "f_best": result["f_best"],
+                "iters_max": int(iters.max(initial=0)),
+                "wall_s": wall_s,
+                "descents_per_min": 60.0 * nlanes / max(wall_s, 1e-9),
+                "exec_cache": cache_info["state"]}
+            status = "ok"
+            return result
+    finally:
+        obs.finish_run(manifest, status=status, write_trace=False)

@@ -336,9 +336,19 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
             partition.CASE_INPUT_RULES)
         return placed["Hs"], placed["Tp"], placed["beta"]
 
+    def _place_seed(seed):
+        """Deliberate placement of the warm-start seed: the same
+        ``XI_SPEC`` layout the in-program statics->dynamics boundary
+        constrains to, so a seeded meshed program starts from correctly
+        sharded lanes instead of implicit replication."""
+        if mesh is None:
+            return seed
+        return partition.shard_tree(
+            {"Xi0": seed}, mesh, ((r".*", partition.XI_SPEC),))["Xi0"]
+
     args = _place(*(jnp.zeros((ncases,), dtype) for _ in range(3)))
     if warm_start:
-        args = (*args, _cold_seed())
+        args = (*args, _place_seed(_cold_seed()))
     exe = None
     key = None
     cache_state = "disabled"
@@ -389,7 +399,7 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
         if warm_start:
             seed = (_cold_seed() if Xi0 is None
                     else jnp.asarray(Xi0, dtype=_config.complex_dtype()))
-            call_args = (Hs, Tp, beta, seed)
+            call_args = (Hs, Tp, beta, _place_seed(seed))
         else:
             call_args = (Hs, Tp, beta)
         out = (exe.call(*call_args) if exe is not None

@@ -113,7 +113,8 @@ def variant_fowt(base: FOWTModel, theta: dict) -> FOWTModel:
       rA0, rB0     (nmem, 3)  member end positions
       d_scale      (nmem, 2)  diameter / side-length scales
       l_fill, rho_fill        per-member lists (ragged -> list of arrays)
-      moor_rFair0  (nl, 3), moor_rAnchor (nl, 3), moor_L (nl,)
+      moor_rFair0  (nl, 3), moor_rAnchor (nl, 3), moor_L (nl,),
+      moor_EA (nl,)
     """
     nmem = len(base.members)
 
@@ -147,12 +148,14 @@ def variant_fowt(base: FOWTModel, theta: dict) -> FOWTModel:
 
     moor = base.mooring
     if moor is not None and any(k in theta for k in
-                                ("moor_rFair0", "moor_rAnchor", "moor_L")):
+                                ("moor_rFair0", "moor_rAnchor", "moor_L",
+                                 "moor_EA")):
         moor = dataclasses.replace(
             moor,
             rFair0=jnp.asarray(theta.get("moor_rFair0", moor.rFair0), float),
             rAnchor=jnp.asarray(theta.get("moor_rAnchor", moor.rAnchor), float),
             L=jnp.asarray(theta.get("moor_L", moor.L), float),
+            EA=jnp.asarray(theta.get("moor_EA", moor.EA), float),
         )
 
     return dataclasses.replace(base, members=members, nodes=nodes,
@@ -201,7 +204,9 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
                         ballast: bool = True, nIter: int = 10,
                         tol: float = 0.01, XiStart: float = 0.1,
                         newton_iters: int = 20, fp_chunk: int = 2,
-                        mesh: Optional[Mesh] = None):
+                        mesh: Optional[Mesh] = None,
+                        implicit_diff: bool = False,
+                        adjoint_iters: Optional[int] = None):
     """Build the pure per-variant function θ -> outputs.
 
     ``mesh``: a named mesh with a ``freq`` axis reshards the
@@ -218,6 +223,15 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
 
     Outputs (per variant): mass, displacement, GMT, offset, pitch_deg (the
     parametersweep.py:9-21 metrics) plus Xi (6,nw) and std (6,).
+
+    ``implicit_diff``: route the statics Newton through the
+    implicit-function custom_vjp (``parallel/optimize.newton_implicit``
+    — forward math unchanged, backward = one adjoint solve with the
+    same tangent stiffness) and attach ``solve.implicit(theta)``, the
+    ``value_and_grad``-able pipeline whose drag fixed point likewise
+    differentiates implicitly (adjoint impedance solves dispatch
+    through ``ops/linalg.impedance_solve``).  The forward values of
+    ``solve``/``solve.batched`` are unchanged either way.
     """
     w = jnp.asarray(base.w)
     nw = len(base.w)
@@ -277,7 +291,11 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
                 F = F + mr.body_wrench(fowt.mooring, X)
             return F
 
-        Xeq = statics_newton(net_force, ref, iters=newton_iters)
+        if implicit_diff:
+            from raft_tpu.parallel.optimize import newton_implicit
+            Xeq = newton_implicit(net_force, ref, iters=newton_iters)
+        else:
+            Xeq = statics_newton(net_force, ref, iters=newton_iters)
 
         # ----- dynamics: drag fixed point + batched RAO solve -----
         hc = fowt_hydro_constants(fowt, pose0)
@@ -378,7 +396,25 @@ def make_variant_solver(base: FOWTModel, Hs=6.0, Tp=12.0, beta=0.0,
         out["fp_chunks"] = chunks
         return out
 
+    def solve_implicit(theta):
+        """Per-variant pipeline with implicit-diff fixed point — the
+        ``value_and_grad``-able forward of the co-design optimizer
+        (``parallel/optimize.py``).  Same math as ``solve`` (setup ->
+        drag fixed point -> stats); the drag fixed point runs through
+        the IFT ``custom_vjp`` so reverse-mode costs one adjoint fixed
+        point instead of an unrolled backprop."""
+        from raft_tpu.parallel.optimize import fixed_point_implicit
+
+        st = setup(theta)
+        Xi0 = jnp.zeros((6, nw), dtype=_config.complex_dtype()) + XiStart
+        Xi = fixed_point_implicit(lambda XiL: drag_step(st, XiL), Xi0,
+                                  nIter=nIter, tol=tol,
+                                  adjoint_iters=adjoint_iters)
+        return _finish(st, Xi)
+
     solve.batched = solve_batched
+    if implicit_diff:
+        solve.implicit = solve_implicit
     # introspection hooks (precision budgeting, tests)
     solve.setup = setup
     solve.drag_step = drag_step

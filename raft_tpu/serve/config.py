@@ -98,7 +98,9 @@ class ServeConfig:
     #: seed the drag fixed point of cache MISSES from the nearest
     #: cold-solved neighbor in (Hs, Tp, beta), guarded by the
     #: divergence watchdog + the warm audit (requires ``store_dir``;
-    #: not yet composed with ``mesh`` sharding)
+    #: composes with ``mesh`` — seeds are placed onto the mesh via the
+    #: partition rules' ``XI_SPEC``, exactly like the in-program
+    #: resharding boundary)
     warm_start: bool = False
     #: neighbor-seeding radius — Euclidean distance over (Hs [m],
     #: Tp [s], beta [rad]); a seed farther than this is worse than a
@@ -132,6 +134,13 @@ class ServeConfig:
     #: device); exec-cache keys carry the full ordered topology so warm
     #: tenancy composes with sharding
     mesh: object = None
+
+    # -- optimize tenant (parallel/optimize.py) ------------------------
+    #: resource guards on POST /optimize requests: descent lanes and
+    #: steps a single request may ask for (a compile-bomb spec is a
+    #: typed reject at admission, never a wedged service)
+    optimize_lanes_max: int = 256
+    optimize_steps_max: int = 200
 
     # -- tenancy (serve/tenancy.py) -----------------------------------
     #: warm compiled batch programs kept live across all tenants;
@@ -173,10 +182,12 @@ class ServeConfig:
             ("store_dir", self.store_dir is None
              or bool(str(self.store_dir).strip())),
             ("warm_start", not self.warm_start
-             or (self.store_dir is not None and self.mesh is None)),
+             or self.store_dir is not None),
             ("warm_radius", self.warm_radius > 0.0),
             ("warm_audit_every", self.warm_audit_every >= 1),
             ("max_live_programs", self.max_live_programs >= 1),
+            ("optimize_lanes_max", self.optimize_lanes_max >= 1),
+            ("optimize_steps_max", self.optimize_steps_max >= 1),
             ("nIter", self.nIter >= 1),
         ]
         bad = [name for name, ok in checks if not ok]

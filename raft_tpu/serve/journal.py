@@ -103,6 +103,19 @@ def request_digest(Hs: float, Tp: float, beta: float,
                            "beta": float(beta), "tenant": str(tenant)})
 
 
+def optimize_digest(spec: dict, tenant: str = "default") -> str:
+    """Content address of one design-optimization request: the dedupe/
+    single-flight key over the CANONICAL spec (bounds + objective +
+    descent knobs; json with sorted keys so dict ordering never forks
+    the digest) under the tenant."""
+    import json
+
+    from raft_tpu.obs.ledger import digest_metrics
+    return digest_metrics({"optimize": json.dumps(spec, sort_keys=True,
+                                                  default=str),
+                           "tenant": str(tenant)})
+
+
 class RequestJournal:
     """The service's append-only WAL (one per journal directory).
 
@@ -199,11 +212,18 @@ class RequestJournal:
 
     def record_admit(self, seq: int, request_id: str, rdigest: str,
                      Hs: float, Tp: float, beta: float,
-                     deadline_s: float, tenant: str):
-        self._write("admit", seq=int(seq), id=str(request_id),
-                    rdigest=rdigest, Hs=float(Hs), Tp=float(Tp),
-                    beta=float(beta), deadline_s=float(deadline_s),
-                    tenant=str(tenant))
+                     deadline_s: float, tenant: str, opt: dict = None):
+        """``opt`` (optimize tenant): the canonical design-optimization
+        request spec — bounds + objective + descent knobs.  Carried in
+        the admit record so replay can re-run an accepted-but-unfinished
+        optimization exactly as submitted."""
+        rec = dict(seq=int(seq), id=str(request_id),
+                   rdigest=rdigest, Hs=float(Hs), Tp=float(Tp),
+                   beta=float(beta), deadline_s=float(deadline_s),
+                   tenant=str(tenant))
+        if opt is not None:
+            rec["opt"] = dict(opt)
+        self._write("admit", **rec)
 
     def record_batch(self, batch_id: int, seqs: list[int], mode: str,
                      tenant: str):
@@ -213,11 +233,17 @@ class RequestJournal:
 
     def record_complete(self, seq: int, rdigest: str, digest: str,
                         mode: str, attempts: int, std: list,
-                        iters: int, converged: bool):
-        self._write("complete", seq=int(seq), rdigest=rdigest,
-                    digest=digest, mode=str(mode), attempts=int(attempts),
-                    std=[float(v) for v in std], iters=int(iters),
-                    converged=bool(converged))
+                        iters: int, converged: bool, extra: dict = None):
+        """``extra`` (optimize tenant): the digest-addressed result
+        payload beyond the std row — optimized design + provenance —
+        journaled so replay re-delivers it without re-descending."""
+        rec = dict(seq=int(seq), rdigest=rdigest,
+                   digest=digest, mode=str(mode), attempts=int(attempts),
+                   std=[float(v) for v in std], iters=int(iters),
+                   converged=bool(converged))
+        if extra is not None:
+            rec["extra"] = dict(extra)
+        self._write("complete", **rec)
 
     def record_fail(self, seq: int, rdigest: str, error: dict,
                     quarantined: bool):

@@ -106,6 +106,10 @@ class SweepResult:
     #: (journaled result re-delivered without a solve), or "deduped"
     #: (duplicate submission matched a completed request digest)
     source: str = "solved"
+    #: digest-addressed payload beyond the std row — the optimize
+    #: tenant's optimized design + provenance (iterations, final
+    #: gradient norm, objective trace); None for plain sweep results
+    extra: dict | None = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -140,10 +144,11 @@ class _Request:
     __slots__ = ("seq", "id", "Hs", "Tp", "beta", "deadline_ts",
                  "submitted_ts", "attempts", "total_attempts", "strikes",
                  "solo", "not_before", "ticket", "tenant", "rdigest",
-                 "replayed", "followers")
+                 "replayed", "followers", "opt")
 
     def __init__(self, seq, Hs, Tp, beta, deadline_ts, now,
-                 tenant=DEFAULT_TENANT, request_id=None, rdigest=None):
+                 tenant=DEFAULT_TENANT, request_id=None, rdigest=None,
+                 opt=None):
         self.seq = int(seq)
         self.id = request_id or f"req{seq}-{uuid.uuid4().hex[:8]}"
         self.Hs = float(Hs)
@@ -159,10 +164,16 @@ class _Request:
         self.tenant = str(tenant)
         # callers that already hashed the admission (the store-enabled
         # submit edge — the exact path the serve bench measures) pass
-        # the digest through instead of hashing twice
-        self.rdigest = rdigest or wal.request_digest(Hs, Tp, beta,
-                                                     self.tenant)
+        # the digest through instead of hashing twice; an optimize
+        # request is content-addressed over its spec, never its
+        # placeholder Hs/Tp/beta
+        self.rdigest = rdigest or (
+            wal.optimize_digest(opt, str(tenant)) if opt
+            else wal.request_digest(Hs, Tp, beta, self.tenant))
         self.replayed = False
+        #: optimize-tenant request: the canonical design-optimization
+        #: spec (bounds + objective + descent knobs); None = sweep case
+        self.opt = dict(opt) if opt else None
         #: single-flight followers: duplicate submissions attached to
         #: this (primary) request — they never enter the queue, and the
         #: primary's terminal outcome fans out to them
@@ -282,6 +293,18 @@ class SweepService:
                                       keep_xi=self.cfg.warm_start)
         #: rdigest -> the PRIMARY in-flight request duplicates attach to
         self._flight: dict[str, _Request] = {}
+        # -- optimize tenant (parallel/optimize.py): design-optimization
+        # requests ride their own bounded queue and dedicated worker —
+        # one descent is a whole compiled batch program, not a lane in
+        # a case batch — but share the WAL, the delivered-result
+        # indexes, the single-flight map, and the admission ladder
+        self._opt_queue: collections.deque[_Request] = collections.deque()
+        self._opt_worker: threading.Thread | None = None
+        self._opt_busy = False
+        #: EMA of one descent's wall time — the optimize queue's own
+        #: Retry-After basis (the sweep estimate knows nothing about
+        #: minutes-long descents)
+        self._opt_ema_s: float | None = None
         #: read-tier latencies (ms) for the p50/p99 summary facts
         self._read_ms: collections.deque[float] = collections.deque(
             maxlen=10_000)
@@ -377,15 +400,17 @@ class SweepService:
         while time.monotonic() < deadline:
             with self._lock:
                 idle = (not self._queue and not self._inflight
-                        and self._ngathered == 0)
+                        and self._ngathered == 0
+                        and not self._opt_queue and not self._opt_busy)
             if idle:
                 break
             time.sleep(0.02)
         with self._cond:
             self._state = "stopped"
             # flush anything left (non-drain stop or drain timeout)
-            leftovers = list(self._queue)
+            leftovers = list(self._queue) + list(self._opt_queue)
             self._queue.clear()
+            self._opt_queue.clear()
             self._cond.notify_all()
         for r in leftovers:
             self._fail(r, errors.DeadlineExceeded(
@@ -393,6 +418,9 @@ class SweepService:
         worker = self._worker
         if worker is not None:
             worker.join(2.0)
+        opt_worker = self._opt_worker
+        if opt_worker is not None:
+            opt_worker.join(2.0)
         self._watchdog.stop()
         summary = self.summary()
         if self._manifest is not None:
@@ -416,12 +444,17 @@ class SweepService:
         with self._open_lock:
             reqs = list(self._open.values())
         now = time.monotonic()
-        return [{"t": round(time.time(), 6), "type": "admit",
-                 "seq": r.seq, "id": r.id, "rdigest": r.rdigest,
-                 "Hs": r.Hs, "Tp": r.Tp, "beta": r.beta,
-                 "deadline_s": max(0.0, r.deadline_ts - now),
-                 "tenant": r.tenant, "checkpoint": True}
-                for r in reqs]
+        out = []
+        for r in reqs:
+            rec = {"t": round(time.time(), 6), "type": "admit",
+                   "seq": r.seq, "id": r.id, "rdigest": r.rdigest,
+                   "Hs": r.Hs, "Tp": r.Tp, "beta": r.beta,
+                   "deadline_s": max(0.0, r.deadline_ts - now),
+                   "tenant": r.tenant, "checkpoint": True}
+            if r.opt is not None:
+                rec["opt"] = dict(r.opt)
+            out.append(rec)
+        return out
 
     def _track_open(self, r: _Request):
         with self._open_lock:
@@ -516,6 +549,7 @@ class SweepService:
                     attempts=int(rec.get("attempts", 0)), latency_s=0.0,
                     digest=rec.get("digest"), std=rec.get("std"),
                     iters=rec.get("iters"), converged=rec.get("converged"),
+                    extra=rec.get("extra"),
                     tenant=str(state["admitted"].get(seq, {}).get(
                         "tenant", DEFAULT_TENANT)), source="recovered")
                 if rec.get("digest"):
@@ -557,13 +591,14 @@ class SweepService:
                     attempts=0, latency_s=0.0, digest=prior.get("digest"),
                     std=prior.get("std"), iters=prior.get("iters"),
                     converged=prior.get("converged"),
+                    extra=prior.get("extra"),
                     tenant=str(dup.get("tenant", DEFAULT_TENANT)),
                     source="deduped")
                 if self._journal is not None:
                     self._journal.record_complete(
                         seq, dup.get("rdigest"), prior.get("digest"),
                         res.mode, 0, res.std or [], res.iters or 0,
-                        bool(res.converged))
+                        bool(res.converged), extra=res.extra)
                 t = Ticket(res.request_id, seq)
                 t._finish(res)
                 tickets[int(orig)] = t
@@ -591,7 +626,7 @@ class SweepService:
                         self._journal.record_complete(
                             seq, rec.get("rdigest"), res.digest,
                             res.mode, 0, res.std or [], res.iters or 0,
-                            bool(res.converged))
+                            bool(res.converged), extra=res.extra)
                     t = Ticket(res.request_id, seq)
                     t._finish(res)
                     tickets[orig] = t
@@ -601,7 +636,9 @@ class SweepService:
                                rec.get("Tp", 1.0), rec.get("beta", 0.0),
                                now + deadline_s,
                                now, tenant=tenant,
-                               request_id=rec.get("id"))
+                               request_id=rec.get("id"),
+                               rdigest=rec.get("rdigest"),
+                               opt=rec.get("opt"))
                 req.replayed = True
                 tickets[orig] = req.ticket
                 # a foreign fold (a dead peer's mirror) replays admits
@@ -613,7 +650,7 @@ class SweepService:
                                                   or seq != orig):
                     self._journal.record_admit(
                         seq, req.id, req.rdigest, req.Hs, req.Tp,
-                        req.beta, deadline_s, tenant)
+                        req.beta, deadline_s, tenant, opt=req.opt)
                 if tenant not in self._tenants.names():
                     # the successor was configured without this tenant:
                     # a typed failure, never a silent drop
@@ -623,6 +660,23 @@ class SweepService:
                     self._fail(req, errors.ModelConfigError(
                         "replayed request names a tenant this service "
                         "does not carry", tenant=tenant, seq=seq))
+                    continue
+                if req.opt is not None:
+                    # an accepted-but-unfinished optimization replays
+                    # onto the optimize queue (re-run as submitted);
+                    # single-flight holds through replay like any
+                    # duplicate pair
+                    prim = self._flight.get(req.rdigest)
+                    if prim is not None and not prim.ticket.done():
+                        prim.followers.append(req)
+                        self._counts["coalesced"] += 1
+                    else:
+                        self._flight[req.rdigest] = req
+                        self._opt_queue.append(req)
+                    self._counts["admitted"] += 1
+                    self._replayed_pending.add(seq)
+                    self._track_open(req)
+                    replayed += 1
                     continue
                 if self._store is not None:
                     # single-flight holds through replay too: a second
@@ -649,6 +703,8 @@ class SweepService:
             # admissions and replayed backoff keys can never collide
             self._seq = max(self._seq, state["max_seq"] + 1, next_fresh)
             self._cond.notify_all()
+        if self._opt_queue:
+            self._ensure_opt_worker()
         info = {"recovered": recovered, "replayed": replayed,
                 "deduped": deduped, "corrupt": int(state["corrupt"])}
         # accumulate across calls (own journal, then a peer's mirror);
@@ -898,6 +954,286 @@ class SweepService:
                     "request admissions/outcomes of the sweep service"
                     ).inc(1.0, outcome="admitted")
         return req.ticket
+
+    # ------------------------------------------------------------------
+    # optimize tenant: batched design descents as journaled requests
+    # ------------------------------------------------------------------
+
+    def submit_optimize(self, spec: dict, deadline_s: float = None,
+                        tenant: str = DEFAULT_TENANT) -> Ticket:
+        """Admit one design-optimization request; returns its
+        :class:`Ticket` whose :class:`SweepResult` carries the
+        digest-addressed optimized design with full provenance
+        (iterations, final gradient norm, objective trace) in
+        ``result.extra``.
+
+        ``spec`` is the JSON request body: ``{"bounds": {design_var:
+        [lo, hi]}, "objective": {...}, "nlanes", "steps", "method",
+        "lr", "gtol", "seed", "nIter", "tol"}`` — validated and
+        canonicalized (typed :class:`~raft_tpu.errors.ModelConfigError`
+        on junk, with ``cfg.optimize_lanes_max``/``optimize_steps_max``
+        as resource guards).  Requests are content-addressed over the
+        canonical spec + tenant: a repeat of an already-delivered
+        optimization resolves from the result index without
+        re-descending, and a duplicate of one in flight attaches to it
+        single-flight.  With a journal configured the admission is
+        WAL-journaled (admit record carrying the spec) BEFORE the
+        ticket returns, and the terminal record carries the optimized
+        design — replay after a crash re-delivers completed
+        optimizations and re-runs accepted-unfinished ones."""
+        from raft_tpu.parallel import optimize as optmod
+
+        obs = self._obs()
+        tenant = self._tenants.require(tenant)
+        spec = optmod.normalize_request(
+            spec, lanes_max=self.cfg.optimize_lanes_max,
+            steps_max=self.cfg.optimize_steps_max)
+        rdigest = wal.optimize_digest(spec, tenant)
+        now = time.monotonic()
+        deadline_s = float(deadline_s if deadline_s is not None
+                           else self.cfg.deadline_s)
+        follower = None
+        dedup = None
+        with self._cond:
+            # the load-shed hint must reflect THIS queue's cadence: a
+            # descent runs minutes, not a batch window — estimate from
+            # the optimize backlog and the observed descent EMA (the
+            # first-ever descent has no EMA; a conservative 60 s beats
+            # telling callers to hammer a compiling service)
+            retry_after = max(
+                self._estimate_wait_locked(),
+                (len(self._opt_queue) + (1 if self._opt_busy else 0))
+                * float(self._opt_ema_s or 60.0))
+            reason = None
+            if self._state in ("draining", "stopped"):
+                reason = "stopped"
+            else:
+                prior_digest = self._rdigest_index.get(rdigest)
+                prior = (self._delivered.get(prior_digest)
+                         if prior_digest else None)
+                if prior is not None and prior.ok:
+                    seq = self._seq
+                    self._seq += 1
+                    dedup = dataclasses.replace(
+                        prior, request_id=f"opt{seq}-{uuid.uuid4().hex[:8]}",
+                        seq=seq, attempts=0, latency_s=0.0,
+                        source="deduped")
+                else:
+                    prim = self._flight.get(rdigest)
+                    if prim is not None and not prim.ticket.done():
+                        seq = self._seq
+                        self._seq += 1
+                        follower = _Request(seq, 0.0, 1.0, 0.0,
+                                            now + deadline_s, now,
+                                            tenant=tenant,
+                                            rdigest=rdigest, opt=spec)
+                        self._track_open(follower)
+                        prim.followers.append(follower)
+                        self._counts["admitted"] += 1
+                        self._counts["coalesced"] += 1
+            if dedup is None and follower is None and reason is None:
+                if self.ladder[self._mode_idx] == "reject":
+                    reason = "degraded"
+                    retry_after = max(retry_after,
+                                      self.cfg.reject_hold_s)
+                elif len(self._opt_queue) >= self.cfg.queue_max:
+                    reason = "queue_full"
+            if reason is not None:
+                self._counts["rejected"] += 1
+            elif dedup is None and follower is None:
+                seq = self._seq
+                self._seq += 1
+                req = _Request(seq, 0.0, 1.0, 0.0, now + deadline_s,
+                               now, tenant=tenant, rdigest=rdigest,
+                               opt=spec)
+                # track BEFORE the request becomes poppable: an
+                # already-running opt worker may terminate it the
+                # instant it appears on the queue, and untrack-then-
+                # track would pin the seq in _open for the process
+                # lifetime (same ordering contract as the follower
+                # attach above)
+                self._track_open(req)
+                self._opt_queue.append(req)
+                self._flight[rdigest] = req
+                self._counts["admitted"] += 1
+                self._cond.notify_all()
+        if reason is not None:
+            self._tenants.count(tenant, "rejected")
+            obs.counter(
+                "raft_tpu_serve_admission_rejects_total",
+                "requests shed at admission, by reason").inc(
+                    1.0, reason=reason)
+            self._emit("admission_reject", reason=reason,
+                       retry_after_s=retry_after, optimize=True)
+            raise errors.AdmissionRejected(
+                f"admission rejected ({reason})",
+                retry_after_s=retry_after, reason=reason,
+                optimize=True)
+        obs.counter(
+            "raft_tpu_serve_optimize_requests_total",
+            "optimize-tenant request admissions/outcomes").inc(
+                1.0, outcome="deduped" if dedup is not None
+                else "admitted")
+        if dedup is not None:
+            # the caller holds the payload synchronously — like a
+            # result-store hit, nothing a crash could lose, so the
+            # dedupe is deliberately not journaled
+            t = Ticket(dedup.request_id, dedup.seq)
+            t._finish(dedup)
+            return t
+        r = follower if follower is not None else req
+        # WAL before ack, spec on the admit record: an accepted
+        # optimization survives a crash and replays as submitted
+        if self._journal is not None:
+            self._journal.record_admit(r.seq, r.id, r.rdigest, r.Hs,
+                                       r.Tp, r.beta, deadline_s, tenant,
+                                       opt=spec)
+        if follower is not None:
+            self._emit("coalesced", req=r.seq, rdigest=r.rdigest,
+                       optimize=True)
+        else:
+            self._ensure_opt_worker()
+        self._tenants.count(tenant, "admitted")
+        obs.counter("raft_tpu_serve_requests_total",
+                    "request admissions/outcomes of the sweep service"
+                    ).inc(1.0, outcome="admitted")
+        return r.ticket
+
+    def _ensure_opt_worker(self):
+        with self._lock:
+            if self._opt_worker is not None \
+                    and self._opt_worker.is_alive():
+                return
+            t = threading.Thread(target=self._opt_worker_loop,
+                                 name="raft-serve-optimize",
+                                 daemon=True)
+            self._opt_worker = t
+        t.start()
+
+    def _opt_worker_loop(self):
+        while True:
+            with self._cond:
+                while not self._opt_queue and self._state != "stopped":
+                    self._cond.wait(0.25)
+                if not self._opt_queue:
+                    return                       # stopped and drained
+                r = self._opt_queue.popleft()
+                self._opt_busy = True
+            try:
+                self._run_optimize(r)
+            except errors.RaftError as e:
+                self._fail(r, e)
+            # the worker seam mirrors the sweep worker's config-
+            # sanctioned contract: a bug becomes a typed result +
+            # counted unhandled, never a dead service
+            except BaseException as e:  # raftlint: disable=RTL004
+                _LOG.error("optimize worker: unhandled %s",
+                           type(e).__name__, exc_info=True)
+                with self._lock:
+                    self._counts["unhandled"] += 1
+                self._fail(r, errors.KernelFailure(
+                    f"unhandled optimize failure: "
+                    f"{type(e).__name__}: {e}", req=r.seq))
+            finally:
+                with self._cond:
+                    self._opt_busy = False
+                    self._cond.notify_all()
+
+    def _run_optimize(self, r: _Request):
+        """One journaled design optimization end to end."""
+        from raft_tpu.parallel import optimize as optmod
+
+        if r.deadline_ts < time.monotonic():
+            with self._lock:
+                self._counts["deadline_misses"] += 1
+            self._fail(r, errors.DeadlineExceeded(
+                "optimize request expired before its descent started",
+                req=r.seq))
+            return
+        spec = r.opt
+        fowt = self._tenants.fowts(r.tenant).get("full")
+        if fowt is None:
+            self._fail(r, errors.ModelConfigError(
+                "optimize tenant has no full-mode model",
+                tenant=r.tenant))
+            return
+        space = optmod.DesignSpace(
+            fowt, {k: tuple(v) for k, v in spec["bounds"].items()})
+        with self._obs().span("serve_optimize", req=r.seq,
+                              nlanes=spec["nlanes"]):
+            out = optmod.optimize_designs(
+                fowt, space, objective=spec["objective"],
+                nlanes=spec["nlanes"], steps=spec["steps"],
+                method=spec["method"], lr=spec["lr"],
+                gtol=spec["gtol"], seed=spec["seed"],
+                nIter=spec["nIter"], tol=spec["tol"])
+        best = int(out["lane_best"])
+        prov = dict(out["provenance"])
+        wall = float(prov.get("wall_s") or 0.0)
+        if wall > 0.0:
+            with self._lock:
+                self._opt_ema_s = (wall if self._opt_ema_s is None
+                                   else 0.7 * self._opt_ema_s
+                                   + 0.3 * wall)
+        prov["objective_trace"] = [
+            float(v) for v in out["obj_trace"][:, best]]
+        payload = {"design": out["design"],
+                   "x_best": [float(v) for v in out["x_best"]],
+                   "f_best": float(out["f_best"]),
+                   "provenance": prov}
+        self._complete_optimize(r, payload)
+
+    def _complete_optimize(self, r: _Request, payload: dict):
+        """Deliver + journal one optimize result (the optimize twin of
+        ``_complete``): digest-addressed over the optimized design,
+        WAL-terminal before the ticket resolves, indexed for dedupe and
+        cross-replica re-resolution, fanned out to single-flight
+        followers."""
+        import json as _json
+
+        obs = self._obs()
+        from raft_tpu.obs.ledger import digest_metrics
+        digest = digest_metrics({
+            "optimize": _json.dumps(payload["design"], sort_keys=True),
+            "f_best": payload["f_best"],
+            "iterations": payload["provenance"]["iterations"]})
+        prov = payload["provenance"]
+        res = SweepResult(
+            ok=True, digest=digest, std=[float(payload["f_best"])],
+            iters=int(prov["iterations"]),
+            converged=bool(prov["converged"] > 0), extra=payload,
+            source="replayed" if r.replayed else "solved",
+            **self._result_base(r, "optimize"))
+        if self._journal is not None:
+            self._journal.record_complete(
+                r.seq, r.rdigest, digest, "optimize",
+                r.total_attempts, res.std, res.iters, res.converged,
+                extra=payload)
+        with self._lock:
+            self._counts["completed"] += 1
+            self._latencies.append(res.latency_s)
+            self._delivered[digest] = res
+            self._rdigest_index[r.rdigest] = digest
+            while len(self._delivered) > self.cfg.result_cache:
+                self._delivered.popitem(last=False)
+            while len(self._rdigest_index) > self.cfg.result_cache:
+                self._rdigest_index.popitem(last=False)
+            self._replayed_pending.discard(r.seq)
+        self._untrack_open(r.seq)
+        self._tenants.count(r.tenant, "completed")
+        obs.counter("raft_tpu_serve_requests_total",
+                    "request admissions/outcomes of the sweep service"
+                    ).inc(1.0, outcome="ok")
+        obs.counter(
+            "raft_tpu_serve_optimize_requests_total",
+            "optimize-tenant request admissions/outcomes").inc(
+                1.0, outcome="ok")
+        self._emit("request_done", req=r.seq, digest=digest,
+                   latency_s=res.latency_s, mode="optimize",
+                   attempts=r.total_attempts,
+                   f_best=payload["f_best"])
+        r.ticket._finish(res)
+        self._fanout_complete(r, res)
 
     # ------------------------------------------------------------------
     # worker: gather -> solve -> split
@@ -1509,7 +1845,7 @@ class SweepService:
             if self._journal is not None:
                 self._journal.record_complete(
                     f.seq, f.rdigest, res.digest, res.mode, 0, res.std,
-                    res.iters, res.converged)
+                    res.iters, res.converged, extra=res.extra)
             with self._lock:
                 self._counts["completed"] += 1
                 self._latencies.append(fres.latency_s)

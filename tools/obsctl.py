@@ -365,6 +365,9 @@ def _fmt_event(e: dict) -> str | None:
     if t == "warm_start_rejected":
         return (f"{ts} WARM-START rejected lane {e.get('lane')} "
                 f"({e.get('outcome')}: {e.get('detail')})")
+    if t == "statics_warm_rejected":
+        return (f"{ts} STATICS warm seed rejected case {e.get('case')} "
+                f"(iters {e.get('iters')}; cold re-solve)")
     return None
 
 

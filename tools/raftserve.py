@@ -342,6 +342,49 @@ def cmd_serve(args) -> int:
                 threading.Thread(target=srv.shutdown,
                                  daemon=True).start()
                 return
+            if self.path == "/optimize":
+                # optimize tenant: bounds + objective spec in, a
+                # journaled digest-addressed optimized design out
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(doc, dict):
+                        raise ValueError("body must be a JSON object")
+                    tenant = str(doc.pop("tenant", "default"))
+                    wait = doc.pop("wait", False)
+                    deadline_s = doc.pop("deadline_s", None)
+                    if deadline_s is not None:
+                        deadline_s = float(deadline_s)
+                        if not (deadline_s > 0.0):
+                            raise ValueError("deadline_s must be > 0")
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    t = service.submit_optimize(doc,
+                                                deadline_s=deadline_s,
+                                                tenant=tenant)
+                except errors.AdmissionRejected as e:
+                    self._send(429, e.context(),
+                               headers={"Retry-After":
+                                        f"{max(1, round(e.retry_after_s))}"})
+                    return
+                except errors.ModelConfigError as e:
+                    self._send(400, e.context())
+                    return
+                _track(t)
+                if wait:
+                    try:
+                        res = t.result((deadline_s or cfg.deadline_s)
+                                       + 5.0)
+                    except errors.DeadlineExceeded as e:
+                        self._send(504, e.context())
+                        return
+                    self._send(200, res.to_dict())
+                else:
+                    self._send(202, {"request_id": t.id, "seq": t.seq})
+                return
             if self.path != "/submit":
                 self._send(404, {"error": "not found"})
                 return
@@ -403,7 +446,8 @@ def cmd_serve(args) -> int:
         threading.Thread(target=_drain, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _on_sigterm)
-    print(f"raftserve: http://{host}:{port}/  (submit, result, drain, "
+    print(f"raftserve: http://{host}:{port}/  (submit, optimize, "
+          f"result, drain, "
           f"stats, healthz; design={args.design}, "
           f"batch={cfg.batch_cases}, "
           f"ladder={'->'.join(service.ladder)}, "

@@ -126,6 +126,19 @@ class ResultStoreCorrupt(CacheCorruption):
     phase = "cache"
 
 
+class StorageExhausted(RaftError, OSError):
+    """A persistence tier (WAL, result store, checkpoint store, exec
+    cache) hit *proven* resource exhaustion — an ``ENOSPC`` write
+    failure, or a configured ``disk_budget_bytes`` ceiling.  Raised only
+    from write paths whose failure the caller can shed gracefully: the
+    service degradation ladder drops checkpointing first, then the
+    result-store write-through, while admission and delivery stay alive
+    on a full disk (``docs/robustness.md`` "Preemption & storage").
+    ``OSError`` base keeps pre-taxonomy filesystem handling working."""
+
+    phase = "storage"
+
+
 class WarmStartRejected(RaftError, RuntimeError):
     """A neighbor-seeded (warm-started) solve tripped the divergence
     guard — the seeded iteration failed to converge, went non-finite,

@@ -45,6 +45,48 @@ def dumps(doc: dict) -> str:
     return json.dumps(doc, separators=(",", ":"), default=_default)
 
 
+def fsync_write(path: str, data: bytes):
+    """The one crash-safe whole-file write every persistence tier uses:
+    per-writer tmp name (concurrent same-path puts from sibling
+    replicas/threads must not truncate each other mid-commit) ->
+    write -> flush -> fsync -> atomic rename.  Raises on I/O trouble
+    (the caller owns its degradation: count-and-miss, typed
+    ``StorageExhausted`` on proven ENOSPC, ...); the tmp file is
+    unlinked on any failure.  raftlint RTL007 statically pins every
+    persistence module's write path onto this helper."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def dir_bytes(path: str) -> int:
+    """Total payload bytes under ``path`` (non-recursive — every
+    journal/store tier is directory-flat), 0 when unreadable.  Feeds
+    the per-component ``raft_tpu_disk_bytes`` gauges."""
+    total = 0
+    try:
+        with os.scandir(path) as entries:
+            for e in entries:
+                try:
+                    if e.is_file(follow_symlinks=False):
+                        total += e.stat(follow_symlinks=False).st_size
+                except OSError:
+                    continue
+    except OSError:
+        return 0
+    return total
+
+
 def count_corrupt(kind: str, n: int = 1):
     """Count torn/corrupt journal entries in the shared
     ``raft_tpu_journal_corrupt_total{kind}`` counter (never raises —

@@ -320,6 +320,19 @@ def record_solve_dispatch(backend: str, n, batch_elems, fused: bool = False):
           ).set(float(batch_elems), backend=str(backend))
 
 
+def record_disk_bytes(component: str, nbytes) -> None:
+    """Set the per-component persistence disk gauge
+    (``raft_tpu_disk_bytes{component}``: journal / resultstore /
+    checkpoint / exec_cache) — the storage-fault ladder's operator
+    surface (docs/robustness.md "Preemption & storage").  Components
+    are a small fixed vocabulary, so the series cardinality stays
+    bounded."""
+    gauge("raft_tpu_disk_bytes",
+          "bytes held on disk per persistence component (journal / "
+          "resultstore / checkpoint / exec_cache)").set(
+              float(nbytes), component=str(component))
+
+
 def record_exec_cache_event(event: str):
     """Count a persistent executable-cache event (hit/miss/store/error),
     from ``parallel.exec_cache`` — also streamed to the flight recorder

@@ -200,6 +200,15 @@ def facts_from_manifest(doc: dict) -> dict:
                 facts[f"serve_{k}"] = serve[k]
         if serve.get("mode"):
             facts["serve_mode"] = str(serve["mode"])
+        # preemption-tolerance + storage facts (serve/checkpoint.py):
+        # unprefixed ckpt_*/disk_* names, present only on
+        # checkpoint-enabled / disk-accounted service rows
+        for k in ("ckpt_writes", "ckpt_corrupt", "ckpt_resumes",
+                  "ckpt_resumed_from_step", "ckpt_resumed",
+                  "ckpt_shed", "store_shed", "disk_journal_bytes",
+                  "disk_resultstore_bytes", "disk_checkpoint_bytes"):
+            if _num(serve.get(k)) is not None:
+                facts[k] = serve[k]
     # serving-throughput bench facts (bench.py serve): one row per
     # sustained-throughput run, trended by `obsctl trend --db`
     sbench = extra.get("serve_bench") or {}
@@ -226,7 +235,13 @@ def facts_from_manifest(doc: dict) -> dict:
                       "descents_per_min", "adjoint_s_per_step",
                       "speedup_vs_dense_sweep", "dense_points",
                       "objective_gap", "design_gap_max_spacing",
-                      "argmin_match", "converged_lanes"):
+                      "argmin_match", "converged_lanes",
+                      # checkpoint facts (segmented descents): the
+                      # bench's segmented-vs-monolithic wall ratio and
+                      # the per-run resume/write census
+                      "ckpt_overhead_ratio", "checkpoint_every",
+                      "resumed_from_step", "ckpt_writes", "segments",
+                      "ckpt_segmented_bitwise"):
                 if _num(opt.get(k)) is not None:
                     facts[f"optimize_{k}"] = opt[k]
             if opt.get("method"):
@@ -234,6 +249,18 @@ def facts_from_manifest(doc: dict) -> dict:
             if opt.get("exec_cache"):
                 facts["optimize_exec_cache_warm"] = int(
                     opt["exec_cache"] == "hit")
+    # preemption chaos soak facts (serve/soak.py run_preempt):
+    # ground-truth resume/storage integrity measured against the clean
+    # uninterrupted run — the two zero-tolerance rules below gate them
+    preempt = extra.get("serve_preempt") or {}
+    if isinstance(preempt, dict):
+        for k in ("ckpt_resume_digest_mismatch",
+                  "storage_corrupt_served_count",
+                  "ckpt_resumed_from_step", "ckpt_writes",
+                  "ckpt_resumes", "ckpt_corrupt", "checkpoint_every",
+                  "preempt_lost", "storage_sheds"):
+            if _num(preempt.get(k)) is not None:
+                facts[k] = preempt[k]
     # duplicate-storm soak facts (serve/soak.py run_storm): ground-truth
     # integrity counts measured against the clean reference digests
     storm = extra.get("serve_storm") or {}
@@ -483,6 +510,20 @@ DEFAULT_SLO_RULES = [
     {"name": "serve_warm_start_digest_mismatch",
      "fact": "serve_warm_start_digest_mismatch", "agg": "max",
      "op": "<=", "threshold": 0.0, "window": 20},
+    # -- preemption-tolerance gates (serve/checkpoint.py; facts exist
+    # only on resumed / storage-fault rows — the preempt soak's
+    # ground-truth comparison and checkpoint-enabled service
+    # summaries — so ordinary runs skip).  Both are zero-tolerance: a
+    # resumed descent whose final digest differs from the
+    # uninterrupted run means the checkpoint carry lied; a corrupt
+    # byte served from any store during a storage-fault wave is never
+    # acceptable.
+    {"name": "ckpt_resume_digest_mismatch",
+     "fact": "ckpt_resume_digest_mismatch", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
+    {"name": "storage_corrupt_served_count",
+     "fact": "storage_corrupt_served_count", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
     # -- mixed-precision ladder gate (bench_kernels.py; skipped when no
     # mixed-ladder bench row exists).  A promoted-lane ratio near 1.0
     # means the mixed ladder silently degenerated to an all-f64

@@ -317,6 +317,10 @@ def store(fn_jitted, args, key: str, meta: dict = None) -> str | None:
 
     bin_path, meta_path = _paths(key)
     try:
+        from raft_tpu.testing import faults
+        if faults.fire_info("exec_cache", action="enospc") is not None:
+            import errno as _errno
+            raise OSError(_errno.ENOSPC, "injected ENOSPC (fault)")
         exported = jexport.export(fn_jitted)(*args)
         data = bytes(exported.serialize())
         os.makedirs(cache_dir(), exist_ok=True)
@@ -331,9 +335,20 @@ def store(fn_jitted, args, key: str, meta: dict = None) -> str | None:
             json.dump(doc, f, indent=1, default=str)
         os.replace(tmp, meta_path)
     # the store is best-effort: an unwritable/full cache dir must not
-    # take down the solve that just compiled successfully
-    except Exception:  # raftlint: disable=RTL004
+    # take down the solve that just compiled successfully.  A PROVEN
+    # full disk additionally emits the storage_degraded signal the
+    # ENOSPC dashboards key on — the cache never sheds (every store is
+    # already optional), it just becomes visible
+    except Exception as e:  # raftlint: disable=RTL004
         _count("error")
+        try:
+            from raft_tpu.serve.checkpoint import is_enospc
+            if is_enospc(e):
+                from raft_tpu import obs
+                obs.events.emit("storage_degraded",
+                                component="exec_cache")
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
         return None
     _count("store")
     return bin_path

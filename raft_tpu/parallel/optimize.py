@@ -625,36 +625,45 @@ def make_descent(base, space: DesignSpace, objective=None,
                 mask.reshape(mask.shape + (1,) * (jnp.ndim(a) - 1)), a, b),
             old, new)
 
-    def descend(X0):
+    def init_carry(X0):
         X0 = jnp.asarray(X0, rdt)
         L = X0.shape[0]
         state0 = jax.vmap(opt.init)(X0)
-        carry0 = (X0, state0, jnp.zeros(L, bool), jnp.zeros(L, bool),
-                  jnp.zeros(L, jnp.int32))
+        return (X0, state0, jnp.zeros(L, bool), jnp.zeros(L, bool),
+                jnp.zeros(L, jnp.int32))
 
-        def body(carry, _):
-            x, state, done, bad, iters = carry
-            v, g = jax.vmap(vg)(x)
-            finite = jax.vmap(_finite_lane)(v, g)
-            bad_now = bad | (~finite & ~done)
-            g_safe = jnp.nan_to_num(g, nan=0.0, posinf=0.0, neginf=0.0)
-            v_safe = jnp.nan_to_num(v, nan=0.0, posinf=0.0, neginf=0.0)
-            x_new, state_new = jax.vmap(lane_update)(x, state, v_safe,
-                                                     g_safe)
-            frozen = done | bad_now
-            x_new = jnp.where(frozen[:, None], x, x_new)
-            state_new = _freeze(frozen, state, state_new)
-            gnorm = jnp.max(jnp.abs(g_safe), axis=-1)
-            moved = jnp.max(jnp.abs(x_new - x), axis=-1)
-            conv = finite & ((gnorm <= gtol) | ((moved <= xtol)
-                                                & (xtol > 0.0)))
-            iters = iters + jnp.where(frozen, 0, 1)
-            done = done | conv
-            return ((x_new, state_new, done, bad_now, iters),
-                    (v, gnorm))
+    def body(carry, _):
+        x, state, done, bad, iters = carry
+        v, g = jax.vmap(vg)(x)
+        finite = jax.vmap(_finite_lane)(v, g)
+        bad_now = bad | (~finite & ~done)
+        g_safe = jnp.nan_to_num(g, nan=0.0, posinf=0.0, neginf=0.0)
+        v_safe = jnp.nan_to_num(v, nan=0.0, posinf=0.0, neginf=0.0)
+        x_new, state_new = jax.vmap(lane_update)(x, state, v_safe,
+                                                 g_safe)
+        frozen = done | bad_now
+        x_new = jnp.where(frozen[:, None], x, x_new)
+        state_new = _freeze(frozen, state, state_new)
+        gnorm = jnp.max(jnp.abs(g_safe), axis=-1)
+        moved = jnp.max(jnp.abs(x_new - x), axis=-1)
+        conv = finite & ((gnorm <= gtol) | ((moved <= xtol)
+                                            & (xtol > 0.0)))
+        iters = iters + jnp.where(frozen, 0, 1)
+        done = done | conv
+        return ((x_new, state_new, done, bad_now, iters),
+                (v, gnorm))
 
-        (x, _, done, bad, iters), (obj_trace, gnorm_trace) = \
-            jax.lax.scan(body, carry0, None, length=steps)
+    def segment(carry, seg_len):
+        """``seg_len`` descent steps from ``carry`` — the checkpoint
+        unit.  Chaining segments is numerically THE monolithic scan:
+        ``lax.scan`` threads the identical carry through the identical
+        body, so a ``checkpoint_every`` chunking reproduces the
+        uninterrupted descent bitwise (pinned by
+        tests/test_checkpoint.py)."""
+        return jax.lax.scan(body, carry, None, length=int(seg_len))
+
+    def finalize(carry, obj_trace, gnorm_trace):
+        x, _, done, bad, iters = carry
         v_fin, g_fin = jax.vmap(vg)(x)
         return {"x": x, "objective": v_fin,
                 "grad_norm": jnp.max(jnp.abs(
@@ -663,9 +672,255 @@ def make_descent(base, space: DesignSpace, objective=None,
                 "iters": iters, "obj_trace": obj_trace,
                 "gnorm_trace": gnorm_trace}
 
+    def descend(X0):
+        carry, (obj_trace, gnorm_trace) = segment(init_carry(X0), steps)
+        return finalize(carry, obj_trace, gnorm_trace)
+
     descend.objective_spec = obj.spec
     descend.space = space
+    descend.init_carry = init_carry
+    descend.segment = segment
+    descend.finalize = finalize
     return descend
+
+
+def _ckpt_identity(base, space, spec, method, steps, lr, gtol, xtol,
+                   nlanes, every, obj_kw=None) -> str:
+    """Content identity of one checkpointable descent — what a resume
+    must agree on before trusting a persisted carry.  EVERY knob that
+    shapes the numerics participates (the solver kwargs ``nIter``/
+    ``tol``/``adjoint_iters``/... included — the carry layout alone
+    cannot distinguish them); a checkpoint from a different spec is
+    ignored (a fresh start), never mis-resumed."""
+    from raft_tpu.obs.ledger import digest_metrics
+    from raft_tpu.parallel import exec_cache
+
+    return digest_metrics({
+        "model": exec_cache.model_digest(base),
+        "space": json.dumps(space.fingerprint(), sort_keys=True),
+        "objective": json.dumps(spec, sort_keys=True),
+        "method": str(method), "steps": int(steps), "lr": float(lr),
+        "gtol": float(gtol), "xtol": float(xtol),
+        "nlanes": int(nlanes), "every": int(every),
+        "kw": json.dumps({k: v for k, v in (obj_kw or {}).items()
+                          if isinstance(v, (int, float, str, bool))},
+                         sort_keys=True)})
+
+
+def _aot_program(fn_jitted, args, key_facts: dict, ckpt_fact: dict,
+                 span_name: str):
+    """Load-or-compile one AOT program under the ``fn="optimize"``
+    exec-cache identity extended by the ``ckpt`` fact (segment length /
+    phase) — the monolithic descent's cache discipline, applied to each
+    segment program.  Returns ``(call, state)`` where ``call(*args)``
+    runs the program (a cached executable that fails its first call
+    recompiles once, like the monolithic path)."""
+    from raft_tpu import obs
+    from raft_tpu.parallel import exec_cache
+
+    key = None
+    exe = None
+    state = "disabled"
+    if exec_cache.enabled():
+        key = exec_cache.make_key(**key_facts, ckpt=ckpt_fact)
+        exe = exec_cache.load(key)
+        state = "hit" if exe is not None else "miss"
+
+    compiled = [None]
+
+    def _compile():
+        probe_gate = (obs.probes.suppress("aot-exported program")
+                      if key is not None else contextlib.nullcontext())
+        with obs.span(span_name), probe_gate:
+            compiled[0] = fn_jitted.lower(*args).compile()
+        if key is not None:
+            with obs.probes.suppress("aot-exported program"):
+                exec_cache.store(fn_jitted, args, key,
+                                 meta={"fn": "optimize",
+                                       "ckpt": ckpt_fact})
+        return compiled[0]
+
+    def call(*a):
+        nonlocal exe
+        if exe is not None:
+            try:
+                return exe.call(*a)
+            except exec_cache.CALL_ERRORS as e:
+                from raft_tpu.utils.profiling import get_logger
+                get_logger("optimize").warning(
+                    "cached optimize segment executable %s failed "
+                    "(%s: %s) — recompiling", key, type(e).__name__, e)
+                exec_cache._count("error")
+                exe = None
+        if compiled[0] is None:
+            _compile()
+        return compiled[0](*a)
+
+    return call, state
+
+
+def _segmented_descent(descend, x0, *, every: int, steps: int,
+                       key_facts: dict, ckpt_store=None,
+                       ckpt_key: str = None, on_checkpoint=None,
+                       identity: str = None,
+                       resume_only: bool = False):
+    """The chunked outer loop around :func:`make_descent`'s segment
+    program: ``every`` steps per compiled segment (the SAME exec-cached
+    program reused per segment), the carry pulled once per segment
+    under the sanctioned-transfer budget and persisted via the
+    checkpoint store, a resume from the newest valid checkpoint, the
+    ``kill@optimize:step=N`` preemption seam at every segment boundary,
+    and the typed :class:`~raft_tpu.errors.StorageExhausted` shed
+    (checkpointing stops, the descent keeps its on-device progress).
+
+    Returns ``(out, cache_state, ckpt_info)`` where ``out`` is the
+    device-side result pytree of the monolithic ``descend`` —
+    bitwise-identical by construction (same scan body, same carry
+    threading, same finalize)."""
+    import os as _os
+
+    from raft_tpu import obs
+    from raft_tpu.testing import faults
+
+    obs_events = obs.events
+    L = int(x0.shape[0])
+    carry = descend.init_carry(x0)
+    treedef = jax.tree.structure(carry)
+    leaves0 = jax.tree.leaves(carry)
+    shapes = [(tuple(l.shape), l.dtype) for l in leaves0]
+
+    # -- resume: the newest VALID checkpoint whose identity + carry
+    # layout agree; anything else is a fresh start, never a mis-resume
+    resumed_from = 0
+    ot_parts: list = []
+    gt_parts: list = []
+    if ckpt_store is not None and ckpt_key:
+        found = ckpt_store.latest(ckpt_key, max_step=steps)
+        if found is not None:
+            step0, arrays, meta = found
+            leaves = None
+            if (meta.get("identity") == identity
+                    and int(meta.get("nleaves", -1)) == len(shapes)):
+                try:
+                    leaves = [jnp.asarray(arrays[f"c{i}"])
+                              for i in range(len(shapes))]
+                    ot = jnp.asarray(arrays["obj_trace"])
+                    gt = jnp.asarray(arrays["gnorm_trace"])
+                except KeyError:
+                    leaves = None
+            if leaves is not None and all(
+                    tuple(l.shape) == s and l.dtype == d
+                    for l, (s, d) in zip(leaves, shapes)) \
+                    and ot.shape == (int(step0), L):
+                carry = jax.tree.unflatten(treedef, leaves)
+                ot_parts, gt_parts = [ot], [gt]
+                resumed_from = int(step0)
+                obs.counter(
+                    "raft_tpu_checkpoint_resumes_total",
+                    "descents resumed from a persisted checkpoint "
+                    "instead of step 0").inc(1.0)
+                obs_events.emit("ckpt_resume", step=resumed_from,
+                                steps=int(steps), key=str(ckpt_key)[:24])
+            else:
+                obs_events.emit("ckpt_resume_rejected",
+                                step=int(step0), key=str(ckpt_key)[:24])
+
+    progs: dict = {}
+    states: list = []
+
+    def prog_for(seg_len, carry_ex):
+        if seg_len not in progs:
+            n = int(seg_len)             # static scan length, host-side
+            fn = jax.jit(lambda c: descend.segment(c, n))
+            call, state = _aot_program(
+                fn, (carry_ex,), key_facts,
+                {"every": int(every), "seg_len": int(seg_len),
+                 "phase": "segment"}, "optimize_segment_build")
+            progs[seg_len] = call
+            states.append(state)
+        return progs[seg_len]
+
+    # resume_only (the service's shed hold): READS above still resume
+    # persisted progress — only the write path is suppressed, and a
+    # suppressed-by-request run must not re-report a shed event
+    shed_event = False
+    writes = 0
+    done_steps = resumed_from
+    nseg = 0
+    while done_steps < steps:
+        # -- preemption seam: kill@optimize:step=N hard-exits the
+        # process at the segment boundary whose cumulative step count
+        # is N — the TPU-VM preemption the successor's resume recovers
+        f = faults.fire_info("optimize", step=done_steps)
+        if f is not None and f["action"] == "kill":
+            from raft_tpu.utils.profiling import get_logger
+            get_logger("optimize").warning(
+                "optimize: injected kill at step %d (os._exit)",
+                done_steps)
+            _os._exit(137)
+        seg_len = min(int(every), int(steps) - done_steps)
+        carry, (ot, gt) = prog_for(seg_len, carry)(carry)
+        done_steps += seg_len
+        nseg += 1
+        ot_parts.append(ot)
+        gt_parts.append(gt)
+        if ckpt_store is not None and ckpt_key and not shed_event \
+                and not resume_only and done_steps < steps:
+            ot_full = (jnp.concatenate(ot_parts)
+                       if len(ot_parts) > 1 else ot_parts[0])
+            gt_full = (jnp.concatenate(gt_parts)
+                       if len(gt_parts) > 1 else gt_parts[0])
+            leaves = jax.tree.leaves(carry)
+            # ONE sanctioned pull per segment: the carry + the traces
+            host = obs.transfers.device_get(
+                tuple(leaves) + (ot_full, gt_full),
+                what="optimize_checkpoint", phase="optimize")
+            arrays = {f"c{i}": np.asarray(v)
+                      for i, v in enumerate(host[:len(leaves)])}
+            arrays["obj_trace"] = np.asarray(host[-2])
+            arrays["gnorm_trace"] = np.asarray(host[-1])
+            try:
+                cd = ckpt_store.put(
+                    ckpt_key, done_steps, arrays,
+                    meta={"identity": identity,
+                          "nleaves": len(leaves),
+                          "steps": int(steps), "every": int(every),
+                          "nlanes": L})
+                if cd:
+                    writes += 1
+                    if on_checkpoint is not None:
+                        on_checkpoint(done_steps, cd)
+            except errors.StorageExhausted as e:
+                # checkpointing sheds FIRST on the storage ladder: the
+                # descent keeps its device-side progress, durability
+                # of progress degrades, the service stays alive
+                shed_event = True
+                obs_events.emit("storage_degraded",
+                                component="checkpoint",
+                                step=done_steps, error=str(e)[:200])
+    ot_full = (jnp.concatenate(ot_parts)
+               if len(ot_parts) > 1 else ot_parts[0])
+    gt_full = (jnp.concatenate(gt_parts)
+               if len(gt_parts) > 1 else gt_parts[0])
+    fin = jax.jit(lambda c, o, g: descend.finalize(c, o, g))
+    call_fin, fin_state = _aot_program(
+        fin, (carry, ot_full, gt_full), key_facts,
+        {"every": int(every), "phase": "finalize"},
+        "optimize_finalize_build")
+    states.append(fin_state)
+    out = call_fin(carry, ot_full, gt_full)
+    jax.block_until_ready(out["x"])
+    if "disabled" in states:
+        cache_state = "disabled"
+    else:
+        cache_state = "hit" if all(s == "hit" for s in states) \
+            else "miss"
+    ckpt_info = {"checkpoint_every": int(every),
+                 "resumed_from_step": resumed_from,
+                 "segments": nseg, "ckpt_writes": writes,
+                 "ckpt_shed": shed_event,
+                 "ckpt_resume_only": bool(resume_only)}
+    return dict(out), cache_state, ckpt_info
 
 
 def optimize_designs(base, space: DesignSpace, objective=None,
@@ -673,6 +928,9 @@ def optimize_designs(base, space: DesignSpace, objective=None,
                      steps: int = 40, lr: float = 0.02,
                      gtol: float = 1e-4, xtol: float = 0.0,
                      mesh=None, seed: int = 0, strict: bool = True,
+                     checkpoint_every: int = None, ckpt_store=None,
+                     ckpt_key: str = None, on_checkpoint=None,
+                     ckpt_resume_only: bool = False,
                      **obj_kw) -> dict:
     """Run ``nlanes`` simultaneous projected gradient descents over
     ``space`` in ONE compiled (AOT-cached) program.
@@ -688,7 +946,22 @@ def optimize_designs(base, space: DesignSpace, objective=None,
     variant sweep; lanes pad to the mesh batch multiple via
     ``partition.pad_batch`` and strip on return.  ``strict=True``
     raises a typed :class:`errors.NonFiniteResult` (``phase="adjoint"``)
-    when EVERY lane's adjoint went non-finite."""
+    when EVERY lane's adjoint went non-finite.
+
+    **Preemption tolerance** (``docs/robustness.md`` "Preemption &
+    storage"): ``checkpoint_every=N`` segments the descent scan into
+    a chunked outer loop — N steps per compiled segment (the same
+    exec-cached program reused per segment; the ``fn="optimize"`` key
+    gains a ``ckpt`` fact), numerically bitwise-identical to the
+    monolithic scan.  With ``ckpt_store`` (a
+    :class:`raft_tpu.serve.checkpoint.CheckpointStore`) and
+    ``ckpt_key`` set, the carry is pulled once per segment and
+    persisted; a later call with the same key resumes from the newest
+    valid checkpoint (``result["resumed_from_step"]``), a corrupt
+    checkpoint falls back one segment, and an ENOSPC write sheds
+    checkpointing (typed, counted) without losing on-device progress.
+    ``on_checkpoint(step, cdigest)`` is called after each persisted
+    segment (the service journals a ``ckpt`` WAL record there)."""
     import time as _time
 
     from raft_tpu import obs
@@ -719,60 +992,82 @@ def optimize_designs(base, space: DesignSpace, objective=None,
     try:
         with obs.span("optimize_designs", nlanes=nlanes,
                       method=method) as sp:
-            jitted = jax.jit(descend)
-            key = None
-            exe = None
-            cache_info = {"state": "disabled"}
-            if exec_cache.enabled():
-                key = exec_cache.make_key(
-                    fn="optimize",
-                    model=exec_cache.model_digest(base),
-                    space=space.fingerprint(),
-                    objective=spec,
-                    method=method, steps=int(steps), lr=float(lr),
-                    gtol=float(gtol), xtol=float(xtol),
-                    batch_shape=[int(x0.shape[0]), space.ndim],
-                    dtype=str(x0.dtype),
-                    mesh=mesh_info,
-                    kw={k: v for k, v in obj_kw.items()
-                        if isinstance(v, (int, float, str, bool))})
-                exe = exec_cache.load(key)
-                cache_info = {"state": "hit" if exe is not None
-                              else "miss", "key": key}
-            sp.set(exec_cache=cache_info["state"])
+            key_facts = dict(
+                fn="optimize",
+                model=exec_cache.model_digest(base),
+                space=space.fingerprint(),
+                objective=spec,
+                method=method, steps=int(steps), lr=float(lr),
+                gtol=float(gtol), xtol=float(xtol),
+                batch_shape=[int(x0.shape[0]), space.ndim],
+                dtype=str(x0.dtype),
+                mesh=mesh_info,
+                kw={k: v for k, v in obj_kw.items()
+                    if isinstance(v, (int, float, str, bool))})
+            ckpt_every = int(checkpoint_every or 0)
+            ckpt_info = None
             t0 = _time.perf_counter()
-            out = None
-            if exe is not None:
-                try:
-                    with obs.span("optimize_execute", cached=True):
-                        out = exe.call(x0)
+            if ckpt_every > 0:
+                # chunked outer loop: every segment is the same
+                # exec-cached program (key gains the ckpt fact), the
+                # carry persists between segments, and a prior life's
+                # newest valid checkpoint is resumed instead of step 0
+                identity = _ckpt_identity(
+                    base, space, spec, method, steps, lr, gtol, xtol,
+                    int(x0.shape[0]), ckpt_every, obj_kw)
+                out, cstate, ckpt_info = _segmented_descent(
+                    descend, x0, every=ckpt_every, steps=int(steps),
+                    key_facts=key_facts, ckpt_store=ckpt_store,
+                    ckpt_key=ckpt_key, on_checkpoint=on_checkpoint,
+                    identity=identity,
+                    resume_only=bool(ckpt_resume_only))
+                cache_info = {"state": cstate}
+                sp.set(exec_cache=cstate,
+                       resumed_from_step=ckpt_info["resumed_from_step"])
+            else:
+                jitted = jax.jit(descend)
+                key = None
+                exe = None
+                cache_info = {"state": "disabled"}
+                if exec_cache.enabled():
+                    key = exec_cache.make_key(**key_facts)
+                    exe = exec_cache.load(key)
+                    cache_info = {"state": "hit" if exe is not None
+                                  else "miss", "key": key}
+                sp.set(exec_cache=cache_info["state"])
+                out = None
+                if exe is not None:
+                    try:
+                        with obs.span("optimize_execute", cached=True):
+                            out = exe.call(x0)
+                            jax.block_until_ready(out["x"])
+                    except exec_cache.CALL_ERRORS as e:
+                        from raft_tpu.utils.profiling import get_logger
+                        get_logger("optimize").warning(
+                            "cached optimize executable %s failed "
+                            "(%s: %s) — recompiling", key,
+                            type(e).__name__, e)
+                        exec_cache._count("error")
+                        cache_info = {"state": "error", "key": key}
+                        out = None
+                if out is None:
+                    probe_gate = (obs.probes.suppress(
+                        "aot-exported program") if key is not None
+                        else contextlib.nullcontext())
+                    with obs.span("optimize_lower"), probe_gate:
+                        lowered = jitted.lower(x0)
+                    with obs.span("optimize_compile"):
+                        compiled = lowered.compile()
+                    with obs.span("optimize_execute"):
+                        out = compiled(x0)
                         jax.block_until_ready(out["x"])
-                except exec_cache.CALL_ERRORS as e:
-                    from raft_tpu.utils.profiling import get_logger
-                    get_logger("optimize").warning(
-                        "cached optimize executable %s failed "
-                        "(%s: %s) — recompiling", key,
-                        type(e).__name__, e)
-                    exec_cache._count("error")
-                    cache_info = {"state": "error", "key": key}
-                    out = None
-            if out is None:
-                probe_gate = (obs.probes.suppress("aot-exported program")
-                              if key is not None
-                              else contextlib.nullcontext())
-                with obs.span("optimize_lower"), probe_gate:
-                    lowered = jitted.lower(x0)
-                with obs.span("optimize_compile"):
-                    compiled = lowered.compile()
-                with obs.span("optimize_execute"):
-                    out = compiled(x0)
-                    jax.block_until_ready(out["x"])
-                if key is not None:
-                    with obs.span("optimize_cache_store"), \
-                            obs.probes.suppress("aot-exported program"):
-                        exec_cache.store(jitted, (x0,), key,
-                                         meta={"fn": "optimize",
-                                               "nlanes": nlanes})
+                    if key is not None:
+                        with obs.span("optimize_cache_store"), \
+                                obs.probes.suppress(
+                                    "aot-exported program"):
+                            exec_cache.store(jitted, (x0,), key,
+                                             meta={"fn": "optimize",
+                                                   "nlanes": nlanes})
             wall_s = _time.perf_counter() - t0
             out = dict(out)
             if npad:
@@ -829,6 +1124,18 @@ def optimize_designs(base, space: DesignSpace, objective=None,
                     "solver": _linalg.last_dispatch(),
                     "exec_cache": cache_info["state"]},
             }
+            if ckpt_info is not None:
+                # preemption-tolerance facts: the resume point, the
+                # segment census, and whether the checkpoint tier shed
+                # (ENOSPC) mid-descent — journaled with the result so
+                # the preempt-soak verdict can gate on them
+                result["resumed_from_step"] = \
+                    ckpt_info["resumed_from_step"]
+                result["provenance"].update(ckpt_info)
+                if ckpt_store is not None and ckpt_key:
+                    # the descent is done and about to be journaled
+                    # terminal: its progress checkpoints are garbage
+                    ckpt_store.delete(ckpt_key)
             sp.set(best=result["f_best"], converged=int(conv.sum()),
                    nonfinite=n_bad)
             obs.gauge(
@@ -850,7 +1157,11 @@ def optimize_designs(base, space: DesignSpace, objective=None,
                 "iters_max": int(iters.max(initial=0)),
                 "wall_s": wall_s,
                 "descents_per_min": 60.0 * nlanes / max(wall_s, 1e-9),
-                "exec_cache": cache_info["state"]}
+                "exec_cache": cache_info["state"],
+                **({k: int(ckpt_info[k]) for k in
+                    ("checkpoint_every", "resumed_from_step",
+                     "segments", "ckpt_writes", "ckpt_shed")}
+                   if ckpt_info is not None else {})}
             status = "ok"
             return result
     finally:

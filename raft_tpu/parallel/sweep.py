@@ -426,6 +426,110 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
     return run
 
 
+def sweep_cases_chunked(fowt: FOWTModel, Hs, Tp, beta, *, store,
+                        key: str, chunk: int, mesh: Mesh = None,
+                        **kw) -> tuple[dict, dict]:
+    """Resumable certification-scale sweep: the case table splits into
+    chunks of ``chunk`` cases, each solved by :func:`sweep_cases` and
+    persisted to a :class:`raft_tpu.serve.checkpoint.CheckpointStore`
+    under ``(key, chunk index)`` — a killed sweep re-solves **only the
+    unfinished chunks** on the next run with the same key.
+
+    Integrity rides the checkpoint store's ladder (sidecar + sha256 +
+    key/step check, corrupt = counted delete-and-miss -> that chunk
+    re-solves) plus a **content guard**: each chunk's persisted meta
+    carries a digest of the chunk's own ``(Hs, Tp, beta)`` rows, so an
+    edited case table can never reuse a stale chunk.  An ENOSPC put is
+    the typed :class:`~raft_tpu.errors.StorageExhausted` shed — the
+    sweep keeps solving, persistence stops, the event is recorded
+    (``storage_degraded``) — and every persistence pull goes through
+    the sanctioned counted transfer channel.
+
+    Returns ``(out, info)``: ``out`` holds the assembled host arrays
+    (``Xi``, ``std``, ``iters``, ``converged`` over all ``ncases``) and
+    ``info`` the resume census (``{"chunks", "resumed", "solved",
+    "ckpt_shed"}``).  On full completion the partial results are left
+    in place (the caller owns cleanup via ``store.delete(key)``) so a
+    repeated call is a pure read."""
+    import json
+
+    from raft_tpu import obs
+    from raft_tpu.obs.ledger import digest_metrics
+    from raft_tpu.parallel import exec_cache
+
+    Hs = np.asarray(Hs, float)
+    Tp = np.asarray(Tp, float)
+    beta = np.asarray(beta, float)
+    n = int(Hs.shape[0])
+    chunk = int(chunk)
+    if chunk < 1:
+        raise errors.ModelConfigError(
+            "sweep_cases_chunked needs chunk >= 1", chunk=chunk)
+    if n < 1:
+        raise errors.ModelConfigError(
+            "sweep_cases_chunked needs a non-empty case table",
+            ncases=n)
+    nchunks = -(-n // chunk)
+    parts: list[dict] = []
+    info = {"chunks": nchunks, "resumed": [], "solved": [],
+            "ckpt_shed": False}
+    # the content guard covers the MODEL and the scalar solver kwargs,
+    # not just the chunk's rows: an edited fowt or a changed nIter/tol
+    # re-run under the same key must never reuse a stale chunk
+    model_kw_id = digest_metrics({
+        "model": exec_cache.model_digest(fowt),
+        "kw": json.dumps({k: v for k, v in kw.items()
+                          if isinstance(v, (int, float, str, bool))},
+                         sort_keys=True),
+        "mesh": "" if mesh is None else str(sorted(
+            (str(k), int(v)) for k, v in mesh.shape.items()))})
+    for ci in range(nchunks):
+        sl = slice(ci * chunk, min(n, (ci + 1) * chunk))
+        guard = digest_metrics({
+            "Hs": [float(v) for v in Hs[sl]],
+            "Tp": [float(v) for v in Tp[sl]],
+            "beta": [float(v) for v in beta[sl]],
+            "chunk": ci, "ncases": n, "solver": model_kw_id})
+        found = store.get(key, ci) if store is not None else None
+        if found is not None:
+            _, arrays, meta = found
+            if meta.get("kind") == "sweep_chunk" \
+                    and meta.get("guard") == guard \
+                    and all(k in arrays for k in ("Xi", "std", "iters",
+                                                  "converged")):
+                parts.append({k: arrays[k] for k in
+                              ("Xi", "std", "iters", "converged")})
+                info["resumed"].append(ci)
+                continue
+        out = sweep_cases(fowt, Hs[sl], Tp[sl], beta[sl], mesh=mesh,
+                          **kw)
+        # persistence pull: the chunk's full result rides ONE counted
+        # sanctioned transfer (distinct from the sweep's own summary)
+        xi, std, iters, conv = obs.transfers.device_get(
+            (out["Xi"], out["std"], out["iters"], out["converged"]),
+            what="sweep_chunk_checkpoint", phase="sweep")
+        part = {"Xi": np.asarray(xi), "std": np.asarray(std),
+                "iters": np.asarray(iters),
+                "converged": np.asarray(conv)}
+        parts.append(part)
+        info["solved"].append(ci)
+        if store is not None and not info["ckpt_shed"]:
+            try:
+                store.put(key, ci, part,
+                          meta={"kind": "sweep_chunk", "guard": guard,
+                                "chunk": ci, "ncases": n})
+            except errors.StorageExhausted as e:
+                # the sweep outlives a full disk: keep solving, stop
+                # persisting, surface the degradation (typed + event)
+                info["ckpt_shed"] = True
+                obs.events.emit("storage_degraded",
+                                component="checkpoint",
+                                chunk=ci, error=str(e)[:200])
+    out = {k: np.concatenate([p[k] for p in parts])
+           for k in ("Xi", "std", "iters", "converged")}
+    return out, info
+
+
 #: batch-quarantine ladder: same-config re-solve through the jnp path
 #: first (clears transient poisoning / kernel trouble at exact parity),
 #: then a damped restart (stronger under-relaxation, doubled iteration

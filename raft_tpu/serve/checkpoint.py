@@ -1,0 +1,450 @@
+"""Checkpoint store for long-running on-device work (preemption
+tolerance).
+
+PR 14's batched descents are the first minutes-long unit of work in the
+system, and the certification-sweep tables (ROADMAP item 3) are next:
+exactly the work a preempted TPU VM or an OOM-killed replica throws
+away.  The serve stack already survives SIGKILL with zero *request*
+loss (WAL + replay, PRs 10-12) — this module keeps the *progress*:
+
+- :func:`raft_tpu.parallel.optimize.optimize_designs` segments its
+  descent scan every ``checkpoint_every`` steps and persists the carry
+  (θ lanes, optimizer state, convergence/frozen masks, step counters,
+  accumulated traces) here, one sanctioned host pull per segment;
+- :func:`raft_tpu.parallel.sweep.sweep_cases_chunked` persists each
+  solved chunk of a large case table, so a killed sweep re-solves only
+  the unfinished chunks;
+- :meth:`raft_tpu.serve.service.SweepService.recover` resumes an
+  accepted-unfinished optimization from its newest *valid* checkpoint
+  instead of step 0.
+
+Integrity contract — the result-store discipline, applied to progress:
+
+- every checkpoint is written through the shared
+  ``tmp -> fsync -> rename`` helper (:func:`raft_tpu.obs.journalio.
+  fsync_write`) with a size+sha256 **sidecar written last** — a crash
+  mid-put leaves a torn checkpoint that reads as a miss, never as
+  state;
+- reads verify sidecar presence, payload size+sha256, the npz parse,
+  and the **key/step check** (the sidecar must answer for the requested
+  key and step) — any failure is **delete-and-miss**, counted in
+  ``raft_tpu_checkpoint_corrupt_total``, and :meth:`latest` *falls back
+  one segment* to the next older checkpoint: a corrupt checkpoint costs
+  ``checkpoint_every`` steps of re-descent, never a wrong resume and
+  never a dead service;
+- a transient read ``OSError`` (the ``eio@checkpoint`` fault) is a
+  counted plain miss — deletion is reserved for proven corruption.
+
+Resource exhaustion is typed: a write that fails with *proven* ENOSPC
+(or would exceed the configured ``budget_bytes``) raises
+:class:`raft_tpu.errors.StorageExhausted` — the one store in the stack
+allowed to raise from a put, because checkpointing is the first rung
+the service's storage ladder sheds (progress durability degrades before
+result durability; admission and delivery never degrade at all).  Every
+other write failure stays a counted gap.
+
+Fault seams (:mod:`raft_tpu.testing.faults`):
+``corrupt@checkpoint[:entry=HEX][:step=N]`` damages the raw bytes
+before the sidecar check; ``enospc@checkpoint`` injects the full-disk
+write failure; ``eio@checkpoint`` injects the transient read error.
+"""
+from __future__ import annotations
+
+import errno as _errno
+import hashlib
+import io
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+
+from raft_tpu import errors
+from raft_tpu.obs import journalio
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("serve.checkpoint")
+
+SCHEMA = "raft_tpu.serve.checkpoint/v1"
+
+_STEP_RE = re.compile(r"^(?P<stem>.+)\.step(?P<step>\d+)\.sum$")
+
+
+def is_enospc(e: BaseException | None, _depth: int = 8) -> bool:
+    """True when ``e`` (or its cause/context chain, bounded) is a
+    *proven* out-of-space failure — the only condition the typed
+    :class:`~raft_tpu.errors.StorageExhausted` shed may fire on."""
+    while e is not None and _depth > 0:
+        if isinstance(e, OSError) and e.errno == _errno.ENOSPC:
+            return True
+        e = e.__cause__ or e.__context__
+        _depth -= 1
+    return False
+
+
+def _stem(key: str) -> str:
+    """Filename stem of one checkpoint key: the bare hex of a
+    ``sha256:<hex>`` request digest (also what the ``entry=HEX`` fault
+    qualifier matches), or the key itself sanitized."""
+    stem = str(key).rsplit(":", 1)[-1]
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", stem)
+
+
+def _pack(arrays: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{str(k): np.asarray(v) for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def _unpack(data: bytes) -> dict:
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        return {k: z[k].copy() for k in z.files}
+
+
+def disk_gauge(component: str, nbytes: int):
+    """Set the per-component ``raft_tpu_disk_bytes`` gauge (guarded —
+    telemetry must never take down a persistence path)."""
+    try:
+        from raft_tpu.obs.metrics import record_disk_bytes
+        record_disk_bytes(component, nbytes)
+    except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+        pass
+
+
+class CheckpointStore:
+    """One checkpoint directory (see module docstring).
+
+    Thread-safe.  ``budget_bytes`` bounds the directory: a put that
+    would exceed it raises the same typed
+    :class:`~raft_tpu.errors.StorageExhausted` a real ENOSPC does, so
+    the shed ladder is exercised long before the disk actually fills.
+    ``component`` labels the ``raft_tpu_disk_bytes`` gauge.
+    """
+
+    #: a payload younger than this with no sidecar may be a concurrent
+    #: put that has not yet landed its certifying sidecar — left alone;
+    #: older ones are torn-put orphans, reclaimed (they are invisible
+    #: to every read path but would consume the disk budget forever)
+    TORN_GRACE_S = 60.0
+
+    def __init__(self, ckpt_dir: str, *, budget_bytes: int = None,
+                 component: str = "checkpoint"):
+        self.dir = str(ckpt_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.budget_bytes = (int(budget_bytes) if budget_bytes
+                             else None)
+        self.component = str(component)
+        self._lock = threading.Lock()
+        self._bytes = journalio.dir_bytes(self.dir)
+        self._counts = {k: 0 for k in (
+            "writes", "write_errors", "enospc", "hits", "misses",
+            "corrupt", "read_errors", "deletes")}
+        disk_gauge(self.component, self._bytes)
+
+    # ------------------------------------------------------------------
+    # paths
+    # ------------------------------------------------------------------
+
+    def _paths(self, key: str, step: int) -> tuple[str, str]:
+        base = os.path.join(self.dir, f"{_stem(key)}.step{int(step)}")
+        return base + ".npz", base + ".sum"
+
+    def steps(self, key: str) -> list[int]:
+        """Steps with a certifying sidecar on disk, ascending."""
+        stem = _stem(key)
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m and m.group("stem") == stem:
+                out.append(int(m.group("step")))
+        return sorted(out)
+
+    def _orphan_paths(self, key: str) -> list[str]:
+        """Dead files of ``key`` no read path will ever serve: payloads
+        with no certifying sidecar (the crash window between the
+        payload and sidecar writes) AND ``fsync_write`` tmp leftovers
+        (``*.tmp.<pid>.<tid>`` — a hard kill mid-write skips the
+        helper's unlink-on-failure).  Both consume the disk budget
+        while being invisible to latest()/get()/delete-by-steps."""
+        stem = _stem(key)
+        try:
+            names = set(os.listdir(self.dir))
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if not n.startswith(stem + ".step"):
+                continue
+            if n.endswith(".sum"):
+                continue                 # sidecars: the read ladder's
+            if n.endswith(".npz") and n[:-4] + ".sum" in names:
+                continue                 # certified payload: live
+            out.append(os.path.join(self.dir, n))
+        return out
+
+    def _reclaim_orphans(self, key: str, grace: float = None):
+        """Delete torn-put orphan payloads older than the grace window
+        (counted as ``torn_put`` corruption): invisible to every read
+        path, they would otherwise consume the disk budget forever.
+        Younger ones are a concurrent put mid-commit and left alone."""
+        grace = self.TORN_GRACE_S if grace is None else float(grace)
+        now = time.time()
+        dropped = 0
+        for p in self._orphan_paths(key):
+            try:
+                if grace > 0 and now - os.path.getmtime(p) < grace:
+                    continue
+                os.unlink(p)
+            except OSError:
+                continue
+            dropped += 1
+            with self._lock:
+                self._counts["corrupt"] += 1
+            self._count_metric("raft_tpu_checkpoint_corrupt_total",
+                               "torn_put")
+            _LOG.warning("checkpoint: reclaimed torn-put orphan %s",
+                         os.path.basename(p))
+        if dropped:
+            self._refresh_bytes()
+
+    # ------------------------------------------------------------------
+    # telemetry (must never take down the write/read path)
+    # ------------------------------------------------------------------
+
+    def _count_metric(self, name: str, reason: str = None):
+        try:
+            from raft_tpu import obs
+            labels = {"reason": reason} if reason else {}
+            obs.counter(name, "checkpoint-store outcomes "
+                        "(serve/checkpoint.py)").inc(1.0, **labels)
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+
+    def _corrupt(self, key: str, step: int, reason: str):
+        """Delete-and-miss one damaged checkpoint; the caller falls
+        back one segment (never served, never fatal)."""
+        entry, sidecar = self._paths(key, step)
+        for p in (entry, sidecar):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        with self._lock:
+            self._counts["corrupt"] += 1
+        self._count_metric("raft_tpu_checkpoint_corrupt_total", reason)
+        try:
+            from raft_tpu import obs
+            obs.events.emit("ckpt_corrupt", key=_stem(key)[:12],
+                            step=int(step), reason=reason)
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+        _LOG.warning("checkpoint %s@step%d failed integrity (%s) — "
+                     "deleted, resume falls back one segment",
+                     _stem(key)[:12], step, reason)
+        self._refresh_bytes()
+
+    def _refresh_bytes(self):
+        with self._lock:
+            self._bytes = journalio.dir_bytes(self.dir)
+            n = self._bytes
+        disk_gauge(self.component, n)
+
+    # ------------------------------------------------------------------
+    # write path
+    # ------------------------------------------------------------------
+
+    def put(self, key: str, step: int, arrays: dict,
+            meta: dict = None) -> str | None:
+        """Persist one checkpoint (named arrays + JSON meta) under
+        ``(key, step)``; returns the content digest (``sha256:<hex>``
+        of the payload bytes) or None on a non-exhaustion write
+        failure.  Proven ENOSPC — a real one, the injected
+        ``enospc@checkpoint`` fault, or the ``budget_bytes`` ceiling —
+        raises the typed :class:`~raft_tpu.errors.StorageExhausted`
+        instead: checkpointing is the first rung the storage ladder
+        sheds, and the shed only works if the signal reaches the
+        caller."""
+        from raft_tpu.testing import faults
+
+        entry, sidecar = self._paths(key, step)
+        data = _pack(arrays)
+        cdigest = "sha256:" + hashlib.sha256(data).hexdigest()
+        with self._lock:
+            projected = self._bytes + len(data)
+        if self.budget_bytes is not None \
+                and projected > self.budget_bytes:
+            with self._lock:
+                self._counts["enospc"] += 1
+            raise errors.StorageExhausted(
+                "checkpoint store disk budget exceeded",
+                component=self.component, budget=self.budget_bytes,
+                bytes=projected)
+        try:
+            if faults.fire_info("checkpoint", action="enospc",
+                                entry=_stem(key), step=int(step)):
+                raise OSError(_errno.ENOSPC, "injected ENOSPC (fault)")
+            journalio.fsync_write(entry, data)
+            side = {"schema": SCHEMA, "key": str(key),
+                    "step": int(step), "size": len(data),
+                    "sha256": cdigest.split(":", 1)[1],
+                    "cdigest": cdigest, "t": round(time.time(), 6),
+                    "meta": dict(meta or {})}
+            # sidecar LAST: its presence certifies a complete put — a
+            # crash before this line is a torn checkpoint that reads
+            # as a miss (resume falls back), never as state
+            journalio.fsync_write(sidecar, json.dumps(
+                side, sort_keys=True, separators=(",", ":"),
+                default=str).encode())
+        except Exception as e:  # raftlint: disable=RTL004
+            if is_enospc(e):
+                with self._lock:
+                    self._counts["enospc"] += 1
+                raise errors.StorageExhausted(
+                    "checkpoint write hit ENOSPC",
+                    component=self.component, key=_stem(key)[:12],
+                    step=int(step)) from e
+            # any other filesystem trouble is a counted durability gap:
+            # the descent keeps its device-side progress regardless
+            with self._lock:
+                self._counts["write_errors"] += 1
+            _LOG.warning("checkpoint put failed for %s@step%d",
+                         _stem(key)[:12], step, exc_info=True)
+            return None
+        with self._lock:
+            self._counts["writes"] += 1
+        # re-anchor the byte accounting against the directory after
+        # every put: an overwrite of the same (key, step) replaces
+        # bytes instead of adding them, and the sidecar counts too —
+        # incremental += would drift the budget check away from disk
+        self._refresh_bytes()
+        self._count_metric("raft_tpu_checkpoint_writes_total")
+        return cdigest
+
+    # ------------------------------------------------------------------
+    # read path (the integrity ladder; corrupt = fall back one segment)
+    # ------------------------------------------------------------------
+
+    def _read_step(self, key: str, step: int) -> tuple | None:
+        """One fully-verified checkpoint, or None (corrupt entries are
+        deleted and counted; transient read errors are plain misses)."""
+        from raft_tpu.testing import faults
+
+        entry, sidecar = self._paths(key, step)
+        try:
+            with open(sidecar, encoding="utf-8") as f:
+                side = json.load(f)
+        except FileNotFoundError:
+            return None
+        except json.JSONDecodeError:
+            self._corrupt(key, step, "sidecar_unreadable")
+            return None
+        except OSError:
+            with self._lock:
+                self._counts["read_errors"] += 1
+            return None
+        try:
+            if faults.fire_info("checkpoint", action="eio",
+                                entry=_stem(key), step=int(step)):
+                raise OSError(_errno.EIO, "injected EIO (fault)")
+            with open(entry, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            # sidecar without payload: a genuine orphan
+            self._corrupt(key, step, "payload_unreadable")
+            return None
+        except OSError:
+            # transient I/O trouble (eio@checkpoint): a counted plain
+            # miss — the caller falls back one segment, and deletion
+            # stays reserved for PROVEN corruption
+            with self._lock:
+                self._counts["read_errors"] += 1
+            return None
+        # -- injection seam: bit-rot/truncation BEFORE the checks
+        if faults.fire_info("checkpoint", action="corrupt",
+                            entry=_stem(key), step=int(step)):
+            head = bytes([data[0] ^ 0xFF]) if data else b"\x00"
+            data = head + data[1: max(1, len(data) - 16)]
+        if len(data) != int(side.get("size", -1)) or \
+                hashlib.sha256(data).hexdigest() != side.get("sha256"):
+            self._corrupt(key, step, "sha_mismatch")
+            return None
+        if side.get("key") != str(key) \
+                or int(side.get("step", -1)) != int(step):
+            self._corrupt(key, step, "key_mismatch")
+            return None
+        try:
+            arrays = _unpack(data)
+        except (ValueError, OSError, KeyError):
+            self._corrupt(key, step, "unparseable")
+            return None
+        with self._lock:
+            self._counts["hits"] += 1
+        return int(step), arrays, dict(side.get("meta") or {})
+
+    def get(self, key: str, step: int) -> tuple | None:
+        """One exact ``(key, step)`` checkpoint, fully verified, as
+        ``(step, arrays, meta)`` or None — the chunked-sweep partial
+        -result read path (each chunk is addressed exactly, no
+        fallback walk)."""
+        return self._read_step(key, int(step))
+
+    def latest(self, key: str, max_step: int = None) -> tuple | None:
+        """The newest *valid* checkpoint for ``key`` as
+        ``(step, arrays, meta)``, or None.  Walks newest -> oldest: a
+        corrupt checkpoint is deleted, counted, and the walk *falls
+        back one segment* to the next older one — a damaged entry
+        costs re-descent, never a wrong resume.  Aged torn-put orphans
+        of the key are reclaimed on the way (counted), so repeated
+        preemptions can never eat the disk budget with dead files."""
+        self._reclaim_orphans(key)
+        for step in reversed(self.steps(key)):
+            if max_step is not None and step > int(max_step):
+                continue
+            found = self._read_step(key, step)
+            if found is not None:
+                return found
+        with self._lock:
+            self._counts["misses"] += 1
+        return None
+
+    def delete(self, key: str):
+        """Drop every checkpoint of ``key`` — torn-put orphans
+        included, with no grace (the descent finished; nothing of this
+        key can be mid-commit anymore)."""
+        n = 0
+        for step in self.steps(key):
+            for p in self._paths(key, step):
+                try:
+                    os.unlink(p)
+                    n += 1
+                except OSError:
+                    pass
+        for p in self._orphan_paths(key):
+            try:
+                os.unlink(p)
+                n += 1
+            except OSError:
+                pass
+        if n:
+            with self._lock:
+                self._counts["deletes"] += 1
+            self._refresh_bytes()
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def disk_bytes(self) -> int:
+        with self._lock:
+            return int(self._bytes)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {**self._counts, "disk_bytes": int(self._bytes),
+                    "dir": self.dir}

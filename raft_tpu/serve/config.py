@@ -129,6 +129,26 @@ class ServeConfig:
     #: False: mirror asynchronously via the bounded catch-up queue
     mirror_sync: bool = True
 
+    # -- preemption tolerance (serve/checkpoint.py) --------------------
+    #: directory of the descent/sweep checkpoint store; None (default)
+    #: keeps no progress — a preempted descent restarts from step 0.
+    #: With it set, optimize requests checkpoint their carry every
+    #: ``checkpoint_every`` steps and ``recover()`` resumes an
+    #: accepted-unfinished descent from its newest valid checkpoint
+    ckpt_dir: str | None = None
+    #: descent steps per compiled segment between checkpoints; 0
+    #: (default) runs the monolithic scan.  Chunking is numerically
+    #: bitwise-identical to the monolithic descent (pinned)
+    checkpoint_every: int = 0
+    #: hard byte budget of the checkpoint directory: a put that would
+    #: exceed it raises the same typed ``StorageExhausted`` shed a real
+    #: ENOSPC does (None = only proven ENOSPC sheds)
+    disk_budget_bytes: int | None = None
+    #: seconds a storage-shed rung (checkpointing first, then the
+    #: result-store write-through) holds before re-probing the disk —
+    #: the self-clear cadence of the ENOSPC degradation ladder
+    storage_shed_hold_s: float = 5.0
+
     # -- sharding (parallel/partition.py) ------------------------------
     #: named mesh the warm batch programs solve on (None = single
     #: device); exec-cache keys carry the full ordered topology so warm
@@ -185,6 +205,12 @@ class ServeConfig:
              or self.store_dir is not None),
             ("warm_radius", self.warm_radius > 0.0),
             ("warm_audit_every", self.warm_audit_every >= 1),
+            ("ckpt_dir", self.ckpt_dir is None
+             or bool(str(self.ckpt_dir).strip())),
+            ("checkpoint_every", self.checkpoint_every >= 0),
+            ("disk_budget_bytes", self.disk_budget_bytes is None
+             or self.disk_budget_bytes >= 1),
+            ("storage_shed_hold_s", self.storage_shed_hold_s >= 0.0),
             ("max_live_programs", self.max_live_programs >= 1),
             ("optimize_lanes_max", self.optimize_lanes_max >= 1),
             ("optimize_steps_max", self.optimize_steps_max >= 1),

@@ -18,6 +18,9 @@ complete    a result is ready, BEFORE the ticket resolves; carries the
             re-deliver without re-solving
 fail        a typed terminal failure, BEFORE the ticket resolves
 tenant      a warm-runner eviction / re-warm (serve/tenancy.py)
+ckpt        a descent segment's checkpoint landed (serve/checkpoint.py):
+            request digest -> segment step + checkpoint content digest,
+            the resume audit trail (non-terminal)
 recover     a replay happened: the recovered/replayed/deduped counts
 handoff     a graceful drain: pending seqs + exec-cache keys the
             successor warm-starts from
@@ -69,7 +72,14 @@ HANDOFF = "handoff.json"
 #: record types replay understands; anything else in the stream is
 #: schema drift and counts as corruption
 RECORD_TYPES = ("begin", "admit", "batch", "complete", "fail", "tenant",
-                "recover", "handoff")
+                "recover", "handoff", "ckpt")
+
+#: journaled ``objective_trace`` entries beyond which the WAL keeps
+#: only first/last + length: a long descent's trace is delivered in
+#: full to the caller, but journaling (and re-journaling: dedupe
+#: fan-outs, rotation-checkpointed parts) the whole series would bloat
+#: every rotated part of a long-lived WAL
+TRACE_CAP = 16
 
 #: terminal record types — an admitted seq carrying one of these is no
 #: longer pending
@@ -101,6 +111,40 @@ def request_digest(Hs: float, Tp: float, beta: float,
     from raft_tpu.obs.ledger import digest_metrics
     return digest_metrics({"Hs": float(Hs), "Tp": float(Tp),
                            "beta": float(beta), "tenant": str(tenant)})
+
+
+def cap_trace(extra: dict, cap: int = None) -> dict:
+    """The journal-facing copy of an optimize result payload: an
+    ``objective_trace`` longer than ``cap`` (default
+    :data:`TRACE_CAP`) collapses to ``{"first", "last", "n"}``.  Pure
+    (the caller's payload is never mutated); short traces and
+    trace-less extras pass through structurally unchanged."""
+    cap = TRACE_CAP if cap is None else int(cap)
+    prov = extra.get("provenance") if isinstance(extra, dict) else None
+    trace = (prov or {}).get("objective_trace")
+    if not isinstance(trace, list) or len(trace) <= cap:
+        return dict(extra)
+    half = max(1, cap // 2)
+    out = dict(extra)
+    out["provenance"] = {**prov, "objective_trace": {
+        "first": [float(v) for v in trace[:half]],
+        "last": [float(v) for v in trace[-half:]],
+        "n": len(trace)}}
+    return out
+
+
+def optimize_result_digest(design: dict, f_best: float,
+                           iterations: int) -> str:
+    """The content address of one optimize delivery — shared by
+    ``SweepService._complete_optimize`` and the preempt-soak verdict,
+    so "resumed digest == clean-run digest" is compared in one
+    recipe."""
+    import json
+
+    from raft_tpu.obs.ledger import digest_metrics
+    return digest_metrics({
+        "optimize": json.dumps(design, sort_keys=True),
+        "f_best": float(f_best), "iterations": int(iterations)})
 
 
 def optimize_digest(spec: dict, tenant: str = "default") -> str:
@@ -181,6 +225,15 @@ class RequestJournal:
             with self._lock:
                 if self._writer.closed:
                     return
+                # deterministic full-disk injection: the same errno a
+                # real ENOSPC surfaces, proven below before the typed
+                # degradation signal fires (action-filtered so it can
+                # never burn a torn spec's once/times budget)
+                if faults.fire_info("journal", action="enospc",
+                                    record=type_) is not None:
+                    import errno as _errno
+                    raise OSError(_errno.ENOSPC,
+                                  "injected ENOSPC (fault)")
                 part = self._writer.part
                 self._writer.write(rec)
                 if self._writer.part != part and self._snapshot:
@@ -191,20 +244,30 @@ class RequestJournal:
                         self._writer.write(dict(srec), rotate=False)
                 # deterministic torn-tail injection: what a crash
                 # between write and flush of this record looks like
-                if faults.fire("journal", record=type_) == "torn":
+                if faults.fire_info("journal", action="torn",
+                                    record=type_) is not None:
                     self._writer.tear_tail()
         # a journal write failure must not take down the service it
-        # protects: count the durability gap and keep serving
-        except Exception:  # raftlint: disable=RTL004
+        # protects: count the durability gap and keep serving — a
+        # PROVEN full disk additionally emits the storage_degraded
+        # signal the operator's ENOSPC dashboards key on (the WAL is
+        # the deepest tier: it never sheds, admission and delivery
+        # stay alive, the gap is visible)
+        except Exception as e:  # raftlint: disable=RTL004
             self.errors += 1
             _LOG.warning("serve journal: write failed (%s record); "
                          "durability gap", type_, exc_info=True)
             try:
                 from raft_tpu import obs
+                from raft_tpu.serve.checkpoint import is_enospc
                 obs.counter(
                     "raft_tpu_serve_journal_errors_total",
                     "serve WAL writes that failed (durability gaps)"
                     ).inc(1.0)
+                if is_enospc(e):
+                    obs.events.emit("storage_degraded",
+                                    component="journal",
+                                    record=str(type_))
             except Exception:                        # pragma: no cover
                 pass
 
@@ -236,14 +299,30 @@ class RequestJournal:
                         iters: int, converged: bool, extra: dict = None):
         """``extra`` (optimize tenant): the digest-addressed result
         payload beyond the std row — optimized design + provenance —
-        journaled so replay re-delivers it without re-descending."""
+        journaled so replay re-delivers it without re-descending.  The
+        provenance ``objective_trace`` is capped at :data:`TRACE_CAP`
+        entries (first/last halves + total length) in the journaled
+        copy: the caller's delivered result keeps the full series, but
+        a long descent must not bloat every rotated WAL part (the
+        record is re-appended on dedupe fan-outs and replay
+        re-journaling too)."""
         rec = dict(seq=int(seq), rdigest=rdigest,
                    digest=digest, mode=str(mode), attempts=int(attempts),
                    std=[float(v) for v in std], iters=int(iters),
                    converged=bool(converged))
         if extra is not None:
-            rec["extra"] = dict(extra)
+            rec["extra"] = cap_trace(extra)
         self._write("complete", **rec)
+
+    def record_ckpt(self, seq: int, rdigest: str, step: int,
+                    cdigest: str):
+        """A descent segment's checkpoint landed: ties the request
+        digest to the segment boundary (``step``) and the checkpoint's
+        content digest — the audit trail the preempt-soak verdict (and
+        a second replay) agree on.  Non-terminal: a seq carrying only
+        admit+ckpt records is still pending."""
+        self._write("ckpt", seq=int(seq), rdigest=rdigest,
+                    step=int(step), cdigest=str(cdigest))
 
     def record_fail(self, seq: int, rdigest: str, error: dict,
                     quarantined: bool):
@@ -278,10 +357,8 @@ def write_handoff_manifest(journal_dir: str, doc: dict) -> str:
     import json
 
     path = handoff_path(journal_dir)
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=1, default=str)
-    os.replace(tmp, path)
+    journalio.fsync_write(path, json.dumps(
+        doc, indent=1, default=str).encode())
     return path
 
 
@@ -331,6 +408,8 @@ def replay(journal_dir: str, strict: bool = False) -> dict:
          "failed":    {seq: fail record},
          "pending":   [admit records with no terminal record, seq-asc],
          "deduped":   {seq: complete record of the SAME rdigest},
+         "ckpts":     {seq: newest ckpt record (pending descents'
+                      resume audit trail)},
          "by_rdigest": {rdigest: complete record},
          "max_seq":   highest admitted seq (-1 when empty),
          "corrupt":   torn/unparseable lines skipped (counted in
@@ -350,6 +429,7 @@ def replay(journal_dir: str, strict: bool = False) -> dict:
     admitted: dict[int, dict] = {}
     completed: dict[int, dict] = {}
     failed: dict[int, dict] = {}
+    ckpts: dict[int, dict] = {}
     handoff = None
     corrupt = 0
     records = 0
@@ -370,6 +450,10 @@ def replay(journal_dir: str, strict: bool = False) -> dict:
                 completed[int(seq)] = doc
             elif t == "fail" and seq is not None:
                 failed[int(seq)] = doc
+            elif t == "ckpt" and seq is not None:
+                # newest wins: the record ties a pending descent's
+                # request digest to its last journaled segment
+                ckpts[int(seq)] = doc
             elif t == "handoff":
                 handoff = doc
     if strict and corrupt:
@@ -393,6 +477,6 @@ def replay(journal_dir: str, strict: bool = False) -> dict:
             pending.append(rec)
     return {"admitted": admitted, "completed": completed,
             "failed": failed, "pending": pending, "deduped": deduped,
-            "by_rdigest": by_rdigest,
+            "ckpts": ckpts, "by_rdigest": by_rdigest,
             "max_seq": max(admitted) if admitted else -1,
             "corrupt": corrupt, "records": records, "handoff": handoff}

@@ -83,23 +83,12 @@ def _result_digest(doc: dict) -> str:
 
 
 def _fsync_write(path: str, data: bytes):
-    # per-writer scratch name: concurrent puts of the SAME digest from
-    # sibling replicas/threads must not truncate each other's
-    # in-progress temp file (a shared ".tmp" could publish one
-    # writer's payload under the other's sidecar)
-    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-    try:
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    # the shared crash-safe write discipline, one implementation for
+    # every persistence tier (per-writer tmp -> fsync -> rename; see
+    # obs/journalio.fsync_write — raftlint RTL007 pins write paths
+    # onto it)
+    from raft_tpu.obs.journalio import fsync_write
+    fsync_write(path, data)
 
 
 class ResultStore:
@@ -140,7 +129,7 @@ class ResultStore:
         self._quarantined: set[str] = set()
         self._counts = {k: 0 for k in (
             "puts", "put_errors", "hits", "misses", "corrupt",
-            "quarantined", "seed_reads")}
+            "quarantined", "seed_reads", "enospc")}
 
     # ------------------------------------------------------------------
     # paths / index
@@ -254,8 +243,11 @@ class ResultStore:
         response the drag fixed point warm-starts from — pass it only
         for COLD-solved results, so every seed in the store traces back
         to an unseeded solve.  Returns False (and counts a
-        ``put_errors``) on any I/O trouble; the store never raises into
-        the serving path."""
+        ``put_errors``) on any I/O trouble — EXCEPT a *proven* full
+        disk (ENOSPC), which raises the typed
+        :class:`~raft_tpu.errors.StorageExhausted` so the service can
+        shed the write-through rung; nothing else ever raises into the
+        serving path."""
         try:
             doc = {k: payload[k] for k in REQUIRED}
         except KeyError as e:
@@ -268,6 +260,11 @@ class ResultStore:
         rdigest = str(doc["rdigest"])
         entry, sidecar, xi_path = self._paths(rdigest)
         try:
+            from raft_tpu.testing import faults
+            if faults.fire_info("resultstore", action="enospc",
+                                entry=_stem(rdigest)) is not None:
+                import errno as _errno
+                raise OSError(_errno.ENOSPC, "injected ENOSPC (fault)")
             data = json.dumps(doc, sort_keys=True,
                               separators=(",", ":")).encode()
             side = {"schema": SCHEMA, "rdigest": rdigest,
@@ -293,10 +290,22 @@ class ResultStore:
             _fsync_write(sidecar, json.dumps(
                 side, sort_keys=True, separators=(",", ":")).encode())
         # the store protects the serving path, never endangers it: any
-        # filesystem trouble is a counted durability gap
-        except Exception:  # raftlint: disable=RTL004
+        # filesystem trouble is a counted durability gap — EXCEPT a
+        # proven full disk, which raises the typed StorageExhausted so
+        # the service can shed the write-through rung (admission and
+        # delivery stay alive; the caller catches, counts, and skips
+        # puts for the shed hold)
+        except Exception as e:  # raftlint: disable=RTL004
             with self._lock:
                 self._counts["put_errors"] += 1
+            from raft_tpu.serve.checkpoint import is_enospc
+            if is_enospc(e):
+                with self._lock:
+                    self._counts["enospc"] += 1
+                raise errors.StorageExhausted(
+                    "result-store write hit ENOSPC",
+                    component="resultstore",
+                    rdigest=_stem(rdigest)[:12]) from e
             _LOG.warning("result store: put failed for %s", rdigest,
                          exc_info=True)
             return False
@@ -344,6 +353,14 @@ class ResultStore:
 
         entry, sidecar, _ = self._paths(rdigest)
         stem = _stem(rdigest)
+        # -- injection seam: transient read I/O error (eio@resultstore)
+        # — a plain counted miss, the entry is NOT deleted (deletion is
+        # reserved for proven corruption; an EIO may clear)
+        if faults.fire_info("resultstore", action="eio",
+                            entry=stem) is not None:
+            with self._lock:
+                self._counts["misses"] += 1
+            return None
         try:
             with open(sidecar, encoding="utf-8") as f:
                 side = json.load(f)
@@ -565,10 +582,22 @@ class ResultStore:
     # introspection
     # ------------------------------------------------------------------
 
+    def disk_bytes(self) -> int:
+        """Bytes held by the store directory; also refreshes the
+        per-component ``raft_tpu_disk_bytes`` gauge."""
+        from raft_tpu.obs.journalio import dir_bytes
+        from raft_tpu.serve.checkpoint import disk_gauge
+
+        n = dir_bytes(self.dir)
+        disk_gauge("resultstore", n)
+        return n
+
     def stats(self) -> dict:
         with self._lock:
             self._refresh_index_locked()
-            return {**self._counts, "entries": len(self._index),
-                    "seeds": sum(1 for m in self._index.values()
-                                 if m.get("xi")),
-                    "dir": self.dir}
+            out = {**self._counts, "entries": len(self._index),
+                   "seeds": sum(1 for m in self._index.values()
+                                if m.get("xi")),
+                   "dir": self.dir}
+        out["disk_bytes"] = self.disk_bytes()
+        return out

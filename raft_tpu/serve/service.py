@@ -282,7 +282,14 @@ class SweepService:
             "retries", "retried_recovered", "deadline_misses",
             "unhandled", "batches", "abandoned_batches", "expired",
             "store_hits", "coalesced", "warm_seeded", "warm_rejected",
-            "warm_mismatch")}
+            "warm_mismatch", "ckpt_resumed", "ckpt_shed", "store_shed")}
+        # -- storage-shed ladder (serve/checkpoint.py, ENOSPC): typed
+        # StorageExhausted from a persistence write sheds THAT rung for
+        # storage_shed_hold_s — checkpointing first, then the
+        # result-store write-through; admission and delivery never
+        # degrade on a full disk.  component -> monotonic shed-until
+        self._storage_shed: dict[str, float] = {}
+        self._last_resumed_step = 0
         # -- result tier (serve/resultstore.py): the persistent
         # content-addressed read-through store, single-flight request
         # coalescing, and neighbor warm starts all key off store_dir
@@ -293,6 +300,16 @@ class SweepService:
                                       keep_xi=self.cfg.warm_start)
         #: rdigest -> the PRIMARY in-flight request duplicates attach to
         self._flight: dict[str, _Request] = {}
+        # -- preemption tolerance (serve/checkpoint.py): descent
+        # progress persists every checkpoint_every steps; recover()
+        # resumes an accepted-unfinished optimization from its newest
+        # valid checkpoint instead of step 0
+        self._ckpt = None
+        if self.cfg.ckpt_dir:
+            from raft_tpu.serve.checkpoint import CheckpointStore
+            self._ckpt = CheckpointStore(
+                self.cfg.ckpt_dir,
+                budget_bytes=self.cfg.disk_budget_bytes)
         # -- optimize tenant (parallel/optimize.py): design-optimization
         # requests ride their own bounded queue and dedicated worker —
         # one descent is a whole compiled batch program, not a lane in
@@ -565,7 +582,7 @@ class SweepService:
                     adm = state["admitted"].get(seq, {})
                     if self._store is not None and rec.get("rdigest") \
                             and "Hs" in adm and res.mode == "full":
-                        self._store.put({
+                        self._store_put({
                             "rdigest": rec["rdigest"],
                             "digest": rec["digest"],
                             "std": rec.get("std") or [],
@@ -707,6 +724,11 @@ class SweepService:
             self._ensure_opt_worker()
         info = {"recovered": recovered, "replayed": replayed,
                 "deduped": deduped, "corrupt": int(state["corrupt"])}
+        # journaled ckpt records tie a pending descent's digest to its
+        # last persisted segment — the resume audit trail the preempt
+        # soak's second replay agrees on (the resume itself reads the
+        # checkpoint STORE by rdigest when the descent re-runs)
+        ckpt_records = len(state.get("ckpts") or {})
         # accumulate across calls (own journal, then a peer's mirror);
         # the mirror flag is sticky — ANY fold of a foreign directory
         # makes this life a failover, which the failover SLO facts gate
@@ -715,6 +737,7 @@ class SweepService:
             **{k: prev.get(k, 0) + v for k, v in info.items()},
             "journal_dir": str(src),
             "records": prev.get("records", 0) + int(state["records"]),
+            "ckpt_records": prev.get("ckpt_records", 0) + ckpt_records,
             "mirror": bool(prev.get("mirror")) or is_mirror}
         for outcome, n in info.items():
             if n:
@@ -730,7 +753,8 @@ class SweepService:
                   "line(s) skipped",
                   " (from mirror)" if is_mirror else "", recovered,
                   replayed, deduped, state["corrupt"])
-        return {**info, "mirror": is_mirror, "tickets": tickets}
+        return {**info, "ckpt_records": ckpt_records,
+                "mirror": is_mirror, "tickets": tickets}
 
     def drain(self, successor: str = None, timeout: float = 30.0) -> dict:
         """Gracefully hand the service off: stop admitting (callers get
@@ -1159,6 +1183,31 @@ class SweepService:
             return
         space = optmod.DesignSpace(
             fowt, {k: tuple(v) for k, v in spec["bounds"].items()})
+        # -- preemption tolerance: segment the descent and persist its
+        # carry every checkpoint_every steps, keyed by the request's
+        # content address — recover() re-runs an accepted-unfinished
+        # optimization through here, and the store's newest valid
+        # checkpoint resumes it instead of step 0.  A shed checkpoint
+        # tier (ENOSPC) keeps the chunking (bitwise-identical numerics
+        # either way) but stops persisting until the hold lapses.
+        ckpt_kw = {}
+        if self.cfg.checkpoint_every:
+            ckpt_kw["checkpoint_every"] = int(self.cfg.checkpoint_every)
+            if self._ckpt is not None:
+                # the store is ALWAYS passed: resuming persisted
+                # progress is a read and must survive the shed hold —
+                # only the write path is suppressed while shed
+                ckpt_kw["ckpt_store"] = self._ckpt
+                ckpt_kw["ckpt_key"] = r.rdigest
+                if self._shed_active("checkpoint"):
+                    ckpt_kw["ckpt_resume_only"] = True
+                elif self._journal is not None:
+                    journal = self._journal
+
+                    def _on_ckpt(step, cdigest, _r=r):
+                        journal.record_ckpt(_r.seq, _r.rdigest, step,
+                                            cdigest)
+                    ckpt_kw["on_checkpoint"] = _on_ckpt
         with self._obs().span("serve_optimize", req=r.seq,
                               nlanes=spec["nlanes"]):
             out = optmod.optimize_designs(
@@ -1166,9 +1215,22 @@ class SweepService:
                 nlanes=spec["nlanes"], steps=spec["steps"],
                 method=spec["method"], lr=spec["lr"],
                 gtol=spec["gtol"], seed=spec["seed"],
-                nIter=spec["nIter"], tol=spec["tol"])
+                nIter=spec["nIter"], tol=spec["tol"], **ckpt_kw)
         best = int(out["lane_best"])
         prov = dict(out["provenance"])
+        if prov.get("ckpt_shed"):
+            self._shed("checkpoint", errors.StorageExhausted(
+                "checkpoint tier shed mid-descent",
+                component="checkpoint", req=r.seq))
+        resumed = int(prov.get("resumed_from_step") or 0)
+        if resumed:
+            with self._lock:
+                self._counts["ckpt_resumed"] += 1
+                self._last_resumed_step = resumed
+            self._emit("ckpt_resumed", req=r.seq, step=resumed,
+                       steps=spec["steps"])
+            _LOG.info("serve: optimize req %d resumed from checkpoint "
+                      "step %d/%d", r.seq, resumed, spec["steps"])
         wall = float(prov.get("wall_s") or 0.0)
         if wall > 0.0:
             with self._lock:
@@ -1189,14 +1251,13 @@ class SweepService:
         WAL-terminal before the ticket resolves, indexed for dedupe and
         cross-replica re-resolution, fanned out to single-flight
         followers."""
-        import json as _json
-
         obs = self._obs()
-        from raft_tpu.obs.ledger import digest_metrics
-        digest = digest_metrics({
-            "optimize": _json.dumps(payload["design"], sort_keys=True),
-            "f_best": payload["f_best"],
-            "iterations": payload["provenance"]["iterations"]})
+        # the shared recipe (journal.optimize_result_digest): the
+        # preempt-soak verdict compares a resumed run's digest to an
+        # uninterrupted clean run's through the same function
+        digest = wal.optimize_result_digest(
+            payload["design"], payload["f_best"],
+            payload["provenance"]["iterations"])
         prov = payload["provenance"]
         res = SweepResult(
             ok=True, digest=digest, std=[float(payload["f_best"])],
@@ -1234,6 +1295,57 @@ class SweepService:
                    f_best=payload["f_best"])
         r.ticket._finish(res)
         self._fanout_complete(r, res)
+
+    # ------------------------------------------------------------------
+    # storage-shed ladder (ENOSPC / disk budget; serve/checkpoint.py)
+    # ------------------------------------------------------------------
+
+    def _shed_active(self, component: str) -> bool:
+        """True while ``component``'s storage shed holds; a lapsed hold
+        self-clears (the next write re-probes the disk)."""
+        with self._lock:
+            until = self._storage_shed.get(component)
+            if until is None:
+                return False
+            if time.monotonic() < until:
+                return True
+            del self._storage_shed[component]
+        self._emit("storage_recovered", component=component)
+        _LOG.info("serve: storage shed of %s lapsed — re-probing",
+                  component)
+        return False
+
+    def _shed(self, component: str, e: BaseException):
+        """Fold one typed :class:`~raft_tpu.errors.StorageExhausted`
+        into the storage ladder: shed ``component`` for the configured
+        hold (checkpointing sheds first, then the result-store
+        write-through; the WAL and the serving loop never shed)."""
+        obs = self._obs()
+        hold = float(self.cfg.storage_shed_hold_s)
+        with self._lock:
+            self._storage_shed[component] = time.monotonic() + hold
+            self._counts["ckpt_shed" if component == "checkpoint"
+                         else "store_shed"] += 1
+        obs.counter(
+            "raft_tpu_serve_storage_shed_total",
+            "persistence rungs shed on proven resource exhaustion "
+            "(ENOSPC / disk budget), by component").inc(
+                1.0, component=component)
+        self._emit("storage_degraded", component=component,
+                   hold_s=hold, error=str(e)[:200])
+        _LOG.warning("serve: storage exhausted at %s — shedding for "
+                     "%.1fs (%s)", component, hold, e)
+
+    def _store_put(self, payload: dict, xi=None):
+        """Result-store write-through under the shed ladder: an ENOSPC
+        put sheds THIS rung (typed, counted, held, self-clearing) —
+        the result still delivers from memory and the WAL."""
+        if self._store is None or self._shed_active("resultstore"):
+            return
+        try:
+            self._store.put(payload, xi=xi)
+        except errors.StorageExhausted as e:
+            self._shed("resultstore", e)
 
     # ------------------------------------------------------------------
     # worker: gather -> solve -> split
@@ -1785,7 +1897,7 @@ class SweepService:
         # never become the canonical cached answer every future repeat
         # (on every replica, forever) short-circuits to
         if self._store is not None and mode == "full":
-            self._store.put({"rdigest": r.rdigest, "digest": digest,
+            self._store_put({"rdigest": r.rdigest, "digest": digest,
                              "std": res.std, "iters": res.iters,
                              "converged": res.converged,
                              "tenant": r.tenant, "Hs": r.Hs, "Tp": r.Tp,
@@ -2097,6 +2209,7 @@ class SweepService:
             replayed_open = len(self._replayed_pending)
             read_ms = list(self._read_ms)
             warm_savings = self._warm_iter_savings
+            last_resumed = self._last_resumed_step
         runners = {}
         for name, t in tenancy["tenants"].items():
             for live in t.get("live", []):
@@ -2147,6 +2260,38 @@ class SweepService:
                 out["replication"] = rep
                 out["replication_lag_records"] = rep["lag_records"]
                 out["replication_errors"] = rep["errors"]
+        if self._ckpt is not None:
+            # preemption-tolerance facts (serve/checkpoint.py): present
+            # only on checkpoint-enabled services, so the resume SLO
+            # rules skip every ordinary serve row
+            st = self._ckpt.stats()
+            out["ckpt"] = st
+            out["ckpt_writes"] = st["writes"]
+            out["ckpt_corrupt"] = st["corrupt"]
+            out["ckpt_resumes"] = counts["ckpt_resumed"]
+            out["ckpt_resumed_from_step"] = last_resumed
+        # per-component disk census -> raft_tpu_disk_bytes gauges +
+        # flat disk_* facts for the trend store
+        disk = {}
+        if self._journal is not None and self.cfg.journal_dir:
+            from raft_tpu.obs.journalio import dir_bytes
+            from raft_tpu.serve.checkpoint import disk_gauge
+            n = dir_bytes(self.cfg.journal_dir)
+            disk_gauge("journal", n)
+            disk["journal"] = n
+        if self._store is not None:
+            # stats() above already walked the store directory (and
+            # set the gauge) — reuse its census instead of a second
+            # O(entries) scandir per summary poll
+            disk["resultstore"] = (
+                out["store"]["disk_bytes"] if "store" in out
+                else self._store.disk_bytes())
+        if self._ckpt is not None:
+            disk["checkpoint"] = self._ckpt.disk_bytes()
+        if disk:
+            out["disk_bytes"] = disk
+            for comp, n in disk.items():
+                out[f"disk_{comp}_bytes"] = n
         if handoff_info:
             out["handoff"] = handoff_info
             out["handoff_pending"] = handoff_info["pending"]

@@ -660,6 +660,277 @@ def run_storm(design: str = "Vertical_cylinder", *, store_dir: str,
 
 
 # ---------------------------------------------------------------------------
+# preemption chaos soak: the checkpoint/resume acceptance harness
+# ---------------------------------------------------------------------------
+
+#: the optimize spec every preempt-soak phase submits (canonicalized by
+#: normalize_request at admission, so clean/child/successor all share
+#: one request digest and one exec-cache identity).  steps=6 with
+#: checkpoint_every=2 on purpose: the successor resumed at step 2
+#: still has a MID-RUN checkpoint boundary (step 4) ahead of it, which
+#: is where the ENOSPC wave's checkpoint shed must fire
+PREEMPT_SPEC = {
+    "bounds": {"d_scale": [0.9, 1.1], "moor_L": [0.95, 1.05]},
+    "objective": {"metric": "std", "Hs": 5.0, "Tp": 9.0},
+    "nlanes": 2, "steps": 6, "nIter": 2, "tol": 0.01, "lr": 0.05,
+    "seed": 3, "method": "adam", "gtol": 1e-4,
+}
+
+
+def preempt_child_main(spec_json: str):
+    """Entry point of the to-be-preempted phase (run in a subprocess by
+    :func:`run_preempt`): admit ONE design-optimization request into a
+    journaled, checkpoint-enabled service, then let the descent run
+    with ``kill@optimize:step=N`` armed — the process hard-exits
+    (``os._exit(137)``) at segment boundary N with at least one
+    checkpoint on disk.  Exit 3 means the kill never fired."""
+    import json
+
+    from raft_tpu.testing import faults
+
+    spec = json.loads(spec_json)
+    fowt = build_fowt(spec["design"], spec["min_freq"],
+                      spec["max_freq"], spec["dfreq"])
+    faults.install(spec["kill_spec"])
+    cfg = default_config(
+        batch_cases=spec["batch_cases"], queue_max=8,
+        journal_dir=spec["journal_dir"], ckpt_dir=spec["ckpt_dir"],
+        checkpoint_every=spec["checkpoint_every"])
+    svc = SweepService(fowt, cfg)
+    t = svc.submit_optimize(dict(spec["opt_spec"]))
+    svc.start()
+    t.result(float(spec.get("timeout_s", 300.0)))
+    svc.stop()
+    sys.exit(3)                          # kill fault never fired
+
+
+def run_preempt(design: str = "Vertical_cylinder", *,
+                journal_dir: str, ckpt_dir: str, store_dir: str,
+                min_freq: float = 0.1, max_freq: float = 0.9,
+                dfreq: float = 0.4, checkpoint_every: int = 2,
+                kill_at_step: int = None, opt_spec: dict = None,
+                batch_cases: int = 4, seed: int = 2026,
+                shed_hold_s: float = 0.5,
+                timeout_s: float = 600.0) -> dict:
+    """The ISSUE-acceptance preemption soak, four movements over one
+    journal + checkpoint + result-store tree:
+
+    1. **clean** (in-process, monolithic descent, no journal): the
+       uninterrupted reference — the optimize result digest plus two
+       sweep-case reference digests (also warms the executable cache).
+    2. **preempt** (subprocess): a journaled, checkpoint-enabled child
+       admits the SAME optimize request; ``kill@optimize:step=N``
+       hard-exits it at segment boundary N — accepted work on the WAL,
+       progress on the checkpoint store.
+    3. **resume + ENOSPC wave**: a successor on the same tree recovers
+       the WAL and re-runs the descent — which resumes from the
+       newest valid checkpoint (``resumed_from_step >=
+       checkpoint_every``) — while ``enospc@checkpoint`` +
+       ``enospc@resultstore`` are active: checkpointing sheds first,
+       then the store write-through, both via typed
+       :class:`~raft_tpu.errors.StorageExhausted`; the resumed descent
+       and a wave sweep request still deliver, digest-identical to
+       clean.
+    4. **self-clear**: the wave lifts, the shed hold lapses, and a
+       fresh sweep request writes through to the store again; the
+       store must hold zero corrupt entries.
+
+    The verdict (``report["ok"]``) gates: the child died by the
+    injected kill; ``resumed_from_step >= checkpoint_every`` (> 0);
+    the resumed design digest **bit-for-bit equal** to the clean run's
+    (`ckpt_resume_digest_mismatch == 0`); zero lost requests; both
+    storage sheds observed and self-cleared without a corrupt byte
+    served (`storage_corrupt_served_count == 0`); and a second journal
+    replay all-terminal."""
+    import json
+
+    from raft_tpu import obs
+    from raft_tpu.serve import journal as wal
+    from raft_tpu.serve.checkpoint import CheckpointStore
+    from raft_tpu.serve.resultstore import ResultStore
+    from raft_tpu.testing import faults
+
+    t0 = time.monotonic()
+    journal_dir = os.path.abspath(journal_dir)
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    store_dir = os.path.abspath(store_dir)
+    every = int(checkpoint_every)
+    kill_at = int(kill_at_step if kill_at_step is not None else every)
+    opt_spec = dict(opt_spec or PREEMPT_SPEC)
+    fowt = build_fowt(design, min_freq, max_freq, dfreq)
+    Hs, Tp, beta = case_table(2, seed=seed)
+    manifest = obs.RunManifest.begin(kind="serve_preempt", config={
+        "design": design, "checkpoint_every": every,
+        "kill_at_step": kill_at, "steps": int(opt_spec["steps"]),
+        "nlanes": int(opt_spec["nlanes"])})
+    status = "failed"
+
+    def preempt_config(**kw):
+        base = dict(batch_cases=batch_cases, queue_max=8,
+                    deadline_s=timeout_s)
+        base.update(kw)
+        return default_config(**base)
+
+    try:
+        # -- movement 1: clean uninterrupted reference ----------------
+        faults.install("")
+        svc = SweepService(fowt, preempt_config(store_dir=None))
+        t_opt = svc.submit_optimize(dict(opt_spec))
+        t_s = [svc.submit(Hs[i], Tp[i], beta[i]) for i in range(2)]
+        svc.start()
+        clean_opt = t_opt.result(timeout_s)
+        clean_sweep = [t.result(timeout_s) for t in t_s]
+        svc.stop()
+        if not (clean_opt.ok and all(r.ok for r in clean_sweep)):
+            raise errors.KernelFailure("preempt soak clean pass failed")
+
+        # -- movement 2: the preempted child --------------------------
+        spec = {"design": design, "min_freq": min_freq,
+                "max_freq": max_freq, "dfreq": dfreq,
+                "batch_cases": batch_cases,
+                "journal_dir": journal_dir, "ckpt_dir": ckpt_dir,
+                "checkpoint_every": every, "opt_spec": opt_spec,
+                "kill_spec": f"kill@optimize:step={kill_at}",
+                "timeout_s": timeout_s}
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = {**os.environ, "RAFT_TPU_FAULTS": ""}
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        child = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; from raft_tpu.serve import soak; "
+             "soak.preempt_child_main(sys.argv[1])", json.dumps(spec)],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        killed = child.returncode == 137
+        if not killed:
+            _LOG.error("preempt soak: child exited %d, not the "
+                       "injected kill\nstderr tail:\n%s",
+                       child.returncode,
+                       "\n".join(child.stderr.splitlines()[-15:]))
+        mid = wal.replay(journal_dir)
+        ckpt_records = len(mid["ckpts"])
+        ckpt_steps_on_disk = CheckpointStore(ckpt_dir).steps(
+            mid["ckpts"][min(mid["ckpts"])].get("rdigest", "")
+            if mid["ckpts"] else "")
+
+        # -- movement 3: resume under the ENOSPC wave -----------------
+        faults.install("enospc@checkpoint,enospc@resultstore")
+        svc = SweepService(fowt, preempt_config(
+            journal_dir=journal_dir, ckpt_dir=ckpt_dir,
+            checkpoint_every=every, store_dir=store_dir,
+            storage_shed_hold_s=shed_hold_s))
+        info = svc.recover()
+        svc.start()
+        resumed = {seq: t.result(timeout_s)
+                   for seq, t in sorted(info["tickets"].items())}
+        wave_sweep = svc.submit(Hs[0], Tp[0], beta[0]).result(timeout_s)
+
+        # -- movement 4: the wave lifts, the shed self-clears ---------
+        faults.install("")
+        time.sleep(shed_hold_s + 0.2)
+        clear_sweep = svc.submit(Hs[1], Tp[1], beta[1]).result(timeout_s)
+        summary = svc.stop()
+
+        # -- verdict --------------------------------------------------
+        opt_res = next((r for r in resumed.values()
+                        if r.mode == "optimize"), None)
+        prov = ((opt_res.extra or {}).get("provenance")
+                if opt_res is not None else None) or {}
+        resumed_from = int(prov.get("resumed_from_step") or 0)
+        resume_mismatch = int(
+            opt_res is None or not opt_res.ok
+            or opt_res.digest != clean_opt.digest)
+        corrupt_served = sum(
+            1 for got, ref in ((wave_sweep, clean_sweep[0]),
+                               (clear_sweep, clean_sweep[1]))
+            if not got.ok or got.digest != ref.digest)
+        # full store audit: re-read EVERY persisted entry through the
+        # integrity ladder (corrupt counters are per-handle — a fresh
+        # handle that reads nothing would report 0 vacuously)
+        store = ResultStore(store_dir)
+        store_entries = 0
+        for name in sorted(os.listdir(store_dir)):
+            if not name.endswith(".sum"):
+                continue
+            try:
+                with open(os.path.join(store_dir, name),
+                          encoding="utf-8") as f:
+                    rd = json.load(f).get("rdigest")
+            except (OSError, json.JSONDecodeError):
+                continue
+            if rd and store.get(rd) is not None:
+                store_entries += 1
+        store_corrupt = store.stats()["corrupt"]
+        # self-clear proof: the post-wave request wrote through
+        clear_doc = store.get(wal.request_digest(
+            Hs[1], Tp[1], beta[1], "default"))
+        final = wal.replay(journal_dir)
+        lost = len(final["pending"]) + len(final["deduped"])
+        facts = {
+            "checkpoint_every": every,
+            "ckpt_resumed_from_step": resumed_from,
+            "ckpt_resume_digest_mismatch": resume_mismatch,
+            "storage_corrupt_served_count": corrupt_served
+            + store_corrupt,
+            "ckpt_writes": ckpt_records,
+            "ckpt_resumes": int(summary.get("ckpt_resumed", 0)),
+            "ckpt_corrupt": int(summary.get("ckpt_corrupt", 0)),
+            "storage_sheds": int(summary.get("ckpt_shed", 0))
+            + int(summary.get("store_shed", 0)),
+            "preempt_lost": lost,
+        }
+        manifest.extra["serve_preempt"] = facts
+        report = {
+            **facts,
+            "killed": killed,
+            "child_rc": child.returncode,
+            "kill_spec": spec["kill_spec"],
+            "ckpt_records_journaled": ckpt_records,
+            "ckpt_steps_on_disk_pre_resume": ckpt_steps_on_disk,
+            "recover": {k: info[k] for k in
+                        ("recovered", "replayed", "deduped", "corrupt",
+                         "ckpt_records")},
+            "resumed_digest": (opt_res.digest if opt_res else None),
+            "clean_digest": clean_opt.digest,
+            "ckpt_shed": int(summary.get("ckpt_shed", 0)),
+            "store_shed": int(summary.get("store_shed", 0)),
+            "store_entries_verified": store_entries,
+            "store_write_through_self_cleared": clear_doc is not None,
+            "replayed_lost_count": summary.get("replayed_lost_count"),
+            "summary": summary,
+            "wall_s": time.monotonic() - t0,
+            "ok": (killed
+                   and resumed_from >= every > 0
+                   and resume_mismatch == 0
+                   and corrupt_served == 0 and store_corrupt == 0
+                   and ckpt_records >= 1
+                   and int(summary.get("ckpt_shed", 0)) >= 1
+                   and int(summary.get("store_shed", 0)) >= 1
+                   and clear_doc is not None
+                   and lost == 0
+                   and summary.get("replayed_lost_count") == 0
+                   and summary.get("unhandled", 0) == 0),
+        }
+        status = "ok" if report["ok"] else "failed"
+    finally:
+        faults.clear()
+        obs.finish_run(manifest, status=status)
+    lvl = _LOG.info if report["ok"] else _LOG.error
+    lvl("preempt soak: %s — child rc=%d, %d ckpt record(s), resumed "
+        "from step %d/%d, digest %s, sheds ckpt=%d store=%d, "
+        "self-clear=%s, %d lost, %.1fs",
+        "OK" if report["ok"] else "FAILED", child.returncode,
+        ckpt_records, resumed_from, int(opt_spec["steps"]),
+        "MATCH" if not resume_mismatch else "MISMATCH",
+        report["ckpt_shed"], report["store_shed"],
+        report["store_write_through_self_cleared"], lost,
+        report["wall_s"])
+    return report
+
+
+# ---------------------------------------------------------------------------
 # cross-host failover soak: the replication acceptance harness
 # ---------------------------------------------------------------------------
 

@@ -19,6 +19,8 @@ serve     ``serve.service`` request worker (per-request, pre/post solve)
 journal   ``serve.journal`` write-ahead journal writes
 replica   ``serve.replica`` WAL mirroring to peer stores
 resultstore  ``serve.resultstore`` content-addressed result reads
+optimize  ``parallel.optimize`` segment loop (host-side, per segment)
+checkpoint  ``serve.checkpoint`` descent/sweep checkpoint store
 ========  ==========================================================
 
 Spec grammar (comma-separated specs)::
@@ -26,10 +28,10 @@ Spec grammar (comma-separated specs)::
     RAFT_TPU_FAULTS="<action>@<site>[:qualifier]*[,...]"
 
     action     nan | raise | corrupt | hang | kill | torn | drop | lag
-               | stale
+               | stale | enospc | eio
     qualifier  case=N | lane=N | fowt=N | req=N | part=N | entry=HEX
-               | once | times=K | s=SECONDS | ms=MILLIS  (hang/lag
-               duration)
+               | step=N | once | times=K | s=SECONDS | ms=MILLIS
+               (hang/lag duration)
 
 Examples: ``nan@dynamics:case=2`` poisons case 2's converged impedance
 with NaN (exercising the non-finite sanitizer and the ladder);
@@ -60,9 +62,10 @@ _FIRED: dict[tuple, int] = {}
 _CONTEXT: list[dict] = []
 
 _ACTIONS = ("nan", "raise", "corrupt", "hang", "kill", "torn", "drop",
-            "lag", "stale")
+            "lag", "stale", "enospc", "eio")
 _SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache",
-          "serve", "journal", "replica", "resultstore")
+          "serve", "journal", "replica", "resultstore", "optimize",
+          "checkpoint")
 
 #: exception class raised per site for ``raise@<site>`` specs.  Site/
 #: action support: statics, dynamics, kernel take ``nan`` and ``raise``;
@@ -89,9 +92,23 @@ _SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache",
 #: before the size/sha256 sidecar check — the delete-and-miss path) and
 #: ``stale`` (``stale@resultstore[:entry=HEX]`` perturbs the PARSED
 #: payload after the byte-level checks pass, a digest-mismatched entry
-#: that only the semantic result-digest check can reject) and nothing
-#: else; ``entry=`` matches the bare hex stem of the request digest
-#: (digest strings carry a ``:`` which the qualifier grammar reserves).
+#: that only the semantic result-digest check can reject), ``enospc``
+#: (the write path sees a full disk — proven ENOSPC becomes a typed
+#: ``StorageExhausted`` the service sheds on) and ``eio`` (the read
+#: path sees an I/O error — a plain miss, never a deletion);
+#: ``entry=`` matches the bare hex stem of the request digest
+#: (digest strings carry a ``:`` which the qualifier grammar reserves);
+#: optimize (the host-side segment loop in raft_tpu/parallel/
+#: optimize.py) takes ``kill`` only (``kill@optimize:step=N``
+#: hard-exits the process at the segment boundary whose cumulative
+#: step count is N — the TPU-VM preemption the checkpoint/resume layer
+#: recovers from); checkpoint (the descent/sweep checkpoint store in
+#: raft_tpu/serve/checkpoint.py) takes ``corrupt`` (damage the raw
+#: checkpoint bytes pre-sidecar-check — resume must fall back one
+#: segment, counted), ``enospc`` (write-side exhaustion -> typed
+#: ``StorageExhausted``; checkpointing sheds first on the storage
+#: ladder) and ``eio`` (read-side I/O error -> counted miss + segment
+#: fallback) and nothing else.
 _RAISES = {
     "statics": errors.StaticsDivergence,
     "dynamics": errors.DynamicsSingular,
@@ -114,19 +131,41 @@ _UNSUPPORTED = {("raise", "exec_cache"), ("corrupt", "statics"),
                 ("hang", "statics"), ("hang", "dynamics"),
                 ("hang", "kernel"), ("hang", "sweep"),
                 ("hang", "exec_cache")}
-_UNSUPPORTED |= {("kill", s) for s in _SITES if s != "serve"}
+# kill hard-exits a host loop: the serve request worker (mid-batch)
+# and the optimize segment loop (mid-descent, kill@optimize:step=N —
+# the preemption the checkpoint/resume layer recovers from)
+_UNSUPPORTED |= {("kill", s) for s in _SITES
+                 if s not in ("serve", "optimize")}
 _UNSUPPORTED |= {("torn", s) for s in _SITES if s != "journal"}
-_UNSUPPORTED |= {(a, "journal") for a in _ACTIONS if a != "torn"}
+# the journal write seam takes torn (truncate the fresh record) and
+# enospc (a full disk under the WAL: counted durability gap + a
+# storage_degraded signal, never a dead service) and nothing else
+_UNSUPPORTED |= {(a, "journal") for a in _ACTIONS
+                 if a not in ("torn", "enospc")}
 # drop/lag are replica-only, and the replica site takes nothing else
 _UNSUPPORTED |= {("drop", s) for s in _SITES if s != "replica"}
 _UNSUPPORTED |= {("lag", s) for s in _SITES if s != "replica"}
 _UNSUPPORTED |= {(a, "replica") for a in _ACTIONS
                  if a not in ("drop", "lag")}
-# stale is resultstore-only, and the resultstore site takes only the
-# two integrity attacks its read path implements (corrupt + stale)
+# the resultstore read/write seams take the two integrity attacks
+# (corrupt + stale), write-side exhaustion (enospc -> typed
+# StorageExhausted shed) and read-side I/O error (eio -> plain miss)
 _UNSUPPORTED |= {("stale", s) for s in _SITES if s != "resultstore"}
 _UNSUPPORTED |= {(a, "resultstore") for a in _ACTIONS
-                 if a not in ("corrupt", "stale")}
+                 if a not in ("corrupt", "stale", "enospc", "eio")}
+# enospc fires only at persistence WRITE seams (each must prove the
+# errno before raising typed StorageExhausted); eio only at the two
+# read seams whose miss path it drives; the checkpoint store takes the
+# integrity attack + both resource faults, the optimize segment loop
+# takes only the preemption kill
+_UNSUPPORTED |= {("enospc", s) for s in _SITES
+                 if s not in ("journal", "resultstore", "exec_cache",
+                              "checkpoint")}
+_UNSUPPORTED |= {("eio", s) for s in _SITES
+                 if s not in ("resultstore", "checkpoint")}
+_UNSUPPORTED |= {(a, "optimize") for a in _ACTIONS if a != "kill"}
+_UNSUPPORTED |= {(a, "checkpoint") for a in _ACTIONS
+                 if a not in ("corrupt", "enospc", "eio")}
 
 #: default stall of a ``hang@serve`` spec without an ``s=``/``ms=``
 #: qualifier — long enough to trip any realistic watchdog deadline

@@ -1,0 +1,685 @@
+"""Preemption-tolerant long work (PR: ISSUE 15): the checkpoint store,
+chunked descents/sweeps, resume-on-recover, and storage-fault
+hardening.
+
+Covers the ISSUE's acceptance head on:
+
+- CheckpointStore roundtrip / sidecar-last torn puts / corrupt =
+  counted delete-and-miss with one-segment fallback / EIO = plain miss
+  / ENOSPC + disk budget = typed ``StorageExhausted``;
+- segmented-vs-monolithic descent parity (bitwise θ / f_best / traces)
+  and resume-from-checkpoint bitwise reproduction of the uninterrupted
+  run, on the 2-frequency-bin cylinder;
+- ``sweep_cases_chunked`` partial-result persistence (killed sweep
+  re-solves only unfinished chunks; edited tables never reuse stale
+  chunks);
+- the service storage-shed ladder (ENOSPC sheds checkpointing first,
+  then the result-store write-through; admission and delivery stay
+  alive; the shed self-clears) and recover()'s resume wiring +
+  replay idempotence (third life all-terminal);
+- the WAL ``objective_trace`` cap (rotation-size regression) and the
+  new trend facts / zero-tolerance SLO rules;
+- the preempt soak acceptance (slow tier — CI runs the bounded
+  ``raftserve soak --preempt`` step).
+
+The physics fixtures ride the 2-bin cylinder with a module-scoped
+executable cache so segment programs compile once; the host-only unit
+tier runs first and dominates the count.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import errors
+from raft_tpu.serve import journal as wal
+from raft_tpu.serve.checkpoint import CheckpointStore, is_enospc
+from raft_tpu.testing import faults
+
+KEY = "sha256:feedfacecafe0123"
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return CheckpointStore(str(tmp_path / "ckpt"))
+
+
+def _arrays(seed=0, nsteps=2):
+    rng = np.random.default_rng(seed)
+    return {"c0": rng.normal(size=(3, 2)),
+            "c1": np.zeros((3,), bool),
+            "obj_trace": rng.normal(size=(nsteps, 3)),
+            "gnorm_trace": rng.normal(size=(nsteps, 3))}
+
+
+# ---------------------------------------------------------------------------
+# unit: the checkpoint store's integrity ladder
+# ---------------------------------------------------------------------------
+
+def test_store_roundtrip_sidecar_and_delete(store):
+    a = _arrays()
+    cd = store.put(KEY, 2, a, meta={"identity": "I", "nleaves": 2})
+    assert cd and cd.startswith("sha256:")
+    store.put(KEY, 4, a, meta={"identity": "I", "nleaves": 2})
+    assert store.steps(KEY) == [2, 4]
+    step, arrays, meta = store.latest(KEY)
+    assert step == 4 and meta["identity"] == "I"
+    np.testing.assert_array_equal(arrays["c0"], a["c0"])
+    # exact-step read (the chunked-sweep path)
+    step, arrays, _ = store.get(KEY, 2)
+    assert step == 2
+    # max_step bound: resume never runs past the requested horizon
+    assert store.latest(KEY, max_step=3)[0] == 2
+    assert store.disk_bytes() > 0
+    store.delete(KEY)
+    assert store.steps(KEY) == [] and store.latest(KEY) is None
+    assert store.stats()["writes"] == 2
+
+
+def test_torn_put_reads_as_miss_never_state(store, tmp_path):
+    """A payload without its certifying sidecar (crash mid-put) is a
+    plain miss while fresh — then a reclaimed (counted) torn put once
+    the grace window lapses, so repeated preemptions can never fill
+    the disk budget with dead files.  A sidecar without its payload is
+    counted corruption immediately."""
+    from raft_tpu.obs.journalio import fsync_write
+
+    entry, sidecar = store._paths(KEY, 2)
+    fsync_write(entry, b"torn-partial-write")
+    assert store.latest(KEY) is None
+    assert store.stats()["corrupt"] == 0          # fresh: left alone
+    assert os.path.exists(entry)
+    # age the orphan past the grace window: reclaimed + counted
+    old = time.time() - store.TORN_GRACE_S - 5.0
+    os.utime(entry, (old, old))
+    assert store.latest(KEY) is None
+    assert not os.path.exists(entry)
+    assert store.stats()["corrupt"] == 1
+    # orphan sidecar: proven corruption, deleted + counted
+    store.put(KEY, 4, _arrays(), meta={})
+    os.unlink(store._paths(KEY, 4)[0])
+    assert store.latest(KEY) is None
+    assert store.stats()["corrupt"] == 2
+    # delete() sweeps orphans with no grace (the key is finished)
+    fsync_write(entry, b"torn-again")
+    store.delete(KEY)
+    assert not os.path.exists(entry)
+    assert store.disk_bytes() == 0
+
+
+def test_corrupt_checkpoint_falls_back_one_segment(store):
+    store.put(KEY, 2, _arrays(1), meta={"identity": "I"})
+    store.put(KEY, 4, _arrays(2), meta={"identity": "I"})
+    faults.install("corrupt@checkpoint:step=4")
+    try:
+        step, arrays, _ = store.latest(KEY)
+    finally:
+        faults.clear()
+    assert step == 2                    # fell back exactly one segment
+    np.testing.assert_array_equal(arrays["c0"], _arrays(1)["c0"])
+    assert store.stats()["corrupt"] == 1
+    assert store.steps(KEY) == [2]      # the damaged entry is deleted
+
+
+def test_eio_read_is_counted_miss_not_deletion(store):
+    store.put(KEY, 2, _arrays(1), meta={})
+    store.put(KEY, 4, _arrays(2), meta={})
+    faults.install("eio@checkpoint:step=4:once")
+    try:
+        step, _, _ = store.latest(KEY)
+    finally:
+        faults.clear()
+    assert step == 2                    # transient error: fallback...
+    assert store.steps(KEY) == [2, 4]   # ...but NO deletion
+    assert store.stats()["read_errors"] == 1
+    assert store.stats()["corrupt"] == 0
+    assert store.latest(KEY)[0] == 4    # clears on the next read
+
+
+def test_enospc_and_budget_raise_typed_storage_exhausted(tmp_path):
+    s = CheckpointStore(str(tmp_path / "c1"))
+    faults.install("enospc@checkpoint")
+    try:
+        with pytest.raises(errors.StorageExhausted) as exc:
+            s.put(KEY, 2, _arrays(), meta={})
+    finally:
+        faults.clear()
+    assert isinstance(exc.value, OSError)         # back-compat base
+    assert exc.value.ctx["component"] == "checkpoint"
+    assert s.stats()["enospc"] == 1
+    # the disk budget trips the SAME typed shed long before a real
+    # ENOSPC would
+    s2 = CheckpointStore(str(tmp_path / "c2"), budget_bytes=64)
+    with pytest.raises(errors.StorageExhausted):
+        s2.put(KEY, 2, _arrays(), meta={})
+    # is_enospc proves the errno chain, not arbitrary OSErrors
+    import errno as _errno
+    assert is_enospc(OSError(_errno.ENOSPC, "x"))
+    assert not is_enospc(OSError(_errno.EIO, "x"))
+    assert not is_enospc(ValueError("x"))
+
+
+def test_storage_fault_grammar():
+    ok = ["enospc@journal", "enospc@resultstore", "enospc@exec_cache",
+          "enospc@checkpoint", "eio@resultstore", "eio@checkpoint",
+          "kill@optimize:step=4", "corrupt@checkpoint:step=2:once"]
+    for s in ok:
+        assert faults.parse(s), s
+    assert faults.parse("kill@optimize:step=4")[0]["match"] == \
+        {"step": 4}
+    # unsupported combos are rejected at parse time, like kill/torn
+    bad = ["enospc@serve", "enospc@statics", "eio@journal",
+           "eio@exec_cache", "kill@checkpoint", "corrupt@optimize",
+           "stale@checkpoint", "hang@optimize", "torn@checkpoint"]
+    for s in bad:
+        assert not faults.parse(s), s
+
+
+# ---------------------------------------------------------------------------
+# unit: WAL objective-trace cap + ckpt records + rotation size
+# ---------------------------------------------------------------------------
+
+def test_cap_trace_keeps_first_last_and_length():
+    extra = {"design": {"d_scale": 1.0},
+             "provenance": {"objective_trace": [float(i)
+                                                for i in range(100)],
+                            "iterations": 100}}
+    capped = wal.cap_trace(extra)
+    t = capped["provenance"]["objective_trace"]
+    assert t["n"] == 100
+    assert t["first"] == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0]
+    assert t["last"] == [92.0, 93.0, 94.0, 95.0, 96.0, 97.0, 98.0,
+                         99.0]
+    # pure: the caller's delivered payload is untouched
+    assert len(extra["provenance"]["objective_trace"]) == 100
+    # short traces pass through structurally unchanged
+    short = {"provenance": {"objective_trace": [1.0, 2.0]}}
+    assert wal.cap_trace(short)["provenance"]["objective_trace"] == \
+        [1.0, 2.0]
+
+
+def test_journal_rotation_size_regression(tmp_path, monkeypatch):
+    """A long descent's objective trace must not bloat rotated WAL
+    parts: record_complete journals the capped form, so thousands of
+    trace entries cost ~a hundred bytes per record."""
+    monkeypatch.setenv("RAFT_TPU_SERVE_JOURNAL_MAX_BYTES", "8192")
+    d = str(tmp_path / "wal")
+    j = wal.RequestJournal(d)
+    trace = [float(i) for i in range(5000)]       # ~100 KB raw
+    for seq in range(8):
+        j.record_admit(seq, f"opt{seq}", f"sha256:{seq:04x}", 0.0, 1.0,
+                       0.0, 30.0, "default",
+                       opt={"bounds": {"d_scale": [0.9, 1.1]}})
+        j.record_complete(
+            seq, f"sha256:{seq:04x}", f"sha256:res{seq:04x}",
+            "optimize", 0, [1.5], 4, True,
+            extra={"design": {"d_scale": 1.0}, "f_best": 1.5,
+                   "provenance": {"iterations": 4,
+                                  "objective_trace": trace}})
+    j.close()
+    # every part stays within ~the rotation bound (an uncapped trace
+    # would make EVERY record ~100 KB, blowing past 8 KiB per line)
+    sizes = [os.path.getsize(os.path.join(d, n))
+             for n in os.listdir(d) if n.startswith("serve.journal")]
+    assert sizes and max(sizes) < 16384
+    state = wal.replay(d)
+    assert len(state["completed"]) == 8
+    t = state["completed"][0]["extra"]["provenance"]["objective_trace"]
+    assert t["n"] == 5000 and len(t["first"]) == 8
+
+
+def test_ckpt_records_replay_nonterminal(tmp_path):
+    d = str(tmp_path / "wal")
+    j = wal.RequestJournal(d)
+    j.record_admit(0, "opt0", "sha256:aa", 0.0, 1.0, 0.0, 30.0,
+                   "default", opt={"bounds": {"d_scale": [0.9, 1.1]}})
+    j.record_ckpt(0, "sha256:aa", 2, "sha256:c1")
+    j.record_ckpt(0, "sha256:aa", 4, "sha256:c2")
+    j.close()
+    state = wal.replay(d)
+    assert len(state["pending"]) == 1             # ckpt is NOT terminal
+    assert state["ckpts"][0]["step"] == 4         # newest wins
+    assert state["ckpts"][0]["cdigest"] == "sha256:c2"
+    assert state["corrupt"] == 0                  # known record type
+
+
+# ---------------------------------------------------------------------------
+# unit: trend facts + the two zero-tolerance SLO rules
+# ---------------------------------------------------------------------------
+
+def test_preempt_trend_facts_and_slo_rules(tmp_path):
+    from raft_tpu.obs import trendstore
+
+    doc = {"kind": "serve_preempt", "config": {},
+           "extra": {"serve_preempt": {
+               "ckpt_resume_digest_mismatch": 0,
+               "storage_corrupt_served_count": 0,
+               "ckpt_resumed_from_step": 2, "ckpt_writes": 1,
+               "ckpt_resumes": 1, "checkpoint_every": 2,
+               "preempt_lost": 0, "storage_sheds": 2}}}
+    facts = trendstore.facts_from_manifest(doc)
+    assert facts["ckpt_resume_digest_mismatch"] == 0
+    assert facts["storage_corrupt_served_count"] == 0
+    assert facts["ckpt_resumed_from_step"] == 2
+    # serve summary rows carry the unprefixed ckpt_*/disk_* facts too
+    sdoc = {"kind": "serve", "config": {}, "extra": {"serve": {
+        "ckpt_writes": 3, "ckpt_corrupt": 0, "ckpt_resumed": 1,
+        "ckpt_shed": 1, "store_shed": 1,
+        "disk_journal_bytes": 1024, "disk_checkpoint_bytes": 2048}}}
+    sfacts = trendstore.facts_from_manifest(sdoc)
+    assert sfacts["ckpt_writes"] == 3
+    assert sfacts["disk_checkpoint_bytes"] == 2048
+    names = {r["name"] for r in trendstore.DEFAULT_SLO_RULES}
+    assert "ckpt_resume_digest_mismatch" in names
+    assert "storage_corrupt_served_count" in names
+
+    def doc_for(run_id, mismatch):
+        return {"schema": "raft_tpu.run_manifest/v1", "run_id": run_id,
+                "kind": "serve_preempt", "status": "ok",
+                "started_at": "2026-08-04T10:00:00+00:00",
+                "duration_s": 10.0, "environment": {}, "config": {},
+                "extra": {"serve_preempt": {
+                    **doc["extra"]["serve_preempt"],
+                    "ckpt_resume_digest_mismatch": mismatch}}}
+
+    rules = [r for r in trendstore.DEFAULT_SLO_RULES
+             if r["name"] == "ckpt_resume_digest_mismatch"]
+    db = trendstore.TrendStore(str(tmp_path / "t.sqlite"))
+    db.append(doc_for("r1", 0))
+    verdict = trendstore.evaluate_slo(db.rows(), rules)
+    assert verdict["ok"] and not verdict["results"][0]["skipped"]
+    db.append(doc_for("r2", 1))
+    assert trendstore.evaluate_slo(db.rows(), rules)["ok"] is False
+    # ordinary rows (no preempt facts) skip both rules
+    other = trendstore.evaluate_slo(
+        [{"kind": "sweep_cases", "facts": {"cases_total": 4}}], rules)
+    assert other["results"][0]["skipped"]
+
+
+# ---------------------------------------------------------------------------
+# unit: the service storage-shed ladder (stub engine, no solves)
+# ---------------------------------------------------------------------------
+
+def _stub_factory(mode, fowt, ncases, **kw):
+    def run(Hs, Tp, beta):
+        Hs = np.asarray(Hs)
+        return {"std": np.stack([np.full(6, float(h)) for h in Hs]),
+                "iters": np.full(len(Hs), 3),
+                "converged": np.ones(len(Hs), bool)}
+    run.ncases = ncases
+    run.cache_state = "stub"
+    return run
+
+
+def test_enospc_sheds_store_write_through_then_self_clears(tmp_path):
+    """ENOSPC on the result-store put: the result still delivers, the
+    write-through rung sheds (typed + counted + event), admission
+    stays alive, and the shed self-clears after the hold."""
+    from raft_tpu.serve import ServeConfig, SweepService
+
+    cfg = ServeConfig(queue_max=8, batch_cases=1, window_s=0.01,
+                      batch_deadline_s=5.0,
+                      store_dir=str(tmp_path / "store"),
+                      storage_shed_hold_s=0.2)
+    svc = SweepService(runner_factory=_stub_factory, config=cfg)
+    svc.start()
+    try:
+        faults.install("enospc@resultstore")
+        r1 = svc.submit(1.0, 8.0, 0.0).result(10.0)
+        assert r1.ok                       # delivery survives the disk
+        deadline = time.monotonic() + 5.0
+        while svc.summary()["store_shed"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        summary = svc.summary()
+        assert summary["store_shed"] >= 1
+        assert summary["store"]["entries"] == 0    # nothing persisted
+        # while shed holds, puts are skipped entirely (no more raises)
+        r2 = svc.submit(2.0, 8.0, 0.0).result(10.0)
+        assert r2.ok
+        # the wave lifts; the hold lapses; writes resume
+        faults.install("")
+        time.sleep(0.3)
+        r3 = svc.submit(3.0, 8.0, 0.0).result(10.0)
+        assert r3.ok
+        deadline = time.monotonic() + 5.0
+        while svc.summary()["store"]["entries"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert svc.summary()["store"]["entries"] >= 1   # self-cleared
+        assert svc.summary()["unhandled"] == 0
+        assert "disk_resultstore_bytes" in svc.summary()
+    finally:
+        faults.clear()
+        svc.stop(drain=False, timeout=5.0)
+
+
+def test_recover_passes_resume_wiring_and_replays_idempotent(
+        tmp_path, monkeypatch):
+    """An accepted-unfinished optimization with journaled ckpt records
+    re-runs through the checkpoint plumbing (store + key = the admit's
+    rdigest); the third life is all-terminal."""
+    from raft_tpu.parallel import optimize as opt
+    from raft_tpu.serve import ServeConfig, SweepService
+
+    seen = []
+
+    def stub(base, space, objective=None, *, nlanes=32, steps=30,
+             method="adam", lr=0.02, gtol=1e-4, seed=0, nIter=10,
+             tol=0.01, checkpoint_every=None, ckpt_store=None,
+             ckpt_key=None, on_checkpoint=None, **kw):
+        seen.append({"every": checkpoint_every, "store": ckpt_store,
+                     "key": ckpt_key, "cb": on_checkpoint})
+        if on_checkpoint is not None:
+            on_checkpoint(2, "sha256:seg2")
+        L = int(nlanes)
+        return {"x": np.ones((L, space.ndim)),
+                "objective": np.full(L, 1.5),
+                "grad_norm": np.zeros(L),
+                "converged": np.ones(L, bool),
+                "nonfinite": np.zeros(L, bool),
+                "iters": np.full(L, steps, np.int32),
+                "obj_trace": np.full((int(steps), L), 1.5),
+                "x_best": np.ones(space.ndim), "f_best": 1.5,
+                "lane_best": 0, "resumed_from_step": 2,
+                "design": {n: 1.0 for n in space.names},
+                "provenance": {"method": method, "steps": int(steps),
+                               "iterations": int(steps),
+                               "grad_norm_best": 0.0,
+                               "grad_nonfinite": 0, "converged": L,
+                               "wall_s": 0.01, "objective": {},
+                               "resumed_from_step": 2,
+                               "checkpoint_every": 2, "segments": 1,
+                               "ckpt_writes": 1, "ckpt_shed": False,
+                               "exec_cache": "disabled"}}
+
+    monkeypatch.setattr(opt, "optimize_designs", stub)
+    spec = opt.normalize_request(
+        {"bounds": {"d_scale": [0.9, 1.1]}, "nlanes": 2, "steps": 4})
+    rdigest = wal.optimize_digest(spec, "default")
+    crashed = str(tmp_path / "crashed")
+    j = wal.RequestJournal(crashed)
+    j.record_admit(0, "opt0-dead", rdigest, 0.0, 1.0, 0.0, 30.0,
+                   "default", opt=spec)
+    j.record_ckpt(0, rdigest, 2, "sha256:seg2")
+    j.close()
+    from types import SimpleNamespace
+    fowt = SimpleNamespace(mooring=None, w=np.array([1.0]),
+                           potSecOrder=0)
+    cfg = ServeConfig(journal_dir=str(tmp_path / "succ"),
+                      ckpt_dir=str(tmp_path / "ckpt"),
+                      checkpoint_every=2, deadline_s=30.0)
+    svc = SweepService(fowt, cfg, runner_factory=_stub_factory)
+    try:
+        info = svc.recover(crashed)
+        assert info["replayed"] == 1 and info["ckpt_records"] == 1
+        res = info["tickets"][0].result(10.0)
+        assert res.ok and res.mode == "optimize"
+        assert res.extra["provenance"]["resumed_from_step"] == 2
+        assert len(seen) == 1
+        assert seen[0]["every"] == 2 and seen[0]["key"] == rdigest
+        assert seen[0]["store"] is svc._ckpt
+        assert seen[0]["cb"] is not None
+        summary = svc.summary()
+        assert summary["ckpt_resumed"] == 1
+        assert summary["ckpt_resumed_from_step"] == 2
+        assert summary["replayed_lost_count"] == 0
+    finally:
+        svc.stop(drain=False, timeout=5.0)
+    # third life: the successor's WAL is terminal — no descent runs,
+    # and the journaled ckpt record never resurrects the request
+    seen.clear()
+    svc2 = SweepService(fowt, cfg, runner_factory=_stub_factory)
+    try:
+        info2 = svc2.recover()
+        assert info2["replayed"] == 0
+        assert seen == []
+        state = wal.replay(cfg.journal_dir)
+        assert state["pending"] == []
+    finally:
+        svc2.stop(drain=False, timeout=5.0)
+
+
+def test_shed_suppresses_writes_but_never_resume(tmp_path, monkeypatch):
+    """While the checkpoint shed holds, a descent still gets the store
+    and key (resume is a READ and must survive the hold) — only the
+    write path is suppressed (``ckpt_resume_only``), and a
+    suppressed-by-request run never re-reports a shed event."""
+    from types import SimpleNamespace
+
+    from raft_tpu.parallel import optimize as opt
+    from raft_tpu.serve import ServeConfig, SweepService
+
+    seen = []
+
+    def stub(base, space, objective=None, *, nlanes=32, steps=30,
+             checkpoint_every=None, ckpt_store=None, ckpt_key=None,
+             on_checkpoint=None, ckpt_resume_only=False, **kw):
+        seen.append({"store": ckpt_store, "key": ckpt_key,
+                     "resume_only": ckpt_resume_only,
+                     "cb": on_checkpoint})
+        L = int(nlanes)
+        return {"x": np.ones((L, space.ndim)),
+                "objective": np.full(L, 1.5),
+                "grad_norm": np.zeros(L),
+                "converged": np.ones(L, bool),
+                "nonfinite": np.zeros(L, bool),
+                "iters": np.full(L, steps, np.int32),
+                "obj_trace": np.full((int(steps), L), 1.5),
+                "x_best": np.ones(space.ndim), "f_best": 1.5,
+                "lane_best": 0,
+                "design": {n: 1.0 for n in space.names},
+                "provenance": {"method": "adam", "steps": int(steps),
+                               "iterations": int(steps),
+                               "grad_norm_best": 0.0,
+                               "grad_nonfinite": 0, "converged": L,
+                               "wall_s": 0.01, "objective": {},
+                               "ckpt_shed": False,
+                               "exec_cache": "disabled"}}
+
+    monkeypatch.setattr(opt, "optimize_designs", stub)
+    fowt = SimpleNamespace(mooring=None, w=np.array([1.0]),
+                           potSecOrder=0)
+    cfg = ServeConfig(ckpt_dir=str(tmp_path / "ckpt"),
+                      checkpoint_every=2, deadline_s=30.0)
+    svc = SweepService(fowt, cfg, runner_factory=_stub_factory)
+    try:
+        svc._storage_shed["checkpoint"] = time.monotonic() + 100.0
+        res = svc.submit_optimize(
+            {"bounds": {"d_scale": [0.9, 1.1]}, "nlanes": 2,
+             "steps": 4}).result(10.0)
+        assert res.ok
+        assert len(seen) == 1
+        assert seen[0]["store"] is svc._ckpt      # reads still flow
+        assert seen[0]["key"] is not None
+        assert seen[0]["resume_only"] is True     # writes suppressed
+        assert seen[0]["cb"] is None
+        # a suppressed run never extends the hold
+        assert svc.summary()["ckpt_shed"] == 0
+    finally:
+        svc.stop(drain=False, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# integration: segmented-vs-monolithic parity + resume (2-bin cylinder)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module", autouse=True)
+def _module_exec_cache(tmp_path_factory):
+    """Module-scoped executable cache: the segment/finalize programs
+    compile once and every later descent in this module warm-starts."""
+    from raft_tpu.parallel import exec_cache
+
+    d = tmp_path_factory.mktemp("execcache")
+    old = os.environ.get("RAFT_TPU_EXEC_CACHE_DIR")
+    os.environ["RAFT_TPU_EXEC_CACHE_DIR"] = str(d)
+    exec_cache.reset_memo()
+    yield
+    if old is None:
+        os.environ.pop("RAFT_TPU_EXEC_CACHE_DIR", None)
+    else:
+        os.environ["RAFT_TPU_EXEC_CACHE_DIR"] = old
+    exec_cache.reset_memo()
+
+
+@pytest.fixture(scope="module")
+def cyl():
+    from raft_tpu.serve.soak import build_fowt
+    return build_fowt("Vertical_cylinder", 0.1, 0.9, 0.4)   # 2 bins
+
+
+@pytest.fixture(scope="module")
+def cyl_space(cyl):
+    from raft_tpu.parallel import optimize as opt
+    return opt.DesignSpace(cyl, {"d_scale": (0.9, 1.1),
+                                 "moor_L": (0.95, 1.05)})
+
+
+_DESCENT_KW = dict(nlanes=2, steps=4, lr=0.05, seed=3, nIter=2,
+                   tol=0.01, strict=False)
+_OBJ = {"metric": "std", "Hs": 5.0, "Tp": 9.0}
+
+
+def test_segmented_descent_matches_monolithic_bitwise(cyl, cyl_space):
+    """The ISSUE acceptance pin: checkpoint_every chunking reproduces
+    the monolithic optimize_designs result bitwise — θ lanes, best
+    objective, traces, AND the per-lane iteration counters."""
+    from raft_tpu.parallel import optimize as opt
+
+    mono = opt.optimize_designs(cyl, cyl_space, _OBJ, **_DESCENT_KW)
+    seg = opt.optimize_designs(cyl, cyl_space, _OBJ,
+                               checkpoint_every=2, **_DESCENT_KW)
+    np.testing.assert_array_equal(np.asarray(mono["x"]),
+                                  np.asarray(seg["x"]))
+    assert mono["f_best"] == seg["f_best"]
+    np.testing.assert_array_equal(np.asarray(mono["obj_trace"]),
+                                  np.asarray(seg["obj_trace"]))
+    np.testing.assert_array_equal(np.asarray(mono["iters"]),
+                                  np.asarray(seg["iters"]))
+    assert seg["provenance"]["checkpoint_every"] == 2
+    assert seg["provenance"]["segments"] == 2
+    assert seg["provenance"]["resumed_from_step"] == 0
+
+
+def test_resume_reproduces_uninterrupted_run_bitwise(
+        cyl, cyl_space, tmp_path):
+    """A descent resumed from its persisted carry finishes with the
+    SAME design digest (bitwise x / f_best / iters) as the
+    uninterrupted segmented run — and the corrupt-checkpoint fault
+    falls the resume back one segment without changing the result."""
+    from raft_tpu.parallel import optimize as opt
+
+    store = CheckpointStore(str(tmp_path / "ck"))
+    key = "sha256:resume0001"
+    ckpts = []
+    store.delete_real = store.delete
+    store.delete = lambda k: None        # keep checkpoints for resume
+    full = opt.optimize_designs(
+        cyl, cyl_space, _OBJ, checkpoint_every=2, ckpt_store=store,
+        ckpt_key=key, on_checkpoint=lambda s, d: ckpts.append((s, d)),
+        **_DESCENT_KW)
+    assert full["resumed_from_step"] == 0
+    assert full["provenance"]["ckpt_writes"] == 1
+    assert ckpts and ckpts[0][0] == 2
+    assert store.steps(key) == [2]
+    # the "successor": same spec, same key — resumes at step 2 and
+    # must land on the identical result
+    resumed = opt.optimize_designs(
+        cyl, cyl_space, _OBJ, checkpoint_every=2, ckpt_store=store,
+        ckpt_key=key, **_DESCENT_KW)
+    assert resumed["resumed_from_step"] == 2
+    np.testing.assert_array_equal(np.asarray(full["x"]),
+                                  np.asarray(resumed["x"]))
+    assert full["f_best"] == resumed["f_best"]
+    np.testing.assert_array_equal(np.asarray(full["iters"]),
+                                  np.asarray(resumed["iters"]))
+    np.testing.assert_array_equal(np.asarray(full["obj_trace"]),
+                                  np.asarray(resumed["obj_trace"]))
+    # corrupt the (only) checkpoint: the resume falls back one segment
+    # — to step 0 here — and STILL reproduces the run, with the
+    # corruption counted and never served
+    faults.install("corrupt@checkpoint:once")
+    try:
+        fallback = opt.optimize_designs(
+            cyl, cyl_space, _OBJ, checkpoint_every=2, ckpt_store=store,
+            ckpt_key=key, **_DESCENT_KW)
+    finally:
+        faults.clear()
+    assert fallback["resumed_from_step"] == 0
+    assert store.stats()["corrupt"] == 1
+    np.testing.assert_array_equal(np.asarray(full["x"]),
+                                  np.asarray(fallback["x"]))
+    # an ENOSPC mid-run sheds checkpointing but finishes the descent
+    faults.install("enospc@checkpoint")
+    try:
+        shed = opt.optimize_designs(
+            cyl, cyl_space, _OBJ, checkpoint_every=2, ckpt_store=store,
+            ckpt_key="sha256:shedkey01", **_DESCENT_KW)
+    finally:
+        faults.clear()
+    assert shed["provenance"]["ckpt_shed"] == 1
+    assert shed["provenance"]["ckpt_writes"] == 0
+    np.testing.assert_array_equal(np.asarray(full["x"]),
+                                  np.asarray(shed["x"]))
+
+
+def test_sweep_cases_chunked_resumes_only_unfinished(cyl, tmp_path):
+    """Partial-result persistence for large case tables: a second run
+    re-solves nothing; an edited table never reuses a stale chunk."""
+    from raft_tpu.parallel.sweep import sweep_cases, sweep_cases_chunked
+
+    store = CheckpointStore(str(tmp_path / "sw"))
+    rng = np.random.default_rng(7)
+    Hs = 2.0 + rng.random(4)
+    Tp = 8.0 + rng.random(4)
+    beta = np.zeros(4)
+    key = "sha256:sweeptable01"
+    out1, info1 = sweep_cases_chunked(cyl, Hs, Tp, beta, store=store,
+                                      key=key, chunk=2, nIter=4)
+    assert info1["solved"] == [0, 1] and info1["resumed"] == []
+    assert out1["std"].shape == (4, 6)
+    # reference: the same table through plain sweep_cases
+    ref = sweep_cases(cyl, Hs, Tp, beta, nIter=4)
+    np.testing.assert_allclose(out1["std"], np.asarray(ref["std"]),
+                               rtol=0, atol=0)
+    # second run: every chunk resumes from the store, nothing solves
+    out2, info2 = sweep_cases_chunked(cyl, Hs, Tp, beta, store=store,
+                                      key=key, chunk=2, nIter=4)
+    assert info2["resumed"] == [0, 1] and info2["solved"] == []
+    np.testing.assert_array_equal(out1["std"], out2["std"])
+    np.testing.assert_array_equal(out1["Xi"], out2["Xi"])
+    # edit one case in chunk 1: the content guard forces a re-solve of
+    # exactly that chunk
+    Hs2 = Hs.copy()
+    Hs2[3] += 0.25
+    out3, info3 = sweep_cases_chunked(cyl, Hs2, Tp, beta, store=store,
+                                      key=key, chunk=2, nIter=4)
+    assert info3["resumed"] == [0] and info3["solved"] == [1]
+    assert not np.array_equal(out3["std"][2:], out1["std"][2:])
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the preemption chaos soak (slow tier; CI runs the
+# bounded `raftserve soak --preempt` step)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_preempt_soak_acceptance(tmp_path):
+    from raft_tpu.serve.soak import run_preempt
+
+    report = run_preempt(
+        journal_dir=str(tmp_path / "wal"),
+        ckpt_dir=str(tmp_path / "ckpt"),
+        store_dir=str(tmp_path / "store"))
+    assert report["killed"], report
+    assert report["ckpt_resumed_from_step"] >= \
+        report["checkpoint_every"] > 0, report
+    assert report["ckpt_resume_digest_mismatch"] == 0, report
+    assert report["storage_corrupt_served_count"] == 0, report
+    assert report["preempt_lost"] == 0, report
+    assert report["ckpt_shed"] >= 1 and report["store_shed"] >= 1
+    assert report["ok"], json.dumps(
+        {k: v for k, v in report.items() if k != "summary"},
+        indent=1, default=str)

@@ -654,6 +654,61 @@ def test_rtl006_plain_strings_and_other_calls_silent(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# RTL007 — persistence write-path discipline
+# ---------------------------------------------------------------------------
+
+RAW_PERSIST_WRITE = """
+    import json, os
+
+    def put(path, doc):
+        with open(path + ".tmp", "w") as f:    # raw write path
+            json.dump(doc, f)
+        os.replace(path + ".tmp", path)
+"""
+
+
+def test_rtl007_fires_on_raw_write_in_persistence_module(tmp_path):
+    rep = lint_src(tmp_path, RAW_PERSIST_WRITE, "RTL007",
+                   relname="raft_tpu/serve/checkpoint.py")
+    assert len(rep.findings) == 1
+    assert "fsync_write" in rep.findings[0].message
+    assert rep.findings[0].rule == "RTL007"
+
+
+def test_rtl007_shared_helper_reads_and_sanction_silent(tmp_path):
+    """The shared helper itself is the sanctioned write shape,
+    read-mode opens are out of scope, and a config-sanctioned file
+    keeps its raw writes."""
+    rep = lint_src(tmp_path, """
+        import os, threading
+
+        def fsync_write(path, data):
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:         # THE helper: sanctioned
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+        def read(path):
+            with open(path, "rb") as f:        # read-mode: fine
+                return f.read()
+    """, "RTL007", relname="raft_tpu/serve/checkpoint.py")
+    assert rep.findings == []
+    # identical raw write in a config-sanctioned file: silent
+    rep = lint_src(
+        tmp_path, RAW_PERSIST_WRITE, "RTL007",
+        relname="raft_tpu/serve/checkpoint.py",
+        options={"rtl007": {
+            "sanctioned": ["raft_tpu/serve/checkpoint.py"]}})
+    assert rep.findings == []
+    # a module outside the persistence list is out of scope
+    rep = lint_src(tmp_path, RAW_PERSIST_WRITE, "RTL007",
+                   relname="raft_tpu/utils/fixture.py")
+    assert rep.findings == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions / baseline / config / CLI
 # ---------------------------------------------------------------------------
 

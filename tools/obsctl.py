@@ -368,6 +368,23 @@ def _fmt_event(e: dict) -> str | None:
     if t == "statics_warm_rejected":
         return (f"{ts} STATICS warm seed rejected case {e.get('case')} "
                 f"(iters {e.get('iters')}; cold re-solve)")
+    # preemption-tolerance events (serve/checkpoint.py — "Preemption &
+    # storage")
+    if t in ("ckpt_resume", "ckpt_resumed"):
+        req = f" req {e['req']}" if e.get("req") is not None else ""
+        return (f"{ts} CKPT resume{req} from step {e.get('step')}"
+                f"/{e.get('steps')}")
+    if t == "ckpt_resume_rejected":
+        return (f"{ts} CKPT resume rejected (step {e.get('step')}: "
+                f"identity/layout mismatch) — fresh start")
+    if t == "ckpt_corrupt":
+        return (f"{ts} CKPT corrupt @step {e.get('step')} "
+                f"({e.get('reason')}) — fall back one segment")
+    if t == "storage_degraded":
+        return (f"{ts} STORAGE degraded: {e.get('component')} shed "
+                f"(ENOSPC/budget)")
+    if t == "storage_recovered":
+        return f"{ts} storage recovered: {e.get('component')} re-probing"
     return None
 
 

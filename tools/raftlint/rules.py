@@ -828,5 +828,69 @@ class RTL006:
         return None
 
 
-ALL_RULES = [RTL001(), RTL002(), RTL003(), RTL004(), RTL005(), RTL006()]
+# ---------------------------------------------------------------------------
+# RTL007 — persistence write-path discipline
+# ---------------------------------------------------------------------------
+
+class RTL007:
+    """Static twin of the persistence integrity contract (PR 12/15):
+    every durable write in a persistence module goes through the ONE
+    shared ``tmp -> fsync -> rename`` helper
+    (``obs.journalio.fsync_write``) so the sidecar-last / torn-put /
+    crash-safety discipline cannot silently fork.  A raw write-mode
+    ``open()`` in a checkpoint/result-store/journal module is a write
+    path the integrity ladder never audits."""
+
+    code = "RTL007"
+    name = "persistence-discipline"
+    summary = ("raw write-mode open() in a persistence module outside "
+               "the shared tmp->fsync->rename helper")
+
+    _WRITE = set("wax")
+    _DEFAULT_MODULES = ["raft_tpu/serve/checkpoint.py",
+                        "raft_tpu/serve/resultstore.py",
+                        "raft_tpu/serve/journal.py"]
+    _DEFAULT_HELPERS = ["fsync_write", "_fsync_write"]
+
+    def check(self, mod, opts):
+        modules = opts.get("persistence-modules", self._DEFAULT_MODULES)
+        if not _prefix_match(mod.relpath, modules):
+            return
+        if _prefix_match(mod.relpath, opts.get("sanctioned", [])):
+            return
+        helpers = set(opts.get("helper-functions",
+                               self._DEFAULT_HELPERS))
+        walk = _ParentedWalk(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "open"):
+                continue
+            mode = None
+            if len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" \
+                        and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if not (isinstance(mode, str)
+                    and (set(mode) & self._WRITE)):
+                continue                 # read-mode / dynamic: fine
+            fn = next((a for a in walk.ancestors(node)
+                       if isinstance(a, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))), None)
+            if fn is not None and fn.name in helpers:
+                continue                 # the shared helper itself
+            yield mod.finding(
+                self.code, node,
+                f"write-mode open({mode!r}) in a persistence module "
+                "outside the shared tmp->fsync->rename helper — route "
+                "durable writes through obs.journalio.fsync_write "
+                "(per-writer tmp, fsync, atomic rename, sidecar-last) "
+                "or sanction the file in [tool.raftlint.rtl007]")
+
+
+ALL_RULES = [RTL001(), RTL002(), RTL003(), RTL004(), RTL005(), RTL006(),
+             RTL007()]
 RULES_BY_CODE = {r.code: r for r in ALL_RULES}

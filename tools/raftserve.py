@@ -52,6 +52,20 @@ Subcommands::
         boundary and every digest is bit-for-bit identical to an
         uninterrupted clean run.
 
+    raftserve soak --preempt --journal-dir DIR --ckpt-dir DIR \\
+                   --store-dir DIR [--checkpoint-every N]
+        Preemption soak (checkpoint/resume): a journaled,
+        checkpoint-enabled child admits one design optimization and is
+        hard-killed mid-descent at a segment boundary
+        (kill@optimize:step=N); the successor recovers the WAL and
+        resumes the descent from the newest valid checkpoint while an
+        ENOSPC wave sheds checkpointing then the result-store
+        write-through (typed StorageExhausted, self-clearing); exits
+        nonzero unless the resumed design digest is bit-for-bit
+        identical to an uninterrupted clean run, resumed_from_step >=
+        checkpoint_every, zero requests were lost, and zero corrupt
+        bytes were served.
+
     raftserve soak --storm --store-dir DIR [--journal-dir DIR]
         Result-tier soak: duplicate-heavy traffic over a persistent
         content-addressed store, a cross-replica read wave, a
@@ -113,6 +127,34 @@ def _build_fowts(args):
 def cmd_soak(args) -> int:
     from raft_tpu.serve import soak
     from raft_tpu.serve.config import ServeConfig
+
+    if args.preempt:
+        if not (args.journal_dir and args.ckpt_dir and args.store_dir):
+            print("raftserve soak --preempt needs --journal-dir, "
+                  "--ckpt-dir and --store-dir", file=sys.stderr)
+            return 2
+        report = soak.run_preempt(
+            args.design, journal_dir=args.journal_dir,
+            ckpt_dir=args.ckpt_dir, store_dir=args.store_dir,
+            min_freq=args.min_freq, max_freq=args.max_freq,
+            dfreq=args.dfreq, checkpoint_every=args.checkpoint_every,
+            kill_at_step=args.kill_at_step, seed=args.seed,
+            timeout_s=args.timeout)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=1, default=str)
+        print(f"raftserve preemption soak: "
+              f"{'OK' if report['ok'] else 'FAILED'} — child "
+              f"rc={report['child_rc']}, resumed from step "
+              f"{report['ckpt_resumed_from_step']} "
+              f"(every={report['checkpoint_every']}), digest "
+              f"{'MATCH' if not report['ckpt_resume_digest_mismatch'] else 'MISMATCH'}, "
+              f"sheds ckpt={report['ckpt_shed']} "
+              f"store={report['store_shed']}, "
+              f"{report['storage_corrupt_served_count']} corrupt "
+              f"served, {report['preempt_lost']} lost; "
+              f"{report['wall_s']:.1f}s")
+        return 0 if report["ok"] else 1
 
     if args.storm:
         if not args.store_dir:
@@ -575,6 +617,23 @@ def main(argv=None) -> int:
                         "(--storm)")
     p.add_argument("--kill-at", type=int, default=6,
                    help="request seq the kill@serve fault fires at")
+    p.add_argument("--preempt", action="store_true",
+                   help="preemption soak (checkpoint/resume): a "
+                        "journaled, checkpoint-enabled child dies "
+                        "mid-descent (kill@optimize:step=N); the "
+                        "successor resumes from the newest valid "
+                        "checkpoint under an ENOSPC wave — gate "
+                        "resumed-digest parity, typed storage sheds, "
+                        "zero loss, zero corrupt bytes")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint-store directory (required with "
+                        "--preempt)")
+    p.add_argument("--checkpoint-every", type=int, default=2,
+                   help="descent steps per checkpointed segment "
+                        "(--preempt)")
+    p.add_argument("--kill-at-step", type=int, default=None,
+                   help="descent step the kill@optimize fault fires "
+                        "at (--preempt; default: checkpoint-every)")
     p.set_defaults(fn=cmd_soak)
 
     p = sub.add_parser("serve", help="HTTP endpoint over SweepService")

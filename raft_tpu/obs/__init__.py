@@ -55,6 +55,7 @@ import os
 from raft_tpu.obs.tracing import (                              # noqa: F401
     span, current_span, spans, aggregate, reset as reset_tracing,
     chrome_trace, export_chrome_trace, dropped_spans,
+    TraceContext, TRACE_HEADER,
 )
 from raft_tpu.obs.metrics import (                              # noqa: F401
     REGISTRY, counter, gauge, histogram, snapshot, to_prometheus,

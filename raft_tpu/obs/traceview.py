@@ -1,0 +1,382 @@
+"""Distributed-trace assembly: one request's journey, re-read from disk.
+
+The serving stack propagates a W3C-style trace context
+(:class:`raft_tpu.obs.tracing.TraceContext`) from the router through
+``SweepService.submit`` into every WAL record the request touches —
+``admit`` / ``batch`` / ``ckpt`` / ``complete`` / ``fail`` each carry
+the member's ``{"trace_id", "span_id", "parent_id"}`` dict, and a
+checkpoint resume on a successor re-journals the inherited context as
+a *child* span (same ``trace_id``, fresh ``span_id``, ``parent_id`` =
+the dead host's span).  That makes the write-ahead journal itself the
+trace store: a trace survives a crash + failover by construction,
+with no tracing daemon in the loop.
+
+This module is the read half — ``obsctl trace`` and the failover/
+preempt soaks call it to fold one or more journal directories (and
+optionally flight-recorder event files) into:
+
+- :func:`assemble` — the span graph of one ``trace_id`` plus its
+  connectivity verdict (``orphan_spans``, ``resume_links``,
+  ``process_tracks``);
+- :func:`chrome_trace` — a Perfetto-loadable Chrome Trace Event
+  Format object: one process track per ``(run_id, pid)`` service
+  lifetime, ``X`` slices for request spans, ``s``/``f`` flow arrows
+  for parent links (the failover handoff renders as an arrow from the
+  dead host's slice into the successor's) and batch membership;
+- :func:`summary_facts` — the trend-store facts the
+  ``trace_orphan_spans <= 0`` SLO rule gates on.
+
+Connectivity verdict: every trace has exactly ONE entitled root — the
+original admission (the trace's earliest span), whose ``parent_id``
+is the router's (or caller's) un-journaled span.  Any *other* span
+whose parent cannot be resolved inside the assembled graph is an
+orphan: a break in the propagation chain.  A ``resume_link`` is a
+resolved parent edge that crosses a process boundary — the failover
+signature.
+
+Pure stdlib + :mod:`raft_tpu.obs.journalio` — jax-free, importable by
+``obsctl`` on a host with no accelerator runtime at all.
+"""
+from __future__ import annotations
+
+import os
+
+from raft_tpu.obs import journalio
+
+#: the serve WAL's on-disk name (mirrors ``serve/journal.py`` — this
+#: module deliberately does NOT import the serve package, whose
+#: ``__init__`` pulls in jax)
+JOURNAL_FILENAME = "serve.journal.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# journal discovery + raw scan
+# ---------------------------------------------------------------------------
+
+def _parts(journal_dir: str) -> list[str]:
+    """Journal files oldest-first (rotated ``.N`` parts then the live
+    file) — the same fold order ``serve.journal.replay`` uses."""
+    main = os.path.join(journal_dir, JOURNAL_FILENAME)
+    parts = []
+    i = 1
+    while os.path.exists(f"{main}.{i}"):
+        parts.append(f"{main}.{i}")
+        i += 1
+    parts.reverse()
+    if os.path.exists(main):
+        parts.append(main)
+    return parts
+
+
+def discover_journal_dirs(root: str) -> list[str]:
+    """Every directory under ``root`` (inclusive) holding a serve
+    journal, sorted.  Accepts either a journal directory itself or a
+    soak tree (``root/primary``, ``root/mirror``,
+    ``root/successor/journal``) — a failed-over trace spans several
+    journals, and the assembler needs all of them."""
+    root = os.path.abspath(root)
+    found = set()
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if any(f == JOURNAL_FILENAME or
+               f.startswith(JOURNAL_FILENAME + ".") for f in filenames):
+            found.add(dirpath)
+    return sorted(found)
+
+
+def scan(journal_dirs) -> list[tuple[tuple, dict]]:
+    """Flatten journal directories into ``[(proc_key, record), ...]``
+    in per-directory write order, where ``proc_key`` identifies the
+    service lifetime that wrote the record: ``(run_id, pid)`` from the
+    most recent ``begin`` header in the stream.
+
+    ``replay()`` cannot do this — it folds ``begin`` and ``batch``
+    records away, and a trace needs exactly those: the process
+    identity per span and the batch membership arrows.
+    """
+    out = []
+    for d in journal_dirs:
+        proc = ("?", 0)
+        for path in _parts(d):
+            docs, _bad = journalio.read_counted(path, kind="serve")
+            for rec in docs:
+                if rec.get("type") == "begin":
+                    proc = (str(rec.get("run_id", "?")),
+                            int(rec.get("pid", 0) or 0))
+                    continue
+                out.append((proc, rec))
+    return out
+
+
+def trace_ids(journal_dirs) -> list[str]:
+    """Distinct trace_ids in admit order across the given journals —
+    how the soak/CI gate finds what to assemble without parsing
+    provenance out of delivered results."""
+    seen = []
+    for _proc, rec in scan(journal_dirs):
+        if rec.get("type") != "admit":
+            continue
+        tid = (rec.get("trace") or {}).get("trace_id")
+        if tid and tid not in seen:
+            seen.append(tid)
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# assembly: span graph + connectivity verdict
+# ---------------------------------------------------------------------------
+
+def assemble(trace_id: str, journal_dirs, events_paths=()) -> dict:
+    """Fold every record carrying ``trace_id`` into a span graph::
+
+        {"trace_id": ..., "spans": {span_id: span}, "batches": [...],
+         "instants": [...], "procs": [proc_key, ...],
+         "process_tracks": n, "orphan_spans": n, "roots": [span_id],
+         "resume_links": n, "open_spans": n, "events_matched": n}
+
+    A *span* is one admitted request on one service lifetime::
+
+        {"span_id", "parent_id", "proc", "seq", "rdigest", "name",
+         "t0", "t1" (None while open), "status", "phases"?}
+
+    The primary and its synchronous mirror hold byte-identical records
+    from the same writer, so spans key on ``span_id`` and duplicate
+    sightings fold into one (earliest ``t0`` / latest ``t1`` win).  A
+    successor's re-journaled admit carries a *fresh* child span, so a
+    failover contributes a second span on a second process track,
+    parented on the first — never a duplicate.
+    """
+    trace_id = str(trace_id)
+    spans: dict[str, dict] = {}
+    batches = []
+    instants = []
+    t_last_by_proc: dict[tuple, float] = {}
+
+    def _span_for(ctx: dict, proc, t: float) -> dict | None:
+        sid = (ctx or {}).get("span_id")
+        if not sid or (ctx or {}).get("trace_id") != trace_id:
+            return None
+        sp = spans.get(sid)
+        if sp is None:
+            sp = spans[sid] = {
+                "span_id": sid, "parent_id": ctx.get("parent_id"),
+                "proc": proc, "seq": None, "rdigest": None,
+                "name": None, "t0": float(t), "t1": None,
+                "status": None,
+            }
+        else:
+            sp["t0"] = min(sp["t0"], float(t))
+            if sp["parent_id"] is None and ctx.get("parent_id"):
+                sp["parent_id"] = ctx.get("parent_id")
+        return sp
+
+    for proc, rec in scan(journal_dirs):
+        t = float(rec.get("t", 0.0) or 0.0)
+        t_last_by_proc[proc] = max(t, t_last_by_proc.get(proc, t))
+        rtype = rec.get("type")
+        if rtype == "admit":
+            sp = _span_for(rec.get("trace"), proc, t)
+            if sp is None:
+                continue
+            sp["seq"] = rec.get("seq")
+            sp["rdigest"] = rec.get("rdigest")
+            kind = "optimize" if rec.get("opt") else "sweep"
+            sp["name"] = f"{kind} seq={rec.get('seq')}"
+        elif rtype in ("complete", "fail"):
+            sp = _span_for(rec.get("trace"), proc, t)
+            if sp is None:
+                continue
+            sp["t1"] = max(t, sp["t1"] or t)
+            sp["status"] = ("ok" if rtype == "complete" else
+                            f"fail:{str(rec.get('error', ''))[:60]}")
+            if sp["seq"] is None:
+                sp["seq"] = rec.get("seq")
+            if sp["name"] is None:
+                # replayed/deduped completion whose admit lives in a
+                # journal we were not given — still a span, still
+                # connective, rendered as a point slice
+                sp["name"] = f"replayed seq={rec.get('seq')}"
+        elif rtype == "ckpt":
+            sp = _span_for(rec.get("trace"), proc, t)
+            if sp is None:
+                continue
+            instants.append({"name": f"ckpt step={rec.get('step')}",
+                             "proc": proc, "t": t,
+                             "span_id": sp["span_id"],
+                             "args": {"step": rec.get("step"),
+                                      "cdigest": rec.get("cdigest")}})
+        elif rtype == "batch":
+            traces = rec.get("traces") or []
+            seqs = rec.get("seqs") or []
+            members = [c.get("span_id") for c in traces
+                       if isinstance(c, dict)
+                       and c.get("trace_id") == trace_id
+                       and c.get("span_id")]
+            if members:
+                batches.append({"batch_id": rec.get("batch_id"),
+                                "proc": proc, "t": t,
+                                "mode": rec.get("mode"),
+                                "seqs": seqs, "members": members})
+
+    # open spans (journal ends mid-flight — the kill signature) render
+    # to the last timestamp their process wrote
+    open_spans = 0
+    for sp in spans.values():
+        if sp["t1"] is None:
+            open_spans += 1
+            sp["t1"] = t_last_by_proc.get(sp["proc"], sp["t0"])
+            sp["status"] = sp["status"] or "open"
+
+    # flight-recorder instants (watchdog/warm-start/ckpt/shed exemplars
+    # carry trace_id; batch-scoped events carry a trace_ids list)
+    events_matched = 0
+    for path in events_paths or ():
+        eproc = ("events", 0)
+        for e in journalio.read(path):
+            if e.get("type") == "begin":
+                eproc = (str(e.get("run_id", "events")),
+                         int(e.get("pid", 0) or 0))
+                continue
+            tids = e.get("trace_ids")
+            if isinstance(tids, str):
+                tids = tids.split(",")
+            hit = (e.get("trace_id") == trace_id
+                   or (isinstance(tids, (list, tuple))
+                       and trace_id in tids))
+            if not hit:
+                continue
+            events_matched += 1
+            args = {k: v for k, v in e.items()
+                    if k not in ("seq", "t", "type")}
+            instants.append({"name": str(e.get("type")), "proc": eproc,
+                             "t": float(e.get("t", 0.0) or 0.0),
+                             "span_id": None, "args": args})
+
+    procs = sorted({sp["proc"] for sp in spans.values()})
+    roots = [sid for sid, sp in spans.items()
+             if not sp["parent_id"] or sp["parent_id"] not in spans]
+    # the EARLIEST span is entitled to an out-of-WAL parent (the
+    # router's / caller's span is never journaled); every other
+    # unresolved root is a break in the propagation chain
+    earliest = (min(spans.values(),
+                    key=lambda s: (s["t0"], s["span_id"]))["span_id"]
+                if spans else None)
+    orphans = [sid for sid in roots if sid != earliest]
+    resume_links = sum(
+        1 for sp in spans.values()
+        if sp["parent_id"] in spans
+        and spans[sp["parent_id"]]["proc"] != sp["proc"])
+    return {
+        "trace_id": trace_id,
+        "spans": spans,
+        "batches": batches,
+        "instants": instants,
+        "procs": procs,
+        "process_tracks": len(procs),
+        "roots": sorted(roots),
+        "orphan_spans": len(orphans),
+        "resume_links": resume_links,
+        "open_spans": open_spans,
+        "events_matched": events_matched,
+    }
+
+
+def summary_facts(assembled: dict) -> dict:
+    """The trend-store facts of one assembled trace — what the
+    zero-tolerance ``trace_orphan_spans`` SLO rule evaluates."""
+    return {
+        "trace_spans": len(assembled["spans"]),
+        "trace_process_tracks": assembled["process_tracks"],
+        "trace_orphan_spans": assembled["orphan_spans"],
+        "trace_resume_links": assembled["resume_links"],
+        "trace_open_spans": assembled["open_spans"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Chrome Trace Event Format export
+# ---------------------------------------------------------------------------
+
+def chrome_trace(assembled: dict) -> dict:
+    """Render one assembled trace as a Chrome Trace Event Format
+    object (load in Perfetto / ``chrome://tracing``): one process per
+    ``(run_id, pid)`` service lifetime, one ``X`` slice per span,
+    ``i`` instants for checkpoints and matched flight-recorder events,
+    and ``s``/``f`` flow arrows for parent links (the resume arrow
+    crosses process tracks) and batch membership."""
+    spans = assembled["spans"]
+    ts_all = ([sp["t0"] for sp in spans.values()]
+              + [i["t"] for i in assembled["instants"]]
+              + [b["t"] for b in assembled["batches"]])
+    t_min = min(ts_all) if ts_all else 0.0
+
+    def us(t: float) -> float:
+        return round((float(t) - t_min) * 1e6, 3)
+
+    procs = list(assembled["procs"])
+    for extra in ({i["proc"] for i in assembled["instants"]}
+                  | {b["proc"] for b in assembled["batches"]}):
+        if extra not in procs:
+            procs.append(extra)
+    pid_of = {proc: i + 1 for i, proc in enumerate(procs)}
+
+    ev = []
+    for proc in procs:
+        run_id, ospid = proc
+        ev.append({"ph": "M", "name": "process_name", "pid": pid_of[proc],
+                   "args": {"name": f"{run_id} (pid {ospid})"}})
+    for sp in sorted(spans.values(), key=lambda s: s["t0"]):
+        pid = pid_of[sp["proc"]]
+        tid = int(sp["seq"] if sp["seq"] is not None else 0)
+        dur = max(1.0, us(sp["t1"]) - us(sp["t0"]))
+        ev.append({"ph": "X", "name": sp["name"] or sp["span_id"],
+                   "cat": "request", "pid": pid, "tid": tid,
+                   "ts": us(sp["t0"]), "dur": dur,
+                   "args": {"span_id": sp["span_id"],
+                            "parent_id": sp["parent_id"],
+                            "rdigest": sp["rdigest"],
+                            "status": sp["status"]}})
+        parent = spans.get(sp["parent_id"] or "")
+        if parent is not None:
+            # flow arrow parent -> child; the "s" anchor must sit
+            # inside the source slice, the "f" (bp=e) inside the
+            # destination
+            fid = f"link:{sp['span_id']}"
+            ppid = pid_of[parent["proc"]]
+            ev.append({"ph": "s", "name": "handoff", "cat": "link",
+                       "id": fid, "pid": ppid,
+                       "tid": int(parent["seq"] or 0),
+                       "ts": us(min(parent["t1"], sp["t0"]))})
+            ev.append({"ph": "f", "bp": "e", "name": "handoff",
+                       "cat": "link", "id": fid, "pid": pid, "tid": tid,
+                       "ts": us(sp["t0"]) + 1.0})
+    for b in assembled["batches"]:
+        pid = pid_of[b["proc"]]
+        ev.append({"ph": "i", "name": f"batch {b['batch_id']}",
+                   "cat": "batch", "s": "p", "pid": pid, "tid": 0,
+                   "ts": us(b["t"]),
+                   "args": {"batch_id": b["batch_id"],
+                            "mode": b["mode"], "seqs": b["seqs"]}})
+        for sid in b["members"]:
+            sp = spans.get(sid)
+            if sp is None or sp["proc"] != b["proc"]:
+                continue
+            fid = f"batch:{b['batch_id']}:{sid}"
+            ev.append({"ph": "s", "name": "batched", "cat": "batch",
+                       "id": fid, "pid": pid,
+                       "tid": int(sp["seq"] or 0),
+                       "ts": us(max(sp["t0"], min(b["t"], sp["t1"])))})
+            ev.append({"ph": "f", "bp": "e", "name": "batched",
+                       "cat": "batch", "id": fid, "pid": pid, "tid": 0,
+                       "ts": us(b["t"]) + 1.0})
+    for i in assembled["instants"]:
+        pid = pid_of[i["proc"]]
+        sp = spans.get(i["span_id"] or "")
+        ev.append({"ph": "i", "name": i["name"], "cat": "event",
+                   "s": "t", "pid": pid,
+                   "tid": int(sp["seq"] or 0) if sp else 0,
+                   "ts": us(i["t"]), "args": i["args"]})
+    return {"traceEvents": ev, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": assembled["trace_id"],
+                          "process_tracks": assembled["process_tracks"],
+                          "orphan_spans": assembled["orphan_spans"],
+                          "resume_links": assembled["resume_links"]}}

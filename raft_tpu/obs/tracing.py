@@ -209,3 +209,102 @@ def export_chrome_trace(path: str) -> str:
     with open(path, "w") as f:
         json.dump(chrome_trace(), f)
     return path
+
+
+# ---------------------------------------------------------------------------
+# distributed trace context (request identity across processes)
+# ---------------------------------------------------------------------------
+
+#: HTTP header carrying the context across the router -> replica hop
+TRACE_HEADER = "X-Raft-Trace"
+
+_HEX = set("0123456789abcdef")
+
+
+def _is_hex_id(s, n: int) -> bool:
+    return (isinstance(s, str) and len(s) == n and set(s) <= _HEX
+            and set(s) != {"0"})
+
+
+class TraceContext:
+    """W3C-traceparent-style request identity: a 128-bit ``trace_id``
+    shared by every hop of one request's journey, a 64-bit ``span_id``
+    naming the current hop, and the ``parent_id`` of the hop that spawned
+    it.  Immutable by convention; derive hops with :meth:`child`.
+
+    The wire form (``to_header`` / ``parse``) is the W3C ``traceparent``
+    layout ``00-<trace_id>-<span_id>-01``; a bare ``<trace_id>-<span_id>``
+    pair is accepted too.  Anything malformed parses to ``None`` — the
+    caller mints a fresh context instead of propagating garbage.
+
+    Allocation-only on the hot path: minting draws 24 random bytes and
+    builds three strings; nothing is locked, written, or signalled.
+    """
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: str = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+    @classmethod
+    def mint(cls) -> "TraceContext":
+        """A fresh root context (new trace_id, no parent)."""
+        return cls(os.urandom(16).hex(), os.urandom(8).hex())
+
+    def child(self) -> "TraceContext":
+        """The next hop: same trace, fresh span, parented on this one."""
+        return TraceContext(self.trace_id, os.urandom(8).hex(),
+                            parent_id=self.span_id)
+
+    @classmethod
+    def parse(cls, header) -> "TraceContext | None":
+        """Parse a ``TRACE_HEADER`` value; None when malformed."""
+        if not isinstance(header, str):
+            return None
+        parts = header.strip().lower().split("-")
+        if len(parts) == 4 and parts[0] == "00":    # full traceparent
+            parts = parts[1:3]
+        if len(parts) != 2:
+            return None
+        tid, sid = parts
+        if not (_is_hex_id(tid, 32) and _is_hex_id(sid, 16)):
+            return None
+        return cls(tid, sid)
+
+    @classmethod
+    def from_header(cls, header) -> "TraceContext":
+        """Parse, or mint a fresh root on a missing/malformed header."""
+        return cls.parse(header) or cls.mint()
+
+    def to_header(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def as_dict(self) -> dict:
+        d = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id:
+            d["parent_id"] = self.parent_id
+        return d
+
+    @classmethod
+    def from_dict(cls, d) -> "TraceContext | None":
+        """Rehydrate from a WAL/provenance dict; None when not a valid
+        serialized context (tolerates foreign keys riding along)."""
+        if not isinstance(d, dict):
+            return None
+        tid, sid = d.get("trace_id"), d.get("span_id")
+        if not (_is_hex_id(tid, 32) and _is_hex_id(sid, 16)):
+            return None
+        pid = d.get("parent_id")
+        return cls(tid, sid, parent_id=pid if _is_hex_id(pid, 16) else None)
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id!r}, {self.span_id!r}, "
+                f"parent_id={self.parent_id!r})")
+
+    def __eq__(self, other):
+        return (isinstance(other, TraceContext)
+                and self.trace_id == other.trace_id
+                and self.span_id == other.span_id
+                and self.parent_id == other.parent_id)

@@ -209,6 +209,23 @@ def facts_from_manifest(doc: dict) -> dict:
                   "disk_resultstore_bytes", "disk_checkpoint_bytes"):
             if _num(serve.get(k)) is not None:
                 facts[k] = serve[k]
+        # per-request phase breakdown (service summary():
+        # phase_<phase>_p50_s / phase_<phase>_p99_s) — the latency
+        # decomposition `obsctl slo`/`trend` follow per phase
+        for k, v in serve.items():
+            if (k.startswith("phase_") and k.endswith("_s")
+                    and _num(v) is not None):
+                facts[f"serve_{k}"] = v
+    # distributed-trace connectivity facts (obs/traceview.py — rows
+    # appended by `obsctl trace --trend-db` and the failover soak):
+    # unprefixed, gated by the zero-tolerance trace_orphan_spans rule
+    trace = extra.get("trace") or {}
+    if isinstance(trace, dict):
+        for k in ("trace_spans", "trace_process_tracks",
+                  "trace_orphan_spans", "trace_resume_links",
+                  "trace_open_spans", "trace_count"):
+            if _num(trace.get(k)) is not None:
+                facts[k] = trace[k]
     # serving-throughput bench facts (bench.py serve): one row per
     # sustained-throughput run, trended by `obsctl trend --db`
     sbench = extra.get("serve_bench") or {}
@@ -540,6 +557,15 @@ DEFAULT_SLO_RULES = [
     # benchmark model means the implicit-diff plumbing regressed.
     {"name": "optimize_grad_nonfinite_ratio",
      "fact": "optimize_grad_nonfinite_ratio", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
+    # -- distributed-tracing gate (obs/traceview.py; fact present only
+    # on rows appended by `obsctl trace --trend-db` / the failover
+    # soak — ordinary runs skip).  Zero-tolerance: an orphan span is a
+    # request whose trace context broke somewhere between the router,
+    # the WAL, and a failover successor — the propagation chain the
+    # whole tracing design guarantees by construction.
+    {"name": "trace_orphan_spans",
+     "fact": "trace_orphan_spans", "agg": "max", "op": "<=",
      "threshold": 0.0, "window": 20},
 ]
 

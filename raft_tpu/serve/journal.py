@@ -275,28 +275,43 @@ class RequestJournal:
 
     def record_admit(self, seq: int, request_id: str, rdigest: str,
                      Hs: float, Tp: float, beta: float,
-                     deadline_s: float, tenant: str, opt: dict = None):
+                     deadline_s: float, tenant: str, opt: dict = None,
+                     trace: dict = None):
         """``opt`` (optimize tenant): the canonical design-optimization
         request spec — bounds + objective + descent knobs.  Carried in
         the admit record so replay can re-run an accepted-but-unfinished
-        optimization exactly as submitted."""
+        optimization exactly as submitted.
+
+        ``trace``: the request's distributed trace context
+        (``{trace_id, span_id, parent_id}``) — journaled so the trace
+        identity survives crash + failover by construction: any
+        successor that replays the WAL inherits it."""
         rec = dict(seq=int(seq), id=str(request_id),
                    rdigest=rdigest, Hs=float(Hs), Tp=float(Tp),
                    beta=float(beta), deadline_s=float(deadline_s),
                    tenant=str(tenant))
         if opt is not None:
             rec["opt"] = dict(opt)
+        if trace is not None:
+            rec["trace"] = dict(trace)
         self._write("admit", **rec)
 
     def record_batch(self, batch_id: int, seqs: list[int], mode: str,
-                     tenant: str):
-        self._write("batch", batch_id=int(batch_id),
-                    seqs=[int(s) for s in seqs], mode=str(mode),
-                    tenant=str(tenant))
+                     tenant: str, traces: list = None):
+        """``traces``: the member requests' trace contexts (parallel to
+        ``seqs``) — the cross-process linkage ``obsctl trace`` draws
+        batch-membership flow arrows from."""
+        rec = dict(batch_id=int(batch_id),
+                   seqs=[int(s) for s in seqs], mode=str(mode),
+                   tenant=str(tenant))
+        if traces is not None:
+            rec["traces"] = [dict(t) if t else None for t in traces]
+        self._write("batch", **rec)
 
     def record_complete(self, seq: int, rdigest: str, digest: str,
                         mode: str, attempts: int, std: list,
-                        iters: int, converged: bool, extra: dict = None):
+                        iters: int, converged: bool, extra: dict = None,
+                        trace: dict = None):
         """``extra`` (optimize tenant): the digest-addressed result
         payload beyond the std row — optimized design + provenance —
         journaled so replay re-delivers it without re-descending.  The
@@ -312,22 +327,30 @@ class RequestJournal:
                    converged=bool(converged))
         if extra is not None:
             rec["extra"] = cap_trace(extra)
+        if trace is not None:
+            rec["trace"] = dict(trace)
         self._write("complete", **rec)
 
     def record_ckpt(self, seq: int, rdigest: str, step: int,
-                    cdigest: str):
+                    cdigest: str, trace: dict = None):
         """A descent segment's checkpoint landed: ties the request
         digest to the segment boundary (``step``) and the checkpoint's
         content digest — the audit trail the preempt-soak verdict (and
         a second replay) agree on.  Non-terminal: a seq carrying only
         admit+ckpt records is still pending."""
-        self._write("ckpt", seq=int(seq), rdigest=rdigest,
-                    step=int(step), cdigest=str(cdigest))
+        rec = dict(seq=int(seq), rdigest=rdigest,
+                   step=int(step), cdigest=str(cdigest))
+        if trace is not None:
+            rec["trace"] = dict(trace)
+        self._write("ckpt", **rec)
 
     def record_fail(self, seq: int, rdigest: str, error: dict,
-                    quarantined: bool):
-        self._write("fail", seq=int(seq), rdigest=rdigest,
-                    error=dict(error or {}), quarantined=bool(quarantined))
+                    quarantined: bool, trace: dict = None):
+        rec = dict(seq=int(seq), rdigest=rdigest,
+                   error=dict(error or {}), quarantined=bool(quarantined))
+        if trace is not None:
+            rec["trace"] = dict(trace)
+        self._write("fail", **rec)
 
     def record_tenant(self, event: str, tenant: str, mode: str):
         self._write("tenant", event=str(event), tenant=str(tenant),

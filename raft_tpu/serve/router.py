@@ -49,6 +49,7 @@ import urllib.parse
 import urllib.request
 
 from raft_tpu import errors
+from raft_tpu.obs.tracing import TRACE_HEADER, TraceContext
 from raft_tpu.serve import journal as wal
 from raft_tpu.serve.tenancy import DEFAULT_TENANT
 from raft_tpu.utils.profiling import get_logger
@@ -328,13 +329,21 @@ class ReplicaRouter:
                 order.extend(rest[self._rr:] + rest[:self._rr])
             return order
 
-    def submit(self, doc: dict, token: str = None) -> tuple[int, dict,
-                                                            dict]:
+    def submit(self, doc: dict, token: str = None,
+               trace_header: str = None) -> tuple[int, dict, dict]:
         """Admit + route one submission; returns ``(status, body,
         headers)``.  Raises :class:`~raft_tpu.errors.AdmissionRejected`
         (the HTTP layer maps it) when admission or every failover
-        candidate refuses."""
+        candidate refuses.
+
+        ``trace_header`` is the inbound ``X-Raft-Trace`` value: a valid
+        context makes the router's hop a child of the caller's span, a
+        missing/malformed one mints a fresh root — either way the
+        context is forwarded to the chosen replica and echoed back in
+        the response body (``trace``) and header."""
         tenant = str(doc.get("tenant") or DEFAULT_TENANT)
+        inbound = TraceContext.parse(trace_header)
+        ctx = inbound.child() if inbound else TraceContext.mint()
         self.admit(tenant, token)
         import math
         try:
@@ -349,7 +358,8 @@ class ReplicaRouter:
         for b in candidates:
             try:
                 code, body, headers = self._post_json(
-                    b, "/submit", doc, timeout=self.timeout_s)
+                    b, "/submit", doc, timeout=self.timeout_s,
+                    headers={TRACE_HEADER: ctx.to_header()})
             except (urllib.error.URLError, OSError, TimeoutError):
                 # the pinned/next replica died mid-request: mark it,
                 # fail over to the next healthy candidate
@@ -359,7 +369,7 @@ class ReplicaRouter:
                 self._count("proxy_errors")
                 self._count("failovers")
                 self._emit("router_failover", backend=b.url,
-                           tenant=tenant)
+                           tenant=tenant, trace_id=ctx.trace_id)
                 _LOG.warning("router: backend %s failed a submit — "
                              "failing over", b.url)
                 continue
@@ -376,8 +386,10 @@ class ReplicaRouter:
                     while len(self._requests) > self._track_max:
                         self._requests.popitem(last=False)
             self._count("routed")
-            body = {**body, "replica": b.url}
-            return code, body, headers
+            body = {**body, "replica": b.url,
+                    "trace": ctx.as_dict()}
+            return code, body, {**headers,
+                                TRACE_HEADER: ctx.to_header()}
         self._count("no_healthy_replica")
         raise errors.AdmissionRejected(
             "router admission rejected (no_healthy_replica)",
@@ -521,11 +533,13 @@ class ReplicaRouter:
 
     @staticmethod
     def _post_json(b: _Backend, path: str, doc: dict,
-                   timeout: float) -> tuple[int, dict, dict]:
+                   timeout: float,
+                   headers: dict = None) -> tuple[int, dict, dict]:
         data = json.dumps(doc, default=str).encode()
         req = urllib.request.Request(
             b.url + path, data=data, method="POST",
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return (resp.status,
@@ -542,7 +556,8 @@ def make_server(router: ReplicaRouter, host: str = "127.0.0.1",
     """The router's stdlib HTTP server (returns it unstarted; callers
     run ``serve_forever``).  Endpoints: ``POST /submit`` (auth +
     quota + route), ``GET /result?id=|digest=|rdigest=``, ``GET
-    /stats``, ``GET /healthz``."""
+    /stats``, ``GET /healthz``, ``GET /metrics`` (Prometheus text
+    exposition of the router process's registry)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
@@ -569,6 +584,15 @@ def make_server(router: ReplicaRouter, host: str = "127.0.0.1",
                             **router.stats()})
             elif url.path == "/stats":
                 self._send(200, router.stats())
+            elif url.path == "/metrics":
+                from raft_tpu.obs import metrics as M
+                data = M.exposition().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             elif url.path == "/result":
                 code, body = router.result(
                     rid=q.get("id", [None])[0],
@@ -596,7 +620,8 @@ def make_server(router: ReplicaRouter, host: str = "127.0.0.1",
                 return
             try:
                 code, body, headers = router.submit(
-                    doc, token=self.headers.get(AUTH_HEADER))
+                    doc, token=self.headers.get(AUTH_HEADER),
+                    trace_header=self.headers.get(TRACE_HEADER))
             except errors.AdmissionRejected as e:
                 reason = e.ctx.get("reason")
                 code = REASON_HTTP.get(reason, 429)
@@ -607,7 +632,8 @@ def make_server(router: ReplicaRouter, host: str = "127.0.0.1",
                 self._send(code, e.context(), headers=hdrs)
                 return
             fwd = {k: v for k, v in headers.items()
-                   if k.lower() == "retry-after"}
+                   if k.lower() in ("retry-after",
+                                    TRACE_HEADER.lower())}
             self._send(code, body, headers=fwd)
 
     return ThreadingHTTPServer((host, port), Handler)

@@ -74,6 +74,7 @@ import uuid
 import numpy as np
 
 from raft_tpu import errors
+from raft_tpu.obs.tracing import TraceContext
 from raft_tpu.serve import journal as wal
 from raft_tpu.serve.config import MODES, ServeConfig
 from raft_tpu.serve.retry import RetryPolicy
@@ -82,6 +83,33 @@ from raft_tpu.serve.watchdog import Watchdog
 from raft_tpu.utils.profiling import get_logger
 
 _LOG = get_logger("serve")
+
+#: the fixed phase vocabulary of the per-request latency breakdown
+#: (raft_tpu_serve_request_phase_seconds{phase=...}); compile is split
+#: by executable-cache outcome
+PHASES = ("admission", "queue_wait", "batch_fill", "compile_cold",
+          "compile_warm", "solve", "store_write", "delivery")
+
+#: phase-latency buckets: sub-millisecond admission/delivery up through
+#: minutes-long descents
+PHASE_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5,
+                 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def _coerce_trace(trace) -> TraceContext:
+    """The submit edge's trace-context normalizer: an inbound header
+    string or upstream context derives a child hop; anything
+    missing/malformed mints a fresh root.  Allocation-only — no I/O,
+    no locks (the ISSUE 16 hot-path contract)."""
+    if isinstance(trace, TraceContext):
+        return trace
+    if isinstance(trace, str):
+        parsed = TraceContext.parse(trace)
+        return parsed.child() if parsed else TraceContext.mint()
+    if isinstance(trace, dict):
+        parsed = TraceContext.from_dict(trace)
+        return parsed.child() if parsed else TraceContext.mint()
+    return TraceContext.mint()
 
 
 @dataclasses.dataclass
@@ -116,11 +144,16 @@ class SweepResult:
 
 
 class Ticket:
-    """Async handle of one admitted request."""
+    """Async handle of one admitted request.  ``trace`` is the
+    request's distributed trace context (when known at admission) —
+    the HTTP layer echoes it so async callers can correlate a 202
+    with the eventual result."""
 
-    def __init__(self, request_id: str, seq: int):
+    def __init__(self, request_id: str, seq: int,
+                 trace: "TraceContext" = None):
         self.id = request_id
         self.seq = seq
+        self.trace = trace
         self._event = threading.Event()
         self._result: SweepResult | None = None
 
@@ -144,11 +177,12 @@ class _Request:
     __slots__ = ("seq", "id", "Hs", "Tp", "beta", "deadline_ts",
                  "submitted_ts", "attempts", "total_attempts", "strikes",
                  "solo", "not_before", "ticket", "tenant", "rdigest",
-                 "replayed", "followers", "opt")
+                 "replayed", "followers", "opt", "trace", "t_admitted",
+                 "t_gathered", "t_solve0", "t_solved")
 
     def __init__(self, seq, Hs, Tp, beta, deadline_ts, now,
                  tenant=DEFAULT_TENANT, request_id=None, rdigest=None,
-                 opt=None):
+                 opt=None, trace=None):
         self.seq = int(seq)
         self.id = request_id or f"req{seq}-{uuid.uuid4().hex[:8]}"
         self.Hs = float(Hs)
@@ -178,7 +212,18 @@ class _Request:
         #: this (primary) request — they never enter the queue, and the
         #: primary's terminal outcome fans out to them
         self.followers: list["_Request"] = []
-        self.ticket = Ticket(self.id, self.seq)
+        #: distributed trace identity (obs.tracing.TraceContext) —
+        #: every request carries one; callers without an inbound
+        #: context get a freshly minted root
+        self.trace: TraceContext = trace or TraceContext.mint()
+        #: lock-free phase timestamps (monotonic), stamped along the
+        #: request's journey and folded into the phase histograms only
+        #: inside the already-locked completion paths
+        self.t_admitted = 0.0
+        self.t_gathered = 0.0
+        self.t_solve0 = 0.0
+        self.t_solved = 0.0
+        self.ticket = Ticket(self.id, self.seq, trace=self.trace)
 
 
 class SweepService:
@@ -325,6 +370,13 @@ class SweepService:
         #: read-tier latencies (ms) for the p50/p99 summary facts
         self._read_ms: collections.deque[float] = collections.deque(
             maxlen=10_000)
+        #: per-phase latency samples (s) behind the phase_p50/p99
+        #: trend facts; bounded like _latencies
+        self._phase_s: dict[str, collections.deque] = {
+            p: collections.deque(maxlen=10_000) for p in PHASES}
+        #: did the latest _ensure_runner acquisition build (cold) or
+        #: reuse (warm)?  Read only by the batch worker that just called
+        self._runner_was_cold = False
         #: observed cold-start iteration baseline (EMA over unseeded
         #: lanes) — what non-audited warm batches report savings against
         self._cold_iters_ema: float | None = None
@@ -362,6 +414,22 @@ class SweepService:
 
     def _emit(self, type_: str, **fields):
         self._obs().events.emit(type_, **fields)
+
+    def _observe_phase(self, phase: str, seconds: float):
+        """Fold one phase-latency sample into the labeled histogram and
+        the bounded summary deque.  Called only from completion paths
+        (never the submit edge); negative/unset stamps are dropped."""
+        if seconds is None or not (seconds >= 0.0):
+            return
+        self._obs().histogram(
+            "raft_tpu_serve_request_phase_seconds",
+            "per-request latency breakdown by phase (admission, queue "
+            "wait, batch fill, compile cold/warm, solve, store write, "
+            "delivery)", buckets=PHASE_BUCKETS).observe(
+                float(seconds), phase=phase)
+        dq = self._phase_s.get(phase)
+        if dq is not None:
+            dq.append(float(seconds))
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -467,7 +535,8 @@ class SweepService:
                    "seq": r.seq, "id": r.id, "rdigest": r.rdigest,
                    "Hs": r.Hs, "Tp": r.Tp, "beta": r.beta,
                    "deadline_s": max(0.0, r.deadline_ts - now),
-                   "tenant": r.tenant, "checkpoint": True}
+                   "tenant": r.tenant, "checkpoint": True,
+                   "trace": r.trace.as_dict()}
             if r.opt is not None:
                 rec["opt"] = dict(r.opt)
             out.append(rec)
@@ -615,7 +684,8 @@ class SweepService:
                     self._journal.record_complete(
                         seq, dup.get("rdigest"), prior.get("digest"),
                         res.mode, 0, res.std or [], res.iters or 0,
-                        bool(res.converged), extra=res.extra)
+                        bool(res.converged), extra=res.extra,
+                        trace=dup.get("trace"))
                 t = Ticket(res.request_id, seq)
                 t._finish(res)
                 tickets[int(orig)] = t
@@ -643,19 +713,28 @@ class SweepService:
                         self._journal.record_complete(
                             seq, rec.get("rdigest"), res.digest,
                             res.mode, 0, res.std or [], res.iters or 0,
-                            bool(res.converged), extra=res.extra)
+                            bool(res.converged), extra=res.extra,
+                            trace=rec.get("trace"))
                     t = Ticket(res.request_id, seq)
                     t._finish(res)
                     tickets[orig] = t
                     deduped += 1
                     continue
+                # resume linkage: the replayed request keeps the dead
+                # process's trace_id and parents its fresh span on the
+                # journaled one — the successor's spans LINK to the
+                # original trace instead of starting a new one (legacy
+                # trace-less WALs mint a fresh root)
+                inherited = TraceContext.from_dict(rec.get("trace"))
                 req = _Request(seq, rec.get("Hs", 0.0),
                                rec.get("Tp", 1.0), rec.get("beta", 0.0),
                                now + deadline_s,
                                now, tenant=tenant,
                                request_id=rec.get("id"),
                                rdigest=rec.get("rdigest"),
-                               opt=rec.get("opt"))
+                               opt=rec.get("opt"),
+                               trace=(inherited.child()
+                                      if inherited else None))
                 req.replayed = True
                 tickets[orig] = req.ticket
                 # a foreign fold (a dead peer's mirror) replays admits
@@ -667,7 +746,8 @@ class SweepService:
                                                   or seq != orig):
                     self._journal.record_admit(
                         seq, req.id, req.rdigest, req.Hs, req.Tp,
-                        req.beta, deadline_s, tenant, opt=req.opt)
+                        req.beta, deadline_s, tenant, opt=req.opt,
+                        trace=req.trace.as_dict())
                 if tenant not in self._tenants.names():
                     # the successor was configured without this tenant:
                     # a typed failure, never a silent drop
@@ -839,7 +919,7 @@ class SweepService:
 
     def submit(self, Hs: float, Tp: float, heading_rad: float,
                deadline_s: float = None,
-               tenant: str = DEFAULT_TENANT) -> Ticket:
+               tenant: str = DEFAULT_TENANT, trace=None) -> Ticket:
         """Admit one case request; returns its :class:`Ticket`.
 
         Raises :class:`~raft_tpu.errors.AdmissionRejected` (with a
@@ -860,9 +940,17 @@ class SweepService:
         request already in flight attaches to that single solve as a
         *follower* instead of occupying a queue slot (a storm of N
         duplicates over D distinct digests performs exactly D
-        solves)."""
+        solves).
+
+        ``trace``: the caller's distributed trace context — an
+        ``X-Raft-Trace`` header string, a :class:`TraceContext`, or a
+        serialized context dict; anything missing/malformed mints a
+        fresh root.  The context rides the request through the WAL,
+        batch membership, and the delivered result's
+        ``provenance["trace"]``."""
         obs = self._obs()
         tenant = self._tenants.require(tenant)
+        ctx = _coerce_trace(trace)
         now = time.monotonic()
         deadline_s = float(deadline_s if deadline_s is not None
                            else self.cfg.deadline_s)
@@ -870,7 +958,12 @@ class SweepService:
             rdigest = wal.request_digest(Hs, Tp, heading_rad, tenant)
             hit = self._lookup_cached(rdigest)
             if hit is not None:
-                t = Ticket(hit.request_id, hit.seq)
+                hit = dataclasses.replace(hit, extra={
+                    **(hit.extra or {}),
+                    "provenance": {
+                        **((hit.extra or {}).get("provenance") or {}),
+                        "trace": ctx.as_dict()}})
+                t = Ticket(hit.request_id, hit.seq, trace=ctx)
                 t._finish(hit)
                 return t
         follower = None
@@ -891,7 +984,8 @@ class SweepService:
                     self._seq += 1
                     follower = _Request(seq, Hs, Tp, heading_rad,
                                         now + deadline_s, now,
-                                        tenant=tenant, rdigest=rdigest)
+                                        tenant=tenant, rdigest=rdigest,
+                                        trace=ctx)
                     # track BEFORE the attach is visible: the primary's
                     # fan-out may deliver (and untrack) the follower
                     # the instant it appears in prim.followers — a
@@ -920,7 +1014,7 @@ class SweepService:
                                now + deadline_s, now, tenant=tenant,
                                rdigest=(rdigest
                                         if self._store is not None
-                                        else None))
+                                        else None), trace=ctx)
                 self._queue.append(req)
                 if self._store is not None:
                     self._flight[req.rdigest] = req
@@ -936,7 +1030,8 @@ class SweepService:
                 self._journal.record_admit(
                     follower.seq, follower.id, follower.rdigest,
                     follower.Hs, follower.Tp, follower.beta, deadline_s,
-                    tenant)
+                    tenant, trace=follower.trace.as_dict())
+            follower.t_admitted = time.monotonic()
             self._tenants.count(tenant, "admitted")
             obs.counter("raft_tpu_serve_coalesced_total",
                         "duplicate submissions single-flighted onto an "
@@ -972,7 +1067,8 @@ class SweepService:
         if self._journal is not None:
             self._journal.record_admit(
                 req.seq, req.id, req.rdigest, req.Hs, req.Tp, req.beta,
-                deadline_s, tenant)
+                deadline_s, tenant, trace=req.trace.as_dict())
+        req.t_admitted = time.monotonic()
         self._tenants.count(tenant, "admitted")
         obs.counter("raft_tpu_serve_requests_total",
                     "request admissions/outcomes of the sweep service"
@@ -984,7 +1080,8 @@ class SweepService:
     # ------------------------------------------------------------------
 
     def submit_optimize(self, spec: dict, deadline_s: float = None,
-                        tenant: str = DEFAULT_TENANT) -> Ticket:
+                        tenant: str = DEFAULT_TENANT,
+                        trace=None) -> Ticket:
         """Admit one design-optimization request; returns its
         :class:`Ticket` whose :class:`SweepResult` carries the
         digest-addressed optimized design with full provenance
@@ -1009,6 +1106,7 @@ class SweepService:
 
         obs = self._obs()
         tenant = self._tenants.require(tenant)
+        ctx = _coerce_trace(trace)
         spec = optmod.normalize_request(
             spec, lanes_max=self.cfg.optimize_lanes_max,
             steps_max=self.cfg.optimize_steps_max)
@@ -1041,7 +1139,12 @@ class SweepService:
                     dedup = dataclasses.replace(
                         prior, request_id=f"opt{seq}-{uuid.uuid4().hex[:8]}",
                         seq=seq, attempts=0, latency_s=0.0,
-                        source="deduped")
+                        source="deduped", extra={
+                            **(prior.extra or {}),
+                            "provenance": {
+                                **((prior.extra or {}).get("provenance")
+                                   or {}),
+                                "trace": ctx.as_dict()}})
                 else:
                     prim = self._flight.get(rdigest)
                     if prim is not None and not prim.ticket.done():
@@ -1050,7 +1153,8 @@ class SweepService:
                         follower = _Request(seq, 0.0, 1.0, 0.0,
                                             now + deadline_s, now,
                                             tenant=tenant,
-                                            rdigest=rdigest, opt=spec)
+                                            rdigest=rdigest, opt=spec,
+                                            trace=ctx)
                         self._track_open(follower)
                         prim.followers.append(follower)
                         self._counts["admitted"] += 1
@@ -1069,7 +1173,7 @@ class SweepService:
                 self._seq += 1
                 req = _Request(seq, 0.0, 1.0, 0.0, now + deadline_s,
                                now, tenant=tenant, rdigest=rdigest,
-                               opt=spec)
+                               opt=spec, trace=ctx)
                 # track BEFORE the request becomes poppable: an
                 # already-running opt worker may terminate it the
                 # instant it appears on the queue, and untrack-then-
@@ -1102,7 +1206,7 @@ class SweepService:
             # the caller holds the payload synchronously — like a
             # result-store hit, nothing a crash could lose, so the
             # dedupe is deliberately not journaled
-            t = Ticket(dedup.request_id, dedup.seq)
+            t = Ticket(dedup.request_id, dedup.seq, trace=ctx)
             t._finish(dedup)
             return t
         r = follower if follower is not None else req
@@ -1111,7 +1215,9 @@ class SweepService:
         if self._journal is not None:
             self._journal.record_admit(r.seq, r.id, r.rdigest, r.Hs,
                                        r.Tp, r.beta, deadline_s, tenant,
-                                       opt=spec)
+                                       opt=spec,
+                                       trace=r.trace.as_dict())
+        r.t_admitted = time.monotonic()
         if follower is not None:
             self._emit("coalesced", req=r.seq, rdigest=r.rdigest,
                        optimize=True)
@@ -1142,6 +1248,7 @@ class SweepService:
                 if not self._opt_queue:
                     return                       # stopped and drained
                 r = self._opt_queue.popleft()
+                r.t_gathered = time.monotonic()
                 self._opt_busy = True
             try:
                 self._run_optimize(r)
@@ -1206,29 +1313,37 @@ class SweepService:
 
                     def _on_ckpt(step, cdigest, _r=r):
                         journal.record_ckpt(_r.seq, _r.rdigest, step,
-                                            cdigest)
+                                            cdigest,
+                                            trace=_r.trace.as_dict())
                     ckpt_kw["on_checkpoint"] = _on_ckpt
+        r.t_solve0 = time.monotonic()
         with self._obs().span("serve_optimize", req=r.seq,
-                              nlanes=spec["nlanes"]):
+                              nlanes=spec["nlanes"],
+                              trace_id=r.trace.trace_id,
+                              span_id=r.trace.span_id,
+                              parent_id=r.trace.parent_id):
             out = optmod.optimize_designs(
                 fowt, space, objective=spec["objective"],
                 nlanes=spec["nlanes"], steps=spec["steps"],
                 method=spec["method"], lr=spec["lr"],
                 gtol=spec["gtol"], seed=spec["seed"],
                 nIter=spec["nIter"], tol=spec["tol"], **ckpt_kw)
+        r.t_solved = time.monotonic()
         best = int(out["lane_best"])
         prov = dict(out["provenance"])
         if prov.get("ckpt_shed"):
             self._shed("checkpoint", errors.StorageExhausted(
                 "checkpoint tier shed mid-descent",
-                component="checkpoint", req=r.seq))
+                component="checkpoint", req=r.seq),
+                trace_id=r.trace.trace_id)
         resumed = int(prov.get("resumed_from_step") or 0)
         if resumed:
             with self._lock:
                 self._counts["ckpt_resumed"] += 1
                 self._last_resumed_step = resumed
             self._emit("ckpt_resumed", req=r.seq, step=resumed,
-                       steps=spec["steps"])
+                       steps=spec["steps"],
+                       trace_id=r.trace.trace_id)
             _LOG.info("serve: optimize req %d resumed from checkpoint "
                       "step %d/%d", r.seq, resumed, spec["steps"])
         wall = float(prov.get("wall_s") or 0.0)
@@ -1259,6 +1374,9 @@ class SweepService:
             payload["design"], payload["f_best"],
             payload["provenance"]["iterations"])
         prov = payload["provenance"]
+        # after the digest: the trace block must not perturb the
+        # resumed-vs-clean digest equality the preempt soak asserts
+        prov["trace"] = r.trace.as_dict()
         res = SweepResult(
             ok=True, digest=digest, std=[float(payload["f_best"])],
             iters=int(prov["iterations"]),
@@ -1269,7 +1387,7 @@ class SweepService:
             self._journal.record_complete(
                 r.seq, r.rdigest, digest, "optimize",
                 r.total_attempts, res.std, res.iters, res.converged,
-                extra=payload)
+                extra=payload, trace=r.trace.as_dict())
         with self._lock:
             self._counts["completed"] += 1
             self._latencies.append(res.latency_s)
@@ -1292,8 +1410,19 @@ class SweepService:
         self._emit("request_done", req=r.seq, digest=digest,
                    latency_s=res.latency_s, mode="optimize",
                    attempts=r.total_attempts,
-                   f_best=payload["f_best"])
+                   f_best=payload["f_best"],
+                   trace_id=r.trace.trace_id)
         r.ticket._finish(res)
+        if r.t_admitted:
+            self._observe_phase("admission",
+                                r.t_admitted - r.submitted_ts)
+            if r.t_gathered:
+                self._observe_phase("queue_wait",
+                                    r.t_gathered - r.t_admitted)
+        if r.t_solve0 and r.t_solved:
+            self._observe_phase("solve", r.t_solved - r.t_solve0)
+            self._observe_phase("delivery",
+                                time.monotonic() - r.t_solved)
         self._fanout_complete(r, res)
 
     # ------------------------------------------------------------------
@@ -1315,7 +1444,8 @@ class SweepService:
                   component)
         return False
 
-    def _shed(self, component: str, e: BaseException):
+    def _shed(self, component: str, e: BaseException,
+              trace_id: str = None):
         """Fold one typed :class:`~raft_tpu.errors.StorageExhausted`
         into the storage ladder: shed ``component`` for the configured
         hold (checkpointing sheds first, then the result-store
@@ -1331,8 +1461,11 @@ class SweepService:
             "persistence rungs shed on proven resource exhaustion "
             "(ENOSPC / disk budget), by component").inc(
                 1.0, component=component)
-        self._emit("storage_degraded", component=component,
-                   hold_s=hold, error=str(e)[:200])
+        fields = {"component": component, "hold_s": hold,
+                  "error": str(e)[:200]}
+        if trace_id:
+            fields["trace_id"] = trace_id
+        self._emit("storage_degraded", **fields)
         _LOG.warning("serve: storage exhausted at %s — shedding for "
                      "%.1fs (%s)", component, hold, e)
 
@@ -1390,6 +1523,7 @@ class SweepService:
                 now = time.monotonic()
                 first = self._pop_ready_locked(now)
                 if first is not None:
+                    first.t_gathered = now
                     self._ngathered += 1
                     break
                 if self._state == "draining" and not self._queue \
@@ -1418,6 +1552,7 @@ class SweepService:
                     r = self._pop_ready_locked(now, solo_ok=False,
                                                tenant=first.tenant)
                     if r is not None:
+                        r.t_gathered = now
                         self._ngathered += 1
                     elif now >= window_end:
                         break
@@ -1437,8 +1572,10 @@ class SweepService:
 
     def _ensure_runner(self, mode: str, tenant: str = DEFAULT_TENANT):
         rmode = self._tenants.resolve_mode(tenant, mode)
+        built = [False]
 
         def build(fowt, tenant_kw):
+            built[0] = True
             kw = {**self.cfg.solver_kw(), **tenant_kw}
             if self._runner_factory is not None:
                 return self._runner_factory(rmode, fowt,
@@ -1453,7 +1590,12 @@ class SweepService:
                                      warm_start=self.cfg.warm_start,
                                      **kw)
 
-        return self._tenants.runner(tenant, rmode, build)
+        runner = self._tenants.runner(tenant, rmode, build)
+        # phase-breakdown exemplar: did THIS acquisition pay a build
+        # (trace/compile or exec-cache deserialize) or reuse the live
+        # program?  Only the batch worker that just called reads it.
+        self._runner_was_cold = built[0]
+        return runner
 
     def _solve_mode_locked(self) -> str:
         mode = self.ladder[self._mode_idx]
@@ -1482,10 +1624,24 @@ class SweepService:
         if self._journal is not None:
             self._journal.record_batch(batch_id,
                                        [r.seq for r in batch],
-                                       solve_mode, tenant)
+                                       solve_mode, tenant,
+                                       traces=[r.trace.as_dict()
+                                               for r in batch])
+        # phase breakdown: queue wait (admit -> gathered) and batch
+        # fill (gathered -> dispatch) per member, from the lock-free
+        # monotonic stamps submit/_gather left on the request
+        for r in batch:
+            adm = r.t_admitted or r.submitted_ts
+            if r.t_gathered:
+                self._observe_phase("queue_wait", r.t_gathered - adm)
+                self._observe_phase("batch_fill", t0 - r.t_gathered)
         wid = None
         try:
+            t_build = time.monotonic()
             runner = self._ensure_runner(solve_mode, tenant)
+            self._observe_phase(
+                "compile_cold" if self._runner_was_cold
+                else "compile_warm", time.monotonic() - t_build)
             # the watchdog deadline covers the SOLVE: a cold runner
             # build (trace/compile or exec-cache deserialize) above may
             # legitimately take longer than batch_deadline_s and must
@@ -1531,11 +1687,19 @@ class SweepService:
                 beta = np.concatenate([beta, np.repeat(beta[-1:], pad)])
             # the watchdog stays armed through the whole solve phase —
             # warm attempt, guard fallback, and audit reference alike
+            t_solve0 = time.monotonic()
+            for r in batch:
+                r.t_solve0 = t_solve0
             with obs.span("serve_batch", n=n, mode=solve_mode,
-                          batch_id=batch_id):
+                          batch_id=batch_id,
+                          trace_ids=",".join(r.trace.trace_id
+                                             for r in batch)):
                 std, iters, conv, xi = self._solve_lanes(
                     runner, batch, batch_id, Hs, Tp, beta, n, ncases,
                     solve_mode)
+            t_solved = time.monotonic()
+            for r in batch:
+                r.t_solved = t_solved
             owned = self._watchdog.disarm(wid)
             wid = None
             if not owned:
@@ -1667,7 +1831,7 @@ class SweepService:
         return (seeds if lanes else None), lanes
 
     def _warm_event(self, outcome: str, lane: int, neighbor: str,
-                    detail: str):
+                    detail: str, trace_id: str = None):
         """Count + record one divergence-guard rejection (or audit
         mismatch) as the typed :class:`~raft_tpu.errors.WarmStartRejected`
         signal — the fallback result is delivered regardless."""
@@ -1679,7 +1843,10 @@ class SweepService:
         obs.counter("raft_tpu_serve_warm_starts_total",
                     "warm-start seeding outcomes of the serving loop"
                     ).inc(1.0, outcome=outcome)
-        self._emit("warm_start_rejected", **e.context())
+        ctx = e.context()
+        if trace_id:
+            ctx["trace_id"] = trace_id   # exemplar: alert -> full trace
+        self._emit("warm_start_rejected", **ctx)
         _LOG.warning("serve: %s", e)
 
     def _solve_lanes(self, runner, batch, batch_id: int, Hs, Tp, beta,
@@ -1747,7 +1914,8 @@ class SweepService:
                 self._counts["warm_rejected"] += 1
             self._warm_event(
                 "rejected", i, seed_lanes[i],
-                "seeded lane non-converged/non-finite; cold fallback")
+                "seeded lane non-converged/non-finite; cold fallback",
+                trace_id=(batch[i].trace.trace_id if i < n else None))
         if audit:
             tol = float(cfg.tol)
             for i, rd in sorted(seed_lanes.items()):
@@ -1763,7 +1931,8 @@ class SweepService:
                     self._warm_event(
                         "mismatch", i, rd,
                         f"audit deviation {float(np.max(rel)):.3e} > "
-                        f"{tol:g}")
+                        f"{tol:g}",
+                        trace_id=batch[i].trace.trace_id)
                 else:
                     with self._lock:
                         self._warm_iter_savings += max(
@@ -1800,7 +1969,8 @@ class SweepService:
                     "requests whose batch overran the watchdog deadline"
                     ).inc(float(len(reqs)))
         self._emit("watchdog_abandon", batch_id=batch_id,
-                   reqs=[r.seq for r in reqs])
+                   reqs=[r.seq for r in reqs],
+                   trace_ids=[r.trace.trace_id for r in reqs])
         _LOG.warning("serve: watchdog abandoned batch %d (%d requests); "
                      "spawning replacement worker", batch_id, len(reqs))
         # the stuck worker still owns a (possibly wedged) solve — a
@@ -1879,14 +2049,18 @@ class SweepService:
                           std=[float(v) for v in std_row],
                           iters=int(iters), converged=bool(converged),
                           source="replayed" if r.replayed else "solved",
+                          extra={"provenance":
+                                 {"trace": r.trace.as_dict()}},
                           **self._result_base(r, mode))
         # WAL before ack: the result (digest + payload) is durable
         # before the ticket resolves — a crash after this line loses
-        # nothing, a crash before it re-solves deterministically
+        # nothing, a crash before it re-solves deterministically.
+        # The trace ctx rides its own WAL field, not ``extra``.
         if self._journal is not None:
             self._journal.record_complete(
                 r.seq, r.rdigest, digest, mode, r.total_attempts,
-                res.std, res.iters, res.converged)
+                res.std, res.iters, res.converged,
+                trace=r.trace.as_dict())
         # result tier: persist the payload under the request's content
         # address (fsync'd + sidecar'd; a put failure is a counted gap,
         # never a lost delivery — memory and the WAL still have it).
@@ -1897,12 +2071,15 @@ class SweepService:
         # never become the canonical cached answer every future repeat
         # (on every replica, forever) short-circuits to
         if self._store is not None and mode == "full":
+            t_put = time.monotonic()
             self._store_put({"rdigest": r.rdigest, "digest": digest,
                              "std": res.std, "iters": res.iters,
                              "converged": res.converged,
                              "tenant": r.tenant, "Hs": r.Hs, "Tp": r.Tp,
                              "beta": r.beta, "mode": mode, "id": r.id,
                              "seq": r.seq}, xi=xi_row)
+            self._observe_phase("store_write",
+                                time.monotonic() - t_put)
         with self._lock:
             self._counts["completed"] += 1
             if r.total_attempts:
@@ -1926,8 +2103,15 @@ class SweepService:
                                30.0, 60.0, 120.0)).observe(res.latency_s)
         self._emit("request_done", req=r.seq, digest=digest,
                    latency_s=res.latency_s, attempts=r.total_attempts,
-                   mode=mode)
+                   mode=mode, trace_id=r.trace.trace_id)
         r.ticket._finish(res)
+        if r.t_admitted:
+            self._observe_phase("admission",
+                                r.t_admitted - r.submitted_ts)
+        if r.t_solve0 and r.t_solved:
+            self._observe_phase("solve", r.t_solved - r.t_solve0)
+            self._observe_phase("delivery",
+                                time.monotonic() - r.t_solved)
         self._fanout_complete(r, res)
 
     def _fanout_complete(self, r: _Request, res: SweepResult):
@@ -1950,14 +2134,19 @@ class SweepService:
                     "coalesced solve finished past this follower's "
                     "deadline", req=f.seq, coalesced=True))
                 continue
+            fextra = dict(res.extra) if res.extra else {}
+            fextra["provenance"] = {
+                **(fextra.get("provenance") or {}),
+                "trace": f.trace.as_dict()}
             fres = dataclasses.replace(
                 res, request_id=f.id, seq=f.seq,
                 latency_s=now - f.submitted_ts, attempts=0,
-                source="coalesced")
+                source="coalesced", extra=fextra)
             if self._journal is not None:
                 self._journal.record_complete(
                     f.seq, f.rdigest, res.digest, res.mode, 0, res.std,
-                    res.iters, res.converged, extra=res.extra)
+                    res.iters, res.converged, extra=res.extra,
+                    trace=f.trace.as_dict())
             with self._lock:
                 self._counts["completed"] += 1
                 self._latencies.append(fres.latency_s)
@@ -1972,7 +2161,8 @@ class SweepService:
                         "service").inc(1.0, outcome="ok")
             self._emit("request_done", req=f.seq, digest=res.digest,
                        latency_s=fres.latency_s, attempts=0,
-                       mode=res.mode, coalesced=True)
+                       mode=res.mode, coalesced=True,
+                       trace_id=f.trace.trace_id)
             f.ticket._finish(fres)
 
     def _fail(self, r: _Request, e: BaseException,
@@ -1986,7 +2176,8 @@ class SweepService:
         # ``journal=False`` is the handoff path: the request must STAY
         # pending in the WAL so the successor re-solves it
         if journal and self._journal is not None:
-            self._journal.record_fail(r.seq, r.rdigest, ctx, quarantined)
+            self._journal.record_fail(r.seq, r.rdigest, ctx, quarantined,
+                                      trace=r.trace.as_dict())
         with self._lock:
             self._counts["failed"] += 1
             if quarantined:
@@ -2003,7 +2194,8 @@ class SweepService:
                     "request admissions/outcomes of the sweep service"
                     ).inc(1.0, outcome=outcome)
         self._emit("quarantine" if quarantined else "request_failed",
-                   **{**ctx, "phase": "serve", "req": r.seq})
+                   **{**ctx, "phase": "serve", "req": r.seq,
+                      "trace_id": r.trace.trace_id})
         r.ticket._finish(res)
         # single-flight: a primary's terminal failure fans out to its
         # followers with the same typed error (the handoff path's
@@ -2210,6 +2402,8 @@ class SweepService:
             read_ms = list(self._read_ms)
             warm_savings = self._warm_iter_savings
             last_resumed = self._last_resumed_step
+            phase_s = {p: list(d) for p, d in self._phase_s.items()
+                       if d}
         runners = {}
         for name, t in tenancy["tenants"].items():
             for live in t.get("live", []):
@@ -2222,6 +2416,11 @@ class SweepService:
             "n_mode_transitions": len(transitions),
             "p50_latency_s": self._percentile(lat, 50),
             "p99_latency_s": self._percentile(lat, 99),
+            # per-phase breakdown facts (phase_<name>_p50_s/_p99_s) —
+            # the trend-store columns `obsctl slo` and the fleet
+            # controller gate on
+            **{f"phase_{p}_p{q}_s": self._percentile(v, q)
+               for p, v in sorted(phase_s.items()) for q in (50, 99)},
             "ema_batch_s": ema,
             "exec_cache": runners,
             "tenancy": tenancy,

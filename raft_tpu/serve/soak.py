@@ -132,6 +132,28 @@ def _run_all(service: SweepService, rows, timeout_s: float,
     return _collect(tickets, timeout_s), rejected
 
 
+def _assemble_traces(root: str) -> dict:
+    """Reassemble every distributed trace journaled under ``root`` (a
+    journal directory or a soak tree) and aggregate the connectivity
+    verdict — the soak-level proof that trace context survived the
+    kill / failover: zero orphan spans, resume links intact."""
+    from raft_tpu.obs import traceview
+
+    dirs = traceview.discover_journal_dirs(root)
+    agg = {"trace_count": 0, "trace_spans": 0, "trace_orphan_spans": 0,
+           "trace_resume_links": 0, "trace_open_spans": 0,
+           "trace_process_tracks": 0}
+    for tid in traceview.trace_ids(dirs):
+        facts = traceview.summary_facts(traceview.assemble(tid, dirs))
+        agg["trace_count"] += 1
+        for k in ("trace_spans", "trace_orphan_spans",
+                  "trace_resume_links", "trace_open_spans"):
+            agg[k] += facts[k]
+        agg["trace_process_tracks"] = max(agg["trace_process_tracks"],
+                                          facts["trace_process_tracks"])
+    return agg
+
+
 def run_soak(fowt, *, coarse_fowt=None, config: ServeConfig = None,
              n_requests: int = 12, faults_spec: str = DEFAULT_FAULTS,
              seed: int = 2026, timeout_s: float = 600.0) -> dict:
@@ -868,6 +890,7 @@ def run_preempt(design: str = "Vertical_cylinder", *,
             Hs[1], Tp[1], beta[1], "default"))
         final = wal.replay(journal_dir)
         lost = len(final["pending"]) + len(final["deduped"])
+        trace_facts = _assemble_traces(journal_dir)
         facts = {
             "checkpoint_every": every,
             "ckpt_resumed_from_step": resumed_from,
@@ -882,6 +905,9 @@ def run_preempt(design: str = "Vertical_cylinder", *,
             "preempt_lost": lost,
         }
         manifest.extra["serve_preempt"] = facts
+        # trend-store row: the zero-tolerance trace_orphan_spans SLO
+        # rule evaluates this section (obs/trendstore.py)
+        manifest.extra["trace"] = trace_facts
         report = {
             **facts,
             "killed": killed,
@@ -900,6 +926,7 @@ def run_preempt(design: str = "Vertical_cylinder", *,
             "store_write_through_self_cleared": clear_doc is not None,
             "replayed_lost_count": summary.get("replayed_lost_count"),
             "summary": summary,
+            "trace": trace_facts,
             "wall_s": time.monotonic() - t0,
             "ok": (killed
                    and resumed_from >= every > 0
@@ -911,7 +938,12 @@ def run_preempt(design: str = "Vertical_cylinder", *,
                    and clear_doc is not None
                    and lost == 0
                    and summary.get("replayed_lost_count") == 0
-                   and summary.get("unhandled", 0) == 0),
+                   and summary.get("unhandled", 0) == 0
+                   # the preempted descent's trace must reassemble
+                   # connected across both service lifetimes, with the
+                   # dead-process→successor resume link present
+                   and trace_facts["trace_orphan_spans"] == 0
+                   and trace_facts["trace_resume_links"] >= 1),
         }
         status = "ok" if report["ok"] else "failed"
     finally:
@@ -1046,6 +1078,7 @@ def run_failover(design: str = "Vertical_cylinder", *,
     # -- verdict: fold the mirror and the successor's own journal -----
     final_mirror = wal.replay(mirror_dir)
     final_succ = wal.replay(cfg.journal_dir)
+    trace_facts = _assemble_traces(base)
     completed = {seq: rec.get("digest")
                  for seq, rec in final_mirror["completed"].items()}
     for seq, rec in final_succ["completed"].items():
@@ -1079,6 +1112,7 @@ def run_failover(design: str = "Vertical_cylinder", *,
                         final_mirror["records"]),
         "handoff": handoff,
         "summary": summary,
+        "trace": trace_facts,
         "wall_s": time.monotonic() - t0,
         "ok": (killed
                and len(mirror_admitted) == n_requests
@@ -1087,15 +1121,25 @@ def run_failover(design: str = "Vertical_cylinder", *,
                and summary.get("failover") == 1
                and summary.get("failover_lost_count") == 0
                and summary.get("replication_lag_records") == 0
-               and not failed),
+               and not failed
+               # every request's distributed trace must reassemble
+               # fully connected across the host boundary: no orphan
+               # spans, and at least one admission→successor resume
+               # link (the failover signature)
+               and trace_facts["trace_orphan_spans"] == 0
+               and trace_facts["trace_count"] == n_requests
+               and trace_facts["trace_resume_links"] >= 1),
     }
     lvl = _LOG.info if report["ok"] else _LOG.error
     lvl("failover soak: %s — child rc=%d, %d/%d admits on the mirror, "
         "%d completed pre-kill, %d recovered / %d replayed / %d "
         "deduped from the MIRROR alone, %d lost, %d digest "
-        "mismatch(es), warm_start=%d, %.1fs",
+        "mismatch(es), warm_start=%d, traces %d/%d orphan(s) "
+        "%d resume link(s), %.1fs",
         "OK" if report["ok"] else "FAILED", child.returncode,
         len(mirror_admitted), n_requests, pre_kill_completed,
         info["recovered"], info["replayed"], info["deduped"],
-        len(lost), len(mismatches), warm, report["wall_s"])
+        len(lost), len(mismatches), warm,
+        trace_facts["trace_orphan_spans"], trace_facts["trace_count"],
+        trace_facts["trace_resume_links"], report["wall_s"])
     return report

@@ -130,6 +130,11 @@ def test_cross_process_warm_start_survives_and_matches(tmp_path,
         capture_output=True, text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
              "PALLAS_AXON_POOL_IPS": "",
+             # pin the child to THIS process's effective precision, not
+             # whatever RAFT_TPU_X64 another test (bench.py import)
+             # leaked into os.environ — the export was built here, and
+             # a c64 child cannot call a c128 executable
+             "RAFT_TPU_X64": "1" if jax.config.jax_enable_x64 else "0",
              "RAFT_TPU_EXEC_CACHE_DIR": str(tmp_path),
              "PYTHONPATH": os.path.dirname(os.path.dirname(
                  os.path.abspath(__file__)))})

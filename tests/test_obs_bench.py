@@ -12,7 +12,16 @@ import pytest
 import raft_tpu  # noqa: F401  (x64 config before bench's setdefault)
 from raft_tpu import obs
 
-import bench
+# bench.py setdefaults RAFT_TPU_X64=0 at import — scrub it afterwards
+# unless the runner set it, or the leaked value infects every LATER
+# test that spawns a subprocess with ``{**os.environ, ...}`` (the
+# exec-cache cross-process test dtype flake: child f32, parent f64)
+_had_x64 = "RAFT_TPU_X64" in os.environ
+
+import bench  # noqa: E402
+
+if not _had_x64:
+    os.environ.pop("RAFT_TPU_X64", None)
 
 
 @pytest.fixture(autouse=True)

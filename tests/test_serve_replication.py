@@ -503,6 +503,7 @@ class _StubReplica:
         self.results = {}
         self.by_rdigest = {}
         self.nsub = 0
+        self.last_trace = None
         outer = self
 
         class H(BaseHTTPRequestHandler):
@@ -538,6 +539,7 @@ class _StubReplica:
                 import math
                 n = int(self.headers.get("Content-Length") or 0)
                 doc = json.loads(self.rfile.read(n) or b"{}")
+                outer.last_trace = self.headers.get("X-Raft-Trace")
                 outer.nsub += 1
                 rid = f"{outer.name}-req{outer.nsub}"
                 beta = math.radians(float(doc.get("heading_deg", 0.0)))
@@ -637,6 +639,53 @@ def test_router_quota_auth_affinity_failover_http():
         srv.shutdown()
         srv.server_close()
         router.stop()
+
+
+def test_router_http_trace_propagation_and_metrics():
+    """The router hop of the distributed trace, over real HTTP: an
+    inbound ``X-Raft-Trace`` is continued as a child span, forwarded
+    verbatim to the chosen replica, and echoed in the response body
+    and header; a traceless submit mints a fresh root; and ``GET
+    /metrics`` serves the Prometheus text exposition."""
+    from raft_tpu.obs.tracing import TRACE_HEADER, TraceContext
+    a = _StubReplica("A")
+    router = ReplicaRouter([a.url], health_interval_s=30.0).start()
+    srv = make_server(router, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        inbound = TraceContext.mint()
+        req = urllib.request.Request(
+            url + "/submit",
+            data=json.dumps({"hs": 2, "tp": 9}).encode(),
+            method="POST", headers={TRACE_HEADER: inbound.to_header()})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            body, hdrs = json.loads(r.read()), dict(r.headers)
+        tr = body["trace"]
+        assert tr["trace_id"] == inbound.trace_id
+        assert tr["parent_id"] == inbound.span_id
+        assert tr["span_id"] != inbound.span_id
+        echoed = TraceContext.parse(hdrs[TRACE_HEADER])
+        assert (echoed.trace_id, echoed.span_id) == \
+            (inbound.trace_id, tr["span_id"])
+        # the replica hop received the SAME continued context
+        fwd = TraceContext.parse(a.last_trace)
+        assert (fwd.trace_id, fwd.span_id) == \
+            (inbound.trace_id, tr["span_id"])
+        # no inbound header -> a fresh root (different trace, no parent)
+        _, b2, _ = _post(url, {"hs": 2.5, "tp": 9})
+        assert b2["trace"]["trace_id"] != inbound.trace_id
+        assert not b2["trace"].get("parent_id")
+        with urllib.request.urlopen(url + "/metrics", timeout=5) as r:
+            text = r.read().decode()
+            ctype = r.headers["Content-Type"]
+        assert "version=0.0.4" in ctype
+        assert "raft_tpu_serve_router_requests_total" in text
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        router.stop()
+        a.shutdown()
 
 
 def test_router_submit_failover_midrequest():
@@ -832,3 +881,22 @@ def test_failover_soak_acceptance(tmp_path, monkeypatch):
     assert set(succ["completed"]) | \
         set(wal.replay(os.path.join(str(root), "mirror"))["completed"]) \
         == set(range(report["n_requests"]))
+    # -- distributed tracing across the host boundary: every request's
+    # trace reassembles fully connected from the WALs alone, and at
+    # least one killed-mid-flight request carries the admission(host A)
+    # -> resume(host B) link on two distinct process tracks
+    tf = report["trace"]
+    assert tf["trace_count"] == report["n_requests"]
+    assert tf["trace_orphan_spans"] == 0
+    assert tf["trace_resume_links"] >= 1
+    assert tf["trace_process_tracks"] >= 2
+    from raft_tpu.obs import traceview
+    dirs = traceview.discover_journal_dirs(str(root))
+    resumed_tid = next(
+        t for t in traceview.trace_ids(dirs)
+        if traceview.assemble(t, dirs)["resume_links"] >= 1)
+    asm = traceview.assemble(resumed_tid, dirs)
+    assert asm["process_tracks"] >= 2 and asm["orphan_spans"] == 0
+    chrome = traceview.chrome_trace(asm)
+    names = {e["ph"] for e in chrome["traceEvents"]}
+    assert {"M", "X", "s", "f"} <= names       # tracks, spans, arrows

@@ -1,0 +1,323 @@
+"""End-to-end distributed request tracing (obs/tracing.TraceContext +
+serve propagation + obs/traceview assembly + obsctl trace).
+
+Unit tier: W3C-style header parse/mint/child semantics, WAL record
+round-trip through a stub service (admit/batch/complete all carry the
+context, delivered results carry ``provenance["trace"]``), the
+per-request phase-breakdown histograms + summary percentiles, resume
+linkage across two service lifetimes on one journal, and the
+``traceview`` assembler's connectivity verdict (orphan detection,
+resume links, process tracks) over synthetic failover-shaped journals
+— plus the ``obsctl trace`` CLI and the ``trace_orphan_spans`` SLO
+rule round trip.
+"""
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs import traceview
+from raft_tpu.obs import trendstore as T
+from raft_tpu.obs.tracing import TRACE_HEADER, TraceContext
+from raft_tpu.serve import ServeConfig, SweepService
+from raft_tpu.serve import journal as wal
+
+
+def stub_factory(mode, fowt, ncases, **kw):
+    def run(Hs, Tp, beta):
+        Hs = np.asarray(Hs)
+        return {"std": np.stack([np.full(6, float(h)) for h in Hs]),
+                "iters": np.full(len(Hs), 3),
+                "converged": np.ones(len(Hs), bool)}
+    run.ncases = ncases
+    run.cache_state = "stub"
+    return run
+
+
+def _cfg(tmp_path=None, **kw):
+    base = dict(queue_max=8, batch_cases=2, window_s=0.02,
+                batch_deadline_s=5.0, retry_base_s=0.01,
+                degrade_after=99)
+    if tmp_path is not None:
+        base["journal_dir"] = str(tmp_path)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _load_obsctl():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "obsctl.py")
+    spec = importlib.util.spec_from_file_location("obsctl", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# unit: the context itself
+# ---------------------------------------------------------------------------
+
+def test_trace_context_mint_child_and_header_roundtrip():
+    ctx = TraceContext.mint()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    assert ctx.parent_id is None
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id
+    assert kid.span_id != ctx.span_id
+    assert kid.parent_id == ctx.span_id
+    back = TraceContext.parse(kid.to_header())
+    assert back.trace_id == kid.trace_id
+    assert back.span_id == kid.span_id
+    # bare "<trace>-<span>" is accepted too
+    bare = TraceContext.parse(f"{ctx.trace_id}-{ctx.span_id}")
+    assert bare.span_id == ctx.span_id
+
+
+@pytest.mark.parametrize("bad", [
+    "", "garbage", "00-short-short-01", "00" * 40,
+    f"00-{'g' * 32}-{'a' * 16}-01",          # non-hex
+    f"00-{'0' * 32}-{'a' * 16}-01",          # all-zero trace id
+])
+def test_trace_context_malformed_headers_rejected(bad):
+    assert TraceContext.parse(bad) is None
+    # from_header never fails — a broken caller still gets traced
+    minted = TraceContext.from_header(bad)
+    assert len(minted.trace_id) == 32
+
+
+def test_trace_context_dict_roundtrip():
+    kid = TraceContext.mint().child()
+    d = kid.as_dict()
+    assert set(d) == {"trace_id", "span_id", "parent_id"}
+    assert TraceContext.from_dict(d) == kid
+    assert TraceContext.from_dict(None) is None
+    assert TraceContext.from_dict({"trace_id": "nope"}) is None
+    # an invalid parent is dropped, not fatal
+    got = TraceContext.from_dict({**d, "parent_id": "zz"})
+    assert got.parent_id is None
+
+
+# ---------------------------------------------------------------------------
+# WAL round trip + phase breakdown through a stub service
+# ---------------------------------------------------------------------------
+
+def test_submit_trace_propagates_to_wal_provenance_and_phases(tmp_path):
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path))
+    inbound = TraceContext.mint()
+    t = svc.submit(2.0, 9.0, 0.0, trace=inbound.to_header())
+    t2 = svc.submit(3.0, 8.0, 10.0)            # no header: minted root
+    svc.start()
+    res = t.result(30.0)
+    res2 = t2.result(30.0)
+    summary = svc.stop()
+    assert res.ok and res2.ok
+
+    prov = (res.extra or {})["provenance"]["trace"]
+    # the service span is a CHILD of the inbound header's span
+    assert prov["trace_id"] == inbound.trace_id
+    assert prov["parent_id"] == inbound.span_id
+    assert prov["span_id"] != inbound.span_id
+    prov2 = (res2.extra or {})["provenance"]["trace"]
+    assert prov2["trace_id"] != inbound.trace_id
+    assert "parent_id" not in prov2            # minted root
+
+    state = wal.replay(str(tmp_path))
+    assert state["admitted"][t.seq]["trace"] == prov
+    assert state["completed"][t.seq]["trace"] == prov
+    # replay() folds batch records away — read the raw stream
+    batch_recs = [r for _p, r in traceview.scan([str(tmp_path)])
+                  if r.get("type") == "batch"]
+    assert any(prov in (b.get("traces") or []) for b in batch_recs)
+
+    # phase breakdown: summary percentiles + the labeled histogram
+    for key in ("phase_admission_p50_s", "phase_queue_wait_p99_s",
+                "phase_solve_p50_s", "phase_delivery_p99_s"):
+        assert key in summary and summary[key] >= 0.0
+    assert "raft_tpu_serve_request_phase_seconds" in obs.snapshot()
+    from raft_tpu.obs import metrics as M
+    assert "raft_tpu_serve_request_phase_seconds" in M.exposition()
+
+
+def test_batch_membership_assembles_with_flow_events(tmp_path):
+    svc = SweepService(runner_factory=stub_factory,
+                       config=_cfg(tmp_path, window_s=0.2))
+    ta = svc.submit(2.0, 9.0, 0.0)
+    tb = svc.submit(3.0, 8.0, 10.0)            # same window, same batch
+    svc.start()
+    assert ta.result(30.0).ok and tb.result(30.0).ok
+    svc.stop()
+    dirs = [str(tmp_path)]
+    tids = traceview.trace_ids(dirs)
+    assert len(tids) == 2
+    for tid in tids:
+        asm = traceview.assemble(tid, dirs)
+        assert len(asm["spans"]) == 1
+        assert asm["orphan_spans"] == 0 and asm["open_spans"] == 0
+        assert asm["batches"], "batch record lost its member context"
+        chrome = traceview.chrome_trace(asm)
+        phs = [e["ph"] for e in chrome["traceEvents"]]
+        assert "X" in phs and "M" in phs
+        # batch membership renders as a flow arrow pair + an instant
+        assert "s" in phs and "f" in phs and "i" in phs
+
+
+def test_resume_linkage_across_two_service_lifetimes(tmp_path):
+    # lifetime A admits (worker never started) and "dies" — the WAL
+    # holds the admit with A's context
+    svc_a = SweepService(runner_factory=stub_factory,
+                         config=_cfg(tmp_path))
+    t_a = svc_a.submit(2.0, 9.0, 0.0)
+    ctx_a = wal.replay(str(tmp_path))["admitted"][t_a.seq]["trace"]
+
+    # lifetime B recovers the same journal and finishes the request
+    svc_b = SweepService(runner_factory=stub_factory,
+                         config=_cfg(tmp_path))
+    info = svc_b.recover()
+    svc_b.start()
+    res = info["tickets"][t_a.seq].result(30.0)
+    svc_b.stop()
+    assert res.ok
+    prov = (res.extra or {})["provenance"]["trace"]
+    # same trace, fresh span, parented on the dead lifetime's span
+    assert prov["trace_id"] == ctx_a["trace_id"]
+    assert prov["span_id"] != ctx_a["span_id"]
+    assert prov["parent_id"] == ctx_a["span_id"]
+
+    asm = traceview.assemble(ctx_a["trace_id"], [str(tmp_path)])
+    assert len(asm["spans"]) == 2
+    assert asm["orphan_spans"] == 0            # B's parent resolves to A
+    assert asm["resume_links"] == 1            # ... across lifetimes
+    assert asm["process_tracks"] == 2          # two run_ids, one dir
+    chrome = traceview.chrome_trace(asm)
+    links = [e for e in chrome["traceEvents"]
+             if e.get("cat") == "link"]
+    assert {"s", "f"} == {e["ph"] for e in links}
+
+
+# ---------------------------------------------------------------------------
+# assembler verdicts over synthetic failover-shaped journals
+# ---------------------------------------------------------------------------
+
+def _write_journal(d, recs):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, traceview.JOURNAL_FILENAME), "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _failover_tree(root):
+    """A hand-built two-host trace: host A admits + checkpoints, dies;
+    host B re-admits as a child span and completes."""
+    tid = "ab" * 16
+    t0 = 1700000000.0
+    a = {"trace_id": tid, "span_id": "aa" * 8}
+    b = {"trace_id": tid, "span_id": "bb" * 8, "parent_id": "aa" * 8}
+    mirror = [
+        {"t": t0, "type": "begin", "run_id": "hostA", "pid": 11},
+        {"t": t0 + 1, "type": "admit", "seq": 0, "rdigest": "r0",
+         "trace": a},
+        {"t": t0 + 2, "type": "batch", "batch_id": 1, "seqs": [0],
+         "mode": "full", "traces": [a]},
+        {"t": t0 + 3, "type": "ckpt", "seq": 0, "step": 2,
+         "cdigest": "c0", "trace": a},
+    ]
+    succ = [
+        {"t": t0 + 10, "type": "begin", "run_id": "hostB", "pid": 22},
+        {"t": t0 + 11, "type": "admit", "seq": 0, "rdigest": "r0",
+         "trace": b},
+        {"t": t0 + 12, "type": "complete", "seq": 0, "rdigest": "r0",
+         "digest": "d0", "trace": b},
+    ]
+    _write_journal(os.path.join(root, "mirror"), mirror)
+    _write_journal(os.path.join(root, "successor", "journal"), succ)
+    return tid
+
+
+def test_traceview_failover_connected_and_orphan_detection(tmp_path):
+    tid = _failover_tree(str(tmp_path))
+    dirs = traceview.discover_journal_dirs(str(tmp_path))
+    assert len(dirs) == 2                      # mirror + successor
+    assert traceview.trace_ids(dirs) == [tid]
+    asm = traceview.assemble(tid, dirs)
+    assert len(asm["spans"]) == 2
+    assert asm["process_tracks"] == 2
+    assert asm["orphan_spans"] == 0
+    assert asm["resume_links"] == 1
+    assert asm["open_spans"] == 1              # host A died mid-flight
+    assert [i["name"] for i in asm["instants"]] == ["ckpt step=2"]
+
+    # corrupt host B's inherited parent: the later span's parent no
+    # longer resolves anywhere -> an orphan (the earliest span alone
+    # is entitled to an out-of-WAL parent)
+    broken = os.path.join(str(tmp_path), "broken")
+    _failover_tree(broken)
+    succ = os.path.join(broken, "successor", "journal",
+                        traceview.JOURNAL_FILENAME)
+    text = open(succ).read().replace("bbbbbbbbbbbbbbbb", "cc" * 8)
+    open(succ, "w").write(text.replace("aaaaaaaaaaaaaaaa", "ff" * 8))
+    part = traceview.assemble(
+        tid, traceview.discover_journal_dirs(broken))
+    assert part["orphan_spans"] == 1 == len(part["spans"]) - 1
+    assert part["resume_links"] == 0
+
+
+def test_obsctl_trace_cli_and_slo_rule(tmp_path):
+    obsctl = _load_obsctl()
+    tid = _failover_tree(str(tmp_path / "soak"))
+    out = str(tmp_path / "trace.json")
+    db = str(tmp_path / "trend.sqlite")
+    rc = obsctl.main(["trace", tid, "--journal-dir",
+                      str(tmp_path / "soak"), "--expect-resume",
+                      "--out", out, "--trend-db", db])
+    assert rc == 0
+    chrome = json.load(open(out))
+    assert chrome["otherData"]["orphan_spans"] == 0
+    assert chrome["otherData"]["process_tracks"] == 2
+    assert any(e["ph"] == "X" for e in chrome["traceEvents"])
+    # --all over the same tree: one trace, still connected
+    assert obsctl.main(["trace", "--all", "--journal-dir",
+                        str(tmp_path / "soak")]) == 0
+    # a broken tree (the successor's inherited parent corrupted) must
+    # exit 1
+    broken = str(tmp_path / "broken")
+    _failover_tree(broken)
+    succ = os.path.join(broken, "successor", "journal",
+                        traceview.JOURNAL_FILENAME)
+    text = open(succ).read().replace("aaaaaaaaaaaaaaaa", "ff" * 8)
+    open(succ, "w").write(text)
+    assert obsctl.main(["trace", tid, "--journal-dir", broken]) == 1
+
+    # the appended trend row feeds the zero-tolerance SLO rule
+    rows = T.TrendStore(db).rows()
+    assert rows and rows[0]["facts"]["trace_orphan_spans"] == 0
+    report = T.evaluate_slo(rows, None)
+    by_name = {r["name"]: r for r in report["results"]}
+    assert by_name["trace_orphan_spans"]["ok"]
+    assert not by_name["trace_orphan_spans"].get("skipped")
+    # ... and violates when an orphan lands in the store
+    # (status stays "ok": the row records the measurement, the rule
+    # does the gating — evaluate_slo only reads status-ok rows)
+    T.TrendStore(db).append({
+        "run_id": "trace-broken", "kind": "trace", "status": "ok",
+        "started_at": "2026-01-01T00:00:00Z",
+        "extra": {"trace": {"trace_orphan_spans": 1}}})
+    assert obsctl.main(["slo", "--db", db]) == 1
+
+
+def test_trendstore_phase_and_trace_fact_folding():
+    doc = {"run_id": "x", "kind": "serve", "status": "ok",
+           "extra": {"serve": {"completed": 2,
+                               "phase_solve_p50_s": 0.125,
+                               "phase_queue_wait_p99_s": 0.5},
+                     "trace": {"trace_orphan_spans": 0,
+                               "trace_resume_links": 1}}}
+    facts = T.facts_from_manifest(doc)
+    assert facts["serve_phase_solve_p50_s"] == 0.125
+    assert facts["serve_phase_queue_wait_p99_s"] == 0.5
+    assert facts["trace_orphan_spans"] == 0
+    assert facts["trace_resume_links"] == 1

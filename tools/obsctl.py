@@ -18,6 +18,10 @@ Subcommands::
     obsctl tail RUN.events.jsonl    # live/offline follow of a flight-
                                     # recorder event file with per-case
                                     # progress + ETA (--follow to stream)
+    obsctl trace TID --journal-dir D  # assemble one distributed trace
+                                    # (serve WAL + event files) into a
+                                    # Perfetto-loadable Chrome trace;
+                                    # exit 1 on a broken/orphaned trace
     obsctl serve --dir OBS_DIR      # stdlib HTTP endpoint: /metrics
                                     # (Prometheus), /events, /runs,
                                     # /healthz (--smoke: self-scrape)
@@ -293,6 +297,18 @@ def cmd_trend(args) -> int:
 # tail — follow a flight-recorder event file
 # ---------------------------------------------------------------------------
 
+def _trace_tag(e: dict) -> str:
+    """Slow-path events carry a distributed-trace exemplar — render it
+    so the line in `obsctl tail` leads straight to `obsctl trace`."""
+    tid = e.get("trace_id")
+    if not tid:
+        tids = e.get("trace_ids")
+        if isinstance(tids, str):
+            tids = [x for x in tids.split(",") if x]
+        tid = tids[0] if isinstance(tids, (list, tuple)) and tids else None
+    return f" trace={str(tid)[:16]}" if tid else ""
+
+
 def _fmt_event(e: dict) -> str | None:
     """One rendered line per event (None = not rendered by default)."""
     ts = time.strftime("%H:%M:%S", time.localtime(float(e.get("t", 0))))
@@ -345,7 +361,7 @@ def _fmt_event(e: dict) -> str | None:
                 f"backoff {e.get('backoff_s', 0):.3f}s)")
     if t == "watchdog_abandon":
         return (f"{ts} WATCHDOG abandoned batch {e.get('batch_id')} "
-                f"(reqs {e.get('reqs')})")
+                f"(reqs {e.get('reqs')}){_trace_tag(e)}")
     if t == "request_done":
         return (f"{ts} req {e.get('req')} done "
                 f"({e.get('latency_s', 0):.2f}s, mode {e.get('mode')}, "
@@ -364,7 +380,8 @@ def _fmt_event(e: dict) -> str | None:
                 f"{str(e.get('rdigest'))[:19]}")
     if t == "warm_start_rejected":
         return (f"{ts} WARM-START rejected lane {e.get('lane')} "
-                f"({e.get('outcome')}: {e.get('detail')})")
+                f"({e.get('outcome')}: {e.get('detail')})"
+                f"{_trace_tag(e)}")
     if t == "statics_warm_rejected":
         return (f"{ts} STATICS warm seed rejected case {e.get('case')} "
                 f"(iters {e.get('iters')}; cold re-solve)")
@@ -373,7 +390,7 @@ def _fmt_event(e: dict) -> str | None:
     if t in ("ckpt_resume", "ckpt_resumed"):
         req = f" req {e['req']}" if e.get("req") is not None else ""
         return (f"{ts} CKPT resume{req} from step {e.get('step')}"
-                f"/{e.get('steps')}")
+                f"/{e.get('steps')}{_trace_tag(e)}")
     if t == "ckpt_resume_rejected":
         return (f"{ts} CKPT resume rejected (step {e.get('step')}: "
                 f"identity/layout mismatch) — fresh start")
@@ -382,7 +399,7 @@ def _fmt_event(e: dict) -> str | None:
                 f"({e.get('reason')}) — fall back one segment")
     if t == "storage_degraded":
         return (f"{ts} STORAGE degraded: {e.get('component')} shed "
-                f"(ENOSPC/budget)")
+                f"(ENOSPC/budget){_trace_tag(e)}")
     if t == "storage_recovered":
         return f"{ts} storage recovered: {e.get('component')} re-probing"
     return None
@@ -717,6 +734,118 @@ def cmd_slo(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# trace — assemble one distributed trace from WAL records + events
+# ---------------------------------------------------------------------------
+
+def _print_trace(asm: dict, verbose: bool = False):
+    spans = asm["spans"]
+    t0 = min((s["t0"] for s in spans.values()), default=0.0)
+    print(f"trace {asm['trace_id']}: {len(spans)} span(s) across "
+          f"{asm['process_tracks']} process track(s), "
+          f"{len(asm['batches'])} batch record(s), "
+          f"{asm['resume_links']} resume link(s), "
+          f"{asm['orphan_spans']} orphan(s), "
+          f"{asm['open_spans']} open")
+    for sp in sorted(spans.values(), key=lambda s: s["t0"]):
+        run_id, pid = sp["proc"]
+        dur = (sp["t1"] - sp["t0"]) if sp["t1"] is not None else 0.0
+        link = ("root" if not sp["parent_id"]
+                else f"<- {str(sp['parent_id'])[:8]}"
+                if sp["parent_id"] in spans
+                else f"<- {str(sp['parent_id'])[:8]} (unresolved)")
+        print(f"  +{sp['t0'] - t0:8.3f}s {dur:7.3f}s "
+              f"{str(sp['name']):18s} span={sp['span_id'][:8]} {link:>16s} "
+              f"[{run_id} pid {pid}] {sp['status']}")
+    if verbose:
+        for i in sorted(asm["instants"], key=lambda x: x["t"]):
+            print(f"  +{i['t'] - t0:8.3f}s          {i['name']} "
+                  f"[{i['proc'][0]} pid {i['proc'][1]}]")
+
+
+def cmd_trace(args) -> int:
+    from raft_tpu.obs import traceview as TV
+    dirs = []
+    for root in args.journal_dir:
+        found = TV.discover_journal_dirs(root)
+        if not found:
+            _fail(f"trace: no serve journal under {root}")
+        dirs.extend(d for d in found if d not in dirs)
+    known = TV.trace_ids(dirs)
+    if args.list:
+        for tid in known:
+            print(tid)
+        return 0
+    if args.all:
+        targets = known
+        if not targets:
+            _fail("trace: no traced admits in the given journals", 1)
+    else:
+        if not args.trace_id:
+            _fail("trace: give a TRACE_ID (or --list / --all)")
+        targets = [args.trace_id]
+
+    assembled = [TV.assemble(t, dirs, events_paths=args.events or ())
+                 for t in targets]
+    ok = all(a["spans"] and a["orphan_spans"] == 0 for a in assembled)
+    if args.expect_resume:
+        ok = ok and any(a["resume_links"] > 0 for a in assembled)
+
+    if args.out:
+        if len(assembled) != 1:
+            _fail("trace: --out needs a single TRACE_ID, not --all")
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(TV.chrome_trace(assembled[0]), f)
+        print(f"wrote {args.out}")
+    if args.trend_db:
+        # fold the connectivity verdict into the trend store so the
+        # zero-tolerance `trace_orphan_spans` SLO rule sees it
+        agg = {"trace_spans": 0, "trace_orphan_spans": 0,
+               "trace_resume_links": 0, "trace_open_spans": 0,
+               "trace_process_tracks": 0, "trace_count": len(assembled)}
+        t_start = None
+        for a in assembled:
+            facts = TV.summary_facts(a)
+            for k in ("trace_spans", "trace_orphan_spans",
+                      "trace_resume_links", "trace_open_spans"):
+                agg[k] += facts[k]
+            agg["trace_process_tracks"] = max(
+                agg["trace_process_tracks"], facts["trace_process_tracks"])
+            for sp in a["spans"].values():
+                t_start = (sp["t0"] if t_start is None
+                           else min(t_start, sp["t0"]))
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              time.gmtime(t_start or 0))
+        # status stays "ok" — the row records the measurement, and the
+        # zero-tolerance trace_orphan_spans RULE does the gating
+        # (evaluate_slo only reads status-ok rows)
+        row = T.TrendStore(args.trend_db).append({
+            "run_id": f"trace-{targets[0][:12]}",
+            "kind": "trace", "status": "ok",
+            "started_at": stamp, "finished_at": stamp,
+            "extra": {"trace": agg}})
+        print(f"trend row appended: {row.get('run_id')} "
+              f"orphans={agg['trace_orphan_spans']}")
+
+    if args.json:
+        print(json.dumps({
+            "ok": ok,
+            "traces": [{**TV.summary_facts(a),
+                        "trace_id": a["trace_id"],
+                        "roots": a["roots"]} for a in assembled],
+        }, indent=1))
+    else:
+        for a in assembled:
+            _print_trace(a, verbose=args.verbose)
+        verdict = "CONNECTED" if ok else "BROKEN"
+        print(f"obsctl trace: {verdict} ({len(assembled)} trace(s), "
+              f"{sum(a['orphan_spans'] for a in assembled)} orphan "
+              f"span(s)"
+              + (", resume link present" if any(
+                  a["resume_links"] for a in assembled) else "") + ")")
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
 # selfcheck
 # ---------------------------------------------------------------------------
 
@@ -956,6 +1085,40 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_slo)
 
+    p = sub.add_parser("trace",
+                       help="assemble one distributed trace from serve "
+                            "WAL records (+ event files) into a "
+                            "Perfetto-loadable Chrome trace; exit 1 on "
+                            "a broken (orphaned) trace")
+    p.add_argument("trace_id", nargs="?",
+                   help="32-hex trace id (see `--list`, result "
+                        "provenance, or `obsctl tail` exemplars)")
+    p.add_argument("--journal-dir", action="append", required=True,
+                   help="journal directory or soak tree root "
+                        "(primary/mirror/successor are auto-"
+                        "discovered), repeatable")
+    p.add_argument("--events", action="append",
+                   help="flight-recorder .events.jsonl file(s) whose "
+                        "trace-tagged events become instants, "
+                        "repeatable")
+    p.add_argument("--list", action="store_true",
+                   help="print the trace ids admitted in the journals")
+    p.add_argument("--all", action="store_true",
+                   help="assemble and gate EVERY trace in the journals "
+                        "(the CI chaos gate)")
+    p.add_argument("--expect-resume", action="store_true",
+                   help="additionally require a cross-process resume "
+                        "link (failover/preemption proof)")
+    p.add_argument("--out", help="write the Chrome trace JSON here "
+                                 "(single TRACE_ID mode)")
+    p.add_argument("--trend-db", help="append the connectivity verdict "
+                                      "as a trend-store row (feeds the "
+                                      "trace_orphan_spans SLO rule)")
+    p.add_argument("--verbose", "-v", action="store_true",
+                   help="also print per-trace instants")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_trace)
+
     p = sub.add_parser("selfcheck",
                        help="round-trip a synthetic ledger through "
                             "diff/check/trend")
@@ -974,4 +1137,14 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    try:
+        code = main()
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # `obsctl trace --list | head -1` closes stdout early; that is
+        # a normal way to consume list output, not an error.  Re-point
+        # stdout at devnull so the interpreter's shutdown flush does
+        # not raise a second time.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        code = 0
+    raise SystemExit(code)

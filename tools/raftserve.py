@@ -153,7 +153,9 @@ def cmd_soak(args) -> int:
               f"store={report['store_shed']}, "
               f"{report['storage_corrupt_served_count']} corrupt "
               f"served, {report['preempt_lost']} lost; "
-              f"{report['wall_s']:.1f}s")
+              f"traces {report['trace']['trace_orphan_spans']} "
+              f"orphan(s) {report['trace']['trace_resume_links']} "
+              f"resume link(s), {report['wall_s']:.1f}s")
         return 0 if report["ok"] else 1
 
     if args.storm:
@@ -211,6 +213,10 @@ def cmd_soak(args) -> int:
               f"alone, {len(report['lost'])} lost, "
               f"{len(report['digest_mismatches'])} digest mismatch(es), "
               f"warm_start={report['restart_warm_start']}, "
+              f"traces {report['trace']['trace_count']}"
+              f"/{report['n_requests']} "
+              f"{report['trace']['trace_orphan_spans']} orphan(s) "
+              f"{report['trace']['trace_resume_links']} resume link(s), "
               f"{report['wall_s']:.1f}s")
         return 0 if report["ok"] else 1
 
@@ -271,6 +277,7 @@ def cmd_serve(args) -> int:
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from raft_tpu import errors
+    from raft_tpu.obs.tracing import TRACE_HEADER
     from raft_tpu.serve import ServeConfig, SweepService
     from raft_tpu.serve import journal as wal
 
@@ -348,6 +355,17 @@ def cmd_serve(args) -> int:
                                  **service.stats()})
             elif url.path == "/stats":
                 self._send(200, service.summary())
+            elif url.path == "/metrics":
+                # Prometheus text exposition of THIS replica's registry
+                # (scrape target; see docs/observability.md)
+                from raft_tpu.obs import metrics as M
+                data = M.exposition().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             elif url.path == "/result":
                 digest = q.get("digest", [None])[0]
                 rdigest = q.get("rdigest", [None])[0]
@@ -404,9 +422,9 @@ def cmd_serve(args) -> int:
                     self._send(400, {"error": f"bad request: {e}"})
                     return
                 try:
-                    t = service.submit_optimize(doc,
-                                                deadline_s=deadline_s,
-                                                tenant=tenant)
+                    t = service.submit_optimize(
+                        doc, deadline_s=deadline_s, tenant=tenant,
+                        trace=self.headers.get(TRACE_HEADER))
                 except errors.AdmissionRejected as e:
                     self._send(429, e.context(),
                                headers={"Retry-After":
@@ -416,6 +434,8 @@ def cmd_serve(args) -> int:
                     self._send(400, e.context())
                     return
                 _track(t)
+                thdr = ({TRACE_HEADER: t.trace.to_header()}
+                        if t.trace else {})
                 if wait:
                     try:
                         res = t.result((deadline_s or cfg.deadline_s)
@@ -423,9 +443,12 @@ def cmd_serve(args) -> int:
                     except errors.DeadlineExceeded as e:
                         self._send(504, e.context())
                         return
-                    self._send(200, res.to_dict())
+                    self._send(200, res.to_dict(), headers=thdr)
                 else:
-                    self._send(202, {"request_id": t.id, "seq": t.seq})
+                    self._send(202, {"request_id": t.id, "seq": t.seq,
+                                     "trace": (t.trace.as_dict()
+                                               if t.trace else None)},
+                               headers=thdr)
                 return
             if self.path != "/submit":
                 self._send(404, {"error": "not found"})
@@ -454,7 +477,8 @@ def cmd_serve(args) -> int:
                 # re-resolution/dedupe contracts depend on backend and
                 # router computing the SAME digest
                 t = service.submit(hs, tp, beta, deadline_s=deadline_s,
-                                   tenant=tenant)
+                                   tenant=tenant,
+                                   trace=self.headers.get(TRACE_HEADER))
             except errors.AdmissionRejected as e:
                 self._send(429, e.context(),
                            headers={"Retry-After":
@@ -465,15 +489,22 @@ def cmd_serve(args) -> int:
                 self._send(400, e.context())
                 return
             _track(t)
+            # echo the continued context: async callers correlate the
+            # 202 with the eventual result (and with `obsctl trace`)
+            thdr = ({TRACE_HEADER: t.trace.to_header()}
+                    if t.trace else {})
             if doc.get("wait"):
                 try:
                     res = t.result((deadline_s or cfg.deadline_s) + 5.0)
                 except errors.DeadlineExceeded as e:
                     self._send(504, e.context())
                     return
-                self._send(200, res.to_dict())
+                self._send(200, res.to_dict(), headers=thdr)
             else:
-                self._send(202, {"request_id": t.id, "seq": t.seq})
+                self._send(202, {"request_id": t.id, "seq": t.seq,
+                                 "trace": (t.trace.as_dict()
+                                           if t.trace else None)},
+                           headers=thdr)
 
     srv = ThreadingHTTPServer((args.host, args.port), Handler)
     host, port = srv.server_address[:2]
@@ -490,7 +521,7 @@ def cmd_serve(args) -> int:
     signal.signal(signal.SIGTERM, _on_sigterm)
     print(f"raftserve: http://{host}:{port}/  (submit, optimize, "
           f"result, drain, "
-          f"stats, healthz; design={args.design}, "
+          f"stats, healthz, metrics; design={args.design}, "
           f"batch={cfg.batch_cases}, "
           f"ladder={'->'.join(service.ladder)}, "
           f"journal={args.journal_dir or 'off'})", flush=True)
@@ -540,7 +571,7 @@ def cmd_route(args) -> int:
     qdesc = ",".join(sorted(quotas)) \
         or ("default" if default_quota else "off")
     print(f"raftserve route: http://{host}:{port}/  (submit, result, "
-          f"stats, healthz; {len(router.backends)} replica(s), "
+          f"stats, healthz, metrics; {len(router.backends)} replica(s), "
           f"{healthy} healthy; quotas={qdesc}; "
           f"auth={'on' if secret else 'off'})", flush=True)
 

@@ -401,8 +401,16 @@ def main():
         manifest.environment = obs.capture_environment()   # backend is up
 
         with obs.span("bench_warmup_compile", nv=NV):
+            # devprof stamps the warmup-compile profile (wall seconds,
+            # static-HLO FLOPs/bytes, watermark delta) into the
+            # manifest and the raft_tpu_devprof_* gauges — the roofline
+            # arithmetic intensity rides the bench row from here
+            prof = obs.devprof.start("bench_variant_pipeline")
+            lowered = batched.lower(thetas)
             out = batched(thetas)   # compile + warmup
             jax.block_until_ready(out["std"])
+            devprof_facts = prof.finish(lowered=lowered)
+        obs.devprof.attach(manifest, devprof_facts)
         # distinct variant batches per rep: the axon tunnel memoizes
         # repeated identical (program, inputs) executions, which would
         # fake the timing
@@ -466,6 +474,9 @@ def main():
             "qtf_ok": qtf_ok,
             "analyze_cases": ac,
             "solver": solver_facts,
+            "devprof": {k: devprof_facts.get(k)
+                        for k in ("compile_s", "flops", "bytes_accessed",
+                                  "arithmetic_intensity")},
             "ok": acc_ok and qtf_ok,
         }
         status = "ok" if result["ok"] else "failed"

@@ -333,3 +333,42 @@ def set_recovery_mode(mode: str | None):
     if mode is not None and str(mode) not in _RECOVERY_MODES:
         raise ValueError(f"recovery mode {mode!r} not in {_RECOVERY_MODES}")
     _recovery_override = None if mode is None else str(mode)
+
+
+# ---------------------------------------------------------------------------
+# batched solve-health telemetry (parallel/sweep.py, parallel/optimize.py)
+# ---------------------------------------------------------------------------
+
+#: RAFT_TPU_HEALTH values: "0" (default) — batched programs are compiled
+#: without the health block and the exec-cache keys stay byte-identical
+#: to pre-health builds; "1" — solve_batched and the optimize summary
+#: additionally report per-lane relative residuals, a conditioning proxy
+#: and nonfinite-lane counts (the exec-cache key forks on ``health``).
+_HEALTH_MODES = ("0", "1")
+_health_override: str | None = None
+
+
+def health_mode() -> str:
+    """Active solve-health mode ("0" | "1"); programmatic override beats
+    the ``RAFT_TPU_HEALTH`` environment variable."""
+    if _health_override is not None:
+        return _health_override
+    mode = os.environ.get("RAFT_TPU_HEALTH", "0").strip().lower()
+    if mode in ("off", "false"):
+        mode = "0"
+    if mode in ("on", "true"):
+        mode = "1"
+    return mode if mode in _HEALTH_MODES else "0"
+
+
+def set_health_mode(mode: str | None):
+    """Override the solve-health mode in-process (None clears)."""
+    global _health_override
+    if mode is not None and str(mode) not in _HEALTH_MODES:
+        raise ValueError(f"health mode {mode!r} not in {_HEALTH_MODES}")
+    _health_override = None if mode is None else str(mode)
+
+
+def health_enabled() -> bool:
+    """True when batched solve-health telemetry is on."""
+    return health_mode() == "1"

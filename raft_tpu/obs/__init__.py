@@ -60,7 +60,8 @@ from raft_tpu.obs.tracing import (                              # noqa: F401
 from raft_tpu.obs.metrics import (                              # noqa: F401
     REGISTRY, counter, gauge, histogram, snapshot, to_prometheus,
     install_jax_hooks, sample_jit_cache, record_build_info, ITER_BUCKETS,
-    record_solve_dispatch, record_exec_cache_event,
+    record_solve_dispatch, record_exec_cache_event, record_solve_health,
+    record_devprof,
 )
 from raft_tpu.obs.manifest import (                             # noqa: F401
     SCHEMA, RunManifest, ProbeAttempt, capture_environment,
@@ -72,6 +73,7 @@ from raft_tpu.obs.ledger import (                               # noqa: F401
     compare_manifests,
 )
 from raft_tpu.obs import device  # noqa: F401
+from raft_tpu.obs import devprof  # noqa: F401
 from raft_tpu.obs import transfers  # noqa: F401
 from raft_tpu.obs import events  # noqa: F401
 from raft_tpu.obs import probes  # noqa: F401

@@ -1,0 +1,149 @@
+"""Program-level device profiling: what each compiled program costs.
+
+PR 16 made requests observable; this module makes the *programs* they
+run observable.  Every AOT compile site (the exec-cache misses in
+``parallel/sweep.py`` and ``parallel/optimize.py``, plus ``bench.py``)
+wraps its lower→compile step in :func:`start` / :meth:`Prof.finish`
+and gets back one JSON-able facts dict per kernel:
+
+- ``compile_s`` — wall seconds spent inside XLA compilation,
+- ``flops`` / ``bytes_accessed`` / ``optimal_seconds`` — static HLO
+  cost analysis via :func:`raft_tpu.obs.device.cost_analysis`,
+- ``arithmetic_intensity`` — flops / bytes_accessed (roofline x-axis),
+- ``argument_bytes`` / ``output_bytes`` / ``temp_bytes`` /
+  ``code_bytes`` — the compiled program's ``memory_analysis()``,
+- ``peak_bytes_before`` / ``peak_bytes_after`` / ``peak_bytes_delta``
+  — device allocator watermark movement across the compile (None on
+  CPU, whose allocator reports no stats).
+
+The facts ride three sinks: the run manifest
+(``extra["devprof"][kernel]``), the exec-cache meta sidecar (so warm
+hits recover the original compile's facts without recompiling), and —
+via :func:`raft_tpu.obs.metrics.record_devprof` — Prometheus gauges
+and the trend store (``devprof_*`` facts, consumed by ``obsctl
+regress``).
+
+Every probe is guarded: a JAX build without ``memory_analysis`` or
+``cost_analysis`` degrades to absent fields, never an error.  This
+module never imports jax at module scope (the ``raft_tpu.obs``
+contract).
+"""
+from __future__ import annotations
+
+import time
+
+
+def peak_bytes() -> int | None:
+    """Sum of per-device ``peak_bytes_in_use`` allocator watermarks, or
+    None when no local device reports memory stats (CPU)."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    total, seen = 0, False
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "peak_bytes_in_use" in stats:
+            total += int(stats["peak_bytes_in_use"])
+            seen = True
+    return total if seen else None
+
+
+def memory_analysis(compiled) -> dict | None:
+    """Buffer sizes of a compiled program: {argument_bytes,
+    output_bytes, temp_bytes, code_bytes} via ``memory_analysis()``
+    (None when this JAX build or backend exposes none)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for attr, key in (("argument_size_in_bytes", "argument_bytes"),
+                      ("output_size_in_bytes", "output_bytes"),
+                      ("temp_size_in_bytes", "temp_bytes"),
+                      ("generated_code_size_in_bytes", "code_bytes")):
+        val = getattr(ma, attr, None)
+        if val is not None:
+            try:
+                out[key] = int(val)
+            except (TypeError, ValueError):
+                pass
+    return out or None
+
+
+class Prof:
+    """One lower→compile measurement; create via :func:`start`."""
+
+    def __init__(self, kernel: str):
+        self.kernel = kernel
+        self._t0 = time.perf_counter()
+        self._peak0 = peak_bytes()
+
+    def finish(self, lowered=None, compiled=None) -> dict:
+        """Close the measurement and return the facts dict.  ``lowered``
+        feeds static cost analysis; ``compiled`` feeds buffer sizes."""
+        compile_s = time.perf_counter() - self._t0
+        facts: dict = {"kernel": self.kernel,
+                       "compile_s": round(compile_s, 6)}
+        if lowered is not None:
+            from raft_tpu.obs import device as _device
+            costs = _device.cost_analysis(lowered, kernel=self.kernel)
+            if costs:
+                for k in ("flops", "bytes_accessed", "transcendentals",
+                          "optimal_seconds"):
+                    if k in costs:
+                        facts[k] = costs[k]
+                if facts.get("flops") and facts.get("bytes_accessed"):
+                    facts["arithmetic_intensity"] = (
+                        facts["flops"] / facts["bytes_accessed"])
+        if compiled is not None:
+            ma = memory_analysis(compiled)
+            if ma:
+                facts.update(ma)
+        peak1 = peak_bytes()
+        if self._peak0 is not None:
+            facts["peak_bytes_before"] = self._peak0
+        if peak1 is not None:
+            facts["peak_bytes_after"] = peak1
+        if self._peak0 is not None and peak1 is not None:
+            facts["peak_bytes_delta"] = peak1 - self._peak0
+        from raft_tpu.obs import metrics as _metrics
+        _metrics.record_devprof(facts)
+        return facts
+
+
+def start(kernel: str) -> Prof:
+    """Begin profiling one compile; call ``.finish(...)`` after it."""
+    return Prof(kernel)
+
+
+def tree_bytes(tree) -> int:
+    """Total ``nbytes`` over the array leaves of a pytree (fallback
+    argument/output sizing when ``memory_analysis`` is unavailable)."""
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:
+        return 0
+    total = 0
+    for leaf in leaves:
+        try:
+            total += int(leaf.nbytes)
+        except (AttributeError, TypeError):
+            pass
+    return total
+
+
+def attach(manifest, facts: dict | None):
+    """Fold one kernel's facts into ``manifest.extra["devprof"]``
+    (keyed by kernel name; None facts are a no-op)."""
+    if manifest is None or not facts:
+        return
+    kernel = facts.get("kernel", "kernel")
+    manifest.extra.setdefault("devprof", {})[kernel] = dict(facts)

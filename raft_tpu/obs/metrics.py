@@ -333,6 +333,72 @@ def record_disk_bytes(component: str, nbytes) -> None:
               float(nbytes), component=str(component))
 
 
+def record_solve_health(phase: str, residual_max, residual_med,
+                        nonfinite_lanes, cond_max=None,
+                        iters_max=None) -> None:
+    """Publish one batch's solve-health summary (the opt-in
+    ``RAFT_TPU_HEALTH=1`` hot-path telemetry): worst/median per-lane
+    relative residual ``‖Z·Xi − F‖/‖F‖``, the count of lanes whose
+    response went non-finite, and optionally the impedance conditioning
+    proxy and drag fixed-point iteration ceiling.  ``phase`` is the
+    producing pipeline (``sweep`` / ``serve`` / ``optimize``) — a small
+    fixed vocabulary, so series cardinality stays bounded."""
+    gauge("raft_tpu_solve_residual_rel",
+          "per-batch relative residual of the batched RAO solve "
+          "(max/median over lanes; health mode only)").set(
+              float(residual_max), phase=str(phase), stat="max")
+    gauge("raft_tpu_solve_residual_rel",
+          "per-batch relative residual of the batched RAO solve "
+          "(max/median over lanes; health mode only)").set(
+              float(residual_med), phase=str(phase), stat="median")
+    gauge("raft_tpu_solve_nonfinite_lanes",
+          "lanes of the last batch whose response was non-finite "
+          "(health mode only)").set(
+              float(nonfinite_lanes), phase=str(phase))
+    if cond_max is not None:
+        gauge("raft_tpu_solve_condition_max",
+              "max conditioning proxy of the batched impedance over "
+              "lanes and frequencies (health mode only)").set(
+                  float(cond_max), phase=str(phase))
+    if iters_max is not None:
+        gauge("raft_tpu_solve_drag_iters_max",
+              "max drag fixed-point iterations over the batch "
+              "(health mode only)").set(
+                  float(iters_max), phase=str(phase))
+
+
+def record_devprof(facts: dict) -> None:
+    """Publish one compiled program's device profile
+    (``obs.devprof``): compile seconds, roofline arithmetic intensity,
+    buffer bytes and the device-memory watermark delta, all labeled by
+    kernel name (one series per AOT program — bounded)."""
+    kernel = str(facts.get("kernel", "kernel"))
+    if facts.get("compile_s") is not None:
+        gauge("raft_tpu_devprof_compile_seconds",
+              "wall seconds spent compiling the program (AOT "
+              "lower→compile at the exec-cache miss)").set(
+                  float(facts["compile_s"]), kernel=kernel)
+    if facts.get("arithmetic_intensity") is not None:
+        gauge("raft_tpu_devprof_arithmetic_intensity",
+              "static-HLO flops / bytes_accessed of the program "
+              "(roofline x-axis)").set(
+                  float(facts["arithmetic_intensity"]), kernel=kernel)
+    for key, help in (("argument_bytes", "argument buffer bytes of the "
+                       "compiled program (memory_analysis)"),
+                      ("output_bytes", "output buffer bytes of the "
+                       "compiled program (memory_analysis)"),
+                      ("temp_bytes", "temporary buffer bytes of the "
+                       "compiled program (memory_analysis)")):
+        if facts.get(key) is not None:
+            gauge(f"raft_tpu_devprof_{key}", help).set(
+                float(facts[key]), kernel=kernel)
+    if facts.get("peak_bytes_delta") is not None:
+        gauge("raft_tpu_devprof_peak_bytes_delta",
+              "device allocator peak-watermark growth across the "
+              "compile (absent on CPU)").set(
+                  float(facts["peak_bytes_delta"]), kernel=kernel)
+
+
 def record_exec_cache_event(event: str):
     """Count a persistent executable-cache event (hit/miss/store/error),
     from ``parallel.exec_cache`` — also streamed to the flight recorder

@@ -289,6 +289,28 @@ def facts_from_manifest(doc: dict) -> dict:
                   "warm_start_digest_mismatch"):
             if _num(storm.get(k)) is not None:
                 facts[f"serve_{k}"] = storm[k]
+    # batched solve-health facts (parallel/sweep.py health mode; facts
+    # exist only on RAFT_TPU_HEALTH=1 rows — default runs skip the two
+    # solve-health SLO rules below)
+    sh = extra.get("solve_health") or {}
+    if isinstance(sh, dict):
+        for k in ("residual_rel_max", "residual_rel_median", "cond_max",
+                  "nonfinite_lanes", "iters_max", "lanes"):
+            if _num(sh.get(k)) is not None:
+                facts[f"solve_{k}"] = sh[k]
+    # program-level device profile (obs/devprof.py): one fact set per
+    # compiled kernel — the roofline/compile-cost series `obsctl
+    # regress` trends per program
+    dp = extra.get("devprof") or {}
+    if isinstance(dp, dict):
+        for kernel, kf in sorted(dp.items()):
+            if not isinstance(kf, dict):
+                continue
+            for k in ("compile_s", "flops", "bytes_accessed",
+                      "arithmetic_intensity", "argument_bytes",
+                      "output_bytes", "temp_bytes", "peak_bytes_delta"):
+                if _num(kf.get(k)) is not None:
+                    facts[f"devprof_{kernel}_{k}"] = kf[k]
     # probe-channel volume (its own budget, distinct from transfers):
     # the embedded metrics snapshot is process-cumulative, so subtract
     # the baseline RunManifest.begin recorded for THIS run
@@ -389,6 +411,16 @@ class TrendStore:
         with self._connect() as con:
             return int(con.execute(
                 "SELECT COUNT(*) FROM runs").fetchone()[0])
+
+    def append_rows(self, rows: list[dict]) -> int:
+        """Upsert pre-built row dicts (the ``obsctl trend --import``
+        backfill path: snapshot-derived history that never had a
+        manifest).  Returns rows written."""
+        if rows:
+            with self._connect() as con:
+                con.executemany(self._INSERT,
+                                [self._row_values(r) for r in rows])
+        return len(rows)
 
     def ingest(self, paths: list[str]) -> int:
         """Load manifest JSON files and/or JSONL row files (the
@@ -558,6 +590,20 @@ DEFAULT_SLO_RULES = [
     {"name": "optimize_grad_nonfinite_ratio",
      "fact": "optimize_grad_nonfinite_ratio", "agg": "max", "op": "<=",
      "threshold": 0.0, "window": 20},
+    # -- batched solve-health gates (parallel/sweep.py health mode;
+    # facts exist only on RAFT_TPU_HEALTH=1 rows — default runs skip).
+    # Zero tolerance on non-finite lanes: a lane whose response went
+    # NaN/Inf past the quarantine ladder is never acceptable on a
+    # healthy model.  The residual bound is loose against f64 solver
+    # accuracy (~1e-15 on OC3) but far below any physically-meaningful
+    # drift — a residual above it means the linear solve itself (not
+    # the drag model) degraded.
+    {"name": "solve_nonfinite_lanes",
+     "fact": "solve_nonfinite_lanes", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
+    {"name": "solve_residual_rel_max", "kind": "sweep_cases",
+     "fact": "solve_residual_rel_max", "agg": "max", "op": "<=",
+     "threshold": 1e-6, "window": 20},
     # -- distributed-tracing gate (obs/traceview.py; fact present only
     # on rows appended by `obsctl trace --trend-db` / the failover
     # soak — ordinary runs skip).  Zero-tolerance: an orphan span is a
@@ -649,6 +695,131 @@ def evaluate_slo(rows: list[dict], rules: list[dict] = None) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# statistical regression sentinel (obsctl regress)
+# ---------------------------------------------------------------------------
+
+#: facts that describe WHAT a row measured rather than how it
+#: performed: rows only ever compare against history with the same
+#: (kind, fingerprint-facts) identity, and the fingerprint facts
+#: themselves are never drift-checked — a topology/precision/metric
+#: change starts a NEW baseline instead of tripping the old one.
+FINGERPRINT_FACTS = (
+    "mesh", "mesh_devices", "solve_precision", "serve_mode",
+    "optimize_method", "bench_metric", "cases_total", "nw",
+    "optimize_nlanes", "optimize_steps", "n_devices",
+)
+
+#: bookkeeping facts whose run-to-run movement is expected (cache
+#: warmth flips on the first run of a process, resume points depend on
+#: where a preemption landed) — excluded from drift checks
+_REGRESS_SKIP = (
+    "exec_cache_warm", "optimize_exec_cache_warm", "probe_events",
+    "resumed_from_step", "ckpt_resumed_from_step",
+    "optimize_resumed_from_step",
+)
+
+
+def _regress_fingerprint(row: dict) -> str:
+    facts = row.get("facts") or {}
+    return json.dumps([(k, facts[k]) for k in FINGERPRINT_FACTS
+                       if k in facts], default=str)
+
+
+def _waived(waivers, kind: str, fact: str) -> bool:
+    for w in waivers or []:
+        if isinstance(w, str):
+            if w == fact or w == f"{kind}:{fact}":
+                return True
+        elif isinstance(w, dict):
+            if (w.get("fact") == fact
+                    and w.get("kind") in (None, "", kind)):
+                return True
+    return False
+
+
+def evaluate_regression(rows: list[dict], *, min_history: int = 3,
+                        nsigma: float = 4.0, rel_floor: float = 0.05,
+                        abs_floor: float = 1e-12,
+                        waivers: list = None) -> dict:
+    """Statistical drift detection over trend rows (newest first, as
+    :meth:`TrendStore.rows` returns them) — no hand-set thresholds.
+
+    Rows group by ``(kind, fingerprint)`` where the fingerprint is the
+    row's :data:`FINGERPRINT_FACTS` subset (topology / precision /
+    batch identity): a number is only ever compared against history
+    that measured the same thing.  Within each group the NEWEST row is
+    the candidate and the older rows are the baseline; every numeric
+    fact of the candidate with at least ``min_history`` baseline
+    samples is tested two-sided against a rolling median/MAD noise
+    band::
+
+        |x - median| > max(nsigma * 1.4826 * MAD,
+                           rel_floor * |median|, abs_floor)
+
+    (1.4826·MAD is the robust sigma estimate; ``rel_floor`` keeps a
+    dead-flat baseline — MAD 0 — from flagging sub-percent jitter, and
+    ``abs_floor`` absorbs float noise around 0).  ``waivers`` silences
+    known-accepted drifts: entries are ``"fact"`` / ``"kind:fact"``
+    strings or ``{"kind", "fact"}`` dicts.
+
+    Returns ``{"ok", "regressions": [...], "groups": [...],
+    "checked"}``; ``ok`` is False iff any unwaived fact drifted."""
+    groups: dict = {}
+    order = []
+    for r in rows:
+        gkey = (r.get("kind"), _regress_fingerprint(r))
+        if gkey not in groups:
+            order.append(gkey)
+        groups.setdefault(gkey, []).append(r)
+    regressions, census = [], []
+    checked = 0
+    for gkey in order:
+        kind, fp = gkey
+        grows = [r for r in groups[gkey] if r.get("status") == "ok"]
+        info = {"kind": kind, "fingerprint": fp, "rows": len(grows),
+                "facts_checked": 0}
+        if len(grows) < int(min_history) + 1:
+            info["skipped"] = "insufficient history"
+            census.append(info)
+            continue
+        cand, base = grows[0], grows[1:]
+        info["candidate"] = cand.get("run_id")
+        cfacts = cand.get("facts") or {}
+        for fact in sorted(cfacts):
+            x = _num(cfacts.get(fact))
+            if x is None or fact in FINGERPRINT_FACTS \
+                    or fact in _REGRESS_SKIP:
+                continue
+            vals = [float(v) for v in
+                    (_num((r.get("facts") or {}).get(fact))
+                     for r in base) if v is not None]
+            if len(vals) < int(min_history):
+                continue
+            vs = sorted(vals)
+            med = vs[len(vs) // 2] if len(vs) % 2 else \
+                0.5 * (vs[len(vs) // 2 - 1] + vs[len(vs) // 2])
+            devs = sorted(abs(v - med) for v in vals)
+            mad = devs[len(devs) // 2] if len(devs) % 2 else \
+                0.5 * (devs[len(devs) // 2 - 1] + devs[len(devs) // 2])
+            band = max(float(nsigma) * 1.4826 * mad,
+                       float(rel_floor) * abs(med), float(abs_floor))
+            info["facts_checked"] += 1
+            checked += 1
+            if abs(float(x) - med) > band:
+                finding = {"kind": kind, "fact": fact,
+                           "value": float(x), "median": med,
+                           "mad": mad, "band": band, "n": len(vals),
+                           "run_id": cand.get("run_id"),
+                           "fingerprint": fp,
+                           "waived": _waived(waivers, kind, fact)}
+                regressions.append(finding)
+        census.append(info)
+    return {"ok": not any(not f["waived"] for f in regressions),
+            "regressions": regressions, "groups": census,
+            "checked": checked}
+
+
+# ---------------------------------------------------------------------------
 # live-metrics evaluation (obsctl slo --url against obsctl serve)
 # ---------------------------------------------------------------------------
 
@@ -661,6 +832,14 @@ def parse_prometheus(text: str) -> dict:
     sample = re.compile(
         r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(-?[\d.eE+-]+|NaN)$")
     label = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+    def unescape(v: str) -> str:
+        # single pass so escape pairs cannot recombine (the exposition
+        # format escapes \ " and newline in label values)
+        return re.sub(r"\\(.)",
+                      lambda m: {"n": "\n"}.get(m.group(1), m.group(1)),
+                      v)
+
     out: dict = {}
     for line in text.splitlines():
         line = line.strip()
@@ -670,7 +849,7 @@ def parse_prometheus(text: str) -> dict:
         if not m:
             continue
         name, labelstr, value = m.groups()
-        labels = {k: v.replace('\\"', '"').replace("\\\\", "\\")
+        labels = {k: unescape(v)
                   for k, v in label.findall(labelstr or "")}
         try:
             out.setdefault(name, []).append((labels, float(value)))

@@ -732,12 +732,17 @@ def _aot_program(fn_jitted, args, key_facts: dict, ckpt_fact: dict,
         probe_gate = (obs.probes.suppress("aot-exported program")
                       if key is not None else contextlib.nullcontext())
         with obs.span(span_name), probe_gate:
-            compiled[0] = fn_jitted.lower(*args).compile()
+            lowered = fn_jitted.lower(*args)
+            prof = obs.devprof.start(span_name)
+            compiled[0] = lowered.compile()
+            devprof_facts = prof.finish(lowered=lowered,
+                                        compiled=compiled[0])
         if key is not None:
             with obs.probes.suppress("aot-exported program"):
                 exec_cache.store(fn_jitted, args, key,
                                  meta={"fn": "optimize",
-                                       "ckpt": ckpt_fact})
+                                       "ckpt": ckpt_fact,
+                                       "devprof": devprof_facts})
         return compiled[0]
 
     def call(*a):
@@ -1006,6 +1011,7 @@ def optimize_designs(base, space: DesignSpace, objective=None,
                     if isinstance(v, (int, float, str, bool))})
             ckpt_every = int(checkpoint_every or 0)
             ckpt_info = None
+            devprof_facts = None
             t0 = _time.perf_counter()
             if ckpt_every > 0:
                 # chunked outer loop: every segment is the same
@@ -1037,6 +1043,8 @@ def optimize_designs(base, space: DesignSpace, objective=None,
                 sp.set(exec_cache=cache_info["state"])
                 out = None
                 if exe is not None:
+                    devprof_facts = (exec_cache.load_meta(key)
+                                     or {}).get("devprof")
                     try:
                         with obs.span("optimize_execute", cached=True):
                             out = exe.call(x0)
@@ -1056,8 +1064,11 @@ def optimize_designs(base, space: DesignSpace, objective=None,
                         else contextlib.nullcontext())
                     with obs.span("optimize_lower"), probe_gate:
                         lowered = jitted.lower(x0)
+                    prof = obs.devprof.start("optimize_descent")
                     with obs.span("optimize_compile"):
                         compiled = lowered.compile()
+                    devprof_facts = prof.finish(lowered=lowered,
+                                                compiled=compiled)
                     with obs.span("optimize_execute"):
                         out = compiled(x0)
                         jax.block_until_ready(out["x"])
@@ -1067,7 +1078,9 @@ def optimize_designs(base, space: DesignSpace, objective=None,
                                     "aot-exported program"):
                             exec_cache.store(jitted, (x0,), key,
                                              meta={"fn": "optimize",
-                                                   "nlanes": nlanes})
+                                                   "nlanes": nlanes,
+                                                   "devprof":
+                                                       devprof_facts})
             wall_s = _time.perf_counter() - t0
             out = dict(out)
             if npad:
@@ -1138,6 +1151,34 @@ def optimize_designs(base, space: DesignSpace, objective=None,
                     ckpt_store.delete(ckpt_key)
             sp.set(best=result["f_best"], converged=int(conv.sum()),
                    nonfinite=n_bad)
+            if _config.health_enabled():
+                # health mode repackages the descent summary that is
+                # already pulled (no program fork here): the descent's
+                # "residual" is its projected gradient norm, and the
+                # nonfinite count is the frozen-lane census
+                gn_fin = gnorm[np.isfinite(gnorm)]
+                gn_max = float(gn_fin.max()) if gn_fin.size else 0.0
+                gn_med = float(np.median(gn_fin)) if gn_fin.size else 0.0
+                health_info = {
+                    "residual_rel_max": gn_max,
+                    "residual_rel_median": gn_med,
+                    "nonfinite_lanes": n_bad,
+                    "iters_max": int(iters.max(initial=0)),
+                    "lanes": nlanes,
+                    "worst_lane": (int(np.flatnonzero(bad)[0]) if n_bad
+                                   else int(np.argmax(np.where(
+                                       np.isfinite(gnorm), gnorm,
+                                       -np.inf))))}
+                obs.record_solve_health(
+                    "optimize", gn_max, gn_med, n_bad,
+                    iters_max=health_info["iters_max"])
+                obs.events.emit(
+                    "solve_health", phase="optimize",
+                    worst_lane=health_info["worst_lane"],
+                    residual_rel_max=gn_max, nonfinite_lanes=n_bad)
+                result["provenance"]["solve_health"] = health_info
+                manifest.extra["solve_health"] = health_info
+                sp.set(health_nonfinite=n_bad)
             obs.gauge(
                 "raft_tpu_optimize_lanes",
                 "descent lanes of the most recent batched design "
@@ -1147,6 +1188,7 @@ def optimize_designs(base, space: DesignSpace, objective=None,
                 "lanes whose projected descent met the gradient "
                 "tolerance").set(int(conv.sum()), method=method)
             manifest.extra["exec_cache"] = cache_info
+            obs.devprof.attach(manifest, devprof_facts)
             manifest.extra["optimize"] = {
                 "nlanes": nlanes, "steps": int(steps),
                 "method": method,

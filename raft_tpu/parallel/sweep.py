@@ -124,7 +124,8 @@ def unrolled_fixed_point(step, Xi0, nIter, tol, chunk: int = 2,
 
 def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
                      XiStart: float = 0.1, r6=None, fp_chunk: int = 2,
-                     relax: float = 0.8, mesh: Mesh = None):
+                     relax: float = 0.8, mesh: Mesh = None,
+                     health: bool = False):
     """Pure per-case response solver (no aero; wave loading) suitable for
     jit/vmap.  Returns fn(Hs, Tp, beta_rad) -> dict(Xi (6,nw) complex,
     std (6,)).
@@ -134,7 +135,18 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
     statics->dynamics boundary (partition.STATE_RULES) and gathers the
     response back to frequency-replicated before any reduction over
     frequency — so the sharded program's summation order, and therefore
-    its output, is bitwise-identical to the unsharded one."""
+    its output, is bitwise-identical to the unsharded one.
+
+    ``health`` (the ``RAFT_TPU_HEALTH=1`` hot-path telemetry) makes the
+    batched program additionally return per-lane solver-health arrays —
+    ``health_residual`` (relative residual of the linear RAO solve at
+    the final drag iterate, the batched twin of the serial path's
+    ``_dyn_solve_core`` residual) and ``health_cond`` (max conditioning
+    proxy of the impedance over the frequency stack).  The returned
+    ``Xi``/``std`` are computed by the exact same ops in the exact same
+    order — health only *adds* outputs, so physics stays bitwise
+    identical — but the program shape changes, which is why the
+    exec-cache key forks on it."""
     from raft_tpu.parallel import partition
     if fowt.potSecOrder > 0:
         import warnings
@@ -237,6 +249,39 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         _, Xi, done, iters, chunks = unrolled_fixed_point(
             lambda XiLast: drag_step(st, XiLast), Xi0, nIter, tol,
             chunk=fp_chunk, relax=relax)
+        health_out = {}
+        if health:
+            # One extra linearization + linear solve at the final
+            # iterate: this measures the LINEAR RAO solve the way the
+            # serial path's _dyn_solve_core does.  (The fixed point
+            # itself only converges to `tol`, so a residual of the
+            # returned Xi against its own re-linearized system would be
+            # O(tol) — drag-model convergence, not solver accuracy.)
+            B6_h, Bmat_h = fowt_hydro_linearization_pre(
+                fowt, st["pose"], st["drag_pre"], Xi)
+            F_drag_h = fowt_drag_excitation(fowt, st["pose"], Bmat_h,
+                                            st["u0"])
+            B_h = B6_h[..., None] + st["B_BEM"]
+            F_h = st["F_lin"] + F_drag_h
+            Xi_h = impedance_solve(w, st["M_lin"], B_h, st["C_lin"], F_h)
+            Z_h = (-(w ** 2) * st["M_lin"] + 1j * w * B_h
+                   + st["C_lin"][..., None]).astype(Xi_h.dtype)
+            R_h = jnp.einsum("...ijw,...jw->...iw", Z_h, Xi_h) - F_h
+            num = jnp.sqrt(jnp.sum(jnp.abs(R_h) ** 2, axis=(-2, -1)))
+            den = jnp.sqrt(jnp.sum(jnp.abs(F_h) ** 2, axis=(-2, -1)))
+            # conditioning proxy over the frequency stack, with the
+            # _cond_core identity substitution so one singular bin
+            # reports inf instead of poisoning the lane's whole stack
+            Zs = jnp.moveaxis(Z_h, -1, -3)
+            bin_ok = jnp.all(jnp.isfinite(Zs.real) & jnp.isfinite(Zs.imag),
+                             axis=(-2, -1))
+            eye = jnp.eye(Zs.shape[-1], dtype=Zs.dtype)
+            conds = jnp.linalg.cond(
+                jnp.where(bin_ok[..., None, None], Zs, eye))
+            health_out = dict(
+                health_residual=num / (den + 1e-300),
+                health_cond=jnp.max(
+                    jnp.where(bin_ok, conds, jnp.inf), axis=-1))
         if partition.has_freq_axis(mesh):
             # gather the frequency axis BEFORE the spectral reduction so
             # per-device summation order matches the unsharded program
@@ -249,7 +294,7 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         probes.probe("sweep_lanes", finite=_lane_finite(Xi),
                      converged=done, iters=iters)
         return dict(Xi=Xi, std=std, converged=done, iters=iters,
-                    fp_chunks=chunks)
+                    fp_chunks=chunks, **health_out)
 
     solve.batched = solve_batched
     # introspection hook: the per-case state pytree at the
@@ -262,6 +307,45 @@ def _lane_finite(Xi):
     """(ncases,) bool device array: lane has an all-finite response."""
     return jnp.all(jnp.isfinite(Xi.real) & jnp.isfinite(Xi.imag),
                    axis=(-2, -1))
+
+
+def _health_summary(phase, residual, cond, lane_ok, iters) -> dict:
+    """Fold one batch's pulled per-lane health arrays into JSON-safe
+    summary facts, the ``raft_tpu_solve_*`` gauges, and a worst-lane
+    flight-recorder event.  Non-finite lanes are excluded from the
+    residual/conditioning aggregates (they are counted — and
+    zero-tolerance SLO-gated — as ``nonfinite_lanes``), so every fact
+    stays finite and serializable."""
+    from raft_tpu import obs
+
+    residual = np.asarray(residual, float)
+    cond = np.asarray(cond, float)
+    lane_ok = np.asarray(lane_ok, bool)
+    iters = np.asarray(iters)
+    nonfinite = int(np.count_nonzero(~lane_ok))
+    res_fin = residual[np.isfinite(residual)]
+    cond_fin = cond[np.isfinite(cond)]
+    res_max = float(res_fin.max()) if res_fin.size else 0.0
+    res_med = float(np.median(res_fin)) if res_fin.size else 0.0
+    cond_max = float(cond_fin.max()) if cond_fin.size else 0.0
+    iters_max = int(iters.max(initial=0))
+    if nonfinite:
+        worst = int(np.flatnonzero(~lane_ok)[0])
+    elif residual.size:
+        worst = int(np.argmax(np.where(np.isfinite(residual),
+                                       residual, np.inf)))
+    else:
+        worst = -1
+    facts = {"residual_rel_max": res_max, "residual_rel_median": res_med,
+             "cond_max": cond_max, "nonfinite_lanes": nonfinite,
+             "iters_max": iters_max, "lanes": int(residual.size),
+             "worst_lane": worst}
+    obs.record_solve_health(phase, res_max, res_med, nonfinite,
+                            cond_max=cond_max, iters_max=iters_max)
+    obs.events.emit("solve_health", phase=str(phase), worst_lane=worst,
+                    residual_rel_max=res_max, cond_max=cond_max,
+                    nonfinite_lanes=nonfinite)
+    return facts
 
 
 def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
@@ -309,12 +393,17 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
 
     t0 = _time.perf_counter()
     ncases = int(ncases)
+    # resolve the health fork BEFORE kw feeds the cache-key facts: the
+    # key must stay byte-identical to pre-health builds when health is
+    # off (a `health: False` entry would rotate every warm program)
+    health = kw.pop("health", None)
+    health = _config.health_enabled() if health is None else bool(health)
     if mesh is not None:
         # the warm program's batch shape is fixed: bake the pad-to-
         # shard-multiple in once and let the service pad (repeat-last-
         # lane, stripped from results) up to it
         ncases += (-ncases) % partition.batch_size(mesh)
-    solver = make_case_solver(fowt, mesh=mesh, **kw)
+    solver = make_case_solver(fowt, mesh=mesh, health=health, **kw)
     nw = len(fowt.w)
     xistart = float(kw.get("XiStart", 0.1))
     if warm_start:
@@ -374,10 +463,12 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
                 if isinstance(v, (int, float, str, bool))},
             kw_arrays=exec_cache.model_digest(
                 {k: v for k, v in kw.items()
-                 if not isinstance(v, (int, float, str, bool))}))
+                 if not isinstance(v, (int, float, str, bool))}),
+            **({"health": True} if health else {}))
         exe = exec_cache.load(key, memo=True)
         cache_state = "hit" if exe is not None else "miss"
     compiled = None
+    devprof_facts = None
     if exe is None:
         # cacheable programs are traced with probes suppressed so the
         # stored export is host-callback-free (same stance as
@@ -385,12 +476,22 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
         probe_gate = (obs.probes.suppress("aot-exported program")
                       if key is not None else contextlib.nullcontext())
         with obs.span("serve_build", ncases=int(ncases)), probe_gate:
-            compiled = batched.lower(*args).compile()
+            lowered = batched.lower(*args)
+            prof = obs.devprof.start("sweep_serve")
+            compiled = lowered.compile()
+            devprof_facts = prof.finish(lowered=lowered,
+                                        compiled=compiled)
             if key is not None:
                 exec_cache.store(batched, args, key,
                                  meta={"fn": "sweep_serve",
                                        "ncases": int(ncases),
-                                       "nw": len(fowt.w)})
+                                       "nw": len(fowt.w),
+                                       "health": health,
+                                       "devprof": devprof_facts})
+    elif key is not None:
+        # warm hit: the original compile's device profile rides the
+        # meta sidecar — recover it without recompiling anything
+        devprof_facts = (exec_cache.load_meta(key) or {}).get("devprof")
 
     def run(Hs, Tp, beta, Xi0=None):
         Hs, Tp, beta = _place(jnp.asarray(Hs, dtype),
@@ -419,6 +520,8 @@ def make_batch_runner(fowt: FOWTModel, ncases: int, warmup: bool = True,
     run.cache_state = cache_state
     run.key = key
     run.mesh = mesh
+    run.health = health
+    run.devprof = devprof_facts
     run.warm_start = bool(warm_start)
     run.nw = int(nw)
     run.xistart = xistart
@@ -665,6 +768,10 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
     from raft_tpu.ops import linalg as _linalg
     from raft_tpu.parallel import exec_cache, partition
 
+    # resolve the health fork BEFORE kw feeds the cache-key facts or the
+    # manifest config: default-path keys stay byte-identical to seed
+    health = kw.pop("health", None)
+    health = _config.health_enabled() if health is None else bool(health)
     ncases = int(jnp.asarray(Hs).shape[0])
     mesh_info = partition.mesh_facts(mesh)
     manifest = obs.RunManifest.begin(kind="sweep_cases", config={
@@ -672,6 +779,7 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
         "sharded": mesh is not None,
         "mesh_devices": 0 if mesh is None else int(mesh.devices.size),
         "mesh": mesh_info,
+        **({"health": True} if health else {}),
         **{k: v for k, v in kw.items() if isinstance(v, (int, float, str))}})
     obs.record_build_info(run_id=manifest.run_id)
     obs.device.jit_cache_delta(scope="sweep_cases")      # delta baseline
@@ -682,7 +790,8 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
         with obs.span("sweep_cases", ncases=ncases,
                       sharded=mesh is not None) as sp:
             with obs.span("sweep_build", ncases=ncases):
-                solver = make_case_solver(fowt, mesh=mesh, **kw)
+                solver = make_case_solver(fowt, mesh=mesh, health=health,
+                                          **kw)
                 batched = jax.jit(solver.batched)
                 Hs = jnp.asarray(Hs, float)
                 Tp = jnp.asarray(Tp, float)
@@ -732,11 +841,15 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                         kw_arrays=exec_cache.model_digest(
                             {k: v for k, v in kw.items()
                              if not isinstance(v, (int, float, str,
-                                                   bool))}))
+                                                   bool))}),
+                        # conditional so the health=off key is byte-
+                        # identical to every pre-health build
+                        **({"health": True} if health else {}))
                 exe = exec_cache.load(key)
                 cache_info = {"state": "hit" if exe is not None else "miss",
                               "key": key}
             out = None
+            devprof_facts = None
             if exe is not None:
                 try:
                     with obs.span("sweep_execute", ncases=ncases,
@@ -769,9 +882,14 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                               else contextlib.nullcontext())
                 with obs.span("sweep_lower", ncases=ncases), probe_gate:
                     lowered = batched.lower(Hs, Tp, beta)
-                    obs.device.cost_analysis(lowered, kernel="sweep_batched")
+                # devprof: compile wall time + static cost analysis +
+                # buffer bytes + device watermark delta, one facts dict
+                # per kernel (manifests, cache sidecar, trend store)
+                prof = obs.devprof.start("sweep_batched")
                 with obs.span("sweep_compile", ncases=ncases):
                     compiled = lowered.compile()
+                devprof_facts = prof.finish(lowered=lowered,
+                                            compiled=compiled)
                 with obs.span("sweep_execute", ncases=ncases):
                     out = compiled(Hs, Tp, beta)
                     jax.block_until_ready(out["std"])
@@ -782,7 +900,8 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                             batched, (Hs, Tp, beta), key,
                             meta={"fn": "sweep_cases", "ncases": ncases,
                                   "nw": len(fowt.w),
-                                  "solver": _linalg.last_dispatch()})
+                                  "solver": _linalg.last_dispatch(),
+                                  "devprof": devprof_facts})
                     cache_info["stored"] = stored is not None
             if npad:
                 # strip the masked pad lanes BEFORE any summary pull,
@@ -816,11 +935,17 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                     out["converged"] = out["converged"].at[ij].set(False)
             # ONE sanctioned counted pull for the batch summary facts
             # (the response stds stay on device until the ledger
-            # digest); the per-lane finite flags ride in the same pull
-            iters, conv_np, chunks_np, lane_ok = obs.transfers.device_get(
-                (out["iters"], out["converged"], out["fp_chunks"],
-                 _lane_finite(out["Xi"])),
-                what="sweep_summary", phase="sweep")
+            # digest); the per-lane finite flags — and, in health mode,
+            # the residual/conditioning lanes — ride in the same pull
+            pull = (out["iters"], out["converged"], out["fp_chunks"],
+                    _lane_finite(out["Xi"]))
+            if health:
+                pull = pull + (out["health_residual"], out["health_cond"])
+            pulled = obs.transfers.device_get(
+                pull, what="sweep_summary", phase="sweep")
+            iters, conv_np, chunks_np, lane_ok = pulled[:4]
+            health_res = np.asarray(pulled[4]) if health else None
+            health_cond = np.asarray(pulled[5]) if health else None
             iters = np.asarray(iters).copy()
             conv_np = np.asarray(conv_np).copy()
             # ----- batch quarantine: re-solve only the offending lanes
@@ -884,6 +1009,14 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
                 "sweep lanes the batch-quarantine ladder could not "
                 "recover (left NaN in the batch outputs)").set(float(
                     len((quarantine_info or {}).get("quarantined", []))))
+            health_info = None
+            if health:
+                health_info = _health_summary(
+                    "sweep", health_res, health_cond,
+                    np.asarray(lane_ok), iters)
+                sp.set(health_residual_max=health_info[
+                           "residual_rel_max"],
+                       health_nonfinite=health_info["nonfinite_lanes"])
         manifest.extra["exec_cache"] = cache_info
         if mesh_info is not None:
             manifest.extra["partition"] = {
@@ -898,8 +1031,14 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
         # executable carries the backend that was baked into it
         solver = _linalg.last_dispatch()
         if cache_info["state"] == "hit":
-            solver = (exec_cache.load_meta(key) or {}).get("solver", solver)
+            meta = exec_cache.load_meta(key) or {}
+            solver = meta.get("solver", solver)
+            # the original compile's device profile rides the sidecar
+            devprof_facts = meta.get("devprof")
         manifest.extra["solver"] = solver
+        obs.devprof.attach(manifest, devprof_facts)
+        if health_info is not None:
+            manifest.extra["solve_health"] = health_info
         manifest.extra["fixed_point"] = {"chunks_run": fp_chunks,
                                          "iters_max": int(
                                              iters.max(initial=0))}

@@ -377,6 +377,7 @@ class SweepService:
         #: did the latest _ensure_runner acquisition build (cold) or
         #: reuse (warm)?  Read only by the batch worker that just called
         self._runner_was_cold = False
+        self._last_health = None
         #: observed cold-start iteration baseline (EMA over unseeded
         #: lanes) — what non-audited warm batches report savings against
         self._cold_iters_ema: float | None = None
@@ -1690,13 +1691,30 @@ class SweepService:
             t_solve0 = time.monotonic()
             for r in batch:
                 r.t_solve0 = t_solve0
+            self._last_health = None
             with obs.span("serve_batch", n=n, mode=solve_mode,
                           batch_id=batch_id,
                           trace_ids=",".join(r.trace.trace_id
-                                             for r in batch)):
+                                             for r in batch)) as bsp:
                 std, iters, conv, xi = self._solve_lanes(
                     runner, batch, batch_id, Hs, Tp, beta, n, ncases,
                     solve_mode)
+                # health mode: per-lane arrays from the delivered
+                # solve's pull (the LAST pull _solve_lanes made)
+                health = self._last_health
+                hsum = None
+                if health is not None:
+                    from raft_tpu.parallel.sweep import _health_summary
+                    hsum = _health_summary(
+                        "serve", health["health_residual"],
+                        health.get("health_cond",
+                                   np.zeros(0, float)),
+                        np.isfinite(np.asarray(std, float)
+                                    ).all(axis=-1),
+                        iters)
+                    bsp.set(health_residual_max=hsum[
+                                "residual_rel_max"],
+                            health_nonfinite=hsum["nonfinite_lanes"])
             t_solved = time.monotonic()
             for r in batch:
                 r.t_solved = t_solved
@@ -1731,10 +1749,26 @@ class SweepService:
                     std[i] = np.nan
                     continue
                 if np.all(np.isfinite(std[i])):
+                    hrow = None
+                    if health is not None and i < len(
+                            health["health_residual"]):
+                        res_i = float(health["health_residual"][i])
+                        cond_i = (float(health["health_cond"][i])
+                                  if "health_cond" in health else None)
+                        hrow = {
+                            "residual_rel": (res_i if np.isfinite(res_i)
+                                             else None),
+                            "cond": (cond_i if cond_i is not None
+                                     and np.isfinite(cond_i) else None),
+                            "batch_residual_rel_max":
+                                hsum["residual_rel_max"],
+                            "batch_nonfinite_lanes":
+                                hsum["nonfinite_lanes"]}
                     self._complete(r, std[i], int(iters[i]),
                                    bool(conv[i]), solve_mode,
                                    xi_row=(xi[i] if xi is not None
-                                           else None))
+                                           else None),
+                                   health=hrow)
                 else:
                     self._retry_or_fail(r, errors.NonFiniteResult(
                         "non-finite response lane", case=r.seq))
@@ -1794,18 +1828,33 @@ class SweepService:
     def _pull(self, out, n: int, with_xi: bool):
         """The sanctioned counted host pull of one batch's outputs
         (PR 4 discipline: one pull per solve; an audited warm batch
-        performs two solves and therefore two pulls)."""
+        performs two solves and therefore two pulls).  When the runner
+        was built in health mode its output dict carries the per-lane
+        solver-health arrays — they ride the SAME pull (no extra
+        transfer) and land on ``self._last_health`` for the batch
+        worker that just called (the ``_runner_was_cold`` pattern)."""
         obs = self._obs()
+        hkeys = [k for k in ("health_residual", "health_cond")
+                 if k in out]
+        extras = tuple(out[k] for k in hkeys)
         if with_xi:
-            std, iters, conv, xi = obs.transfers.device_get(
-                (out["std"], out["iters"], out["converged"], out["Xi"]),
+            pulled = obs.transfers.device_get(
+                (out["std"], out["iters"], out["converged"], out["Xi"])
+                + extras,
                 what="serve_batch", phase="serve")
+            std, iters, conv, xi = pulled[:4]
+            rest = pulled[4:]
             xi = np.asarray(xi)[:n]
         else:
-            std, iters, conv = obs.transfers.device_get(
-                (out["std"], out["iters"], out["converged"]),
+            pulled = obs.transfers.device_get(
+                (out["std"], out["iters"], out["converged"]) + extras,
                 what="serve_batch", phase="serve")
+            std, iters, conv = pulled[:3]
+            rest = pulled[3:]
             xi = None
+        self._last_health = ({k: np.asarray(v)[:n]
+                              for k, v in zip(hkeys, rest)}
+                             if hkeys else None)
         return (np.array(std, float)[:n], np.asarray(iters)[:n],
                 np.asarray(conv)[:n], xi)
 
@@ -2040,17 +2089,23 @@ class SweepService:
                 "latency_s": time.monotonic() - r.submitted_ts}
 
     def _complete(self, r: _Request, std_row, iters: int,
-                  converged: bool, mode: str, xi_row=None):
+                  converged: bool, mode: str, xi_row=None,
+                  health: dict = None):
         obs = self._obs()
         from raft_tpu.obs.ledger import digest_metrics
         digest = digest_metrics({"std": std_row, "iters": int(iters),
                                  "converged": bool(converged)})
+        # per-lane solver-health facts (health mode only) ride the
+        # served result's provenance — NOT its digest: the digest
+        # identifies the physics, health describes how it was solved
+        prov = {"trace": r.trace.as_dict()}
+        if health is not None:
+            prov["solve_health"] = dict(health)
         res = SweepResult(ok=True, digest=digest,
                           std=[float(v) for v in std_row],
                           iters=int(iters), converged=bool(converged),
                           source="replayed" if r.replayed else "solved",
-                          extra={"provenance":
-                                 {"trace": r.trace.as_dict()}},
+                          extra={"provenance": prov},
                           **self._result_base(r, mode))
         # WAL before ack: the result (digest + payload) is durable
         # before the ticket resolves — a crash after this line loses

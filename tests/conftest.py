@@ -45,6 +45,7 @@ def _obs_isolation(monkeypatch, tmp_path):
     monkeypatch.delenv("RAFT_TPU_OBS_MAX_RUNS", raising=False)
     monkeypatch.delenv("RAFT_TPU_FAULTS", raising=False)
     monkeypatch.delenv("RAFT_TPU_RECOVERY", raising=False)
+    monkeypatch.delenv("RAFT_TPU_HEALTH", raising=False)
     monkeypatch.delenv("RAFT_TPU_TREND", raising=False)
     monkeypatch.delenv("RAFT_TPU_TREND_DB", raising=False)
     monkeypatch.delenv("RAFT_TPU_EVENTS", raising=False)

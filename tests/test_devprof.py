@@ -1,0 +1,95 @@
+"""Program-level device profiling (``obs.devprof``).
+
+Every AOT compile site stamps compile wall seconds, static-HLO
+FLOPs/bytes, buffer sizes and the device-memory watermark delta into
+one facts dict that rides the run manifest, the exec-cache meta
+sidecar, the ``raft_tpu_devprof_*`` gauges, and ``devprof_*`` trend
+facts.  All probes must degrade to absent fields — never an error —
+on builds/backends without the introspection APIs.
+"""
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs import devprof
+
+
+def test_prof_facts_from_a_real_compile():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.sin(x) @ x.T
+
+    x = np.ones((8, 8), np.float64)
+    prof = devprof.start("unit_kernel")
+    lowered = jax.jit(f).lower(x)
+    compiled = lowered.compile()
+    facts = prof.finish(lowered=lowered, compiled=compiled)
+
+    assert facts["kernel"] == "unit_kernel"
+    assert facts["compile_s"] > 0.0
+    # static cost analysis on CPU reports flops for a matmul
+    assert facts.get("flops", 0) > 0
+    if facts.get("bytes_accessed"):
+        assert facts["arithmetic_intensity"] == pytest.approx(
+            facts["flops"] / facts["bytes_accessed"])
+    # CPU devices report no memory_stats: watermark fields are absent,
+    # not zero or garbage
+    if devprof.peak_bytes() is None:
+        assert "peak_bytes_delta" not in facts
+
+    # metrics sink
+    snap = obs.snapshot()
+    series = {s["labels"]["kernel"]: s["value"]
+              for s in snap["raft_tpu_devprof_compile_seconds"]["series"]}
+    assert series["unit_kernel"] > 0.0
+
+
+def test_prof_never_raises_without_introspection():
+    prof = devprof.start("degraded")
+    facts = prof.finish(lowered=None, compiled=None)
+    assert facts["kernel"] == "degraded"
+    assert facts["compile_s"] >= 0.0
+    assert "flops" not in facts
+
+
+def test_attach_and_trend_facts():
+    man = obs.RunManifest.begin(kind="sweep_cases", devices=False)
+    devprof.attach(man, {"kernel": "sweep_batched", "compile_s": 1.25,
+                         "flops": 4.0e9, "bytes_accessed": 2.0e9,
+                         "arithmetic_intensity": 2.0,
+                         "argument_bytes": 1024})
+    man.finish("ok")
+    assert man.extra["devprof"]["sweep_batched"]["compile_s"] == 1.25
+    facts = obs.trendstore.facts_from_manifest(man.to_dict())
+    assert facts["devprof_sweep_batched_compile_s"] == 1.25
+    assert facts["devprof_sweep_batched_arithmetic_intensity"] == 2.0
+    assert facts["devprof_sweep_batched_argument_bytes"] == 1024
+    # attach(None) is a no-op fact set, never a crash
+    devprof.attach(man, None)
+
+
+def test_sweep_runner_stamps_and_recovers_devprof(tmp_path, monkeypatch):
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.models.fowt import build_fowt
+    from raft_tpu.parallel import exec_cache
+    from raft_tpu.parallel.sweep import make_batch_runner
+
+    design = load_design("Vertical_cylinder")
+    w = np.arange(0.05, 0.5, 0.1) * 2 * np.pi
+    fowt = build_fowt(design, w,
+                      depth=float(design["site"]["water_depth"]))
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_memo()
+    cold = make_batch_runner(fowt, 2, nIter=2)
+    assert cold.cache_state == "miss"
+    assert cold.devprof["kernel"] == "sweep_serve"
+    assert cold.devprof["compile_s"] > 0.0
+    # the warm build recovers the ORIGINAL compile's profile from the
+    # exec-cache meta sidecar without recompiling
+    exec_cache.reset_memo()
+    warm = make_batch_runner(fowt, 2, nIter=2)
+    assert warm.cache_state == "hit"
+    assert warm.devprof is not None
+    assert warm.devprof["compile_s"] == cold.devprof["compile_s"]

@@ -1,0 +1,44 @@
+"""``obsctl`` / ``raftlint`` piped into ``head`` must exit 0.
+
+Under ``set -o pipefail`` (the CI shell), an unguarded BrokenPipeError
+— raised when the downstream reader closes early — turns a routine
+``obsctl tail ... | head`` into a red job and a Python traceback on
+stderr.  Both CLIs guard the write AND the interpreter-shutdown flush
+of sys.stdout.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _piped(cmd: str):
+    return subprocess.run(
+        ["bash", "-c", f"set -o pipefail; {cmd}"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_obsctl_tail_into_head(tmp_path):
+    # enough rendered lines to overflow the 64 KiB pipe buffer after
+    # head exits, forcing the EPIPE on a mid-stream write
+    events = tmp_path / "sweep_pipe.events.jsonl"
+    with open(events, "w") as f:
+        for i in range(20000):
+            f.write(json.dumps({"type": "span_open", "t": 1.0 + i,
+                                "name": f"span_{i}"}) + "\n")
+    p = _piped(f"{sys.executable} tools/obsctl.py tail --spans "
+               f"{events} | head -2")
+    assert p.returncode == 0, p.stderr
+    assert "Traceback" not in p.stderr
+    assert len(p.stdout.splitlines()) == 2
+
+
+def test_raftlint_json_into_head():
+    p = _piped(f"{sys.executable} -m tools.raftlint --format json "
+               f"| head -c 64")
+    assert p.returncode == 0, p.stderr
+    assert "Traceback" not in p.stderr
+    assert p.stdout        # head got the start of the report

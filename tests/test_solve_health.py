@@ -1,0 +1,207 @@
+"""Hot-path solve-health telemetry (``RAFT_TPU_HEALTH=1`` / ``health=True``).
+
+The opt-in health mode makes the batched sweep program additionally
+emit per-lane linear-solve residuals, a conditioning proxy, and
+non-finite flags — riding the batch's existing single sanctioned
+summary pull.  These tests pin the ISSUE acceptance scenario (OC3 at
+f64: max relative residual <= 1e-8, zero non-finite lanes, facts
+visible in the span, /metrics, the manifest, and a trend row), the
+serve-layer provenance plumbing, and the cache-key discipline: with
+health OFF the exec-cache key is byte-identical to the uninstrumented
+build; health ON forks it.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu import _config, obs
+from raft_tpu.parallel import exec_cache
+from raft_tpu.parallel.sweep import sweep_cases
+
+
+@pytest.fixture(scope="module")
+def oc3_fowt():
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.models.fowt import build_fowt
+
+    design = load_design("OC3spar")
+    w = np.arange(0.05, 0.45, 0.05) * 2 * np.pi     # 8 coarse bins
+    return build_fowt(design, w,
+                      depth=float(design["site"]["water_depth"]))
+
+
+# ---------------------------------------------------------------------------
+# config knob
+# ---------------------------------------------------------------------------
+
+def test_health_knob(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_HEALTH", raising=False)
+    assert _config.health_enabled() is False         # off by default
+    monkeypatch.setenv("RAFT_TPU_HEALTH", "1")
+    assert _config.health_enabled() is True
+    monkeypatch.setenv("RAFT_TPU_HEALTH", "off")
+    assert _config.health_enabled() is False
+    monkeypatch.setenv("RAFT_TPU_HEALTH", "on")
+    assert _config.health_enabled() is True
+    try:
+        _config.set_health_mode("0")                 # override beats env
+        assert _config.health_enabled() is False
+        with pytest.raises(ValueError):
+            _config.set_health_mode("maybe")
+    finally:
+        _config.set_health_mode(None)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: OC3 at f64
+# ---------------------------------------------------------------------------
+
+def test_oc3_health_sweep_residual_and_surfaces(oc3_fowt, tmp_path):
+    obs.configure(str(tmp_path))
+    ncases = 4
+    Hs = np.array([2.0, 4.0, 6.0, 8.0])
+    Tp = np.array([8.0, 10.0, 12.0, 14.0])
+    beta = np.zeros(ncases)
+    out = sweep_cases(oc3_fowt, Hs, Tp, beta, nIter=4, health=True)
+
+    # on-device health lanes ride the batch output, unpadded
+    res = np.asarray(out["health_residual"])
+    cond = np.asarray(out["health_cond"])
+    assert res.shape == (ncases,) and cond.shape == (ncases,)
+    assert np.all(np.isfinite(res))
+    assert float(res.max()) <= 1e-8                  # f64 linear solve
+    assert np.all(np.isfinite(cond)) and np.all(cond >= 1.0)
+    # health must not perturb the physics outputs
+    assert np.all(np.isfinite(np.asarray(out["std"])))
+
+    # /metrics surface
+    snap = obs.snapshot()
+    series = {(s["labels"].get("phase"), s["labels"].get("stat")):
+              s["value"]
+              for s in snap["raft_tpu_solve_residual_rel"]["series"]}
+    assert series[("sweep", "max")] <= 1e-8
+    assert series[("sweep", "median")] <= series[("sweep", "max")]
+    nonfin = {s["labels"]["phase"]: s["value"]
+              for s in snap["raft_tpu_solve_nonfinite_lanes"]["series"]}
+    assert nonfin["sweep"] == 0.0
+    assert "raft_tpu_solve_condition_max" in snap
+    assert "raft_tpu_solve_drag_iters_max" in snap
+
+    # span surface
+    sweep_span = [e for e in obs.spans() if e["name"] == "sweep_cases"][-1]
+    assert sweep_span["attrs"]["health_residual_max"] <= 1e-8
+    assert sweep_span["attrs"]["health_nonfinite"] == 0
+
+    # manifest + trend-row surface (facts_from_manifest extraction)
+    man_paths = [p for p in os.listdir(tmp_path)
+                 if p.endswith(".manifest.json")]
+    assert len(man_paths) == 1
+    with open(tmp_path / man_paths[0]) as f:
+        man = json.load(f)
+    hinfo = man["extra"]["solve_health"]
+    assert hinfo["residual_rel_max"] <= 1e-8
+    assert hinfo["nonfinite_lanes"] == 0
+    assert hinfo["lanes"] == ncases
+    json.dumps(hinfo, allow_nan=False)               # JSON-safe always
+    rows = obs.trendstore.TrendStore(
+        str(tmp_path / "trend.sqlite")).rows()
+    assert len(rows) == 1
+    facts = rows[0]["facts"]
+    assert facts["solve_residual_rel_max"] <= 1e-8
+    assert facts["solve_nonfinite_lanes"] == 0
+
+    # flight-recorder surface: the solve_health event names a worst lane
+    ev_paths = [p for p in os.listdir(tmp_path)
+                if p.endswith(".events.jsonl")]
+    events = [json.loads(line)
+              for line in open(tmp_path / ev_paths[0])]
+    (hev,) = [e for e in events if e.get("type") == "solve_health"]
+    assert hev["phase"] == "sweep" and 0 <= hev["worst_lane"] < ncases
+
+    # the new SLO rules hold over this run's trend row
+    rep = obs.trendstore.evaluate_slo(rows)
+    by_name = {r["name"]: r for r in rep["results"]}
+    assert by_name["solve_nonfinite_lanes"]["ok"]
+    assert not by_name["solve_nonfinite_lanes"]["skipped"]
+    assert by_name["solve_residual_rel_max"]["ok"]
+
+
+def test_health_off_is_the_default(oc3_fowt):
+    out = sweep_cases(oc3_fowt, np.array([3.0]), np.array([9.0]),
+                      np.array([0.0]), nIter=2)
+    assert "health_residual" not in out
+    assert "health_cond" not in out
+
+
+# ---------------------------------------------------------------------------
+# cache-key discipline
+# ---------------------------------------------------------------------------
+
+def test_health_forks_the_exec_cache_key():
+    base = exec_cache.make_key(fn="sweep_cases", ncases=4, nw=8)
+    # health OFF adds NO fact: the default key is byte-identical to the
+    # uninstrumented build's (golden ledgers and warm caches carry over)
+    assert exec_cache.make_key(fn="sweep_cases", ncases=4, nw=8,
+                               **({})) == base
+    assert exec_cache.make_key(fn="sweep_cases", ncases=4, nw=8,
+                               health=True) != base
+
+
+def test_batch_runner_health_key_fork(oc3_fowt, tmp_path, monkeypatch):
+    from raft_tpu.parallel.sweep import make_batch_runner
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_memo()
+    r1 = make_batch_runner(oc3_fowt, 2, nIter=2)
+    assert r1.cache_state == "miss" and r1.health is False
+    r2 = make_batch_runner(oc3_fowt, 2, nIter=2, health=True)
+    assert r2.cache_state == "miss" and r2.health is True   # forked key
+    r3 = make_batch_runner(oc3_fowt, 2, nIter=2)
+    assert r3.cache_state == "hit"          # default key undisturbed
+    out = r2(np.array([2.0, 4.0]), np.array([8.0, 10.0]),
+             np.array([0.0, 0.3]))
+    res = np.asarray(out["health_residual"])
+    assert res.shape == (2,) and float(res.max()) <= 1e-8
+    ref = r3(np.array([2.0, 4.0]), np.array([8.0, 10.0]),
+             np.array([0.0, 0.3]))
+    # identical physics from the health-on program, bit for bit
+    np.testing.assert_array_equal(np.asarray(out["std"]),
+                                  np.asarray(ref["std"]))
+
+
+# ---------------------------------------------------------------------------
+# serve-layer provenance
+# ---------------------------------------------------------------------------
+
+def test_serve_result_provenance_carries_health(monkeypatch):
+    from raft_tpu.io.designs import load_design
+    from raft_tpu.models.fowt import build_fowt
+    from raft_tpu.serve import ServeConfig, SweepService
+
+    monkeypatch.setenv("RAFT_TPU_HEALTH", "1")
+    design = load_design("Vertical_cylinder")
+    w = np.arange(0.05, 0.5, 0.05) * 2 * np.pi
+    fowt = build_fowt(design, w,
+                      depth=float(design["site"]["water_depth"]))
+    cfg = ServeConfig(queue_max=8, batch_cases=2, window_s=0.02,
+                      batch_deadline_s=60.0)
+    svc = SweepService(fowt, cfg)
+    svc.start()
+    try:
+        t1 = svc.submit(2.0, 8.0, 0.0)
+        t2 = svc.submit(3.0, 9.0, 0.2)
+        r1 = t1.result(120.0)
+        r2 = t2.result(120.0)
+    finally:
+        svc.stop()
+    for r in (r1, r2):
+        h = (r.extra or {}).get("provenance", {}).get("solve_health")
+        assert h is not None
+        assert h["residual_rel"] is not None and h["residual_rel"] <= 1e-6
+        assert h["batch_nonfinite_lanes"] == 0
+        json.dumps(h, allow_nan=False)
+    # the health facts must NOT move the physics digest: digests are
+    # computed from the response spectra alone
+    assert r1.digest != r2.digest

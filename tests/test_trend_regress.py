@@ -1,0 +1,180 @@
+"""The statistical trend-regression sentinel (``obsctl regress``) and
+the ``obsctl trend --import`` snapshot backfill.
+
+``evaluate_regression`` compares each (kind, fingerprint) group's
+newest trend row against its own rolling median/MAD history — no
+hand-set thresholds; the CLI layer backfills committed BENCH/MULTICHIP
+snapshots into a store and exits 1 on unwaived drift.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from raft_tpu.obs import trendstore as T
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OBSCTL = os.path.join(REPO, "tools", "obsctl.py")
+
+
+def row(i, value, *, kind="bench-round", status="ok",
+        metric="solves/sec", fact="result_value", **extra):
+    facts = {"bench_metric": metric, fact: value}
+    facts.update(extra)
+    return {"run_id": f"r{i:03d}", "kind": kind, "status": status,
+            "started_at": f"2026-03-{i:02d}T00:00:00", "facts": facts}
+
+
+def history(values, **kw):
+    """Newest-first rows (as TrendStore.rows returns them)."""
+    n = len(values)
+    return [row(n - i, v, **kw) for i, v in enumerate(values)]
+
+
+# ---------------------------------------------------------------------------
+# the math
+# ---------------------------------------------------------------------------
+
+def test_noise_passes():
+    rep = T.evaluate_regression(
+        history([1001.0, 999.0, 1000.5, 998.5, 1000.0]))
+    assert rep["ok"] and rep["checked"] == 1 and not rep["regressions"]
+
+
+def test_two_sided_detection():
+    for cand in (480.0, 2100.0):          # slowdown AND suspicious jump
+        rep = T.evaluate_regression(
+            history([cand, 999.0, 1000.5, 998.5, 1000.0]))
+        assert not rep["ok"]
+        (f,) = rep["regressions"]
+        assert f["fact"] == "result_value" and f["value"] == cand
+        assert f["n"] == 4 and not f["waived"]
+
+
+def test_min_history_guard():
+    rep = T.evaluate_regression(history([480.0, 999.0, 1000.5]))
+    assert rep["ok"] and rep["checked"] == 0
+    assert rep["groups"][0]["skipped"] == "insufficient history"
+
+
+def test_rel_floor_absorbs_dead_flat_baselines():
+    # MAD 0 on a flat history: a 2% wiggle stays inside the 5% floor,
+    # a 20% break does not
+    assert T.evaluate_regression(
+        history([102.0, 100.0, 100.0, 100.0, 100.0]))["ok"]
+    assert not T.evaluate_regression(
+        history([120.0, 100.0, 100.0, 100.0, 100.0]))["ok"]
+
+
+def test_fingerprint_isolates_baselines():
+    rows = history([999.0, 1000.5, 998.5, 1000.0])
+    rows.insert(0, row(9, 480.0, metric="other metric"))
+    rep = T.evaluate_regression(rows)
+    assert rep["ok"]                      # new metric = new baseline
+    assert any(g.get("skipped") for g in rep["groups"])
+
+
+def test_non_ok_rows_never_qualify():
+    rows = history([480.0, 999.0, 1000.5, 998.5, 1000.0])
+    rows[0]["status"] = "failed"          # the bad candidate is non-ok
+    rep = T.evaluate_regression(rows)
+    assert rep["ok"]
+
+
+def test_bookkeeping_and_fingerprint_facts_not_drift_checked():
+    rows = history([1000.0, 1000.0, 1000.0, 1000.0, 1000.0],
+                   exec_cache_warm=0.0)
+    rows[0]["facts"]["exec_cache_warm"] = 1.0   # warmth flip: expected
+    rep = T.evaluate_regression(rows)
+    assert rep["ok"] and rep["checked"] == 1    # only result_value
+
+
+def test_waivers():
+    rows = history([480.0, 999.0, 1000.5, 998.5, 1000.0])
+    for waiver in ("result_value", "bench-round:result_value",
+                   {"fact": "result_value"},
+                   {"kind": "bench-round", "fact": "result_value"}):
+        rep = T.evaluate_regression(rows, waivers=[waiver])
+        assert rep["ok"], waiver
+        assert rep["regressions"][0]["waived"]
+    rep = T.evaluate_regression(rows, waivers=["other:result_value"])
+    assert not rep["ok"]
+
+
+# ---------------------------------------------------------------------------
+# the CLI: trend --import + regress exit codes
+# ---------------------------------------------------------------------------
+
+def _run(*args, cwd=REPO):
+    return subprocess.run([sys.executable, OBSCTL, *args], cwd=cwd,
+                          capture_output=True, text=True,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+@pytest.fixture(scope="module")
+def backfilled_db(tmp_path_factory):
+    db = str(tmp_path_factory.mktemp("regress") / "trend.sqlite")
+    snaps = (sorted(f for f in os.listdir(REPO)
+                    if f.startswith("BENCH_r") and f.endswith(".json"))
+             + sorted(f for f in os.listdir(REPO)
+                      if f.startswith("MULTICHIP_r")
+                      and f.endswith(".json")))
+    assert snaps, "committed bench snapshots missing"
+    p = _run("trend", "--import", "--db", db, *snaps)
+    assert p.returncode == 0, p.stderr
+    return db
+
+
+def test_import_backfills_snapshots(backfilled_db):
+    rows = T.TrendStore(backfilled_db).rows()
+    kinds = {r["kind"] for r in rows}
+    assert kinds == {"bench-round", "multichip"}
+    ok_bench = [r for r in rows if r["kind"] == "bench-round"
+                and r["status"] == "ok"]
+    assert ok_bench and all("bench_metric" in r["facts"]
+                            and "result_value" in r["facts"]
+                            for r in ok_bench)
+    # failed rounds import as NON-ok so they never become baselines
+    assert any(r["status"] != "ok" for r in rows)
+
+
+def test_regress_exit_0_on_backfilled_history(backfilled_db):
+    p = _run("regress", "--db", backfilled_db)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "obsctl regress: OK" in p.stdout
+
+
+def test_regress_exit_1_on_synthetic_regression(tmp_path):
+    db = str(tmp_path / "trend.sqlite")
+    T.TrendStore(db).append_rows(
+        history([480.0, 999.0, 1000.5, 998.5, 1000.0]))
+    p = _run("regress", "--db", db, "--json")
+    assert p.returncode == 1
+    rep = json.loads(p.stdout)
+    assert not rep["ok"]
+    assert rep["regressions"][0]["fact"] == "result_value"
+    # a waiver file flips it back to 0
+    wf = tmp_path / "waivers.json"
+    wf.write_text(json.dumps({"waivers": ["bench-round:result_value"]}))
+    p = _run("regress", "--db", db, "--waivers", str(wf))
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_regress_bad_inputs_exit_2(tmp_path):
+    p = _run("regress", "--db", str(tmp_path / "missing.sqlite"))
+    assert p.returncode == 2
+    db = str(tmp_path / "t.sqlite")
+    T.TrendStore(db).append_rows(history([1.0]))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    p = _run("regress", "--db", db, "--waivers", str(bad))
+    assert p.returncode == 2
+
+
+def test_import_requires_db_and_inputs(tmp_path):
+    p = _run("trend", "--import")
+    assert p.returncode == 2
+    p = _run("trend", "--import", "--db", str(tmp_path / "t.sqlite"))
+    assert p.returncode == 2

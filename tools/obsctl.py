@@ -245,7 +245,80 @@ def _store_trend_rows(db: str, limit: int = None) -> list[dict]:
     return out
 
 
+def _import_snapshot_row(path: str, doc: dict) -> dict | None:
+    """A persistent trend-store row backfilled from a committed bench
+    snapshot (``BENCH_r0*.json`` / ``MULTICHIP_r0*.json``): the
+    statistical regression sentinel needs history that predates the
+    store itself.  ``started_at`` is synthesized from the file mtime so
+    the store's newest-first ordering matches the snapshot sequence."""
+    name = os.path.basename(path)
+    run_id = name[:-len(".json")] if name.endswith(".json") else name
+    try:
+        started = time.strftime("%Y-%m-%dT%H:%M:%S",
+                                time.gmtime(os.path.getmtime(path)))
+    except OSError:
+        started = None
+    if "tail" in doc and ("cmd" in doc or "n" in doc):    # BENCH_r0*.json
+        inner = (doc.get("parsed")
+                 or _last_json_line(doc.get("tail", "")) or {})
+        facts = {"snapshot": name}
+        if inner.get("metric") is not None:
+            facts["bench_metric"] = str(inner["metric"])
+        if isinstance(inner.get("value"), (int, float)):
+            facts["result_value"] = float(inner["value"])
+        if isinstance(inner.get("vs_baseline"), (int, float)):
+            facts["result_vs_baseline"] = float(inner["vs_baseline"])
+        ok = not doc.get("rc") and bool(inner.get("ok", True))
+        status = "ok" if ok else str(inner.get("reason")
+                                     or f"rc={doc.get('rc')}")
+        return {"run_id": run_id, "kind": "bench-round", "status": status,
+                "started_at": started, "facts": facts}
+    if "n_devices" in doc:                                # MULTICHIP_r0*.json
+        status = ("skipped" if doc.get("skipped")
+                  else "ok" if doc.get("ok") else f"rc={doc.get('rc')}")
+        return {"run_id": run_id, "kind": "multichip", "status": status,
+                "started_at": started,
+                "facts": {"snapshot": name,
+                          "n_devices": doc.get("n_devices")}}
+    return None
+
+
 def cmd_trend(args) -> int:
+    if getattr(args, "do_import", False):
+        if not getattr(args, "db", None):
+            _fail("trend --import: --db DB is required")
+        if not args.paths:
+            _fail("trend --import: no snapshot files given")
+        imported, skipped = [], []
+        for p in _expand_trend_paths(args.paths):
+            try:
+                with open(p) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                skipped.append((os.path.basename(p), type(e).__name__))
+                continue
+            row = _import_snapshot_row(p, doc)
+            if row is None:
+                skipped.append((os.path.basename(p), "unrecognized"))
+                continue
+            imported.append(row)
+        if not imported:
+            _fail("trend --import: no recognizable snapshots "
+                  f"({len(skipped)} skipped)")
+        T.TrendStore(args.db).append_rows(imported)
+        if args.json:
+            print(json.dumps({"imported": imported,
+                              "skipped": [list(s) for s in skipped]},
+                             indent=1))
+            return 0
+        for row in imported:
+            print(f"imported {row['run_id']} kind={row['kind']} "
+                  f"status={row['status']} "
+                  f"({len(row['facts'])} facts)")
+        for name, why in skipped:
+            print(f"skipped {name}: {why}")
+        print(f"trend --import: {len(imported)} row(s) -> {args.db}")
+        return 0
     if getattr(args, "db", None):
         try:
             rows = _store_trend_rows(args.db, limit=args.limit)
@@ -291,6 +364,62 @@ def cmd_trend(args) -> int:
         print(f"  {running} run(s) still marked running (in flight or "
               "killed) — not comparable baselines")
     return 0
+
+
+# ---------------------------------------------------------------------------
+# regress — statistical trend-regression sentinel
+# ---------------------------------------------------------------------------
+
+def cmd_regress(args) -> int:
+    """Statistical drift sentinel: compare the newest run of every
+    (kind, fingerprint) trend-store group against its own rolling
+    median/MAD history (no hand-set thresholds); exit 1 when any
+    unwaived numeric fact lands outside the noise band."""
+    db = args.db or os.environ.get("RAFT_TPU_TREND_DB")
+    if not db:
+        _fail("regress: no trend store (pass --db or set "
+              "RAFT_TPU_TREND_DB)")
+    if not os.path.exists(db):
+        _fail(f"regress: store {db} does not exist")
+    waivers = []
+    if args.waivers:
+        try:
+            with open(args.waivers) as f:
+                loaded = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            _fail(f"regress: cannot read waivers {args.waivers}: {e}")
+        waivers = (loaded.get("waivers", [])
+                   if isinstance(loaded, dict) else loaded)
+        if not isinstance(waivers, list):
+            _fail("regress: waivers must be a JSON list (or "
+                  '{"waivers": [...]})')
+    try:
+        rows = T.TrendStore(db).rows(kind=args.kind, limit=args.limit)
+    except Exception as e:  # sqlite errors are bad input, not a crash
+        _fail(f"regress: cannot read store {db}: {e}")
+    if not rows:
+        _fail(f"regress: store {db} has no runs")
+    rep = T.evaluate_regression(rows, min_history=args.min_history,
+                                nsigma=args.nsigma,
+                                rel_floor=args.rel_floor,
+                                waivers=waivers)
+    if args.json:
+        print(json.dumps(rep, indent=1, default=str))
+        return 0 if rep["ok"] else 1
+    for g in rep["groups"]:
+        tag = g.get("skipped") or f"{g['facts_checked']} fact(s) checked"
+        print(f"group kind={g['kind']} rows={g['rows']}: {tag}")
+    for f_ in rep["regressions"]:
+        mark = "waived" if f_["waived"] else "REGRESSION"
+        print(f"{mark}: {f_['kind']}:{f_['fact']} = {f_['value']:.6g} "
+              f"vs median {f_['median']:.6g} "
+              f"(band {f_['band']:.3g}, n={f_['n']}, "
+              f"run {f_['run_id']})")
+    n_bad = sum(1 for f_ in rep["regressions"] if not f_["waived"])
+    print(f"obsctl regress: {'OK' if rep['ok'] else 'FAILED'} "
+          f"({rep['checked']} fact(s) checked, "
+          f"{len(rep['regressions'])} drift(s), {n_bad} unwaived)")
+    return 0 if rep["ok"] else 1
 
 
 # ---------------------------------------------------------------------------
@@ -950,6 +1079,47 @@ def cmd_selfcheck(args) -> int:
         check("trend renders",
               rc_trend == 0 and "bench-round" in trend_buf.getvalue())
 
+        # regress import + sentinel round trip: backfill the synthetic
+        # bench round into a store, then drive the full exit-code path
+        db = os.path.join(td, "trend.sqlite")
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc_imp = cmd_trend(argparse.Namespace(
+                paths=[os.path.join(td, "BENCH_r99.json")], db=db,
+                do_import=True, json=False, limit=None))
+        check("trend --import ok",
+              rc_imp == 0 and T.TrendStore(db).count() == 1)
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc_reg = cmd_regress(argparse.Namespace(
+                db=db, kind=None, limit=None, min_history=3,
+                nsigma=4.0, rel_floor=0.05, waivers=None, json=False))
+        check("regress single-row history ok", rc_reg == 0)
+
+    # regression-sentinel math: identical-fingerprint history with a
+    # clear 2x slowdown must flag; sub-percent noise must not
+    def srow(i, v):
+        return {"run_id": f"r{i:02d}", "kind": "bench-round",
+                "status": "ok",
+                "started_at": f"2026-01-{i:02d}T00:00:00",
+                "facts": {"bench_metric": "solves/sec",
+                          "result_value": v}}
+    noisy = [srow(5, 1001.0), srow(4, 999.0), srow(3, 1000.5),
+             srow(2, 998.5), srow(1, 1000.0)]
+    check("regress passes noise", T.evaluate_regression(noisy)["ok"])
+    slow = [srow(6, 500.0)] + noisy[1:]
+    rep = T.evaluate_regression(slow)
+    check("regress flags 2x slowdown",
+          not rep["ok"]
+          and rep["regressions"][0]["fact"] == "result_value")
+    check("regress waiver silences",
+          T.evaluate_regression(
+              slow, waivers=["bench-round:result_value"])["ok"])
+    check("regress min-history guard",
+          T.evaluate_regression(slow[:3])["ok"])
+    changed = [srow(6, 500.0)] + noisy[1:]
+    changed[0]["facts"]["bench_metric"] = "other metric"
+    check("regress fingerprint isolates",
+          T.evaluate_regression(changed)["ok"])
+
     n_fail = sum(1 for _, ok in checks if not ok)
     print(f"obsctl selfcheck: {'OK' if not n_fail else 'FAILED'} "
           f"({len(checks) - n_fail}/{len(checks)} checks passed)")
@@ -1037,8 +1207,34 @@ def main(argv=None) -> int:
                                 "instead of scanning files")
     p.add_argument("--limit", type=int, default=None,
                    help="newest N store rows (--db mode)")
+    p.add_argument("--import", dest="do_import", action="store_true",
+                   help="backfill committed snapshot files "
+                        "(BENCH_r0*.json / MULTICHIP_r0*.json) into the "
+                        "--db trend store as history rows")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_trend)
+
+    p = sub.add_parser("regress",
+                       help="statistical drift detection over the trend "
+                            "store (rolling median/MAD noise bands); "
+                            "exit 1 on an unwaived regression")
+    p.add_argument("--db", help="trend store path (default: "
+                                "RAFT_TPU_TREND_DB)")
+    p.add_argument("--kind", help="restrict to one run kind")
+    p.add_argument("--limit", type=int, default=None,
+                   help="newest N store rows (default: all)")
+    p.add_argument("--min-history", type=int, default=3,
+                   help="baseline samples required per fact (default 3)")
+    p.add_argument("--nsigma", type=float, default=4.0,
+                   help="noise-band width in robust sigmas (default 4)")
+    p.add_argument("--rel-floor", type=float, default=0.05,
+                   help="minimum fractional noise band (default 0.05)")
+    p.add_argument("--waivers",
+                   help="JSON waiver file: a list of \"fact\" / "
+                        "\"kind:fact\" strings or {\"kind\", \"fact\"} "
+                        "dicts (or {\"waivers\": [...]})")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_regress)
 
     p = sub.add_parser("tail",
                        help="follow a flight-recorder event file with "

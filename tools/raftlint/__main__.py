@@ -117,4 +117,15 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    try:
+        code = main()
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # `raftlint ... | head` (directly or via `obsctl lint`) closes
+        # stdout before the report finishes printing; that is a normal
+        # way to skim findings, not an error.  Re-point stdout at
+        # devnull so the interpreter's shutdown flush cannot raise a
+        # second time under `set -o pipefail`.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        code = 0
+    raise SystemExit(code)

@@ -271,66 +271,32 @@ def cmd_soak(args) -> int:
     return 0 if report["ok"] else 1
 
 
-def cmd_serve(args) -> int:
-    import signal
+def make_serve_server(service, host: str = "127.0.0.1", port: int = 0, *,
+                      successor: str = None, deadline_s: float = 60.0,
+                      tickets_max: int = 1024):
+    """The replica's ThreadingHTTPServer over ``service`` (submit,
+    optimize, result, drain, stats, healthz, metrics).  Module-level —
+    not inlined in :func:`cmd_serve` — so the Prometheus
+    exposition-conformance tests can stand up the REAL /metrics
+    endpoint without booting a FOWT.  The returned server carries
+    ``track_ticket`` (bounded-FIFO ticket registration, used by journal
+    recovery) as an attribute."""
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from raft_tpu import errors
     from raft_tpu.obs.tracing import TRACE_HEADER
-    from raft_tpu.serve import ServeConfig, SweepService
-    from raft_tpu.serve import journal as wal
 
-    fowt, coarse = _build_fowts(args)
-    cfg = ServeConfig(batch_cases=args.batch, queue_max=args.queue_max,
-                      deadline_s=args.deadline,
-                      batch_deadline_s=args.batch_deadline,
-                      journal_dir=args.journal_dir,
-                      mirror_dirs=tuple(args.mirror_dir or ()),
-                      store_dir=args.store_dir,
-                      warm_start=bool(args.warm_start))
-    degraded = {"coarse": coarse} if coarse is not None else None
-    service = SweepService(fowt, cfg, degraded_fowts=degraded)
     # bounded FIFO, like SweepService._delivered: an always-on process
     # must not retain one ticket per request forever
     import collections
     tickets: collections.OrderedDict[str, object] = \
         collections.OrderedDict()
-    tickets_max = 1024
 
     def _track(t):
         tickets[t.id] = t
         while len(tickets) > tickets_max:
             tickets.popitem(last=False)
-
-    # crash recovery: a journal left by a predecessor (killed or
-    # drained) replays BEFORE the worker starts — completed results
-    # become fetchable, unfinished requests re-enter the queue under
-    # their original seqs, and their tickets are trackable by id.
-    # --recover-from points at a FOREIGN directory (a dead peer's WAL
-    # mirror): this process journals into its own --journal-dir and
-    # replays the mirror — the cross-host failover boot
-    # OWN journal first, then the foreign mirror: the own journal's
-    # pending requests keep their original seqs (deterministic backoff
-    # keys), and its completed results are in the dedupe index before
-    # the mirror's duplicates replay
-    sources = []
-    if args.journal_dir and \
-            os.path.exists(wal.journal_path(args.journal_dir)):
-        sources.append(args.journal_dir)
-    if args.recover_from:
-        sources.append(args.recover_from)
-    for src in sources:
-        info = service.recover(src)
-        for t in info["tickets"].values():
-            _track(t)
-        print(f"raftserve: journal recovery from {src}"
-              f"{' (mirror/failover)' if info['mirror'] else ''} — "
-              f"{info['recovered']} result(s) restored, "
-              f"{info['replayed']} request(s) replayed, "
-              f"{info['deduped']} deduped, "
-              f"{info['corrupt']} corrupt line(s) skipped", flush=True)
-    service.start()
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):                     # pragma: no cover
@@ -397,7 +363,7 @@ def cmd_serve(args) -> int:
             if self.path == "/drain":
                 # graceful handoff: flush/journal everything, write the
                 # handoff manifest, answer with it, then shut down
-                doc = service.drain(successor=args.successor)
+                doc = service.drain(successor=successor)
                 self._send(200, doc)
                 threading.Thread(target=srv.shutdown,
                                  daemon=True).start()
@@ -412,10 +378,10 @@ def cmd_serve(args) -> int:
                         raise ValueError("body must be a JSON object")
                     tenant = str(doc.pop("tenant", "default"))
                     wait = doc.pop("wait", False)
-                    deadline_s = doc.pop("deadline_s", None)
-                    if deadline_s is not None:
-                        deadline_s = float(deadline_s)
-                        if not (deadline_s > 0.0):
+                    deadline_s_req = doc.pop("deadline_s", None)
+                    if deadline_s_req is not None:
+                        deadline_s_req = float(deadline_s_req)
+                        if not (deadline_s_req > 0.0):
                             raise ValueError("deadline_s must be > 0")
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
@@ -423,7 +389,7 @@ def cmd_serve(args) -> int:
                     return
                 try:
                     t = service.submit_optimize(
-                        doc, deadline_s=deadline_s, tenant=tenant,
+                        doc, deadline_s=deadline_s_req, tenant=tenant,
                         trace=self.headers.get(TRACE_HEADER))
                 except errors.AdmissionRejected as e:
                     self._send(429, e.context(),
@@ -438,7 +404,7 @@ def cmd_serve(args) -> int:
                         if t.trace else {})
                 if wait:
                     try:
-                        res = t.result((deadline_s or cfg.deadline_s)
+                        res = t.result((deadline_s_req or deadline_s)
                                        + 5.0)
                     except errors.DeadlineExceeded as e:
                         self._send(504, e.context())
@@ -462,10 +428,10 @@ def cmd_serve(args) -> int:
                         if "heading_deg" in doc
                         else float(doc.get("heading_rad", 0.0)))
                 tenant = str(doc.get("tenant", "default"))
-                deadline_s = doc.get("deadline_s")
-                if deadline_s is not None:
-                    deadline_s = float(deadline_s)
-                    if not (deadline_s > 0.0):
+                deadline_s_req = doc.get("deadline_s")
+                if deadline_s_req is not None:
+                    deadline_s_req = float(deadline_s_req)
+                    if not (deadline_s_req > 0.0):
                         raise ValueError("deadline_s must be > 0")
             except (KeyError, TypeError, ValueError,
                     json.JSONDecodeError) as e:
@@ -476,7 +442,8 @@ def cmd_serve(args) -> int:
                 # rdigest is tenant-salted, and the router's
                 # re-resolution/dedupe contracts depend on backend and
                 # router computing the SAME digest
-                t = service.submit(hs, tp, beta, deadline_s=deadline_s,
+                t = service.submit(hs, tp, beta,
+                                   deadline_s=deadline_s_req,
                                    tenant=tenant,
                                    trace=self.headers.get(TRACE_HEADER))
             except errors.AdmissionRejected as e:
@@ -495,7 +462,7 @@ def cmd_serve(args) -> int:
                     if t.trace else {})
             if doc.get("wait"):
                 try:
-                    res = t.result((deadline_s or cfg.deadline_s) + 5.0)
+                    res = t.result((deadline_s_req or deadline_s) + 5.0)
                 except errors.DeadlineExceeded as e:
                     self._send(504, e.context())
                     return
@@ -506,7 +473,59 @@ def cmd_serve(args) -> int:
                                            if t.trace else None)},
                            headers=thdr)
 
-    srv = ThreadingHTTPServer((args.host, args.port), Handler)
+    srv = ThreadingHTTPServer((host, port), Handler)
+    srv.track_ticket = _track
+    return srv
+
+
+def cmd_serve(args) -> int:
+    import signal
+    import threading
+
+    from raft_tpu.serve import ServeConfig, SweepService
+    from raft_tpu.serve import journal as wal
+
+    fowt, coarse = _build_fowts(args)
+    cfg = ServeConfig(batch_cases=args.batch, queue_max=args.queue_max,
+                      deadline_s=args.deadline,
+                      batch_deadline_s=args.batch_deadline,
+                      journal_dir=args.journal_dir,
+                      mirror_dirs=tuple(args.mirror_dir or ()),
+                      store_dir=args.store_dir,
+                      warm_start=bool(args.warm_start))
+    degraded = {"coarse": coarse} if coarse is not None else None
+    service = SweepService(fowt, cfg, degraded_fowts=degraded)
+    srv = make_serve_server(service, args.host, args.port,
+                            successor=args.successor,
+                            deadline_s=cfg.deadline_s)
+    # crash recovery: a journal left by a predecessor (killed or
+    # drained) replays BEFORE the worker starts — completed results
+    # become fetchable, unfinished requests re-enter the queue under
+    # their original seqs, and their tickets are trackable by id.
+    # --recover-from points at a FOREIGN directory (a dead peer's WAL
+    # mirror): this process journals into its own --journal-dir and
+    # replays the mirror — the cross-host failover boot
+    # OWN journal first, then the foreign mirror: the own journal's
+    # pending requests keep their original seqs (deterministic backoff
+    # keys), and its completed results are in the dedupe index before
+    # the mirror's duplicates replay
+    sources = []
+    if args.journal_dir and \
+            os.path.exists(wal.journal_path(args.journal_dir)):
+        sources.append(args.journal_dir)
+    if args.recover_from:
+        sources.append(args.recover_from)
+    for src in sources:
+        info = service.recover(src)
+        for t in info["tickets"].values():
+            srv.track_ticket(t)
+        print(f"raftserve: journal recovery from {src}"
+              f"{' (mirror/failover)' if info['mirror'] else ''} — "
+              f"{info['recovered']} result(s) restored, "
+              f"{info['replayed']} request(s) replayed, "
+              f"{info['deduped']} deduped, "
+              f"{info['corrupt']} corrupt line(s) skipped", flush=True)
+    service.start()
     host, port = srv.server_address[:2]
 
     def _on_sigterm(signum, frame):                    # pragma: no cover

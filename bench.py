@@ -1179,10 +1179,218 @@ def _optimize_bench_main() -> int:
     return 0 if report["ok"] else 1
 
 
+def farm_bench(*, design=None, n_turbines=None, ncases=None,
+               spacing_m=None, min_freq=None, max_freq=None, dfreq=None,
+               nIter=None, tol=1e-4, seed=2026, serial_sample=None,
+               k_w=0.05):
+    """Benchmark + parity-gate the device-resident farm axis
+    (``parallel/sweep.sweep_farm`` / ``make_farm_runner``): N turbines x
+    M cases solved as ONE compiled program, wake equilibrium included.
+
+    Two measurements over the SAME layout and case table:
+
+    1. **Farm-batched** — the warm ``make_farm_runner`` program timed
+       over distinct case batches (the axon tunnel memoizes identical
+       executions); metric ``turbine_cases_per_min``.
+    2. **Serial baseline** — the host wake fixed point per case plus
+       one jitted SINGLE-LANE solve per (turbine, case), measured on a
+       sample of lanes and extrapolated (the way the reference drives
+       farms: one FOWT, one case at a time).
+
+    The GATE: every farm lane's response std must match the per-turbine
+    serial path (same case solver, host wake equilibrium, per-lane
+    mooring stiffness and aero damping) to solver tolerance —
+    ``farm_parity_mismatch`` counts lanes beyond 1e-6 relative and the
+    trend-store SLO rule pins it at 0: a fast-but-wrong farm number is
+    not a result.
+
+    Facts (``bench_farm`` manifest -> trend store): turbine_cases/min
+    farm and serial, speedup, wake fixed-point iterations, parity.
+    Knobs: ``RAFT_BENCH_FARM_{DESIGN,NT,NC,SPACING,NITER,SERIAL_N}``."""
+    import jax
+
+    from raft_tpu.models import mooring as mr
+    from raft_tpu.models import wake as wk
+    from raft_tpu.parallel import sweep as sweepmod
+    from raft_tpu.serve.soak import build_fowt, case_table
+
+    def _knob(value, env, fallback, cast):
+        return cast(value if value is not None
+                    else os.environ.get(env, fallback))
+
+    design = _knob(design, "RAFT_BENCH_FARM_DESIGN", "OC3spar", str)
+    nt = _knob(n_turbines, "RAFT_BENCH_FARM_NT", 4, int)
+    nc = _knob(ncases, "RAFT_BENCH_FARM_NC", 64, int)
+    spacing = _knob(spacing_m, "RAFT_BENCH_FARM_SPACING", 800.0, float)
+    min_freq = _knob(min_freq, "RAFT_BENCH_FARM_MIN_FREQ", 0.05, float)
+    max_freq = _knob(max_freq, "RAFT_BENCH_FARM_MAX_FREQ", 0.5, float)
+    dfreq = _knob(dfreq, "RAFT_BENCH_FARM_DFREQ", 0.05, float)
+    nIter = _knob(nIter, "RAFT_BENCH_FARM_NITER", 8, int)
+    nser = _knob(serial_sample, "RAFT_BENCH_FARM_SERIAL_N", 8, int)
+
+    obs = _obs_default()
+    fowt = build_fowt(design, min_freq, max_freq, dfreq)
+    # single row along +x: every downstream turbine sits in the wake
+    # cone at wind_dir ~ 0, so the equilibrium is genuinely coupled
+    xy = np.stack([np.arange(nt) * spacing, np.zeros(nt)], axis=1)
+    Hs, Tp, beta = case_table(nc, seed=seed)
+    rng = np.random.default_rng(seed)
+    U_inf = 6.0 + 8.0 * rng.random(nc)
+    wind_dir = rng.uniform(-15.0, 15.0, nc)
+
+    manifest = obs.RunManifest.begin(kind="bench_farm", config={
+        "design": design, "n_turbines": nt, "ncases": nc,
+        "spacing_m": spacing, "nw": len(fowt.w), "nIter": nIter,
+        "seed": seed})
+    status = "failed"
+    try:
+        # the BEM induction solve behind the power/thrust curve needs
+        # f64 (in f32 the bracket test mis-signs; see _aero_constants)
+        # — build the curve once under the scoped x64 enable and hand
+        # the plain-numpy tables to the f32 farm program
+        x64_ctx, dev_ctx = _f64_scope()
+        with x64_ctx, dev_ctx:
+            curve = wk.power_thrust_curve(fowt)
+        with obs.span("farm_bench_build", n_turbines=nt, ncases=nc):
+            runner = sweepmod.make_farm_runner(
+                fowt, xy, nc, nIter=nIter, tol=tol, k_w=k_w, curve=curve)
+        # ----- farm-batched throughput (warm program, distinct inputs)
+        reps = 3
+        batches = []
+        for rp in range(reps):
+            h, t, b = case_table(nc, seed=seed + 1 + rp)
+            r2 = np.random.default_rng(seed + 1 + rp)
+            batches.append((h, t, b, 6.0 + 8.0 * r2.random(nc),
+                            r2.uniform(-15.0, 15.0, nc)))
+        with obs.span("farm_bench_timed", reps=reps):
+            t0 = time.perf_counter()
+            for arrs in batches:
+                runner(*arrs)
+            farm_dt = (time.perf_counter() - t0) / reps
+        farm_tcpm = nt * nc / farm_dt * 60.0
+
+        # ----- parity: farm lanes vs the serial per-turbine path -----
+        out = runner(Hs, Tp, beta, U_inf, wind_dir)
+        shaped = sweepmod._farm_reshape(out, nt, nc)
+        std_farm = np.asarray(shaped["std"])          # (nt, nc, 6)
+        wake_iters = int(np.max(np.asarray(shaped["wake_iters"])))
+        curve = runner.curve
+        rot = fowt.rotors[0]
+        D = np.full(nt, 2.0 * rot.R_rot)
+        # host wake fixed point per case — find_wake_equilibrium's exact
+        # schedule (same relax/tol/termination), Model-free
+        t_wake0 = time.perf_counter()
+        U_t = np.zeros((nt, nc))
+        for c in range(nc):
+            U = np.full(nt, U_inf[c])
+            Ct = wk._curve_interp(U, curve, "Ct")
+            for _ in range(100):
+                U_new = wk.wake_velocities(xy, D, Ct, float(U_inf[c]),
+                                           float(wind_dir[c]), k_w)
+                if np.max(np.abs(U_new - U)) < 1e-4:
+                    U = U_new
+                    break
+                U = 0.5 * U + 0.5 * U_new
+                Ct = wk._curve_interp(U, curve, "Ct")
+            U_t[:, c] = U
+        wake_host_s = time.perf_counter() - t_wake0
+        r6_ref = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+        C_base = (np.asarray(mr.coupled_stiffness_rotvec(fowt.mooring,
+                                                         r6_ref))
+                  if fowt.mooring is not None else np.zeros((6, 6)))
+        B_tab = sweepmod.aero_damping_table(curve, float(rot.hubHt))
+        cs = np.asarray(curve["wind_speed"])
+        case = sweepmod.make_case_solver(fowt, nIter=nIter, tol=tol)
+        C_b = np.broadcast_to(C_base, (nc, 6, 6))
+        std_ref = np.zeros_like(std_farm)
+        with obs.span("farm_bench_parity_ref", n_turbines=nt):
+            for t in range(nt):
+                r6_b = np.zeros((nc, 6))
+                r6_b[:, :2] = xy[t]
+                B_add = sweepmod._interp_along0(
+                    jax.numpy.asarray(cs), jax.numpy.asarray(B_tab),
+                    jax.numpy.asarray(U_t[t]))
+                o = case.batched(Hs, Tp, beta, r6_b=r6_b, C_moor_b=C_b,
+                                 B_add=B_add)
+                std_ref[t] = np.asarray(o["std"])
+        rel = (np.abs(std_farm - std_ref)
+               / np.maximum(np.abs(std_ref), 1e-12))
+        lane_rel = rel.max(axis=-1)                    # (nt, nc)
+        # parity threshold scales with the active dtype: the farm
+        # program and the serial reference order their f32 reductions
+        # differently (~1e-5 roundoff); in f64 they agree to ~1e-15.
+        # Real physics mistakes (wrong mooring block, unwaked lane)
+        # show up at >1e-2 either way.
+        from raft_tpu import _config as _cfg
+        ptol = (1e-6 if np.dtype(_cfg.real_dtype()) == np.float64
+                else 5e-4)
+        mismatch = int(np.sum(lane_rel > ptol))
+
+        # ----- serial baseline: one lane at a time, extrapolated -----
+        jlane = jax.jit(lambda h, t, b, r6, C, B: case.batched(
+            h, t, b, r6_b=r6, C_moor_b=C, B_add=B))
+        C_1 = C_base[None]
+        lanes = [(t, c) for t in range(nt) for c in range(nc)]
+        sample = lanes[:: max(1, len(lanes) // nser)][:nser]
+
+        def _one(t, c):
+            r6_1 = np.zeros((1, 6))
+            r6_1[0, :2] = xy[t]
+            B_1 = sweepmod._interp_along0(
+                jax.numpy.asarray(cs), jax.numpy.asarray(B_tab),
+                jax.numpy.asarray(U_t[t, c:c + 1]))
+            return jlane(Hs[c:c + 1], Tp[c:c + 1], beta[c:c + 1],
+                         r6_1, C_1, B_1)
+
+        jax.block_until_ready(_one(*sample[0])["std"])   # compile
+        with obs.span("farm_bench_serial", sample=len(sample)):
+            t0 = time.perf_counter()
+            for t, c in sample:
+                jax.block_until_ready(_one(t, c)["std"])
+            lane_dt = (time.perf_counter() - t0) / len(sample)
+        serial_s = lane_dt * nt * nc + wake_host_s
+        serial_tcpm = nt * nc / serial_s * 60.0
+
+        facts = {
+            "turbine_cases_per_min": round(farm_tcpm, 2),
+            "serial_turbine_cases_per_min": round(serial_tcpm, 2),
+            "speedup_vs_serial": round(farm_tcpm / serial_tcpm, 3),
+            "wake_iters": wake_iters,
+            "n_turbines": nt,
+            "ncases": nc,
+            "farm_parity_mismatch": mismatch,
+            "parity_max_rel": float(lane_rel.max()),
+            "parity_tol": ptol,
+            "wall_s": round(farm_dt, 4),
+            "serial_lane_s": round(lane_dt, 5),
+            "cache_state": str(runner.cache_state),
+            "build_s": round(float(runner.build_s), 3),
+        }
+        manifest.extra["farm_bench"] = facts
+        status = "ok" if mismatch == 0 else "failed"
+        report = {"metric": "farm axis throughput "
+                            f"({design}: {nt} turbines x {nc} cases, "
+                            f"{len(fowt.w)} bins, one compiled program "
+                            "incl. wake equilibrium)",
+                  **facts, "ok": status == "ok"}
+    finally:
+        paths = obs.finish_run(manifest, status=status)
+    report["manifest"] = paths["manifest"]
+    return report
+
+
+def _farm_bench_main() -> int:
+    report = farm_bench()
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
 if __name__ == "__main__":
     import sys as _sys
     if len(_sys.argv) > 1 and _sys.argv[1] == "serve":
         raise SystemExit(_serve_bench_main())
     if len(_sys.argv) > 1 and _sys.argv[1] == "optimize":
         raise SystemExit(_optimize_bench_main())
+    if len(_sys.argv) > 1 and _sys.argv[1] == "farm":
+        raise SystemExit(_farm_bench_main())
     main()

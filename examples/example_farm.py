@@ -1,0 +1,66 @@
+"""Batched wind-farm sweep — N turbines x M cases as ONE compiled program.
+
+Builds one OC3 spar FOWT, lays four of them out in a row, and solves
+every (turbine, case) lane in a single device program via
+`parallel.sweep.sweep_farm`: the Gaussian-deficit wake equilibrium runs
+*inside* the program (per-lane waked wind speeds feed the aero
+damping), each lane solves at its turbine's position and mooring
+stiffness, and the outputs come back as (n_turbines, ncases, ...)
+arrays.  For a design YAML with an `array` table (e.g. the 2-FOWT
+VolturnUS-S farm), `Model(design).sweep_farm(...)` does the same with
+the array-mooring stiffness blocks wired in.
+
+See docs/performance.md "Layer 8 — the farm axis" for the lane layout,
+sharding rules, and cache identity; `python bench.py farm` for the
+parity + throughput gate.
+
+Usage:  python example_farm.py
+"""
+import numpy as np
+
+from raft_tpu.io.designs import load_design
+from raft_tpu.models.fowt import build_fowt
+from raft_tpu.parallel.sweep import sweep_farm
+
+
+def run_example():
+    # one platform design, replicated at each layout position
+    design = load_design("OC3spar")
+    w = np.arange(0.05, 0.5, 0.02) * 2 * np.pi
+    fowt = build_fowt(design, w,
+                      depth=float(design["site"]["water_depth"]))
+
+    # a 4-turbine row, 800 m spacing, wind blowing along the row
+    layout = np.stack([np.arange(4) * 800.0, np.zeros(4)], axis=1)
+
+    # per-case sea states + free-stream wind driving the wake coupling
+    ncases = 8
+    rng = np.random.default_rng(7)
+    Hs = 3.0 + 3.0 * rng.random(ncases)
+    Tp = 8.0 + 5.0 * rng.random(ncases)
+    beta = np.zeros(ncases)
+    U_inf = 7.0 + 6.0 * rng.random(ncases)
+    wind_dir = rng.uniform(-10.0, 10.0, ncases)
+
+    out = sweep_farm(fowt, layout, Hs, Tp, beta, U_inf, wind_dir,
+                     nIter=8)
+
+    std = np.asarray(out["std"])          # (4, 8, 6) motion stds
+    U_wake = np.asarray(out["U_wake"])    # (4, 8) waked hub winds
+    power = np.asarray(out["aero_power"])  # (4, 8) rotor power [W]
+    print(f"solved {std.shape[0]} turbines x {std.shape[1]} cases in "
+          f"one program; wake iters per case: "
+          f"{np.asarray(out['wake_iters']).tolist()}")
+    for c in (0, ncases - 1):
+        losses = 100.0 * (1.0 - U_wake[:, c] / U_inf[c])
+        print(f"case {c}: U_inf={U_inf[c]:5.2f} m/s, per-turbine wake "
+              f"loss [%] = {np.round(losses, 2).tolist()}, "
+              f"farm power = {power[:, c].sum() / 1e6:.1f} MW")
+    print(f"surge std range: {std[..., 0].min():.3f} - "
+          f"{std[..., 0].max():.3f} m")
+    assert np.all(np.isfinite(std))
+    return out
+
+
+if __name__ == "__main__":
+    run_example()

@@ -1478,6 +1478,110 @@ class Model:
                             config or ServeConfig(**config_kw),
                             degraded_fowts=degraded)
 
+    def sweep_farm(self, cases=None, mesh=None, **kw):
+        """Batched farm sweep: every turbine x every case of this array
+        model in ONE compiled program (:func:`raft_tpu.parallel.sweep.
+        sweep_farm`), wake-coupled through the device-resident Gaussian
+        wake equilibrium.
+
+        ``cases``: optional dict of per-case arrays (``Hs``, ``Tp``,
+        ``beta`` [rad], ``U_inf``, ``wind_dir`` [deg]); default = this
+        design's ``cases`` table (wave_height/wave_period/wave_heading/
+        wind_speed/wind_heading columns).  ``mesh`` defaults to the
+        model's ambient mesh.  Remaining ``kw`` passes through to the
+        farm solver (``k_w``, ``aero``, ``nIter``, ...).
+
+        The batched program replicates ``fowtList[0]`` at every layout
+        position — a HOMOGENEOUS farm.  Heterogeneous arrays (mixed
+        platform/turbine IDs, per-turbine heading_adjust) keep their
+        per-turbine geometry only on the serial ``analyzeCases`` path; a
+        warning is emitted when this approximation is in play.  Array
+        mooring enters at the statics boundary: when ``solveStatics``
+        has populated ``_K_array``, its per-turbine 6x6 diagonal blocks
+        are added to the base platform's own-mooring stiffness (the
+        turbine-coupling OFF-diagonal blocks are dropped — the batched
+        lanes are independent solves; docs/performance.md Layer 8).
+
+        Returns the :func:`~raft_tpu.parallel.sweep.sweep_farm` output
+        dict of (n_turbines, ncases, ...) arrays, also stored as
+        ``self.results["farm"]`` summary facts."""
+        import warnings
+
+        from raft_tpu.models import mooring as mr
+        from raft_tpu.parallel import sweep as _sweep
+
+        fowt = self.fowtList[0]
+        n = self.nFOWT
+        arr = self.design.get("array")
+        if arr:
+            rows = [dict(zip(arr["keys"], r)) for r in arr["data"]]
+            hetero = {(r.get("turbineID", 1), r.get("platformID", 1),
+                       r.get("mooringID", 1),
+                       float(r.get("heading_adjust", 0.0)))
+                      for r in rows}
+            if len(hetero) > 1:
+                warnings.warn(
+                    "sweep_farm replicates the first FOWT at every "
+                    "layout position — this array mixes platform/"
+                    "turbine/mooring IDs or heading adjustments, which "
+                    "only the serial analyzeCases path preserves",
+                    stacklevel=2)
+        xy = np.array([[f.x_ref, f.y_ref] for f in self.fowtList])
+
+        # mooring stiffness at the statics boundary: own mooring at the
+        # BASE reference position (translation-invariant under a move of
+        # platform + anchors together) plus the array-mooring diagonal
+        # block when solveStatics has solved the shared-line network
+        r6_ref = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+        C_base = (np.asarray(mr.coupled_stiffness_rotvec(fowt.mooring,
+                                                         r6_ref))
+                  if fowt.mooring is not None else np.zeros((6, 6)))
+        C_moor_t = np.broadcast_to(C_base, (n, 6, 6)).copy()
+        if self._K_array is not None:
+            Kb = np.asarray(self._K_array).reshape(n, 6, n, 6)
+            for i in range(n):
+                C_moor_t[i] += Kb[i, :, i, :]
+        elif self.arr_ms is not None:
+            warnings.warn(
+                "array_mooring present but statics not solved — run "
+                "solveStatics first so sweep_farm can include the "
+                "shared-line stiffness blocks", stacklevel=2)
+
+        if cases is None:
+            ctab = self.design.get("cases")
+            if not ctab:
+                raise errors.ModelConfigError(
+                    "sweep_farm needs a cases= dict or a design 'cases' "
+                    "table")
+            rows = [dict(zip(ctab["keys"], r)) for r in ctab["data"]]
+            def _ws(r):
+                v = r.get("wind_speed", 10.0)
+                return float(np.max(v)) if np.ndim(v) > 0 else float(v)
+            def _wd(r):
+                v = r.get("wind_heading", 0.0)
+                return float(np.mean(v)) if np.ndim(v) > 0 else float(v)
+            cases = {
+                "Hs": [float(r.get("wave_height", 0.0)) for r in rows],
+                "Tp": [float(r.get("wave_period", 10.0)) for r in rows],
+                "beta": [np.deg2rad(float(r.get("wave_heading", 0.0)))
+                         for r in rows],
+                "U_inf": [_ws(r) for r in rows],
+                "wind_dir": [_wd(r) for r in rows]}
+        mesh = self.mesh if mesh is None else mesh
+        kw.setdefault("nIter", self.nIter)
+        kw.setdefault("XiStart", self.XiStart)
+        out = _sweep.sweep_farm(
+            fowt, xy, cases["Hs"], cases["Tp"], cases["beta"],
+            cases["U_inf"], cases.get("wind_dir"), mesh=mesh,
+            C_moor_t=C_moor_t, **kw)
+        self.results["farm"] = {
+            "n_turbines": n, "ncases": int(np.asarray(cases["Hs"]).size),
+            "std": np.asarray(out["std"]),
+            "U_wake": np.asarray(out["U_wake"]),
+            "aero_power": np.asarray(out["aero_power"]),
+            "wake_iters": np.asarray(out["wake_iters"])}
+        return out
+
     def analyzeCases(self, display=0, RAO_plot=False, resume=False,
                      warm_statics=None):
         """Statics + dynamics + output statistics per load case.  Records

@@ -9,13 +9,27 @@ linear wake expansion and root-sum-square superposition, the same model
 family FLORIS's default gauss velocity model implements — so farms get
 wake-coupled operating points and AEP with zero extra dependencies.
 
-All functions are plain numpy (host-side orchestration, like the
-reference's FLORIS loop); the per-turbine aero evaluations inside the
-fixed point reuse the jitted BEM rotor model.
+The host functions are plain numpy (host-side orchestration, like the
+reference's FLORIS loop); the per-turbine aero evaluations behind the
+power/thrust curve reuse the jitted BEM rotor model.  The ``*_jnp``
+twins at the bottom are the device-resident port the batched farm sweep
+(:func:`raft_tpu.parallel.sweep.sweep_farm`) traces into its single
+compiled program — same deficit model, same fixed-point schedule,
+shape-stable ``lax.while_loop`` instead of the host Python loop.
 """
 from __future__ import annotations
 
 import numpy as np
+import jax
+import jax.numpy as jnp
+
+#: the reference clips Ct at 0.96 before dividing by sqrt(1 - Ct); the
+#: guard below additionally floors (1 - Ct) at this value so a jnp port
+#: of the same expression has no Ct -> 1 singularity on the UNTAKEN
+#: branch (jax.grad evaluates both sides of a where/clip — a NaN there
+#: poisons the gradient even when the clipped forward value is fine)
+CT_MAX = 0.96
+_ONE_MINUS_CT_MIN = 1.0 - CT_MAX
 
 
 def gaussian_deficit(x_d, y_d, Ct, k_w=0.05):
@@ -26,9 +40,14 @@ def gaussian_deficit(x_d, y_d, Ct, k_w=0.05):
     Bastankhah & Porte-Agel (2014): sigma/D = k_w x/D + 0.25 sqrt(beta),
     beta = (1 + sqrt(1-Ct)) / (2 sqrt(1-Ct));
     dU/U = (1 - sqrt(1 - Ct/(8 (sigma/D)^2))) exp(-y^2/(2 sigma^2)).
+
+    Ct -> 1 guard: Ct is clipped at :data:`CT_MAX` AND (1 - Ct) is
+    floored at (1 - CT_MAX) before the square root — bitwise identical
+    for every in-range Ct, finite (value and gradient) for any raw
+    Ct >= 1 a thrust curve or an optimizer step might produce.
     """
-    Ct = np.clip(Ct, 0.0, 0.96)
-    sq = np.sqrt(1.0 - Ct)
+    Ct = np.clip(Ct, 0.0, CT_MAX)
+    sq = np.sqrt(np.maximum(1.0 - Ct, _ONE_MINUS_CT_MIN))
     beta = 0.5 * (1.0 + sq) / sq
     sigma_D = k_w * np.maximum(x_d, 0.1) + 0.25 * np.sqrt(beta)
     rad = 1.0 - Ct / (8.0 * sigma_D**2)
@@ -37,31 +56,40 @@ def gaussian_deficit(x_d, y_d, Ct, k_w=0.05):
     return np.where(x_d > 0.05, dU, 0.0)
 
 
+def _wake_frame(xy, wind_dir_deg):
+    """Rotate farm coordinates into the downwind/crosswind frame."""
+    th = np.deg2rad(wind_dir_deg)
+    R = np.array([[np.cos(th), np.sin(th)], [-np.sin(th), np.cos(th)]])
+    return np.asarray(xy, float) @ R.T
+
+
 def wake_velocities(xy, D, Ct, U_inf, wind_dir_deg=0.0, k_w=0.05):
     """Effective hub-height wind speed at each turbine of a farm.
 
     xy: (n,2) turbine positions [m]; D: rotor diameter(s); Ct: (n,) thrust
     coefficients; wind_dir_deg: direction the wind FLOWS TOWARD (x-axis at
     0).  Root-sum-square deficit superposition.
+
+    One broadcast over the full (receiver i, source j) pair matrix —
+    the O(n^2) Python double loop this replaces is parity-pinned in
+    tests/test_wake.py (the loop sums sources in index order; the
+    pairwise summation here agrees to float64 roundoff).
     """
     xy = np.asarray(xy, float)
     n = len(xy)
     D = np.broadcast_to(np.asarray(D, float), (n,))
     Ct = np.asarray(Ct, float)
-    th = np.deg2rad(wind_dir_deg)
-    R = np.array([[np.cos(th), np.sin(th)], [-np.sin(th), np.cos(th)]])
-    xy_w = xy @ R.T          # downwind/crosswind frame
-    U = np.full(n, float(U_inf))
-    for i in range(n):       # receiving turbine
-        ssq = 0.0
-        for j in range(n):   # wake source
-            if i == j:
-                continue
-            dx = (xy_w[i, 0] - xy_w[j, 0]) / D[j]
-            dy = (xy_w[i, 1] - xy_w[j, 1]) / D[j]
-            ssq += gaussian_deficit(dx, dy, Ct[j], k_w) ** 2
-        U[i] = U_inf * (1.0 - np.sqrt(ssq))
-    return U
+    xy_w = _wake_frame(xy, wind_dir_deg)   # downwind/crosswind frame
+    # pair matrices, receiver i on rows / source j on columns,
+    # normalized by the SOURCE diameter (self-deficit masked below;
+    # gaussian_deficit is zero at x_d = 0 anyway, the mask keeps the
+    # diagonal exactly 0.0 regardless of the near-wake cutoff)
+    dx = (xy_w[:, 0][:, None] - xy_w[None, :, 0]) / D[None, :]
+    dy = (xy_w[:, 1][:, None] - xy_w[None, :, 1]) / D[None, :]
+    dU = gaussian_deficit(dx, dy, Ct[None, :], k_w)
+    np.fill_diagonal(dU, 0.0)
+    ssq = np.sum(dU**2, axis=1)
+    return U_inf * (1.0 - np.sqrt(ssq))
 
 
 def power_thrust_curve(model, speeds=None, ifowt=0, cut_in=3.0,
@@ -75,10 +103,19 @@ def power_thrust_curve(model, speeds=None, ifowt=0, cut_in=3.0,
     and zero rotor speed, like the reference's 'parked' case switch
     (raft_model.py:1705-1708); np.interp clamping of the operating
     schedule would otherwise report near-rated loads at storm speeds.
+
+    ``model`` may be a Model (rotor taken from ``fowtList[ifowt]``) or a
+    bare FOWT (so the farm sweep path can build a curve without a Model).
+    The operating-schedule lookups are batched (one np.interp per
+    channel over all speeds) and a single jitted BEM closure is traced
+    once and reused across speeds — the rotor geometry setup no longer
+    repeats per operating point.
     """
+    import jax as _jax
+
     from raft_tpu.models.rotor import bem_evaluate
 
-    fowt = model.fowtList[ifowt]
+    fowt = model.fowtList[ifowt] if hasattr(model, "fowtList") else model
     rot = fowt.rotors[0]
     if speeds is None:
         speeds = np.arange(3.0, 25.5, 1.0)
@@ -89,19 +126,24 @@ def power_thrust_curve(model, speeds=None, ifowt=0, cut_in=3.0,
     T = np.zeros_like(speeds)
     pitch = np.zeros_like(speeds)
     omega = np.zeros_like(speeds)
-    for i, U in enumerate(speeds):
-        if not (cut_in <= U <= cut_out):
-            continue                    # parked: all-zero row
-        Uh = U * rot.speed_gain
-        om = float(np.interp(Uh, rot.Uhub_ops, rot.Omega_rpm_ops))
-        pi_deg = float(np.interp(Uh, rot.Uhub_ops, rot.pitch_deg_ops))
-        # tilt seen by the BEM is -shaft_tilt (q[2] = -sin(shaft_tilt);
-        # same convention calc_aero derives from the pose)
-        loads = bem_evaluate(rot, Uh, om, pi_deg, tilt=-rot.shaft_tilt)
-        P[i] = float(loads["P"])
-        T[i] = float(loads["T"])
-        pitch[i] = pi_deg
-        omega[i] = om
+    op = (speeds >= cut_in) & (speeds <= cut_out)   # else parked: zero row
+    Uh_all = speeds * rot.speed_gain
+    om_all = np.interp(Uh_all, rot.Uhub_ops, rot.Omega_rpm_ops)
+    pi_all = np.interp(Uh_all, rot.Uhub_ops, rot.pitch_deg_ops)
+
+    # tilt seen by the BEM is -shaft_tilt (q[2] = -sin(shaft_tilt);
+    # same convention calc_aero derives from the pose)
+    @_jax.jit
+    def _bem(U, om, pi_deg):
+        out = bem_evaluate(rot, U, om, pi_deg, tilt=-rot.shaft_tilt)
+        return out["P"], out["T"]
+
+    for i in np.flatnonzero(op):
+        p_i, t_i = _bem(Uh_all[i], om_all[i], pi_all[i])
+        P[i] = float(p_i)
+        T[i] = float(t_i)
+    pitch[op] = pi_all[op]
+    omega[op] = om_all[op]
     Cp = P / (0.5 * rho * A * speeds**3)
     Ct = np.clip(T / (0.5 * rho * A * speeds**2), 0.0, 2.0)
     return dict(wind_speed=speeds, power=P, thrust=T, Cp=Cp, Ct=Ct,
@@ -202,6 +244,123 @@ def calc_aep(model, wind_rose, k_w=0.05, availability=1.0):
                               farm_power=farm_p, U=eq["U"]))
         aep += prob * farm_p * hours
     return dict(AEP=aep * availability, states=per_state)
+
+
+# --------------------------------------------------------------------------
+# Device-resident port (jnp) — traced into the batched farm program
+# --------------------------------------------------------------------------
+# Same Bastankhah deficit, same fixed-point schedule as the host
+# functions above, but expressed as shape-stable jax so sweep_farm can
+# fold the wake equilibrium into its ONE compiled program.  The
+# wake<->rotor coupling enters through the (wind_speed, Ct, power)
+# curve tables produced by power_thrust_curve — i.e. the jitted BEM
+# evaluation, sampled once per rotor design, exactly as the host loop
+# consumes it via _curve_interp.
+
+def gaussian_deficit_jnp(x_d, y_d, Ct, k_w=0.05):
+    """jnp twin of :func:`gaussian_deficit` — identical math, plus
+    double-where guards so jax.grad stays finite at Ct -> 1 (the clip
+    boundary) and at rad <= 0 (sqrt(0) has an infinite derivative and
+    clip alone still differentiates the sqrt at 0)."""
+    Ct = jnp.clip(Ct, 0.0, CT_MAX)
+    sq = jnp.sqrt(jnp.maximum(1.0 - Ct, _ONE_MINUS_CT_MIN))
+    beta = 0.5 * (1.0 + sq) / sq
+    sigma_D = k_w * jnp.maximum(x_d, 0.1) + 0.25 * jnp.sqrt(beta)
+    rad = 1.0 - Ct / (8.0 * sigma_D**2)
+    rad_pos = rad > 0.0
+    C = 1.0 - jnp.where(rad_pos,
+                        jnp.sqrt(jnp.where(rad_pos, rad, 1.0)),
+                        jnp.where(rad > 1.0, 1.0, 0.0))
+    # rad > 1 cannot occur (Ct >= 0, sigma_D > 0) but keeps the clip
+    # semantics of the host exact: clip(rad, 0, 1) -> sqrt
+    dU = C * jnp.exp(-y_d**2 / (2.0 * sigma_D**2))
+    return jnp.where(x_d > 0.05, dU, 0.0)
+
+
+def wake_velocities_jnp(xy_w, D, Ct, U_inf, k_w=0.05):
+    """jnp twin of :func:`wake_velocities`, already in the wake frame.
+
+    xy_w: (n,2) positions rotated into the downwind/crosswind frame
+    (rotation is a case-level constant — do it once outside the fixed
+    point); D: (n,); Ct: (n,); U_inf scalar.  Returns (n,) speeds.
+    """
+    dx = (xy_w[:, 0][:, None] - xy_w[None, :, 0]) / D[None, :]
+    dy = (xy_w[:, 1][:, None] - xy_w[None, :, 1]) / D[None, :]
+    dU = gaussian_deficit_jnp(dx, dy, Ct[None, :], k_w)
+    n = xy_w.shape[0]
+    dU = dU * (1.0 - jnp.eye(n, dtype=dU.dtype))   # no self-deficit
+    ssq = jnp.sum(dU**2, axis=1)
+    return U_inf * (1.0 - jnp.sqrt(ssq))
+
+
+def _curve_interp_jnp(U, xs, vals, outside=0.0):
+    """jnp twin of :func:`_curve_interp` — clamped interp with the
+    parked-outside-range override."""
+    out = jnp.interp(U, xs, vals)
+    return jnp.where((U < xs[0]) | (U > xs[-1]), outside, out)
+
+
+def wake_equilibrium_jnp(xy, D, curve_speed, curve_Ct, curve_power,
+                         U_inf, wind_dir_deg, k_w=0.05, max_iter=100,
+                         tol=1e-4, relax=0.5):
+    """Device-resident farm wake fixed point — the jnp mirror of
+    :func:`find_wake_equilibrium`'s iteration, for ONE (U_inf, wind
+    direction) state of a homogeneous farm (one curve table shared by
+    all turbines).
+
+    The host loop breaks as soon as max|U_new - U| < tol, keeping
+    U = U_new and NOT re-interpolating Ct on the break iteration; the
+    while_loop state machine below reproduces that exactly, so the two
+    paths agree bitwise-modulo-interp-roundoff at any iteration count.
+
+    Returns dict(U (n,), Ct (n,), power (n,), iterations scalar int).
+    """
+    xy = jnp.asarray(xy)
+    n = xy.shape[0]
+    D = jnp.broadcast_to(jnp.asarray(D), (n,))
+    th = jnp.deg2rad(wind_dir_deg)
+    R = jnp.stack([jnp.stack([jnp.cos(th), jnp.sin(th)]),
+                   jnp.stack([-jnp.sin(th), jnp.cos(th)])])
+    xy_w = xy @ R.T
+
+    def interp_ct(U):
+        return _curve_interp_jnp(U, curve_speed, curve_Ct)
+
+    U0 = jnp.broadcast_to(jnp.asarray(U_inf, dtype=xy_w.dtype), (n,))
+    Ct0 = interp_ct(U0)
+
+    def cond(state):
+        U, Ct, it, done = state
+        return (~done) & (it < max_iter)
+
+    def body(state):
+        U, Ct, it, _ = state
+        U_new = wake_velocities_jnp(xy_w, D, Ct, U_inf, k_w)
+        conv = jnp.max(jnp.abs(U_new - U)) < tol
+        U2 = jnp.where(conv, U_new, relax * U + (1.0 - relax) * U_new)
+        Ct2 = jnp.where(conv, Ct, interp_ct(U2))
+        return (U2, Ct2, it + 1, conv)
+
+    U, Ct, it, _ = jax.lax.while_loop(
+        cond, body, (U0, Ct0, jnp.asarray(0), jnp.asarray(False)))
+    power = _curve_interp_jnp(U, curve_speed, curve_power)
+    return dict(U=U, Ct=Ct, power=power, iterations=it)
+
+
+def wake_equilibria_jnp(xy, D, curve_speed, curve_Ct, curve_power,
+                        U_inf, wind_dir_deg, k_w=0.05, max_iter=100,
+                        tol=1e-4, relax=0.5):
+    """vmap of :func:`wake_equilibrium_jnp` over a case axis.
+
+    U_inf, wind_dir_deg: (ncases,).  Returns dict with U/Ct/power of
+    shape (ncases, n_turbines) and iterations (ncases,).
+    """
+    def one(ui, wd):
+        return wake_equilibrium_jnp(
+            xy, D, curve_speed, curve_Ct, curve_power, ui, wd,
+            k_w=k_w, max_iter=max_iter, tol=tol, relax=relax)
+
+    return jax.vmap(one)(jnp.asarray(U_inf), jnp.asarray(wind_dir_deg))
 
 
 # --------------------------------------------------------------------------

@@ -266,6 +266,24 @@ def facts_from_manifest(doc: dict) -> dict:
             if opt.get("exec_cache"):
                 facts["optimize_exec_cache_warm"] = int(
                     opt["exec_cache"] == "hit")
+    # farm-axis facts (parallel/sweep.sweep_farm + bench.py farm): the
+    # batched N-turbines x M-cases throughput row and its zero-tolerance
+    # serial-parity gate (farm_parity_mismatch rule below)
+    farm = extra.get("farm_bench") or extra.get("farm") or {}
+    if isinstance(farm, dict):
+        for k in ("turbine_cases_per_min", "serial_turbine_cases_per_min",
+                  "speedup_vs_serial", "wake_iters", "wake_iters_max",
+                  "n_turbines", "ncases", "parity_max_rel",
+                  "nonfinite_lanes", "wall_s", "serial_lane_s",
+                  "build_s"):
+            if _num(farm.get(k)) is not None:
+                facts[f"farm_{k}"] = farm[k]
+        # unprefixed: named exactly by the SLO rule + bench fact
+        if _num(farm.get("farm_parity_mismatch")) is not None:
+            facts["farm_parity_mismatch"] = farm["farm_parity_mismatch"]
+        if farm.get("cache_state"):
+            facts["farm_exec_cache_warm"] = int(
+                farm["cache_state"] == "hit")
     # preemption chaos soak facts (serve/soak.py run_preempt):
     # ground-truth resume/storage integrity measured against the clean
     # uninterrupted run — the two zero-tolerance rules below gate them
@@ -604,6 +622,15 @@ DEFAULT_SLO_RULES = [
     {"name": "solve_residual_rel_max", "kind": "sweep_cases",
      "fact": "solve_residual_rel_max", "agg": "max", "op": "<=",
      "threshold": 1e-6, "window": 20},
+    # -- farm-axis parity gate (bench.py farm; fact present only on
+    # bench_farm rows — ordinary runs skip).  Zero-tolerance: a lane of
+    # the batched N-turbines x M-cases program whose response std
+    # disagrees with the serial per-turbine path beyond solver
+    # tolerance means the farm axis changed physics — a faster wrong
+    # number is never a result.
+    {"name": "farm_parity_mismatch",
+     "fact": "farm_parity_mismatch", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
     # -- distributed-tracing gate (obs/traceview.py; fact present only
     # on rows appended by `obsctl trace --trend-db` / the failover
     # soak — ordinary runs skip).  Zero-tolerance: an orphan span is a
@@ -707,6 +734,7 @@ FINGERPRINT_FACTS = (
     "mesh", "mesh_devices", "solve_precision", "serve_mode",
     "optimize_method", "bench_metric", "cases_total", "nw",
     "optimize_nlanes", "optimize_steps", "n_devices",
+    "farm_n_turbines", "farm_ncases",
 )
 
 #: bookkeeping facts whose run-to-run movement is expected (cache

@@ -147,6 +147,19 @@ def model_digest(obj) -> str:
     return digest_metrics(flat)
 
 
+def layout_digest(xy, D=None) -> str:
+    """Short content digest of a farm layout — the (n,2) turbine
+    positions (plus rotor diameters when given), rounded to the
+    millimeter so host float noise can't fork cache identities.  Carried
+    in farm exec-cache keys and salted into farm serve rdigests."""
+    # f64 on purpose: the digest must not fork with the precision mode
+    xy = np.round(np.asarray(xy, dtype=np.float64), 3)  # raftlint: disable=RTL003
+    flat: dict = {"xy": xy}
+    if D is not None:
+        flat["D"] = np.round(np.asarray(D, dtype=np.float64), 3)  # raftlint: disable=RTL003
+    return digest_metrics(flat)[7:][:16]
+
+
 def _env_facts() -> dict:
     import jax
 

@@ -72,7 +72,7 @@ FREQ = "__freq__"
 
 #: canonical mesh axis names (documentation + raftlint RTL006 config —
 #: the literals themselves must not leak outside this module)
-CANONICAL_AXES = ("variants", "cases", FREQ_AXIS, "designs")
+CANONICAL_AXES = ("variants", "cases", "turbines", FREQ_AXIS, "designs")
 
 
 # ---------------------------------------------------------------------------
@@ -125,6 +125,20 @@ CASE_INPUT_RULES = (
 #: sweep_variants inputs: every theta leaf carries a leading variant axis
 VARIANT_INPUT_RULES = (
     (r".*", P(BATCH)),
+)
+
+#: sweep_farm inputs: the sea-state scalars arrive as (L,) LANE arrays
+#: with L = n_turbines * ncases (turbine-major, lane = t*ncases + c), so
+#: BATCH — which resolves to the tuple of ALL non-freq mesh axes — lets
+#: the flattened turbine x case product shard over a ("turbines",
+#: "cases") mesh (or any 1-D batch mesh) through the same machinery the
+#: case sweep uses.  The wake drivers are (ncases,) per-CASE arrays
+#: consumed by the replicated in-program wake equilibrium; they stay
+#: unsharded (every device computes the identical (ncases, n_turbines)
+#: equilibrium — it is tiny next to one impedance solve).
+FARM_INPUT_RULES = (
+    (r"^(Hs|Tp|beta)$", P(BATCH)),
+    (r"^(U_inf|wind_dir)$", P()),
 )
 
 #: per-case response state during the drag fixed point (batch, 6, nw)

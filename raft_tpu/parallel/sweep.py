@@ -162,16 +162,29 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
     nw = len(fowt.w)
     dw = float(fowt.w[1] - fowt.w[0])
 
-    def setup(Hs, Tp, beta):
-        pose = fowt_pose(fowt, r6)
+    def setup(Hs, Tp, beta, r6_in=None, C_moor_in=None):
+        # r6_in/C_moor_in: per-lane overrides for the farm path — the
+        # platform reference pose (a traced (6,) array; fowt_pose is
+        # pure jnp) and a precomputed mooring stiffness.  The farm
+        # evaluates C_moor ONCE at the base reference position and
+        # passes it per lane: a platform translated together with its
+        # anchors has the identical stiffness, whereas evaluating the
+        # base fowt's mooring at a translated farm position would solve
+        # km-scale line spans.  Defaults reproduce the single-FOWT path
+        # bitwise.
+        r6_eff = r6 if r6_in is None else r6_in
+        pose = fowt_pose(fowt, r6_eff)
         stat = fowt_statics(fowt, pose)
         hc = fowt_hydro_constants(fowt, pose)
-        # rotvec flavor for MoorPy parity (coincides with the Euler
-        # jacobian at the zero-angle reference pose used here, but keeps
-        # the two sweep paths on the same convention as Model)
-        C_moor = (mr.coupled_stiffness_rotvec(fowt.mooring, r6)
-                  if fowt.mooring is not None
-                  else jnp.zeros((6, 6), dtype=_config.real_dtype()))
+        if C_moor_in is not None:
+            C_moor = jnp.asarray(C_moor_in, dtype=_config.real_dtype())
+        else:
+            # rotvec flavor for MoorPy parity (coincides with the Euler
+            # jacobian at the zero-angle reference pose used here, but
+            # keeps the two sweep paths on the same convention as Model)
+            C_moor = (mr.coupled_stiffness_rotvec(fowt.mooring, r6_eff)
+                      if fowt.mooring is not None
+                      else jnp.zeros((6, 6), dtype=_config.real_dtype()))
 
         S = jonswap(w, Hs, Tp)
         zeta = jnp.sqrt(2.0 * S * dw).astype(_config.complex_dtype())
@@ -222,7 +235,8 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         std = jax.vmap(lambda row: get_rms(row))(Xi)
         return dict(Xi=Xi, std=std)
 
-    def solve_batched(Hs, Tp, beta, Xi0=None):
+    def solve_batched(Hs, Tp, beta, Xi0=None, r6_b=None, C_moor_b=None,
+                      B_add=None, F_add=None):
         """Explicitly batched case sweep: vmapped setup + manually batched
         fixed point (vmap around the loop primitive compiles ~300x slower
         on XLA:TPU; see make_variant_solver.batched).
@@ -232,8 +246,41 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
         (:mod:`raft_tpu.serve.resultstore`).  The iteration scheme is
         unchanged: a seed only moves the starting point, so a good seed
         converges in fewer executed passes (``iters``) and a bad one is
-        caught by the same convergence test a cold start faces."""
-        st = jax.vmap(setup)(Hs, Tp, beta)
+        caught by the same convergence test a cold start faces.
+
+        Farm hooks (all default-None, the single-FOWT program is
+        byte-identical without them):
+
+        - ``r6_b``/``C_moor_b`` (``(ncases, 6)`` / ``(ncases, 6, 6)``,
+          both or neither): per-lane platform reference pose and mooring
+          stiffness — a lane becomes (turbine at its layout position,
+          case), which is how :func:`make_farm_solver` stacks N turbines
+          x M cases into one batch.
+        - ``B_add`` (``(ncases, 6, 6)``): additional linear damping per
+          lane, added to the radiation damping before the drag fixed
+          point — the wake-coupled rotor state enters the spectral solve
+          here as the linearized aero damping at each turbine's waked
+          wind speed.
+        - ``F_add`` (``(ncases, 6, nw)`` complex): additional excitation
+          per lane (the matching aero-excitation hook).
+        """
+        if (r6_b is None) != (C_moor_b is None):
+            raise errors.ModelConfigError(
+                "solve_batched: r6_b and C_moor_b come as a pair — the "
+                "farm evaluates mooring stiffness at the base reference "
+                "position, never implicitly at a translated r6")
+        if r6_b is None:
+            st = jax.vmap(setup)(Hs, Tp, beta)
+        else:
+            st = jax.vmap(setup)(Hs, Tp, beta, jnp.asarray(r6_b),
+                                 jnp.asarray(C_moor_b))
+        if B_add is not None:
+            st = dict(st)
+            st["B_BEM"] = st["B_BEM"] + jnp.asarray(B_add)[..., None]
+        if F_add is not None:
+            st = dict(st)
+            st["F_lin"] = st["F_lin"] + jnp.asarray(
+                F_add, dtype=_config.complex_dtype())
         nc = Hs.shape[0]
         if Xi0 is None:
             Xi0 = jnp.zeros((nc, 6, nw),
@@ -297,9 +344,11 @@ def make_case_solver(fowt: FOWTModel, nIter: int = 10, tol: float = 0.01,
                     fp_chunks=chunks, **health_out)
 
     solve.batched = solve_batched
-    # introspection hook: the per-case state pytree at the
+    # introspection hooks: the per-case state pytree at the
     # statics->dynamics boundary (partition-rule tests match over it)
+    # and the drag pass (the farm solver reuses both)
     solve.setup = setup
+    solve.drag_step = drag_step
     return solve
 
 
@@ -1057,3 +1106,684 @@ def sweep_cases(fowt: FOWTModel, Hs, Tp, beta, mesh: Mesh = None,
             pass
         obs.finish_run(manifest, status=status, write_trace=False,
                        ledger=ledger)
+
+
+# ---------------------------------------------------------------------------
+# the farm axis: N turbines x M cases in ONE compiled program
+# ---------------------------------------------------------------------------
+# A farm lane is (turbine at its layout position, case).  The turbine x
+# case product flattens turbine-major into L = n_turbines * ncases lanes
+# (lane = t * ncases + c) so the SAME batched machinery — vmapped setup,
+# unrolled fixed point, STATE_RULES resharding, health, probes — solves
+# the whole farm; partition.BATCH resolves to the tuple of all non-freq
+# mesh axes, so the lane axis shards over a ("turbines", "cases") mesh
+# (or any 1-D batch mesh) with no new placement code.  The wake <-> rotor
+# coupling runs IN-PROGRAM: the jnp wake equilibrium
+# (models/wake.wake_equilibria_jnp, a shape-stable lax.while_loop over
+# the BEM-derived power/thrust curve) produces per-(case, turbine) waked
+# wind speeds, which enter each lane's spectral solve as linearized aero
+# damping (B_add).  Array-mooring coupled stiffness enters at the
+# statics boundary via the per-lane C_moor override.
+
+def _interp_along0(xs, ys, x):
+    """Piecewise-linear interpolation of a table ``ys`` (n, ...) along
+    its leading axis at query points ``x`` (m,) -> (m, ...); clamped
+    inside the table, ZERO outside it (parked semantics, matching
+    wake._curve_interp — below cut-in / above cut-out the rotor
+    contributes no aero damping)."""
+    xs = jnp.asarray(xs)
+    ys = jnp.asarray(ys)
+    x = jnp.asarray(x)
+    idx = jnp.clip(jnp.searchsorted(xs, x, side="right") - 1,
+                   0, xs.shape[0] - 2)
+    x0 = xs[idx]
+    x1 = xs[idx + 1]
+    f = jnp.clip((x - x0) / (x1 - x0), 0.0, 1.0)
+    expand = (slice(None),) + (None,) * (ys.ndim - 1)
+    out = ys[idx] * (1.0 - f)[expand] + ys[idx + 1] * f[expand]
+    parked = (x < xs[0]) | (x > xs[-1])
+    return jnp.where(parked[expand], jnp.zeros_like(out), out)
+
+
+def aero_damping_table(curve, zhub):
+    """(nspeeds, 6, 6) linearized aero-damping table from a BEM
+    power/thrust curve: B_aero = dT/dU at the operating point, acting at
+    hub height — the standard quasi-steady surge/pitch damping
+    [[dT/dU, dT/dU*z], [dT/dU*z, dT/dU*z^2]] on the (surge, pitch)
+    block.  Interpolated per lane at the WAKED wind speed, this is how
+    the wake equilibrium's rotor state feeds each turbine's spectral
+    solve."""
+    ws = np.asarray(curve["wind_speed"], float)
+    dTdU = np.gradient(np.asarray(curve["thrust"], float), ws)
+    B = np.zeros((len(ws), 6, 6))
+    B[:, 0, 0] = dTdU
+    B[:, 0, 4] = B[:, 4, 0] = dTdU * zhub
+    B[:, 4, 4] = dTdU * zhub**2
+    return B
+
+
+def make_farm_solver(fowt: FOWTModel, xy, curve=None, C_moor_t=None,
+                     aero: bool = True, k_w: float = 0.05,
+                     wake_max_iter: int = 100, wake_tol: float = 1e-4,
+                     wake_relax: float = 0.5, mesh: Mesh = None, **kw):
+    """Batched farm solver: N turbines x M cases as ONE jit-able pure
+    function.
+
+    ``xy``: (n_turbines, 2) layout positions [m].  The farm is
+    HOMOGENEOUS — one platform/rotor design (``fowt``) replicated at
+    each position; heterogeneous arrays (per-turbine heading_adjust,
+    mixed platforms) still go through the serial Model path.
+
+    ``curve``: optional precomputed power/thrust curve dict (from
+    :func:`raft_tpu.models.wake.power_thrust_curve`); built from the
+    fowt's rotor by default.  ``C_moor_t``: optional (n_turbines, 6, 6)
+    per-turbine mooring stiffness — the statics-boundary entry point for
+    ``models/mooring_array`` coupled stiffness (Model.sweep_farm passes
+    its array-mooring diagonal blocks here).  Default: the base fowt's
+    own mooring stiffness evaluated ONCE at its reference position and
+    shared by every turbine (translation invariance — a platform moved
+    together with its anchors has identical stiffness).
+
+    ``aero``: interpolate the linearized aero-damping table at each
+    lane's waked wind speed and add it to the radiation damping;
+    ``False`` solves wave-only lanes (the wake outputs still ride
+    along).  Remaining ``kw`` goes to :func:`make_case_solver`
+    (``nIter``, ``tol``, ``fp_chunk``, ``relax``, ``health``, ...).
+
+    Returns ``solve_farm(Hs, Tp, beta, U_inf, wind_dir, Xi0=None)``:
+    ``Hs``/``Tp``/``beta`` are (L,) turbine-major LANE arrays with
+    L = n_turbines * ncases (lane = t*ncases + c; :func:`sweep_farm`
+    tiles per-case sea states for you), ``U_inf``/``wind_dir`` (ncases,)
+    per-case wake drivers.  Output dict: lane-shaped ``Xi`` (L, 6, nw),
+    ``std`` (L, 6), ``converged``/``iters`` (L,), ``fp_chunks``, plus
+    farm outputs ``U_wake``/``Ct_wake``/``aero_power`` (n_turbines,
+    ncases) and ``wake_iters`` (ncases,)."""
+    from raft_tpu.models import wake as wk
+
+    xy = np.asarray(xy, float).reshape(-1, 2)
+    nt = int(xy.shape[0])
+    if nt < 1:
+        raise errors.ModelConfigError("farm needs at least one turbine",
+                                      n_turbines=nt)
+    rot = fowt.rotors[0] if fowt.rotors else None
+    if curve is None:
+        if rot is None:
+            raise errors.ModelConfigError(
+                "make_farm_solver needs a rotor (or an explicit curve=) "
+                "to build the wake power/thrust coupling")
+        curve = wk.power_thrust_curve(fowt)
+    D = np.full(nt, 2.0 * rot.R_rot if rot is not None
+                else float(curve.get("rotor_diameter", 200.0)))
+    rdt = _config.real_dtype()
+    r6_ref = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], float)
+    if C_moor_t is None:
+        C_base = (np.asarray(mr.coupled_stiffness_rotvec(fowt.mooring,
+                                                         r6_ref))
+                  if fowt.mooring is not None else np.zeros((6, 6)))
+        C_moor_t = np.broadcast_to(C_base, (nt, 6, 6)).copy()
+    else:
+        C_moor_t = np.asarray(C_moor_t, float).reshape(nt, 6, 6)
+    r6_t = np.zeros((nt, 6))
+    r6_t[:, :2] = xy
+
+    case = make_case_solver(fowt, mesh=mesh, **kw)
+
+    # device-resident farm constants (baked into the compiled program)
+    cs = jnp.asarray(curve["wind_speed"], rdt)
+    cCt = jnp.asarray(curve["Ct"], rdt)
+    cP = jnp.asarray(curve["power"], rdt)
+    xy_j = jnp.asarray(xy, rdt)
+    D_j = jnp.asarray(D, rdt)
+    r6_j = jnp.asarray(r6_t, rdt)
+    C_j = jnp.asarray(C_moor_t, rdt)
+    B_tab = (jnp.asarray(aero_damping_table(curve, float(rot.hubHt)), rdt)
+             if (aero and rot is not None) else None)
+
+    def solve_farm(Hs, Tp, beta, U_inf, wind_dir, Xi0=None):
+        nc = U_inf.shape[0]
+        # in-program wake equilibrium: tiny next to one impedance solve,
+        # computed replicated on every device (no sharded axis touches
+        # it — FARM_INPUT_RULES keeps U_inf/wind_dir unsharded), so the
+        # per-lane aero damping needs no cross-device communication
+        eq = wk.wake_equilibria_jnp(
+            xy_j, D_j, cs, cCt, cP,
+            jnp.asarray(U_inf, rdt), jnp.asarray(wind_dir, rdt),
+            k_w=k_w, max_iter=wake_max_iter, tol=wake_tol,
+            relax=wake_relax)
+        U_t = eq["U"].T                      # (nt, nc)
+        U_l = jnp.reshape(U_t, (-1,))        # turbine-major lanes
+        r6_l = jnp.repeat(r6_j, nc, axis=0)  # (L, 6)
+        C_l = jnp.repeat(C_j, nc, axis=0)    # (L, 6, 6)
+        B_add = _interp_along0(cs, B_tab, U_l) if B_tab is not None \
+            else None
+        out = case.batched(Hs, Tp, beta, Xi0=Xi0, r6_b=r6_l,
+                           C_moor_b=C_l, B_add=B_add)
+        out = dict(out)
+        out["U_wake"] = U_t
+        out["Ct_wake"] = eq["Ct"].T
+        out["aero_power"] = eq["power"].T
+        out["wake_iters"] = eq["iterations"]
+        return out
+
+    solve_farm.n_turbines = nt
+    solve_farm.layout = xy
+    solve_farm.curve = curve
+    solve_farm.C_moor_t = C_moor_t
+    solve_farm.case = case
+    solve_farm.aero = bool(aero and B_tab is not None)
+    solve_farm.wake_kw = dict(k_w=float(k_w),
+                              wake_max_iter=int(wake_max_iter),
+                              wake_tol=float(wake_tol),
+                              wake_relax=float(wake_relax))
+    return solve_farm
+
+
+def _farm_lane_tile(x, nt):
+    """(ncases,) case array -> (L,) turbine-major lane array."""
+    return jnp.tile(jnp.asarray(x), (int(nt),))
+
+
+def _farm_reshape(out, nt, ncases):
+    """Lane-shaped program outputs -> (n_turbines, ncases, ...) host
+    view, stripping case padding: lane arrays reshape to (nt, nc_pad,
+    ...) and take [:, :ncases]; the replicated wake outputs take their
+    case columns; scalars pass through."""
+    shaped = {}
+    for k, v in out.items():
+        if k == "fp_chunks":
+            shaped[k] = v
+        elif k in ("U_wake", "Ct_wake", "aero_power"):
+            shaped[k] = v[:, :ncases]
+        elif k == "wake_iters":
+            shaped[k] = v[:ncases]
+        else:
+            lead = v.shape[0] // nt
+            shaped[k] = jnp.reshape(v, (nt, lead) + v.shape[1:])[
+                :, :ncases]
+    return shaped
+
+
+def sweep_farm(fowt: FOWTModel, xy, Hs, Tp, beta, U_inf, wind_dir=None,
+               mesh: Mesh = None, **kw):
+    """Solve an N-turbine x M-case farm batch as ONE compiled program,
+    sharding the flattened (turbines x cases) lane axis over ``mesh``.
+
+    ``xy``: (n_turbines, 2) layout [m].  ``Hs``/``Tp``/``beta``:
+    (ncases,) per-case sea states, shared by every turbine of a case
+    (tiled turbine-major into the lane axis here).  ``U_inf``:
+    (ncases,) free-stream hub wind speeds driving the in-program wake
+    equilibrium; ``wind_dir`` (ncases,) wake-frame directions [deg]
+    (default all zero).  Remaining ``kw`` goes to
+    :func:`make_farm_solver` / :func:`make_case_solver`.
+
+    Returns a dict of (n_turbines, ncases, ...) outputs: ``Xi``,
+    ``std``, ``converged``, ``iters``, the wake state ``U_wake`` /
+    ``Ct_wake`` / ``aero_power``, per-case ``wake_iters``, and the
+    scalar ``fp_chunks``.
+
+    Lifecycle is sweep_cases' exactly: RunManifest (kind ``sweep_farm``)
+    with build/cache_key/lower/compile/execute spans, executable cache
+    keyed on the farm facts (model digest, n_turbines, LAYOUT DIGEST,
+    wake knobs, lane batch shape, mesh topology + rule fingerprint), a
+    cached-call error demoting to recompile-once, case padding to the
+    mesh batch multiple (stripped before any metric), and ONE sanctioned
+    counted summary pull (wake facts ride in it).  Batch quarantine is
+    NOT wired for farm lanes yet (a farm lane re-solve needs its wake
+    state re-fed) — non-finite lanes are reported, not re-solved."""
+    from raft_tpu import obs
+    from raft_tpu.ops import linalg as _linalg
+    from raft_tpu.parallel import exec_cache, partition
+
+    health = kw.pop("health", None)
+    health = _config.health_enabled() if health is None else bool(health)
+    xy = np.asarray(xy, float).reshape(-1, 2)
+    nt = int(xy.shape[0])
+    Hs = np.asarray(Hs, float)
+    Tp = np.asarray(Tp, float)
+    beta = np.asarray(beta, float)
+    U_inf = np.asarray(U_inf, float)
+    wind_dir = (np.zeros_like(U_inf) if wind_dir is None
+                else np.asarray(wind_dir, float))
+    ncases = int(Hs.shape[0])
+    if not (Tp.shape[0] == beta.shape[0] == U_inf.shape[0]
+            == wind_dir.shape[0] == ncases):
+        raise errors.ModelConfigError(
+            "sweep_farm case arrays must share one length",
+            ncases=ncases, Tp=int(Tp.shape[0]), beta=int(beta.shape[0]),
+            U_inf=int(U_inf.shape[0]), wind_dir=int(wind_dir.shape[0]))
+    mesh_info = partition.mesh_facts(mesh)
+    ldig = exec_cache.layout_digest(xy)
+    manifest = obs.RunManifest.begin(kind="sweep_farm", config={
+        "ncases": ncases, "n_turbines": nt, "nw": len(fowt.w),
+        "layout_digest": ldig,
+        "sharded": mesh is not None,
+        "mesh_devices": 0 if mesh is None else int(mesh.devices.size),
+        "mesh": mesh_info,
+        **({"health": True} if health else {}),
+        **{k: v for k, v in kw.items()
+           if isinstance(v, (int, float, str))}})
+    obs.record_build_info(run_id=manifest.run_id)
+    obs.device.jit_cache_delta(scope="sweep_farm")
+    transfers0 = obs.transfers.snapshot()
+    status = "failed"
+    ledger = None
+    try:
+        with obs.span("sweep_farm", ncases=ncases, n_turbines=nt,
+                      sharded=mesh is not None) as sp:
+            with obs.span("farm_build", ncases=ncases, n_turbines=nt):
+                solver = make_farm_solver(fowt, xy, mesh=mesh,
+                                          health=health, **kw)
+                batched = jax.jit(solver)
+                npad = 0
+                if mesh is not None:
+                    # pad the CASE axis to the mesh batch multiple —
+                    # the lane count L = nt * nc_pad then divides the
+                    # batch-shard product for any nt
+                    (Hs, Tp, beta, U_inf, wind_dir), npad = \
+                        partition.pad_batch(
+                            (jnp.asarray(Hs), jnp.asarray(Tp),
+                             jnp.asarray(beta), jnp.asarray(U_inf),
+                             jnp.asarray(wind_dir)),
+                            ncases, partition.batch_size(mesh))
+                nc_pad = ncases + npad
+                lanes = {
+                    "Hs": _farm_lane_tile(Hs, nt),
+                    "Tp": _farm_lane_tile(Tp, nt),
+                    "beta": _farm_lane_tile(beta, nt),
+                    "U_inf": jnp.asarray(U_inf),
+                    "wind_dir": jnp.asarray(wind_dir)}
+                if mesh is not None:
+                    lanes = partition.shard_tree(
+                        lanes, mesh, partition.FARM_INPUT_RULES)
+                args = (lanes["Hs"], lanes["Tp"], lanes["beta"],
+                        lanes["U_inf"], lanes["wind_dir"])
+            key = None
+            exe = None
+            cache_info = {"state": "disabled"}
+            if exec_cache.enabled():
+                with obs.span("farm_cache_key", ncases=ncases):
+                    key = exec_cache.make_key(
+                        fn="sweep_farm",
+                        model=exec_cache.model_digest(fowt),
+                        nw=len(fowt.w),
+                        n_turbines=nt,
+                        layout=ldig,
+                        wake=solver.wake_kw,
+                        aero=solver.aero,
+                        batch_shape=[int(nt * nc_pad)],
+                        dtype=str(np.dtype(_config.real_dtype())),
+                        mesh=mesh_info,
+                        partition_rules=(
+                            None if mesh is None
+                            else partition.rules_fingerprint(
+                                partition.FARM_INPUT_RULES,
+                                partition.STATE_RULES,
+                                partition.XI_SPEC)),
+                        kw={k: v for k, v in kw.items()
+                            if isinstance(v, (int, float, str, bool))},
+                        # curve / C_moor_t / other array-valued config is
+                        # baked into the program — key it by content
+                        farm_arrays=exec_cache.model_digest(
+                            {"curve": solver.curve,
+                             "C_moor_t": solver.C_moor_t,
+                             **{k: v for k, v in kw.items()
+                                if not isinstance(v, (int, float, str,
+                                                      bool))}}),
+                        **({"health": True} if health else {}))
+                exe = exec_cache.load(key)
+                cache_info = {"state": "hit" if exe is not None
+                              else "miss", "key": key}
+            out = None
+            devprof_facts = None
+            if exe is not None:
+                try:
+                    with obs.span("farm_execute", ncases=ncases,
+                                  cached=True):
+                        out = exe.call(*args)
+                        jax.block_until_ready(out["std"])
+                except _CACHED_CALL_ERRORS as e:
+                    _LOG.warning(
+                        "cached farm executable %s failed (%s: %s) — "
+                        "recompiling", key, type(e).__name__, e)
+                    obs.record_exec_cache_event("call_error")
+                    cache_info = {"state": "error", "key": key,
+                                  "error":
+                                      f"{type(e).__name__}: {e}"[:200]}
+                    out = None
+            if out is None:
+                probe_gate = (obs.probes.suppress("aot-exported program")
+                              if key is not None
+                              else contextlib.nullcontext())
+                with obs.span("farm_lower", ncases=ncases), probe_gate:
+                    lowered = batched.lower(*args)
+                prof = obs.devprof.start("sweep_farm")
+                with obs.span("farm_compile", ncases=ncases):
+                    compiled = lowered.compile()
+                devprof_facts = prof.finish(lowered=lowered,
+                                            compiled=compiled)
+                with obs.span("farm_execute", ncases=ncases):
+                    out = compiled(*args)
+                    jax.block_until_ready(out["std"])
+                if key is not None:
+                    with obs.span("farm_cache_store", ncases=ncases), \
+                            obs.probes.suppress("aot-exported program"):
+                        stored = exec_cache.store(
+                            batched, args, key,
+                            meta={"fn": "sweep_farm", "ncases": ncases,
+                                  "n_turbines": nt, "nw": len(fowt.w),
+                                  "layout": ldig,
+                                  "solver": _linalg.last_dispatch(),
+                                  "devprof": devprof_facts})
+                    cache_info["stored"] = stored is not None
+            # (nt, nc, ...) views with the case padding stripped BEFORE
+            # any summary pull, metric, or ledger digest
+            out = _farm_reshape(out, nt, ncases)
+            # ONE sanctioned counted pull for the whole farm batch —
+            # the wake facts ride in it
+            pull = (out["iters"], out["converged"], out["fp_chunks"],
+                    _lane_finite(out["Xi"]), out["wake_iters"])
+            if health:
+                pull = pull + (out["health_residual"],
+                               out["health_cond"])
+            pulled = obs.transfers.device_get(
+                pull, what="farm_summary", phase="farm")
+            iters, conv_np, chunks_np, lane_ok, wake_iters = pulled[:5]
+            health_res = np.asarray(pulled[5]) if health else None
+            health_cond = np.asarray(pulled[6]) if health else None
+            iters = np.asarray(iters)
+            conv_np = np.asarray(conv_np)
+            lane_ok = np.asarray(lane_ok)
+            wake_iters = np.asarray(wake_iters)
+            n_conv = int(conv_np.sum())
+            n_lanes = int(conv_np.size)
+            fp_chunks = int(chunks_np)
+            nonfinite = int(np.count_nonzero(~lane_ok))
+            sp.set(converged=n_conv, lanes=n_lanes,
+                   iters_max=int(iters.max(initial=0)),
+                   fp_chunks=fp_chunks,
+                   wake_iters_max=int(wake_iters.max(initial=0)),
+                   nonfinite_lanes=nonfinite,
+                   exec_cache=cache_info["state"])
+            if mesh_info is not None:
+                sp.set(mesh=mesh_info["topology"])
+                obs.gauge(
+                    "raft_tpu_mesh_devices",
+                    "devices in the active sweep mesh, labeled by the "
+                    "ordered axis topology").set(
+                        mesh_info["devices"],
+                        topology=mesh_info["topology"])
+            obs.histogram(
+                "raft_sweep_fixed_point_iterations",
+                "per-case drag fixed-point iterations in the batched sweep",
+                buckets=obs.ITER_BUCKETS).observe_many(iters.ravel())
+            obs.gauge(
+                "raft_sweep_converged_cases",
+                "cases whose drag fixed point converged within nIter",
+                ).set(n_conv, sharded=str(mesh is not None).lower())
+            obs.gauge(
+                "raft_sweep_batch_cases",
+                "case-batch size of the most recent sweep",
+                ).set(n_lanes, sharded=str(mesh is not None).lower())
+            obs.gauge(
+                "raft_tpu_farm_wake_iterations",
+                "wake-equilibrium fixed-point iterations of the most "
+                "recent farm batch (max over cases)").set(
+                    int(wake_iters.max(initial=0)))
+            health_info = None
+            if health:
+                health_info = _health_summary(
+                    "farm", health_res.ravel(), health_cond.ravel(),
+                    lane_ok.ravel(), iters.ravel())
+                sp.set(health_residual_max=health_info[
+                           "residual_rel_max"],
+                       health_nonfinite=health_info["nonfinite_lanes"])
+        manifest.extra["exec_cache"] = cache_info
+        manifest.extra["farm"] = {
+            "n_turbines": nt, "ncases": ncases,
+            "layout_digest": ldig, "aero": solver.aero,
+            "wake": solver.wake_kw,
+            "wake_iters_max": int(wake_iters.max(initial=0)),
+            "nonfinite_lanes": nonfinite}
+        if mesh_info is not None:
+            manifest.extra["partition"] = {
+                "mesh": mesh_info, "npad": npad,
+                "rules": partition.rules_fingerprint(
+                    partition.FARM_INPUT_RULES, partition.STATE_RULES,
+                    partition.XI_SPEC)}
+        solver_dispatch = _linalg.last_dispatch()
+        if cache_info["state"] == "hit":
+            meta = exec_cache.load_meta(key) or {}
+            solver_dispatch = meta.get("solver", solver_dispatch)
+            devprof_facts = meta.get("devprof")
+        manifest.extra["solver"] = solver_dispatch
+        obs.devprof.attach(manifest, devprof_facts)
+        if health_info is not None:
+            manifest.extra["solve_health"] = health_info
+        manifest.extra["fixed_point"] = {
+            "chunks_run": fp_chunks,
+            "iters_max": int(iters.max(initial=0))}
+        manifest.extra["host_transfers"] = obs.transfers.delta(
+            transfers0, obs.transfers.snapshot())
+        obs.device.collect(manifest, scope="sweep_farm")
+        # the ledger walks a 1-D case axis — hand it the flattened
+        # turbine-major lane view (lane i = turbine i//ncases, case
+        # i%ncases)
+        ledger = obs.ledger_from_sweep(
+            {"std": np.asarray(out["std"]).reshape(nt * ncases, -1),
+             "iters": iters.reshape(-1),
+             "converged": conv_np.reshape(-1)},
+            config=dict(manifest.config), run_id=manifest.run_id)
+        status = "ok"
+        return out
+    finally:
+        try:
+            jax.effects_barrier()
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+        obs.finish_run(manifest, status=status, write_trace=False,
+                       ledger=ledger)
+
+
+def make_farm_runner(fowt: FOWTModel, xy, ncases: int,
+                     warmup: bool = True, mesh: Mesh = None, **kw):
+    """One warm, reusable compiled farm program for the serving loop —
+    :func:`make_batch_runner`'s farm twin (same build-once /
+    execute-many shape, same exec-cache + devprof lifecycle).
+
+    ``ncases`` is the per-turbine case count; the program's lane batch
+    is ``n_turbines * run.ncases`` with the case count rounded up to the
+    mesh batch multiple.  Returns ``run(Hs, Tp, beta, U_inf,
+    wind_dir) -> out`` taking (run.ncases,) CASE arrays (the service
+    pads short batches) and returning the lane-shaped program outputs
+    plus wake state, exactly as :func:`make_farm_solver` documents.
+    The callable carries ``.ncases``, ``.n_turbines``, ``.layout``,
+    ``.cache_state``, ``.key``, ``.devprof`` and ``.build_s``."""
+    import time as _time
+
+    from raft_tpu import obs
+    from raft_tpu.parallel import exec_cache, partition
+
+    t0 = _time.perf_counter()
+    ncases = int(ncases)
+    health = kw.pop("health", None)
+    health = _config.health_enabled() if health is None else bool(health)
+    if mesh is not None:
+        ncases += (-ncases) % partition.batch_size(mesh)
+    solver = make_farm_solver(fowt, xy, mesh=mesh, health=health, **kw)
+    nt = solver.n_turbines
+    ldig = exec_cache.layout_digest(solver.layout)
+    batched = jax.jit(solver)
+    dtype = _config.real_dtype()
+
+    def _place(Hs, Tp, beta, U_inf, wind_dir):
+        lanes = {"Hs": _farm_lane_tile(Hs, nt),
+                 "Tp": _farm_lane_tile(Tp, nt),
+                 "beta": _farm_lane_tile(beta, nt),
+                 "U_inf": jnp.asarray(U_inf, dtype),
+                 "wind_dir": jnp.asarray(wind_dir, dtype)}
+        if mesh is not None:
+            lanes = partition.shard_tree(lanes, mesh,
+                                         partition.FARM_INPUT_RULES)
+        return (lanes["Hs"], lanes["Tp"], lanes["beta"],
+                lanes["U_inf"], lanes["wind_dir"])
+
+    args = _place(*(jnp.zeros((ncases,), dtype) for _ in range(5)))
+    exe = None
+    key = None
+    cache_state = "disabled"
+    if exec_cache.enabled():
+        key = exec_cache.make_key(
+            fn="farm_serve",
+            model=exec_cache.model_digest(fowt),
+            nw=len(fowt.w),
+            n_turbines=nt,
+            layout=ldig,
+            wake=solver.wake_kw,
+            aero=solver.aero,
+            batch_shape=[int(nt * ncases)],
+            dtype=str(np.dtype(dtype)),
+            mesh=partition.mesh_facts(mesh),
+            partition_rules=(None if mesh is None
+                             else partition.rules_fingerprint(
+                                 partition.FARM_INPUT_RULES,
+                                 partition.STATE_RULES,
+                                 partition.XI_SPEC)),
+            kw={k: v for k, v in kw.items()
+                if isinstance(v, (int, float, str, bool))},
+            farm_arrays=exec_cache.model_digest(
+                {"curve": solver.curve, "C_moor_t": solver.C_moor_t,
+                 **{k: v for k, v in kw.items()
+                    if not isinstance(v, (int, float, str, bool))}}),
+            **({"health": True} if health else {}))
+        exe = exec_cache.load(key, memo=True)
+        cache_state = "hit" if exe is not None else "miss"
+    compiled = None
+    devprof_facts = None
+    if exe is None:
+        probe_gate = (obs.probes.suppress("aot-exported program")
+                      if key is not None else contextlib.nullcontext())
+        with obs.span("farm_serve_build", ncases=ncases,
+                      n_turbines=nt), probe_gate:
+            lowered = batched.lower(*args)
+            prof = obs.devprof.start("farm_serve")
+            compiled = lowered.compile()
+            devprof_facts = prof.finish(lowered=lowered,
+                                        compiled=compiled)
+            if key is not None:
+                exec_cache.store(batched, args, key,
+                                 meta={"fn": "farm_serve",
+                                       "ncases": ncases,
+                                       "n_turbines": nt,
+                                       "layout": ldig,
+                                       "nw": len(fowt.w),
+                                       "health": health,
+                                       "devprof": devprof_facts})
+    elif key is not None:
+        devprof_facts = (exec_cache.load_meta(key) or {}).get("devprof")
+
+    def run(Hs, Tp, beta, U_inf, wind_dir):
+        call_args = _place(jnp.asarray(Hs, dtype),
+                           jnp.asarray(Tp, dtype),
+                           jnp.asarray(beta, dtype),
+                           U_inf, wind_dir)
+        out = (exe.call(*call_args) if exe is not None
+               else compiled(*call_args))
+        jax.block_until_ready(out["std"])
+        return out
+
+    if warmup:
+        run(jnp.full((ncases,), 1.0, dtype),
+            jnp.full((ncases,), 8.0, dtype),
+            jnp.zeros((ncases,), dtype),
+            jnp.full((ncases,), 10.0, dtype),
+            jnp.zeros((ncases,), dtype))
+
+    run.ncases = ncases
+    run.n_turbines = nt
+    run.layout = solver.layout
+    run.layout_digest = ldig
+    run.curve = solver.curve
+    run.cache_state = cache_state
+    run.key = key
+    run.mesh = mesh
+    run.health = health
+    run.devprof = devprof_facts
+    run.nw = int(len(fowt.w))
+    run.build_s = _time.perf_counter() - t0
+    return run
+
+
+def normalize_farm_request(spec, turbines_max: int = 16,
+                           cases_max: int = 4096) -> dict:
+    """Validate + canonicalize a farm serve request spec into plain
+    floats/arrays (typed :class:`~raft_tpu.errors.ModelConfigError` on
+    junk — the admission boundary, same stance as the optimize spec).
+
+    Spec keys: ``layout`` (required, (n_turbines, 2) positions [m]),
+    ``Hs``/``Tp``/``beta``/``U_inf`` (required, equal-length per-case
+    lists), ``wind_dir`` (optional, default zeros), ``k_w`` (optional
+    wake-expansion knob)."""
+    if not isinstance(spec, dict):
+        raise errors.ModelConfigError(
+            "farm spec must be a mapping", got=type(spec).__name__)
+    try:
+        layout = np.asarray(spec["layout"], float)
+    except KeyError:
+        raise errors.ModelConfigError("farm spec needs a layout")
+    except (TypeError, ValueError) as e:
+        raise errors.ModelConfigError(
+            "farm layout must be an (n_turbines, 2) array of positions",
+            error=str(e)[:200])
+    if layout.ndim != 2 or layout.shape[1] != 2 or layout.shape[0] < 1:
+        raise errors.ModelConfigError(
+            "farm layout must be an (n_turbines, 2) array of positions",
+            shape=list(layout.shape))
+    if not np.all(np.isfinite(layout)):
+        raise errors.ModelConfigError("farm layout must be finite")
+    nt = int(layout.shape[0])
+    if nt > int(turbines_max):
+        raise errors.ModelConfigError(
+            "farm turbine count exceeds the tenant cap",
+            n_turbines=nt, turbines_max=int(turbines_max))
+    arrays = {}
+    for k in ("Hs", "Tp", "beta", "U_inf"):
+        if k not in spec:
+            raise errors.ModelConfigError(
+                f"farm spec needs per-case '{k}'")
+        try:
+            arrays[k] = np.atleast_1d(np.asarray(spec[k], float))
+        except (TypeError, ValueError) as e:
+            raise errors.ModelConfigError(
+                f"farm '{k}' must be a numeric per-case list",
+                error=str(e)[:200])
+        if arrays[k].ndim != 1 or not np.all(np.isfinite(arrays[k])):
+            raise errors.ModelConfigError(
+                f"farm '{k}' must be a finite 1-D per-case list")
+    ncases = int(arrays["Hs"].shape[0])
+    if ncases < 1 or ncases > int(cases_max):
+        raise errors.ModelConfigError(
+            "farm case count outside the tenant cap",
+            ncases=ncases, cases_max=int(cases_max))
+    if any(int(a.shape[0]) != ncases for a in arrays.values()):
+        raise errors.ModelConfigError(
+            "farm per-case lists must share one length",
+            lengths={k: int(a.shape[0]) for k, a in arrays.items()})
+    wd = spec.get("wind_dir")
+    wd = (np.zeros(ncases) if wd is None
+          else np.atleast_1d(np.asarray(wd, float)))
+    if wd.shape[0] != ncases or not np.all(np.isfinite(wd)):
+        raise errors.ModelConfigError(
+            "farm wind_dir must be a finite per-case list",
+            ncases=ncases, got=int(wd.shape[0]))
+    k_w = spec.get("k_w", 0.05)
+    try:
+        k_w = float(k_w)
+    except (TypeError, ValueError):
+        raise errors.ModelConfigError("farm k_w must be a number",
+                                      got=repr(k_w)[:50])
+    if not (0.0 < k_w < 1.0):
+        raise errors.ModelConfigError(
+            "farm k_w outside (0, 1)", k_w=k_w)
+    return dict(layout=layout, Hs=arrays["Hs"], Tp=arrays["Tp"],
+                beta=arrays["beta"], U_inf=arrays["U_inf"],
+                wind_dir=wd, k_w=k_w, n_turbines=nt, ncases=ncases)

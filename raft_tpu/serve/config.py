@@ -162,6 +162,13 @@ class ServeConfig:
     optimize_lanes_max: int = 256
     optimize_steps_max: int = 200
 
+    # -- farm tenant (parallel/sweep.sweep_farm) -----------------------
+    #: resource guards on POST /farm requests: turbines and per-turbine
+    #: cases one request may ask for (a compile-bomb layout is a typed
+    #: reject at admission, never a wedged service)
+    farm_turbines_max: int = 16
+    farm_cases_max: int = 1024
+
     # -- tenancy (serve/tenancy.py) -----------------------------------
     #: warm compiled batch programs kept live across all tenants;
     #: least-recently-used runners are evicted (and re-warmed from the
@@ -214,6 +221,8 @@ class ServeConfig:
             ("max_live_programs", self.max_live_programs >= 1),
             ("optimize_lanes_max", self.optimize_lanes_max >= 1),
             ("optimize_steps_max", self.optimize_steps_max >= 1),
+            ("farm_turbines_max", self.farm_turbines_max >= 1),
+            ("farm_cases_max", self.farm_cases_max >= 1),
             ("nIter", self.nIter >= 1),
         ]
         bad = [name for name, ok in checks if not ok]

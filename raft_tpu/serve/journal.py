@@ -160,6 +160,30 @@ def optimize_digest(spec: dict, tenant: str = "default") -> str:
                            "tenant": str(tenant)})
 
 
+def farm_digest(spec: dict, tenant: str = "default") -> str:
+    """Content address of one farm request: the dedupe/single-flight
+    key over the canonical farm spec — which INCLUDES the layout, so
+    the rdigest is salted by turbine positions (two farms with the same
+    case table but different layouts never dedupe into one flight)."""
+    import json
+
+    from raft_tpu.obs.ledger import digest_metrics
+    return digest_metrics({"farm": json.dumps(spec, sort_keys=True,
+                                              default=str),
+                           "tenant": str(tenant)})
+
+
+def farm_result_digest(std_norm: float, n_turbines: int,
+                       ncases: int, wake_iters: int) -> str:
+    """The content address of one farm delivery — the recover/replay
+    verdict's "resumed digest == clean-run digest" comparison key."""
+    from raft_tpu.obs.ledger import digest_metrics
+    return digest_metrics({
+        "farm_std_norm": float(std_norm),
+        "n_turbines": int(n_turbines), "ncases": int(ncases),
+        "wake_iters": int(wake_iters)})
+
+
 class RequestJournal:
     """The service's append-only WAL (one per journal directory).
 
@@ -276,11 +300,13 @@ class RequestJournal:
     def record_admit(self, seq: int, request_id: str, rdigest: str,
                      Hs: float, Tp: float, beta: float,
                      deadline_s: float, tenant: str, opt: dict = None,
-                     trace: dict = None):
+                     farm: dict = None, trace: dict = None):
         """``opt`` (optimize tenant): the canonical design-optimization
         request spec — bounds + objective + descent knobs.  Carried in
         the admit record so replay can re-run an accepted-but-unfinished
-        optimization exactly as submitted.
+        optimization exactly as submitted.  ``farm`` (farm tenant): the
+        canonical farm request spec (layout + case table + wake knobs),
+        journaled for exactly the same replay reason.
 
         ``trace``: the request's distributed trace context
         (``{trace_id, span_id, parent_id}``) — journaled so the trace
@@ -292,6 +318,8 @@ class RequestJournal:
                    tenant=str(tenant))
         if opt is not None:
             rec["opt"] = dict(opt)
+        if farm is not None:
+            rec["farm"] = dict(farm)
         if trace is not None:
             rec["trace"] = dict(trace)
         self._write("admit", **rec)

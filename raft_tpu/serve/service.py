@@ -177,12 +177,12 @@ class _Request:
     __slots__ = ("seq", "id", "Hs", "Tp", "beta", "deadline_ts",
                  "submitted_ts", "attempts", "total_attempts", "strikes",
                  "solo", "not_before", "ticket", "tenant", "rdigest",
-                 "replayed", "followers", "opt", "trace", "t_admitted",
-                 "t_gathered", "t_solve0", "t_solved")
+                 "replayed", "followers", "opt", "farm", "trace",
+                 "t_admitted", "t_gathered", "t_solve0", "t_solved")
 
     def __init__(self, seq, Hs, Tp, beta, deadline_ts, now,
                  tenant=DEFAULT_TENANT, request_id=None, rdigest=None,
-                 opt=None, trace=None):
+                 opt=None, farm=None, trace=None):
         self.seq = int(seq)
         self.id = request_id or f"req{seq}-{uuid.uuid4().hex[:8]}"
         self.Hs = float(Hs)
@@ -203,11 +203,15 @@ class _Request:
         # placeholder Hs/Tp/beta
         self.rdigest = rdigest or (
             wal.optimize_digest(opt, str(tenant)) if opt
+            else wal.farm_digest(farm, str(tenant)) if farm
             else wal.request_digest(Hs, Tp, beta, self.tenant))
         self.replayed = False
         #: optimize-tenant request: the canonical design-optimization
         #: spec (bounds + objective + descent knobs); None = sweep case
         self.opt = dict(opt) if opt else None
+        #: farm-tenant request: the canonical farm spec (layout + case
+        #: table + wake knobs); None = single-FOWT sweep case
+        self.farm = dict(farm) if farm else None
         #: single-flight followers: duplicate submissions attached to
         #: this (primary) request — they never enter the queue, and the
         #: primary's terminal outcome fans out to them
@@ -734,6 +738,7 @@ class SweepService:
                                request_id=rec.get("id"),
                                rdigest=rec.get("rdigest"),
                                opt=rec.get("opt"),
+                               farm=rec.get("farm"),
                                trace=(inherited.child()
                                       if inherited else None))
                 req.replayed = True
@@ -748,6 +753,7 @@ class SweepService:
                     self._journal.record_admit(
                         seq, req.id, req.rdigest, req.Hs, req.Tp,
                         req.beta, deadline_s, tenant, opt=req.opt,
+                        farm=req.farm,
                         trace=req.trace.as_dict())
                 if tenant not in self._tenants.names():
                     # the successor was configured without this tenant:
@@ -759,11 +765,11 @@ class SweepService:
                         "replayed request names a tenant this service "
                         "does not carry", tenant=tenant, seq=seq))
                     continue
-                if req.opt is not None:
-                    # an accepted-but-unfinished optimization replays
-                    # onto the optimize queue (re-run as submitted);
-                    # single-flight holds through replay like any
-                    # duplicate pair
+                if req.opt is not None or req.farm is not None:
+                    # an accepted-but-unfinished optimization or farm
+                    # solve replays onto the long-request queue (re-run
+                    # as submitted); single-flight holds through replay
+                    # like any duplicate pair
                     prim = self._flight.get(req.rdigest)
                     if prim is not None and not prim.ticket.done():
                         prim.followers.append(req)
@@ -1230,6 +1236,162 @@ class SweepService:
                     ).inc(1.0, outcome="admitted")
         return r.ticket
 
+    def submit_farm(self, spec: dict, deadline_s: float = None,
+                    tenant: str = DEFAULT_TENANT,
+                    trace=None) -> Ticket:
+        """Admit one farm request — N turbines x M cases solved as ONE
+        compiled program on the device mesh
+        (:func:`raft_tpu.parallel.sweep.make_farm_runner`) — returning
+        its :class:`Ticket` whose :class:`SweepResult` carries the
+        per-turbine motion statistics, waked wind field, and wake
+        fixed-point provenance in ``result.extra``.
+
+        ``spec`` is the JSON request body: ``{"layout": [[x, y], ...],
+        "Hs": [...], "Tp": [...], "beta": [...], "U_inf": [...],
+        "wind_dir": [...], "k_w": 0.05}`` — validated and canonicalized
+        (typed :class:`~raft_tpu.errors.ModelConfigError` on junk, with
+        ``cfg.farm_turbines_max``/``farm_cases_max`` as resource
+        guards).  Requests are content-addressed over the canonical
+        spec + tenant — the digest is salted with the LAYOUT, so two
+        farms with identical sea states but different turbine positions
+        never dedupe onto each other.  Farm solves ride the long-request
+        lane (the optimize queue): they compile once per (layout,
+        case-count) and run minutes-scale, not batch-window-scale.
+        With a journal configured the admission is WAL-journaled (admit
+        record carrying the spec) BEFORE the ticket returns — replay
+        after a crash re-delivers completed farms and re-runs
+        accepted-unfinished ones."""
+        from raft_tpu.parallel import sweep as sweepmod
+
+        obs = self._obs()
+        tenant = self._tenants.require(tenant)
+        ctx = _coerce_trace(trace)
+        norm = sweepmod.normalize_farm_request(
+            spec, turbines_max=self.cfg.farm_turbines_max,
+            cases_max=self.cfg.farm_cases_max)
+        # the canonical spec is plain JSON (lists, floats): the WAL
+        # admit record and the content digest both see the SAME bytes
+        # a replay reconstructs — numpy arrays never reach the journal
+        spec = {"layout": norm["layout"].tolist(),
+                "Hs": norm["Hs"].tolist(), "Tp": norm["Tp"].tolist(),
+                "beta": norm["beta"].tolist(),
+                "U_inf": norm["U_inf"].tolist(),
+                "wind_dir": norm["wind_dir"].tolist(),
+                "k_w": float(norm["k_w"]),
+                "n_turbines": int(norm["n_turbines"]),
+                "ncases": int(norm["ncases"])}
+        rdigest = wal.farm_digest(spec, tenant)
+        now = time.monotonic()
+        deadline_s = float(deadline_s if deadline_s is not None
+                           else self.cfg.deadline_s)
+        follower = None
+        dedup = None
+        with self._cond:
+            # same load-shed cadence as optimize: the farm rides the
+            # long-request queue, so the hint folds its backlog and EMA
+            retry_after = max(
+                self._estimate_wait_locked(),
+                (len(self._opt_queue) + (1 if self._opt_busy else 0))
+                * float(self._opt_ema_s or 60.0))
+            reason = None
+            if self._state in ("draining", "stopped"):
+                reason = "stopped"
+            else:
+                prior_digest = self._rdigest_index.get(rdigest)
+                prior = (self._delivered.get(prior_digest)
+                         if prior_digest else None)
+                if prior is not None and prior.ok:
+                    seq = self._seq
+                    self._seq += 1
+                    dedup = dataclasses.replace(
+                        prior,
+                        request_id=f"farm{seq}-{uuid.uuid4().hex[:8]}",
+                        seq=seq, attempts=0, latency_s=0.0,
+                        source="deduped", extra={
+                            **(prior.extra or {}),
+                            "provenance": {
+                                **((prior.extra or {}).get("provenance")
+                                   or {}),
+                                "trace": ctx.as_dict()}})
+                else:
+                    prim = self._flight.get(rdigest)
+                    if prim is not None and not prim.ticket.done():
+                        seq = self._seq
+                        self._seq += 1
+                        follower = _Request(seq, 0.0, 1.0, 0.0,
+                                            now + deadline_s, now,
+                                            tenant=tenant,
+                                            rdigest=rdigest, farm=spec,
+                                            trace=ctx)
+                        self._track_open(follower)
+                        prim.followers.append(follower)
+                        self._counts["admitted"] += 1
+                        self._counts["coalesced"] += 1
+            if dedup is None and follower is None and reason is None:
+                if self.ladder[self._mode_idx] == "reject":
+                    reason = "degraded"
+                    retry_after = max(retry_after,
+                                      self.cfg.reject_hold_s)
+                elif len(self._opt_queue) >= self.cfg.queue_max:
+                    reason = "queue_full"
+            if reason is not None:
+                self._counts["rejected"] += 1
+            elif dedup is None and follower is None:
+                seq = self._seq
+                self._seq += 1
+                req = _Request(seq, 0.0, 1.0, 0.0, now + deadline_s,
+                               now, tenant=tenant, rdigest=rdigest,
+                               farm=spec, trace=ctx)
+                # track BEFORE the request becomes poppable (same
+                # ordering contract as submit_optimize)
+                self._track_open(req)
+                self._opt_queue.append(req)
+                self._flight[rdigest] = req
+                self._counts["admitted"] += 1
+                self._cond.notify_all()
+        if reason is not None:
+            self._tenants.count(tenant, "rejected")
+            obs.counter(
+                "raft_tpu_serve_admission_rejects_total",
+                "requests shed at admission, by reason").inc(
+                    1.0, reason=reason)
+            self._emit("admission_reject", reason=reason,
+                       retry_after_s=retry_after, farm=True)
+            raise errors.AdmissionRejected(
+                f"admission rejected ({reason})",
+                retry_after_s=retry_after, reason=reason,
+                optimize=True)
+        obs.counter(
+            "raft_tpu_serve_farm_requests_total",
+            "farm-tenant request admissions/outcomes").inc(
+                1.0, outcome="deduped" if dedup is not None
+                else "admitted")
+        if dedup is not None:
+            # synchronous payload — like a result-store hit, nothing a
+            # crash could lose, so the dedupe is not journaled
+            t = Ticket(dedup.request_id, dedup.seq, trace=ctx)
+            t._finish(dedup)
+            return t
+        r = follower if follower is not None else req
+        # WAL before ack, spec on the admit record: an accepted farm
+        # survives a crash and replays as submitted
+        if self._journal is not None:
+            self._journal.record_admit(r.seq, r.id, r.rdigest, r.Hs,
+                                       r.Tp, r.beta, deadline_s, tenant,
+                                       farm=spec,
+                                       trace=r.trace.as_dict())
+        r.t_admitted = time.monotonic()
+        if follower is not None:
+            self._emit("coalesced", req=r.seq, rdigest=r.rdigest,
+                       farm=True)
+        else:
+            self._ensure_opt_worker()
+        self._tenants.count(tenant, "admitted")
+        obs.counter("raft_tpu_serve_requests_total",
+                    "request admissions/outcomes of the sweep service"
+                    ).inc(1.0, outcome="admitted")
+        return r.ticket
+
     def _ensure_opt_worker(self):
         with self._lock:
             if self._opt_worker is not None \
@@ -1252,7 +1414,14 @@ class SweepService:
                 r.t_gathered = time.monotonic()
                 self._opt_busy = True
             try:
-                self._run_optimize(r)
+                # the long-request lane carries both tenants: design
+                # optimizations and farm solves (each compile-heavy,
+                # each minutes-scale — neither belongs in the batch
+                # window)
+                if r.farm is not None:
+                    self._run_farm(r)
+                else:
+                    self._run_optimize(r)
             except errors.RaftError as e:
                 self._fail(r, e)
             # the worker seam mirrors the sweep worker's config-
@@ -1412,6 +1581,148 @@ class SweepService:
                    latency_s=res.latency_s, mode="optimize",
                    attempts=r.total_attempts,
                    f_best=payload["f_best"],
+                   trace_id=r.trace.trace_id)
+        r.ticket._finish(res)
+        if r.t_admitted:
+            self._observe_phase("admission",
+                                r.t_admitted - r.submitted_ts)
+            if r.t_gathered:
+                self._observe_phase("queue_wait",
+                                    r.t_gathered - r.t_admitted)
+        if r.t_solve0 and r.t_solved:
+            self._observe_phase("solve", r.t_solved - r.t_solve0)
+            self._observe_phase("delivery",
+                                time.monotonic() - r.t_solved)
+        self._fanout_complete(r, res)
+
+    def _run_farm(self, r: _Request):
+        """One journaled farm solve end to end (the farm twin of
+        :meth:`_run_optimize`): warm (layout, case-count)-keyed runner
+        from the tenant registry, one compiled N-turbines x M-cases
+        program, per-turbine results + wake provenance delivered."""
+        import numpy as np
+
+        from raft_tpu.parallel import sweep as sweepmod
+
+        if r.deadline_ts < time.monotonic():
+            with self._lock:
+                self._counts["deadline_misses"] += 1
+            self._fail(r, errors.DeadlineExceeded(
+                "farm request expired before its solve started",
+                req=r.seq))
+            return
+        spec = r.farm
+        base = self._tenants.fowts(r.tenant).get("full")
+        if base is None:
+            self._fail(r, errors.ModelConfigError(
+                "farm tenant has no full-mode model", tenant=r.tenant))
+            return
+        xy = np.asarray(spec["layout"], float)
+        nt = int(spec["n_turbines"])
+        nc = int(spec["ncases"])
+        from raft_tpu.parallel import exec_cache
+        ldig = exec_cache.layout_digest(xy)
+
+        def build(_fowt, kw):
+            # farm is a MODE of the tenant, not a degraded sibling: the
+            # registry has no "farm:..." fowt, so the program is built
+            # over the tenant's full-physics model — one warm runner
+            # per (layout digest, case count), LRU-evicted like any
+            # other mode's program
+            solver_kw = {k: v for k, v in kw.items()
+                         if k in ("nIter", "tol", "fp_chunk")}
+            return sweepmod.make_farm_runner(
+                base, xy, nc, mesh=self.cfg.mesh,
+                k_w=float(spec["k_w"]), **solver_kw)
+
+        runner = self._tenants.runner(
+            r.tenant, f"farm:{ldig[:8]}x{nc}", build)
+        # the warm program's case axis may be padded up to the mesh
+        # batch multiple — pad by repeating the last case, strip after
+        pad = int(runner.ncases) - nc
+        arrs = [np.asarray(spec[k], float)
+                for k in ("Hs", "Tp", "beta", "U_inf", "wind_dir")]
+        if pad:
+            arrs = [np.concatenate([a, np.repeat(a[-1:], pad)])
+                    for a in arrs]
+        r.t_solve0 = time.monotonic()
+        with self._obs().span("serve_farm", req=r.seq, n_turbines=nt,
+                              ncases=nc,
+                              trace_id=r.trace.trace_id,
+                              span_id=r.trace.span_id,
+                              parent_id=r.trace.parent_id):
+            out = runner(*arrs)
+            shaped = sweepmod._farm_reshape(out, nt, nc)
+            std = np.asarray(shaped["std"])          # (nt, nc, 6)
+            iters = np.asarray(shaped["iters"])
+            conv = np.asarray(shaped["converged"])
+            U_wake = np.asarray(shaped["U_wake"])    # (nt, nc)
+            power = np.asarray(shaped["aero_power"])
+            wake_iters = np.asarray(shaped["wake_iters"])
+        r.t_solved = time.monotonic()
+        payload = {
+            "std": std.tolist(),
+            "std_norm": float(np.linalg.norm(std)),
+            "iters": int(np.max(iters)),
+            "converged": bool(np.all(conv)),
+            "U_wake": U_wake.tolist(),
+            "aero_power": power.tolist(),
+            "wake_iters": [int(v) for v in wake_iters],
+            "n_turbines": nt, "ncases": nc,
+            "layout_digest": ldig,
+            "provenance": {
+                "cache_state": str(runner.cache_state),
+                "build_s": float(runner.build_s),
+                "k_w": float(spec["k_w"])}}
+        self._complete_farm(r, payload)
+
+    def _complete_farm(self, r: _Request, payload: dict):
+        """Deliver + journal one farm result (the farm twin of
+        ``_complete_optimize``): digest-addressed over the per-turbine
+        response statistics + wake provenance, WAL-terminal before the
+        ticket resolves, indexed for dedupe, fanned out to
+        single-flight followers."""
+        obs = self._obs()
+        digest = wal.farm_result_digest(
+            payload["std_norm"], payload["n_turbines"],
+            payload["ncases"], max(payload["wake_iters"]))
+        # after the digest: the trace block must not perturb the
+        # replayed-vs-clean digest equality recovery asserts
+        payload["provenance"]["trace"] = r.trace.as_dict()
+        res = SweepResult(
+            ok=True, digest=digest, std=[float(payload["std_norm"])],
+            iters=int(payload["iters"]),
+            converged=bool(payload["converged"]), extra=payload,
+            source="replayed" if r.replayed else "solved",
+            **self._result_base(r, "farm"))
+        if self._journal is not None:
+            self._journal.record_complete(
+                r.seq, r.rdigest, digest, "farm",
+                r.total_attempts, res.std, res.iters, res.converged,
+                extra=payload, trace=r.trace.as_dict())
+        with self._lock:
+            self._counts["completed"] += 1
+            self._latencies.append(res.latency_s)
+            self._delivered[digest] = res
+            self._rdigest_index[r.rdigest] = digest
+            while len(self._delivered) > self.cfg.result_cache:
+                self._delivered.popitem(last=False)
+            while len(self._rdigest_index) > self.cfg.result_cache:
+                self._rdigest_index.popitem(last=False)
+            self._replayed_pending.discard(r.seq)
+        self._untrack_open(r.seq)
+        self._tenants.count(r.tenant, "completed")
+        obs.counter("raft_tpu_serve_requests_total",
+                    "request admissions/outcomes of the sweep service"
+                    ).inc(1.0, outcome="ok")
+        obs.counter(
+            "raft_tpu_serve_farm_requests_total",
+            "farm-tenant request admissions/outcomes").inc(
+                1.0, outcome="ok")
+        self._emit("request_done", req=r.seq, digest=digest,
+                   latency_s=res.latency_s, mode="farm",
+                   attempts=r.total_attempts,
+                   n_turbines=payload["n_turbines"],
                    trace_id=r.trace.trace_id)
         r.ticket._finish(res)
         if r.t_admitted:

@@ -1,0 +1,215 @@
+"""Device-resident farm axis (parallel/sweep): make_farm_solver /
+sweep_farm / make_farm_runner.
+
+Parity pins run on the coarse rotor-less Vertical_cylinder with a
+synthetic power/thrust curve — wave-only lanes (no aero damping table
+without a rotor) but the full farm machinery: the in-program wake
+equilibrium, turbine-major lane tiling, per-lane placement/stiffness at
+the statics boundary, the (turbines, cases) mesh, and the executable
+cache keyed on the layout digest.  This keeps the compile cheap enough
+for the fast tier; the rotor-coupled farm is pinned by bench.py farm
+and tests/test_serve_farm.py.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import errors
+from raft_tpu.io.designs import load_design
+from raft_tpu.models.fowt import build_fowt
+from raft_tpu.parallel import exec_cache, partition
+from raft_tpu.parallel.sweep import (make_case_solver, make_farm_runner,
+                                     make_farm_solver,
+                                     normalize_farm_request, sweep_farm)
+
+XY = np.array([[0.0, 0.0], [800.0, 100.0], [1600.0, -150.0]])
+
+
+def _curve():
+    """Synthetic monotone power/thrust table (no BEM; rotor_diameter
+    feeds the wake model of the rotor-less platform)."""
+    ws = np.linspace(3.0, 25.0, 45)
+    Ct = np.clip(0.85 - 0.028 * (ws - 3.0), 0.06, 0.85)
+    power = 5.0e6 * np.clip((ws - 3.0) / 8.0, 0.0, 1.0) ** 3
+    return {"wind_speed": ws, "Ct": Ct, "power": power,
+            "rotor_diameter": 240.0}
+
+
+def _cases(nc, seed=3):
+    rng = np.random.default_rng(seed)
+    return (4.0 + 2.0 * rng.random(nc),          # Hs
+            8.0 + 4.0 * rng.random(nc),          # Tp
+            rng.uniform(0.0, 2 * np.pi, nc),     # beta
+            6.0 + 8.0 * rng.random(nc),          # U_inf
+            rng.uniform(-20.0, 20.0, nc))        # wind_dir
+
+
+@pytest.fixture(scope="module")
+def cyl_fowt():
+    design = load_design("Vertical_cylinder")
+    w = np.arange(0.05, 0.5, 0.05) * 2 * np.pi
+    return build_fowt(design, w,
+                      depth=float(design["site"]["water_depth"]))
+
+
+def test_farm_solver_matches_serial_per_turbine(cyl_fowt):
+    """ISSUE acceptance: the N x M farm program must reproduce the
+    serial path — make_case_solver.batched per turbine at that
+    turbine's position/stiffness and the same wake state — to solver
+    tolerance."""
+    nc = 4
+    nt = len(XY)
+    Hs, Tp, beta, U_inf, wind_dir = _cases(nc)
+    solver = make_farm_solver(cyl_fowt, XY, curve=_curve(), nIter=4)
+    assert solver.n_turbines == nt and solver.aero is False
+    lane = lambda x: jnp.tile(jnp.asarray(x), (nt,))
+    out = jax.jit(solver)(lane(Hs), lane(Tp), lane(beta),
+                          jnp.asarray(U_inf), jnp.asarray(wind_dir))
+    std_farm = np.asarray(out["std"]).reshape(nt, nc, 6)
+    iters_farm = np.asarray(out["iters"]).reshape(nt, nc)
+    assert np.all(np.isfinite(std_farm))
+
+    case = make_case_solver(cyl_fowt, nIter=4)
+    for t in range(nt):
+        r6 = np.zeros((nc, 6))
+        r6[:, :2] = XY[t]
+        C = np.broadcast_to(solver.C_moor_t[t], (nc, 6, 6))
+        ref = jax.jit(case.batched)(jnp.asarray(Hs), jnp.asarray(Tp),
+                                    jnp.asarray(beta),
+                                    r6_b=jnp.asarray(r6),
+                                    C_moor_b=jnp.asarray(C))
+        np.testing.assert_allclose(std_farm[t], np.asarray(ref["std"]),
+                                   rtol=1e-9, atol=1e-12)
+        np.testing.assert_array_equal(iters_farm[t],
+                                      np.asarray(ref["iters"]))
+
+    # the riding wake outputs match the host fixed point
+    from raft_tpu.models import wake as wk
+    U_wake = np.asarray(out["U_wake"])
+    assert U_wake.shape == (nt, nc)
+    curve = _curve()
+    for c in range(nc):
+        U = np.full(nt, U_inf[c])
+        Ct = np.asarray(wk._curve_interp(U, curve, "Ct"))
+        for it in range(100):
+            U_new = wk.wake_velocities(XY, curve["rotor_diameter"], Ct,
+                                       U_inf[c], wind_dir[c])
+            if np.max(np.abs(U_new - U)) < 1e-4:
+                U = U_new
+                break
+            U = 0.5 * U + 0.5 * U_new
+            Ct = np.asarray(wk._curve_interp(U, curve, "Ct"))
+        np.testing.assert_allclose(U_wake[:, c], U, rtol=1e-8)
+        assert int(np.asarray(out["wake_iters"])[c]) == it + 1
+
+
+def test_sweep_farm_sharded_matches_single_device(cyl_fowt):
+    """ISSUE acceptance: a (2, 4) (turbines, cases) mesh over the 8
+    virtual CPU devices must agree with the single-device program to
+    1e-12 (measured bitwise — the in-program wake equilibrium is
+    replicated, the lane solves are element-independent)."""
+    nc = 8
+    xy = XY[:2]
+    Hs, Tp, beta, U_inf, wind_dir = _cases(nc, seed=5)
+    kw = dict(curve=_curve(), nIter=3)
+    single = sweep_farm(cyl_fowt, xy, Hs, Tp, beta, U_inf, wind_dir,
+                        mesh=None, **kw)
+    mesh = partition.make_mesh((2, 4), ("turbines", "cases"),
+                               devices=jax.devices("cpu")[:8])
+    assert partition.batch_size(mesh) == 8
+    sharded = sweep_farm(cyl_fowt, xy, Hs, Tp, beta, U_inf, wind_dir,
+                         mesh=mesh, **kw)
+    assert np.asarray(sharded["std"]).shape == (2, nc, 6)
+    for k in ("std", "Xi", "U_wake", "aero_power"):
+        np.testing.assert_allclose(np.asarray(sharded[k]),
+                                   np.asarray(single[k]),
+                                   rtol=0, atol=1e-12)
+    np.testing.assert_array_equal(np.asarray(sharded["iters"]),
+                                  np.asarray(single["iters"]))
+    np.testing.assert_array_equal(np.asarray(sharded["wake_iters"]),
+                                  np.asarray(single["wake_iters"]))
+
+
+def test_farm_runner_exec_cache_roundtrip(cyl_fowt, tmp_path,
+                                          monkeypatch):
+    """Cold build -> exec-cache MISS; identical rebuild -> HIT serving
+    bitwise-identical lanes; a moved turbine -> different layout digest,
+    different key, MISS (cache identity covers the layout)."""
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_memo()
+    nc = 3
+    Hs, Tp, beta, U_inf, wind_dir = _cases(nc, seed=7)
+    kw = dict(curve=_curve(), nIter=3)
+    r1 = make_farm_runner(cyl_fowt, XY, nc, **kw)
+    assert r1.cache_state == "miss"
+    assert r1.layout_digest == exec_cache.layout_digest(XY)
+    out1 = r1(Hs, Tp, beta, U_inf, wind_dir)
+    # ONE compiled program carries every (turbine, case) lane
+    assert np.asarray(out1["std"]).shape == (r1.n_turbines * r1.ncases,
+                                             6)
+    r2 = make_farm_runner(cyl_fowt, XY, nc, **kw)
+    assert r2.cache_state == "hit" and r2.key == r1.key
+    out2 = r2(Hs, Tp, beta, U_inf, wind_dir)
+    np.testing.assert_array_equal(np.asarray(out2["std"]),
+                                  np.asarray(out1["std"]))
+    np.testing.assert_array_equal(np.asarray(out2["U_wake"]),
+                                  np.asarray(out1["U_wake"]))
+    moved = XY + np.array([50.0, 0.0])
+    r3 = make_farm_runner(cyl_fowt, moved, nc, **kw)
+    assert r3.cache_state == "miss" and r3.key != r1.key
+    assert r3.layout_digest != r1.layout_digest
+
+
+def test_normalize_farm_request_admission_boundary():
+    good = {"layout": [[0.0, 0.0], [800.0, 0.0]],
+            "Hs": [1.0, 2.0], "Tp": [8.0, 9.0], "beta": [0.0, 0.1],
+            "U_inf": [10.0, 11.0]}
+    out = normalize_farm_request(good)
+    assert out["n_turbines"] == 2 and out["ncases"] == 2
+    assert np.array_equal(out["wind_dir"], [0.0, 0.0])  # default
+    assert out["k_w"] == 0.05
+    with pytest.raises(errors.ModelConfigError, match="layout"):
+        normalize_farm_request({k: v for k, v in good.items()
+                                if k != "layout"})
+    with pytest.raises(errors.ModelConfigError, match="cap"):
+        normalize_farm_request(dict(good, layout=[[0.0, 0.0]] * 5),
+                               turbines_max=4)
+    with pytest.raises(errors.ModelConfigError, match="length"):
+        normalize_farm_request(dict(good, Tp=[8.0]))
+    with pytest.raises(errors.ModelConfigError, match="k_w"):
+        normalize_farm_request(dict(good, k_w=1.5))
+    with pytest.raises(errors.ModelConfigError, match="finite"):
+        normalize_farm_request(dict(good, Hs=[1.0, np.nan]))
+
+
+@pytest.mark.slow
+def test_model_sweep_farm_volturnus(reference_test_data):
+    """Model.sweep_farm on the reference 2-FOWT VolturnUS-S farm: the
+    homogeneous batched program vs the serial per-turbine solver with
+    the same array-mooring diagonal blocks."""
+    import os
+
+    import yaml
+
+    from raft_tpu.model import Model
+
+    path = os.path.join(reference_test_data, "VolturnUS-S_farm.yaml")
+    design = yaml.safe_load(open(path))
+    design["array_mooring"]["file"] = os.path.join(
+        reference_test_data, "shared_mooring_volturnus.dat")
+    model = Model(design)
+    nc = 2
+    cases = {"Hs": np.array([4.0, 6.0]), "Tp": np.array([10.0, 12.0]),
+             "beta": np.array([0.0, 0.3]),
+             "U_inf": np.array([10.0, 12.0]),
+             "wind_dir": np.array([0.0, 0.0])}
+    out = model.sweep_farm(cases=cases, nIter=4)
+    std = np.asarray(out["std"])
+    assert std.shape == (model.nFOWT, nc, 6)
+    assert np.all(np.isfinite(std))
+    # downwind turbine is waked at wind_dir 0 (array laid out along +x)
+    U = np.asarray(out["U_wake"])
+    assert np.all(U[1] < cases["U_inf"] + 1e-9)
+    assert "farm" in model.results

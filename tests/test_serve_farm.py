@@ -1,0 +1,90 @@
+"""Farm tenant mode of the sweep service: POST /farm's service path.
+
+One coarse OC3 spar (4 frequency bins, real rotor so the BEM
+power/thrust curve and the aero-damping table engage) driven through
+submit_farm: admission -> WAL -> the warm farm runner on the shared
+long-request lane -> result digest -> dedupe -> crash recovery.
+Mirrors the durability contract of the optimize tenant
+(tests/test_serve_durability.py): every acked admission survives a
+stop/restart and re-delivers the identical payload.
+"""
+import numpy as np
+import pytest
+
+from raft_tpu.serve import ServeConfig, SweepService
+from raft_tpu.serve import journal as wal
+from raft_tpu.serve.soak import build_fowt
+
+pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+
+SPEC = {"layout": [[0.0, 0.0], [800.0, 0.0]],
+        "Hs": [1.0, 2.0], "Tp": [8.0, 10.0], "beta": [0.0, 0.1],
+        "U_inf": [10.0, 12.0]}
+
+
+@pytest.fixture(scope="module")
+def spar_fowt():
+    return build_fowt("OC3spar.yaml", min_freq=0.1, max_freq=0.5,
+                      dfreq=0.1)
+
+
+def test_farm_tenant_round_trip(spar_fowt, tmp_path):
+    cfg = ServeConfig(journal_dir=str(tmp_path / "wal"), nIter=4)
+    svc = SweepService(spar_fowt, cfg)
+    svc.start()
+    try:
+        t = svc.submit_farm(SPEC)
+        res = t.result(300.0)
+        assert res.ok and res.mode == "farm" and res.source == "solved"
+        ex = res.extra
+        assert ex["n_turbines"] == 2 and ex["ncases"] == 2
+        std = np.asarray(ex["std"])
+        assert std.shape == (2, 2, 6) and np.all(np.isfinite(std))
+        U = np.asarray(ex["U_wake"])
+        # wind flows along +x over the [0, 800] m row: the downwind
+        # turbine is waked, the upwind one sees the free stream
+        assert np.allclose(U[0], SPEC["U_inf"], atol=1e-6)
+        assert np.all(U[1] < np.asarray(SPEC["U_inf"]) - 0.1)
+        assert ex["layout_digest"] and ex["provenance"]["cache_state"] \
+            in ("miss", "hit", "disabled")
+
+        # duplicate admission: served from the digest index, no second
+        # solve, identical result digest
+        r2 = svc.submit_farm(SPEC).result(30.0)
+        assert r2.source == "deduped" and r2.digest == res.digest
+
+        # the admission digest is salted with the layout: moving one
+        # turbine is a DIFFERENT request even with identical sea states
+        moved = dict(SPEC, layout=[[0.0, 0.0], [900.0, 0.0]])
+        assert wal.farm_digest(SPEC, "default") != \
+            wal.farm_digest(moved, "default")
+    finally:
+        svc.stop()
+
+    # crash recovery: a fresh service over the same WAL re-delivers the
+    # completed farm result by digest without re-solving
+    svc2 = SweepService(spar_fowt, cfg)
+    try:
+        info = svc2.recover()
+        assert info["recovered"] >= 1
+        got = svc2.fetch(res.digest)
+        assert got is not None
+        assert got.extra["std_norm"] == res.extra["std_norm"]
+        assert got.extra["wake_iters"] == res.extra["wake_iters"]
+    finally:
+        svc2.stop()
+
+
+def test_farm_admission_caps_are_typed(spar_fowt):
+    from raft_tpu import errors
+
+    cfg = ServeConfig(farm_turbines_max=2, farm_cases_max=4)
+    svc = SweepService(spar_fowt, cfg)
+    with pytest.raises(errors.ModelConfigError, match="cap"):
+        svc.submit_farm(dict(SPEC, layout=[[0.0, 0.0], [500.0, 0.0],
+                                           [1000.0, 0.0]]))
+    with pytest.raises(errors.ModelConfigError, match="cap"):
+        n = 5
+        svc.submit_farm(dict(SPEC, Hs=[1.0] * n, Tp=[8.0] * n,
+                             beta=[0.0] * n, U_inf=[10.0] * n))
+    assert svc.stop()["completed"] == 0

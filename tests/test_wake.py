@@ -138,3 +138,175 @@ def test_floris_coupling_optional_import(pseudo_farm, tmp_path):
         pytest.skip("floris installed — adapter exercised elsewhere")
     with pytest.raises(ImportError, match="built-in wake"):
         floris_coupling(pseudo_farm, str(tmp_path / "farm.yaml"), [], str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# reference-free: broadcast parity, the Ct -> 1 guard, and the device-
+# resident jnp twins the batched farm sweep traces (no /root/reference)
+# ---------------------------------------------------------------------------
+
+def _synth_curve():
+    """Monotone synthetic power/thrust table — enough structure for the
+    wake fixed point without touching the BEM rotor."""
+    ws = np.linspace(3.0, 25.0, 45)
+    Ct = np.clip(0.85 - 0.028 * (ws - 3.0), 0.06, 0.85)
+    power = 5.0e6 * np.clip((ws - 3.0) / 8.0, 0.0, 1.0) ** 3
+    return {"wind_speed": ws, "Ct": Ct, "power": power}
+
+
+def _wake_velocities_loop(xy, D, Ct, U_inf, wind_dir_deg=0.0, k_w=0.05):
+    """The O(n^2) Python double loop wake_velocities vectorized away —
+    kept here as the parity reference (index-order summation)."""
+    from raft_tpu.models.wake import _wake_frame
+
+    xy_w = _wake_frame(xy, wind_dir_deg)
+    n = len(xy_w)
+    D = np.broadcast_to(np.asarray(D, float), (n,))
+    U = np.zeros(n)
+    for i in range(n):
+        ssq = 0.0
+        for j in range(n):
+            if i == j:
+                continue
+            x_d = (xy_w[i, 0] - xy_w[j, 0]) / D[j]
+            y_d = (xy_w[i, 1] - xy_w[j, 1]) / D[j]
+            ssq += float(gaussian_deficit(x_d, y_d, float(Ct[j]),
+                                          k_w)) ** 2
+        U[i] = U_inf * (1.0 - np.sqrt(ssq))
+    return U
+
+
+def test_wake_velocities_broadcast_matches_pair_loop():
+    rng = np.random.default_rng(11)
+    n = 7
+    xy = np.stack([rng.uniform(0, 4000, n), rng.uniform(-800, 800, n)],
+                  axis=1)
+    Ct = rng.uniform(0.2, 0.9, n)
+    D = rng.uniform(120.0, 250.0, n)       # per-turbine diameters too
+    for wd in (0.0, 37.0, 200.0):
+        got = wake_velocities(xy, D, Ct, 10.0, wind_dir_deg=wd)
+        ref = _wake_velocities_loop(xy, D, Ct, 10.0, wind_dir_deg=wd)
+        np.testing.assert_allclose(got, ref, rtol=1e-12, atol=1e-12)
+
+
+def test_gaussian_deficit_ct_guard():
+    """Clip + floor at CT_MAX: bitwise no-op for in-range Ct, finite for
+    the Ct >= 1 a raw thrust curve or an optimizer step can produce."""
+    from raft_tpu.models.wake import CT_MAX
+
+    # in-range: identical to the unguarded expression
+    for ct in (0.2, 0.5, 0.9):
+        sq = np.sqrt(1.0 - ct)
+        beta = 0.5 * (1.0 + sq) / sq
+        sigma_D = 0.05 * 5.0 + 0.25 * np.sqrt(beta)
+        want = ((1.0 - np.sqrt(1.0 - ct / (8.0 * sigma_D ** 2)))
+                * np.exp(-0.0))
+        assert gaussian_deficit(5.0, 0.0, ct) == want
+    # at and past the singularity: finite, saturated at the CT_MAX value
+    d_max = gaussian_deficit(5.0, 0.0, CT_MAX)
+    for ct in (1.0, 1.5, 3.0):
+        d = gaussian_deficit(5.0, 0.0, ct)
+        assert np.isfinite(d) and d == d_max
+
+
+def test_gaussian_deficit_jnp_matches_host_and_grad_finite():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.models.wake import gaussian_deficit_jnp
+
+    x = np.linspace(-1.0, 12.0, 27)
+    y = np.linspace(-3.0, 3.0, 27)
+    for ct in (0.1, 0.5, 0.85, 0.96, 1.0, 1.2):
+        host = gaussian_deficit(x, y, ct)
+        dev = np.asarray(gaussian_deficit_jnp(jnp.asarray(x),
+                                              jnp.asarray(y), ct))
+        np.testing.assert_allclose(dev, host, rtol=1e-14, atol=1e-14)
+    # the guard's whole point: grad stays finite THROUGH Ct -> 1 (jax
+    # evaluates both sides of the clip; an unguarded sqrt(1 - Ct) NaNs
+    # the cotangent even when the clipped forward value is fine)
+    g = jax.grad(lambda c: gaussian_deficit_jnp(5.0, 0.5, c))
+    for ct in (0.5, 0.95, 0.96, 1.0, 1.3):
+        assert np.isfinite(float(g(ct))), ct
+    gx = jax.grad(lambda xx: gaussian_deficit_jnp(xx, 0.0, 0.8))
+    assert np.isfinite(float(gx(0.06)))
+
+
+def _host_equilibrium(xy, D, curve, U_inf, wind_dir, k_w=0.05,
+                      max_iter=100, tol=1e-4, relax=0.5):
+    """find_wake_equilibrium's exact schedule on a bare curve dict (the
+    model-level wrapper needs rotors; the jnp twin pins against this)."""
+    from raft_tpu.models.wake import _curve_interp
+
+    n = len(xy)
+    U = np.full(n, float(U_inf))
+    Ct = np.asarray(_curve_interp(U, curve, "Ct"))
+    for it in range(max_iter):
+        U_new = wake_velocities(xy, D, Ct, U_inf, wind_dir, k_w)
+        if np.max(np.abs(U_new - U)) < tol:
+            U = U_new
+            break
+        U = relax * U + (1.0 - relax) * U_new
+        Ct = np.asarray(_curve_interp(U, curve, "Ct"))
+    power = np.asarray(_curve_interp(U, curve, "power"))
+    return dict(U=U, Ct=Ct, power=power, iterations=it + 1)
+
+
+def test_wake_equilibrium_jnp_matches_host_fixed_point():
+    """The while_loop state machine must reproduce the host loop's
+    break semantics exactly: U = U_new kept on convergence, Ct NOT
+    re-interpolated — same iterate sequence, same iteration count."""
+    import jax.numpy as jnp
+
+    from raft_tpu.models.wake import wake_equilibrium_jnp
+
+    curve = _synth_curve()
+    xy = np.array([[0.0, 0.0], [900.0, 60.0], [1800.0, -90.0],
+                   [2700.0, 30.0]])
+    D = 240.0
+    for U_inf, wd in ((10.0, 0.0), (7.5, 15.0), (13.0, -30.0)):
+        host = _host_equilibrium(xy, D, curve, U_inf, wd)
+        dev = wake_equilibrium_jnp(
+            jnp.asarray(xy), D, jnp.asarray(curve["wind_speed"]),
+            jnp.asarray(curve["Ct"]), jnp.asarray(curve["power"]),
+            U_inf, wd)
+        np.testing.assert_allclose(np.asarray(dev["U"]), host["U"],
+                                   rtol=1e-10, atol=1e-10)
+        np.testing.assert_allclose(np.asarray(dev["Ct"]), host["Ct"],
+                                   rtol=1e-10, atol=1e-10)
+        np.testing.assert_allclose(np.asarray(dev["power"]),
+                                   host["power"], rtol=1e-10, atol=1e-6)
+        assert int(dev["iterations"]) == host["iterations"]
+    # parked free stream (above cut-out): no thrust, no wake, converges
+    # on the first check in both paths
+    host = _host_equilibrium(xy, D, curve, 30.0, 0.0)
+    dev = wake_equilibrium_jnp(
+        jnp.asarray(xy), D, jnp.asarray(curve["wind_speed"]),
+        jnp.asarray(curve["Ct"]), jnp.asarray(curve["power"]), 30.0, 0.0)
+    assert np.allclose(np.asarray(dev["U"]), 30.0)
+    assert int(dev["iterations"]) == host["iterations"] == 1
+
+
+def test_wake_equilibria_jnp_vmaps_cases():
+    import jax.numpy as jnp
+
+    from raft_tpu.models.wake import (wake_equilibria_jnp,
+                                      wake_equilibrium_jnp)
+
+    curve = _synth_curve()
+    xy = np.array([[0.0, 0.0], [1000.0, 0.0], [2000.0, 0.0]])
+    U_inf = np.array([8.0, 10.0, 12.0, 30.0])
+    wd = np.array([0.0, 10.0, -20.0, 0.0])
+    eq = wake_equilibria_jnp(
+        jnp.asarray(xy), 200.0, jnp.asarray(curve["wind_speed"]),
+        jnp.asarray(curve["Ct"]), jnp.asarray(curve["power"]),
+        U_inf, wd)
+    assert np.asarray(eq["U"]).shape == (4, 3)
+    assert np.asarray(eq["iterations"]).shape == (4,)
+    one = wake_equilibrium_jnp(
+        jnp.asarray(xy), 200.0, jnp.asarray(curve["wind_speed"]),
+        jnp.asarray(curve["Ct"]), jnp.asarray(curve["power"]),
+        float(U_inf[1]), float(wd[1]))
+    np.testing.assert_allclose(np.asarray(eq["U"])[1],
+                               np.asarray(one["U"]), rtol=1e-12)
+    assert int(np.asarray(eq["iterations"])[1]) == int(one["iterations"])

@@ -368,9 +368,13 @@ def make_serve_server(service, host: str = "127.0.0.1", port: int = 0, *,
                 threading.Thread(target=srv.shutdown,
                                  daemon=True).start()
                 return
-            if self.path == "/optimize":
-                # optimize tenant: bounds + objective spec in, a
-                # journaled digest-addressed optimized design out
+            if self.path in ("/optimize", "/farm"):
+                # long-request tenants: /optimize takes bounds +
+                # objective and answers with a journaled
+                # digest-addressed optimized design; /farm takes a
+                # turbine layout + per-case sea states/wind and answers
+                # with the batched N x M farm solve (one compiled
+                # program, layout-salted digest)
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
                     doc = json.loads(self.rfile.read(n) or b"{}")
@@ -387,8 +391,10 @@ def make_serve_server(service, host: str = "127.0.0.1", port: int = 0, *,
                         json.JSONDecodeError) as e:
                     self._send(400, {"error": f"bad request: {e}"})
                     return
+                submit = (service.submit_farm if self.path == "/farm"
+                          else service.submit_optimize)
                 try:
-                    t = service.submit_optimize(
+                    t = submit(
                         doc, deadline_s=deadline_s_req, tenant=tenant,
                         trace=self.headers.get(TRACE_HEADER))
                 except errors.AdmissionRejected as e:
@@ -538,7 +544,7 @@ def cmd_serve(args) -> int:
         threading.Thread(target=_drain, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _on_sigterm)
-    print(f"raftserve: http://{host}:{port}/  (submit, optimize, "
+    print(f"raftserve: http://{host}:{port}/  (submit, optimize, farm, "
           f"result, drain, "
           f"stats, healthz, metrics; design={args.design}, "
           f"batch={cfg.batch_cases}, "

@@ -1,0 +1,204 @@
+"""Pure-JAX surrogate MLP over the served case space.
+
+The learned read tier (:mod:`raft_tpu.serve.surrogate`) distills the
+result store's corpus — every cold solve the service ever persisted —
+into a tiny per-tenant MLP mapping ``(Hs, Tp, beta)`` to the served
+response summary: the six per-DOF response ``std`` channels, an
+iteration-count proxy, and a converged logit.  This module is the
+*network only*: parameter init, the normalized forward pass, and the
+optax fit loop.  Bundling, calibration, hull checks, and every serving
+decision live in the serve layer — the net knows nothing about
+tenants, stores, or bounds.
+
+Design constraints, in order:
+
+- **pure JAX, no new deps** — optax is already a dependency of the
+  co-design descents (:mod:`raft_tpu.parallel.optimize`);
+- **npz-serializable params** — the parameter set is a flat
+  ``{name: np.ndarray}`` dict (layer weights plus the input/output
+  normalization constants), so a bundle is one ``np.savez`` away and
+  its digest is a hash over deterministic bytes;
+- **self-contained forward** — normalization constants ride inside the
+  params, so ``predict(params, X)`` is the whole inference story: a
+  caller cannot forget to normalize.
+
+Output layout (:data:`OUT_CHANNELS` wide): columns ``0..5`` are the
+per-DOF response std (surge..yaw), column 6 the iters proxy, column 7
+the converged logit (sigmoid > 0.5 ⇒ converged).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu import errors
+
+#: input features per example: (Hs [m], Tp [s], beta [rad])
+IN_FEATURES = 3
+#: what the first layer actually sees: (Hs, Tp, sin beta, cos beta).
+#: beta is periodic and the global-frame response channels vary with
+#: it through |cos|/|sin| projections — fed raw, the net treats
+#: beta=0.1 and beta=2*pi-0.1 as opposite ends of the support and
+#: wastes its capacity faking the wrap; the embedding makes the
+#: periodicity structural
+NET_FEATURES = 4
+#: output channels: 6 per-DOF std + iters proxy + converged logit
+OUT_CHANNELS = 8
+#: floor on normalization scales — a constant column (e.g. every
+#: corpus case converged) must not divide by ~0
+_SCALE_FLOOR = 1e-8
+
+
+def init_params(sizes, seed: int = 0) -> dict:
+    """Fresh parameter dict for layer widths ``sizes`` (e.g.
+    ``[4, 32, 32, 8]`` — the first width is :data:`NET_FEATURES`),
+    Glorot-scaled, deterministically seeded.  Normalization constants
+    start at identity (mu=0, sd=1)."""
+    sizes = [int(s) for s in sizes]
+    if len(sizes) < 2 or sizes[0] != NET_FEATURES \
+            or sizes[-1] != OUT_CHANNELS or any(s < 1 for s in sizes):
+        raise errors.ModelConfigError(
+            "surrogate net sizes must run 4 -> ... -> 8 with positive "
+            "widths", sizes=str(sizes))
+    rng = np.random.default_rng(int(seed))
+    params = {"layers": np.asarray(len(sizes) - 1, dtype=np.int64)}
+    for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
+        scale = np.sqrt(2.0 / (m + n))
+        params[f"W{i}"] = (rng.standard_normal((m, n)) * scale).astype(
+            np.float64)
+        params[f"b{i}"] = np.zeros(n, dtype=np.float64)
+    params["x_mu"] = np.zeros(NET_FEATURES, dtype=np.float64)
+    params["x_sd"] = np.ones(NET_FEATURES, dtype=np.float64)
+    params["y_mu"] = np.zeros(OUT_CHANNELS, dtype=np.float64)
+    params["y_sd"] = np.ones(OUT_CHANNELS, dtype=np.float64)
+    return params
+
+
+def _nlayers(params: dict) -> int:
+    try:
+        return int(np.asarray(params["layers"]))
+    except (KeyError, TypeError, ValueError) as e:
+        raise errors.ModelConfigError(
+            "surrogate params carry no layer count", field="layers"
+        ) from e
+
+
+def _features(X, xp):
+    """Raw ``(N, 3)`` inputs -> the ``(N, 4)`` net features
+    (Hs, Tp, sin beta, cos beta); ``xp`` is numpy or jax.numpy."""
+    X = xp.asarray(X)
+    return xp.concatenate(
+        [X[:, :2], xp.sin(X[:, 2:3]), xp.cos(X[:, 2:3])], axis=1)
+
+
+def forward(params: dict, X):
+    """Batched forward pass: ``X (N, 3)`` raw inputs -> ``(N, 8)`` raw
+    outputs (periodic beta embedding + normalization applied
+    internally on both sides).  Traceable — the serve layer jits it
+    once per bundle."""
+    import jax.numpy as jnp
+
+    L = _nlayers(params)
+    h = (_features(X, jnp) - params["x_mu"]) / params["x_sd"]
+    for i in range(L):
+        h = h @ params[f"W{i}"] + params[f"b{i}"]
+        if i < L - 1:
+            h = jnp.tanh(h)
+    return h * params["y_sd"] + params["y_mu"]
+
+
+def forward_np(params: dict, X) -> np.ndarray:
+    """:func:`forward` in pure NumPy — the serving hot path.  One
+    ``(1, 3)`` row through this tiny MLP is ~15 us of float64 matmuls;
+    the jitted XLA twin pays several times the net's whole FLOP cost
+    in per-call dispatch overhead alone.  Training stays on JAX; the
+    two agree to ~1 ulp (same float64 ops, same order), and the
+    conformal calibration evaluates THIS function so the served bounds
+    are calibrated against the exact forward that serves."""
+    L = _nlayers(params)
+    h = (_features(np.asarray(X, dtype=np.float64), np)
+         - params["x_mu"]) / params["x_sd"]
+    for i in range(L):
+        h = h @ params[f"W{i}"] + params[f"b{i}"]
+        if i < L - 1:
+            np.tanh(h, out=h)
+    return h * params["y_sd"] + params["y_mu"]
+
+
+def fit(X, Y, *, hidden=(32, 32), steps: int = 1500, lr: float = 5e-3,
+        seed: int = 0) -> tuple[dict, dict]:
+    """Train the net on corpus arrays ``X (N, 3)`` / ``Y (N, 8)`` with
+    full-batch Adam (the corpora are thousands of rows, not millions).
+
+    Returns ``(params, info)``: npz-ready ``params`` (weights + the
+    normalization constants fitted from THIS data) and an ``info`` dict
+    with the loss trajectory endpoints and step count.  Deterministic
+    for fixed inputs/seed."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    X = np.asarray(X, dtype=np.float64)
+    Y = np.asarray(Y, dtype=np.float64)
+    if X.ndim != 2 or X.shape[1] != IN_FEATURES or Y.ndim != 2 \
+            or Y.shape[1] != OUT_CHANNELS or X.shape[0] != Y.shape[0]:
+        raise errors.ModelConfigError(
+            "surrogate corpus must be X (N, 3) / Y (N, 8)",
+            x_shape=str(X.shape), y_shape=str(Y.shape))
+    if X.shape[0] < 2:
+        raise errors.ModelConfigError(
+            "surrogate corpus too small to fit", rows=X.shape[0])
+    if int(steps) < 1 or float(lr) <= 0.0:
+        raise errors.ModelConfigError(
+            "surrogate fit needs steps >= 1 and lr > 0",
+            steps=int(steps), lr=float(lr))
+
+    params = init_params([NET_FEATURES, *hidden, OUT_CHANNELS],
+                         seed=seed)
+    feats = np.asarray(_features(X, np))
+    params["x_mu"] = feats.mean(axis=0)
+    params["x_sd"] = np.maximum(feats.std(axis=0), _SCALE_FLOOR)
+    params["y_mu"] = Y.mean(axis=0)
+    params["y_sd"] = np.maximum(Y.std(axis=0), _SCALE_FLOOR)
+    frozen = {k: params[k] for k in
+              ("layers", "x_mu", "x_sd", "y_mu", "y_sd")}
+    train = {k: jnp.asarray(v) for k, v in params.items()
+             if k not in frozen}
+    Xj, Yj = jnp.asarray(X), jnp.asarray(Y)
+
+    def loss_fn(tp):
+        pred = forward({**frozen, **tp}, Xj)
+        # normalized-space MSE: every channel counts equally regardless
+        # of its physical units
+        err = (pred - Yj) / frozen["y_sd"]
+        return jnp.mean(err * err)
+
+    opt = optax.adam(float(lr))
+    state = opt.init(train)
+
+    @jax.jit
+    def step(tp, st):
+        val, grads = jax.value_and_grad(loss_fn)(tp)
+        upd, st = opt.update(grads, st, tp)
+        return optax.apply_updates(tp, upd), st, val
+
+    loss0 = loss_last = None
+    for _ in range(int(steps)):
+        train, state, val = step(train, state)
+        loss_last = float(val)
+        if loss0 is None:
+            loss0 = loss_last
+    params = {**frozen,
+              **{k: np.asarray(v, dtype=np.float64)
+                 for k, v in train.items()}}
+    return params, {"steps": int(steps), "loss_first": loss0,
+                    "loss_last": loss_last,
+                    "hidden": [int(h) for h in hidden],
+                    "rows": int(X.shape[0])}
+
+
+def predict_row(params: dict, Hs: float, Tp: float, beta: float):
+    """Single-case convenience wrapper around :func:`forward` — one
+    ``(8,)`` numpy row (std[6], iters proxy, converged logit)."""
+    out = forward(params, np.asarray(
+        [[float(Hs), float(Tp), float(beta)]], dtype=np.float64))
+    return np.asarray(out)[0]

@@ -200,13 +200,24 @@ def facts_from_manifest(doc: dict) -> dict:
                 facts[f"serve_{k}"] = serve[k]
         if serve.get("mode"):
             facts["serve_mode"] = str(serve["mode"])
-        # preemption-tolerance + storage facts (serve/checkpoint.py):
-        # unprefixed ckpt_*/disk_* names, present only on
-        # checkpoint-enabled / disk-accounted service rows
+        # preemption-tolerance + storage facts (serve/checkpoint.py)
+        # and learned-read-tier facts (serve/surrogate.py): unprefixed
+        # names matched exactly by their SLO rules, present only on
+        # checkpoint-/surrogate-enabled service rows
         for k in ("ckpt_writes", "ckpt_corrupt", "ckpt_resumes",
                   "ckpt_resumed_from_step", "ckpt_resumed",
                   "ckpt_shed", "store_shed", "disk_journal_bytes",
-                  "disk_resultstore_bytes", "disk_checkpoint_bytes"):
+                  "disk_resultstore_bytes", "disk_checkpoint_bytes",
+                  "surrogate_served", "surrogate_escalated",
+                  "surrogate_audits", "surrogate_audit_errors",
+                  "surrogate_quarantines", "surrogate_hit_ratio",
+                  "surrogate_read_p50_ms", "surrogate_read_p99_ms",
+                  "surrogate_bound_violation_served_count",
+                  "surrogate_quarantine_miss",
+                  # quarantine-drill rows (cfg.surrogate_drill): the
+                  # intentional served violation trends under its own
+                  # name, never the zero-tolerance fact above
+                  "surrogate_drill", "surrogate_drill_violations"):
             if _num(serve.get(k)) is not None:
                 facts[k] = serve[k]
         # per-request phase breakdown (service summary():
@@ -239,6 +250,24 @@ def facts_from_manifest(doc: dict) -> dict:
                   "warm_start_digest_mismatch"):
             if _num(sbench.get(k)) is not None:
                 facts[f"serve_{k}"] = sbench[k]
+    # learned-read-tier bench facts (bench.py surrogate): the
+    # ground-truth audit row — every surrogate-served answer in the
+    # bench is ALSO cold-solved, so the two zero-tolerance facts here
+    # are measured against real physics, not the service's sampled
+    # audit cadence
+    sur = extra.get("surrogate_bench") or {}
+    if isinstance(sur, dict):
+        for k in ("served", "escalated", "hit_ratio", "read_p50_ms",
+                  "read_p99_ms", "speedup_vs_cold", "cold_case_s",
+                  "corpus_rows", "bound_rel_max", "quarantines",
+                  "audited"):
+            if _num(sur.get(k)) is not None:
+                facts[f"surrogate_{k}"] = sur[k]
+        # unprefixed: named exactly by the zero-tolerance SLO rules
+        for k in ("surrogate_bound_violation_served_count",
+                  "surrogate_quarantine_miss"):
+            if _num(sur.get(k)) is not None:
+                facts[k] = sur[k]
     # differentiable co-design facts (parallel/optimize.py +
     # bench.py optimize): descent throughput, the gradient-health
     # ratio (SLO rule: non-finite adjoints must be 0), and the
@@ -577,6 +606,18 @@ DEFAULT_SLO_RULES = [
     {"name": "serve_warm_start_digest_mismatch",
      "fact": "serve_warm_start_digest_mismatch", "agg": "max",
      "op": "<=", "threshold": 0.0, "window": 20},
+    # -- learned-read-tier gates (serve/surrogate.py; facts exist only
+    # on surrogate-enabled service rows and the surrogate bench's
+    # ground-truth audit — ordinary runs skip).  Both zero-tolerance:
+    # a surrogate answer delivered outside its calibrated bound is a
+    # wrong number served as physics; a bound violation that did NOT
+    # quarantine its bundle is the audit ladder failing silent.
+    {"name": "surrogate_bound_violation_served_count",
+     "fact": "surrogate_bound_violation_served_count", "agg": "max",
+     "op": "<=", "threshold": 0.0, "window": 20},
+    {"name": "surrogate_quarantine_miss",
+     "fact": "surrogate_quarantine_miss", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
     # -- preemption-tolerance gates (serve/checkpoint.py; facts exist
     # only on resumed / storage-fault rows — the preempt soak's
     # ground-truth comparison and checkpoint-enabled service

@@ -114,6 +114,38 @@ class ServeConfig:
     #: audit every batch (the parity-proof mode the storm soak runs)
     warm_audit_every: int = 8
 
+    # -- learned read tier (serve/surrogate.py) ------------------------
+    #: directory of distilled surrogate bundles (written by ``raftserve
+    #: distill``); None (default) disables surrogate serving.  Requires
+    #: ``store_dir`` — the surrogate is distilled FROM the result store
+    #: and audited AGAINST it
+    surrogate_dir: str | None = None
+    #: serve from the surrogate only when the bundle's calibrated
+    #: relative std error bound (conformal holdout quantile) clears
+    #: this tolerance; a sloppier bundle escalates everything to the
+    #: exact path
+    surrogate_tol: float = 0.05
+    #: every Nth surrogate-served request is ALSO cold-solved and the
+    #: two compared at the calibrated bound — a violation quarantines
+    #: the bundle and the tenant falls back to exact serving.  1 =
+    #: audit every surrogate answer (the parity-proof mode the bench
+    #: runs)
+    surrogate_audit_every: int = 8
+    #: stale-corpus drift guard: after this many result-store puts
+    #: since a tenant's last audit, the next surrogate-served request
+    #: is force-audited regardless of the cadence above
+    surrogate_refresh_writes: int = 64
+    #: quarantine-drill mode (bench/chaos only): this service EXPECTS
+    #: to serve stale-bundle answers so the audit->quarantine ladder
+    #: can be proven live.  Its summary reports served violations as
+    #: ``surrogate_drill_violations`` instead of the zero-tolerance
+    #: ``surrogate_bound_violation_served_count`` fact, so the drill's
+    #: intentional violation never trips the production SLO rule.
+    #: ``surrogate_quarantine_miss`` stays zero-tolerance either way —
+    #: a drill violation the audit fails to quarantine is still a
+    #: silent-audit failure.  Never set this on a production service.
+    surrogate_drill: bool = False
+
     # -- replication (serve/replica.py) -------------------------------
     #: peer directories the write-ahead journal is mirrored to (local
     #: paths now, object-store mounts later); requires ``journal_dir``.
@@ -212,6 +244,13 @@ class ServeConfig:
              or self.store_dir is not None),
             ("warm_radius", self.warm_radius > 0.0),
             ("warm_audit_every", self.warm_audit_every >= 1),
+            ("surrogate_dir", self.surrogate_dir is None
+             or (bool(str(self.surrogate_dir).strip())
+                 and self.store_dir is not None)),
+            ("surrogate_tol", self.surrogate_tol > 0.0),
+            ("surrogate_audit_every", self.surrogate_audit_every >= 1),
+            ("surrogate_refresh_writes",
+             self.surrogate_refresh_writes >= 1),
             ("ckpt_dir", self.ckpt_dir is None
              or bool(str(self.ckpt_dir).strip())),
             ("checkpoint_every", self.checkpoint_every >= 0),

@@ -72,7 +72,7 @@ HANDOFF = "handoff.json"
 #: record types replay understands; anything else in the stream is
 #: schema drift and counts as corruption
 RECORD_TYPES = ("begin", "admit", "batch", "complete", "fail", "tenant",
-                "recover", "handoff", "ckpt")
+                "recover", "handoff", "ckpt", "surrogate")
 
 #: journaled ``objective_trace`` entries beyond which the WAL keeps
 #: only first/last + length: a long descent's trace is delivered in
@@ -372,6 +372,23 @@ class RequestJournal:
             rec["trace"] = dict(trace)
         self._write("ckpt", **rec)
 
+    def record_surrogate(self, rdigest: str, tenant: str, bundle: str,
+                         digest: str, bound: float, audited: bool,
+                         trace: dict = None):
+        """A request was answered by the learned read tier: the
+        provenance link from the request digest to the serving bundle's
+        content digest, the served payload digest, and the calibrated
+        bound it was served under.  Non-terminal and seq-less — a
+        surrogate answer never occupies a queue slot, and replay must
+        never mistake predicted physics for a solver result (there is
+        deliberately NO ``complete`` record)."""
+        rec = dict(rdigest=rdigest, tenant=str(tenant),
+                   bundle=str(bundle), digest=str(digest),
+                   bound=float(bound), audited=bool(audited))
+        if trace is not None:
+            rec["trace"] = dict(trace)
+        self._write("surrogate", **rec)
+
     def record_fail(self, seq: int, rdigest: str, error: dict,
                     quarantined: bool, trace: dict = None):
         rec = dict(seq=int(seq), rdigest=rdigest,
@@ -461,6 +478,9 @@ def replay(journal_dir: str, strict: bool = False) -> dict:
          "deduped":   {seq: complete record of the SAME rdigest},
          "ckpts":     {seq: newest ckpt record (pending descents'
                       resume audit trail)},
+         "surrogates": [surrogate provenance records, stream order —
+                      answers served by the learned read tier; never
+                      terminal, never replayed as physics],
          "by_rdigest": {rdigest: complete record},
          "max_seq":   highest admitted seq (-1 when empty),
          "corrupt":   torn/unparseable lines skipped (counted in
@@ -481,6 +501,7 @@ def replay(journal_dir: str, strict: bool = False) -> dict:
     completed: dict[int, dict] = {}
     failed: dict[int, dict] = {}
     ckpts: dict[int, dict] = {}
+    surrogates: list[dict] = []
     handoff = None
     corrupt = 0
     records = 0
@@ -505,6 +526,8 @@ def replay(journal_dir: str, strict: bool = False) -> dict:
                 # newest wins: the record ties a pending descent's
                 # request digest to its last journaled segment
                 ckpts[int(seq)] = doc
+            elif t == "surrogate":
+                surrogates.append(doc)
             elif t == "handoff":
                 handoff = doc
     if strict and corrupt:
@@ -528,6 +551,7 @@ def replay(journal_dir: str, strict: bool = False) -> dict:
             pending.append(rec)
     return {"admitted": admitted, "completed": completed,
             "failed": failed, "pending": pending, "deduped": deduped,
-            "ckpts": ckpts, "by_rdigest": by_rdigest,
+            "ckpts": ckpts, "surrogates": surrogates,
+            "by_rdigest": by_rdigest,
             "max_seq": max(admitted) if admitted else -1,
             "corrupt": corrupt, "records": records, "handoff": handoff}

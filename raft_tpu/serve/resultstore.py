@@ -125,6 +125,11 @@ class ResultStore:
         #: never read until a hit needs one)
         self._index: dict[str, dict] | None = None
         self._index_mtime: int = -1
+        #: vectorized :meth:`nearest` arrays (rdigests, (N,3) coords,
+        #: tenants, seed mask, rdigest->row map), rebuilt lazily after
+        #: any index mutation — the per-query cost is O(1) NumPy array
+        #: ops, not a Python loop over every entry
+        self._narr = None
         self._last_force_rescan = float("-inf")
         self._quarantined: set[str] = set()
         self._counts = {k: 0 for k in (
@@ -172,6 +177,7 @@ class ResultStore:
             return
         self._index = {}
         self._index_mtime = self._dir_mtime()
+        self._narr = None
         try:
             names = os.listdir(self.dir)
         except OSError:
@@ -191,6 +197,7 @@ class ResultStore:
         if not force and mtime == self._index_mtime:
             return
         self._index_mtime = mtime
+        self._narr = None
         try:
             names = os.listdir(self.dir)
         except OSError:
@@ -315,8 +322,17 @@ class ResultStore:
                 "Hs": side["Hs"], "Tp": side["Tp"], "beta": side["beta"],
                 "tenant": side["tenant"], "digest": doc["digest"],
                 "xi": xi_arr is not None}
+            self._narr = None
             self._counts["puts"] += 1
         return True
+
+    @property
+    def put_count(self) -> int:
+        """Completed puts this process has seen — the cheap drift
+        signal the surrogate tier's re-audit cadence keys off (no
+        directory walk, unlike :meth:`stats`)."""
+        with self._lock:
+            return self._counts["puts"]
 
     # ------------------------------------------------------------------
     # read path (the integrity ladder)
@@ -330,6 +346,7 @@ class ResultStore:
                 pass
         if self._index is not None:
             self._index.pop(rdigest, None)
+            self._narr = None
 
     def _corrupt(self, rdigest: str, reason: str, strict: bool):
         with self._lock:
@@ -441,12 +458,15 @@ class ResultStore:
         with self._lock:
             self._counts["hits"] += 1
             self._ensure_index_locked()
-            self._index.setdefault(str(rdigest), {
-                "Hs": float(doc["Hs"]), "Tp": float(doc["Tp"]),
-                "beta": float(doc["beta"]), "tenant": str(doc["tenant"]),
-                "digest": doc["digest"],
-                "xi": bool(side.get("xi_sha256"))
-                and os.path.exists(self._paths(rdigest)[2])})
+            if str(rdigest) not in self._index:
+                self._index[str(rdigest)] = {
+                    "Hs": float(doc["Hs"]), "Tp": float(doc["Tp"]),
+                    "beta": float(doc["beta"]),
+                    "tenant": str(doc["tenant"]),
+                    "digest": doc["digest"],
+                    "xi": bool(side.get("xi_sha256"))
+                    and os.path.exists(self._paths(rdigest)[2])}
+                self._narr = None
         return doc
 
     def get_by_digest(self, digest: str, strict: bool = False) -> dict | None:
@@ -484,6 +504,7 @@ class ResultStore:
         with self._lock:
             if self._index is not None and rdigest in self._index:
                 self._index[rdigest]["xi"] = False
+                self._narr = None
         self._count_corrupt(reason)
         _LOG.warning("result store: seed of %s failed integrity (%s) "
                      "— seed dropped, payload kept",
@@ -522,6 +543,34 @@ class ResultStore:
     # neighbor seeding
     # ------------------------------------------------------------------
 
+    def _nearest_arrays_locked(self):
+        """Parallel NumPy views of the index for :meth:`nearest` —
+        rebuilt only after an index mutation (every mutator clears
+        ``_narr``; the rebuild itself rides the same directory-mtime
+        guard the dict index does), so each neighbor query is O(1)
+        vectorized array ops instead of a per-entry Python loop."""
+        if self._narr is None:
+            rds, coords, tenants, xi = [], [], [], []
+            for rd, m in self._index.items():
+                try:
+                    c = (float(m["Hs"]), float(m["Tp"]),
+                         float(m["beta"]))
+                except (TypeError, ValueError):
+                    continue
+                rds.append(rd)
+                coords.append(c)
+                tenants.append(str(m.get("tenant")))
+                xi.append(bool(m.get("xi"))
+                          and rd not in self._quarantined)
+            self._narr = (
+                np.asarray(rds, dtype=object),
+                np.asarray(coords, dtype=np.float64).reshape(
+                    len(rds), 3),
+                np.asarray(tenants, dtype=object),
+                np.asarray(xi, dtype=bool),
+                {rd: i for i, rd in enumerate(rds)})
+        return self._narr
+
     def nearest(self, Hs: float, Tp: float, beta: float, tenant: str,
                 radius: float, exclude=()) -> tuple[str, float] | None:
         """The closest seed-bearing entry to ``(Hs, Tp, beta)`` for
@@ -529,23 +578,27 @@ class ResultStore:
         beta [rad] — the case tables are smooth on roughly unit scales
         in all three), skipping quarantined keys and ``exclude``.
         Returns ``(rdigest, distance)`` or None."""
-        best = None
-        best_d = float(radius)
         with self._lock:
             self._refresh_index_locked()
-            for rd, m in self._index.items():
-                if not m.get("xi") or rd in self._quarantined \
-                        or rd in exclude or m.get("tenant") != tenant:
-                    continue
-                try:
-                    d = ((float(m["Hs"]) - Hs) ** 2
-                         + (float(m["Tp"]) - Tp) ** 2
-                         + (float(m["beta"]) - beta) ** 2) ** 0.5
-                except (TypeError, ValueError):
-                    continue
-                if d <= best_d:
-                    best, best_d = rd, d
-        return (best, best_d) if best is not None else None
+            rds, coords, tenants, xi, pos = self._nearest_arrays_locked()
+            if not len(rds):
+                return None
+            ok = xi & (tenants == tenant)
+            for rd in exclude:
+                i = pos.get(rd)
+                if i is not None:
+                    ok[i] = False
+            if not ok.any():
+                return None
+            d2 = coords - np.asarray(
+                [float(Hs), float(Tp), float(beta)])
+            d2 = np.einsum("ij,ij->i", d2, d2)
+            d2 = np.where(ok, d2, np.inf)
+            i = int(np.argmin(d2))
+            d = float(np.sqrt(d2[i]))
+            if d > float(radius):
+                return None
+            return str(rds[i]), d
 
     def quarantine(self, rdigest: str):
         """Remove one entry from all future seeding (the divergence
@@ -566,6 +619,7 @@ class ResultStore:
                 pass
             if self._index is not None and rdigest in self._index:
                 self._index[rdigest]["xi"] = False
+            self._narr = None
         try:
             from raft_tpu import obs
             obs.counter(
@@ -577,6 +631,68 @@ class ResultStore:
             pass
         _LOG.warning("result store: seed %s quarantined (divergence "
                      "guard)", _stem(rdigest)[:12])
+
+    # ------------------------------------------------------------------
+    # corpus export (the surrogate tier's training feed)
+    # ------------------------------------------------------------------
+
+    def iter_corpus(self, tenant: str = None, counts: dict = None):
+        """Deterministic training-corpus iterator: yield ``(rdigest,
+        payload)`` for every entry that passes the FULL read integrity
+        ladder, in sorted-rdigest order — two exports of the same store
+        see the same rows in the same order, byte for byte.
+
+        Invalid entries are skipped and counted into ``counts``:
+
+        - ``skipped_orphan`` — a payload with no certifying sidecar (a
+          torn put); detected by directory scan and never touched (a
+          young orphan may be a put still committing), so repeated
+          exports of the same store count it identically;
+        - ``skipped_quarantined`` — entries whose seed the divergence
+          guard quarantined this process-lifetime: their physics is
+          suspect, so they never become training data;
+        - ``skipped_corrupt`` — indexed entries that failed the read
+          ladder (those ride the store's normal delete-and-miss
+          discipline, counted alongside its corrupt counter);
+        - ``skipped_degraded`` — entries solved below the ``full``
+          rung (never canonical physics);
+        - ``exported`` — rows actually yielded."""
+        if counts is None:
+            counts = {}
+        for k in ("exported", "skipped_orphan", "skipped_quarantined",
+                  "skipped_corrupt", "skipped_degraded"):
+            counts.setdefault(k, 0)
+        with self._lock:
+            self._refresh_index_locked(force=True)
+            rds = sorted(self._index)
+            tenants = {rd: self._index[rd].get("tenant") for rd in rds}
+            quarantined = set(self._quarantined)
+            try:
+                names = os.listdir(self.dir)
+            except OSError:
+                names = []
+        # torn-put orphans are invisible to the sidecar-built index —
+        # scan for payloads with no certifying sidecar so the export
+        # accounting is complete (counted, untouched)
+        stems = {n[:-4] for n in names if n.endswith(".sum")}
+        counts["skipped_orphan"] += sum(
+            1 for n in sorted(names)
+            if n.endswith(".json") and n[:-5] not in stems)
+        for rd in rds:
+            if tenant is not None and tenants.get(rd) != tenant:
+                continue
+            if rd in quarantined:
+                counts["skipped_quarantined"] += 1
+                continue
+            doc = self.get(rd)
+            if doc is None:
+                counts["skipped_corrupt"] += 1
+                continue
+            if doc.get("mode", "full") != "full":
+                counts["skipped_degraded"] += 1
+                continue
+            counts["exported"] += 1
+            yield rd, doc
 
     # ------------------------------------------------------------------
     # introspection

@@ -331,7 +331,10 @@ class SweepService:
             "retries", "retried_recovered", "deadline_misses",
             "unhandled", "batches", "abandoned_batches", "expired",
             "store_hits", "coalesced", "warm_seeded", "warm_rejected",
-            "warm_mismatch", "ckpt_resumed", "ckpt_shed", "store_shed")}
+            "warm_mismatch", "ckpt_resumed", "ckpt_shed", "store_shed",
+            "surrogate_served", "surrogate_escalated",
+            "surrogate_audits", "surrogate_violations",
+            "surrogate_quarantines", "surrogate_audit_errors")}
         # -- storage-shed ladder (serve/checkpoint.py, ENOSPC): typed
         # StorageExhausted from a persistence write sheds THAT rung for
         # storage_shed_hold_s — checkpointing first, then the
@@ -349,6 +352,20 @@ class SweepService:
                                       keep_xi=self.cfg.warm_start)
         #: rdigest -> the PRIMARY in-flight request duplicates attach to
         self._flight: dict[str, _Request] = {}
+        # -- learned read tier (serve/surrogate.py): distilled
+        # per-tenant MLP answering in-hull queries between the
+        # exact-digest hit and the cold solve, kept honest by the
+        # audit/quarantine ladder
+        self._surrogate = None
+        if self.cfg.surrogate_dir:
+            from raft_tpu.serve.surrogate import SurrogateTier
+            self._surrogate = SurrogateTier(
+                self.cfg.surrogate_dir, tol=self.cfg.surrogate_tol,
+                audit_every=self.cfg.surrogate_audit_every,
+                refresh_writes=self.cfg.surrogate_refresh_writes)
+        #: surrogate-serve latencies (ms) for the p50/p99 summary facts
+        self._surrogate_ms: collections.deque[float] = collections.deque(
+            maxlen=10_000)
         # -- preemption tolerance (serve/checkpoint.py): descent
         # progress persists every checkpoint_every steps; recover()
         # resumes an accepted-unfinished optimization from its newest
@@ -926,7 +943,8 @@ class SweepService:
 
     def submit(self, Hs: float, Tp: float, heading_rad: float,
                deadline_s: float = None,
-               tenant: str = DEFAULT_TENANT, trace=None) -> Ticket:
+               tenant: str = DEFAULT_TENANT, trace=None,
+               exact: bool = False) -> Ticket:
         """Admit one case request; returns its :class:`Ticket`.
 
         Raises :class:`~raft_tpu.errors.AdmissionRejected` (with a
@@ -954,7 +972,20 @@ class SweepService:
         serialized context dict; anything missing/malformed mints a
         fresh root.  The context rides the request through the WAL,
         batch membership, and the delivered result's
-        ``provenance["trace"]``."""
+        ``provenance["trace"]``.
+
+        With the learned read tier configured (``cfg.surrogate_dir``)
+        an exact-digest *miss* consults the tenant's distilled
+        surrogate next: a query inside the training hull whose
+        calibrated error bound clears ``cfg.surrogate_tol`` is
+        answered from one compiled forward pass
+        (``source="surrogate"``, no queue slot, no physics record in
+        the WAL — the response carries a ``surrogate`` provenance
+        block naming the bundle digest and bound).  Anything outside
+        the hull, over tolerance, or under quarantine escalates to
+        the cold path unchanged.  ``exact=True`` bypasses the
+        surrogate tier entirely (the audit path uses this to obtain
+        ground truth)."""
         obs = self._obs()
         tenant = self._tenants.require(tenant)
         ctx = _coerce_trace(trace)
@@ -973,6 +1004,11 @@ class SweepService:
                 t = Ticket(hit.request_id, hit.seq, trace=ctx)
                 t._finish(hit)
                 return t
+            if self._surrogate is not None and not exact:
+                t = self._try_surrogate(rdigest, Hs, Tp, heading_rad,
+                                        tenant, ctx)
+                if t is not None:
+                    return t
         follower = None
         with self._cond:
             retry_after = self._estimate_wait_locked()
@@ -2694,6 +2730,135 @@ class SweepService:
             ).observe(elapsed)
         return res
 
+    def _try_surrogate(self, rdigest: str, Hs: float, Tp: float,
+                       beta: float, tenant: str,
+                       ctx: TraceContext) -> Ticket | None:
+        """The learned read tier (serve/surrogate.py), consulted on an
+        exact-digest miss: a query inside the tenant bundle's training
+        hull whose calibrated bound clears ``cfg.surrogate_tol`` is
+        answered from one compiled forward pass — a finished ticket,
+        no queue slot, no solver work.  Returns None (escalate to the
+        cold path) for anything else: no bundle, quarantined,
+        out-of-hull, over-tolerance, or a predicted non-converged
+        regime.
+
+        Every served answer is journaled as a non-terminal
+        ``surrogate`` provenance record (NEVER a ``complete`` — replay
+        must not mistake predicted physics for a solve), and every
+        ``audit_every``-th one is additionally cold-solved in the
+        background and compared at the bound
+        (:meth:`_audit_surrogate`)."""
+        obs = self._obs()
+        t0 = time.perf_counter()
+        decision = self._surrogate.decide(tenant, Hs, Tp, beta)
+        if decision is None:
+            if self._surrogate.has_bundle(tenant):
+                with self._lock:
+                    self._counts["surrogate_escalated"] += 1
+                obs.counter(
+                    "raft_tpu_serve_surrogate_total",
+                    "learned-read-tier admission outcomes").inc(
+                        1.0, outcome="escalated")
+            return None
+        bundle, (std, iters, converged) = decision
+        from raft_tpu.obs.ledger import digest_metrics
+        digest = digest_metrics({"std": std, "iters": int(iters),
+                                 "converged": bool(converged)})
+        elapsed = time.perf_counter() - t0
+        due = self._surrogate.note_served(tenant, self._store.put_count)
+        res = SweepResult(
+            ok=True, request_id=f"sur-{uuid.uuid4().hex[:8]}", seq=-1,
+            mode="full", attempts=0, latency_s=elapsed, digest=digest,
+            std=std, iters=int(iters), converged=bool(converged),
+            tenant=tenant, source="surrogate",
+            extra={"provenance": {
+                "trace": ctx.as_dict(),
+                "surrogate": {
+                    "bundle": bundle.digest,
+                    "version": bundle.version,
+                    "bound_rel_max": float(bundle.bound_rel.max()),
+                    "bound_abs": [float(v) for v in bundle.bound_abs],
+                    "tol": self._surrogate.tol,
+                    "audited": bool(due)}}})
+        if self._journal is not None:
+            self._journal.record_surrogate(
+                rdigest, tenant, bundle.digest, digest,
+                float(bundle.bound_rel.max()), due,
+                trace=ctx.as_dict())
+        with self._lock:
+            self._counts["surrogate_served"] += 1
+            self._surrogate_ms.append(elapsed * 1e3)
+        self._tenants.count(tenant, "completed")
+        obs.counter("raft_tpu_serve_surrogate_total",
+                    "learned-read-tier admission outcomes").inc(
+                        1.0, outcome="served")
+        obs.histogram(
+            "raft_tpu_serve_surrogate_read_s",
+            "learned-read-tier serve latency (admission to payload)",
+            buckets=(1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 0.1)
+            ).observe(elapsed)
+        self._emit("surrogate_served", rdigest=rdigest, tenant=tenant,
+                   bundle=bundle.digest, version=bundle.version,
+                   digest=digest, audit=bool(due))
+        if due:
+            run_audit = False
+            with self._cond:
+                run_audit = self._state == "running"
+            if run_audit:
+                threading.Thread(
+                    target=self._audit_surrogate,
+                    args=(tenant, bundle, Hs, Tp, beta, std,
+                          int(iters), bool(converged), rdigest),
+                    name="raft-surrogate-audit", daemon=True).start()
+        t = Ticket(res.request_id, res.seq, trace=ctx)
+        t._finish(res)
+        return t
+
+    def _audit_surrogate(self, tenant: str, bundle, Hs: float,
+                         Tp: float, beta: float, std, iters: int,
+                         converged: bool, rdigest: str):
+        """Ground-truth audit of one surrogate-served answer: re-solve
+        the same request on the exact path (``exact=True`` bypasses
+        the surrogate tier; the exact-digest store hit still counts —
+        stored physics IS ground truth) and compare at the calibrated
+        bound.  A violation quarantines the tenant's bundle durably
+        (:meth:`SurrogateTier.quarantine`): the tenant's digests fall
+        back to exact serving until a fresh distill."""
+        try:
+            ticket = self.submit(Hs, Tp, beta, tenant=tenant,
+                                 exact=True)
+            cold = ticket.result(timeout=self.cfg.deadline_s * 4)
+            if not cold.ok:
+                raise errors.RaftError(
+                    f"audit re-solve failed: {cold.error}")
+            ok, detail = bundle.within_bound(
+                std, iters, converged, cold,
+                tol=self.cfg.surrogate_tol)
+        except errors.RaftError:
+            with self._lock:
+                self._counts["surrogate_audit_errors"] += 1
+            self._emit("surrogate_audit", rdigest=rdigest,
+                       tenant=tenant, ok=False, error=True)
+            return
+        with self._lock:
+            self._counts["surrogate_audits"] += 1
+            if not ok:
+                self._counts["surrogate_violations"] += 1
+        self._obs().counter(
+            "raft_tpu_serve_surrogate_audits_total",
+            "surrogate ground-truth audits, by verdict").inc(
+                1.0, verdict="ok" if ok else "violation")
+        self._emit("surrogate_audit", rdigest=rdigest, tenant=tenant,
+                   ok=bool(ok), **{k: v for k, v in detail.items()})
+        if not ok:
+            with self._lock:
+                self._counts["surrogate_quarantines"] += 1
+            self._surrogate.quarantine(tenant, bundle,
+                                       "bound_violation", detail)
+            self._emit("surrogate_quarantine", tenant=tenant,
+                       bundle=bundle.digest, version=bundle.version,
+                       **{k: v for k, v in detail.items()})
+
     def fetch_rdigest(self, rdigest: str) -> SweepResult | None:
         """Completed result by its REQUEST digest (the content address
         of the submitted physics) — how a router re-resolves an
@@ -2766,6 +2931,7 @@ class SweepService:
                             if self._handoff_info else None)
             replayed_open = len(self._replayed_pending)
             read_ms = list(self._read_ms)
+            surrogate_ms = list(self._surrogate_ms)
             warm_savings = self._warm_iter_savings
             last_resumed = self._last_resumed_step
             phase_s = {p: list(d) for p, d in self._phase_s.items()
@@ -2813,6 +2979,46 @@ class SweepService:
             out["warm_start_rejected"] = counts["warm_rejected"]
             out["warm_start_digest_mismatch"] = counts["warm_mismatch"]
             out["warm_start_iter_savings"] = round(warm_savings, 3)
+        if self._surrogate is not None:
+            # learned-read-tier facts (serve/surrogate.py): present
+            # ONLY on surrogate-enabled services, so the zero-tolerance
+            # SLO rules (served bound violations, quarantine misses)
+            # skip every ordinary serve row.  ``requests`` grows by the
+            # served count — a surrogate answer IS a served request.
+            out["surrogate"] = self._surrogate.facts()
+            served = counts["surrogate_served"]
+            out["requests"] += served
+            out["surrogate_served"] = served
+            out["surrogate_escalated"] = counts["surrogate_escalated"]
+            out["surrogate_audits"] = counts["surrogate_audits"]
+            out["surrogate_audit_errors"] = counts[
+                "surrogate_audit_errors"]
+            if self.cfg.surrogate_drill:
+                # quarantine drill: the served violation is the point
+                # of the exercise — report it under a drill-scoped
+                # name so the zero-tolerance production rule only ever
+                # sees real serving rows.  quarantine_miss below stays
+                # zero-tolerance: a drill violation the audit fails to
+                # quarantine is still a silent-audit failure.
+                out["surrogate_drill"] = 1
+                out["surrogate_drill_violations"] = counts[
+                    "surrogate_violations"]
+            else:
+                out["surrogate_bound_violation_served_count"] = counts[
+                    "surrogate_violations"]
+            out["surrogate_quarantines"] = counts[
+                "surrogate_quarantines"]
+            # a violation that did NOT quarantine its bundle is the
+            # audit ladder failing silent — MUST be zero
+            out["surrogate_quarantine_miss"] = int(
+                counts["surrogate_violations"] >
+                counts["surrogate_quarantines"])
+            out["surrogate_hit_ratio"] = served / max(
+                1, served + counts["admitted"] + counts["store_hits"])
+            out["surrogate_read_p50_ms"] = self._percentile(
+                surrogate_ms, 50)
+            out["surrogate_read_p99_ms"] = self._percentile(
+                surrogate_ms, 99)
         if self._journal is not None:
             out["journal"] = {"path": self._journal.path,
                               "errors": self._journal.errors}

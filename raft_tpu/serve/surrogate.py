@@ -1,0 +1,564 @@
+"""Learned read tier: per-tenant surrogate serving distilled from the
+result store, with calibrated error bounds and audited escalation.
+
+Every cold solve the service completes persists its full response
+summary under integrity hashes (:mod:`raft_tpu.serve.resultstore`) — a
+silently accumulating training corpus.  This module distills it into a
+small pure-JAX MLP (:mod:`raft_tpu.models.surrogate_net`) per tenant
+and slots its inference between the exact-digest hit and the cold solve
+in :meth:`SweepService.submit`: a query inside the training hull whose
+calibrated error bound clears ``ServeConfig.surrogate_tol`` is answered
+from one compiled forward pass (``source="surrogate"``, microsecond
+latency, no queue slot, no WAL-complete of fake physics); anything else
+escalates to the normal solve path.
+
+The honesty ladder generalizes the PR 12 warm-start guard verbatim:
+
+- **calibrated bounds** — ``raftserve distill`` splits the exported
+  corpus into train/holdout and stamps the bundle with a
+  conformal-style per-channel error bound (the ``ceil((n+1)(1-alpha))``
+  smallest holdout absolute error); a bundle whose relative std bound
+  does not clear ``surrogate_tol`` never serves at all;
+- **audited escalation** — every ``surrogate_audit_every``-th
+  surrogate-served request is ALSO cold-solved (``submit(...,
+  exact=True)``) and the two compared at the bound.  A violation is
+  counted, the bundle is durably quarantined (marker file next to the
+  bundle, seen across restarts), and the tenant falls back to exact
+  serving;
+- **drift re-audit** — a corpus that keeps growing means the world
+  moved: after every ``surrogate_refresh_writes`` store puts the next
+  surrogate-served request is force-audited regardless of cadence.
+
+Bundle format: one versioned ``.npz`` (net params + normalization +
+``bound_abs``/``bound_rel`` + the training hull box + a JSON meta
+blob), digest-stamped by the sha256 of its own bytes and named by a
+``surrogate_<tenant>.json`` pointer written last — a torn publish
+leaves the previous bundle live, never a half-written one.  All writes
+ride the shared crash-safe helper (``obs/journalio.fsync_write``;
+raftlint RTL007 pins this module onto it).
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from raft_tpu import errors
+from raft_tpu.models import surrogate_net
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("serve.surrogate")
+
+SCHEMA = "raft_tpu.serve.surrogate/v1"
+
+#: saturated logit the converged flag trains toward (sigmoid(±4) is
+#: within 2% of 0/1 — a clean regression target that still round-trips
+#: through a threshold at 0)
+CONV_LOGIT = 4.0
+
+#: conformal miscoverage level: bounds cover >= (1 - alpha) of holdout
+DEFAULT_ALPHA = 0.1
+
+#: refuse to distill below this many verified corpus rows — a bundle
+#: calibrated on a handful of points has meaningless bounds
+MIN_ROWS = 16
+
+
+def _fsync_write(path: str, data: bytes):
+    # the shared crash-safe write discipline (tmp -> fsync -> rename);
+    # raftlint RTL007 pins every persistence write in this module on it
+    from raft_tpu.obs.journalio import fsync_write
+    fsync_write(path, data)
+
+
+def bundle_pointer_path(sdir: str, tenant: str) -> str:
+    return os.path.join(str(sdir), f"surrogate_{tenant}.json")
+
+
+def quarantine_marker_path(sdir: str, tenant: str) -> str:
+    return os.path.join(str(sdir), f"surrogate_{tenant}.quarantined.json")
+
+
+# ---------------------------------------------------------------------------
+# corpus export (deterministic — satellite-pinned byte identity)
+# ---------------------------------------------------------------------------
+
+def export_corpus(store, tenant: str = "default",
+                  counts: dict = None) -> tuple[np.ndarray, np.ndarray,
+                                                list[str]]:
+    """Export the store's verified corpus for one tenant as training
+    arrays: ``X (N, 3)`` = (Hs, Tp, beta), ``Y (N, 8)`` = per-DOF std,
+    iters, converged logit — plus the sorted rdigest list the rows came
+    from.
+
+    Deterministic by construction (sorted-rdigest iteration over
+    sidecar-verified entries, float64 throughout): exporting the same
+    store twice yields byte-identical arrays.  Invalid entries —
+    torn-put orphans, integrity failures, quarantined seeds, degraded-
+    mode rows — are skipped and counted in ``counts``; the export never
+    deletes anything (it is an offline reader, not the serving ladder).
+    """
+    X, Y, rds = [], [], []
+    for rd, doc in store.iter_corpus(tenant=tenant, counts=counts):
+        X.append([float(doc["Hs"]), float(doc["Tp"]),
+                  float(doc["beta"])])
+        Y.append([*(float(v) for v in doc["std"]), float(doc["iters"]),
+                  CONV_LOGIT if doc["converged"] else -CONV_LOGIT])
+        rds.append(rd)
+    X = np.asarray(X, dtype=np.float64).reshape(len(rds), 3)
+    Y = np.asarray(Y, dtype=np.float64).reshape(
+        len(rds), surrogate_net.OUT_CHANNELS)
+    return X, Y, rds
+
+
+def corpus_digest(X: np.ndarray, Y: np.ndarray) -> str:
+    """Content address of one exported corpus (the provenance link a
+    bundle records back to its training data)."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(X, dtype=np.float64).tobytes())
+    h.update(np.ascontiguousarray(Y, dtype=np.float64).tobytes())
+    return "sha256:" + h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# calibration + bundle write
+# ---------------------------------------------------------------------------
+
+def _conformal_bound(abs_err: np.ndarray, alpha: float) -> np.ndarray:
+    """Per-channel conformal-style bound: the ``ceil((n+1)(1-alpha))``
+    smallest holdout absolute error (clipped to the sample) — covers at
+    least ``1 - alpha`` of exchangeable future queries per channel."""
+    n = abs_err.shape[0]
+    k = min(n, max(1, int(np.ceil((n + 1) * (1.0 - float(alpha))))))
+    return np.sort(abs_err, axis=0)[k - 1]
+
+
+def write_bundle(sdir: str, tenant: str, params: dict, *,
+                 bound_abs: np.ndarray, bound_rel: np.ndarray,
+                 hull_lo: np.ndarray, hull_hi: np.ndarray,
+                 meta: dict, rel_floor: np.ndarray = None) -> dict:
+    """Serialize one bundle, digest-stamp it, and publish it as the
+    tenant's current bundle (pointer written LAST — a crash mid-publish
+    leaves the previous bundle live).  A fresh publish clears any
+    standing quarantine marker: a re-distilled bundle supersedes the
+    quarantined one.  Returns ``{path, digest, version}``."""
+    os.makedirs(str(sdir), exist_ok=True)
+    pointer = bundle_pointer_path(sdir, tenant)
+    version = 1
+    try:
+        with open(pointer, encoding="utf-8") as f:
+            version = int(json.load(f).get("version", 0)) + 1
+    except (OSError, ValueError, json.JSONDecodeError):
+        pass
+    doc = dict(meta, schema=SCHEMA, tenant=str(tenant), version=version)
+    buf = io.BytesIO()
+    if rel_floor is None:
+        rel_floor = np.zeros(6)
+    np.savez(buf, **params, bound_abs=np.asarray(bound_abs, np.float64),
+             bound_rel=np.asarray(bound_rel, np.float64),
+             rel_floor=np.asarray(rel_floor, np.float64),
+             hull_lo=np.asarray(hull_lo, np.float64),
+             hull_hi=np.asarray(hull_hi, np.float64),
+             meta_json=np.frombuffer(
+                 json.dumps(doc, sort_keys=True).encode(), dtype=np.uint8))
+    data = buf.getvalue()
+    digest = "sha256:" + hashlib.sha256(data).hexdigest()
+    name = f"surrogate_{tenant}_v{version}_{digest[-12:]}.npz"
+    path = os.path.join(str(sdir), name)
+    _fsync_write(path, data)
+    _fsync_write(pointer, json.dumps(
+        {"schema": SCHEMA, "tenant": str(tenant), "file": name,
+         "sha256": digest, "version": version},
+        sort_keys=True, separators=(",", ":")).encode())
+    try:
+        os.unlink(quarantine_marker_path(sdir, tenant))
+    except OSError:
+        pass
+    return {"path": path, "digest": digest, "version": version}
+
+
+def distill(store, out_dir: str, *, tenant: str = "default",
+            hidden=(32, 32), steps: int = 1500, lr: float = 5e-3,
+            seed: int = 0, holdout_frac: float = 0.25,
+            alpha: float = DEFAULT_ALPHA, min_rows: int = MIN_ROWS,
+            stale_y_scale: float = None) -> dict:
+    """The offline training pipeline behind ``raftserve distill``:
+    export the tenant's sidecar-verified corpus, train on a seeded
+    train split, calibrate conformal per-channel bounds on the held-out
+    split, and publish a digest-stamped versioned bundle.
+
+    ``stale_y_scale`` (testing/bench only) scales the std channels of
+    the training targets — a deliberately wrong bundle whose
+    self-consistent calibration passes but whose predictions violate
+    the true physics, exactly the drift shape the audit ladder must
+    catch."""
+    counts = {}
+    X, Y, rds = export_corpus(store, tenant=tenant, counts=counts)
+    n = X.shape[0]
+    if n < int(min_rows):
+        raise errors.ModelConfigError(
+            "surrogate corpus too small to distill",
+            tenant=str(tenant), rows=n, min_rows=int(min_rows))
+    cdigest = corpus_digest(X, Y)
+    if stale_y_scale is not None:
+        Y = Y.copy()
+        Y[:, :6] *= float(stale_y_scale)
+    rng = np.random.default_rng(int(seed))
+    perm = rng.permutation(n)
+    n_hold = max(1, int(round(n * float(holdout_frac))))
+    if n - n_hold < 2:
+        raise errors.ModelConfigError(
+            "surrogate holdout split leaves too few training rows",
+            rows=n, holdout=n_hold)
+    hold, train = perm[:n_hold], perm[n_hold:]
+    params, fit_info = surrogate_net.fit(
+        X[train], Y[train], hidden=hidden, steps=steps, lr=lr, seed=seed)
+    # calibrate against the exact forward that serves (forward_np, the
+    # pure-NumPy hot path) — not its jax twin
+    pred = surrogate_net.forward_np(params, X[hold])
+    abs_err = np.abs(pred - Y[hold])
+    bound_abs = _conformal_bound(abs_err, alpha)
+    # relative std bounds: per-channel |err| over the true magnitude,
+    # floored at 1% of the channel's corpus mean AND at 0.1% of the
+    # dominant channel's scale.  The cross-channel term is what keeps a
+    # dead DOF honest: beta=0 seas on an axisymmetric hull leave
+    # sway/roll/yaw at ~1e-18 m while the net's y_sd floor puts its
+    # reconstruction noise near 1e-8 — measured against the channel's
+    # own near-zero mean that is a relative error of ~1e4, vetoing
+    # serving over a response nobody can observe.  Against the
+    # platform's actual response scale it is ~1e-5 and irrelevant.
+    chan_mean = np.abs(Y[:, :6]).mean(axis=0)
+    scale = max(float(chan_mean.max()), 1e-12)
+    rel_floor = np.maximum(chan_mean * 1e-2,
+                           np.maximum(scale * 1e-3, 1e-12))
+    rel_err = abs_err[:, :6] / np.maximum(np.abs(Y[hold][:, :6]),
+                                          rel_floor)
+    bound_rel = _conformal_bound(rel_err, alpha)
+    hull_lo, hull_hi = X[train].min(axis=0), X[train].max(axis=0)
+    meta = {"corpus_digest": cdigest, "corpus_rows": int(n),
+            "train_rows": int(train.shape[0]),
+            "holdout_rows": int(n_hold), "alpha": float(alpha),
+            "seed": int(seed), "counts": dict(counts or {}),
+            "fit": fit_info, "stale_y_scale": stale_y_scale,
+            "created_unix": time.time()}
+    out = write_bundle(out_dir, tenant, params, bound_abs=bound_abs,
+                       bound_rel=bound_rel, rel_floor=rel_floor,
+                       hull_lo=hull_lo, hull_hi=hull_hi, meta=meta)
+    out.update({"tenant": str(tenant), "corpus_rows": int(n),
+                "holdout_rows": int(n_hold),
+                "bound_rel_max": float(bound_rel.max()),
+                "bound_abs": [float(v) for v in bound_abs],
+                "corpus_digest": cdigest, "counts": dict(counts or {}),
+                "fit": fit_info})
+    _LOG.info("surrogate distilled: tenant=%s rows=%d v%d "
+              "bound_rel_max=%.4g", tenant, n, out["version"],
+              out["bound_rel_max"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bundle load / inference
+# ---------------------------------------------------------------------------
+
+class SurrogateBundle:
+    """One loaded, digest-verified bundle: the compiled forward pass,
+    the training hull box, and the calibrated bounds."""
+
+    def __init__(self, params: dict, *, bound_abs, bound_rel, hull_lo,
+                 hull_hi, meta: dict, digest: str, path: str,
+                 rel_floor=None):
+        self.params = params
+        self.bound_abs = np.asarray(bound_abs, np.float64)
+        self.bound_rel = np.asarray(bound_rel, np.float64)
+        self.rel_floor = np.asarray(
+            np.zeros(6) if rel_floor is None else rel_floor, np.float64)
+        self.hull_lo = np.asarray(hull_lo, np.float64)
+        self.hull_hi = np.asarray(hull_hi, np.float64)
+        self.meta = dict(meta)
+        self.digest = str(digest)
+        self.path = str(path)
+        self.version = int(self.meta.get("version", 0))
+        self.tenant = str(self.meta.get("tenant", "default"))
+
+    @classmethod
+    def load(cls, sdir: str, tenant: str) -> "SurrogateBundle | None":
+        """The tenant's current bundle via its pointer, fully verified
+        (pointer parse -> file sha256 -> npz parse -> meta schema), or
+        None when no bundle is published.  Verification failure is a
+        typed :class:`~raft_tpu.errors.CacheCorruption` — the caller
+        (the tier) counts it and serves exact."""
+        pointer = bundle_pointer_path(sdir, tenant)
+        try:
+            with open(pointer, encoding="utf-8") as f:
+                ptr = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, json.JSONDecodeError) as e:
+            raise errors.CacheCorruption(
+                "surrogate bundle pointer unreadable",
+                tenant=str(tenant), pointer=pointer) from e
+        path = os.path.join(str(sdir), str(ptr.get("file", "")))
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise errors.CacheCorruption(
+                "surrogate bundle file unreadable",
+                tenant=str(tenant), path=path) from e
+        digest = "sha256:" + hashlib.sha256(data).hexdigest()
+        if digest != ptr.get("sha256"):
+            raise errors.CacheCorruption(
+                "surrogate bundle digest mismatch (torn or tampered)",
+                tenant=str(tenant), path=path, want=str(ptr.get("sha256")),
+                got=digest)
+        try:
+            with np.load(io.BytesIO(data)) as z:
+                arrays = {k: np.asarray(z[k]) for k in z.files}
+            meta = json.loads(bytes(arrays.pop("meta_json")).decode())
+        except (OSError, ValueError, KeyError,
+                json.JSONDecodeError) as e:
+            raise errors.CacheCorruption(
+                "surrogate bundle unparseable", tenant=str(tenant),
+                path=path) from e
+        if meta.get("schema") != SCHEMA:
+            raise errors.CacheCorruption(
+                "surrogate bundle schema mismatch", tenant=str(tenant),
+                schema=str(meta.get("schema")))
+        bound_abs = arrays.pop("bound_abs")
+        bound_rel = arrays.pop("bound_rel")
+        rel_floor = arrays.pop("rel_floor", None)
+        hull_lo = arrays.pop("hull_lo")
+        hull_hi = arrays.pop("hull_hi")
+        return cls(arrays, bound_abs=bound_abs, bound_rel=bound_rel,
+                   rel_floor=rel_floor, hull_lo=hull_lo,
+                   hull_hi=hull_hi, meta=meta, digest=digest, path=path)
+
+    # -- serving gates -------------------------------------------------
+
+    def serving_ok(self, tol: float) -> bool:
+        """Does the calibrated relative std bound clear the configured
+        tolerance?  A sloppy bundle simply never serves."""
+        return float(self.bound_rel.max()) <= float(tol)
+
+    def in_hull(self, Hs: float, Tp: float, beta: float) -> bool:
+        x = np.asarray([float(Hs), float(Tp), float(beta)])
+        return bool(np.all(x >= self.hull_lo)
+                    and np.all(x <= self.hull_hi))
+
+    # -- inference -----------------------------------------------------
+
+    def predict(self, Hs: float, Tp: float,
+                beta: float) -> tuple[list, int, bool]:
+        """One forward pass -> ``(std[6], iters, converged)`` in
+        served-payload shape.  Pure NumPy
+        (:func:`surrogate_net.forward_np`): at ``(1, 3)`` the jitted
+        XLA twin spends several times the whole net's FLOP cost in
+        per-call dispatch overhead, so the serve hot path stays off
+        jax entirely — and the conformal bounds were calibrated
+        against this exact function."""
+        row = surrogate_net.forward_np(
+            self.params, [[float(Hs), float(Tp), float(beta)]])[0]
+        std = [float(v) for v in row[:6]]
+        iters = max(0, int(round(float(row[6]))))
+        return std, iters, bool(row[7] > 0.0)
+
+    # -- the audit comparison -----------------------------------------
+
+    def within_bound(self, std, iters, converged, cold,
+                     tol: float = None) -> tuple[bool, dict]:
+        """Compare a surrogate-served answer against its cold solve AT
+        THE BOUND: every std channel within the larger of its absolute
+        conformal bound and the floored-relative allowance — the exact
+        contract serving advertises (relative error within
+        ``surrogate_tol``, denominators floored at ``rel_floor``).
+        Pass the serving ``tol`` so the relative allowance is the
+        ADVERTISED tolerance, not the (often far tighter) calibrated
+        per-channel bound: a near-zero channel's conformal abs bound is
+        the max of a tiny holdout error distribution and the ~1-alpha
+        coverage makes occasional physically-invisible misses there a
+        certainty, while a genuinely drifted bundle still lands orders
+        over ``tol`` on the live channels.  Also: the iters proxy
+        within its bound (floored at one iteration — it is an integer
+        proxy), and the converged flag equal.  Returns
+        ``(ok, detail)``."""
+        cstd = np.asarray([float(v) for v in cold.std], np.float64)
+        sstd = np.asarray([float(v) for v in std], np.float64)
+        err = np.abs(sstd - cstd)
+        rel = self.bound_rel if tol is None else np.maximum(
+            self.bound_rel, float(tol))
+        allowed = np.maximum(
+            self.bound_abs[:6],
+            rel * np.maximum(np.abs(cstd), self.rel_floor))
+        std_ok = bool(np.all(err <= allowed))
+        iters_ok = abs(int(iters) - int(cold.iters)) <= max(
+            1.0, float(self.bound_abs[6]))
+        conv_ok = bool(converged) == bool(cold.converged)
+        worst = float((err / np.maximum(allowed, 1e-300)).max())
+        return (std_ok and iters_ok and conv_ok), {
+            "worst_std_err_over_bound": worst,
+            "iters_ok": bool(iters_ok), "converged_ok": conv_ok}
+
+
+# ---------------------------------------------------------------------------
+# the serving tier (per-tenant bundles, audit cadence, quarantine)
+# ---------------------------------------------------------------------------
+
+class SurrogateTier:
+    """The service-side state of the learned read tier: per-tenant
+    bundle cache, audit cadence (every Nth serve, plus a forced
+    re-audit after ``refresh_writes`` store puts — stale-corpus drift),
+    and the durable quarantine ladder.  Thread-safe; never raises into
+    the admission path."""
+
+    def __init__(self, sdir: str, *, tol: float, audit_every: int,
+                 refresh_writes: int):
+        self.dir = str(sdir)
+        self.tol = float(tol)
+        self.audit_every = int(audit_every)
+        self.refresh_writes = int(refresh_writes)
+        self._lock = threading.Lock()
+        #: tenant -> SurrogateBundle | None (None = known-absent; the
+        #: sentinel avoids re-stat()ing the pointer per admission)
+        self._bundles: dict[str, "SurrogateBundle | None"] = {}
+        self._served: dict[str, int] = {}
+        #: tenant -> store put-count at the last audit (drift re-audit)
+        self._audit_marker: dict[str, int] = {}
+        self._quarantined: set[str] = set()
+        self._load_errors = 0
+
+    # -- bundle lookup -------------------------------------------------
+
+    def reload(self, tenant: str = None):
+        """Drop the cached bundle(s) so the next lookup re-reads the
+        pointer — how a freshly distilled bundle goes live on a
+        running service."""
+        with self._lock:
+            if tenant is None:
+                self._bundles.clear()
+                self._quarantined.clear()
+            else:
+                self._bundles.pop(tenant, None)
+                self._quarantined.discard(tenant)
+
+    def lookup(self, tenant: str) -> "SurrogateBundle | None":
+        with self._lock:
+            if tenant in self._quarantined:
+                return None
+            if tenant in self._bundles:
+                return self._bundles[tenant]
+        bundle = None
+        if not os.path.exists(quarantine_marker_path(self.dir, tenant)):
+            try:
+                bundle = SurrogateBundle.load(self.dir, tenant)
+            except errors.CacheCorruption:
+                # a corrupt bundle is a counted miss, never a dead
+                # admission path — the tenant serves exact
+                bundle = None
+                with self._lock:
+                    self._load_errors += 1
+                _LOG.warning("surrogate bundle for tenant %s failed "
+                             "verification — serving exact", tenant,
+                             exc_info=True)
+        else:
+            with self._lock:
+                self._quarantined.add(tenant)
+        with self._lock:
+            self._bundles[tenant] = bundle
+        return bundle
+
+    def has_bundle(self, tenant: str) -> bool:
+        with self._lock:
+            return self._bundles.get(tenant) is not None
+
+    # -- the admission decision ---------------------------------------
+
+    def decide(self, tenant: str, Hs: float, Tp: float, beta: float):
+        """The whole serving gate in one call: current bundle exists,
+        clears ``tol``, the query is inside the training hull, and the
+        prediction itself claims convergence.  Returns ``(bundle,
+        (std, iters, converged))`` or None (escalate to exact)."""
+        bundle = self.lookup(tenant)
+        if bundle is None or not bundle.serving_ok(self.tol) \
+                or not bundle.in_hull(Hs, Tp, beta):
+            return None
+        std, iters, converged = bundle.predict(Hs, Tp, beta)
+        if not converged or not all(np.isfinite(std)):
+            # the net predicts a non-converged (or non-finite) regime:
+            # exactly the queries the full machinery exists for
+            return None
+        return bundle, (std, iters, converged)
+
+    # -- audit cadence -------------------------------------------------
+
+    def note_served(self, tenant: str, store_puts: int) -> bool:
+        """Count one surrogate-served answer; True when THIS answer is
+        audit-due — the fixed cadence (every ``audit_every``-th) or the
+        drift trigger (``refresh_writes`` store puts since the tenant's
+        last audit)."""
+        with self._lock:
+            n = self._served.get(tenant, 0) + 1
+            self._served[tenant] = n
+            marker = self._audit_marker.setdefault(tenant,
+                                                   int(store_puts))
+            due = (n % self.audit_every == 0) or (
+                int(store_puts) - marker >= self.refresh_writes)
+            if due:
+                self._audit_marker[tenant] = int(store_puts)
+            return due
+
+    # -- quarantine ----------------------------------------------------
+
+    def quarantine(self, tenant: str, bundle: "SurrogateBundle",
+                   reason: str, detail: dict = None):
+        """Durably pull one tenant's bundle out of serving: marker file
+        written next to the bundle (survives restarts, seen by sibling
+        replicas sharing the directory), cached bundle dropped.  The
+        tenant serves exact until a fresh distill publishes a new
+        version (which clears the marker)."""
+        with self._lock:
+            if tenant in self._quarantined:
+                return
+            self._quarantined.add(tenant)
+            self._bundles[tenant] = None
+        try:
+            _fsync_write(quarantine_marker_path(self.dir, tenant),
+                         json.dumps({
+                             "schema": SCHEMA, "tenant": str(tenant),
+                             "bundle": bundle.digest if bundle else None,
+                             "version": bundle.version if bundle else None,
+                             "reason": str(reason),
+                             "detail": dict(detail or {}),
+                             "unix": time.time()},
+                             sort_keys=True).encode())
+        except OSError:
+            # in-memory quarantine still holds for this process; the
+            # durability gap is logged, never fatal to serving
+            _LOG.warning("surrogate quarantine marker write failed for "
+                         "tenant %s", tenant, exc_info=True)
+        _LOG.warning("surrogate bundle quarantined: tenant=%s reason=%s",
+                     tenant, reason)
+
+    def quarantined(self, tenant: str) -> bool:
+        with self._lock:
+            return tenant in self._quarantined
+
+    # -- facts ---------------------------------------------------------
+
+    def facts(self) -> dict:
+        with self._lock:
+            bundles = {t: {"digest": b.digest, "version": b.version,
+                           "bound_rel_max": float(b.bound_rel.max())}
+                       for t, b in self._bundles.items()
+                       if b is not None}
+            return {"dir": self.dir, "tol": self.tol,
+                    "audit_every": self.audit_every,
+                    "refresh_writes": self.refresh_writes,
+                    "bundles": bundles,
+                    "served": dict(self._served),
+                    "quarantined": sorted(self._quarantined),
+                    "load_errors": self._load_errors}

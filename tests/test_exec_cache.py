@@ -128,7 +128,19 @@ def test_cross_process_warm_start_survives_and_matches(tmp_path,
             "               jnp.arange(4.0).astype(complex))\n"
             "print(repr(np.asarray(out['x']).tolist()))\n")],
         capture_output=True, text=True, timeout=300,
-        env={**os.environ, "JAX_PLATFORMS": "cpu",
+        # explicit ALLOWLIST env, not {**os.environ}: inheriting the
+        # parent's environment imports whatever RAFT_TPU_* / JAX_* /
+        # PALLAS_* state earlier tests (bench.py import-time
+        # setdefaults, obs scratch dirs) left behind, and the child's
+        # behavior then depends on collection ORDER — the documented
+        # cross-test flake class this test sat in.  The child gets the
+        # interpreter plumbing it needs and NOTHING else.
+        env={**{k: os.environ[k]
+                for k in ("PATH", "HOME", "TMPDIR", "TEMP", "TMP",
+                          "LD_LIBRARY_PATH", "PYTHONHOME",
+                          "SYSTEMROOT")
+                if k in os.environ},
+             "JAX_PLATFORMS": "cpu",
              "PALLAS_AXON_POOL_IPS": "",
              # pin the child to THIS process's effective precision, not
              # whatever RAFT_TPU_X64 another test (bench.py import)

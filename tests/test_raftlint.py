@@ -708,6 +708,78 @@ def test_rtl007_shared_helper_reads_and_sanction_silent(tmp_path):
     assert rep.findings == []
 
 
+#: the repo's configured coverage of the learned read tier
+#: (pyproject.toml): models/surrogate_net.py is the ONE models/ file
+#: on the serving path (RTL004 typed-raise discipline), and
+#: serve/surrogate.py publishes durable bundles/pointers/markers
+#: (RTL007 fsync-helper discipline)
+_SURROGATE_OPTS = {"rtl004": {
+    "solve-modules": ["raft_tpu/model.py", "raft_tpu/ops",
+                      "raft_tpu/parallel", "raft_tpu/io",
+                      "raft_tpu/recovery.py", "raft_tpu/serve",
+                      "raft_tpu/models/surrogate_net.py"],
+},
+    "rtl007": {"persistence-modules": [
+        "raft_tpu/serve/checkpoint.py",
+        "raft_tpu/serve/resultstore.py",
+        "raft_tpu/serve/journal.py",
+        "raft_tpu/serve/surrogate.py"]}}
+
+_SURROGATE_NET_SRC = """
+    from raft_tpu import errors
+
+    def fit(X, Y):
+        if X.shape[0] < 2:
+            raise errors.ModelConfigError("corpus too small")  # typed
+        if X.shape[1] != 3:
+            raise ValueError("untyped feature-width failure")
+"""
+
+
+def test_rtl004_covers_surrogate_net_fixture_pair(tmp_path):
+    """models/ raises are normally out of RTL004 scope (config
+    validation lives there), but surrogate_net.py serves predictions
+    on the admission path — the typed taxonomy applies to it alone."""
+    rep = lint_src(tmp_path, _SURROGATE_NET_SRC, "RTL004",
+                   relname="raft_tpu/models/surrogate_net.py",
+                   options=_SURROGATE_OPTS)
+    assert len(rep.findings) == 1
+    assert "raise ValueError" in rep.findings[0].message
+    # the identical file anywhere else in models/ keeps the relaxed
+    # scope — listing ONE file must not drag the whole package in
+    rep2 = lint_src(tmp_path, _SURROGATE_NET_SRC, "RTL004",
+                    relname="raft_tpu/models/fixture.py",
+                    options=_SURROGATE_OPTS)
+    assert rep2.findings == []
+
+
+def test_rtl007_covers_surrogate_bundle_writes_fixture_pair(tmp_path):
+    """Bundle/pointer/quarantine-marker publishes in serve/surrogate.py
+    are durable serving state: a raw write fires; routing through the
+    shared fsync helper (the module's actual shape) is silent."""
+    rep = lint_src(tmp_path, RAW_PERSIST_WRITE, "RTL007",
+                   relname="raft_tpu/serve/surrogate.py",
+                   options=_SURROGATE_OPTS)
+    assert len(rep.findings) == 1
+    assert "fsync_write" in rep.findings[0].message
+    rep2 = lint_src(tmp_path, """
+        import json
+        from raft_tpu.obs.journalio import fsync_write
+
+        def _fsync_write(path, data):
+            fsync_write(path, data)
+
+        def publish(pointer, doc):
+            _fsync_write(pointer, json.dumps(doc).encode())
+
+        def load(path):
+            with open(path, "rb") as f:        # read-mode: fine
+                return f.read()
+    """, "RTL007", relname="raft_tpu/serve/surrogate.py",
+                    options=_SURROGATE_OPTS)
+    assert rep2.findings == []
+
+
 # ---------------------------------------------------------------------------
 # suppressions / baseline / config / CLI
 # ---------------------------------------------------------------------------

@@ -212,6 +212,139 @@ def test_nearest_respects_radius_tenant_and_quarantine(tmp_path):
     assert s2.nearest(2.0, 8.0, 0.0, "default", radius=1.0) is None
 
 
+def _nearest_loop_reference(index, quarantined, Hs, Tp, beta, tenant,
+                            radius, exclude=()):
+    """The pre-vectorization semantics of ``nearest()``: one Python
+    loop over every index entry.  Kept as the parity oracle and the
+    baseline the micro-benchmark below pins the NumPy scan against."""
+    best = None
+    for rd, m in index.items():
+        if (not m.get("xi") or rd in quarantined
+                or str(m.get("tenant")) != tenant or rd in exclude):
+            continue
+        d = ((float(m["Hs"]) - Hs) ** 2 + (float(m["Tp"]) - Tp) ** 2
+             + (float(m["beta"]) - beta) ** 2) ** 0.5
+        if d <= radius and (best is None or d < best[1]):
+            best = (rd, d)
+    return best
+
+
+def test_nearest_vectorized_parity_and_speed(tmp_path):
+    """The vectorized ``nearest()`` must (a) agree with the Python-loop
+    reference on every query over a large synthetic index, and (b) be
+    pinned meaningfully faster — the whole point of caching parallel
+    NumPy views is that a neighbor query over thousands of entries
+    stops costing a per-entry interpreter loop at admission time."""
+    n = 8000
+    rng = np.random.default_rng(7)
+    s = ResultStore(str(tmp_path), keep_xi=True)
+    index = {}
+    for i in range(n):
+        tenant = ("default", "acme", "zeta")[i % 3]
+        index[f"sha256:{i:08x}"] = {
+            "Hs": float(rng.uniform(1.0, 12.0)),
+            "Tp": float(rng.uniform(5.0, 18.0)),
+            "beta": float(rng.uniform(-0.5, 0.5)),
+            "tenant": tenant, "digest": f"d{i}",
+            "xi": bool(i % 7),            # ~14% seed-less
+        }
+    quarantined = {f"sha256:{i:08x}" for i in range(0, n, 11)}
+    s._index = dict(index)
+    s._quarantined = set(quarantined)
+    # the synthetic index has no on-disk sidecars backing it; pin the
+    # refresh out so the scan itself (what this test times) is isolated
+    # from the directory walk
+    s._refresh_index_locked = lambda force=False: None
+
+    queries = [(float(rng.uniform(1.0, 12.0)),
+                float(rng.uniform(5.0, 18.0)),
+                float(rng.uniform(-0.5, 0.5)),
+                ("default", "acme", "zeta")[k % 3])
+               for k in range(40)]
+    exclude = (f"sha256:{5:08x}", "sha256:not-present")
+
+    # -- parity: every query, including misses and exclusions ---------
+    for Hs, Tp, beta, tenant in queries:
+        for radius in (0.05, 2.0, 50.0):
+            want = _nearest_loop_reference(
+                index, quarantined, Hs, Tp, beta, tenant, radius,
+                exclude)
+            got = s.nearest(Hs, Tp, beta, tenant, radius,
+                            exclude=exclude)
+            if want is None:
+                assert got is None
+            else:
+                assert got is not None and got[0] == want[0]
+                assert got[1] == pytest.approx(want[1], rel=1e-12)
+
+    # -- micro-benchmark: pinned speedup over the loop reference ------
+    s.nearest(6.0, 10.0, 0.0, "default", 50.0)   # build the cache once
+    t0 = time.perf_counter()
+    for Hs, Tp, beta, tenant in queries:
+        s.nearest(Hs, Tp, beta, tenant, 50.0)
+    t_vec = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for Hs, Tp, beta, tenant in queries:
+        _nearest_loop_reference(index, quarantined, Hs, Tp, beta,
+                                tenant, 50.0)
+    t_loop = time.perf_counter() - t0
+    # the observed gap is ~20-40x; 2x keeps the pin loose enough for a
+    # loaded CI box while still catching a regression to a Python loop
+    assert t_vec < t_loop / 2.0, (t_vec, t_loop)
+
+    # a mutation invalidates the cached arrays: quarantining the
+    # current best must be visible to the very next query
+    best = s.nearest(6.0, 10.0, 0.0, "acme", 50.0)
+    s.quarantine(best[0])
+    after = s.nearest(6.0, 10.0, 0.0, "acme", 50.0)
+    assert after is None or after[0] != best[0]
+
+
+def test_corpus_export_deterministic_and_skips_invalid(tmp_path):
+    """The surrogate's training feed: exporting the same store twice —
+    with a torn-put orphan and a quarantined seed both present — must
+    yield byte-identical arrays, identical skip accounting, and leave
+    the store untouched (the exporter is an offline reader, not the
+    serving ladder's delete-and-miss discipline)."""
+    from raft_tpu.serve import surrogate
+
+    s = ResultStore(str(tmp_path), keep_xi=True)
+    rows = [_payload(Hs=2.0 + 0.5 * i, Tp=7.0 + 0.3 * i, beta=0.01 * i,
+                     seed=1.0 + i) for i in range(12)]
+    for p in rows:
+        s.put(p, xi=np.ones((6, 2), complex))
+    s.put(_payload(Hs=3.3, Tp=9.9, tenant="acme"))   # other tenant
+    # a torn put: payload with no certifying .sum sidecar (a crashed
+    # writer) — counted, never touched
+    with open(os.path.join(str(tmp_path), "deadbeef.json"), "w") as f:
+        json.dump({"torn": True}, f)
+    # a quarantined seed: the divergence guard rejected its physics,
+    # so it must never become training data
+    s.quarantine(rows[3]["rdigest"])
+
+    c1, c2 = {}, {}
+    X1, Y1, rds1 = surrogate.export_corpus(s, counts=c1)
+    X2, Y2, rds2 = surrogate.export_corpus(s, counts=c2)
+    assert rds1 == rds2 == sorted(rds1)
+    assert X1.dtype == np.float64 and X1.shape == (11, 3)
+    assert X1.tobytes() == X2.tobytes()        # byte identity, not approx
+    assert Y1.tobytes() == Y2.tobytes()
+    assert surrogate.corpus_digest(X1, Y1) \
+        == surrogate.corpus_digest(X2, Y2)
+    assert c1 == c2
+    assert c1["exported"] == 11 == len(rds1)
+    assert c1["skipped_orphan"] == 1
+    assert c1["skipped_quarantined"] == 1
+    assert c1["skipped_corrupt"] == 0 and c1["skipped_degraded"] == 0
+    assert rows[3]["rdigest"] not in rds1
+    # the tenant filter keeps corpora per-tenant
+    assert all(s._index[rd].get("tenant") == "default" for rd in rds1)
+    # nothing mutated: the orphan survives and the quarantined
+    # payload is still readable (only its SEED was revoked)
+    assert os.path.exists(os.path.join(str(tmp_path), "deadbeef.json"))
+    assert s.get(rows[3]["rdigest"]) is not None
+
+
 def test_warm_watchdog_window_covers_audit_double_solve(tmp_path,
                                                         monkeypatch):
     """An audited (or guard-fallback) warm batch legitimately runs TWO

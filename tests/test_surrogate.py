@@ -1,0 +1,577 @@
+"""The learned read tier (raft_tpu/serve/surrogate.py + models/
+surrogate_net.py): offline distillation from the result store,
+calibrated serving gates, the in-service surrogate slot, the audited
+escalation ladder, and the trend-store facts that gate it in CI.
+
+Everything here runs on stub physics — a smooth closed-form std map
+shared by the corpus builder and the batch-engine stub, so audits
+compare the surrogate against the same ground truth it was distilled
+from.  No real solves, no TPU.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import errors
+from raft_tpu.models import surrogate_net
+from raft_tpu.obs.ledger import digest_metrics
+from raft_tpu.serve import ServeConfig, SweepService, surrogate
+from raft_tpu.serve import journal as wal
+from raft_tpu.serve.resultstore import ResultStore
+from raft_tpu.serve.surrogate import SurrogateBundle, SurrogateTier
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::DeprecationWarning")
+
+# the shared ground truth: smooth on the (Hs, Tp, beta) scales the
+# case tables use, every channel's magnitude comfortably off zero so
+# the 1%-of-mean relative floor never dominates the calibration
+ITERS = 4
+
+
+def _smooth_std(h, t, b):
+    return [0.12 * h, 0.05 * h + 0.02 * t, 0.01 * t + 0.2,
+            0.3 + 0.002 * h * t, 0.08 * h + 0.1, 0.25 + 0.02 * t
+            + 0.05 * b]
+
+
+def _grid():
+    """The training corpus grid: 6 x 6 over (Hs, Tp), beta fixed —
+    36 rows, comfortably above the distill floor."""
+    rows = []
+    for h in np.linspace(1.5, 5.0, 6):
+        for t in np.linspace(6.0, 12.0, 6):
+            rows.append((float(h), float(t), 0.0))
+    return rows
+
+
+def _put_row(store, h, t, b, tenant="default"):
+    std = _smooth_std(h, t, b)
+    doc = {"rdigest": wal.request_digest(h, t, b, tenant),
+           "digest": digest_metrics({"std": std, "iters": ITERS,
+                                     "converged": True}),
+           "std": std, "iters": ITERS, "converged": True,
+           "tenant": tenant, "Hs": h, "Tp": t, "beta": b}
+    assert store.put(doc)
+    return doc
+
+
+def _seed_store(store_dir):
+    store = ResultStore(store_dir)
+    for h, t, b in _grid():
+        _put_row(store, h, t, b)
+    return store
+
+
+def stub_factory(mode, fowt, ncases, **kw):
+    """Batch engine speaking the shared ground truth."""
+    def run(Hs, Tp, beta):
+        Hs, Tp, beta = (np.asarray(a) for a in (Hs, Tp, beta))
+        return {"std": np.stack([_smooth_std(h, t, b) for h, t, b
+                                 in zip(Hs, Tp, beta)]),
+                "iters": np.full(len(Hs), ITERS),
+                "converged": np.ones(len(Hs), bool)}
+    run.ncases = ncases
+    run.cache_state = "stub"
+    return run
+
+
+def _cfg(tmp_path, sur_dir, **kw):
+    base = dict(queue_max=16, batch_cases=4, window_s=0.02,
+                batch_deadline_s=10.0, retry_base_s=0.01,
+                degrade_after=99, store_dir=str(tmp_path / "store"),
+                surrogate_dir=str(sur_dir), surrogate_tol=0.05)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def distilled(tmp_path_factory):
+    """One seeded store + one distilled bundle, shared by the
+    read-only tests (training dominates this module's runtime)."""
+    root = tmp_path_factory.mktemp("surrogate")
+    store_dir = str(root / "store")
+    sur_dir = str(root / "sur")
+    store = _seed_store(store_dir)
+    info = surrogate.distill(store, sur_dir, steps=900, seed=3)
+    return {"root": root, "store_dir": store_dir, "sur_dir": sur_dir,
+            "info": info}
+
+
+# ---------------------------------------------------------------------------
+# the net and the calibration primitive
+# ---------------------------------------------------------------------------
+
+def test_surrogate_net_fit_and_predict_shapes():
+    rng = np.random.default_rng(0)
+    X = rng.uniform([1, 6, -0.3], [5, 12, 0.3], size=(64, 3))
+    Y = np.stack([[*_smooth_std(*x), ITERS, 4.0] for x in X])
+    params, info = surrogate_net.fit(X, Y, hidden=(16, 16), steps=400,
+                                     lr=5e-3, seed=0)
+    assert info["loss_last"] < info["loss_first"]
+    pred = np.asarray(surrogate_net.forward(params, X))
+    assert pred.shape == (64, surrogate_net.OUT_CHANNELS)
+    # the fit is close on its own training support
+    assert float(np.abs(pred[:, :6] - Y[:, :6]).mean()) < 0.1
+    # params serialize as plain float64 numpy (the bundle contract);
+    # "layers" is the integer topology record
+    assert all(np.asarray(v).dtype == np.float64
+               for k, v in params.items() if k != "layers")
+    assert np.issubdtype(np.asarray(params["layers"]).dtype,
+                         np.integer)
+
+
+def test_conformal_bound_is_the_order_statistic():
+    # alpha=0.1, n=9 -> k = ceil(10 * 0.9) = 9 -> the 9th smallest
+    # (here: the max); alpha=0.5 -> k=5 -> the median
+    err = np.arange(1.0, 10.0).reshape(9, 1)
+    assert surrogate._conformal_bound(err, 0.1)[0] == 9.0
+    assert surrogate._conformal_bound(err, 0.5)[0] == 5.0
+    # per-channel, not pooled
+    err2 = np.stack([np.arange(1.0, 10.0),
+                     np.arange(10.0, 100.0, 10.0)], axis=1)
+    assert list(surrogate._conformal_bound(err2, 0.1)) == [9.0, 90.0]
+
+
+# ---------------------------------------------------------------------------
+# distill -> publish -> load
+# ---------------------------------------------------------------------------
+
+def test_distill_publishes_versioned_verified_bundle(distilled):
+    info = distilled["info"]
+    assert info["version"] == 1
+    assert info["corpus_rows"] == 36
+    assert info["counts"]["exported"] == 36
+    assert info["corpus_digest"].startswith("sha256:")
+    # the calibrated bound clears the default serving tolerance —
+    # smooth physics, well-conditioned channels
+    assert info["bound_rel_max"] <= 0.05, info
+    bundle = SurrogateBundle.load(distilled["sur_dir"], "default")
+    assert bundle is not None
+    assert bundle.digest == info["digest"]
+    assert bundle.version == 1
+    assert bundle.serving_ok(0.05)
+    assert bundle.meta["corpus_digest"] == info["corpus_digest"]
+    # prediction parity with the training physics, inside the hull
+    std, iters, converged = bundle.predict(3.1, 9.2, 0.0)
+    want = _smooth_std(3.1, 9.2, 0.0)
+    assert converged and iters >= 0
+    np.testing.assert_allclose(std, want, rtol=0.08, atol=0.05)
+    assert bundle.in_hull(3.1, 9.2, 0.0)
+    assert not bundle.in_hull(9.0, 9.2, 0.0)      # off the Hs support
+    # the audit comparator passes the true physics at the bound
+    cold = type("C", (), {"std": want, "iters": ITERS,
+                          "converged": True})
+    ok, detail = bundle.within_bound(std, iters, converged, cold)
+    assert ok, detail
+
+
+def test_distill_dead_channels_do_not_veto_serving(tmp_path):
+    """Real axisymmetric physics under beta=0 seas: sway/roll/yaw std
+    sit at ~1e-18 while surge is O(0.5 m).  The net's y_sd floor puts
+    its reconstruction noise on a dead channel near 1e-8 — against the
+    channel's own near-zero mean that is a relative error of ~1e4, and
+    the old per-channel-only floor let it veto serving for the whole
+    tenant (bound_rel_max ~300 on the Vertical_cylinder bench).  The
+    scale-aware rel_floor measures a dead DOF against the platform's
+    dominant response instead, and the audit comparator honours the
+    same floored-relative contract."""
+    store = ResultStore(str(tmp_path / "store"))
+    for h, t, b in _grid():
+        live = _smooth_std(h, t, b)
+        std = [live[0], 1e-18, live[2], 1e-18, live[4], 1e-18]
+        doc = {"rdigest": wal.request_digest(h, t, b, "default"),
+               "digest": digest_metrics({"std": std, "iters": ITERS,
+                                         "converged": True}),
+               "std": std, "iters": ITERS, "converged": True,
+               "tenant": "default", "Hs": h, "Tp": t, "beta": b}
+        assert store.put(doc)
+    sur = str(tmp_path / "sur")
+    info = surrogate.distill(store, sur, steps=900, seed=3)
+    # the dead channels no longer blow the serving gate
+    assert info["bound_rel_max"] <= 0.05, info
+    bundle = SurrogateBundle.load(sur, "default")
+    assert bundle.serving_ok(0.05)
+    # the floor rides in the bundle: dead channels floored by the
+    # dominant channel's scale, live channels by their own mean
+    assert bundle.rel_floor.shape == (6,)
+    assert float(bundle.rel_floor[1]) >= 1e-4   # scale-aware, not 1e-12
+    # the audit passes true physics whose dead channels are exact zero
+    # even though the net predicts O(1e-8) noise there...
+    std, iters, converged = bundle.predict(3.1, 9.2, 0.0)
+    want = _smooth_std(3.1, 9.2, 0.0)
+    cold = type("C", (), {"std": [want[0], 0.0, want[2], 0.0,
+                                  want[4], 0.0],
+                          "iters": ITERS, "converged": True})
+    ok, detail = bundle.within_bound(std, iters, converged, cold)
+    assert ok, detail
+    # ...while a genuinely wrong live channel still trips it
+    bad = list(std)
+    bad[0] = float(cold.std[0]) * 1.5
+    ok, detail = bundle.within_bound(bad, iters, converged, cold)
+    assert not ok
+    assert detail["worst_std_err_over_bound"] > 1.0
+
+
+def test_distill_too_small_corpus_is_typed(tmp_path):
+    store = ResultStore(str(tmp_path / "store"))
+    for h, t, b in _grid()[:6]:
+        _put_row(store, h, t, b)
+    with pytest.raises(errors.ModelConfigError):
+        surrogate.distill(store, str(tmp_path / "sur"), steps=10)
+
+
+def test_redistill_bumps_version_and_clears_quarantine(tmp_path):
+    store = _seed_store(str(tmp_path / "store"))
+    sur = str(tmp_path / "sur")
+    v1 = surrogate.distill(store, sur, steps=60, seed=1)
+    assert v1["version"] == 1
+    marker = surrogate.quarantine_marker_path(sur, "default")
+    with open(marker, "w") as f:
+        json.dump({"reason": "test"}, f)
+    v2 = surrogate.distill(store, sur, steps=60, seed=1)
+    assert v2["version"] == 2
+    assert not os.path.exists(marker)      # fresh publish supersedes
+    assert SurrogateBundle.load(sur, "default").version == 2
+
+
+def test_bundle_corruption_ladder_is_typed(tmp_path, distilled):
+    import shutil
+
+    sur = str(tmp_path / "sur")
+    shutil.copytree(distilled["sur_dir"], sur)
+    pointer = surrogate.bundle_pointer_path(sur, "default")
+    # flipped bytes in the bundle file -> digest mismatch
+    with open(pointer, encoding="utf-8") as f:
+        name = json.load(f)["file"]
+    path = os.path.join(sur, name)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(blob)
+    with pytest.raises(errors.CacheCorruption):
+        SurrogateBundle.load(sur, "default")
+    # unparseable pointer
+    with open(pointer, "w") as f:
+        f.write("{not json")
+    with pytest.raises(errors.CacheCorruption):
+        SurrogateBundle.load(sur, "default")
+    # pointer at a missing file
+    with open(pointer, "w") as f:
+        json.dump({"file": "gone.npz", "sha256": "sha256:0",
+                   "version": 9}, f)
+    with pytest.raises(errors.CacheCorruption):
+        SurrogateBundle.load(sur, "default")
+    # no pointer at all is a plain miss, not an error
+    os.unlink(pointer)
+    assert SurrogateBundle.load(sur, "default") is None
+    # the tier converts the typed failure into a counted exact-serving
+    # miss — corruption must never take down admission
+    with open(pointer, "w") as f:
+        f.write("{not json")
+    tier = SurrogateTier(sur, tol=0.05, audit_every=8,
+                         refresh_writes=64)
+    assert tier.lookup("default") is None
+    assert tier.facts()["load_errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the tier: serving gates, audit cadence, quarantine
+# ---------------------------------------------------------------------------
+
+def test_tier_decide_gates_and_audit_cadence(distilled):
+    tier = SurrogateTier(distilled["sur_dir"], tol=0.05, audit_every=3,
+                         refresh_writes=10)
+    hit = tier.decide("default", 3.0, 9.0, 0.0)
+    assert hit is not None
+    bundle, (std, iters, converged) = hit
+    assert converged and len(std) == 6
+    # out-of-hull escalates
+    assert tier.decide("default", 9.0, 9.0, 0.0) is None
+    # a tolerance tighter than the calibrated bound never serves
+    strict = SurrogateTier(distilled["sur_dir"], tol=1e-6,
+                           audit_every=3, refresh_writes=10)
+    assert strict.decide("default", 3.0, 9.0, 0.0) is None
+    # an unknown tenant has no bundle
+    assert tier.decide("acme", 3.0, 9.0, 0.0) is None
+    assert not tier.has_bundle("acme")
+    # cadence: every 3rd serve is audit-due...
+    assert [tier.note_served("default", 0) for _ in range(6)] \
+        == [False, False, True, False, False, True]
+    # ...and the drift trigger fires when the store has grown by
+    # refresh_writes puts since the last audit, off-cadence
+    assert tier.note_served("default", 10)    # 7th serve, 10 puts
+    assert not tier.note_served("default", 12)
+
+
+def test_tier_quarantine_is_durable_until_redistill(tmp_path):
+    store = _seed_store(str(tmp_path / "store"))
+    sur = str(tmp_path / "sur")
+    surrogate.distill(store, sur, steps=900, seed=3)
+    tier = SurrogateTier(sur, tol=0.05, audit_every=8,
+                         refresh_writes=64)
+    bundle = tier.lookup("default")
+    assert tier.decide("default", 3.0, 9.0, 0.0) is not None
+    tier.quarantine("default", bundle, "bound_violation",
+                    {"worst_std_err_over_bound": 9.9})
+    tier.quarantine("default", bundle, "bound_violation")  # idempotent
+    assert tier.quarantined("default")
+    assert tier.decide("default", 3.0, 9.0, 0.0) is None
+    assert "default" in tier.facts()["quarantined"]
+    # durable: a fresh tier (a restarted service, a sibling replica)
+    # sees the marker and keeps serving exact
+    tier2 = SurrogateTier(sur, tol=0.05, audit_every=8,
+                          refresh_writes=64)
+    assert tier2.lookup("default") is None
+    assert tier2.decide("default", 3.0, 9.0, 0.0) is None
+    # a fresh distill clears the marker; reload() brings it live
+    surrogate.distill(store, sur, steps=900, seed=3)
+    tier2.reload("default")
+    assert tier2.decide("default", 3.0, 9.0, 0.0) is not None
+    assert tier2.lookup("default").version == 2
+
+
+# ---------------------------------------------------------------------------
+# the service: the surrogate slot, provenance, WAL, audit, quarantine
+# ---------------------------------------------------------------------------
+
+def test_service_serves_in_hull_and_escalates(tmp_path, distilled):
+    import shutil
+
+    shutil.copytree(distilled["store_dir"], str(tmp_path / "store"))
+    cfg = _cfg(tmp_path, distilled["sur_dir"],
+               journal_dir=str(tmp_path / "wal"),
+               surrogate_audit_every=10 ** 6)
+    svc = SweepService(runner_factory=stub_factory, config=cfg)
+    svc.start()
+    try:
+        # an in-hull exact-digest MISS answers from the bundle:
+        # immediately, no queue slot, full provenance
+        t = svc.submit(2.2, 8.3, 0.0)
+        assert t.done()                      # no batch window wait
+        r = t.result(10.0)
+        assert r.ok and r.source == "surrogate"
+        assert r.seq == -1 and r.attempts == 0
+        np.testing.assert_allclose(r.std, _smooth_std(2.2, 8.3, 0.0),
+                                   rtol=0.08, atol=0.05)
+        prov = r.extra["provenance"]["surrogate"]
+        assert prov["bundle"] == distilled["info"]["digest"]
+        assert prov["tol"] == 0.05
+        assert r.digest == digest_metrics(
+            {"std": [float(v) for v in r.std], "iters": int(r.iters),
+             "converged": bool(r.converged)})
+        # out-of-hull escalates to a real solve
+        r2 = svc.submit(8.5, 9.0, 0.0).result(30.0)
+        assert r2.ok and r2.source != "surrogate"
+        # exact=True bypasses the tier even in-hull
+        r3 = svc.submit(2.4, 8.1, 0.0, exact=True).result(30.0)
+        assert r3.ok and r3.source != "surrogate"
+        # an exact-digest store hit STILL wins over the surrogate
+        row = _grid()[0]
+        r4 = svc.submit(*row).result(10.0)
+        assert r4.ok and r4.source == "cached"
+    finally:
+        summary = svc.stop()
+    assert summary["surrogate_served"] == 1
+    assert summary["surrogate_escalated"] == 1
+    assert summary["surrogate_bound_violation_served_count"] == 0
+    assert summary["surrogate_quarantine_miss"] == 0
+    assert summary["surrogate_read_p50_ms"] is not None
+    assert 0.0 < summary["surrogate_hit_ratio"] < 1.0
+    assert summary["surrogate"]["bundles"]["default"]["version"] == 1
+    # the WAL carries the provenance record — non-terminal, seq-less,
+    # and deliberately NOT a complete: replay must never mistake
+    # predicted physics for a solver result
+    rep = wal.replay(cfg.journal_dir)
+    assert len(rep["surrogates"]) == 1
+    rec = rep["surrogates"][0]
+    assert rec["bundle"] == distilled["info"]["digest"]
+    assert rec["digest"] == r.digest and rec["audited"] is False
+    assert rep["pending"] == []              # nothing re-admits
+
+
+def test_service_audit_violation_quarantines_then_exact(tmp_path):
+    store_dir = str(tmp_path / "store")
+    sur = str(tmp_path / "sur")
+    store = _seed_store(store_dir)
+    # a deliberately stale bundle: self-consistently calibrated on
+    # 1.3x-scaled targets, so it SERVES — and every answer violates
+    # the true physics at the bound
+    surrogate.distill(store, sur, steps=900, seed=3, stale_y_scale=1.3)
+    cfg = _cfg(tmp_path, sur, surrogate_audit_every=1)
+    svc = SweepService(runner_factory=stub_factory, config=cfg)
+    svc.start()
+    try:
+        q = (2.7, 8.9, 0.0)
+        r = svc.submit(*q).result(10.0)
+        assert r.ok and r.source == "surrogate"
+        assert r.extra["provenance"]["surrogate"]["audited"] is True
+        deadline = time.monotonic() + 60.0
+        while (svc.stats()["surrogate_quarantines"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        st = svc.stats()
+        assert st["surrogate_audits"] == 1
+        assert st["surrogate_violations"] == 1
+        assert st["surrogate_quarantines"] == 1
+        # the tenant is back on exact serving: same request, solver
+        # path, digest bit-for-bit with the audit's cold solve
+        r_after = svc.submit(*q).result(30.0)
+        assert r_after.ok and r_after.source != "surrogate"
+        np.testing.assert_allclose(r_after.std, _smooth_std(*q),
+                                   rtol=1e-6)
+        summary = svc.stop()
+    finally:
+        svc.stop()
+    assert summary["surrogate_bound_violation_served_count"] == 1
+    assert summary["surrogate_quarantines"] == 1
+    assert summary["surrogate_quarantine_miss"] == 0   # caught, never missed
+    # the quarantine is durable: a successor service serves exact
+    svc2 = SweepService(runner_factory=stub_factory,
+                        config=_cfg(tmp_path, sur))
+    svc2.start()
+    try:
+        r2 = svc2.submit(3.3, 10.1, 0.0).result(30.0)
+        assert r2.ok and r2.source != "surrogate"
+    finally:
+        svc2.stop()
+
+
+def test_drill_service_scopes_served_violation_fact(tmp_path):
+    """cfg.surrogate_drill: the quarantine drill's INTENTIONAL served
+    violation reports as ``surrogate_drill_violations`` — the
+    zero-tolerance ``surrogate_bound_violation_served_count`` fact
+    never appears on a drill row, so the drill can't trip the
+    production SLO rule — while ``surrogate_quarantine_miss`` stays
+    zero-tolerance (a drill violation the audit fails to quarantine
+    is still a silent-audit failure)."""
+    from raft_tpu.obs import trendstore
+
+    store_dir = str(tmp_path / "store")
+    sur = str(tmp_path / "sur")
+    store = _seed_store(store_dir)
+    surrogate.distill(store, sur, steps=900, seed=3, stale_y_scale=1.3)
+    cfg = _cfg(tmp_path, sur, surrogate_audit_every=1,
+               surrogate_drill=True)
+    svc = SweepService(runner_factory=stub_factory, config=cfg)
+    svc.start()
+    try:
+        r = svc.submit(2.7, 8.9, 0.0).result(10.0)
+        assert r.ok and r.source == "surrogate"
+        deadline = time.monotonic() + 60.0
+        while (svc.stats()["surrogate_quarantines"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        summary = svc.stop()
+    finally:
+        svc.stop()
+    assert summary["surrogate_drill"] == 1
+    assert summary["surrogate_drill_violations"] == 1
+    assert "surrogate_bound_violation_served_count" not in summary
+    assert summary["surrogate_quarantines"] == 1
+    assert summary["surrogate_quarantine_miss"] == 0
+    # through fact extraction + the SLO gate: the drill row trends
+    # under its own names and passes the zero-tolerance rules
+    doc = {"schema": "raft_tpu.run_manifest/v1", "run_id": "drill",
+           "kind": "serve", "status": "ok",
+           "extra": {"serve": summary}}
+    facts = trendstore.facts_from_manifest(doc)
+    assert facts["surrogate_drill_violations"] == 1
+    assert "surrogate_bound_violation_served_count" not in facts
+    rows = [{"kind": "serve", "status": "ok", "facts": facts}]
+    assert trendstore.evaluate_slo(rows)["ok"]
+
+
+# ---------------------------------------------------------------------------
+# trend-store facts and the CI gate
+# ---------------------------------------------------------------------------
+
+def test_surrogate_facts_reach_trend_row_and_slo_rules():
+    from raft_tpu.obs import trendstore
+
+    summary = {"requests": 10, "surrogate_served": 6,
+               "surrogate_escalated": 1, "surrogate_audits": 2,
+               "surrogate_audit_errors": 0,
+               "surrogate_bound_violation_served_count": 0,
+               "surrogate_quarantines": 0,
+               "surrogate_quarantine_miss": 0,
+               "surrogate_hit_ratio": 0.6,
+               "surrogate_read_p50_ms": 0.7,
+               "surrogate_read_p99_ms": 2.0}
+    doc = {"schema": "raft_tpu.run_manifest/v1", "run_id": "t1",
+           "kind": "serve", "status": "ok",
+           "extra": {"serve": summary}}
+    facts = trendstore.facts_from_manifest(doc)
+    assert facts["surrogate_served"] == 6
+    assert facts["surrogate_bound_violation_served_count"] == 0
+    assert facts["surrogate_quarantine_miss"] == 0
+    # the bench fact block lands under surrogate_-prefixed names plus
+    # the two unprefixed rule-named facts
+    bench_doc = {"schema": "raft_tpu.run_manifest/v1", "run_id": "t2",
+                 "kind": "bench_surrogate", "status": "ok",
+                 "extra": {"surrogate_bench": {
+                     "served": 12, "hit_ratio": 0.8,
+                     "speedup_vs_cold": 90.0, "read_p50_ms": 0.7,
+                     "surrogate_bound_violation_served_count": 0,
+                     "surrogate_quarantine_miss": 0}}}
+    bfacts = trendstore.facts_from_manifest(bench_doc)
+    assert bfacts["surrogate_speedup_vs_cold"] == 90.0
+    assert bfacts["surrogate_bound_violation_served_count"] == 0
+    names = [r["name"] for r in trendstore.DEFAULT_SLO_RULES]
+    assert "surrogate_bound_violation_served_count" in names
+    assert "surrogate_quarantine_miss" in names
+    rows = [{"kind": "serve", "status": "ok", "facts": facts},
+            {"kind": "bench_surrogate", "status": "ok",
+             "facts": bfacts}]
+    assert trendstore.evaluate_slo(rows)["ok"]
+    # zero tolerance: ONE served violation anywhere in the window
+    # fails the gate; a missed quarantine fails the second rule
+    bad = [{"kind": "bench_surrogate", "status": "ok",
+            "facts": {"surrogate_bound_violation_served_count": 1,
+                      "surrogate_quarantine_miss": 1}}]
+    rep = trendstore.evaluate_slo(bad)
+    assert not rep["ok"]
+    failing = {r["name"] for r in rep["results"] if not r["ok"]}
+    assert {"surrogate_bound_violation_served_count",
+            "surrogate_quarantine_miss"} <= failing
+    # rows with no surrogate facts (an ordinary serve run) never trip
+    # the rule — facts are only emitted on surrogate rows
+    plain = [{"kind": "serve", "status": "ok",
+              "facts": {"serve_store_hits": 3}}]
+    assert trendstore.evaluate_slo(plain)["ok"]
+
+
+def test_obsctl_tail_renders_surrogate_events(tmp_path):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    events = tmp_path / "serve_sur.events.jsonl"
+    with open(events, "w") as f:
+        for e in ({"type": "surrogate_served", "t": 1.0,
+                   "rdigest": "sha256:aaaa", "tenant": "default",
+                   "bundle": "sha256:bbbb", "version": 2,
+                   "audit": True},
+                  {"type": "surrogate_audit", "t": 2.0,
+                   "rdigest": "sha256:aaaa", "tenant": "default",
+                   "ok": False, "worst_std_err_over_bound": 3.25},
+                  {"type": "surrogate_quarantine", "t": 3.0,
+                   "tenant": "default", "bundle": "sha256:bbbb",
+                   "version": 2}):
+            f.write(json.dumps(e) + "\n")
+    p = subprocess.run(
+        [sys.executable, "tools/obsctl.py", "tail", str(events)],
+        cwd=repo, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert p.returncode == 0, p.stderr
+    lines = p.stdout.splitlines()
+    assert any("surrogate served" in ln and "AUDIT-DUE" in ln
+               and "bundle v2" in ln for ln in lines)
+    assert any("surrogate audit VIOLATION" in ln
+               and "worst err/bound 3.25" in ln for ln in lines)
+    assert any("SURROGATE QUARANTINE tenant default" in ln
+               and "exact serving until re-distill" in ln
+               for ln in lines)

@@ -514,6 +514,31 @@ def _fmt_event(e: dict) -> str | None:
     if t == "statics_warm_rejected":
         return (f"{ts} STATICS warm seed rejected case {e.get('case')} "
                 f"(iters {e.get('iters')}; cold re-solve)")
+    # learned-read-tier events (serve/surrogate.py — docs/
+    # performance.md "Layer 9")
+    if t == "surrogate_served":
+        audit = " AUDIT-DUE" if e.get("audit") else ""
+        return (f"{ts} surrogate served {str(e.get('rdigest'))[:19]} "
+                f"tenant {e.get('tenant')} "
+                f"(bundle v{e.get('version')} "
+                f"{str(e.get('bundle'))[:19]}){audit}")
+    if t == "surrogate_audit":
+        if e.get("error"):
+            return (f"{ts} surrogate AUDIT-ERROR "
+                    f"{str(e.get('rdigest'))[:19]} "
+                    f"tenant {e.get('tenant')} (re-solve failed)")
+        verdict = "ok" if e.get("ok") else "VIOLATION"
+        worst = e.get("worst_std_err_over_bound")
+        detail = (f", worst err/bound {worst:.2f}"
+                  if isinstance(worst, (int, float)) else "")
+        return (f"{ts} surrogate audit {verdict} "
+                f"{str(e.get('rdigest'))[:19]} "
+                f"tenant {e.get('tenant')}{detail}")
+    if t == "surrogate_quarantine":
+        return (f"{ts} SURROGATE QUARANTINE tenant {e.get('tenant')} "
+                f"bundle v{e.get('version')} "
+                f"{str(e.get('bundle'))[:19]} — exact serving until "
+                f"re-distill")
     # preemption-tolerance events (serve/checkpoint.py — "Preemption &
     # storage")
     if t in ("ckpt_resume", "ckpt_resumed"):

@@ -75,6 +75,18 @@ Subcommands::
         corrupt bytes are ever served, and every digest (warm starts
         included) is bit-for-bit identical to the clean run.
 
+    raftserve distill --store-dir DIR --surrogate-dir DIR \\
+                      [--tenant NAME] [--steps N] [--hidden 32,32]
+        Train the learned read tier offline from the result-store
+        corpus (raft_tpu/serve/surrogate.py): export every
+        sidecar-verified full-mode entry for the tenant, fit the
+        per-tenant MLP, calibrate a conformal error bound per output
+        channel on a holdout split, and publish a versioned,
+        digest-stamped bundle (pointer written last — a torn publish
+        leaves the previous bundle live; a fresh publish clears any
+        quarantine marker).  A running `raftserve serve
+        --surrogate-dir` picks the new bundle up on its next lookup.
+
     raftserve route --backend URL [--backend URL ...] [--port N]
                     [--secret-file F] [--quota TENANT=RATE[:BURST]]
                     [--default-quota RATE[:BURST]]
@@ -498,7 +510,10 @@ def cmd_serve(args) -> int:
                       journal_dir=args.journal_dir,
                       mirror_dirs=tuple(args.mirror_dir or ()),
                       store_dir=args.store_dir,
-                      warm_start=bool(args.warm_start))
+                      warm_start=bool(args.warm_start),
+                      surrogate_dir=args.surrogate_dir,
+                      surrogate_tol=args.surrogate_tol,
+                      surrogate_audit_every=args.surrogate_audit_every)
     degraded = {"coarse": coarse} if coarse is not None else None
     service = SweepService(fowt, cfg, degraded_fowts=degraded)
     srv = make_serve_server(service, args.host, args.port,
@@ -558,6 +573,41 @@ def cmd_serve(args) -> int:
         srv.server_close()
         summary = service.stop()
         print(json.dumps(summary, indent=1, default=str))
+    return 0
+
+
+def cmd_distill(args) -> int:
+    from raft_tpu import errors
+    from raft_tpu.serve import surrogate
+    from raft_tpu.serve.resultstore import ResultStore
+
+    hidden = tuple(int(v) for v in str(args.hidden).split(",") if v)
+    store = ResultStore(args.store_dir)
+    try:
+        res = surrogate.distill(
+            store, args.surrogate_dir, tenant=args.tenant,
+            hidden=hidden, steps=args.steps, lr=args.lr,
+            seed=args.seed, holdout_frac=args.holdout_frac,
+            alpha=args.alpha, min_rows=args.min_rows)
+    except errors.ModelConfigError as e:
+        print(f"raftserve distill: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=1, default=str)
+    c = res["counts"]
+    print(f"raftserve distill: tenant={res['tenant']} "
+          f"v{res['version']} {os.path.basename(res['path'])} — "
+          f"{res['corpus_rows']} corpus rows "
+          f"({c['skipped_orphan']} orphan, "
+          f"{c['skipped_quarantined']} quarantined, "
+          f"{c['skipped_corrupt']} corrupt, "
+          f"{c['skipped_degraded']} degraded skipped), "
+          f"{res['holdout_rows']} holdout, "
+          f"bound_rel_max={res['bound_rel_max']:.4f} "
+          f"(serves under tol >= that), "
+          f"loss {res['fit']['loss_first']:.3g} -> "
+          f"{res['fit']['loss_last']:.3g}")
     return 0
 
 
@@ -725,7 +775,44 @@ def main(argv=None) -> int:
                    help="seed cache-miss solves from the nearest "
                         "cold-solved store neighbor (guarded + "
                         "audited; needs --store-dir)")
+    p.add_argument("--surrogate-dir", default=None,
+                   help="learned read tier: directory of distilled "
+                        "per-tenant surrogate bundles (`raftserve "
+                        "distill`); in-hull queries under the "
+                        "calibrated bound answer from one forward "
+                        "pass, audited + quarantined (needs "
+                        "--store-dir)")
+    p.add_argument("--surrogate-tol", type=float, default=0.05,
+                   help="max relative calibrated bound a bundle may "
+                        "serve under")
+    p.add_argument("--surrogate-audit-every", type=int, default=8,
+                   help="cold-solve + compare every Nth "
+                        "surrogate-served answer")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("distill",
+                       help="train + publish the learned read tier "
+                            "from the result-store corpus")
+    p.add_argument("--store-dir", required=True,
+                   help="result-store directory (the training corpus)")
+    p.add_argument("--surrogate-dir", required=True,
+                   help="bundle output directory (served by "
+                        "`raftserve serve --surrogate-dir`)")
+    p.add_argument("--tenant", default="default")
+    p.add_argument("--hidden", default="32,32",
+                   help="comma-separated MLP hidden widths")
+    p.add_argument("--steps", type=int, default=1500)
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--holdout-frac", type=float, default=0.25,
+                   help="corpus fraction held out for calibration")
+    p.add_argument("--alpha", type=float, default=0.1,
+                   help="conformal miscoverage level (bound covers "
+                        ">= 1-alpha of holdout errors)")
+    p.add_argument("--min-rows", type=int, default=16)
+    p.add_argument("--json", help="write the distill report to this "
+                                  "path")
+    p.set_defaults(fn=cmd_distill)
 
     p = sub.add_parser("route", help="replica router over N raftserve "
                                      "backends (health checks, "

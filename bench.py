@@ -832,7 +832,12 @@ def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
       path a caller blocks on, NOT the solve);
     - ``batch_fill_ratio`` — completed / (batches x batch size): how
       well the coalescing window packs the warm program under this
-      arrival rate (1.0 = every batch full).
+      arrival rate (1.0 = every batch full);
+    - ``queue_depth_p50`` / ``queue_depth_p99`` and
+      ``quota_pressure`` (shed arrivals / arrivals) — the elastic
+      fleet controller's input signals (serve/fleet.py), trended here
+      so its scale thresholds are chosen against measured load curves
+      rather than guessed.
 
     The facts land in a ``bench_serve`` run manifest
     (``extra["serve_bench"]``) -> trend-store row, so `obsctl trend
@@ -898,6 +903,7 @@ def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
         arrivals = t0 + np.cumsum(gaps)
         tickets = {}
         admit_s = []
+        depth_samples = []
         shed = 0
         for i in range(n):
             wait = arrivals[i] - time.monotonic()
@@ -910,6 +916,9 @@ def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
                 shed += 1        # open loop: shed arrivals do not retry
             finally:
                 admit_s.append(time.perf_counter() - ta)
+            # queue depth AT each arrival: the distribution the fleet
+            # controller's scale-up threshold cuts through
+            depth_samples.append(svc.stats()["queue_depth"])
         results = {}
         deadline = time.monotonic() + timeout_s
         for i, t in tickets.items():
@@ -926,6 +935,11 @@ def serve_bench(runner_factory=None, *, design="Vertical_cylinder",
                 completed / (batches * cfg.batch_cases), 4),
             "arrival_rps": rps,
             "open_loop_s": round(open_loop_s, 3),
+            "queue_depth_p50": SweepService._percentile(
+                depth_samples, 50),
+            "queue_depth_p99": SweepService._percentile(
+                depth_samples, 99),
+            "quota_pressure": round(shed / float(n), 4) if n else 0.0,
             "completed": completed,
             "shed": shed,
             "failed": sum(1 for r in results.values() if not r.ok),

@@ -247,7 +247,11 @@ def facts_from_manifest(doc: dict) -> dict:
                   "dup_ratio", "store_hit_ratio", "read_p50_ms",
                   "read_p99_ms", "warm_start_iter_savings",
                   "store_corrupt_served_count",
-                  "warm_start_digest_mismatch"):
+                  "warm_start_digest_mismatch",
+                  # fleet-controller input signals (serve/fleet.py
+                  # thresholds are tuned against these trends)
+                  "queue_depth_p50", "queue_depth_p99",
+                  "quota_pressure"):
             if _num(sbench.get(k)) is not None:
                 facts[f"serve_{k}"] = sbench[k]
     # learned-read-tier bench facts (bench.py surrogate): the
@@ -325,6 +329,21 @@ def facts_from_manifest(doc: dict) -> dict:
                   "preempt_lost", "storage_sheds"):
             if _num(preempt.get(k)) is not None:
                 facts[k] = preempt[k]
+    # elastic-fleet soak facts (serve/soak.py run_elastic +
+    # serve/fleet.py): autoscaling ground truth — the two unprefixed
+    # zero-tolerance facts are matched exactly by their SLO rules and
+    # exist only on elastic-soak rows, so ordinary runs skip
+    fleet = extra.get("fleet") or {}
+    if isinstance(fleet, dict):
+        for k in ("fleet_scale_loss_count",
+                  "fleet_preempt_digest_mismatch",
+                  "fleet_scale_ups", "fleet_scale_downs",
+                  "fleet_preemptions", "fleet_folds",
+                  "fleet_kills_injected", "fleet_handoffs",
+                  "fleet_replicas_max", "fleet_ckpt_shed",
+                  "fleet_resumed_from_step"):
+            if _num(fleet.get(k)) is not None:
+                facts[k] = fleet[k]
     # duplicate-storm soak facts (serve/soak.py run_storm): ground-truth
     # integrity counts measured against the clean reference digests
     storm = extra.get("serve_storm") or {}
@@ -631,6 +650,19 @@ DEFAULT_SLO_RULES = [
      "threshold": 0.0, "window": 20},
     {"name": "storage_corrupt_served_count",
      "fact": "storage_corrupt_served_count", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
+    # -- elastic-fleet gates (serve/fleet.py + soak.run_elastic; facts
+    # exist only on elastic-soak rows — ordinary runs skip).  Both are
+    # zero-tolerance: an accepted request lost across a scale-down
+    # drain or a preemption fold means the handoff/recover composition
+    # dropped work the service acknowledged; a preempted descent that
+    # resumed on a survivor with a digest differing from the
+    # uninterrupted reference means the fleet's checkpoint carry lied.
+    {"name": "fleet_scale_loss_count",
+     "fact": "fleet_scale_loss_count", "agg": "max", "op": "<=",
+     "threshold": 0.0, "window": 20},
+    {"name": "fleet_preempt_digest_mismatch",
+     "fact": "fleet_preempt_digest_mismatch", "agg": "max", "op": "<=",
      "threshold": 0.0, "window": 20},
     # -- mixed-precision ladder gate (bench_kernels.py; skipped when no
     # mixed-ladder bench row exists).  A promoted-lane ratio near 1.0

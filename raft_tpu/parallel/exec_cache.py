@@ -85,6 +85,56 @@ def cache_dir() -> str:
                             "executables"))
 
 
+#: NamedTuple node types already registered with ``jax.export``'s
+#: PyTreeDef serde registry (re-registration raises, so memoized here)
+_EXPORT_TYPES: set = set()
+
+
+def register_export_types(tree) -> int:
+    """Register every NamedTuple pytree node type reachable in ``tree``
+    for ``jax.export`` PyTreeDef (de)serialization, idempotently.
+
+    ``jax.export`` refuses to serialize a program whose example args
+    contain an unregistered container type — optax optimizer states
+    (``ScaleByAdamState`` & co) being the canonical offenders, which
+    silently demoted every optimize-program store to an ``error`` and
+    every warm-process descent to a full recompile.  The serialized
+    name is derived from the type's module + qualname, so the store-ing
+    and load-ing processes agree without coordination.  Returns the
+    number of newly registered types; never raises (an unregisterable
+    type just falls through to export's own error, counted as usual)."""
+    from jax import export as jexport
+
+    new = 0
+
+    def _walk(node):
+        nonlocal new
+        t = type(node)
+        if isinstance(node, tuple) and hasattr(t, "_fields"):
+            with _LOCK:
+                fresh = t not in _EXPORT_TYPES
+                if fresh:
+                    _EXPORT_TYPES.add(t)
+            if fresh:
+                try:
+                    jexport.register_namedtuple_serialization(
+                        t, serialized_name=(
+                            f"{t.__module__}.{t.__qualname__}"))
+                    new += 1
+                # already registered elsewhere (same name): fine
+                except Exception:  # raftlint: disable=RTL004
+                    pass
+        if isinstance(node, (list, tuple)):
+            for c in node:
+                _walk(c)
+        elif isinstance(node, dict):
+            for c in node.values():
+                _walk(c)
+
+    _walk(tree)
+    return new
+
+
 def stats() -> dict:
     with _LOCK:
         return dict(_STATS)

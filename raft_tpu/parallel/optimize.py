@@ -722,6 +722,9 @@ def _aot_program(fn_jitted, args, key_facts: dict, ckpt_fact: dict,
     exe = None
     state = "disabled"
     if exec_cache.enabled():
+        # the carry holds optax state NamedTuples — jax.export's
+        # PyTreeDef serde must know them before deserialize OR export
+        exec_cache.register_export_types(args)
         key = exec_cache.make_key(**key_facts, ckpt=ckpt_fact)
         exe = exec_cache.load(key)
         state = "hit" if exe is not None else "miss"
@@ -774,8 +777,11 @@ def _segmented_descent(descend, x0, *, every: int, steps: int,
     program reused per segment), the carry pulled once per segment
     under the sanctioned-transfer budget and persisted via the
     checkpoint store, a resume from the newest valid checkpoint, the
-    ``kill@optimize:step=N`` preemption seam at every segment boundary,
-    and the typed :class:`~raft_tpu.errors.StorageExhausted` shed
+    ``kill@optimize:step=N`` / ``hang@optimize:step=N`` preemption
+    seam at every segment boundary (hang parks the loop after step N's
+    checkpoint is durable so an external SIGKILL lands at a known
+    resume point), and the typed
+    :class:`~raft_tpu.errors.StorageExhausted` shed
     (checkpointing stops, the descent keeps its on-device progress).
 
     Returns ``(out, cache_state, ckpt_info)`` where ``out`` is the
@@ -863,6 +869,17 @@ def _segmented_descent(descend, x0, *, every: int, steps: int,
                 "optimize: injected kill at step %d (os._exit)",
                 done_steps)
             _os._exit(137)
+        if f is not None and f["action"] == "hang":
+            # park at the boundary AFTER step N's checkpoint is durable
+            # and mirrored: an external preemption (the elastic soak's
+            # controller-issued kill@fleet) then lands at a KNOWN
+            # resume point instead of racing the descent's step rate
+            import time as _time
+            from raft_tpu.utils.profiling import get_logger
+            get_logger("optimize").warning(
+                "optimize: injected hang at step %d (%.1fs)",
+                done_steps, f.get("hang_s", 30.0))
+            _time.sleep(float(f.get("hang_s", 30.0)))
         seg_len = min(int(every), int(steps) - done_steps)
         carry, (ot, gt) = prog_for(seg_len, carry)(carry)
         done_steps += seg_len

@@ -1,0 +1,827 @@
+"""Elastic fleet controller: autoscaling that treats preemption as
+routine.
+
+The serving arc so far built every primitive a fleet needs — a
+write-ahead journal with synchronous WAL mirroring, ``recover()``
+failover with seq remapping, ``/drain`` handoff manifests,
+checkpoint-resumable descents, a health-swept router with tenant
+affinity, and trend-store signals.  :class:`FleetController` is the
+control loop that composes them: it boots and retires ``raftserve
+serve`` replica subprocesses against directory-shaped stores, watches
+the router's live signals (queue depth, per-tenant quota pressure) and
+the trend store's admission p99 against configurable thresholds, and
+scales with hysteresis and a cooldown so one noisy sweep never flaps
+the fleet.
+
+The lifecycle contracts, in the order the elastic soak proves them:
+
+- **Scale-up** launches a replica wired with its own ``--journal-dir``
+  and a WAL mirror peer (the "network disk" a survivor folds), waits
+  for ``/healthz``, and registers it with the router via the dynamic
+  :meth:`~raft_tpu.serve.router.ReplicaRouter.add_backend` API.
+- **Scale-down** drains via the existing ``/drain`` handoff and
+  deregisters only after the ``handoff.json`` manifest lands; a
+  handoff that left pending requests behind is folded into a survivor
+  before the victim is forgotten — a planned retirement loses zero
+  accepted requests by construction.
+- **Preemption** (an unplanned death) is detected by the health sweep
+  (the subprocess exit first, the router's failed probes as backstop);
+  the dead replica's WAL mirror is folded into a survivor via ``POST
+  /recover`` -> :meth:`SweepService.recover`, so its accepted-
+  unfinished work — checkpoint-resumable descents included — resumes
+  on the survivor with bit-for-bit digests.
+- **Controller death** is itself routine: every membership transition
+  is journaled WAL-style (``fleet.events.jsonl``, torn-tail tolerant)
+  before it is acted on, and a restarted controller rebuilds its fleet
+  view from the journal alone (:meth:`FleetController.recover_view`),
+  re-adopting live replicas and treating expected-but-dead ones as
+  preemptions.
+
+Metrics: ``raft_tpu_fleet_replicas`` (gauge),
+``raft_tpu_fleet_scale_total{direction,reason}`` and
+``raft_tpu_fleet_preemptions_total`` (counters).  The elastic soak
+(:func:`raft_tpu.serve.soak.run_elastic`) feeds the zero-tolerance
+SLO rules ``fleet_scale_loss_count`` / ``fleet_preempt_digest_mismatch``
+(obs/trendstore.py).
+
+Fault seam: ``kill@fleet:replica=N`` hard-kills the Nth spawned
+replica from the controller's tick — the preemption wave, injected at
+the controller (the cluster's SIGKILL), mirroring ``kill@serve``.
+
+CLI: ``tools/raftserve.py fleet --root DIR ...``; docs:
+docs/robustness.md "Elastic fleet".
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from raft_tpu import errors
+from raft_tpu.obs import journalio
+from raft_tpu.utils.profiling import get_logger
+
+_LOG = get_logger("serve.fleet")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: fleet event journal filename under ``FleetConfig.root``
+EVENTS_NAME = "fleet.events.jsonl"
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Knobs of one :class:`FleetController` (validated eagerly, like
+    :class:`~raft_tpu.serve.config.ServeConfig`)."""
+
+    #: fleet root directory: ``replica<i>/{journal,mirror}`` trees, the
+    #: shared checkpoint store, and the controller's event journal
+    root: str = "fleet"
+
+    # -- replica model (must match across replicas for digest parity) --
+    design: str = "Vertical_cylinder"
+    min_freq: float = 0.05
+    max_freq: float = 0.5
+    dfreq: float = 0.05
+    batch_cases: int = 4
+    queue_max: int = 64
+    #: per-request deadline forwarded to every replica (--deadline)
+    deadline_s: float = 300.0
+    #: solver kwargs forwarded to every replica (--niter/--tol/
+    #: --fp-chunk) — clean-reference digests only match if every
+    #: replica solves with identical solver parameters
+    nIter: int = 10
+    tol: float = 0.01
+    fp_chunk: int = 2
+    #: shared checkpoint store (descents resume across replicas); None
+    #: disables checkpointing fleet-wide
+    ckpt_dir: str | None = None
+    checkpoint_every: int = 0
+    #: extra RAFT_TPU_FAULTS value spawned replicas boot with (chaos
+    #: harness only — production replicas boot clean)
+    replica_faults: str = ""
+
+    # -- membership bounds --------------------------------------------
+    min_replicas: int = 1
+    max_replicas: int = 4
+
+    # -- scaling signals / thresholds ---------------------------------
+    #: scale up when the max backend queue depth reaches this
+    scale_up_queue_depth: float = 4.0
+    #: scale up when the trend store's serve_admission_p99_s reaches
+    #: this (None ignores the trend signal)
+    scale_up_admission_p99_s: float | None = None
+    #: scale up when quota_exceeded / (routed + quota_exceeded) over
+    #: the last tick reaches this ratio
+    scale_up_quota_pressure: float = 0.5
+    #: scale down when the max backend queue depth is at or below this
+    scale_down_queue_depth: float = 0.0
+
+    # -- hysteresis / cadence -----------------------------------------
+    #: consecutive breaching ticks before a scale decision acts
+    hysteresis_ticks: int = 2
+    #: minimum seconds between scale actions
+    cooldown_s: float = 5.0
+    #: control-loop cadence (health sweep + signal sample)
+    tick_s: float = 0.5
+    #: consecutive failed router probes before a silent replica (no
+    #: subprocess handle to poll) is declared dead
+    dead_after_fails: int = 2
+
+    # -- replica lifecycle --------------------------------------------
+    host: str = "127.0.0.1"
+    boot_timeout_s: float = 120.0
+    drain_timeout_s: float = 30.0
+    http_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        checks = [
+            ("root", bool(str(self.root).strip())),
+            ("batch_cases", self.batch_cases >= 1),
+            ("queue_max", self.queue_max >= 1),
+            ("deadline_s", self.deadline_s > 0.0),
+            ("nIter", self.nIter >= 1),
+            ("checkpoint_every", self.checkpoint_every >= 0),
+            ("min_replicas", self.min_replicas >= 1),
+            ("max_replicas", self.max_replicas >= self.min_replicas),
+            ("scale_up_queue_depth", self.scale_up_queue_depth > 0.0),
+            ("scale_up_quota_pressure",
+             0.0 < self.scale_up_quota_pressure <= 1.0),
+            ("scale_down_queue_depth",
+             0.0 <= self.scale_down_queue_depth
+             < self.scale_up_queue_depth),
+            ("hysteresis_ticks", self.hysteresis_ticks >= 1),
+            ("cooldown_s", self.cooldown_s >= 0.0),
+            ("tick_s", self.tick_s > 0.0),
+            ("dead_after_fails", self.dead_after_fails >= 1),
+            ("boot_timeout_s", self.boot_timeout_s > 0.0),
+            ("drain_timeout_s", self.drain_timeout_s > 0.0),
+        ]
+        bad = [name for name, ok in checks if not ok]
+        if bad:
+            raise errors.ModelConfigError(
+                "invalid FleetConfig", fields=",".join(bad))
+
+
+# ---------------------------------------------------------------------------
+# tiny stdlib HTTP helpers (the controller is a client, never a server)
+# ---------------------------------------------------------------------------
+
+def _http_json(url: str, doc: dict = None,
+               timeout: float = 30.0) -> tuple[int, dict]:
+    data = None if doc is None else json.dumps(doc, default=str).encode()
+    req = urllib.request.Request(
+        url, data=data, method="GET" if doc is None else "POST",
+        headers={"Content-Type": "application/json"} if doc else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _free_port(host: str) -> int:
+    import socket
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return int(port)
+
+
+class _Replica:
+    """One fleet member: a ``raftserve serve`` subprocess (or an
+    adopted/stubbed equivalent) plus its directory tree."""
+
+    __slots__ = ("index", "url", "pid", "proc", "journal_dir",
+                 "mirror_dir", "state", "log_path")
+
+    def __init__(self, index: int, url: str, pid: int, proc,
+                 journal_dir: str, mirror_dir: str,
+                 log_path: str = None):
+        self.index = int(index)
+        self.url = str(url).rstrip("/")
+        self.pid = int(pid)
+        self.proc = proc
+        self.journal_dir = journal_dir
+        self.mirror_dir = mirror_dir
+        self.state = "live"              # live | draining | retired |
+        self.log_path = log_path         # preempted
+
+
+class FleetController:
+    """The elastic control loop (see module docstring).
+
+    ``launcher`` (optional) replaces the subprocess replica launcher —
+    ``launcher(index, port, journal_dir, mirror_dir) -> (url, pid,
+    proc)`` — so the unit tier can drive the whole control loop against
+    in-process stub replicas without booting a FOWT.  ``proc`` needs
+    ``poll()``/``kill()``/``wait(timeout)`` (a real ``Popen`` or a
+    stub)."""
+
+    def __init__(self, cfg: FleetConfig, *, launcher=None,
+                 router_kw: dict = None):
+        self.cfg = cfg
+        self.root = os.path.abspath(str(cfg.root))
+        self.replicas: dict[int, _Replica] = {}
+        self.router = None
+        self._router_kw = dict(router_kw or {})
+        self._launcher = launcher or self._spawn_replica
+        self._journal: journalio.JsonlWriter | None = None
+        self._lock = threading.RLock()
+        self._thread = None
+        self._state = "new"
+        self._next_index = 0
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_scale = 0.0
+        self._prev_counts: dict = {}
+        self._counts = {"scale_ups": 0, "scale_downs": 0,
+                        "preemptions": 0, "folds": 0, "kills_injected": 0,
+                        "handoffs": 0}
+        self.last_signals: dict = {}
+
+    # ------------------------------------------------------------------
+    # event journal (WAL-style: the transition is durable BEFORE the
+    # controller acts on it, so a killed controller replays its view)
+    # ------------------------------------------------------------------
+
+    @property
+    def events_path(self) -> str:
+        return os.path.join(self.root, EVENTS_NAME)
+
+    def _event(self, type_: str, **fields):
+        doc = {"kind": "fleet_event", "type": type_, "t": time.time(),
+               **fields}
+        with self._lock:
+            if self._journal is not None:
+                self._journal.write(doc)
+        try:
+            from raft_tpu import obs
+            obs.events.emit("fleet_" + type_, **fields)
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+
+    @staticmethod
+    def read_events(root: str) -> list[dict]:
+        """Every fleet event journaled under ``root`` (torn-tail
+        tolerant, like any WAL read)."""
+        path = os.path.join(os.path.abspath(str(root)), EVENTS_NAME)
+        if not os.path.exists(path):
+            return []
+        return journalio.read(path, kind="fleet")
+
+    @classmethod
+    def recover_view(cls, root: str) -> dict:
+        """Rebuild the fleet view a dead controller held, from its
+        event journal alone: expected-live replicas (with their urls,
+        pids and directory trees), terminal members, and the scale /
+        preemption accounting.  This is the boot path of a restarted
+        controller — and the soak's controller-crash gate."""
+        replicas: dict[int, dict] = {}
+        counts = {"scale_ups": 0, "scale_downs": 0, "preemptions": 0,
+                  "folds": 0}
+        for ev in cls.read_events(root):
+            t = ev.get("type")
+            idx = ev.get("index")
+            if t == "replica_launched":
+                replicas[int(idx)] = {
+                    "index": int(idx), "url": ev.get("url"),
+                    "pid": ev.get("pid"),
+                    "journal_dir": ev.get("journal_dir"),
+                    "mirror_dir": ev.get("mirror_dir"),
+                    "state": "live"}
+            elif t == "replica_retired" and idx is not None \
+                    and int(idx) in replicas:
+                replicas[int(idx)]["state"] = "retired"
+            elif t == "preemption_detected":
+                counts["preemptions"] += 1
+                if idx is not None and int(idx) in replicas:
+                    replicas[int(idx)]["state"] = "preempted"
+            elif t == "scale_up":
+                counts["scale_ups"] += 1
+            elif t == "scale_down":
+                counts["scale_downs"] += 1
+            elif t == "fold_completed":
+                counts["folds"] += 1
+        live = {i: r for i, r in replicas.items()
+                if r["state"] == "live"}
+        return {"replicas": replicas, "live": live, **counts,
+                "next_index": (max(replicas) + 1) if replicas else 0}
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+
+    def _gauge(self):
+        try:
+            from raft_tpu import obs
+            obs.gauge("raft_tpu_fleet_replicas",
+                      "live replicas under the fleet controller"
+                      ).set(float(len(self.live())))
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+
+    def _count_scale(self, direction: str, reason: str):
+        with self._lock:
+            self._counts["scale_ups" if direction == "up"
+                         else "scale_downs"] += 1
+        try:
+            from raft_tpu import obs
+            obs.counter("raft_tpu_fleet_scale_total",
+                        "fleet scale actions, by direction and reason"
+                        ).inc(1.0, direction=direction, reason=reason)
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+
+    def _count_preemption(self):
+        with self._lock:
+            self._counts["preemptions"] += 1
+        try:
+            from raft_tpu import obs
+            obs.counter("raft_tpu_fleet_preemptions_total",
+                        "unplanned replica deaths the sweep detected"
+                        ).inc(1.0)
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            pass
+
+    # ------------------------------------------------------------------
+    # replica lifecycle
+    # ------------------------------------------------------------------
+
+    def _replica_dirs(self, index: int) -> tuple[str, str]:
+        base = os.path.join(self.root, f"replica{index}")
+        return (os.path.join(base, "journal"),
+                os.path.join(base, "mirror"))
+
+    def _spawn_replica(self, index: int, port: int, journal_dir: str,
+                       mirror_dir: str):
+        """Default launcher: one ``raftserve serve`` subprocess, WAL
+        journaled + mirrored, solver params pinned to the fleet's."""
+        cfg = self.cfg
+        argv = [sys.executable,
+                os.path.join(_REPO_ROOT, "tools", "raftserve.py"),
+                "serve", "--design", cfg.design,
+                "--min-freq", str(cfg.min_freq),
+                "--max-freq", str(cfg.max_freq),
+                "--dfreq", str(cfg.dfreq),
+                "--batch", str(cfg.batch_cases),
+                "--queue-max", str(cfg.queue_max),
+                "--niter", str(cfg.nIter), "--tol", str(cfg.tol),
+                "--fp-chunk", str(cfg.fp_chunk),
+                "--deadline", str(cfg.deadline_s),
+                "--host", cfg.host, "--port", str(port),
+                "--journal-dir", journal_dir,
+                "--mirror-dir", mirror_dir,
+                "--no-coarse"]
+        if cfg.ckpt_dir:
+            argv += ["--ckpt-dir", cfg.ckpt_dir,
+                     "--checkpoint-every", str(cfg.checkpoint_every)]
+        env = {**os.environ, "RAFT_TPU_FAULTS": cfg.replica_faults}
+        env["PYTHONPATH"] = _REPO_ROOT + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        log_path = os.path.join(self.root, f"replica{index}",
+                                "replica.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        log = open(log_path, "a")
+        proc = subprocess.Popen(argv, stdout=log, stderr=log, env=env)
+        log.close()
+        return f"http://{cfg.host}:{port}", proc.pid, proc
+
+    def launch_replica(self) -> _Replica:
+        """Boot one replica, wait for its ``/healthz``, journal the
+        membership transition.  Registration with the router is the
+        caller's move (boot order: the first replica exists before the
+        router does)."""
+        with self._lock:
+            index = self._next_index
+            self._next_index += 1
+        jdir, mdir = self._replica_dirs(index)
+        port = _free_port(self.cfg.host)
+        url, pid, proc = self._launcher(index, port, jdir, mdir)
+        rec = _Replica(index, url, pid, proc, jdir, mdir)
+        deadline = time.monotonic() + self.cfg.boot_timeout_s
+        while True:
+            try:
+                code, doc = _http_json(rec.url + "/healthz",
+                                       timeout=2.0)
+                if code == 200 and doc.get("ok"):
+                    break
+            except (urllib.error.URLError, OSError, TimeoutError,
+                    ValueError):
+                pass
+            if proc is not None and proc.poll() is not None:
+                raise errors.KernelFailure(
+                    "fleet replica died during boot", index=index,
+                    rc=proc.returncode, log=rec.log_path)
+            if time.monotonic() > deadline:
+                if proc is not None:
+                    proc.kill()
+                raise errors.DeadlineExceeded(
+                    "fleet replica boot timed out", index=index,
+                    timeout_s=self.cfg.boot_timeout_s)
+            time.sleep(0.05)
+        with self._lock:
+            self.replicas[index] = rec
+        self._event("replica_launched", index=index, url=rec.url,
+                    pid=rec.pid, journal_dir=jdir, mirror_dir=mdir)
+        self._gauge()
+        _LOG.info("fleet: replica %d up at %s (pid %d)", index,
+                  rec.url, rec.pid)
+        return rec
+
+    def live(self) -> list[_Replica]:
+        with self._lock:
+            return [r for r in self.replicas.values()
+                    if r.state == "live"]
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self, run_loop: bool = True) -> "FleetController":
+        """Boot to ``min_replicas`` (recovering a prior controller
+        life's journal first) and start the tick thread.  With
+        ``run_loop=False`` the thread is not started and the caller
+        drives :meth:`tick` — the unit tier's deterministic mode."""
+        from raft_tpu.serve.router import ReplicaRouter
+
+        os.makedirs(self.root, exist_ok=True)
+        had_journal = os.path.exists(self.events_path)
+        prior = self.recover_view(self.root) if had_journal else None
+        self._journal = journalio.JsonlWriter(self.events_path)
+        dead_expected = []
+        if prior is not None and prior["replicas"]:
+            self._event("controller_recovered",
+                        expected_live=sorted(prior["live"]),
+                        replicas=len(prior["replicas"]))
+            self._next_index = prior["next_index"]
+            # re-adopt what still answers; what doesn't is a preemption
+            # this controller life must fold like any other
+            for idx, r in sorted(prior["live"].items()):
+                rec = _Replica(idx, r["url"], int(r["pid"] or 0), None,
+                               r["journal_dir"], r["mirror_dir"])
+                alive = False
+                try:
+                    code, doc = _http_json(rec.url + "/healthz",
+                                           timeout=2.0)
+                    alive = code == 200 and bool(doc.get("ok"))
+                except (urllib.error.URLError, OSError, TimeoutError,
+                        ValueError):
+                    alive = False
+                with self._lock:
+                    self.replicas[idx] = rec
+                if alive:
+                    _LOG.info("fleet: re-adopted replica %d at %s",
+                              idx, rec.url)
+                else:
+                    dead_expected.append(rec)
+        while len(self.live()) - len(dead_expected) \
+                < self.cfg.min_replicas:
+            self.launch_replica()
+        self.router = ReplicaRouter(
+            [r.url for r in self.live() if r not in dead_expected],
+            health_interval_s=max(self.cfg.tick_s, 0.1),
+            timeout_s=self.cfg.http_timeout_s, **self._router_kw)
+        self.router.check_now()
+        for rec in dead_expected:
+            self._handle_preemption(rec, registered=False)
+        with self._lock:
+            self._state = "running"
+        if run_loop:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="raft-fleet-tick",
+                                            daemon=True)
+            self._thread.start()
+        self._gauge()
+        return self
+
+    def stop(self, drain: bool = True) -> dict:
+        """Stop the control loop; with ``drain`` retire every live
+        replica through the handoff path first.  Returns the counts."""
+        with self._lock:
+            self._state = "stopped"
+        if self._thread is not None:
+            self._thread.join(max(2.0, 4.0 * self.cfg.tick_s))
+        if drain:
+            for rec in sorted(self.live(), key=lambda r: -r.index):
+                keep = len(self.live()) > 1
+                self._retire(rec, reason="shutdown",
+                             fold_into_survivor=keep)
+        if self.router is not None:
+            self.router.stop()
+        self._event("controller_stopped", **self._counts)
+        with self._lock:
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
+            return dict(self._counts)
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                if self._state != "running":
+                    return
+            time.sleep(self.cfg.tick_s)
+            # keep-alive seam: one bad tick (a probe burst racing a
+            # dying replica, a transient fold error) must never kill
+            # the control loop itself
+            try:
+                self.tick()
+            except Exception:  # raftlint: disable=RTL004
+                _LOG.exception("fleet: tick failed (retrying)")
+
+    # ------------------------------------------------------------------
+    # the control loop body
+    # ------------------------------------------------------------------
+
+    def tick(self):
+        """One control-loop pass: injected preemptions, the health
+        sweep + death fold, signal sampling, the hysteresis/cooldown
+        scale decision."""
+        self._fire_kill_seam()
+        self.router.check_now()
+        self._sweep_deaths()
+        sig = self.signals()
+        self._decide(sig)
+        self._gauge()
+
+    def _fire_kill_seam(self):
+        from raft_tpu.testing import faults
+        for rec in self.live():
+            f = faults.fire_info("fleet", action="kill",
+                                 replica=rec.index)
+            if f is None:
+                continue
+            with self._lock:
+                self._counts["kills_injected"] += 1
+            self._event("kill_injected", index=rec.index,
+                        spec=f.get("spec"))
+            _LOG.warning("fleet: injected preemption of replica %d "
+                         "(%s)", rec.index, f.get("spec"))
+            if rec.proc is not None:
+                rec.proc.kill()
+            else:                                    # adopted replica
+                try:
+                    os.kill(rec.pid, signal.SIGKILL)
+                except OSError:                      # pragma: no cover
+                    pass
+
+    def _dead(self, rec: _Replica) -> bool:
+        if rec.proc is not None:
+            return rec.proc.poll() is not None
+        b = next((b for b in self.router.backends
+                  if b.url == rec.url), None)
+        return (b is None or (not b.healthy
+                              and b.fails >= self.cfg.dead_after_fails))
+
+    def _sweep_deaths(self):
+        for rec in self.live():
+            if self._dead(rec):
+                self._handle_preemption(rec)
+
+    def _handle_preemption(self, rec: _Replica, registered: bool = True):
+        """A replica died without a drain: journal it, deregister it,
+        fold its WAL mirror into a survivor (so its accepted-unfinished
+        work — descents included — resumes there), and backfill the
+        fleet below ``min_replicas``."""
+        rec.state = "preempted"
+        self._count_preemption()
+        self._event("preemption_detected", index=rec.index,
+                    url=rec.url, pid=rec.pid)
+        _LOG.warning("fleet: replica %d (pid %d) preempted", rec.index,
+                     rec.pid)
+        if registered and self.router is not None:
+            self.router.remove_backend(rec.url)
+        survivors = self.live()
+        with self._lock:
+            running = self._state == "running"
+        if not survivors:
+            if not running:
+                # stopping controller: the dead member's work stays on
+                # its WAL/mirror for the next controller life to fold
+                return
+            # total preemption: boot a replacement and fold into it
+            survivors = [self.launch_replica()]
+            if self.router is not None:
+                self.router.add_backend(survivors[0].url)
+        self._fold(rec.mirror_dir, survivors[0], dead_index=rec.index)
+        while running and len(self.live()) < self.cfg.min_replicas:
+            new = self.launch_replica()
+            if self.router is not None:
+                self.router.add_backend(new.url)
+
+    def _fold(self, src_dir: str, survivor: _Replica, *,
+              dead_index: int = None) -> dict | None:
+        """POST the dead member's journal/mirror directory to a
+        survivor's ``/recover`` — the runtime WAL fold."""
+        from raft_tpu.serve import journal as wal
+        if not os.path.exists(wal.journal_path(src_dir)):
+            self._event("fold_skipped", src=src_dir,
+                        survivor=survivor.index, reason="no_journal")
+            return None
+        try:
+            code, doc = _http_json(
+                survivor.url + "/recover", {"journal_dir": src_dir},
+                timeout=self.cfg.http_timeout_s)
+        except (urllib.error.URLError, OSError, TimeoutError,
+                ValueError) as e:
+            _LOG.error("fleet: fold of %s into replica %d failed: %s",
+                       src_dir, survivor.index, e)
+            self._event("fold_failed", src=src_dir,
+                        survivor=survivor.index, error=str(e))
+            return None
+        with self._lock:
+            self._counts["folds"] += 1
+        self._event("fold_completed", src=src_dir, dead=dead_index,
+                    survivor=survivor.index,
+                    recovered=doc.get("recovered"),
+                    replayed=doc.get("replayed"),
+                    deduped=doc.get("deduped"))
+        _LOG.info("fleet: folded %s into replica %d — %s recovered, "
+                  "%s replayed, %s deduped", src_dir, survivor.index,
+                  doc.get("recovered"), doc.get("replayed"),
+                  doc.get("deduped"))
+        return doc
+
+    # ------------------------------------------------------------------
+    # signals + scaling decision
+    # ------------------------------------------------------------------
+
+    def _trend_admission_p99(self) -> float | None:
+        """Latest ``serve_admission_p99_s`` trend fact (bench serve
+        publishes it) — best-effort: a missing/odd trend store is a
+        None signal, never a dead controller."""
+        try:
+            from raft_tpu.obs import trendstore
+            path = trendstore.db_path()
+            if not path or not os.path.exists(path):
+                return None
+            for row in trendstore.TrendStore(path).rows(limit=20):
+                v = (row.get("facts") or {}).get("serve_admission_p99_s")
+                if v is not None:
+                    return float(v)
+            return None
+        except Exception:  # pragma: no cover  # raftlint: disable=RTL004
+            return None
+
+    def signals(self) -> dict:
+        """The controller's inputs this tick: max backend queue depth
+        and quota-pressure ratio from router ``stats()``, admission p99
+        from the trend store."""
+        st = self.router.stats()
+        depths = [b.get("queue_depth", 0) or 0
+                  for b in st["backends"].values()
+                  if b.get("healthy")]
+        queue_depth = max(depths) if depths else 0
+        cur = {k: st.get(k, 0) for k in ("routed", "quota_exceeded")}
+        d_routed = cur["routed"] - self._prev_counts.get("routed", 0)
+        d_quota = (cur["quota_exceeded"]
+                   - self._prev_counts.get("quota_exceeded", 0))
+        self._prev_counts = cur
+        pressure = (d_quota / float(d_routed + d_quota)
+                    if (d_routed + d_quota) > 0 else 0.0)
+        sig = {"queue_depth": queue_depth,
+               "quota_pressure": pressure,
+               "admission_p99_s": self._trend_admission_p99(),
+               "healthy": st["healthy"], "live": len(self.live())}
+        self.last_signals = sig
+        return sig
+
+    def _want_up(self, sig: dict) -> str | None:
+        if sig["queue_depth"] >= self.cfg.scale_up_queue_depth:
+            return "queue_depth"
+        if sig["quota_pressure"] >= self.cfg.scale_up_quota_pressure:
+            return "quota_pressure"
+        p99 = sig.get("admission_p99_s")
+        if (self.cfg.scale_up_admission_p99_s is not None
+                and p99 is not None
+                and p99 >= self.cfg.scale_up_admission_p99_s):
+            return "admission_p99"
+        return None
+
+    def _decide(self, sig: dict):
+        up_reason = self._want_up(sig)
+        want_down = (up_reason is None
+                     and sig["queue_depth"]
+                     <= self.cfg.scale_down_queue_depth)
+        with self._lock:
+            self._up_streak = self._up_streak + 1 if up_reason else 0
+            self._down_streak = (self._down_streak + 1 if want_down
+                                 else 0)
+            streak_up, streak_down = self._up_streak, self._down_streak
+            cooled = (time.monotonic() - self._last_scale
+                      >= self.cfg.cooldown_s)
+        if not cooled:
+            return
+        if (up_reason and streak_up >= self.cfg.hysteresis_ticks
+                and len(self.live()) < self.cfg.max_replicas):
+            self.scale_up(up_reason)
+        elif (want_down and streak_down >= self.cfg.hysteresis_ticks
+                and len(self.live()) > self.cfg.min_replicas):
+            self.scale_down("idle")
+
+    def _stamp_scale(self):
+        with self._lock:
+            self._last_scale = time.monotonic()
+            self._up_streak = 0
+            self._down_streak = 0
+
+    def scale_up(self, reason: str) -> _Replica:
+        rec = self.launch_replica()
+        self.router.add_backend(rec.url)
+        self._count_scale("up", reason)
+        self._event("scale_up", index=rec.index, reason=reason,
+                    live=len(self.live()))
+        self._stamp_scale()
+        _LOG.info("fleet: scaled UP to %d replicas (reason=%s)",
+                  len(self.live()), reason)
+        return rec
+
+    def scale_down(self, reason: str) -> bool:
+        victims = sorted(self.live(), key=lambda r: -r.index)
+        if len(victims) <= self.cfg.min_replicas:
+            return False
+        ok = self._retire(victims[0], reason=reason,
+                          fold_into_survivor=True)
+        self._count_scale("down", reason)
+        self._event("scale_down", index=victims[0].index,
+                    reason=reason, live=len(self.live()))
+        self._stamp_scale()
+        return ok
+
+    def _retire(self, rec: _Replica, *, reason: str,
+                fold_into_survivor: bool) -> bool:
+        """Planned retirement: ``/drain`` (the handoff), deregister
+        only after ``handoff.json`` lands, fold any handoff-pending
+        work into a survivor, reap the process."""
+        rec.state = "draining"
+        self._event("drain_started", index=rec.index, reason=reason)
+        handoff = None
+        try:
+            code, handoff = _http_json(
+                rec.url + "/drain", {},
+                timeout=self.cfg.drain_timeout_s)
+        except (urllib.error.URLError, OSError, TimeoutError,
+                ValueError) as e:
+            _LOG.error("fleet: drain of replica %d failed (%s) — "
+                       "treating as preemption", rec.index, e)
+            rec.state = "live"
+            self._handle_preemption(rec)
+            return False
+        manifest_path = os.path.join(rec.journal_dir, "handoff.json")
+        deadline = time.monotonic() + self.cfg.drain_timeout_s
+        while (not os.path.exists(manifest_path)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        landed = os.path.exists(manifest_path)
+        with self._lock:
+            self._counts["handoffs"] += 1
+        self._event("handoff_landed", index=rec.index, landed=landed,
+                    pending=(handoff or {}).get("pending"))
+        # deregister AFTER the manifest landed: until then the replica
+        # is still answering result fetches for its in-flight work
+        if self.router is not None:
+            self.router.remove_backend(rec.url)
+        pending = (handoff or {}).get("pending") or 0
+        survivors = [r for r in self.live() if r is not rec]
+        if fold_into_survivor and pending and survivors:
+            # a handoff that left pending requests behind: fold the
+            # drained WAL into a survivor so they re-solve there —
+            # zero accepted-request loss on the planned path too
+            self._fold(rec.journal_dir, survivors[0],
+                       dead_index=rec.index)
+        if rec.proc is not None:
+            try:
+                rec.proc.wait(timeout=self.cfg.drain_timeout_s)
+            except subprocess.TimeoutExpired:        # pragma: no cover
+                rec.proc.kill()
+        rec.state = "retired"
+        self._event("replica_retired", index=rec.index, reason=reason)
+        self._gauge()
+        _LOG.info("fleet: replica %d retired (reason=%s, handoff "
+                  "landed=%s, pending=%s)", rec.index, reason, landed,
+                  pending)
+        return landed
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {**self._counts, "state": self._state,
+                    "replicas": {r.index: {"url": r.url, "pid": r.pid,
+                                           "state": r.state}
+                                 for r in self.replicas.values()},
+                    "live": len(self.live()),
+                    "signals": dict(self.last_signals)}

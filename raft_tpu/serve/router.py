@@ -200,32 +200,89 @@ class ReplicaRouter:
             except Exception:
                 _LOG.exception("router: health sweep failed (retrying)")
 
+    # ------------------------------------------------------------------
+    # dynamic membership (the fleet controller's registration seam)
+    # ------------------------------------------------------------------
+
+    def add_backend(self, url: str, *, check: bool = True) -> str:
+        """Register a backend at runtime (scale-up).  The backend list
+        is replaced, never mutated in place, so concurrent sweeps and
+        submits iterating the old snapshot stay valid.  Returns the
+        normalized URL; raises on a duplicate."""
+        b = _Backend(url)
+        with self._lock:
+            if any(x.url == b.url for x in self.backends):
+                raise errors.ModelConfigError(
+                    "router backend already registered", backend=b.url)
+            self.backends = self.backends + [b]
+        if check:
+            self._probe(b)
+        self._gauge_health()
+        self._emit("router_backend_added", backend=b.url,
+                   healthy=b.healthy)
+        _LOG.info("router: backend %s registered (healthy=%s)",
+                  b.url, b.healthy)
+        return b.url
+
+    def remove_backend(self, url: str) -> bool:
+        """Deregister a backend at runtime (scale-down / preemption).
+        Tenant-affinity entries pinned to it are invalidated in the
+        same critical section — without that, every pinned tenant keeps
+        leading with the dead/retired replica until the next health
+        sweep, paying a connect-timeout failover per submit."""
+        url = str(url).rstrip("/")
+        with self._lock:
+            keep = [b for b in self.backends if b.url != url]
+            if len(keep) == len(self.backends):
+                return False
+            self.backends = keep
+            self._drop_affinity(url)
+        self._gauge_health()
+        self._emit("router_backend_removed", backend=url)
+        _LOG.info("router: backend %s deregistered", url)
+        return True
+
+    def _drop_affinity(self, url: str):
+        """Purge every tenant-affinity entry pinned to ``url``.
+        Callers hold ``self._lock`` or accept the benign race."""
+        with self._lock:
+            for tenant in [t for t, u in self._affinity.items()
+                           if u == url]:
+                del self._affinity[tenant]
+
     def check_now(self):
         """One synchronous health sweep over every backend."""
-        for b in self.backends:
-            was = b.healthy
-            try:
-                doc = self._get_json(b, "/healthz",
-                                     timeout=min(2.0,
-                                                 self.timeout_s))
-                b.healthy = bool(doc.get("ok"))
-                b.stats = {k: doc[k] for k in ("mode", "state",
-                                               "queue_depth")
-                           if k in doc}
-                b.fails = 0
-            # keep-alive seam: any probe trouble means "unhealthy",
-            # never an escaped exception
-            except Exception:
-                b.healthy = False
-                b.fails += 1
-            b.checked_at = time.time()
-            if was != b.healthy:
-                (_LOG.info if b.healthy else _LOG.warning)(
-                    "router: backend %s is %s", b.url,
-                    "healthy" if b.healthy else "UNHEALTHY")
-                self._emit("router_health", backend=b.url,
-                           healthy=b.healthy)
+        for b in list(self.backends):
+            self._probe(b)
         self._gauge_health()
+
+    def _probe(self, b: _Backend):
+        """Probe one backend's ``/healthz``; flips ``b.healthy`` and
+        drops its affinity pins on a healthy->unhealthy transition."""
+        was = b.healthy
+        try:
+            doc = self._get_json(b, "/healthz",
+                                 timeout=min(2.0,
+                                             self.timeout_s))
+            b.healthy = bool(doc.get("ok"))
+            b.stats = {k: doc[k] for k in ("mode", "state",
+                                           "queue_depth")
+                       if k in doc}
+            b.fails = 0
+        # keep-alive seam: any probe trouble means "unhealthy",
+        # never an escaped exception
+        except Exception:
+            b.healthy = False
+            b.fails += 1
+        b.checked_at = time.time()
+        if was != b.healthy:
+            if not b.healthy:
+                self._drop_affinity(b.url)
+            (_LOG.info if b.healthy else _LOG.warning)(
+                "router: backend %s is %s", b.url,
+                "healthy" if b.healthy else "UNHEALTHY")
+            self._emit("router_health", backend=b.url,
+                       healthy=b.healthy)
 
     def _gauge_health(self):
         try:
@@ -362,9 +419,12 @@ class ReplicaRouter:
                     headers={TRACE_HEADER: ctx.to_header()})
             except (urllib.error.URLError, OSError, TimeoutError):
                 # the pinned/next replica died mid-request: mark it,
-                # fail over to the next healthy candidate
+                # drop its affinity pins (or every pinned tenant keeps
+                # leading with the corpse until the next sweep), fail
+                # over to the next healthy candidate
                 b.healthy = False
                 b.fails += 1
+                self._drop_affinity(b.url)
                 self._gauge_health()
                 self._count("proxy_errors")
                 self._count("failovers")
@@ -442,6 +502,7 @@ class ReplicaRouter:
                         return code, {**body, "replica": owner.url}
                 except (urllib.error.URLError, OSError, TimeoutError):
                     owner.healthy = False
+                    self._drop_affinity(owner.url)
                     self._gauge_health()
                     self._count("proxy_errors")
             # the owner is gone (or forgot the ticket): re-resolve by
@@ -483,6 +544,7 @@ class ReplicaRouter:
                     b, path, timeout=self.timeout_s)
             except (urllib.error.URLError, OSError, TimeoutError):
                 b.healthy = False
+                self._drop_affinity(b.url)
                 self._gauge_health()
                 self._count("proxy_errors")
                 continue

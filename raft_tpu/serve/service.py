@@ -3077,8 +3077,13 @@ class SweepService:
             # (handed-off ones resolved typed and stay pending in the
             # WAL): MUST be zero — the no-silent-drop gate
             out["replayed_lost_count"] = replayed_open
-            out["restart_warm_start"] = int(
-                any(c == "hit" for c in runners.values()))
+            if recover_info["replayed"]:
+                # warm-start is measurable only when the recovery
+                # actually re-ran work: a fresh boot against an empty
+                # journal (an elastic-fleet scale-up) replays nothing
+                # and must not trip the restart-latency SLO rule
+                out["restart_warm_start"] = int(
+                    any(c == "hit" for c in runners.values()))
             if recover_info.get("mirror"):
                 # this life is a FAILOVER (it folded a foreign mirror
                 # directory): the zero-loss gate gets its own fact so

@@ -698,6 +698,12 @@ PREEMPT_SPEC = {
     "seed": 3, "method": "adam", "gtol": 1e-4,
 }
 
+#: the elastic soak's descent: PREEMPT_SPEC with two extra steps so the
+#: survivor's resume always has a mid-run checkpoint left to WRITE (the
+#: enospc@checkpoint shed gate needs an attempt) even when a fast
+#: replica lands its step-4 checkpoint before the injected kill does
+ELASTIC_SPEC = {**PREEMPT_SPEC, "steps": 8}
+
 
 def preempt_child_main(spec_json: str):
     """Entry point of the to-be-preempted phase (run in a subprocess by
@@ -1142,4 +1148,330 @@ def run_failover(design: str = "Vertical_cylinder", *,
         len(lost), len(mismatches), warm,
         trace_facts["trace_orphan_spans"], trace_facts["trace_count"],
         trace_facts["trace_resume_links"], report["wall_s"])
+    return report
+
+# ---------------------------------------------------------------------------
+# elastic-fleet soak: the autoscaling acceptance harness
+# ---------------------------------------------------------------------------
+
+def run_elastic(design: str = "Vertical_cylinder", *, root: str,
+                min_freq: float = 0.1, max_freq: float = 0.9,
+                dfreq: float = 0.4, checkpoint_every: int = 2,
+                opt_spec: dict = None, n_wave: int = 8,
+                seed: int = 2026, timeout_s: float = 600.0) -> dict:
+    """The elastic-fleet acceptance soak: one
+    :class:`~raft_tpu.serve.fleet.FleetController` over REAL
+    ``raftserve serve`` replica subprocesses, driven through the full
+    lifecycle the controller exists for — six movements over one fleet
+    root:
+
+    1. **clean** (in-process, no fleet): the uninterrupted reference —
+       every ramp case's sweep digest plus the :data:`ELASTIC_SPEC`
+       descent digest (also warms the shared executable cache the
+       replicas boot against).
+    2. **fleet boot**: replica 0 comes up clean under the controller;
+       scale-up survivors are armed with ``enospc@checkpoint:times=2``
+       (the resume-phase storage wave) before they exist.
+    3. **open-loop ramp -> scale-up**: a burst of sweep submissions
+       through the router backs replica 0's queue past the threshold
+       for ``hysteresis_ticks`` consecutive ticks; the controller
+       launches replica 1 (journal + WAL mirror wired) and registers
+       it via the dynamic backend API.
+    4. **preemption wave**: the descent is admitted on replica 0
+       (armed with ``hang@optimize`` so it parks right after its first
+       checkpoint is durable — the kill lands at a known resume point
+       instead of racing the warm step rate); once the
+       step-``checkpoint_every`` checkpoint record lands on the WAL
+       *mirror*, ``kill@fleet:replica=0`` SIGKILLs it from the
+       controller's own tick.  The health sweep detects the death,
+       deregisters the corpse (affinity invalidated), folds the mirror
+       into replica 1 via ``POST /recover``, and the descent resumes
+       there from the newest valid checkpoint while the ENOSPC wave
+       sheds the survivor's first resume checkpoints (typed,
+       digest-neutral).  In-flight sweeps re-resolve by request digest
+       through the router.
+    5. **second ramp -> drained scale-down**: fresh load scales the
+       fleet back to two (replica 2, booted clean); the load drop then
+       drains the highest-index replica through ``/drain`` —
+       deregistered only after the handoff manifest lands.
+    6. **controller recovery**: a fresh
+       :meth:`~raft_tpu.serve.fleet.FleetController.recover_view` over
+       the event journal alone must reproduce the live controller's
+       fleet view bit-for-bit — the proof a SIGKILLed controller
+       reboots into the same fleet.
+
+    The verdict (``report["ok"]``) gates: two scale-ups; exactly one
+    injected kill and one detected preemption with >= 1 WAL fold; the
+    resumed descent's ``resumed_from_step >= checkpoint_every`` and
+    its digest **bit-for-bit equal** to the clean run's
+    (``fleet_preempt_digest_mismatch == 0``, sweep digests included);
+    zero accepted requests lost (``fleet_scale_loss_count == 0``);
+    >= 1 checkpoint shed observed on the survivor; a drained
+    scale-down whose handoff manifest landed before deregistration;
+    and the journal-recovered controller view matching the live one."""
+    import json as _json  # noqa: F401  (parity with sibling soaks)
+
+    from raft_tpu import obs
+    from raft_tpu.serve import journal as wal
+    from raft_tpu.serve.fleet import (FleetConfig, FleetController,
+                                      _http_json)
+    from raft_tpu.testing import faults
+
+    t0 = time.monotonic()
+    root = os.path.abspath(root)
+    every = int(checkpoint_every)
+    opt_spec = dict(opt_spec or ELASTIC_SPEC)
+    n_total = 2 * int(n_wave)
+    fowt = build_fowt(design, min_freq, max_freq, dfreq)
+    Hs, Tp, beta = case_table(n_total, seed=seed)
+    manifest = obs.RunManifest.begin(kind="serve_elastic", config={
+        "design": design, "checkpoint_every": every,
+        "n_requests": n_total, "steps": int(opt_spec["steps"])})
+    status = "failed"
+    ctl = None
+
+    def _until(pred, bound_s: float):
+        limit = min(t0 + timeout_s, time.monotonic() + bound_s)
+        while time.monotonic() < limit:
+            if pred():
+                return True
+            time.sleep(0.1)
+        return bool(pred())
+
+    try:
+        # -- movement 1: clean uninterrupted reference ----------------
+        # segmented exactly like the replicas (same ckpt cadence):
+        # the exec-cache identity of an optimize program includes the
+        # segment facts, so only a segmented clean pass warms the
+        # programs every replica descent will load instead of recompile
+        faults.install("")
+        svc = SweepService(fowt, default_config(
+            batch_cases=4, queue_max=n_total + 2, deadline_s=timeout_s,
+            ckpt_dir=os.path.join(root, "clean-ckpt"),
+            checkpoint_every=every))
+        t_opt = svc.submit_optimize(dict(opt_spec))
+        t_s = [svc.submit(Hs[i], Tp[i], beta[i]) for i in range(n_total)]
+        svc.start()
+        clean_opt = t_opt.result(timeout_s)
+        clean = [t.result(timeout_s) for t in t_s]
+        svc.stop()
+        if not (clean_opt.ok and all(r.ok for r in clean)):
+            raise errors.KernelFailure("elastic soak clean pass failed")
+
+        # -- movement 2: fleet boot -----------------------------------
+        fcfg = FleetConfig(
+            root=root, design=design, min_freq=min_freq,
+            max_freq=max_freq, dfreq=dfreq, batch_cases=4, queue_max=8,
+            deadline_s=timeout_s, nIter=6, tol=0.01, fp_chunk=2,
+            ckpt_dir=os.path.join(root, "ckpt"), checkpoint_every=every,
+            min_replicas=1, max_replicas=2,
+            scale_up_queue_depth=2.0, scale_down_queue_depth=0.0,
+            hysteresis_ticks=2, cooldown_s=1.0, tick_s=0.2,
+            boot_timeout_s=timeout_s, drain_timeout_s=60.0,
+            http_timeout_s=timeout_s,
+            # replica 0 parks its descent right after the step-`every`
+            # checkpoint is durable+mirrored, so the controller-issued
+            # kill below lands at a KNOWN resume point — without the
+            # park, a warm replica outruns the mirror poll + tick and
+            # resumes so close to `steps` that no post-resume
+            # checkpoint write (the shed gate's trigger) remains
+            replica_faults=("hang@optimize:step=%d:s=45:once" % every))
+        ctl = FleetController(fcfg).start()
+        # replica 0 booted parked-on-checkpoint; every LATER replica
+        # boots with the resume-phase storage wave armed instead
+        # (harness knob: the soak turns it off again before the clean
+        # second-ramp replica)
+        ctl.cfg.replica_faults = "enospc@checkpoint:times=2"
+        # hold automatic down-scaling until the preemption movement is
+        # done — the harness's hand on the knob, not a config contract
+        ctl.cfg.scale_down_queue_depth = -1.0
+
+        rids: dict[int, str] = {}
+        replicas_max = len(ctl.live())
+
+        def _submit_case(i):
+            while True:
+                try:
+                    code, body, _ = ctl.router.submit(
+                        {"hs": float(Hs[i]), "tp": float(Tp[i]),
+                         "heading_rad": float(beta[i])})
+                except errors.AdmissionRejected as e:
+                    if time.monotonic() > t0 + timeout_s:
+                        raise
+                    time.sleep(min(1.0, max(0.05, e.retry_after_s)))
+                    continue
+                if code == 202:
+                    rids[i] = body["request_id"]
+                    return
+                if code == 429:
+                    # replica backpressure IS the scale-up signal:
+                    # honor the hint and keep the queue pinned full
+                    if time.monotonic() > t0 + timeout_s:
+                        raise errors.DeadlineExceeded(
+                            "elastic ramp submit timed out", case=i)
+                    time.sleep(0.2)
+                    continue
+                raise errors.KernelFailure(
+                    "elastic ramp submit failed", case=i, code=code)
+
+        # -- movement 3: open-loop ramp -> scale-up -------------------
+        for i in range(n_wave):
+            _submit_case(i)
+            replicas_max = max(replicas_max, len(ctl.live()))
+        _until(lambda: ctl.stats()["scale_ups"] >= 1, 90.0)
+        replicas_max = max(replicas_max, len(ctl.live()))
+        scale_up_fired = ctl.stats()["scale_ups"] >= 1
+        if not scale_up_fired:
+            # the wave must overfill one batch (n_wave > batch_cases +
+            # threshold) or the queue-depth signal never breaches; a
+            # kill below would then hit the only replica — abort loudly
+            raise errors.KernelFailure(
+                "elastic soak ramp did not trigger scale-up",
+                n_wave=int(n_wave),
+                queue_depth_threshold=fcfg.scale_up_queue_depth)
+
+        # -- movement 4: preemption wave ------------------------------
+        rec0 = ctl.replicas.get(0)
+        code, body = _http_json(rec0.url + "/optimize",
+                                {**opt_spec, "wait": False},
+                                timeout=timeout_s)
+        if code != 202:
+            raise errors.KernelFailure(
+                "elastic soak optimize admission failed", code=code)
+        opt_rid = body["request_id"]
+        # wait for the step-`every` checkpoint record to land on the
+        # WAL *mirror* — the "network disk" the survivor will fold
+        _until(lambda: len(wal.replay(rec0.mirror_dir)["ckpts"]) >= 1,
+               180.0)
+        ckpts_on_mirror = len(wal.replay(rec0.mirror_dir)["ckpts"])
+        faults.install("kill@fleet:replica=0")
+        _until(lambda: ctl.stats()["preemptions"] >= 1, 60.0)
+        faults.install("")
+        preempted = ctl.stats()["preemptions"]
+        surv = next(iter(ctl.live()), None)
+        if surv is None:
+            raise errors.KernelFailure(
+                "elastic soak lost every replica")
+        opt_body = {}
+
+        def _opt_done():
+            try:
+                c, doc = _http_json(
+                    surv.url + "/result?id=" + opt_rid, timeout=10.0)
+            except (OSError, ValueError, TimeoutError):
+                return False
+            if c == 200:
+                opt_body.update(doc)
+            return c == 200
+
+        _until(_opt_done, 240.0)
+        prov = ((opt_body.get("extra") or {}).get("provenance") or {})
+        resumed_from = int(prov.get("resumed_from_step") or 0)
+        opt_mismatch = int(not opt_body.get("ok")
+                           or opt_body.get("digest") != clean_opt.digest)
+        _c, sdoc = _http_json(surv.url + "/stats", timeout=30.0)
+        ckpt_shed = int(sdoc.get("ckpt_shed") or 0)
+
+        # -- movement 5: second ramp -> drained scale-down ------------
+        ctl.cfg.replica_faults = ""
+        for i in range(n_wave, n_total):
+            _submit_case(i)
+            replicas_max = max(replicas_max, len(ctl.live()))
+        _until(lambda: ctl.stats()["scale_ups"] >= 2, 90.0)
+        replicas_max = max(replicas_max, len(ctl.live()))
+        results: dict[int, dict] = {}
+
+        def _collect_all():
+            for i, rid in rids.items():
+                if i in results:
+                    continue
+                c, doc = ctl.router.result(rid=rid)
+                if c == 200:
+                    results[i] = doc
+            return len(results) == len(rids)
+
+        _until(_collect_all, 240.0)
+        ctl.cfg.scale_down_queue_depth = 0.0
+        _until(lambda: ctl.stats()["scale_downs"] >= 1, 120.0)
+        scale_down_fired = ctl.stats()["scale_downs"] >= 1
+        events = FleetController.read_events(root)
+        handoff_landed = any(e.get("type") == "handoff_landed"
+                             and e.get("landed") for e in events)
+
+        # -- movement 6: accounting + controller-view recovery --------
+        live_idx = sorted(r.index for r in ctl.live())
+        cstats = ctl.stats()
+        view = FleetController.recover_view(root)
+        controller_view_ok = (
+            sorted(view["live"]) == live_idx
+            and all(view[k] == cstats[k]
+                    for k in ("scale_ups", "scale_downs",
+                              "preemptions", "folds")))
+        mismatches = [i for i, r in results.items()
+                      if not r.get("ok")
+                      or r.get("digest") != clean[i].digest]
+        lost = sorted(i for i in rids if i not in results)
+        mismatch_count = len(mismatches) + opt_mismatch
+        facts = {
+            "fleet_scale_loss_count": len(lost),
+            "fleet_preempt_digest_mismatch": mismatch_count,
+            "fleet_scale_ups": cstats["scale_ups"],
+            "fleet_scale_downs": cstats["scale_downs"],
+            "fleet_preemptions": cstats["preemptions"],
+            "fleet_folds": cstats["folds"],
+            "fleet_kills_injected": cstats["kills_injected"],
+            "fleet_handoffs": cstats["handoffs"],
+            "fleet_replicas_max": replicas_max,
+            "fleet_ckpt_shed": ckpt_shed,
+            "fleet_resumed_from_step": resumed_from,
+        }
+        manifest.extra["fleet"] = facts
+        report = {
+            "fleet": facts,
+            "n_requests": len(rids), "completed": len(results),
+            "lost": lost, "digest_mismatches": mismatches,
+            "min_replicas": fcfg.min_replicas,
+            "max_replicas": fcfg.max_replicas,
+            "ckpts_on_mirror_at_kill": ckpts_on_mirror,
+            "resumed_digest": opt_body.get("digest"),
+            "clean_digest": clean_opt.digest,
+            "controller_view_ok": controller_view_ok,
+            "handoff_landed": handoff_landed,
+            "events": len(events),
+            "wall_s": time.monotonic() - t0,
+            "ok": (scale_up_fired
+                   and cstats["scale_ups"] >= 2
+                   and preempted == 1
+                   and cstats["kills_injected"] == 1
+                   and cstats["folds"] >= 1
+                   and resumed_from >= every > 0
+                   and mismatch_count == 0
+                   and not lost and len(rids) == n_total
+                   and ckpt_shed >= 1
+                   and scale_down_fired and handoff_landed
+                   and controller_view_ok
+                   and replicas_max == fcfg.max_replicas),
+        }
+        status = "ok" if report["ok"] else "failed"
+    finally:
+        faults.clear()
+        if ctl is not None:
+            ctl.stop(drain=True)
+        obs.finish_run(manifest, status=status)
+    fl = report["fleet"]
+    lvl = _LOG.info if report["ok"] else _LOG.error
+    lvl("elastic soak: %s — replicas max=%d, ups=%d downs=%d "
+        "preemptions=%d folds=%d, %d/%d digest-exact (%d lost), "
+        "descent resumed from step %d digest %s, ckpt sheds=%d, "
+        "handoff landed=%s, controller view %s, %.1fs",
+        "OK" if report["ok"] else "FAILED", fl["fleet_replicas_max"],
+        fl["fleet_scale_ups"], fl["fleet_scale_downs"],
+        fl["fleet_preemptions"], fl["fleet_folds"],
+        report["completed"], report["n_requests"], len(report["lost"]),
+        fl["fleet_resumed_from_step"],
+        "MATCH" if not fl["fleet_preempt_digest_mismatch"]
+        else "MISMATCH", fl["fleet_ckpt_shed"],
+        report["handoff_landed"],
+        "recovered" if report["controller_view_ok"] else "DIVERGED",
+        report["wall_s"])
     return report

@@ -21,6 +21,7 @@ replica   ``serve.replica`` WAL mirroring to peer stores
 resultstore  ``serve.resultstore`` content-addressed result reads
 optimize  ``parallel.optimize`` segment loop (host-side, per segment)
 checkpoint  ``serve.checkpoint`` descent/sweep checkpoint store
+fleet     ``serve.fleet`` controller tick (replica preemption)
 ========  ==========================================================
 
 Spec grammar (comma-separated specs)::
@@ -30,8 +31,8 @@ Spec grammar (comma-separated specs)::
     action     nan | raise | corrupt | hang | kill | torn | drop | lag
                | stale | enospc | eio
     qualifier  case=N | lane=N | fowt=N | req=N | part=N | entry=HEX
-               | step=N | once | times=K | s=SECONDS | ms=MILLIS
-               (hang/lag duration)
+               | step=N | replica=N | once | times=K | s=SECONDS
+               | ms=MILLIS (hang/lag duration)
 
 Examples: ``nan@dynamics:case=2`` poisons case 2's converged impedance
 with NaN (exercising the non-finite sanitizer and the ladder);
@@ -65,7 +66,7 @@ _ACTIONS = ("nan", "raise", "corrupt", "hang", "kill", "torn", "drop",
             "lag", "stale", "enospc", "eio")
 _SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache",
           "serve", "journal", "replica", "resultstore", "optimize",
-          "checkpoint")
+          "checkpoint", "fleet")
 
 #: exception class raised per site for ``raise@<site>`` specs.  Site/
 #: action support: statics, dynamics, kernel take ``nan`` and ``raise``;
@@ -99,10 +100,14 @@ _SITES = ("statics", "dynamics", "kernel", "sweep", "exec_cache",
 #: ``entry=`` matches the bare hex stem of the request digest
 #: (digest strings carry a ``:`` which the qualifier grammar reserves);
 #: optimize (the host-side segment loop in raft_tpu/parallel/
-#: optimize.py) takes ``kill`` only (``kill@optimize:step=N``
-#: hard-exits the process at the segment boundary whose cumulative
-#: step count is N — the TPU-VM preemption the checkpoint/resume layer
-#: recovers from); checkpoint (the descent/sweep checkpoint store in
+#: optimize.py) takes ``kill`` (``kill@optimize:step=N`` hard-exits
+#: the process at the segment boundary whose cumulative step count is
+#: N — the TPU-VM preemption the checkpoint/resume layer recovers
+#: from) and ``hang`` (``hang@optimize:step=N:s=S`` stalls the loop at
+#: the same boundary AFTER step N's checkpoint is durable+mirrored, so
+#: an external preemption — e.g. the elastic soak's controller-issued
+#: ``kill@fleet`` — lands at a known resume point instead of racing
+#: the descent); checkpoint (the descent/sweep checkpoint store in
 #: raft_tpu/serve/checkpoint.py) takes ``corrupt`` (damage the raw
 #: checkpoint bytes pre-sidecar-check — resume must fall back one
 #: segment, counted), ``enospc`` (write-side exhaustion -> typed
@@ -120,9 +125,10 @@ _RAISES = {
 #: (action, site) combinations with no seam behavior — dropped at parse
 #: time so a spec can never silently no-op while consuming fire budget.
 #: ``kill`` (hard ``os._exit`` mid-batch — the crash the write-ahead
-#: journal must survive) is a serve-only action, like ``hang``; ``torn``
-#: (truncate the last journal record mid-write) is journal-only, and
-#: the journal site takes nothing else.
+#: journal must survive) and ``hang`` live at the serve request worker
+#: and the optimize segment loop only; ``torn`` (truncate the last
+#: journal record mid-write) is journal-only, and the journal site
+#: takes nothing else.
 _UNSUPPORTED = {("raise", "exec_cache"), ("corrupt", "statics"),
                 ("corrupt", "dynamics"), ("corrupt", "kernel"),
                 ("corrupt", "sweep"), ("corrupt", "serve"),
@@ -131,11 +137,15 @@ _UNSUPPORTED = {("raise", "exec_cache"), ("corrupt", "statics"),
                 ("hang", "statics"), ("hang", "dynamics"),
                 ("hang", "kernel"), ("hang", "sweep"),
                 ("hang", "exec_cache")}
-# kill hard-exits a host loop: the serve request worker (mid-batch)
-# and the optimize segment loop (mid-descent, kill@optimize:step=N —
-# the preemption the checkpoint/resume layer recovers from)
+# kill hard-exits a host loop: the serve request worker (mid-batch),
+# the optimize segment loop (mid-descent, kill@optimize:step=N — the
+# preemption the checkpoint/resume layer recovers from), and the fleet
+# controller tick (kill@fleet:replica=N — SIGKILL the Nth spawned
+# replica subprocess: the preemption wave the elastic soak composes).
+# The fleet site takes nothing but kill.
 _UNSUPPORTED |= {("kill", s) for s in _SITES
-                 if s not in ("serve", "optimize")}
+                 if s not in ("serve", "optimize", "fleet")}
+_UNSUPPORTED |= {(a, "fleet") for a in _ACTIONS if a != "kill"}
 _UNSUPPORTED |= {("torn", s) for s in _SITES if s != "journal"}
 # the journal write seam takes torn (truncate the fresh record) and
 # enospc (a full disk under the WAL: counted durability gap + a
@@ -163,7 +173,8 @@ _UNSUPPORTED |= {("enospc", s) for s in _SITES
                               "checkpoint")}
 _UNSUPPORTED |= {("eio", s) for s in _SITES
                  if s not in ("resultstore", "checkpoint")}
-_UNSUPPORTED |= {(a, "optimize") for a in _ACTIONS if a != "kill"}
+_UNSUPPORTED |= {(a, "optimize") for a in _ACTIONS
+                 if a not in ("kill", "hang")}
 _UNSUPPORTED |= {(a, "checkpoint") for a in _ACTIONS
                  if a not in ("corrupt", "enospc", "eio")}
 

@@ -164,15 +164,21 @@ def test_enospc_and_budget_raise_typed_storage_exhausted(tmp_path):
 def test_storage_fault_grammar():
     ok = ["enospc@journal", "enospc@resultstore", "enospc@exec_cache",
           "enospc@checkpoint", "eio@resultstore", "eio@checkpoint",
-          "kill@optimize:step=4", "corrupt@checkpoint:step=2:once"]
+          "kill@optimize:step=4", "corrupt@checkpoint:step=2:once",
+          "hang@optimize:step=2:s=45:once"]
     for s in ok:
         assert faults.parse(s), s
     assert faults.parse("kill@optimize:step=4")[0]["match"] == \
         {"step": 4}
+    # hang parks the segment loop post-checkpoint: the duration is a
+    # fault fact, never a match key (the elastic soak relies on both)
+    f = faults.parse("hang@optimize:step=2:s=45:once")[0]
+    assert f["match"] == {"step": 2} and f["hang_s"] == 45.0 \
+        and f["times"] == 1
     # unsupported combos are rejected at parse time, like kill/torn
     bad = ["enospc@serve", "enospc@statics", "eio@journal",
            "eio@exec_cache", "kill@checkpoint", "corrupt@optimize",
-           "stale@checkpoint", "hang@optimize", "torn@checkpoint"]
+           "stale@checkpoint", "hang@checkpoint", "torn@checkpoint"]
     for s in bad:
         assert not faults.parse(s), s
 

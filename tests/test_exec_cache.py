@@ -96,6 +96,44 @@ def test_store_load_roundtrip(tmp_path, monkeypatch):
     assert st["misses"] == 1 and st["stores"] == 1 and st["hits"] == 1
 
 
+def test_store_program_with_optax_state_args(tmp_path, monkeypatch):
+    """Regression (found by the elastic-fleet soak): a program whose
+    example args carry optax optimizer states — plain NamedTuples
+    ``jax.export`` refuses to serialize unregistered — silently failed
+    every store (counted as ``error``), so every warm process recompiled
+    the descent from scratch.  ``register_export_types`` walks the args
+    and registers them; the store must succeed and the loaded executable
+    must reproduce the jitted numbers."""
+    import optax
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE_DIR", str(tmp_path))
+    exec_cache.reset_stats()
+    opt = optax.adam(0.1)
+    x = jnp.arange(4.0)
+    state = opt.init(x)
+    g = jnp.ones(4)
+
+    def step(carry, grad):
+        xx, st = carry
+        upd, st = opt.update(grad, st)
+        return (optax.apply_updates(xx, upd), st)
+
+    fn = jax.jit(step)
+    assert exec_cache.register_export_types(((x, state), g)) > 0
+    # second walk is a no-op, never a re-registration error
+    assert exec_cache.register_export_types(((x, state), g)) == 0
+    key = exec_cache.make_key(fn="toy_opt", shape=str(x.shape))
+    assert exec_cache.store(fn, ((x, state), g), key) is not None
+    exe = exec_cache.load(key)
+    assert exe is not None
+    got = exe.call((x, state), g)
+    want = fn((x, state), g)
+    np.testing.assert_array_equal(np.asarray(got[0]),
+                                  np.asarray(want[0]))
+    st = exec_cache.stats()
+    assert st["errors"] == 0 and st["stores"] == 1 and st["hits"] == 1
+
+
 def test_cross_process_warm_start_survives_and_matches(tmp_path,
                                                        monkeypatch):
     """Regression (found by the PR 9 serving chaos work): a process

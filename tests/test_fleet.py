@@ -1,0 +1,506 @@
+"""Elastic fleet (raft_tpu/serve/fleet.py + router dynamic membership).
+
+Unit tier (stub replicas, no solves): the ``kill@fleet:replica=N``
+fault grammar (parse-time rejection of every other action on the fleet
+site), the router's dynamic ``add_backend``/``remove_backend`` API
+(registration mid-storm, removal with in-flight failover, affinity
+invalidation on removal AND on a failed proxy — the regression that
+motivated it), ``FleetConfig`` validation, the whole control loop
+driven deterministically through ``tick()`` against in-process stub
+replicas (hysteresis, cooldown, drain/handoff scale-down, preemption
+detection + the WAL-mirror fold into a survivor, the injected kill
+seam), the torn-tail-tolerant event journal and the
+``recover_view`` controller-crash replay, and the elastic trend-store
+facts + zero-tolerance SLO rules.
+
+The end-to-end choreography — real ``raftserve serve`` subprocesses,
+checkpoint-resumable descents preempted mid-flight, digest parity —
+lives in :func:`raft_tpu.serve.soak.run_elastic` (CI "Elastic chaos").
+"""
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from raft_tpu import errors
+from raft_tpu.obs import trendstore
+from raft_tpu.serve import ReplicaRouter, fleet
+from raft_tpu.serve import journal as wal
+from raft_tpu.testing import faults
+
+from test_serve_replication import _StubReplica
+
+
+# ---------------------------------------------------------------------------
+# unit: the kill@fleet fault grammar
+# ---------------------------------------------------------------------------
+
+def test_faults_fleet_kill_grammar():
+    specs = faults.parse("kill@fleet:replica=1,kill@fleet")
+    assert [(f["action"], f["site"]) for f in specs] == \
+        [("kill", "fleet"), ("kill", "fleet")]
+    assert specs[0]["match"] == {"replica": 1}
+    # the fleet site takes NOTHING but kill: every other action is
+    # rejected at parse time, never at fire time
+    assert faults.parse(
+        "nan@fleet,raise@fleet,hang@fleet,corrupt@fleet,torn@fleet,"
+        "drop@fleet,lag@fleet,enospc@fleet,eio@fleet,stale@fleet") == []
+    # a composed chaos wave keeps only its supported members
+    wave = faults.parse(
+        "enospc@checkpoint:times=2,kill@fleet:replica=0,nan@fleet")
+    assert [(f["action"], f["site"]) for f in wave] == \
+        [("enospc", "checkpoint"), ("kill", "fleet")]
+    # fire_info matches on the replica index and honors once
+    faults.install("kill@fleet:replica=1:once")
+    try:
+        assert faults.fire_info("fleet", action="kill",
+                                replica=0) is None
+        f = faults.fire_info("fleet", action="kill", replica=1)
+        assert f is not None and f["action"] == "kill"
+        assert faults.fire_info("fleet", action="kill",
+                                replica=1) is None
+    finally:
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# unit: router dynamic membership (the fleet controller's API)
+# ---------------------------------------------------------------------------
+
+def test_router_dynamic_add_remove():
+    a, b = _StubReplica("A"), _StubReplica("B")
+    router = ReplicaRouter([a.url], health_interval_s=30.0)
+    router.check_now()
+    try:
+        assert set(router.stats()["backends"]) == {a.url}
+        # a duplicate registration is a typed config error
+        with pytest.raises(errors.ModelConfigError):
+            router.add_backend(a.url)
+        # scale-up: the new member is probed and live immediately —
+        # no waiting out a health-sweep interval
+        router.add_backend(b.url)
+        st = router.stats()
+        assert set(st["backends"]) == {a.url, b.url}
+        assert st["backends"][b.url]["healthy"]
+        assert st["healthy"] == 2
+        # removing an unknown url is a no-op, not an error
+        assert router.remove_backend("http://127.0.0.1:1") is False
+        # scale-down: the member leaves the live set at once
+        assert router.remove_backend(b.url) is True
+        assert set(router.stats()["backends"]) == {a.url}
+        assert router.stats()["healthy"] == 1
+    finally:
+        router.stop()
+        a.shutdown()
+        b.shutdown()
+
+
+def test_router_affinity_invalidated_on_removal_and_dead_pin():
+    """Regression: a tenant pinned to a replica that is removed — or
+    that dies mid-submit — must not keep leading with the corpse,
+    paying a connect-timeout per request until the next health sweep.
+    Both invalidation paths move the pin to the survivor."""
+    a, b = _StubReplica("A"), _StubReplica("B")
+    router = ReplicaRouter([a.url, b.url], health_interval_s=30.0)
+    router.check_now()
+    stubs = {a.url: a, b.url: b}
+    try:
+        code, body, _ = router.submit({"hs": 2.0, "tp": 9.0,
+                                       "tenant": "t"})
+        assert code == 202
+        pinned = body["replica"]
+        assert router.stats()["affinity"]["t"] == pinned
+        # planned removal purges the pin in the same critical section
+        assert router.remove_backend(pinned) is True
+        assert "t" not in router.stats()["affinity"]
+        surv = a.url if pinned == b.url else b.url
+        code2, body2, _ = router.submit({"hs": 2.5, "tp": 9.0,
+                                         "tenant": "t"})
+        assert code2 == 202 and body2["replica"] == surv
+        assert router.stats()["affinity"]["t"] == surv
+        # re-register the removed member (its stub never died), then
+        # kill the CURRENT pin without telling the router: the same
+        # submit fails over and the pin moves — no corpse-leading
+        router.add_backend(pinned)
+        stubs[surv].shutdown()
+        code3, body3, _ = router.submit({"hs": 3.0, "tp": 9.0,
+                                         "tenant": "t"})
+        assert code3 == 202 and body3["replica"] == pinned
+        st = router.stats()
+        assert st["failovers"] == 1
+        assert st["affinity"]["t"] == pinned
+        assert surv not in set(st["affinity"].values())
+    finally:
+        router.stop()
+        a.shutdown()
+        b.shutdown()
+
+
+def test_router_registration_mid_storm():
+    """``add_backend`` lands while four writers storm the router: no
+    request errors, every submit 202, and the new member takes a share
+    of the traffic the moment it registers (copy-on-write backend
+    list — in-flight iterations never see a torn list)."""
+    a = _StubReplica("A")
+    router = ReplicaRouter([a.url], default_quota=(10000.0, 10000.0),
+                           health_interval_s=30.0)
+    router.check_now()
+    b = _StubReplica("B")
+    codes, errs = [], []
+    stop_evt = threading.Event()
+
+    def storm(k):
+        i = 0
+        while not stop_evt.is_set():
+            i += 1
+            try:
+                code, _, _ = router.submit(
+                    {"hs": 2.0, "tp": 9.0, "tenant": f"w{k}-{i}"})
+                codes.append(code)
+            except Exception as e:            # noqa: BLE001 — recorded
+                errs.append(e)
+                return
+    threads = [threading.Thread(target=storm, args=(k,))
+               for k in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        router.add_backend(b.url)             # registration mid-storm
+        time.sleep(0.3)
+    finally:
+        stop_evt.set()
+        for t in threads:
+            t.join(10.0)
+    try:
+        assert not errs
+        assert codes and set(codes) == {202}
+        assert router.stats()["backends"][b.url]["healthy"]
+        # fresh (unpinned) tenants round-robin across the live set, so
+        # the late joiner served real traffic
+        assert b.nsub >= 1
+        assert a.nsub + b.nsub == len(codes)
+    finally:
+        router.stop()
+        a.shutdown()
+        b.shutdown()
+
+
+def test_router_removal_with_inflight_failover():
+    """A replica dies holding tracked in-flight work; ``result(rid)``
+    re-resolves by request digest against the survivor; deregistering
+    the corpse afterwards leaves the tracked ticket answering."""
+    a, b = _StubReplica("A"), _StubReplica("B")
+    router = ReplicaRouter([a.url, b.url], health_interval_s=30.0)
+    router.check_now()
+    try:
+        code, body, _ = router.submit({"hs": 2.0, "tp": 9.0,
+                                       "tenant": "t"})
+        assert code == 202
+        rid = body["request_id"]
+        owner = a if body["replica"] == a.url else b
+        surv = b if owner is a else a
+        surv.by_rdigest.update(owner.by_rdigest)  # mirror replayed
+        owner.shutdown()
+        router.check_now()
+        code2, got = router.result(rid=rid)
+        assert code2 == 200 and got["replica"] == surv.url
+        assert router.stats()["reresolved"] == 1
+        # the controller now deregisters the corpse (preemption path):
+        # the ticket keeps answering from the survivor
+        assert router.remove_backend(owner.url) is True
+        code3, got3 = router.result(rid=rid)
+        assert code3 == 200 and got3["replica"] == surv.url
+        st = router.stats()
+        assert set(st["backends"]) == {surv.url}
+        assert st["reresolved"] == 2
+    finally:
+        router.stop()
+        a.shutdown()
+        b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# unit: the fleet controller against stub replicas
+# ---------------------------------------------------------------------------
+
+class _FleetStub:
+    """raftserve-shaped replica for FleetController tests: ``/healthz``
+    with a controllable queue depth, ``/drain`` writing the handoff
+    manifest, ``/recover`` recording the WAL fold."""
+
+    def __init__(self, index, host, port, journal_dir, mirror_dir):
+        self.index = index
+        self.journal_dir = journal_dir
+        self.mirror_dir = mirror_dir
+        self.depth = 0
+        self.pending = 0
+        self.drained = False
+        self.recovers = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, doc):
+                data = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {"ok": True,
+                                     "queue_depth": outer.depth})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/drain":
+                    outer.drained = True
+                    os.makedirs(outer.journal_dir, exist_ok=True)
+                    with open(os.path.join(outer.journal_dir,
+                                           "handoff.json"), "w") as f:
+                        json.dump({"pending": outer.pending}, f)
+                    self._send(200, {"ok": True,
+                                     "pending": outer.pending})
+                elif self.path == "/recover":
+                    outer.recovers.append(doc.get("journal_dir"))
+                    self._send(200, {"recovered": 1, "replayed": 1,
+                                     "deduped": 0})
+                else:
+                    self._send(404, {"error": "not found"})
+
+        self.srv = ThreadingHTTPServer((host, port), H)
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://{host}:{port}"
+        self._down = False
+
+    def shutdown(self):
+        if not self._down:
+            self._down = True
+            self.srv.shutdown()
+            self.srv.server_close()
+
+
+class _FakeProc:
+    """Popen-shaped handle whose ``kill()`` downs the stub's server —
+    the subprocess death and the HTTP death arrive together, exactly
+    like a SIGKILLed replica."""
+
+    def __init__(self, stub):
+        self.stub = stub
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def kill(self):
+        if self.returncode is None:
+            self.returncode = -9
+            self.stub.shutdown()
+
+    def wait(self, timeout=None):
+        if self.returncode is None:
+            self.returncode = 0
+            self.stub.shutdown()
+        return self.returncode
+
+
+def _stub_fleet(cfg):
+    stubs = {}
+
+    def launcher(index, port, journal_dir, mirror_dir):
+        stub = _FleetStub(index, cfg.host, port, journal_dir,
+                          mirror_dir)
+        stubs[index] = stub
+        return stub.url, 100000 + index, _FakeProc(stub)
+
+    return fleet.FleetController(cfg, launcher=launcher), stubs
+
+
+def test_fleet_config_validation(tmp_path):
+    fleet.FleetConfig(root=str(tmp_path))          # defaults are legal
+    with pytest.raises(errors.ModelConfigError) as exc:
+        fleet.FleetConfig(root=" ", min_replicas=0, max_replicas=-1,
+                          tick_s=0.0)
+    fields = exc.value.ctx["fields"]
+    for name in ("root", "min_replicas", "max_replicas", "tick_s"):
+        assert name in fields
+    # scale-down threshold must sit strictly below scale-up
+    with pytest.raises(errors.ModelConfigError) as exc:
+        fleet.FleetConfig(root=str(tmp_path), scale_up_queue_depth=2.0,
+                          scale_down_queue_depth=2.0)
+    assert "scale_down_queue_depth" in exc.value.ctx["fields"]
+
+
+def test_fleet_scale_cycle_hysteresis_cooldown_and_recover_view(
+        tmp_path):
+    """The planned half of the lifecycle, tick by tick: hysteresis
+    holds one breaching tick, the second scales up; cooldown holds a
+    persisting breach; two idle ticks retire the newest member through
+    ``/drain`` with the handoff manifest landing BEFORE deregistration
+    and its leftover pending work folded into the survivor; and the
+    event journal replays the whole view — torn tail included."""
+    root = str(tmp_path / "fleet")
+    cfg = fleet.FleetConfig(
+        root=root, min_replicas=1, max_replicas=3,
+        scale_up_queue_depth=4.0, scale_down_queue_depth=0.0,
+        hysteresis_ticks=2, cooldown_s=0.0, tick_s=0.05,
+        boot_timeout_s=10.0, drain_timeout_s=5.0)
+    ctl, stubs = _stub_fleet(cfg)
+    ctl.start(run_loop=False)
+    try:
+        assert [r.index for r in ctl.live()] == [0]
+        assert set(ctl.router.stats()["backends"]) == {stubs[0].url}
+        # one breaching tick is streak 1 of 2: hysteresis holds
+        stubs[0].depth = 9
+        ctl.tick()
+        assert len(ctl.live()) == 1 and ctl.stats()["scale_ups"] == 0
+        ctl.tick()
+        assert len(ctl.live()) == 2
+        st = ctl.stats()
+        assert st["scale_ups"] == 1
+        assert st["signals"]["queue_depth"] == 9
+        assert set(ctl.router.stats()["backends"]) == \
+            {stubs[0].url, stubs[1].url}
+        # cooldown: the breach persists but the controller holds
+        ctl.cfg.cooldown_s = 3600.0
+        stubs[1].depth = 9
+        for _ in range(3):
+            ctl.tick()
+        assert ctl.stats()["scale_ups"] == 1 and len(ctl.live()) == 2
+        # idle: two quiet ticks retire the newest member via drain;
+        # its handoff leaves pending work behind, so its WAL folds
+        # into the survivor before the victim is forgotten
+        ctl.cfg.cooldown_s = 0.0
+        stubs[0].depth = stubs[1].depth = 0
+        stubs[1].pending = 2
+        os.makedirs(stubs[1].journal_dir, exist_ok=True)
+        open(wal.journal_path(stubs[1].journal_dir), "w").close()
+        ctl.tick()
+        assert ctl.stats()["scale_downs"] == 0
+        ctl.tick()
+        st = ctl.stats()
+        assert st["scale_downs"] == 1 and st["folds"] == 1
+        assert [r.index for r in ctl.live()] == [0]
+        assert stubs[1].drained
+        assert os.path.exists(os.path.join(stubs[1].journal_dir,
+                                           "handoff.json"))
+        assert stubs[0].recovers == [stubs[1].journal_dir]
+        assert set(ctl.router.stats()["backends"]) == {stubs[0].url}
+        # the journal replays the controller's exact view
+        view = fleet.FleetController.recover_view(root)
+        assert sorted(view["live"]) == [0]
+        assert view["scale_ups"] == 1 and view["scale_downs"] == 1
+        assert view["replicas"][1]["state"] == "retired"
+        assert view["next_index"] == 2
+        types = [e["type"] for e in
+                 fleet.FleetController.read_events(root)]
+        for t in ("replica_launched", "scale_up", "drain_started",
+                  "handoff_landed", "fold_completed", "scale_down",
+                  "replica_retired"):
+            assert t in types
+    finally:
+        counts = ctl.stop(drain=True)
+        for s in stubs.values():
+            s.shutdown()
+    assert counts["scale_ups"] == 1 and counts["scale_downs"] == 1
+    # a torn tail (the controller died mid-write) never breaks replay
+    with open(os.path.join(root, fleet.EVENTS_NAME), "ab") as f:
+        f.write(b'{"kind": "fleet_event", "type": "scale_u')
+    view = fleet.FleetController.recover_view(root)
+    assert view["live"] == {}                 # shutdown retired them
+    assert view["scale_ups"] == 1 and view["scale_downs"] == 1
+
+
+def test_fleet_preemption_fold_and_kill_seam(tmp_path):
+    """The unplanned half: ``kill@fleet:replica=N`` matches ONLY its
+    index; the matching kill downs the sole replica, the sweep detects
+    it, a replacement boots, and the dead member's WAL mirror folds
+    into it via ``POST /recover`` — then the journal replays it all."""
+    root = str(tmp_path / "fleet")
+    cfg = fleet.FleetConfig(
+        root=root, min_replicas=1, max_replicas=2,
+        hysteresis_ticks=2, cooldown_s=0.0, tick_s=0.05,
+        boot_timeout_s=10.0, drain_timeout_s=5.0)
+    ctl, stubs = _stub_fleet(cfg)
+    ctl.start(run_loop=False)
+    try:
+        rec0 = ctl.replicas[0]
+        os.makedirs(rec0.mirror_dir, exist_ok=True)
+        open(wal.journal_path(rec0.mirror_dir), "w").close()
+        # a non-matching index must not touch the fleet
+        faults.install("kill@fleet:replica=5")
+        ctl.tick()
+        assert ctl.stats()["kills_injected"] == 0
+        assert [r.index for r in ctl.live()] == [0]
+        # the matching spec is the preemption wave
+        faults.install("kill@fleet:replica=0:once")
+        ctl.tick()
+        st = ctl.stats()
+        assert st["kills_injected"] == 1
+        assert st["preemptions"] == 1 and st["folds"] == 1
+        assert [r.index for r in ctl.live()] == [1]
+        assert stubs[1].recovers == [rec0.mirror_dir]
+        assert set(ctl.router.stats()["backends"]) == {stubs[1].url}
+        # quiet follow-up ticks change nothing (once burned its budget)
+        ctl.tick()
+        assert ctl.stats()["kills_injected"] == 1
+        view = fleet.FleetController.recover_view(root)
+        assert view["preemptions"] == 1 and view["folds"] == 1
+        assert sorted(view["live"]) == [1]
+        assert view["replicas"][0]["state"] == "preempted"
+    finally:
+        faults.clear()
+        ctl.stop(drain=True)
+        for s in stubs.values():
+            s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# unit: elastic trend facts + the zero-tolerance SLO rules
+# ---------------------------------------------------------------------------
+
+def test_trendstore_fleet_facts_and_slo_rules():
+    doc = {"kind": "serve_elastic", "extra": {"fleet": {
+        "fleet_scale_loss_count": 0,
+        "fleet_preempt_digest_mismatch": 0,
+        "fleet_scale_ups": 2, "fleet_scale_downs": 1,
+        "fleet_preemptions": 1, "fleet_folds": 1,
+        "fleet_kills_injected": 1, "fleet_handoffs": 2,
+        "fleet_replicas_max": 2, "fleet_ckpt_shed": 2,
+        "fleet_resumed_from_step": 4}}}
+    facts = trendstore.facts_from_manifest(doc)
+    for k, v in doc["extra"]["fleet"].items():
+        assert facts[k] == v
+    # a non-numeric value never becomes a fact
+    bad = trendstore.facts_from_manifest(
+        {"extra": {"fleet": {"fleet_folds": "nope"}}})
+    assert "fleet_folds" not in bad
+    # both elastic rules are committed, zero-tolerance
+    rules = {r["name"]: r for r in trendstore.DEFAULT_SLO_RULES}
+    for name in ("fleet_scale_loss_count",
+                 "fleet_preempt_digest_mismatch"):
+        assert rules[name]["op"] == "<=" \
+            and rules[name]["threshold"] == 0.0
+    # the zero-loss gate fails the moment a request is lost
+    row = {"kind": "serve_elastic", "created_at": "2026-01-01",
+           "status": "ok",
+           "facts": {"fleet_scale_loss_count": 1,
+                     "fleet_preempt_digest_mismatch": 0}}
+    rep = trendstore.evaluate_slo([row])
+    by_name = {r["name"]: r for r in rep["results"]}
+    assert not by_name["fleet_scale_loss_count"]["ok"]
+    assert not by_name["fleet_scale_loss_count"]["skipped"]
+    assert by_name["fleet_preempt_digest_mismatch"]["ok"]
+    assert not by_name["fleet_preempt_digest_mismatch"]["skipped"]
+    assert not rep["ok"]

@@ -773,6 +773,9 @@ def test_bench_serve_open_loop_facts(tmp_path, monkeypatch):
     assert 0.0 < rep["batch_fill_ratio"] <= 1.0
     assert rep["admission_p99_s"] >= rep["admission_p50_s"] >= 0.0
     assert rep["cases_per_min"] > 0
+    # the fleet controller's input signals, measured under this load
+    assert rep["queue_depth_p99"] >= rep["queue_depth_p50"] >= 0
+    assert rep["quota_pressure"] == 0.0        # nothing shed
     from raft_tpu.obs import trendstore as T
     rows = T.TrendStore(str(tmp_path / "t.sqlite")).rows(
         kind="bench_serve")
@@ -780,6 +783,8 @@ def test_bench_serve_open_loop_facts(tmp_path, monkeypatch):
     assert facts["serve_cases_per_min"] == rep["cases_per_min"]
     assert facts["serve_batch_fill_ratio"] == rep["batch_fill_ratio"]
     assert facts["serve_admission_p99_s"] == rep["admission_p99_s"]
+    assert facts["serve_queue_depth_p99"] == rep["queue_depth_p99"]
+    assert facts["serve_quota_pressure"] == rep["quota_pressure"]
 
 
 # ---------------------------------------------------------------------------

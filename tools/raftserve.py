@@ -75,6 +75,32 @@ Subcommands::
         corrupt bytes are ever served, and every digest (warm starts
         included) is bit-for-bit identical to the clean run.
 
+    raftserve soak --elastic --journal-dir DIR
+        Elastic-fleet soak (raft_tpu/serve/fleet.py): a
+        FleetController boots real replica subprocesses under an
+        open-loop load ramp — scale-up past the queue-depth threshold,
+        a kill@fleet:replica=0 preemption wave whose WAL mirror is
+        folded into a survivor via POST /recover (its accepted descent
+        resumes from the newest valid checkpoint while
+        enospc@checkpoint sheds the survivor's next checkpoint
+        writes), load drop, then a drained scale-down that deregisters
+        only after the handoff manifest lands; exits nonzero unless
+        zero accepted requests were lost, every digest (the resumed
+        descent's included) is bit-for-bit identical to an
+        uninterrupted clean run, and a restarted controller rebuilds
+        the same fleet view from its event journal.
+
+    raftserve fleet --root DIR [--min-replicas N] [--max-replicas N]
+        Elastic autoscaling control plane: boots/retires `raftserve
+        serve` replica subprocesses against directory-shaped stores
+        under --root, watches queue depth, admission p99 and quota
+        pressure against scale thresholds (hysteresis + cooldown),
+        folds preempted members' WAL mirrors into survivors, and
+        fronts the fleet with the replica router on --port.  Every
+        membership transition is journaled to --root/fleet.events.jsonl
+        before it is acted on, so a killed controller recovers its
+        fleet view on restart.
+
     raftserve distill --store-dir DIR --surrogate-dir DIR \\
                       [--tenant NAME] [--steps N] [--hidden 32,32]
         Train the learned read tier offline from the result-store
@@ -139,6 +165,37 @@ def _build_fowts(args):
 def cmd_soak(args) -> int:
     from raft_tpu.serve import soak
     from raft_tpu.serve.config import ServeConfig
+
+    if args.elastic:
+        if not args.journal_dir:
+            print("raftserve soak --elastic needs --journal-dir "
+                  "(the fleet root)", file=sys.stderr)
+            return 2
+        report = soak.run_elastic(
+            args.design, root=args.journal_dir,
+            min_freq=args.min_freq, max_freq=args.max_freq,
+            dfreq=args.dfreq, checkpoint_every=args.checkpoint_every,
+            seed=args.seed, timeout_s=args.timeout)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=1, default=str)
+        fl = report["fleet"]
+        print(f"raftserve elastic soak: "
+              f"{'OK' if report['ok'] else 'FAILED'} — replicas "
+              f"{report['min_replicas']}->{fl['fleet_replicas_max']} "
+              f"(ups={fl['fleet_scale_ups']} "
+              f"downs={fl['fleet_scale_downs']} "
+              f"preemptions={fl['fleet_preemptions']} "
+              f"folds={fl['fleet_folds']}), "
+              f"{report['completed']}/{report['n_requests']} "
+              f"digest-exact, {fl['fleet_scale_loss_count']} lost; "
+              f"descent resumed from step "
+              f"{fl['fleet_resumed_from_step']} digest "
+              f"{'MATCH' if not fl['fleet_preempt_digest_mismatch'] else 'MISMATCH'}, "
+              f"ckpt sheds={fl['fleet_ckpt_shed']}; controller view "
+              f"{'recovered' if report['controller_view_ok'] else 'DIVERGED'}, "
+              f"{report['wall_s']:.1f}s")
+        return 0 if report["ok"] else 1
 
     if args.preempt:
         if not (args.journal_dir and args.ckpt_dir and args.store_dir):
@@ -380,6 +437,33 @@ def make_serve_server(service, host: str = "127.0.0.1", port: int = 0, *,
                 threading.Thread(target=srv.shutdown,
                                  daemon=True).start()
                 return
+            if self.path == "/recover":
+                # runtime WAL fold: replay a dead peer's journal/mirror
+                # directory into THIS running replica (recover() claims
+                # fresh seqs for collisions and re-journals the foreign
+                # admits).  The fleet controller's preemption path —
+                # the survivor adopts the preempted member's accepted-
+                # unfinished work, descents resuming from their newest
+                # valid checkpoints.
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    src = str(doc["journal_dir"])
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    info = service.recover(src)
+                except errors.ModelConfigError as e:
+                    self._send(400, e.context())
+                    return
+                for t in info["tickets"].values():
+                    _track(t)
+                self._send(200, {k: info.get(k) for k in
+                                 ("recovered", "replayed", "deduped",
+                                  "corrupt", "ckpt_records", "mirror")})
+                return
             if self.path in ("/optimize", "/farm"):
                 # long-request tenants: /optimize takes bounds +
                 # objective and answers with a journaled
@@ -504,11 +588,15 @@ def cmd_serve(args) -> int:
     from raft_tpu.serve import journal as wal
 
     fowt, coarse = _build_fowts(args)
-    cfg = ServeConfig(batch_cases=args.batch, queue_max=args.queue_max,
+    cfg = ServeConfig(nIter=args.niter, tol=args.tol,
+                      fp_chunk=args.fp_chunk,
+                      batch_cases=args.batch, queue_max=args.queue_max,
                       deadline_s=args.deadline,
                       batch_deadline_s=args.batch_deadline,
                       journal_dir=args.journal_dir,
                       mirror_dirs=tuple(args.mirror_dir or ()),
+                      ckpt_dir=args.ckpt_dir,
+                      checkpoint_every=args.checkpoint_every,
                       store_dir=args.store_dir,
                       warm_start=bool(args.warm_start),
                       surrogate_dir=args.surrogate_dir,
@@ -560,7 +648,7 @@ def cmd_serve(args) -> int:
 
     signal.signal(signal.SIGTERM, _on_sigterm)
     print(f"raftserve: http://{host}:{port}/  (submit, optimize, farm, "
-          f"result, drain, "
+          f"result, drain, recover, "
           f"stats, healthz, metrics; design={args.design}, "
           f"batch={cfg.batch_cases}, "
           f"ladder={'->'.join(service.ladder)}, "
@@ -573,6 +661,53 @@ def cmd_serve(args) -> int:
         srv.server_close()
         summary = service.stop()
         print(json.dumps(summary, indent=1, default=str))
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    import signal
+    import threading
+
+    from raft_tpu.serve.fleet import FleetConfig, FleetController
+    from raft_tpu.serve.router import make_server
+
+    cfg = FleetConfig(
+        root=args.root, design=args.design, min_freq=args.min_freq,
+        max_freq=args.max_freq, dfreq=args.dfreq,
+        batch_cases=args.batch, queue_max=args.queue_max or 64,
+        nIter=args.niter, tol=args.tol, fp_chunk=args.fp_chunk,
+        ckpt_dir=args.ckpt_dir,
+        checkpoint_every=args.checkpoint_every,
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        scale_up_queue_depth=args.scale_up_queue_depth,
+        scale_down_queue_depth=args.scale_down_queue_depth,
+        hysteresis_ticks=args.hysteresis, cooldown_s=args.cooldown,
+        tick_s=args.tick, host=args.host)
+    ctl = FleetController(cfg).start()
+    # the fleet's front door is the controller's router: callers see
+    # one logical service while membership changes under them
+    srv = make_server(ctl.router, args.host, args.port)
+    host, port = srv.server_address[:2]
+    print(f"raftserve fleet: http://{host}:{port}/  (router front "
+          f"door; {len(ctl.live())} replica(s) live, "
+          f"min={cfg.min_replicas} max={cfg.max_replicas}, "
+          f"up@depth>={cfg.scale_up_queue_depth:g} "
+          f"down@depth<={cfg.scale_down_queue_depth:g}, "
+          f"hysteresis={cfg.hysteresis_ticks} tick(s), "
+          f"cooldown={cfg.cooldown_s:g}s, root={ctl.root})", flush=True)
+
+    def _shutdown(signum=None, frame=None):            # pragma: no cover
+        threading.Thread(target=srv.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:                          # pragma: no cover
+        pass
+    finally:
+        srv.server_close()
+        counts = ctl.stop(drain=True)
+        print(json.dumps(counts, indent=1, default=str))
     return 0
 
 
@@ -740,6 +875,15 @@ def main(argv=None) -> int:
     p.add_argument("--kill-at-step", type=int, default=None,
                    help="descent step the kill@optimize fault fires "
                         "at (--preempt; default: checkpoint-every)")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic-fleet soak: a FleetController under "
+                        "an open-loop load ramp — scale-up, a "
+                        "kill@fleet preemption wave whose WAL mirror "
+                        "folds into a survivor (descent resumes from "
+                        "checkpoint under enospc@checkpoint), load "
+                        "drop, drained scale-down — gate zero accepted-"
+                        "request loss + bit-for-bit digest parity "
+                        "(--journal-dir is the fleet root)")
     p.set_defaults(fn=cmd_soak)
 
     p = sub.add_parser("serve", help="HTTP endpoint over SweepService")
@@ -750,6 +894,21 @@ def main(argv=None) -> int:
                    help="default per-request deadline (s)")
     p.add_argument("--batch-deadline", type=float, default=60.0,
                    help="watchdog deadline per in-flight batch (s)")
+    p.add_argument("--niter", type=int, default=10,
+                   help="fixed-point solver iterations — fleet "
+                        "replicas must agree for digest parity")
+    p.add_argument("--tol", type=float, default=0.01,
+                   help="fixed-point convergence tolerance")
+    p.add_argument("--fp-chunk", type=int, default=2,
+                   help="frequency-chunk width of the solver scan")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint-store directory: descents write "
+                        "resumable segments here (share it across a "
+                        "fleet so a survivor resumes a preempted "
+                        "replica's descent)")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="descent steps per checkpointed segment "
+                        "(0 = off; needs --ckpt-dir)")
     p.add_argument("--journal-dir", default=None,
                    help="write-ahead request journal directory; a "
                         "journal left by a predecessor is recovered "
@@ -813,6 +972,42 @@ def main(argv=None) -> int:
     p.add_argument("--json", help="write the distill report to this "
                                   "path")
     p.set_defaults(fn=cmd_distill)
+
+    p = sub.add_parser("fleet",
+                       help="elastic autoscaling control plane over "
+                            "raftserve replica subprocesses "
+                            "(raft_tpu/serve/fleet.py)")
+    _add_model_args(p)
+    p.add_argument("--root", required=True,
+                   help="fleet root directory: per-replica journal + "
+                        "mirror trees, the shared checkpoint store, "
+                        "and the controller's event journal")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8700,
+                   help="router front-door port")
+    p.add_argument("--niter", type=int, default=10)
+    p.add_argument("--tol", type=float, default=0.01)
+    p.add_argument("--fp-chunk", type=int, default=2)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="shared checkpoint store (descents resume "
+                        "across replicas after a preemption)")
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--scale-up-queue-depth", type=float, default=4.0,
+                   help="scale up when any backend's queue depth "
+                        "reaches this")
+    p.add_argument("--scale-down-queue-depth", type=float, default=0.0,
+                   help="scale down when the max queue depth is at or "
+                        "below this")
+    p.add_argument("--hysteresis", type=int, default=2,
+                   help="consecutive breaching ticks before a scale "
+                        "decision acts")
+    p.add_argument("--cooldown", type=float, default=5.0,
+                   help="minimum seconds between scale actions")
+    p.add_argument("--tick", type=float, default=0.5,
+                   help="control-loop cadence (s)")
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser("route", help="replica router over N raftserve "
                                      "backends (health checks, "
